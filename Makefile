@@ -1,0 +1,15 @@
+CXX ?= g++
+CXXFLAGS ?= -O2 -g -std=c++17 -fPIC -Wall -Wextra -pthread
+BUILD := build
+LIB := $(BUILD)/libparsec_core.so
+
+all: $(LIB)
+
+$(LIB): native/core.cpp native/parsec_core.h
+	@mkdir -p $(BUILD)
+	$(CXX) $(CXXFLAGS) -shared -o $@ native/core.cpp
+
+clean:
+	rm -rf $(BUILD)
+
+.PHONY: all clean

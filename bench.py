@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Framework benchmark driver.  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measurement ladder (BASELINE.md): this currently reports rung 1 —
+task-dispatch p50 µs on the Ex04_ChainData configuration (single-process
+chain of dependent tasks, native noop bodies, i.e. pure runtime dispatch
+overhead: select → execute → release_deps → next task ready).
+
+The reference publishes no in-tree numbers (BASELINE.md); `vs_baseline`
+is computed against a 5 µs/task dispatch budget, the commonly-cited
+per-task overhead regime of the reference runtime class (values > 1.0 are
+better than that budget).
+"""
+import json
+import sys
+
+import numpy as np
+
+import parsec_tpu as pt
+
+
+def bench_dispatch_chain(nb_tasks: int = 20000, reps: int = 5):
+    """Ex04-style chain: Task(k) <- Task(k-1), noop bodies, 1 worker."""
+    p50s = []
+    for _ in range(reps):
+        with pt.Context(nb_workers=1) as ctx:
+            ctx.profile_enable(True)
+            ctx.register_arena("t", 8)
+            tp = pt.Taskpool(ctx, globals={"NB": nb_tasks - 1})
+            k = pt.L("k")
+            tc = tp.task_class("Task")
+            tc.param("k", 0, pt.G("NB"))
+            tc.flow("A", "RW",
+                    pt.In(None, guard=(k == 0)),
+                    pt.In(pt.Ref("Task", k - 1, flow="A")),
+                    pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                           guard=(k < pt.G("NB"))),
+                    arena="t")
+            tc.body_noop()
+            tp.run()
+            tp.wait()
+            ev = ctx.profile_take()
+        # exec-begin timestamps, ordered by task index k
+        begins = ev[(ev[:, 0] == 0) & (ev[:, 1] == 0)]
+        order = np.argsort(begins[:, 3])
+        t = begins[order, 4]
+        deltas_us = np.diff(t) / 1e3
+        # skip warmup portion
+        deltas_us = deltas_us[len(deltas_us) // 10:]
+        p50s.append(float(np.percentile(deltas_us, 50)))
+    return min(p50s)
+
+
+def main():
+    p50_us = bench_dispatch_chain()
+    budget_us = 5.0
+    print(json.dumps({
+        "metric": "task_dispatch_p50",
+        "value": round(p50_us, 3),
+        "unit": "us",
+        "vs_baseline": round(budget_us / p50_us, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

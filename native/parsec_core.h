@@ -1,0 +1,207 @@
+/* parsec_core.h — C API of the native tpu-parsec core runtime.
+ *
+ * The native core owns the hot path of the framework: task-class
+ * interpretation, dependency tracking, ready-task scheduling across worker
+ * threads, the chore (incarnation) execution protocol, and local termination
+ * detection.  It corresponds to the reference runtime's L0+L3 layers
+ * (parsec/parsec.c, parsec/scheduling.c, parsec/parsec_internal.h — see
+ * SURVEY.md §2.1/§2.4), re-designed: where the reference compiles each JDF
+ * task class to C code (parsec/interfaces/ptg/ptg-compiler/jdf2c.c), this
+ * core *interprets* a compact table-driven spec whose scalar expressions
+ * (ranges, guards, indices, priorities) are bytecode for a tiny stack VM.
+ * Python (or the JDF compiler) emits the spec; no codegen round-trip needed,
+ * and the interpreter cost is O(tens of ns) per expression — far below the
+ * per-task dispatch budget.
+ *
+ * Everything here is extern "C" and ctypes-friendly: opaque pointers +
+ * int64 arrays only.
+ */
+#ifndef PTC_CORE_H
+#define PTC_CORE_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------------------------------------------------------- limits */
+#define PTC_MAX_LOCALS 20   /* matches reference MAX_LOCAL_COUNT */
+#define PTC_MAX_FLOWS  20   /* matches reference MAX_PARAM_COUNT */
+
+/* ------------------------------------------------------- hook protocol
+ * Return protocol of a task body (chore), mirroring the reference's
+ * parsec_hook_return_t (parsec/scheduling.c:124-203 consumption):       */
+enum {
+  PTC_HOOK_DONE    = 0,   /* body executed, complete the task            */
+  PTC_HOOK_AGAIN   = 1,   /* not executed, reschedule on same device     */
+  PTC_HOOK_ASYNC   = 2,   /* ownership transferred (device queue); the
+                             owner must call ptc_task_complete() later   */
+  PTC_HOOK_NEXT    = 3,   /* try the next chore incarnation              */
+  PTC_HOOK_DISABLE = 4,   /* disable this chore for the class; try next  */
+  PTC_HOOK_ERROR   = -1
+};
+
+/* flow access flags */
+enum {
+  PTC_FLOW_READ  = 1,
+  PTC_FLOW_WRITE = 2,
+  PTC_FLOW_RW    = 3,
+  PTC_FLOW_CTL   = 4
+};
+
+/* chore body kinds (spec "chore" entries) */
+enum {
+  PTC_BODY_NOOP     = 0,  /* arg ignored */
+  PTC_BODY_CB       = 1,  /* arg = body-callback id (ptc_register_body) */
+  PTC_BODY_DEVICE   = 2   /* arg = device queue id: push + return ASYNC */
+};
+
+/* device types for chores (scheduler picks first enabled/accepting) */
+enum {
+  PTC_DEV_CPU = 0,
+  PTC_DEV_TPU = 1,
+  PTC_DEV_RECURSIVE = 2
+};
+
+/* ------------------------------------------------------- expression VM
+ * An expr is encoded in a spec as [nwords, w0, w1, ...]; nwords==0 means
+ * the constant 0 (also used for "no guard" == always true, by convention
+ * guards with nwords==0 evaluate to 1 — see PTC_EXPR_EMPTY_TRUE use).
+ * Stack machine over int64.  Operand-carrying opcodes consume the next
+ * word.                                                                 */
+enum {
+  PTC_OP_IMM    = 1,   /* push operand                                  */
+  PTC_OP_LOCAL  = 2,   /* push locals[operand]                          */
+  PTC_OP_GLOBAL = 3,   /* push taskpool globals[operand]                */
+  PTC_OP_ADD    = 4,
+  PTC_OP_SUB    = 5,
+  PTC_OP_MUL    = 6,
+  PTC_OP_DIV    = 7,
+  PTC_OP_MOD    = 8,
+  PTC_OP_NEG    = 9,
+  PTC_OP_EQ     = 10,
+  PTC_OP_NE     = 11,
+  PTC_OP_LT     = 12,
+  PTC_OP_LE     = 13,
+  PTC_OP_GT     = 14,
+  PTC_OP_GE     = 15,
+  PTC_OP_AND    = 16,
+  PTC_OP_OR     = 17,
+  PTC_OP_NOT    = 18,
+  PTC_OP_SELECT = 19,  /* pop b, a, c; push c ? a : b                   */
+  PTC_OP_MIN    = 20,
+  PTC_OP_MAX    = 21,
+  PTC_OP_CALL   = 22   /* push expr-callback(operand)(locals, globals)  */
+};
+
+/* ------------------------------------------------------- opaque types */
+typedef struct ptc_context  ptc_context_t;
+typedef struct ptc_taskpool ptc_taskpool_t;
+typedef struct ptc_task     ptc_task_t;
+typedef struct ptc_data     ptc_data_t;
+typedef struct ptc_copy     ptc_copy_t;
+
+/* ------------------------------------------------------- callbacks */
+/* inline-expression escape hatch (JDF %{ ... %}) */
+typedef int64_t (*ptc_expr_cb)(void *user, const int64_t *locals,
+                               int32_t nb_locals, const int64_t *globals);
+/* task body; runs on a worker thread */
+typedef int32_t (*ptc_body_cb)(void *user, ptc_task_t *task);
+/* data-collection vtable pieces (Python-defined collections) */
+typedef uint32_t   (*ptc_rank_of_cb)(void *user, const int64_t *idx, int32_t n);
+typedef ptc_data_t*(*ptc_data_of_cb)(void *user, const int64_t *idx, int32_t n);
+
+/* ------------------------------------------------------- context */
+ptc_context_t *ptc_context_new(int32_t nb_workers);
+void ptc_context_destroy(ptc_context_t *ctx);
+int32_t ptc_context_nb_workers(ptc_context_t *ctx);
+/* start worker threads (idempotent) */
+int32_t ptc_context_start(ptc_context_t *ctx);
+/* block until every added taskpool has completed */
+int32_t ptc_context_wait(ptc_context_t *ctx);
+/* non-blocking: 1 if all taskpools complete, 0 otherwise */
+int32_t ptc_context_test(ptc_context_t *ctx);
+/* scheduler selection, by name ("lfq", "gd", "ap"); default lfq */
+int32_t ptc_context_set_scheduler(ptc_context_t *ctx, const char *name);
+
+/* registries: return non-negative id, or -1 on error */
+int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user);
+int32_t ptc_register_body(ptc_context_t *ctx, ptc_body_cb cb, void *user);
+int32_t ptc_register_collection(ptc_context_t *ctx, uint32_t nodes,
+                                uint32_t myrank, ptc_rank_of_cb rank_of,
+                                ptc_data_of_cb data_of, void *user);
+/* built-in linear host collection: key k -> base + k*elem_size, rank k%nodes */
+int32_t ptc_register_linear_collection(ptc_context_t *ctx, uint32_t nodes,
+                                       uint32_t myrank, void *base,
+                                       int64_t nb_elems, int64_t elem_size);
+/* arena: size-class allocator for WRITE-only flow outputs */
+int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size);
+
+/* set my rank / world for affinity filtering (default 0/1) */
+void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes);
+
+/* ------------------------------------------------------- taskpool */
+ptc_taskpool_t *ptc_tp_new(ptc_context_t *ctx, int32_t nb_globals,
+                           const int64_t *globals);
+void ptc_tp_destroy(ptc_taskpool_t *tp);
+/* register a task class from its spec blob; returns class id */
+int32_t ptc_tp_add_class(ptc_taskpool_t *tp, const char *name,
+                         const int64_t *spec, int64_t spec_len);
+/* enumerate startup tasks, install task counts, release to scheduler */
+int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp);
+/* block until this taskpool completed */
+int32_t ptc_tp_wait(ptc_taskpool_t *tp);
+int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp);       /* remaining local tasks */
+int64_t ptc_tp_nb_total_tasks(ptc_taskpool_t *tp); /* as counted at startup */
+/* keep a taskpool alive for dynamic insertion (DTD): while open, reaching
+ * zero remaining tasks does not complete it */
+void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open);
+
+/* ------------------------------------------------------- data */
+/* create a host-backed datum with a single host copy */
+ptc_data_t *ptc_data_new(int64_t key, void *ptr, int64_t size);
+void ptc_data_destroy(ptc_data_t *d);
+ptc_copy_t *ptc_data_host_copy(ptc_data_t *d);
+void    *ptc_copy_ptr(ptc_copy_t *c);
+int64_t  ptc_copy_size(ptc_copy_t *c);
+int64_t  ptc_copy_handle(ptc_copy_t *c);
+void     ptc_copy_set_handle(ptc_copy_t *c, int64_t handle);
+int32_t  ptc_copy_version(ptc_copy_t *c);
+
+/* ------------------------------------------------------- task accessors */
+int64_t  ptc_task_local(ptc_task_t *t, int32_t i);
+int32_t  ptc_task_class(ptc_task_t *t);
+int32_t  ptc_task_priority(ptc_task_t *t);
+void    *ptc_task_data_ptr(ptc_task_t *t, int32_t flow);
+ptc_copy_t *ptc_task_copy(ptc_task_t *t, int32_t flow);
+ptc_taskpool_t *ptc_task_taskpool(ptc_task_t *t);
+int64_t  ptc_tp_global(ptc_taskpool_t *tp, int32_t i);
+
+/* ------------------------------------------------------- device queues
+ * A device queue decouples ASYNC chores from workers: the chore body
+ * (PTC_BODY_DEVICE) pushes the task and returns ASYNC; a device manager
+ * thread (Python/TPU side) pops, executes, then calls ptc_task_complete.
+ * This is the seam the TPU device module plugs into (reference analog:
+ * the CUDA manager thread + pending fifo, device_cuda_module.c:2563).  */
+int32_t ptc_device_queue_new(ptc_context_t *ctx);
+/* blocking pop with timeout (ms); NULL on timeout or shutdown */
+ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms);
+/* completion entry point for ASYNC owners (any thread) */
+void ptc_task_complete(ptc_context_t *ctx, ptc_task_t *task);
+
+/* ------------------------------------------------------- profiling
+ * Minimal paired-event trace: per-worker buffers of (key, begin/end,
+ * class, taskhash, t_ns).  ptc_profile_take copies out and clears.      */
+void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
+/* returns number of int64 words written into out (5 per event), up to cap */
+int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
+
+/* version / build info */
+const char *ptc_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PTC_CORE_H */

@@ -1,0 +1,142 @@
+"""ctypes bindings to the native core (build/libparsec_core.so).
+
+Auto-builds via `make` when the shared library is missing or older than its
+sources.  All Python→native traffic goes through this module; keep the ABI in
+sync with native/parsec_core.h.
+"""
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO, "build", "libparsec_core.so")
+_SOURCES = [
+    os.path.join(_REPO, "native", "core.cpp"),
+    os.path.join(_REPO, "native", "parsec_core.h"),
+]
+
+# hook protocol (parsec_core.h)
+HOOK_DONE = 0
+HOOK_AGAIN = 1
+HOOK_ASYNC = 2
+HOOK_NEXT = 3
+HOOK_DISABLE = 4
+HOOK_ERROR = -1
+
+FLOW_READ = 1
+FLOW_WRITE = 2
+FLOW_RW = 3
+FLOW_CTL = 4
+
+BODY_NOOP = 0
+BODY_CB = 1
+BODY_DEVICE = 2
+
+DEV_CPU = 0
+DEV_TPU = 1
+DEV_RECURSIVE = 2
+
+# expression VM opcodes
+OP_IMM = 1
+OP_LOCAL = 2
+OP_GLOBAL = 3
+OP_ADD = 4
+OP_SUB = 5
+OP_MUL = 6
+OP_DIV = 7
+OP_MOD = 8
+OP_NEG = 9
+OP_EQ = 10
+OP_NE = 11
+OP_LT = 12
+OP_LE = 13
+OP_GT = 14
+OP_GE = 15
+OP_AND = 16
+OP_OR = 17
+OP_NOT = 18
+OP_SELECT = 19
+OP_MIN = 20
+OP_MAX = 21
+OP_CALL = 22
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(s) > lib_mtime for s in _SOURCES
+               if os.path.exists(s))
+
+
+def _build() -> None:
+    subprocess.run(["make", "-s"], cwd=_REPO, check=True)
+
+
+if _needs_build():
+    _build()
+
+lib = C.CDLL(_LIB_PATH)
+
+# callback signatures
+EXPR_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.POINTER(C.c_int64), C.c_int32,
+                        C.POINTER(C.c_int64))
+BODY_CB_T = C.CFUNCTYPE(C.c_int32, C.c_void_p, C.c_void_p)
+RANK_OF_CB_T = C.CFUNCTYPE(C.c_uint32, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
+DATA_OF_CB_T = C.CFUNCTYPE(C.c_void_p, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
+
+_sigs = {
+    "ptc_version": (C.c_char_p, []),
+    "ptc_context_new": (C.c_void_p, [C.c_int32]),
+    "ptc_context_destroy": (None, [C.c_void_p]),
+    "ptc_context_nb_workers": (C.c_int32, [C.c_void_p]),
+    "ptc_context_start": (C.c_int32, [C.c_void_p]),
+    "ptc_context_wait": (C.c_int32, [C.c_void_p]),
+    "ptc_context_test": (C.c_int32, [C.c_void_p]),
+    "ptc_context_set_scheduler": (C.c_int32, [C.c_void_p, C.c_char_p]),
+    "ptc_context_set_rank": (None, [C.c_void_p, C.c_uint32, C.c_uint32]),
+    "ptc_register_expr_cb": (C.c_int32, [C.c_void_p, EXPR_CB_T, C.c_void_p]),
+    "ptc_register_body": (C.c_int32, [C.c_void_p, BODY_CB_T, C.c_void_p]),
+    "ptc_register_collection": (C.c_int32, [C.c_void_p, C.c_uint32, C.c_uint32,
+                                            RANK_OF_CB_T, DATA_OF_CB_T, C.c_void_p]),
+    "ptc_register_linear_collection": (C.c_int32, [C.c_void_p, C.c_uint32,
+                                                   C.c_uint32, C.c_void_p,
+                                                   C.c_int64, C.c_int64]),
+    "ptc_register_arena": (C.c_int32, [C.c_void_p, C.c_int64]),
+    "ptc_tp_new": (C.c_void_p, [C.c_void_p, C.c_int32, C.POINTER(C.c_int64)]),
+    "ptc_tp_destroy": (None, [C.c_void_p]),
+    "ptc_tp_add_class": (C.c_int32, [C.c_void_p, C.c_char_p,
+                                     C.POINTER(C.c_int64), C.c_int64]),
+    "ptc_context_add_taskpool": (C.c_int32, [C.c_void_p, C.c_void_p]),
+    "ptc_tp_wait": (C.c_int32, [C.c_void_p]),
+    "ptc_tp_nb_tasks": (C.c_int64, [C.c_void_p]),
+    "ptc_tp_nb_total_tasks": (C.c_int64, [C.c_void_p]),
+    "ptc_tp_set_open": (None, [C.c_void_p, C.c_int32]),
+    "ptc_tp_global": (C.c_int64, [C.c_void_p, C.c_int32]),
+    "ptc_data_new": (C.c_void_p, [C.c_int64, C.c_void_p, C.c_int64]),
+    "ptc_data_destroy": (None, [C.c_void_p]),
+    "ptc_data_host_copy": (C.c_void_p, [C.c_void_p]),
+    "ptc_copy_ptr": (C.c_void_p, [C.c_void_p]),
+    "ptc_copy_size": (C.c_int64, [C.c_void_p]),
+    "ptc_copy_handle": (C.c_int64, [C.c_void_p]),
+    "ptc_copy_set_handle": (None, [C.c_void_p, C.c_int64]),
+    "ptc_copy_version": (C.c_int32, [C.c_void_p]),
+    "ptc_task_local": (C.c_int64, [C.c_void_p, C.c_int32]),
+    "ptc_task_class": (C.c_int32, [C.c_void_p]),
+    "ptc_task_priority": (C.c_int32, [C.c_void_p]),
+    "ptc_task_data_ptr": (C.c_void_p, [C.c_void_p, C.c_int32]),
+    "ptc_task_copy": (C.c_void_p, [C.c_void_p, C.c_int32]),
+    "ptc_task_taskpool": (C.c_void_p, [C.c_void_p]),
+    "ptc_device_queue_new": (C.c_int32, [C.c_void_p]),
+    "ptc_device_pop": (C.c_void_p, [C.c_void_p, C.c_int32, C.c_int32]),
+    "ptc_task_complete": (None, [C.c_void_p, C.c_void_p]),
+    "ptc_profile_enable": (None, [C.c_void_p, C.c_int32]),
+    "ptc_profile_take": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
+}
+
+for _name, (_res, _args) in _sigs.items():
+    fn = getattr(lib, _name)
+    fn.restype = _res
+    fn.argtypes = _args

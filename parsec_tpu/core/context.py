@@ -1,0 +1,176 @@
+"""Context: process-wide runtime handle (reference: parsec_context_t,
+parsec/runtime.h parsec_init/parsec_context_* — SURVEY.md §2.4/§3.1).
+
+Owns the native context (worker threads, scheduler, registries), Python-side
+keep-alives for ctypes callbacks and pinned buffers, and the name→id maps for
+data collections and arenas.
+"""
+from __future__ import annotations
+
+import ctypes as C
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import _native as N
+
+
+class Data:
+    """A named datum with a host copy (reference: parsec_data_t +
+    parsec_data_copy_t, parsec/data_internal.h:35-83)."""
+
+    def __init__(self, key: int, array: np.ndarray):
+        if not array.flags["C_CONTIGUOUS"]:
+            array = np.ascontiguousarray(array)
+        self.array = array  # keep-alive
+        self.key = key
+        self._ptr = N.lib.ptc_data_new(
+            key, array.ctypes.data_as(C.c_void_p), array.nbytes)
+
+    @property
+    def version(self) -> int:
+        if self._ptr is None:
+            raise RuntimeError("Data already destroyed")
+        return N.lib.ptc_copy_version(N.lib.ptc_data_host_copy(self._ptr))
+
+    def destroy(self):
+        if self._ptr:
+            N.lib.ptc_data_destroy(self._ptr)
+            self._ptr = None
+
+
+class Context:
+    def __init__(self, nb_workers: int = 0, scheduler: str = "lfq"):
+        self._ptr = N.lib.ptc_context_new(nb_workers)
+        if scheduler != "lfq":
+            N.lib.ptc_context_set_scheduler(self._ptr, scheduler.encode())
+        # keep-alives: ctypes callbacks must outlive the native context
+        self._expr_cbs: List = []
+        self._body_cbs: List = []
+        self._coll_cbs: List = []
+        self._datas: List[Data] = []
+        self._buffers: List[np.ndarray] = []
+        self.collections: Dict[str, int] = {}
+        self.arenas: Dict[str, int] = {}
+        self._destroyed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        N.lib.ptc_context_start(self._ptr)
+
+    def wait(self):
+        N.lib.ptc_context_wait(self._ptr)
+
+    def test(self) -> bool:
+        return bool(N.lib.ptc_context_test(self._ptr))
+
+    def destroy(self):
+        if not self._destroyed:
+            self._destroyed = True
+            N.lib.ptc_context_destroy(self._ptr)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.destroy()
+
+    @property
+    def nb_workers(self) -> int:
+        return N.lib.ptc_context_nb_workers(self._ptr)
+
+    def set_rank(self, myrank: int, nodes: int):
+        N.lib.ptc_context_set_rank(self._ptr, myrank, nodes)
+
+    # ------------------------------------------------------------ registries
+    def register_expr_cb(self, fn: Callable) -> int:
+        cb = N.EXPR_CB_T(fn)
+        self._expr_cbs.append(cb)
+        return N.lib.ptc_register_expr_cb(self._ptr, cb, None)
+
+    def register_body_cb(self, fn: Callable) -> int:
+        cb = N.BODY_CB_T(fn)
+        self._body_cbs.append(cb)
+        return N.lib.ptc_register_body(self._ptr, cb, None)
+
+    def data(self, key: int, array: np.ndarray) -> Data:
+        d = Data(key, array)
+        self._datas.append(d)
+        return d
+
+    def register_linear_collection(self, name: str, array: np.ndarray,
+                                   elem_size: Optional[int] = None,
+                                   nodes: int = 1, myrank: int = 0) -> int:
+        """Built-in 1-D host collection: key k → base + k*elem_size,
+        rank_of(k) = k % nodes.  Evaluated fully natively (no GIL on the
+        dependency path) — the bench-path equivalent of a user collection."""
+        if not array.flags["C_CONTIGUOUS"]:
+            raise ValueError("linear collection array must be C-contiguous")
+        if elem_size is None:
+            elem_size = array.itemsize * (array.size // max(1, array.shape[0]))
+        nb = array.nbytes // elem_size
+        self._buffers.append(array)
+        dc = N.lib.ptc_register_linear_collection(
+            self._ptr, nodes, myrank, array.ctypes.data_as(C.c_void_p),
+            nb, elem_size)
+        self.collections[name] = dc
+        return dc
+
+    def register_collection(self, name: str, coll) -> int:
+        """Register a Python data collection (duck-typed vtable: rank_of(*idx)
+        → int, data_of(*idx) → Data).  Reference analog:
+        parsec_data_collection_t (parsec/include/parsec/data_distribution.h).
+        """
+        def _rank_of(user, idx, n):
+            return coll.rank_of(*[idx[i] for i in range(n)])
+
+        def _data_of(user, idx, n):
+            d = coll.data_of(*[idx[i] for i in range(n)])
+            return d._ptr if d is not None else None
+
+        rcb = N.RANK_OF_CB_T(_rank_of)
+        dcb = N.DATA_OF_CB_T(_data_of)
+        self._coll_cbs.append((rcb, dcb, coll))
+        dc = N.lib.ptc_register_collection(
+            self._ptr, getattr(coll, "nodes", 1), getattr(coll, "myrank", 0),
+            rcb, dcb, None)
+        self.collections[name] = dc
+        return dc
+
+    def register_arena(self, name: str, elem_size: int) -> int:
+        aid = N.lib.ptc_register_arena(self._ptr, elem_size)
+        self.arenas[name] = aid
+        return aid
+
+    # ------------------------------------------------------------ devices
+    def device_queue_new(self) -> int:
+        return N.lib.ptc_device_queue_new(self._ptr)
+
+    def device_pop(self, qid: int, timeout_ms: int = 100):
+        return N.lib.ptc_device_pop(self._ptr, qid, timeout_ms)
+
+    def task_complete(self, task_ptr):
+        N.lib.ptc_task_complete(self._ptr, task_ptr)
+
+    # ------------------------------------------------------------ profiling
+    def profile_enable(self, enable: bool = True):
+        N.lib.ptc_profile_enable(self._ptr, 1 if enable else 0)
+
+    def profile_take(self) -> np.ndarray:
+        """Drain profiling buffers; returns an (n, 5) int64 array of
+        (key, phase, class_id, local0, t_ns).  Loops with a fixed-size
+        buffer until the native side reports empty."""
+        chunk_words = (1 << 16) * 5
+        buf = (C.c_int64 * chunk_words)()
+        parts = []
+        while True:
+            n = N.lib.ptc_profile_take(self._ptr, buf, chunk_words)
+            if n <= 0:
+                break
+            parts.append(np.ctypeslib.as_array(buf, shape=(chunk_words,))[:n]
+                         .copy())
+            if n < chunk_words:
+                break
+        if not parts:
+            return np.empty((0, 5), dtype=np.int64)
+        return np.concatenate(parts).reshape(-1, 5)

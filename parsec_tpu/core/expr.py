@@ -1,0 +1,187 @@
+"""Symbolic scalar expressions compiled to the native expression-VM bytecode.
+
+These play the role of the reference's JDF expressions (ranges, guards,
+affinity indices, priorities — parsec/interfaces/ptg/ptg-compiler/jdf.h
+expression trees compiled by jdf2c): here they are small Python AST objects
+with operator overloading, compiled to the stack-VM bytecode interpreted by
+the native core (native/parsec_core.h PTC_OP_*).
+
+`L("k")` references a task local, `G("NB")` a taskpool global; `select(c, a,
+b)` is the ternary; `call(fn)` escapes to a Python callback (the analog of
+JDF inline `%{ ... %}` C expressions).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Union
+
+from .. import _native as N
+
+ExprLike = Union["Expr", int]
+
+
+class Expr:
+    """Base class; supports arithmetic/comparison operator overloading."""
+
+    def _emit(self, out: List[int], ctx: "CompileCtx") -> None:
+        raise NotImplementedError
+
+    # arithmetic
+    def __add__(self, o): return BinOp(N.OP_ADD, self, o)
+    def __radd__(self, o): return BinOp(N.OP_ADD, o, self)
+    def __sub__(self, o): return BinOp(N.OP_SUB, self, o)
+    def __rsub__(self, o): return BinOp(N.OP_SUB, o, self)
+    def __mul__(self, o): return BinOp(N.OP_MUL, self, o)
+    def __rmul__(self, o): return BinOp(N.OP_MUL, o, self)
+    def __floordiv__(self, o): return BinOp(N.OP_DIV, self, o)
+    def __rfloordiv__(self, o): return BinOp(N.OP_DIV, o, self)
+    def __mod__(self, o): return BinOp(N.OP_MOD, self, o)
+    def __rmod__(self, o): return BinOp(N.OP_MOD, o, self)
+    def __neg__(self): return UnOp(N.OP_NEG, self)
+    # comparisons
+    def __eq__(self, o): return BinOp(N.OP_EQ, self, o)  # type: ignore
+    def __ne__(self, o): return BinOp(N.OP_NE, self, o)  # type: ignore
+    def __lt__(self, o): return BinOp(N.OP_LT, self, o)
+    def __le__(self, o): return BinOp(N.OP_LE, self, o)
+    def __gt__(self, o): return BinOp(N.OP_GT, self, o)
+    def __ge__(self, o): return BinOp(N.OP_GE, self, o)
+    # boolean combinators (use & | ~ since `and`/`or` can't be overloaded)
+    def __and__(self, o): return BinOp(N.OP_AND, self, o)
+    def __or__(self, o): return BinOp(N.OP_OR, self, o)
+    def __invert__(self): return UnOp(N.OP_NOT, self)
+    def __hash__(self):
+        return id(self)
+
+
+def _wrap(v: ExprLike) -> Expr:
+    if isinstance(v, Expr):
+        return v
+    if isinstance(v, (int,)):
+        return Const(int(v))
+    if isinstance(v, str):
+        # bare strings in range/guard positions refer to globals by name
+        return G(v)
+    raise TypeError(f"cannot use {v!r} as an expression")
+
+
+class Const(Expr):
+    def __init__(self, v: int):
+        self.v = v
+
+    def _emit(self, out, ctx):
+        out += [N.OP_IMM, self.v]
+
+
+class L(Expr):
+    """Reference to a task local (parameter or derived), by name."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _emit(self, out, ctx):
+        if self.name not in ctx.locals:
+            raise KeyError(f"unknown local {self.name!r}; have {list(ctx.locals)}")
+        out += [N.OP_LOCAL, ctx.locals[self.name]]
+
+
+class G(Expr):
+    """Reference to a taskpool global, by name."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _emit(self, out, ctx):
+        if self.name not in ctx.globals:
+            raise KeyError(f"unknown global {self.name!r}; have {list(ctx.globals)}")
+        out += [N.OP_GLOBAL, ctx.globals[self.name]]
+
+
+class BinOp(Expr):
+    def __init__(self, op: int, a: ExprLike, b: ExprLike):
+        self.op, self.a, self.b = op, _wrap(a), _wrap(b)
+
+    def _emit(self, out, ctx):
+        self.a._emit(out, ctx)
+        self.b._emit(out, ctx)
+        out.append(self.op)
+
+
+class UnOp(Expr):
+    def __init__(self, op: int, a: ExprLike):
+        self.op, self.a = op, _wrap(a)
+
+    def _emit(self, out, ctx):
+        self.a._emit(out, ctx)
+        out.append(self.op)
+
+
+class Select(Expr):
+    def __init__(self, c: ExprLike, a: ExprLike, b: ExprLike):
+        self.c, self.a, self.b = _wrap(c), _wrap(a), _wrap(b)
+
+    def _emit(self, out, ctx):
+        self.c._emit(out, ctx)
+        self.a._emit(out, ctx)
+        self.b._emit(out, ctx)
+        out.append(N.OP_SELECT)
+
+
+def select(c: ExprLike, a: ExprLike, b: ExprLike) -> Expr:
+    return Select(c, a, b)
+
+
+def minimum(a: ExprLike, b: ExprLike) -> Expr:
+    return BinOp(N.OP_MIN, a, b)
+
+
+def maximum(a: ExprLike, b: ExprLike) -> Expr:
+    return BinOp(N.OP_MAX, a, b)
+
+
+class Call(Expr):
+    """Escape hatch: evaluate a Python callable(locals_dict, globals_dict).
+
+    Compiled to an OP_CALL against a context-registered callback — the analog
+    of JDF inline `%{ return ...; %}` expressions.  The callable must be pure
+    and non-blocking (it runs on worker threads under the GIL).
+    """
+
+    def __init__(self, fn: Callable[..., int]):
+        self.fn = fn
+
+    def _emit(self, out, ctx):
+        cb_id = ctx.register_call(self.fn)
+        out += [N.OP_CALL, cb_id]
+
+
+def call(fn: Callable[..., int]) -> Expr:
+    return Call(fn)
+
+
+class Range:
+    """lo..hi..step range, usable as a dep param (broadcast / control gather)
+    and as a task parameter space."""
+
+    def __init__(self, lo: ExprLike, hi: ExprLike, step: ExprLike = 1):
+        self.lo, self.hi, self.step = _wrap(lo), _wrap(hi), _wrap(step)
+
+
+class CompileCtx:
+    """Name→index resolution + Python-callback registration for one class."""
+
+    def __init__(self, locals_map: Dict[str, int], globals_map: Dict[str, int],
+                 register_call: Callable[[Callable], int]):
+        self.locals = locals_map
+        self.globals = globals_map
+        self._register_call = register_call
+
+    def register_call(self, fn: Callable) -> int:
+        return self._register_call(fn)
+
+
+def compile_expr(e: Optional[ExprLike], ctx: CompileCtx) -> List[int]:
+    """Return the spec encoding [nwords, words...]; None → empty expr."""
+    if e is None:
+        return [0]
+    out: List[int] = []
+    _wrap(e)._emit(out, ctx)
+    return [len(out)] + out

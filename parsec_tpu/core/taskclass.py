@@ -1,0 +1,267 @@
+"""Task-class builder: the Python-facing PTG authoring API.
+
+A TaskClass declares parameter ranges, derived locals, placement affinity,
+dataflow (flows with guarded In/Out deps), priority, and a list of chores
+(bodies per device type).  `Taskpool.commit()` compiles each class to the
+native spec blob (see native/parsec_core.h spec layout).
+
+This is the hand-written equivalent of what the reference's parsec_ptgpp
+compiler emits from a .jdf file (parsec/interfaces/ptg/ptg-compiler/jdf2c.c);
+the JDF front-end (parsec_tpu/dsl/ptg) produces exactly these objects.
+"""
+from __future__ import annotations
+
+import ctypes as C
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import _native as N
+from .expr import CompileCtx, Expr, ExprLike, Range, compile_expr
+
+ACCESS = {"READ": N.FLOW_READ, "WRITE": N.FLOW_WRITE, "RW": N.FLOW_RW,
+          "CTL": N.FLOW_CTL, "R": N.FLOW_READ, "W": N.FLOW_WRITE}
+
+DEVICE_TYPES = {"cpu": N.DEV_CPU, "tpu": N.DEV_TPU,
+                "recursive": N.DEV_RECURSIVE}
+
+
+class Ref:
+    """Reference to a peer task instance's flow: Ref("Gemm", k, m, flow="C").
+
+    Params may be Range(...) on *output* deps (broadcast) and on *CTL input*
+    deps (control gather)."""
+
+    def __init__(self, task: str, *params: Union[ExprLike, Range],
+                 flow: Optional[str] = None):
+        self.task = task
+        self.params = list(params)
+        self.flow = flow
+
+
+class Mem:
+    """Reference to a datum of a collection: Mem("A", m, n)."""
+
+    def __init__(self, collection: str, *idx: ExprLike):
+        self.collection = collection
+        self.idx = list(idx)
+
+
+class _Dep:
+    def __init__(self, direction: int, target, guard: Optional[ExprLike]):
+        self.direction = direction
+        self.target = target  # Ref | Mem | None
+        self.guard = guard
+
+
+def In(target=None, guard: Optional[ExprLike] = None) -> _Dep:
+    return _Dep(0, target, guard)
+
+
+def Out(target=None, guard: Optional[ExprLike] = None) -> _Dep:
+    return _Dep(1, target, guard)
+
+
+class _Flow:
+    def __init__(self, name: str, access: int, deps: Sequence[_Dep],
+                 arena: Optional[str]):
+        self.name = name
+        self.access = access
+        self.deps = list(deps)
+        self.arena = arena
+
+
+class _Chore:
+    def __init__(self, device_type: int, body_kind: int, body=None):
+        self.device_type = device_type
+        self.body_kind = body_kind
+        self.body = body  # callable | qid | None
+        self.body_arg = 0  # resolved at commit
+
+
+class TaskClass:
+    def __init__(self, name: str):
+        self.name = name
+        self.locals: List[tuple] = []  # (name, is_range, payload)
+        self._affinity: Optional[Mem] = None
+        self._priority: Optional[ExprLike] = None
+        self.flows: List[_Flow] = []
+        self.chores: List[_Chore] = []
+        self.id: int = -1  # assigned by Taskpool
+
+    # ---------------------------------------------------------- declaration
+    def param(self, name: str, lo: ExprLike, hi: ExprLike,
+              step: ExprLike = 1) -> "TaskClass":
+        """Declare a range parameter (JDF `k = lo .. hi .. step`)."""
+        self.locals.append((name, True, Range(lo, hi, step)))
+        return self
+
+    def local(self, name: str, value: ExprLike) -> "TaskClass":
+        """Declare a derived local (JDF `loc = expr`)."""
+        self.locals.append((name, False, value))
+        return self
+
+    def affinity(self, collection: str, *idx: ExprLike) -> "TaskClass":
+        """Placement (JDF `: desc(m, n)`): run where this datum lives."""
+        self._affinity = Mem(collection, *idx)
+        return self
+
+    def priority(self, e: ExprLike) -> "TaskClass":
+        self._priority = e
+        return self
+
+    def flow(self, name: str, access: str, *deps: _Dep,
+             arena: Optional[str] = None) -> "TaskClass":
+        self.flows.append(_Flow(name, ACCESS[access.upper()], deps, arena))
+        return self
+
+    def body(self, fn: Callable, device: str = "cpu") -> "TaskClass":
+        """Attach a Python body chore.  fn(TaskView) -> None | hook code."""
+        self.chores.append(_Chore(DEVICE_TYPES[device], N.BODY_CB, fn))
+        return self
+
+    def body_noop(self, device: str = "cpu") -> "TaskClass":
+        self.chores.append(_Chore(DEVICE_TYPES[device], N.BODY_NOOP))
+        return self
+
+    def body_device(self, qid: int, device: str = "tpu") -> "TaskClass":
+        """Attach an ASYNC device chore: the task is pushed onto device
+        queue `qid` and completed by the device manager thread."""
+        ch = _Chore(DEVICE_TYPES[device], N.BODY_DEVICE)
+        ch.body_arg = qid
+        self.chores.append(ch)
+        return self
+
+    # ---------------------------------------------------------- compilation
+    def flow_index(self, name: str) -> int:
+        for i, f in enumerate(self.flows):
+            if f.name == name:
+                return i
+        raise KeyError(f"{self.name}: unknown flow {name!r}")
+
+    def local_index(self, name: str) -> int:
+        for i, (n, _, _) in enumerate(self.locals):
+            if n == name:
+                return i
+        raise KeyError(f"{self.name}: unknown local {name!r}")
+
+    def compile(self, tp) -> List[int]:
+        """Serialize to the native spec blob (version-1 layout)."""
+        locals_map = {n: i for i, (n, _, _) in enumerate(self.locals)}
+        cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call)
+        spec: List[int] = [1, len(self.locals)]
+        for (_, is_range, payload) in self.locals:
+            spec.append(1 if is_range else 0)
+            if is_range:
+                spec += compile_expr(payload.lo, cctx)
+                spec += compile_expr(payload.hi, cctx)
+                spec += compile_expr(payload.step, cctx)
+            else:
+                spec += compile_expr(payload, cctx)
+        # affinity
+        if self._affinity is not None:
+            spec.append(tp.ctx.collections[self._affinity.collection])
+            spec.append(len(self._affinity.idx))
+            for e in self._affinity.idx:
+                spec += compile_expr(e, cctx)
+        else:
+            spec += [-1, 0]
+        spec += compile_expr(self._priority, cctx)
+        # flows
+        spec.append(len(self.flows))
+        for fl in self.flows:
+            arena_id = tp.ctx.arenas[fl.arena] if fl.arena else -1
+            spec += [fl.access, arena_id, len(fl.deps)]
+            for d in fl.deps:
+                spec.append(d.direction)
+                spec += compile_expr(d.guard, cctx)
+                t = d.target
+                if t is None:
+                    spec.append(0)  # DEP_NONE
+                elif isinstance(t, Ref):
+                    peer = tp.class_by_name(t.task)
+                    if t.flow is not None:
+                        peer_flow = peer.flow_index(t.flow)
+                    elif peer.flows:
+                        peer_flow = min(len(peer.flows) - 1,
+                                        self.flows.index(fl))
+                    else:
+                        raise ValueError(
+                            f"{self.name}.{fl.name}: peer class {t.task!r} "
+                            f"has no flows; specify flow= explicitly")
+                    spec += [1, peer.id, peer_flow, len(t.params)]
+                    for p in t.params:
+                        if isinstance(p, Range):
+                            spec.append(1)
+                            spec += compile_expr(p.lo, cctx)
+                            spec += compile_expr(p.hi, cctx)
+                            spec += compile_expr(p.step, cctx)
+                        else:
+                            spec.append(0)
+                            spec += compile_expr(p, cctx)
+                elif isinstance(t, Mem):
+                    spec += [2, tp.ctx.collections[t.collection], len(t.idx)]
+                    for e in t.idx:
+                        spec += compile_expr(e, cctx)
+                else:
+                    raise TypeError(f"bad dep target {t!r}")
+                spec.append(-1)  # per-dep arena (reserved)
+        # chores
+        spec.append(len(self.chores))
+        for ch in self.chores:
+            if ch.body_kind == N.BODY_CB:
+                ch.body_arg = tp._register_body(self, ch.body)
+            spec += [ch.device_type, ch.body_kind, ch.body_arg]
+        return spec
+
+
+class TaskView:
+    """Body-side view of a task instance: named locals + numpy views of
+    flow data."""
+
+    __slots__ = ("_ptr", "_tc", "_tp")
+
+    def __init__(self, ptr, tc: TaskClass, tp):
+        self._ptr = ptr
+        self._tc = tc
+        self._tp = tp
+
+    def local(self, name: str) -> int:
+        return N.lib.ptc_task_local(self._ptr, self._tc.local_index(name))
+
+    def __getitem__(self, name: str) -> int:
+        return self.local(name)
+
+    def global_(self, name: str) -> int:
+        return N.lib.ptc_tp_global(self._tp._ptr, self._tp.globals_map[name])
+
+    @property
+    def priority(self) -> int:
+        return N.lib.ptc_task_priority(self._ptr)
+
+    def data_ptr(self, flow: str) -> int:
+        return N.lib.ptc_task_data_ptr(self._ptr, self._tc.flow_index(flow))
+
+    def data(self, flow: str, dtype=np.uint8, shape=None) -> np.ndarray:
+        """Numpy view over the flow's buffer (host copies)."""
+        fi = self._tc.flow_index(flow)
+        ptr = N.lib.ptc_task_data_ptr(self._ptr, fi)
+        if not ptr:
+            raise RuntimeError(
+                f"{self._tc.name}: flow {flow!r} has no data attached")
+        size = N.lib.ptc_copy_size(N.lib.ptc_task_copy(self._ptr, fi))
+        dt = np.dtype(dtype)
+        count = size // dt.itemsize
+        buf = (C.c_char * size).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dt, count=count)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return arr
+
+    def copy_handle(self, flow: str) -> int:
+        return N.lib.ptc_copy_handle(
+            N.lib.ptc_task_copy(self._ptr, self._tc.flow_index(flow)))
+
+    def set_copy_handle(self, flow: str, handle: int):
+        N.lib.ptc_copy_set_handle(
+            N.lib.ptc_task_copy(self._ptr, self._tc.flow_index(flow)), handle)

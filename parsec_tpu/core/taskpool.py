@@ -1,0 +1,117 @@
+"""Taskpool: a DAG of task classes sharing globals (reference:
+parsec_taskpool_t, parsec/parsec_internal.h:119-161)."""
+from __future__ import annotations
+
+import ctypes as C
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from .. import _native as N
+from .context import Context
+from .taskclass import TaskClass, TaskView
+
+
+class Taskpool:
+    def __init__(self, ctx: Context, globals: Optional[Dict[str, int]] = None):
+        self.ctx = ctx
+        self.globals_map: Dict[str, int] = {}
+        vals: List[int] = []
+        for i, (k, v) in enumerate((globals or {}).items()):
+            self.globals_map[k] = i
+            vals.append(int(v))
+        arr = (C.c_int64 * max(1, len(vals)))(*vals)
+        self._ptr = N.lib.ptc_tp_new(ctx._ptr, len(vals), arr)
+        self.classes: List[TaskClass] = []
+        self._by_name: Dict[str, TaskClass] = {}
+        self._committed = False
+        self._destroyed = False
+
+    # ------------------------------------------------------------- building
+    def add(self, tc: TaskClass) -> TaskClass:
+        if self._committed:
+            raise RuntimeError("taskpool already committed")
+        tc.id = len(self.classes)
+        self.classes.append(tc)
+        self._by_name[tc.name] = tc
+        return tc
+
+    def task_class(self, name: str) -> TaskClass:
+        return self.add(TaskClass(name))
+
+    def class_by_name(self, name: str) -> TaskClass:
+        return self._by_name[name]
+
+    def _register_call(self, fn: Callable) -> int:
+        """Register an inline-expression callback (JDF %{...%} analog)."""
+        globals_names = list(self.globals_map)
+
+        def _cb(user, locals_ptr, nb_locals, globals_ptr):
+            locs = [locals_ptr[i] for i in range(nb_locals)]
+            globs = {n: globals_ptr[i] for i, n in enumerate(globals_names)}
+            try:
+                return int(fn(locs, globs))
+            except Exception:
+                traceback.print_exc()
+                return 0
+
+        return self.ctx.register_expr_cb(_cb)
+
+    def _register_body(self, tc: TaskClass, fn: Callable) -> int:
+        def _cb(user, task_ptr):
+            try:
+                r = fn(TaskView(task_ptr, tc, self))
+                # bool is an int subclass; True must not become HOOK_AGAIN
+                if isinstance(r, int) and not isinstance(r, bool):
+                    return r
+                return N.HOOK_DONE
+            except Exception:
+                traceback.print_exc()
+                return N.HOOK_ERROR
+
+        return self.ctx.register_body_cb(_cb)
+
+    def commit(self) -> "Taskpool":
+        """Compile every class spec and register with the native core."""
+        if self._committed:
+            return self
+        self._committed = True
+        for tc in self.classes:
+            spec = tc.compile(self)
+            arr = (C.c_int64 * len(spec))(*spec)
+            cid = N.lib.ptc_tp_add_class(self._ptr, tc.name.encode(), arr,
+                                         len(spec))
+            if cid != tc.id:
+                raise RuntimeError(
+                    f"class id mismatch for {tc.name}: {cid} != {tc.id}")
+        return self
+
+    # ------------------------------------------------------------- running
+    def run(self) -> "Taskpool":
+        """commit + add to context + start (convenience)."""
+        self.commit()
+        rc = N.lib.ptc_context_add_taskpool(self.ctx._ptr, self._ptr)
+        if rc != 0:
+            raise RuntimeError("ptc_context_add_taskpool failed")
+        return self
+
+    def wait(self):
+        rc = N.lib.ptc_tp_wait(self._ptr)
+        if rc != 0:
+            raise RuntimeError(
+                "taskpool aborted: a task body failed (see stderr)")
+
+    @property
+    def nb_tasks(self) -> int:
+        return N.lib.ptc_tp_nb_tasks(self._ptr)
+
+    @property
+    def nb_total_tasks(self) -> int:
+        return N.lib.ptc_tp_nb_total_tasks(self._ptr)
+
+    def set_open(self, open_: bool):
+        N.lib.ptc_tp_set_open(self._ptr, 1 if open_ else 0)
+
+    def destroy(self):
+        if not self._destroyed:
+            self._destroyed = True
+            N.lib.ptc_tp_destroy(self._ptr)

@@ -1,0 +1,10 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding paths compile+execute without TPU hardware (the driver separately
+dry-runs multichip; bench.py runs on the real chip outside pytest)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

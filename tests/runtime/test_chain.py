@@ -1,0 +1,346 @@
+"""End-to-end core-runtime tests mirroring the reference tutorial examples
+Ex01_HelloWorld .. Ex07_RAW_CTL (reference: examples/*.jdf behaviors)."""
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+
+
+def test_hello_world_single_task():
+    """Ex01: one task, no flows."""
+    ran = []
+    with pt.Context(nb_workers=2) as ctx:
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("Hello")
+        tc.body(lambda t: ran.append(1))
+        tp.run()
+        tp.wait()
+    assert ran == [1]
+    assert tp.nb_total_tasks == 1
+
+
+def test_chain_ordering():
+    """Ex02: Task(k), k=0..NB, each depending on Task(k-1) via CTL-ish RW."""
+    NB = 50
+    order = []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_arena("int", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": NB})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+                arena="int")
+
+        def body(t):
+            with lock:
+                order.append(t["k"])
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+    assert order == list(range(NB + 1))
+    assert tp.nb_total_tasks == NB + 1
+
+
+def test_chain_data_increment():
+    """Ex04: chain threading one datum through memory, each task increments."""
+    NB = 20
+    buf = np.array([300], dtype=np.int64)
+    seen = []
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_linear_collection("mydata", buf, elem_size=8)
+        tp = pt.Taskpool(ctx, globals={"NB": NB})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("mydata", k)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("mydata", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Mem("mydata", 0), guard=(k == pt.G("NB"))),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"), guard=(k < pt.G("NB"))))
+
+        def body(t):
+            a = t.data("A", dtype=np.int64)
+            a[0] += 1
+            seen.append(int(a[0]))
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+    assert seen == list(range(301, 301 + NB + 1))
+    assert buf[0] == 300 + NB + 1
+
+
+def test_broadcast_fanout():
+    """Ex05/Ex06: one task broadcasts its datum to a range of readers."""
+    NB = 6
+    got = []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=2) as ctx:
+        src = np.array([42], dtype=np.int64)
+        ctx.register_linear_collection("d", src, elem_size=8)
+        tp = pt.Taskpool(ctx, globals={"NB": NB})
+        k, n = pt.L("k"), pt.L("n")
+        bcast = tp.task_class("Bcast")
+        bcast.param("k", 0, 0)
+        bcast.flow("A", "RW",
+                   pt.In(pt.Mem("d", 0)),
+                   pt.Out(pt.Ref("Recv", pt.Range(0, pt.G("NB"), 2), flow="A")))
+        bcast.body(lambda t: None)
+
+        recv = tp.task_class("Recv")
+        recv.param("n", 0, pt.G("NB"), 2)
+        recv.flow("A", "READ", pt.In(pt.Ref("Bcast", 0, flow="A")))
+
+        def rbody(t):
+            with lock:
+                got.append((t["n"], int(t.data("A", np.int64)[0])))
+
+        recv.body(rbody)
+        tp.run()
+        tp.wait()
+    assert sorted(got) == [(n, 42) for n in range(0, NB + 1, 2)]
+
+
+def test_ctl_gather():
+    """Ex07-style: a sink waits on a CTL flow fed by a range of producers."""
+    NB = 9
+    done = []
+    with pt.Context(nb_workers=2) as ctx:
+        tp = pt.Taskpool(ctx, globals={"NB": NB})
+        k = pt.L("k")
+        prod = tp.task_class("Prod")
+        prod.param("k", 0, pt.G("NB"))
+        prod.flow("X", "CTL", pt.Out(pt.Ref("Sink", flow="X")))
+        prod.body(lambda t: None)
+
+        sink = tp.task_class("Sink")
+        sink.flow("X", "CTL",
+                  pt.In(pt.Ref("Prod", pt.Range(0, pt.G("NB")), flow="X")))
+        sink.body(lambda t: done.append(1))
+        tp.run()
+        tp.wait()
+    assert done == [1]
+    assert tp.nb_total_tasks == NB + 2
+
+
+def test_derived_locals():
+    """Ex06 TaskRecv-style derived local loc = k + n."""
+    vals = []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=2) as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": 3})
+        k, n = pt.L("k"), pt.L("n")
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("N"))
+        tc.param("n", 0, k)  # triangular: later range depends on earlier param
+        tc.local("loc", k * 10 + n)
+
+        def body(t):
+            with lock:
+                vals.append((t["k"], t["n"], t["loc"]))
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+    expect = [(k, n, k * 10 + n) for k in range(4) for n in range(k + 1)]
+    assert sorted(vals) == expect
+
+
+def test_two_class_pingpong():
+    """Cross-class dataflow A→B→A with data mutation."""
+    NB = 10
+    with pt.Context(nb_workers=2) as ctx:
+        buf = np.zeros(1, dtype=np.int64)
+        ctx.register_linear_collection("d", buf, elem_size=8)
+        tp = pt.Taskpool(ctx, globals={"NB": NB})
+        k = pt.L("k")
+        ping = tp.task_class("Ping")
+        ping.param("k", 0, pt.G("NB"))
+        ping.flow("A", "RW",
+                  pt.In(pt.Mem("d", 0), guard=(k == 0)),
+                  pt.In(pt.Ref("Pong", k - 1, flow="A")),
+                  pt.Out(pt.Ref("Pong", k, flow="A")))
+
+        def pingb(t):
+            t.data("A", np.int64)[0] += 1
+
+        ping.body(pingb)
+
+        pong = tp.task_class("Pong")
+        pong.param("k", 0, pt.G("NB"))
+        pong.flow("A", "RW",
+                  pt.In(pt.Ref("Ping", k, flow="A")),
+                  pt.Out(pt.Ref("Ping", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+                  pt.Out(pt.Mem("d", 0), guard=(k == pt.G("NB"))))
+
+        def pongb(t):
+            t.data("A", np.int64)[0] *= 2
+
+        pong.body(pongb)
+        tp.run()
+        tp.wait()
+    # x -> 2*(x+1) applied NB+1 times from 0
+    x = 0
+    for _ in range(NB + 1):
+        x = 2 * (x + 1)
+    assert buf[0] == x
+
+
+def test_priority_scheduler_ap():
+    """ap scheduler runs higher-priority ready tasks first (single worker)."""
+    ran = []
+    with pt.Context(nb_workers=1, scheduler="ap") as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": 19})
+        k = pt.L("k")
+        gate = tp.task_class("Gate")
+        gate.flow("X", "CTL",
+                  pt.Out(pt.Ref("T", pt.Range(0, pt.G("N")), flow="X")))
+        gate.body(lambda t: None)
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("N"))
+        tc.priority(k)
+        tc.flow("X", "CTL", pt.In(pt.Ref("Gate", flow="X")))
+        tc.body(lambda t: ran.append(t["k"]))
+        tp.run()
+        tp.wait()
+    # after the gate, all 20 are ready; ap picks by descending priority
+    assert ran == sorted(ran, reverse=True)
+
+
+def test_inline_expr_callback():
+    """JDF %{ ... %} analog: Python callback inside a range bound."""
+    ran = []
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx, globals={"nodes": 4})
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.call(lambda locs, globs: globs["nodes"] - 1))
+        tc.body(lambda t: ran.append(t["k"]))
+        tp.run()
+        tp.wait()
+    assert sorted(ran) == [0, 1, 2, 3]
+
+
+def test_empty_taskpool_completes():
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": -1})
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("N"))  # 0..-1 = empty
+        tc.body(lambda t: None)
+        tp.run()
+        tp.wait()
+        ctx.wait()
+    assert tp.nb_total_tasks == 0
+
+
+def test_write_only_arena_flow():
+    """A task with a pure-WRITE flow gets an arena buffer; consumer reads."""
+    got = []
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("tile", 64)
+        tp = pt.Taskpool(ctx)
+        w = tp.task_class("W")
+        w.flow("A", "WRITE", pt.Out(pt.Ref("R", flow="A")), arena="tile")
+
+        def wbody(t):
+            t.data("A", np.int64)[0] = 7
+
+        w.body(wbody)
+        r = tp.task_class("R")
+        r.flow("A", "READ", pt.In(pt.Ref("W", flow="A")))
+        r.body(lambda t: got.append(int(t.data("A", np.int64)[0])))
+        tp.run()
+        tp.wait()
+    assert got == [7]
+
+
+@pytest.mark.parametrize("sched", ["lfq", "gd", "ap"])
+def test_schedulers_complete_wide_graph(sched):
+    """Fan-out/fan-in across every scheduler."""
+    N = 40
+    count = []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=3, scheduler=sched) as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": N - 1})
+        src = tp.task_class("Src")
+        src.flow("X", "CTL",
+                 pt.Out(pt.Ref("Mid", pt.Range(0, pt.G("N")), flow="X")))
+        src.body(lambda t: None)
+        mid = tp.task_class("Mid")
+        mid.param("k", 0, pt.G("N"))
+        mid.flow("X", "CTL",
+                 pt.In(pt.Ref("Src", flow="X")),
+                 pt.Out(pt.Ref("Sink", flow="X")))
+
+        def mbody(t):
+            with lock:
+                count.append(t["k"])
+
+        mid.body(mbody)
+        sink = tp.task_class("Sink")
+        sink.flow("X", "CTL",
+                  pt.In(pt.Ref("Mid", pt.Range(0, pt.G("N")), flow="X")))
+        sink.body(lambda t: count.append(-1))
+        tp.run()
+        tp.wait()
+    assert sorted(count)[0] == -1
+    assert len(count) == N + 1
+
+
+def test_body_exception_aborts_taskpool():
+    """A failing body must abort the pool (successors would see garbage);
+    tp.wait() raises instead of hanging."""
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx)
+        a = tp.task_class("A")
+
+        def boom(t):
+            raise ValueError("intentional")
+
+        a.flow("X", "CTL", pt.Out(pt.Ref("B", flow="X")))
+        a.body(boom)
+        b = tp.task_class("B")
+        b.flow("X", "CTL", pt.In(pt.Ref("A", flow="X")))
+        b.body(lambda t: None)
+        tp.run()
+        with pytest.raises(RuntimeError):
+            tp.wait()
+
+
+def test_set_open_close_after_drain_completes():
+    """Closing an open (DTD-style) pool whose count already drained must
+    complete it (regression: missed completion re-check)."""
+    ran = []
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx)
+        tp.set_open(True)
+        tc = tp.task_class("T")
+        tc.body(lambda t: ran.append(1))
+        tp.run()
+        import time
+        deadline = time.time() + 5
+        while tp.nb_tasks > 0 and time.time() < deadline:
+            time.sleep(0.01)
+        tp.set_open(False)
+        tp.wait()
+    assert ran == [1]
+
+
+def test_bool_return_from_body_is_done():
+    """Regression: body returning True must not be treated as HOOK_AGAIN."""
+    ran = []
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("T")
+        tc.body(lambda t: (ran.append(1), True)[1])
+        tp.run()
+        tp.wait()
+    assert ran == [1]
