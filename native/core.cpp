@@ -418,6 +418,10 @@ struct ptc_context {
   std::mutex free_lock;
   ptc_task *free_list = nullptr;
 
+  /* device-layer hook: copy with handle released */
+  ptc_copy_release_cb copy_release_cb = nullptr;
+  void *copy_release_user = nullptr;
+
   /* profiling */
   std::atomic<bool> prof_enabled{false};
   std::vector<ProfBuf *> prof;
@@ -612,6 +616,8 @@ static void copy_retain(ptc_copy *c) {
 static void copy_release(ptc_context *ctx, ptc_copy *c) {
   if (!c) return;
   if (c->refcount.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    if (c->handle != 0 && ctx->copy_release_cb)
+      ctx->copy_release_cb(ctx->copy_release_user, c->handle);
     if (c->arena_id >= 0 && c->ptr)
       ctx->arenas[(size_t)c->arena_id]->dealloc(c->ptr);
     else if (c->owns_ptr && c->ptr)
@@ -1397,6 +1403,15 @@ int64_t ptc_copy_size(ptc_copy_t *c) { return c ? c->size : 0; }
 int64_t ptc_copy_handle(ptc_copy_t *c) { return c ? c->handle : 0; }
 void ptc_copy_set_handle(ptc_copy_t *c, int64_t h) { if (c) c->handle = h; }
 int32_t ptc_copy_version(ptc_copy_t *c) { return c ? c->version.load() : 0; }
+int32_t ptc_copy_is_persistent(ptc_copy_t *c) {
+  return (c && c->data) ? 1 : 0;
+}
+
+void ptc_set_copy_release_cb(ptc_context_t *ctx, ptc_copy_release_cb cb,
+                             void *user) {
+  ctx->copy_release_cb = cb;
+  ctx->copy_release_user = user;
+}
 
 /* task accessors */
 int64_t ptc_task_local(ptc_task_t *t, int32_t i) {

@@ -198,6 +198,16 @@ void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
 
+/* Notification when a copy with a nonzero handle reaches refcount 0: the
+ * device layer drops its device-resident mirror (the handle is the device
+ * layer's uid).  Called from whichever thread releases the last ref. */
+typedef void (*ptc_copy_release_cb)(void *user, int64_t handle);
+void ptc_set_copy_release_cb(ptc_context_t *ctx, ptc_copy_release_cb cb,
+                             void *user);
+/* nonzero if the copy is backed by persistent user data (ptc_data_new),
+ * zero for transient arena-backed copies */
+int32_t ptc_copy_is_persistent(ptc_copy_t *c);
+
 /* version / build info */
 const char *ptc_version(void);
 

@@ -86,6 +86,7 @@ EXPR_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.POINTER(C.c_int64), C.c_int32,
 BODY_CB_T = C.CFUNCTYPE(C.c_int32, C.c_void_p, C.c_void_p)
 RANK_OF_CB_T = C.CFUNCTYPE(C.c_uint32, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
 DATA_OF_CB_T = C.CFUNCTYPE(C.c_void_p, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
+COPY_RELEASE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 
 _sigs = {
     "ptc_version": (C.c_char_p, []),
@@ -123,6 +124,9 @@ _sigs = {
     "ptc_copy_handle": (C.c_int64, [C.c_void_p]),
     "ptc_copy_set_handle": (None, [C.c_void_p, C.c_int64]),
     "ptc_copy_version": (C.c_int32, [C.c_void_p]),
+    "ptc_copy_is_persistent": (C.c_int32, [C.c_void_p]),
+    "ptc_set_copy_release_cb": (None, [C.c_void_p, COPY_RELEASE_CB_T,
+                                       C.c_void_p]),
     "ptc_task_local": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_task_class": (C.c_int32, [C.c_void_p]),
     "ptc_task_priority": (C.c_int32, [C.c_void_p]),

@@ -1,0 +1,5 @@
+from .gemm import build_gemm, run_gemm
+from .potrf import build_potrf, potrf_flops, run_potrf
+
+__all__ = ["build_gemm", "run_gemm", "build_potrf", "run_potrf",
+           "potrf_flops"]

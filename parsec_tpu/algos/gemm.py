@@ -1,0 +1,74 @@
+"""Tiled GEMM as a PTG taskpool over a 2D block-cyclic distribution.
+
+C(m,n) += sum_k A(m,k) @ B(k,n): each Gemm(m,n,k) task carries the C tile
+through a k-chain (owner-computes on C's placement), reading A/B tiles from
+their collections.  This is the DPLASMA-style summa-ish shape used by the
+BASELINE measurement ladder rung 2/5; the kernel runs as a cached XLA
+executable on the TPU device (or numpy on the CPU fallback chore).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import parsec_tpu as pt
+from ..data.collections import TwoDimBlockCyclic
+from ..device.tpu import TpuDevice
+
+
+def k_gemm_nn(a, b, c):
+    # bf16 inputs to the MXU with f32 accumulate is the TPU-native contract
+    import jax
+    return c + jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=c.dtype)
+
+
+def build_gemm(ctx: pt.Context, A: TwoDimBlockCyclic, B: TwoDimBlockCyclic,
+               C: TwoDimBlockCyclic, dev: Optional[TpuDevice] = None,
+               names=("A", "B", "C")) -> pt.Taskpool:
+    """Build (but don't run) the GEMM taskpool.  Collections must already be
+    registered with ctx under `names`."""
+    mt, nt, kt = C.mt, C.nt, A.nt
+    assert A.mt == mt and B.nt == nt and B.mt == kt
+    tp = pt.Taskpool(ctx, globals={"MT": mt - 1, "NT": nt - 1, "KT": kt - 1})
+    m, n, k = pt.L("m"), pt.L("n"), pt.L("k")
+    an, bn, cn = names
+
+    g = tp.task_class("Gemm")
+    g.param("m", 0, pt.G("MT"))
+    g.param("n", 0, pt.G("NT"))
+    g.param("k", 0, pt.G("KT"))
+    g.affinity(cn, m, n)
+    # deeper k first so the chain head is prioritized
+    g.priority(pt.G("KT") - k)
+    g.flow("A", "READ", pt.In(pt.Mem(an, m, k)))
+    g.flow("B", "READ", pt.In(pt.Mem(bn, k, n)))
+    g.flow("C", "RW",
+           pt.In(pt.Mem(cn, m, n), guard=(k == 0)),
+           pt.In(pt.Ref("Gemm", m, n, k - 1, flow="C")),
+           pt.Out(pt.Ref("Gemm", m, n, k + 1, flow="C"),
+                  guard=(k < pt.G("KT"))),
+           pt.Out(pt.Mem(cn, m, n), guard=(k == pt.G("KT"))))
+
+    shp = {"A": (A.mb, A.nb), "B": (B.mb, B.nb), "C": (C.mb, C.nb)}
+    if dev is not None:
+        dev.attach(g, tp, kernel=k_gemm_nn, reads=["A", "B", "C"],
+                   writes=["C"], shapes=shp, dtype=C.dtype)
+
+    def cpu_body(t):
+        a = t.data("A", C.dtype, shp["A"])
+        b = t.data("B", C.dtype, shp["B"])
+        c = t.data("C", C.dtype, shp["C"])
+        c += a @ b
+
+    g.body(cpu_body)
+    return tp
+
+
+def run_gemm(ctx, A, B, C, dev=None) -> None:
+    tp = build_gemm(ctx, A, B, C, dev)
+    tp.run()
+    tp.wait()
+    if dev is not None:
+        dev.flush()

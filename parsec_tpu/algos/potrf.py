@@ -1,0 +1,173 @@
+"""Tiled Cholesky factorization (lower) as a PTG taskpool — the DPLASMA
+dpotrf_L dataflow (the BASELINE north-star workload), built from four task
+classes over a 2D block-cyclic matrix:
+
+  POTRF(k)    : diagonal tile factor        A[k,k] = chol(A[k,k])
+  TRSM(m,k)   : panel solve                 A[m,k] = A[m,k] inv(L[k,k])^T
+  SYRK(k,m)   : diagonal trailing update    A[m,m] -= A[m,k] A[m,k]^T
+  GEMM(m,n,k) : off-diag trailing update    A[m,n] -= A[m,k] A[n,k]^T
+
+Kernels run as cached XLA executables on the TPU device, with numpy CPU
+fallback chores.  Priorities favor the critical path (deeper k first),
+matching the reference's priority-expression practice in dense LA JDFs.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import parsec_tpu as pt
+from ..data.collections import TwoDimBlockCyclic
+from ..device.tpu import TpuDevice
+
+
+# ---------------------------------------------------------------- kernels
+# module-level so their identity is stable: jax.jit keeps ONE compiled
+# executable per (kernel, tile shape, dtype) across taskpools/processes
+def k_potrf(t):
+    import jax.numpy as jnp
+    return jnp.linalg.cholesky(t)
+
+
+def k_trsm(l, c):
+    import jax
+    return jax.scipy.linalg.solve_triangular(l, c.T, lower=True).T
+
+
+def k_syrk(a, t):
+    import jax
+    return t - jax.lax.dot_general(a, a, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=t.dtype)
+
+
+def k_gemm(a, b, c):
+    import jax
+    return c - jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=c.dtype)
+
+
+def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
+                dev: Optional[TpuDevice] = None,
+                name: str = "A") -> pt.Taskpool:
+    """Build the Cholesky taskpool for the square tiled SPD matrix `A`
+    (registered with ctx under `name`).  A.mt == A.nt required."""
+    nt = A.mt
+    assert A.mt == A.nt and A.mb == A.nb
+    nb = A.mb
+    tp = pt.Taskpool(ctx, globals={"NT": nt - 1})
+    k, m, n = pt.L("k"), pt.L("m"), pt.L("n")
+    NT = pt.G("NT")
+    shp = (nb, nb)
+    dt = A.dtype
+
+    # ------------------------------------------------------------- POTRF(k)
+    po = tp.task_class("POTRF")
+    po.param("k", 0, NT)
+    po.affinity(name, k, k)
+    po.priority((NT - k) * 1000)
+    po.flow("T", "RW",
+            pt.In(pt.Mem(name, k, k), guard=(k == 0)),
+            pt.In(pt.Ref("SYRK", k - 1, k, flow="T")),
+            pt.Out(pt.Ref("TRSM", k, pt.Range(k + 1, NT), flow="L"),
+                   guard=(k < NT)),
+            pt.Out(pt.Mem(name, k, k)))
+
+    # ----------------------------------------------------------- TRSM(m, k)
+    tr = tp.task_class("TRSM")
+    tr.param("k", 0, NT)
+    tr.param("m", k + 1, NT)
+    tr.affinity(name, m, k)
+    tr.priority((NT - k) * 1000 - m)
+    tr.flow("L", "READ", pt.In(pt.Ref("POTRF", k, flow="T")))
+    # NB: GEMM's declared param order is (k, m, n) — Refs must match it
+    tr.flow("C", "RW",
+            pt.In(pt.Mem(name, m, k), guard=(k == 0)),
+            pt.In(pt.Ref("GEMM", k - 1, m, k, flow="C")),
+            # SYRK(k, m) updates diagonal (m, m) with this panel
+            pt.Out(pt.Ref("SYRK", k, m, flow="A")),
+            # GEMM row m: A[m, n] for k < n < m uses this as the A operand
+            pt.Out(pt.Ref("GEMM", k, m, pt.Range(k + 1, m - 1), flow="A"),
+                   guard=(m > k + 1)),
+            # GEMM column m: A[mm, m] for m < mm <= NT uses it as B operand
+            pt.Out(pt.Ref("GEMM", k, pt.Range(m + 1, NT), m, flow="B"),
+                   guard=(m < NT)),
+            pt.Out(pt.Mem(name, m, k)))
+
+    # ----------------------------------------------------------- SYRK(k, m)
+    sy = tp.task_class("SYRK")
+    sy.param("k", 0, NT)
+    sy.param("m", k + 1, NT)
+    sy.affinity(name, m, m)
+    sy.priority((NT - k) * 1000 - m)
+    sy.flow("A", "READ", pt.In(pt.Ref("TRSM", k, m, flow="C")))
+    sy.flow("T", "RW",
+            pt.In(pt.Mem(name, m, m), guard=(k == 0)),
+            pt.In(pt.Ref("SYRK", k - 1, m, flow="T")),
+            pt.Out(pt.Ref("POTRF", m, flow="T"), guard=(m == k + 1)),
+            pt.Out(pt.Ref("SYRK", k + 1, m, flow="T"), guard=(m > k + 1)))
+
+    # -------------------------------------------------------- GEMM(m, n, k)
+    ge = tp.task_class("GEMM")
+    ge.param("k", 0, NT)
+    ge.param("m", k + 2, NT)
+    ge.param("n", k + 1, m - 1)
+    ge.affinity(name, m, n)
+    ge.priority((NT - k) * 1000 - m - n)
+    ge.flow("A", "READ", pt.In(pt.Ref("TRSM", k, m, flow="C")))
+    ge.flow("B", "READ", pt.In(pt.Ref("TRSM", k, n, flow="C")))
+    ge.flow("C", "RW",
+            pt.In(pt.Mem(name, m, n), guard=(k == 0)),
+            pt.In(pt.Ref("GEMM", k - 1, m, n, flow="C")),
+            pt.Out(pt.Ref("TRSM", n, m, flow="C"), guard=(n == k + 1)),
+            pt.Out(pt.Ref("GEMM", k + 1, m, n, flow="C"), guard=(n > k + 1)))
+
+    # --------------------------------------------------------------- chores
+    if dev is not None:
+        dev.attach(po, tp, kernel=k_potrf, reads=["T"], writes=["T"],
+                   shapes={"T": shp}, dtype=dt)
+        dev.attach(tr, tp, kernel=k_trsm, reads=["L", "C"], writes=["C"],
+                   shapes={"L": shp, "C": shp}, dtype=dt)
+        dev.attach(sy, tp, kernel=k_syrk, reads=["A", "T"], writes=["T"],
+                   shapes={"A": shp, "T": shp}, dtype=dt)
+        dev.attach(ge, tp, kernel=k_gemm, reads=["A", "B", "C"], writes=["C"],
+                   shapes={"A": shp, "B": shp, "C": shp}, dtype=dt)
+
+    def b_potrf(t):
+        a = t.data("T", dt, shp)
+        a[...] = np.linalg.cholesky(a)
+
+    def b_trsm(t):
+        l = t.data("L", dt, shp)
+        c = t.data("C", dt, shp)
+        # X L^T = C -> X = (L^-1 C^T)^T ; use lapack-free solve
+        c[...] = np.linalg.solve(l, c.T).T
+
+    def b_syrk(t):
+        a = t.data("A", dt, shp)
+        x = t.data("T", dt, shp)
+        x -= a @ a.T
+
+    def b_gemm(t):
+        a = t.data("A", dt, shp)
+        b = t.data("B", dt, shp)
+        c = t.data("C", dt, shp)
+        c -= a @ b.T
+
+    po.body(b_potrf)
+    tr.body(b_trsm)
+    sy.body(b_syrk)
+    ge.body(b_gemm)
+    return tp
+
+
+def run_potrf(ctx, A, dev=None):
+    tp = build_potrf(ctx, A, dev)
+    tp.run()
+    tp.wait()
+    if dev is not None:
+        dev.flush()
+
+
+def potrf_flops(N: int) -> float:
+    return N ** 3 / 3.0 + N ** 2 / 2.0 + N / 6.0

@@ -52,6 +52,7 @@ class Context:
         self._buffers: List[np.ndarray] = []
         self.collections: Dict[str, int] = {}
         self.arenas: Dict[str, int] = {}
+        self._devices: List = []  # TpuDevice instances (stopped on destroy)
         self._destroyed = False
 
     # ------------------------------------------------------------ lifecycle
@@ -67,6 +68,13 @@ class Context:
     def destroy(self):
         if not self._destroyed:
             self._destroyed = True
+            # stop device manager threads first: they block in
+            # ptc_device_pop on queues owned by the native context
+            for dev in list(getattr(self, "_devices", [])):
+                try:
+                    dev.stop()
+                except Exception:
+                    pass
             N.lib.ptc_context_destroy(self._ptr)
 
     def __enter__(self):

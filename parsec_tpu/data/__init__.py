@@ -1,0 +1,7 @@
+from .collections import (Collection, HashDatadist, SymTwoDimBlockCyclic,
+                          TwoDimBlockCyclic, TwoDimTabular, VectorCyclic)
+
+__all__ = [
+    "Collection", "TwoDimBlockCyclic", "SymTwoDimBlockCyclic",
+    "TwoDimTabular", "VectorCyclic", "HashDatadist",
+]

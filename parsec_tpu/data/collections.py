@@ -1,0 +1,232 @@
+"""Distributed data collections: the tiled-matrix family and irregular
+distributions.
+
+Reference analogs (SURVEY.md §2.3):
+  - parsec_data_collection_t vtable  (parsec/include/parsec/data_distribution.h:26-66)
+  - 2D block-cyclic                  (parsec/data_dist/matrix/two_dim_rectangle_cyclic.c)
+  - symmetric 2D block-cyclic       (parsec/data_dist/matrix/sym_two_dim_rectangle_cyclic.c)
+  - tabular (arbitrary tile→rank)   (parsec/data_dist/matrix/two_dim_tabular.c)
+  - vector cyclic                   (parsec/data_dist/matrix/vector_two_dim_cyclic.c)
+  - hash datadist (irregular keys)  (parsec/data_dist/hash_datadist.c)
+
+A collection supplies rank_of(*idx) (owner-computes placement) and
+data_of(*idx) (the local datum).  Local tiles are numpy arrays; the TPU
+device layer mirrors them into device copies on demand.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.context import Context, Data
+
+
+class Collection:
+    """Base: duck-typed vtable consumed by Context.register_collection."""
+
+    nodes: int = 1
+    myrank: int = 0
+
+    def rank_of(self, *idx: int) -> int:
+        raise NotImplementedError
+
+    def data_of(self, *idx: int) -> Optional[Data]:
+        raise NotImplementedError
+
+    def register(self, ctx: Context, name: str) -> int:
+        self._ctx = ctx
+        return ctx.register_collection(name, self)
+
+
+class TwoDimBlockCyclic(Collection):
+    """2D block-cyclic tiled matrix over a P×Q process grid.
+
+    Tile (m, n) lives on rank (m % P) * Q + (n % Q); local tiles are
+    allocated lazily as mb×nb numpy arrays.  This is the workhorse
+    distribution of dense LA (DPLASMA-style potrf/gemm run on it).
+    """
+
+    def __init__(self, M: int, N: int, mb: int, nb: int, P: int = 1,
+                 Q: int = 1, nodes: int = 1, myrank: int = 0,
+                 dtype=np.float32, init: Optional[Callable] = None):
+        assert P * Q == nodes, "grid P*Q must equal nodes"
+        self.M, self.N, self.mb, self.nb = M, N, mb, nb
+        self.P, self.Q = P, Q
+        self.nodes, self.myrank = nodes, myrank
+        self.mt = (M + mb - 1) // mb  # tiles in M
+        self.nt = (N + nb - 1) // nb  # tiles in N
+        self.dtype = np.dtype(dtype)
+        self._tiles: Dict[Tuple[int, int], np.ndarray] = {}
+        self._datas: Dict[Tuple[int, int], Data] = {}
+        self._init = init
+
+    # -------------------------------------------------------------- vtable
+    def rank_of(self, m: int, n: int) -> int:
+        return (m % self.P) * self.Q + (n % self.Q)
+
+    def key_of(self, m: int, n: int) -> int:
+        return m * self.nt + n
+
+    def tile_shape(self, m: int, n: int) -> Tuple[int, int]:
+        rows = min(self.mb, self.M - m * self.mb)
+        cols = min(self.nb, self.N - n * self.nb)
+        return rows, cols
+
+    def tile(self, m: int, n: int) -> np.ndarray:
+        """The local tile array (allocating on first touch)."""
+        key = (m, n)
+        t = self._tiles.get(key)
+        if t is None:
+            if self.rank_of(m, n) != self.myrank:
+                raise KeyError(f"tile {key} is remote (rank {self.rank_of(m, n)})")
+            # full mb×nb allocation (simplifies device staging); logical
+            # shape may be smaller on boundary tiles
+            t = np.zeros((self.mb, self.nb), dtype=self.dtype)
+            if self._init is not None:
+                rows, cols = self.tile_shape(m, n)
+                t[:rows, :cols] = self._init(self, m, n)[:rows, :cols]
+            self._tiles[key] = t
+        return t
+
+    def data_of(self, m: int, n: int) -> Optional[Data]:
+        key = (m, n)
+        d = self._datas.get(key)
+        if d is None:
+            d = self._ctx.data(self.key_of(m, n), self.tile(m, n))
+            self._datas[key] = d
+        return d
+
+    # -------------------------------------------------------------- helpers
+    def fill(self, fn: Callable[[int, int], np.ndarray]):
+        """Materialize every local tile via fn(m, n) -> (mb, nb) array."""
+        for m in range(self.mt):
+            for n in range(self.nt):
+                if self.rank_of(m, n) == self.myrank:
+                    rows, cols = self.tile_shape(m, n)
+                    self.tile(m, n)[:rows, :cols] = \
+                        np.asarray(fn(m, n))[:rows, :cols]
+
+    def to_dense(self) -> np.ndarray:
+        """Gather local tiles into a dense matrix (single-rank only)."""
+        assert self.nodes == 1
+        A = np.zeros((self.M, self.N), dtype=self.dtype)
+        for m in range(self.mt):
+            for n in range(self.nt):
+                rows, cols = self.tile_shape(m, n)
+                A[m * self.mb:m * self.mb + rows,
+                  n * self.nb:n * self.nb + cols] = self.tile(m, n)[:rows, :cols]
+        return A
+
+    def from_dense(self, A: np.ndarray):
+        for m in range(self.mt):
+            for n in range(self.nt):
+                if self.rank_of(m, n) == self.myrank:
+                    rows, cols = self.tile_shape(m, n)
+                    self.tile(m, n)[:rows, :cols] = \
+                        A[m * self.mb:m * self.mb + rows,
+                          n * self.nb:n * self.nb + cols]
+
+
+class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
+    """Symmetric/lower(upper)-storage variant: only tiles of one triangle are
+    stored; rank/data of (m, n) with n > m (lower) map to... the stored
+    triangle is addressed directly — tasks only reference stored tiles.
+    Placement cycles over the triangle like the reference's sym 2D BC."""
+
+    def __init__(self, *args, uplo: str = "lower", **kw):
+        super().__init__(*args, **kw)
+        self.uplo = uplo
+
+    def stored(self, m: int, n: int) -> bool:
+        return n <= m if self.uplo == "lower" else m <= n
+
+    def tile(self, m: int, n: int) -> np.ndarray:
+        if not self.stored(m, n):
+            raise KeyError(f"tile ({m},{n}) not stored ({self.uplo})")
+        return super().tile(m, n)
+
+
+class TwoDimTabular(Collection):
+    """Arbitrary tile→rank table (reference: two_dim_tabular.c)."""
+
+    def __init__(self, M: int, N: int, mb: int, nb: int,
+                 table: np.ndarray, nodes: int = 1, myrank: int = 0,
+                 dtype=np.float32):
+        self.M, self.N, self.mb, self.nb = M, N, mb, nb
+        self.mt = (M + mb - 1) // mb
+        self.nt = (N + nb - 1) // nb
+        self.table = np.asarray(table, dtype=np.int64).reshape(self.mt, self.nt)
+        self.nodes, self.myrank = nodes, myrank
+        self.dtype = np.dtype(dtype)
+        self._tiles: Dict[Tuple[int, int], np.ndarray] = {}
+        self._datas: Dict[Tuple[int, int], Data] = {}
+
+    def rank_of(self, m: int, n: int) -> int:
+        return int(self.table[m, n])
+
+    def tile(self, m: int, n: int) -> np.ndarray:
+        key = (m, n)
+        if key not in self._tiles:
+            self._tiles[key] = np.zeros((self.mb, self.nb), dtype=self.dtype)
+        return self._tiles[key]
+
+    def data_of(self, m: int, n: int) -> Optional[Data]:
+        key = (m, n)
+        if key not in self._datas:
+            self._datas[key] = self._ctx.data(m * self.nt + n, self.tile(m, n))
+        return self._datas[key]
+
+
+class VectorCyclic(Collection):
+    """1-D cyclic distribution of vector segments (reference:
+    vector_two_dim_cyclic.c)."""
+
+    def __init__(self, N: int, nb: int, nodes: int = 1, myrank: int = 0,
+                 dtype=np.float32):
+        self.N, self.nb = N, nb
+        self.nt = (N + nb - 1) // nb
+        self.nodes, self.myrank = nodes, myrank
+        self.dtype = np.dtype(dtype)
+        self._segs: Dict[int, np.ndarray] = {}
+        self._datas: Dict[int, Data] = {}
+
+    def rank_of(self, k: int) -> int:
+        return k % self.nodes
+
+    def seg(self, k: int) -> np.ndarray:
+        if k not in self._segs:
+            self._segs[k] = np.zeros(self.nb, dtype=self.dtype)
+        return self._segs[k]
+
+    def data_of(self, k: int) -> Optional[Data]:
+        if k not in self._datas:
+            self._datas[k] = self._ctx.data(k, self.seg(k))
+        return self._datas[k]
+
+
+class HashDatadist(Collection):
+    """Irregular user-keyed distribution (reference: hash_datadist.c):
+    register arbitrary (key → rank, array) pairs."""
+
+    def __init__(self, nodes: int = 1, myrank: int = 0):
+        self.nodes, self.myrank = nodes, myrank
+        self._ranks: Dict[int, int] = {}
+        self._arrays: Dict[int, np.ndarray] = {}
+        self._datas: Dict[int, Data] = {}
+
+    def add(self, key: int, rank: int, array: Optional[np.ndarray] = None):
+        self._ranks[key] = rank
+        if array is not None:
+            self._arrays[key] = array
+
+    def rank_of(self, key: int) -> int:
+        return self._ranks.get(key, 0)
+
+    def data_of(self, key: int) -> Optional[Data]:
+        if key not in self._datas:
+            arr = self._arrays.get(key)
+            if arr is None:
+                return None
+            self._datas[key] = self._ctx.data(key, arr)
+        return self._datas[key]

@@ -1,0 +1,3 @@
+from .tpu import TpuDevice
+
+__all__ = ["TpuDevice"]

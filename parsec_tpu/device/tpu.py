@@ -1,0 +1,347 @@
+"""TPU device module: dispatches task bodies as cached XLA executables.
+
+Reference analog: the CUDA device module (parsec/mca/device/cuda/
+device_cuda_module.c — SURVEY.md §2.6/§3.4), re-designed for TPU/XLA:
+
+  - the native core pushes device-chore tasks onto a device queue
+    (PTC_BODY_DEVICE → ASYNC); a manager thread drains it — the analog of
+    the CUDA manager-thread pattern (device_cuda_module.c:2563-2589)
+  - task bodies are jax-traceable kernels; `jax.jit` gives the cached
+    per-(kernel, shape, dtype) executable — the analog of the dyld'd
+    cublas handle lookup (cuda_find_incarnation, :175)
+  - **device-resident dataflow**: results of device tasks stay on the TPU
+    (OWNED state); successors consume them straight from HBM.  The host
+    copy is only materialized (a) synchronously when the flow writes back
+    to collection memory (DEP_MEM output), (b) at `flush()`, or (c) never,
+    if the copy dies first (the native copy-release hook drops dead
+    mirrors).  This is the analog of the CUDA module's coherency
+    OWNED→SHARED epilog (device_cuda_module.c:2365-2420) + LRU
+    (parsec_gpu_data_reserve_device_space, :864).
+  - XLA's async dispatch gives the execution pipelining the CUDA module
+    builds manually from streams+events: the manager never blocks on
+    results that only device-side consumers need.
+
+Coherency caveat (round 1): a CPU chore consuming a tile whose newest
+version is device-resident would read stale host memory — chore lists
+put the TPU incarnation first, so mixed execution of one flow's
+producer/consumer across device types requires an intervening flush().
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import _native as N
+from ..core.context import Context
+from ..core.taskclass import Mem, TaskClass, TaskView
+from ..core.taskpool import Taskpool
+
+
+class _DeviceBody:
+    def __init__(self, kernel: Callable, reads: Sequence[str],
+                 writes: Sequence[str], shapes: Dict[str, tuple],
+                 dtypes: Dict[str, np.dtype], tc: TaskClass, tp: Taskpool):
+        self.kernel = kernel
+        self.reads = list(reads)
+        self.writes = list(writes)
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self.tc = tc
+        self.tp = tp
+        # flows whose output deps include a memory writeback: their host
+        # copy must be coherent at completion (release_deps may memcpy it)
+        self.mem_out_flows = set()
+        for fl in tc.flows:
+            if fl.name in self.writes:
+                for d in fl.deps:
+                    if d.direction == 1 and isinstance(d.target, Mem):
+                        self.mem_out_flows.add(fl.name)
+
+
+# process-wide executable cache: kernel fn -> jax.jit wrapper.  Re-wrapping
+# the same kernel in a new TpuDevice would re-trace and re-compile; keeping
+# the wrapper global makes every (kernel, shape, dtype) compile exactly once
+# per process (plus the on-disk jax compilation cache across processes).
+_JIT_CACHE: Dict[object, Callable] = {}
+
+
+def _get_jitted(jax_mod, kernel: Callable) -> Callable:
+    j = _JIT_CACHE.get(kernel)
+    if j is None:
+        j = jax_mod.jit(kernel)
+        _JIT_CACHE[kernel] = j
+    return j
+
+
+def local_tile_index(coll):
+    """Row-major (m, n) list of this rank's stored local tiles."""
+    out = []
+    for m in range(coll.mt):
+        for n in range(getattr(coll, "nt", 1)):
+            if coll.rank_of(m, n) != coll.myrank:
+                continue
+            if hasattr(coll, "stored") and not coll.stored(m, n):
+                continue
+            out.append((m, n))
+    return out
+
+
+class _CacheEnt:
+    __slots__ = ("version", "arr", "nbytes", "dirty", "host", "persistent")
+
+    def __init__(self, version, arr, nbytes, dirty=False, host=None,
+                 persistent=True):
+        self.version = version
+        self.arr = arr
+        self.nbytes = nbytes
+        self.dirty = dirty  # device newer than host; host view kept to flush
+        self.host = host
+        # persistent: backed by user Data (host buffer cannot be freed
+        # mid-flush); transient arena copies are never host-flushed
+        self.persistent = persistent
+
+
+class TpuDevice:
+    """One TPU device (one jax device) with a manager thread."""
+
+    def __init__(self, ctx: Context, jax_device=None, pipeline_depth: int = 16,
+                 cache_bytes: int = 4 << 30):
+        import jax  # deferred: tests may pin the platform first
+        from collections import OrderedDict
+        self._jax = jax
+        try:  # cross-process executable warmth (best effort)
+            import os
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ.get("PTC_JAX_CACHE",
+                                             "/tmp/ptc_jax_cache"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        except Exception:
+            pass
+        self.ctx = ctx
+        self.device = jax_device or jax.devices()[0]
+        self.qid = ctx.device_queue_new()
+        self.pipeline_depth = pipeline_depth
+        self.bodies: Dict[Tuple[int, int], _DeviceBody] = {}
+        self._tp_by_ptr: Dict[int, Taskpool] = {}
+        # device-copy LRU keyed by uid (stamped into the native copy handle,
+        # so freed/reused ptc_copy addresses can't alias — ABA guard)
+        self._cache: "OrderedDict[int, _CacheEnt]" = OrderedDict()
+        self._cache_bytes = cache_bytes
+        self._cache_used = 0
+        self._next_uid = 1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"tasks": 0, "h2d_bytes": 0, "d2h_bytes": 0,
+                      "h2d_hits": 0, "evictions": 0, "dead_drops": 0}
+        # native hook: copies dying with a device mirror drop it (a dead
+        # dirty mirror is garbage by definition — no consumer remains)
+        self._release_cb = N.COPY_RELEASE_CB_T(self._on_copy_released)
+        N.lib.ptc_set_copy_release_cb(ctx._ptr, self._release_cb, None)
+        ctx._devices.append(self)  # stopped before the native ctx dies
+
+    # ------------------------------------------------------------ cache
+    def _copy_uid(self, cptr) -> int:
+        with self._lock:  # races: manager vs stage_collection/gather
+            h = N.lib.ptc_copy_handle(cptr)
+            if h == 0:
+                h = self._next_uid
+                self._next_uid += 1
+                N.lib.ptc_copy_set_handle(cptr, h)
+            return h
+
+    def _on_copy_released(self, user, handle):
+        with self._lock:
+            ent = self._cache.pop(handle, None)
+            if ent is not None:
+                self._cache_used -= ent.nbytes
+                self.stats["dead_drops"] += 1
+
+    def _cache_put(self, uid, version, arr, nbytes, dirty=False, host=None,
+                   persistent=True):
+        with self._lock:
+            old = self._cache.pop(uid, None)
+            if old is not None:
+                self._cache_used -= old.nbytes
+            self._cache[uid] = _CacheEnt(version, arr, nbytes, dirty, host,
+                                         persistent)
+            self._cache_used += nbytes
+            evict = []
+            if self._cache_used > self._cache_bytes:
+                for k, ent in self._cache.items():
+                    if self._cache_used <= self._cache_bytes:
+                        break
+                    if ent.dirty or k == uid:
+                        continue  # dirty entries are pinned until flushed
+                    evict.append(k)
+                    self._cache_used -= ent.nbytes
+                for k in evict:
+                    del self._cache[k]
+                    self.stats["evictions"] += 1
+
+    def _cache_get(self, uid, version) -> Optional[object]:
+        with self._lock:
+            ent = self._cache.get(uid)
+            if ent is not None and ent.version == version:
+                self._cache.move_to_end(uid)
+                return ent.arr
+        return None
+
+    def flush(self):
+        """Write every dirty device mirror back to its host copy.  Call
+        before reading tiles on the host (to_dense, CPU chores, comm).
+        Same-shape mirrors are batched into one stacked d2h transfer."""
+        import jax.numpy as jnp
+        with self._lock:
+            # only persistent (user-Data-backed) hosts are written: arena
+            # buffers can be freed concurrently by the last consumer
+            dirty = [(k, e) for k, e in self._cache.items()
+                     if e.dirty and e.persistent]
+        by_shape: Dict[tuple, list] = {}
+        for uid, ent in dirty:
+            by_shape.setdefault(tuple(ent.host.shape), []).append(ent)
+        for shape, ents in by_shape.items():
+            stacked = np.asarray(jnp.stack([e.arr for e in ents]))
+            for e, res in zip(ents, stacked):
+                e.host[...] = res.reshape(e.host.shape)
+                self.stats["d2h_bytes"] += res.nbytes
+                with self._lock:
+                    e.dirty = False
+
+    # ------------------------------------------------------------ attach
+    def attach(self, tc: TaskClass, tp: Taskpool, kernel: Callable,
+               reads: Sequence[str], writes: Sequence[str],
+               shapes: Dict[str, tuple], dtype=np.float32,
+               dtypes: Optional[Dict[str, np.dtype]] = None,
+               sync_mem_out: bool = False):
+        """Attach a TPU chore: kernel(*read_arrays) -> write_array(s).
+
+        sync_mem_out=True forces a blocking d2h before task completion for
+        flows with memory-output deps — required only when the DAG writes a
+        flow into a *different* collection tile (cross-collection memcpy at
+        release); same-tile pass-through writebacks are no-ops natively and
+        are satisfied lazily by flush()."""
+        if dtypes is None:
+            dtypes = {f: np.dtype(dtype) for f in set(reads) | set(writes)}
+        tc.body_device(self.qid, device="tpu")
+        body = _DeviceBody(kernel, reads, writes, shapes, dtypes, tc, tp)
+        if not sync_mem_out:
+            body.mem_out_flows = set()
+        self.bodies[(id(tp), tc.id)] = body
+        self._tp_by_ptr[tp._ptr] = tp
+        if self._thread is None:
+            self.start()
+
+    def stage_collection(self, coll):
+        """Bulk-prestage every local tile of a TwoDimBlockCyclic-like
+        collection: ONE h2d transfer of a stacked array, then per-tile
+        device views.  Amortizes per-transfer latency (critical on
+        high-latency links; on any link it beats per-tile puts)."""
+        tiles = []
+        uids = []
+        for m, n in local_tile_index(coll):
+            d = coll.data_of(m, n)
+            cptr = N.lib.ptc_data_host_copy(d._ptr)
+            uids.append((self._copy_uid(cptr),
+                         N.lib.ptc_copy_version(cptr)))
+            tiles.append(coll.tile(m, n))
+        if not tiles:
+            return
+        stacked = self._jax.device_put(np.stack(tiles), self.device)
+        for i, (uid, ver) in enumerate(uids):
+            self._cache_put(uid, ver, stacked[i], tiles[i].nbytes)
+        self.stats["h2d_bytes"] += stacked.nbytes
+
+    def warm(self, kernel: Callable, example_args) -> None:
+        """Pre-compile a kernel for given example shapes (optional)."""
+        _get_jitted(self._jax, kernel).lower(*example_args).compile()
+
+    # ------------------------------------------------------------ manager
+    def start(self):
+        self._thread = threading.Thread(target=self._manager, daemon=True,
+                                        name="ptc-tpu-manager")
+        self._thread.start()
+
+    def stop(self):
+        """Flush dirty mirrors and stop the manager (idempotent)."""
+        if self._stop.is_set():
+            return
+        self.flush()
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def _manager(self):
+        """Dispatch loop.  XLA queues kernels asynchronously, so completing
+        a task here only means 'enqueued after its inputs' — device-side
+        consumers chain correctly, and host coherence points (mem-out
+        flows / flush) block on the actual results."""
+        while not self._stop.is_set():
+            task = self.ctx.device_pop(self.qid, timeout_ms=50)
+            if task:
+                self._dispatch(task)
+
+    def _body_for(self, task) -> Optional[_DeviceBody]:
+        tp_ptr = N.lib.ptc_task_taskpool(task)
+        tp = self._tp_by_ptr.get(tp_ptr)
+        if tp is None:
+            return None
+        cid = N.lib.ptc_task_class(task)
+        return self.bodies.get((id(tp), cid))
+
+    def _stage_in(self, view: TaskView, body: _DeviceBody, flow: str):
+        fi = body.tc.flow_index(flow)
+        cptr = N.lib.ptc_task_copy(view._ptr, fi)
+        uid = self._copy_uid(cptr)
+        ver = N.lib.ptc_copy_version(cptr)
+        arr = self._cache_get(uid, ver)
+        if arr is not None:
+            self.stats["h2d_hits"] += 1
+            return arr
+        host = view.data(flow, dtype=body.dtypes[flow],
+                         shape=body.shapes.get(flow))
+        darr = self._jax.device_put(host, self.device)
+        self._cache_put(uid, ver, darr, host.nbytes)
+        self.stats["h2d_bytes"] += host.nbytes
+        return darr
+
+    def _dispatch(self, task):
+        body = self._body_for(task)
+        if body is None:
+            self.ctx.task_complete(task)
+            return
+        view = TaskView(task, body.tc, body.tp)
+        try:
+            jitted = _get_jitted(self._jax, body.kernel)
+            ins = [self._stage_in(view, body, f) for f in body.reads]
+            out = jitted(*ins)  # async: returns immediately
+            outs = out if isinstance(out, tuple) else (out,)
+            for f, arr in zip(body.writes, outs):
+                fi = body.tc.flow_index(f)
+                cptr = N.lib.ptc_task_copy(view._ptr, fi)
+                uid = self._copy_uid(cptr)
+                ver = N.lib.ptc_copy_version(cptr)
+                host = view.data(f, dtype=body.dtypes[f],
+                                 shape=body.shapes.get(f))
+                persistent = bool(N.lib.ptc_copy_is_persistent(cptr))
+                if f in body.mem_out_flows:
+                    # host copy must be coherent before release_deps may
+                    # memcpy it into another collection tile
+                    res = np.asarray(arr)
+                    host[...] = res.reshape(host.shape)
+                    self.stats["d2h_bytes"] += res.nbytes
+                    self._cache_put(uid, ver + 1, arr, host.nbytes,
+                                    persistent=persistent)
+                else:
+                    self._cache_put(uid, ver + 1, arr, host.nbytes,
+                                    dirty=True, host=host,
+                                    persistent=persistent)
+            self.stats["tasks"] += 1
+        except Exception:
+            import traceback
+            traceback.print_exc()
+        finally:
+            self.ctx.task_complete(task)

@@ -1,0 +1,33 @@
+"""Tiled Cholesky correctness vs numpy (north-star workload, BASELINE rung 3/5)."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.algos import build_potrf
+from parsec_tpu.data import TwoDimBlockCyclic
+from parsec_tpu.device import TpuDevice
+
+
+def _spd(n, rng):
+    x = rng.standard_normal((n, n)).astype(np.float64)
+    return (x @ x.T + n * np.eye(n)).astype(np.float32)
+
+
+@pytest.mark.parametrize("use_dev", [False, True])
+@pytest.mark.parametrize("N,nb", [(64, 16), (96, 32)])
+def test_potrf_matches_numpy(N, nb, use_dev):
+    rng = np.random.default_rng(42)
+    M = _spd(N, rng)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(M)
+        A.register(ctx, "A")
+        dev = TpuDevice(ctx) if use_dev else None
+        tp = build_potrf(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        if dev:
+            dev.stop()
+        got = np.tril(A.to_dense())
+        ref = np.linalg.cholesky(M.astype(np.float64))
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)

@@ -1,10 +1,18 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh so multi-chip
-sharding paths compile+execute without TPU hardware (the driver separately
-dry-runs multichip; bench.py runs on the real chip outside pytest)."""
+sharding paths compile+execute without TPU hardware, and so the suite never
+contends for the real chip (bench.py runs on it outside pytest).
+
+Note: this environment ships an `axon` TPU plugin that overrides
+JAX_PLATFORMS=cpu from the environment — `jax.config.update` is the knob
+that actually wins."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
