@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Run a tutorial JDF: python examples/run_example.py Ex04_ChainData.jdf"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import parsec_tpu as pt
+from parsec_tpu.dsl.jdf import compile_jdf
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "Ex04_ChainData.jdf"
+    if not os.path.exists(path):
+        path = os.path.join(os.path.dirname(__file__), path)
+    src = open(path).read()
+    with pt.Context() as ctx:
+        buf = np.zeros(64, dtype=np.int64)
+        buf[0] = 300
+        ctx.register_linear_collection("mydata", buf, elem_size=8)
+        ctx.register_arena("default", 64)
+        b = compile_jdf(src, ctx, globals={"NB": 10, "N": 10},
+                        dtype=np.int64,
+                        arenas={"A": "default"})
+        tp = b.run()
+        tp.wait()
+    print("done;", tp.nb_total_tasks, "tasks")
+
+
+if __name__ == "__main__":
+    main()

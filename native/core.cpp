@@ -195,6 +195,20 @@ struct Arena {
 /* task                                                                */
 /* ------------------------------------------------------------------ */
 
+/* Dynamic-task extension (DTD): explicit successor lists instead of
+ * expression-derived deps.  Reference: parsec/interfaces/dtd.  */
+struct DynExt {
+  std::mutex lock;
+  std::vector<ptc_task *> succs;  /* registered, not yet released */
+  std::atomic<int32_t> remaining{1}; /* +1 submission hold */
+  std::atomic<int32_t> refs{1};      /* runtime ref; tiles add refs */
+  bool completed = false;
+  int32_t nb_flows = 0;
+  int32_t body_kind = 0; /* PTC_BODY_* */
+  int64_t body_arg = 0;
+  int32_t modes[PTC_MAX_FLOWS] = {0}; /* PTC_DTD_* per flow */
+};
+
 struct ptc_task {
   ptc_taskpool *tp = nullptr;
   int32_t class_id = 0;
@@ -204,6 +218,7 @@ struct ptc_task {
   int64_t locals[PTC_MAX_LOCALS];
   ptc_copy *data[PTC_MAX_FLOWS];
   ptc_task *next = nullptr; /* freelist link */
+  DynExt *dyn = nullptr;    /* non-null for DTD tasks */
 };
 
 namespace {
@@ -384,6 +399,9 @@ struct ptc_taskpool {
   DepShard shards[NB_SHARDS];
   std::mutex done_lock;
   std::condition_variable done_cv;
+  /* DTD insertion-window throttle */
+  std::mutex window_lock;
+  std::condition_variable window_cv;
 };
 
 struct ptc_context {
@@ -976,7 +994,52 @@ static void tp_abort(ptc_context *ctx, ptc_taskpool *tp) {
   tp_mark_complete(ctx, tp);
 }
 
+/* -------- DTD task lifetime + completion -------- */
+static void dyn_retain(ptc_task *t) {
+  t->dyn->refs.fetch_add(1, std::memory_order_relaxed);
+}
+
+static void dyn_release(ptc_task *t) {
+  if (t->dyn->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    delete t->dyn;
+    delete t; /* dyn tasks never enter the freelist */
+  }
+}
+
+static void dyn_complete_task(ptc_context *ctx, int worker, ptc_task *t) {
+  ptc_taskpool *tp = t->tp;
+  DynExt *dx = t->dyn;
+  /* version bumps MUST precede successor release: the device layer keys
+   * its copy mirrors by version (same order as the PTG path, which bumps
+   * in release_deps before delivering) */
+  for (int f = 0; f < dx->nb_flows; f++)
+    if (t->data[f] && (dx->modes[f] & PTC_DTD_OUTPUT))
+      t->data[f]->version.fetch_add(1, std::memory_order_release);
+  std::vector<ptc_task *> succs;
+  {
+    std::lock_guard<std::mutex> g(dx->lock);
+    dx->completed = true;
+    succs.swap(dx->succs);
+  }
+  for (ptc_task *s : succs) {
+    if (s->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
+      schedule_task(ctx, worker, s);
+  }
+  for (int f = 0; f < dx->nb_flows; f++)
+    if (t->data[f]) copy_release(ctx, t->data[f]);
+  dyn_release(t);
+  tp_task_done(ctx, tp); /* decrement before waking window waiters */
+  {
+    std::lock_guard<std::mutex> g(tp->window_lock);
+  }
+  tp->window_cv.notify_all();
+}
+
 static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
+  if (t->dyn) {
+    dyn_complete_task(ctx, worker, t);
+    return;
+  }
   ptc_taskpool *tp = t->tp;
   const TaskClass &tc = tp->classes[(size_t)t->class_id];
   release_deps(ctx, worker, t);
@@ -1009,9 +1072,76 @@ static void prof_event(ptc_context *ctx, int worker, int64_t key, int64_t phase,
   b->words.push_back(now_ns());
 }
 
+/* DTD failure: same taskpool-abort semantics as fail_task */
+static void dyn_fail_task(ptc_context *ctx, ptc_task *t) {
+  ptc_taskpool *tp = t->tp;
+  DynExt *dx = t->dyn;
+  {
+    std::lock_guard<std::mutex> g(dx->lock);
+    dx->completed = true; /* successors are never released */
+  }
+  for (int f = 0; f < dx->nb_flows; f++)
+    if (t->data[f]) copy_release(ctx, t->data[f]);
+  dyn_release(t);
+  tp_abort(ctx, tp);
+  {
+    std::lock_guard<std::mutex> g(tp->window_lock);
+  }
+  tp->window_cv.notify_all();
+}
+
+/* single-chore execution for dynamic tasks */
+static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
+  DynExt *dx = t->dyn;
+  int32_t rc = PTC_HOOK_DONE;
+  switch (dx->body_kind) {
+  case PTC_BODY_NOOP:
+    prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
+    prof_event(ctx, worker, PROF_KEY_EXEC, 1, t);
+    break;
+  case PTC_BODY_CB: {
+    BodyCb &cb = ctx->body_cbs[(size_t)dx->body_arg];
+    prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
+    rc = cb.fn(cb.user, t);
+    prof_event(ctx, worker, PROF_KEY_EXEC, 1, t);
+    break;
+  }
+  case PTC_BODY_DEVICE: {
+    DeviceQueue *q = ctx->dev_queues[(size_t)dx->body_arg];
+    {
+      std::lock_guard<std::mutex> g(q->lock);
+      q->dq.push_back(t);
+    }
+    q->cv.notify_one();
+    return; /* ASYNC */
+  }
+  default:
+    rc = PTC_HOOK_ERROR;
+  }
+  switch (rc) {
+  case PTC_HOOK_DONE:
+    complete_task(ctx, worker, t);
+    return;
+  case PTC_HOOK_AGAIN:
+    schedule_task(ctx, worker, t);
+    return;
+  case PTC_HOOK_ASYNC:
+    return;
+  default:
+    std::fprintf(stderr, "ptc: dtd task body error (%d); aborting taskpool\n",
+                 rc);
+    dyn_fail_task(ctx, t);
+    return;
+  }
+}
+
 /* chore execution protocol (reference: __parsec_execute,
  * parsec/scheduling.c:124-203) */
 static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
+  if (t->dyn) {
+    execute_dyn(ctx, worker, t);
+    return;
+  }
   ptc_taskpool *tp = t->tp;
   TaskClass &tc = tp->classes[(size_t)t->class_id];
   prepare_input(ctx, t);
@@ -1188,6 +1318,19 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
 }
 
 } // namespace
+
+/* ------------------------------------------------------------------ */
+/* DTD tiles                                                           */
+/* ------------------------------------------------------------------ */
+
+/* Per-tile accessor chain (reference: parsec_dtd_tile_t last_user /
+ * last_writer under per-tile locks, insert_function_internal.h:110-139) */
+struct ptc_dtile {
+  std::mutex lock;
+  ptc_copy *copy = nullptr;
+  ptc_task *last_writer = nullptr;
+  std::vector<ptc_task *> readers;
+};
 
 /* ------------------------------------------------------------------ */
 /* C API                                                               */
@@ -1427,6 +1570,15 @@ ptc_copy_t *ptc_task_copy(ptc_task_t *t, int32_t f) {
   return (t && f >= 0 && f < PTC_MAX_FLOWS) ? t->data[f] : nullptr;
 }
 ptc_taskpool_t *ptc_task_taskpool(ptc_task_t *t) { return t ? t->tp : nullptr; }
+void ptc_task_set_tag(ptc_task_t *t, int64_t tag) {
+  if (t) t->locals[PTC_MAX_LOCALS - 1] = tag;
+}
+int64_t ptc_task_get_tag(ptc_task_t *t) {
+  return t ? t->locals[PTC_MAX_LOCALS - 1] : 0;
+}
+int32_t ptc_dtask_nb_flows(ptc_task_t *t) {
+  return (t && t->dyn) ? t->dyn->nb_flows : 0;
+}
 
 /* device queues */
 int32_t ptc_device_queue_new(ptc_context_t *ctx) {
@@ -1451,6 +1603,121 @@ ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) 
 
 void ptc_task_complete(ptc_context_t *ctx, ptc_task_t *task) {
   complete_task(ctx, -1, task);
+}
+
+/* ------------------------------------------------------------ DTD API */
+ptc_dtile_t *ptc_dtile_new(ptc_context_t *ctx, ptc_data_t *d) {
+  (void)ctx;
+  if (!d || !d->host_copy) return nullptr;
+  ptc_dtile *tile = new ptc_dtile();
+  copy_retain(d->host_copy);
+  tile->copy = d->host_copy;
+  return tile;
+}
+
+void ptc_dtile_destroy(ptc_context_t *ctx, ptc_dtile_t *tile) {
+  if (!tile) return;
+  {
+    std::lock_guard<std::mutex> g(tile->lock);
+    if (tile->last_writer) dyn_release(tile->last_writer);
+    for (ptc_task *r : tile->readers) dyn_release(r);
+    tile->readers.clear();
+    tile->last_writer = nullptr;
+  }
+  copy_release(ctx, tile->copy);
+  delete tile;
+}
+
+ptc_task_t *ptc_dtask_begin(ptc_taskpool_t *tp, int32_t body_kind,
+                            int64_t body_arg, int32_t priority) {
+  ptc_task *t = new ptc_task();
+  t->tp = tp;
+  t->class_id = -1;
+  t->priority = priority;
+  std::memset(t->locals, 0, sizeof(t->locals));
+  std::memset(t->data, 0, sizeof(t->data));
+  t->dyn = new DynExt();
+  t->dyn->body_kind = body_kind;
+  t->dyn->body_arg = body_arg;
+  return t;
+}
+
+int32_t ptc_dtask_arg(ptc_task_t *t, ptc_dtile_t *tile, int32_t mode) {
+  DynExt *dx = t->dyn;
+  if (!dx || dx->nb_flows >= PTC_MAX_FLOWS) return -1;
+  int f = dx->nb_flows++;
+  dx->modes[f] = mode;
+  std::lock_guard<std::mutex> g(tile->lock);
+  copy_retain(tile->copy);
+  t->data[f] = tile->copy;
+
+  auto add_dep = [&](ptc_task *pred) {
+    if (!pred || pred == t || !pred->dyn) return;
+    std::lock_guard<std::mutex> pg(pred->dyn->lock);
+    if (!pred->dyn->completed) {
+      dx->remaining.fetch_add(1, std::memory_order_relaxed);
+      pred->dyn->succs.push_back(t);
+    }
+  };
+
+  /* RAW/WAW: everyone orders after the last writer */
+  add_dep(tile->last_writer);
+  if (mode & PTC_DTD_OUTPUT) {
+    /* WAR: writers wait for all current readers, then take the chain */
+    for (ptc_task *r : tile->readers) add_dep(r);
+    if (tile->last_writer) dyn_release(tile->last_writer);
+    for (ptc_task *r : tile->readers) dyn_release(r);
+    tile->readers.clear();
+    dyn_retain(t);
+    tile->last_writer = t;
+  } else {
+    /* amortized pruning: drop already-completed readers so read-heavy
+     * chains don't retain dead tasks (and writers scan fewer entries) */
+    size_t w = 0;
+    for (size_t i = 0; i < tile->readers.size(); i++) {
+      ptc_task *r = tile->readers[i];
+      bool done;
+      {
+        std::lock_guard<std::mutex> rg(r->dyn->lock);
+        done = r->dyn->completed;
+      }
+      if (done)
+        dyn_release(r);
+      else
+        tile->readers[w++] = r;
+    }
+    tile->readers.resize(w);
+    dyn_retain(t);
+    tile->readers.push_back(t);
+  }
+  return f;
+}
+
+int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
+  ptc_taskpool *tp = t->tp;
+  if (window > 0) {
+    std::unique_lock<std::mutex> lk(tp->window_lock);
+    tp->window_cv.wait(lk, [&] {
+      return tp->nb_tasks.load() < window ||
+             tp->completed.load(std::memory_order_acquire) ||
+             ctx->shutdown.load(std::memory_order_acquire);
+    });
+  }
+  if (tp->completed.load(std::memory_order_acquire)) {
+    /* pool aborted (a body failed): refuse the insertion */
+    ptc_task_t *dead = t;
+    for (int f = 0; f < dead->dyn->nb_flows; f++)
+      if (dead->data[f]) copy_release(ctx, dead->data[f]);
+    dyn_release(dead);
+    return -1;
+  }
+  tp->nb_tasks.fetch_add(1, std::memory_order_acq_rel);
+  tp->nb_total.fetch_add(1, std::memory_order_relaxed);
+  ptc_context_start(ctx);
+  /* drop the submission hold; schedule if all preds already done */
+  if (t->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
+    schedule_task(ctx, 0, t);
+  return 0;
 }
 
 /* profiling */

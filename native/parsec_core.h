@@ -198,6 +198,35 @@ void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
 
+/* ------------------------------------------------------- DTD (dynamic)
+ * Dynamic task discovery: tasks are inserted one by one with explicit
+ * data arguments; dependencies derive from per-tile last-writer/reader
+ * accessor chains (reference: parsec/interfaces/dtd/insert_function.c,
+ * insert_function_internal.h:110-139 — SURVEY.md §2.7).  The taskpool
+ * must be open (ptc_tp_set_open) while inserting.                       */
+typedef struct ptc_dtile ptc_dtile_t;
+
+enum { PTC_DTD_INPUT = 1, PTC_DTD_OUTPUT = 2, PTC_DTD_INOUT = 3 };
+
+/* wrap a datum's host copy as a trackable tile */
+ptc_dtile_t *ptc_dtile_new(ptc_context_t *ctx, ptc_data_t *d);
+/* drop the tile tracker (does not free the datum) */
+void ptc_dtile_destroy(ptc_context_t *ctx, ptc_dtile_t *tile);
+
+/* begin a dynamic task: body as in chores (PTC_BODY_CB/NOOP/DEVICE) */
+ptc_task_t *ptc_dtask_begin(ptc_taskpool_t *tp, int32_t body_kind,
+                            int64_t body_arg, int32_t priority);
+/* append a data argument (flow index = call order); mode PTC_DTD_*  */
+int32_t ptc_dtask_arg(ptc_task_t *t, ptc_dtile_t *tile, int32_t mode);
+/* submit; blocks while more than `window` tasks are in flight (0: no
+ * throttle).  Returns 0, or -1 if the pool aborted (task refused). */
+int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window);
+int32_t ptc_dtask_nb_flows(ptc_task_t *t);
+/* opaque user tag on a task (stored in the last local slot; used by the
+ * device layer to key per-task DTD bodies without pointer-ABA issues) */
+void ptc_task_set_tag(ptc_task_t *t, int64_t tag);
+int64_t ptc_task_get_tag(ptc_task_t *t);
+
 /* Notification when a copy with a nonzero handle reaches refcount 0: the
  * device layer drops its device-resident mirror (the handle is the device
  * layer's uid).  Called from whichever thread releases the last ref. */

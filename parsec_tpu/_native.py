@@ -136,6 +136,15 @@ _sigs = {
     "ptc_device_queue_new": (C.c_int32, [C.c_void_p]),
     "ptc_device_pop": (C.c_void_p, [C.c_void_p, C.c_int32, C.c_int32]),
     "ptc_task_complete": (None, [C.c_void_p, C.c_void_p]),
+    "ptc_dtile_new": (C.c_void_p, [C.c_void_p, C.c_void_p]),
+    "ptc_dtile_destroy": (None, [C.c_void_p, C.c_void_p]),
+    "ptc_dtask_begin": (C.c_void_p, [C.c_void_p, C.c_int32, C.c_int64,
+                                     C.c_int32]),
+    "ptc_dtask_arg": (C.c_int32, [C.c_void_p, C.c_void_p, C.c_int32]),
+    "ptc_dtask_submit": (C.c_int32, [C.c_void_p, C.c_void_p, C.c_int64]),
+    "ptc_dtask_nb_flows": (C.c_int32, [C.c_void_p]),
+    "ptc_task_set_tag": (None, [C.c_void_p, C.c_int64]),
+    "ptc_task_get_tag": (C.c_int64, [C.c_void_p]),
     "ptc_profile_enable": (None, [C.c_void_p, C.c_int32]),
     "ptc_profile_take": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
 }

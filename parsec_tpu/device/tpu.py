@@ -40,9 +40,10 @@ from ..core.taskpool import Taskpool
 
 
 class _DeviceBody:
-    def __init__(self, kernel: Callable, reads: Sequence[str],
-                 writes: Sequence[str], shapes: Dict[str, tuple],
-                 dtypes: Dict[str, np.dtype], tc: TaskClass, tp: Taskpool):
+    def __init__(self, kernel: Callable, reads: Sequence,
+                 writes: Sequence, shapes: Dict, dtypes: Dict,
+                 tc: Optional[TaskClass], tp: Optional[Taskpool],
+                 nb_flows: int = 0):
         self.kernel = kernel
         self.reads = list(reads)
         self.writes = list(writes)
@@ -50,14 +51,25 @@ class _DeviceBody:
         self.dtypes = dtypes
         self.tc = tc
         self.tp = tp
+        self.nb_flows = nb_flows
         # flows whose output deps include a memory writeback: their host
         # copy must be coherent at completion (release_deps may memcpy it)
         self.mem_out_flows = set()
-        for fl in tc.flows:
-            if fl.name in self.writes:
-                for d in fl.deps:
-                    if d.direction == 1 and isinstance(d.target, Mem):
-                        self.mem_out_flows.add(fl.name)
+        if tc is not None:
+            for fl in tc.flows:
+                if fl.name in self.writes:
+                    for d in fl.deps:
+                        if d.direction == 1 and isinstance(d.target, Mem):
+                            self.mem_out_flows.add(fl.name)
+
+    def flow_index(self, f) -> int:
+        return f if isinstance(f, int) else self.tc.flow_index(f)
+
+    def make_view(self, task_ptr):
+        if self.tc is not None:
+            return TaskView(task_ptr, self.tc, self.tp)
+        from ..dsl.dtd import DtdView
+        return DtdView(task_ptr, self.nb_flows)
 
 
 # process-wide executable cache: kernel fn -> jax.jit wrapper.  Re-wrapping
@@ -124,6 +136,7 @@ class TpuDevice:
         self.qid = ctx.device_queue_new()
         self.pipeline_depth = pipeline_depth
         self.bodies: Dict[Tuple[int, int], _DeviceBody] = {}
+        self._dtd_bodies: Dict[int, _DeviceBody] = {}
         self._tp_by_ptr: Dict[int, Taskpool] = {}
         # device-copy LRU keyed by uid (stamped into the native copy handle,
         # so freed/reused ptc_copy addresses can't alias — ABA guard)
@@ -141,6 +154,7 @@ class TpuDevice:
         self._release_cb = N.COPY_RELEASE_CB_T(self._on_copy_released)
         N.lib.ptc_set_copy_release_cb(ctx._ptr, self._release_cb, None)
         ctx._devices.append(self)  # stopped before the native ctx dies
+        self.start()
 
     # ------------------------------------------------------------ cache
     def _copy_uid(self, cptr) -> int:
@@ -231,8 +245,6 @@ class TpuDevice:
             body.mem_out_flows = set()
         self.bodies[(id(tp), tc.id)] = body
         self._tp_by_ptr[tp._ptr] = tp
-        if self._thread is None:
-            self.start()
 
     def stage_collection(self, coll):
         """Bulk-prestage every local tile of a TwoDimBlockCyclic-like
@@ -260,6 +272,8 @@ class TpuDevice:
 
     # ------------------------------------------------------------ manager
     def start(self):
+        if self._thread is not None:
+            return
         self._thread = threading.Thread(target=self._manager, daemon=True,
                                         name="ptc-tpu-manager")
         self._thread.start()
@@ -284,7 +298,26 @@ class TpuDevice:
             if task:
                 self._dispatch(task)
 
+    def register_dtd_task(self, task_ptr, kernel, reads, writes, shapes,
+                          dtype, nb_flows):
+        """Per-task body for a DTD device task (consumed at dispatch).
+        Keyed by a unique tag stamped on the task — raw heap addresses can
+        be reused by later tasks (same ABA issue the copy cache guards)."""
+        dtypes = {i: np.dtype(dtype) for i in range(nb_flows)}
+        with self._lock:
+            tag = self._next_uid
+            self._next_uid += 1
+            N.lib.ptc_task_set_tag(task_ptr, tag)
+            self._dtd_bodies[tag] = _DeviceBody(
+                kernel, reads, writes, shapes, dtypes, None, None, nb_flows)
+
     def _body_for(self, task) -> Optional[_DeviceBody]:
+        tag = N.lib.ptc_task_get_tag(task)
+        if tag:
+            with self._lock:
+                b = self._dtd_bodies.pop(tag, None)
+            if b is not None:
+                return b
         tp_ptr = N.lib.ptc_task_taskpool(task)
         tp = self._tp_by_ptr.get(tp_ptr)
         if tp is None:
@@ -292,8 +325,8 @@ class TpuDevice:
         cid = N.lib.ptc_task_class(task)
         return self.bodies.get((id(tp), cid))
 
-    def _stage_in(self, view: TaskView, body: _DeviceBody, flow: str):
-        fi = body.tc.flow_index(flow)
+    def _stage_in(self, view, body: _DeviceBody, flow):
+        fi = body.flow_index(flow)
         cptr = N.lib.ptc_task_copy(view._ptr, fi)
         uid = self._copy_uid(cptr)
         ver = N.lib.ptc_copy_version(cptr)
@@ -313,14 +346,14 @@ class TpuDevice:
         if body is None:
             self.ctx.task_complete(task)
             return
-        view = TaskView(task, body.tc, body.tp)
+        view = body.make_view(task)
         try:
             jitted = _get_jitted(self._jax, body.kernel)
             ins = [self._stage_in(view, body, f) for f in body.reads]
             out = jitted(*ins)  # async: returns immediately
             outs = out if isinstance(out, tuple) else (out,)
             for f, arr in zip(body.writes, outs):
-                fi = body.tc.flow_index(f)
+                fi = body.flow_index(f)
                 cptr = N.lib.ptc_task_copy(view._ptr, fi)
                 uid = self._copy_uid(cptr)
                 ver = N.lib.ptc_copy_version(cptr)

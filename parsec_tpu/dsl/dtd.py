@@ -1,0 +1,163 @@
+"""DTD — Dynamic Task Discovery interface.
+
+Build the DAG as you go: every inserted task names its data arguments with
+access modes (INPUT/OUTPUT/INOUT); dependencies derive from per-tile
+last-writer/readers accessor chains maintained natively.  A sliding window
+throttles discovery so the DAG never outruns execution.
+
+Reference: parsec/interfaces/dtd/insert_function.{c,h} (SURVEY.md §2.7,
+call stack §3.5): parsec_dtd_taskpool_new / parsec_dtd_tile_of /
+parsec_dtd_insert_task / parsec_dtd_taskpool_wait, window throttling at
+insert_function.c:69,472-509.
+"""
+from __future__ import annotations
+
+import traceback
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import _native as N
+from ..core.context import Context, Data
+from ..core.taskpool import Taskpool
+
+INPUT = N_INPUT = 1
+OUTPUT = N_OUTPUT = 2
+INOUT = N_INOUT = 3
+
+_MODES = {"INPUT": 1, "OUTPUT": 2, "INOUT": 3, "R": 1, "W": 2, "RW": 3}
+
+
+class DtdView:
+    """Body-side view of a DTD task: flows addressed by argument index."""
+
+    __slots__ = ("_ptr", "nb_flows")
+
+    def __init__(self, ptr, nb_flows: int = -1):
+        self._ptr = ptr
+        # per-task arity from the native side (a cached body callback is
+        # shared between insertions of the same fn with different arities)
+        self.nb_flows = (nb_flows if nb_flows >= 0
+                         else N.lib.ptc_dtask_nb_flows(ptr))
+
+    def data_ptr(self, i: int) -> int:
+        return N.lib.ptc_task_data_ptr(self._ptr, i)
+
+    def data(self, i: int, dtype=np.uint8, shape=None) -> np.ndarray:
+        import ctypes as C
+        ptr = N.lib.ptc_task_data_ptr(self._ptr, i)
+        if not ptr:
+            raise RuntimeError(f"dtd task: argument {i} has no data")
+        size = N.lib.ptc_copy_size(N.lib.ptc_task_copy(self._ptr, i))
+        dt = np.dtype(dtype)
+        buf = (C.c_char * size).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dt, count=size // dt.itemsize)
+        return arr.reshape(shape) if shape is not None else arr
+
+
+class DtdTile:
+    """Handle to a tracked datum (reference: parsec_dtd_tile_of)."""
+
+    __slots__ = ("_ptr", "data")
+
+    def __init__(self, ctx: Context, data: Data):
+        self.data = data
+        self._ptr = N.lib.ptc_dtile_new(ctx._ptr, data._ptr)
+
+
+class DtdTaskpool:
+    def __init__(self, ctx: Context, window: int = 8000):
+        self.ctx = ctx
+        self.window = window
+        self.tp = Taskpool(ctx)
+        self.tp.set_open(True)
+        self.tp.run()  # zero classes; registers with the context
+        self._tiles: Dict[Tuple[int, object], DtdTile] = {}
+        self._body_ids: Dict[Callable, int] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------- tiles
+    def tile_of(self, source, *key) -> DtdTile:
+        """Tile for a Data object or a (collection, key...) pair."""
+        if isinstance(source, Data):
+            k = (id(source), None)
+            if k not in self._tiles:
+                self._tiles[k] = DtdTile(self.ctx, source)
+            return self._tiles[k]
+        k = (id(source), key)
+        if k not in self._tiles:
+            d = source.data_of(*key)
+            self._tiles[k] = DtdTile(self.ctx, d)
+        return self._tiles[k]
+
+    # ------------------------------------------------------------- insert
+    def _body_id(self, fn: Callable) -> int:
+        bid = self._body_ids.get(fn)
+        if bid is None:
+            def _cb(user, task_ptr):
+                try:
+                    r = fn(DtdView(task_ptr))
+                    if isinstance(r, int) and not isinstance(r, bool):
+                        return r
+                    return N.HOOK_DONE
+                except Exception:
+                    traceback.print_exc()
+                    return N.HOOK_ERROR
+
+            bid = self.ctx.register_body_cb(_cb)
+            self._body_ids[fn] = bid
+        return bid
+
+    def insert_task(self, fn: Callable, *args, priority: int = 0):
+        """insert_task(body, (tile, "INPUT"), (tile2, "INOUT"), ...).
+
+        body(view) runs on a worker; view.data(i) is the i-th argument."""
+        if self._closed:
+            raise RuntimeError("taskpool already closed")
+        bid = self._body_id(fn)
+        t = N.lib.ptc_dtask_begin(self.tp._ptr, N.BODY_CB, bid, priority)
+        for tile, mode in args:
+            m = _MODES[mode.upper()] if isinstance(mode, str) else int(mode)
+            if N.lib.ptc_dtask_arg(t, tile._ptr, m) < 0:
+                raise ValueError(
+                    "insert_task: too many arguments (max 20)")
+        if N.lib.ptc_dtask_submit(self.ctx._ptr, t, self.window) != 0:
+            raise RuntimeError("taskpool aborted: insertion refused")
+        return t
+
+    def insert_tpu_task(self, dev, kernel: Callable, *args,
+                        shapes=None, dtype=np.float32, priority: int = 0):
+        """Insert a device task: kernel(*inputs) -> outputs, dispatched by
+        the TPU device manager (reads = all args; writes = OUTPUT/INOUT
+        args, in order)."""
+        if self._closed:
+            raise RuntimeError("taskpool already closed")
+        t = N.lib.ptc_dtask_begin(self.tp._ptr, N.BODY_DEVICE, dev.qid,
+                                  priority)
+        reads, writes = [], []
+        for i, (tile, mode) in enumerate(args):
+            m = _MODES[mode.upper()] if isinstance(mode, str) else int(mode)
+            if N.lib.ptc_dtask_arg(t, tile._ptr, m) < 0:
+                raise ValueError("insert_tpu_task: too many arguments")
+            if m & 1:
+                reads.append(i)
+            if m & 2:
+                writes.append(i)
+        dev.register_dtd_task(t, kernel, reads, writes,
+                              shapes or {}, dtype, len(args))
+        if N.lib.ptc_dtask_submit(self.ctx._ptr, t, self.window) != 0:
+            raise RuntimeError("taskpool aborted: insertion refused")
+        return t
+
+    # ------------------------------------------------------------- finish
+    def wait(self):
+        """Close the window and wait for every discovered task."""
+        self._closed = True
+        self.tp.set_open(False)
+        self.tp.wait()
+
+    def destroy(self):
+        for tile in self._tiles.values():
+            N.lib.ptc_dtile_destroy(self.ctx._ptr, tile._ptr)
+        self._tiles.clear()
+        self.tp.destroy()

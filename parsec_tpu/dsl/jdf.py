@@ -1,0 +1,606 @@
+"""PTG front-end: the JDF language, compiled to table-driven task classes.
+
+Reference: the parsec_ptgpp compiler (parsec/interfaces/ptg/ptg-compiler:
+parsec.l flex lexer, parsec.y bison grammar, jdf2c.c generator — SURVEY.md
+§2.7/§3.6).  This implementation keeps the JDF *surface syntax* — globals,
+parameter ranges, derived locals, `: coll(...)` affinity, guarded/ternary
+dataflow deps with ranges, CTL flows, NEW/NULL, multiple BODY incarnations
+— but compiles to the native expression-VM spec via the TaskClass builder
+instead of generating C, and bodies are Python (CPU chore) or jax-traceable
+code (`BODY [type=TPU]`) instead of inline C.
+
+Supported grammar (subset, expanding):
+
+    extern "C" %{ <python prologue> %}      # exec'd into the program scope
+    NAME [type="int"] [hidden=on] [default=<expr>]
+    Task(k, m)
+    k = lo .. hi [.. step]                   # range parameter
+    loc = <expr>                             # derived local
+    : coll(<expr>, ...)                      # affinity
+    priority = <expr>                        # optional
+    RW|READ|WRITE|CTL F <- <dep>  -> <dep> ...
+    BODY [type=TPU] { <python/jax code> } END / BODY { ... } END
+
+    <dep> := [(guard) ?] <target> [: <target>]
+    <target> := F Task(e, lo..hi, ...) | coll(e, ...) | NEW | NULL
+
+Expressions: C-style with ? :, && || !, comparisons, + - * / %, and
+`%{ <python expr> %}` escapes evaluated with (locals, globals) dicts.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import expr as E
+from ..core.taskclass import In, Mem, Out, Ref, TaskClass
+from ..core.taskpool import Taskpool
+
+# ------------------------------------------------------------------ lexer
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|//[^\n]*|/\*.*?\*/)
+  | (?P<escape>%\{.*?%\})
+  | (?P<num>\d+)
+  | (?P<id>[A-Za-z_]\w*)
+  | (?P<str>"[^"]*")
+  | (?P<arrow_in><-)
+  | (?P<arrow_out>->)
+  | (?P<dotdot>\.\.)
+  | (?P<op>==|!=|<=|>=|&&|\|\||[-+*/%()\[\],:?=<>!;{}])
+""", re.VERBOSE | re.DOTALL)
+
+
+class Tok:
+    def __init__(self, kind: str, val: str, pos: int):
+        self.kind = kind
+        self.val = val
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.val!r}"
+
+
+def _lex(src: str) -> List[Tok]:
+    toks = []
+    i = 0
+    while i < len(src):
+        m = _TOKEN_RE.match(src, i)
+        if not m:
+            raise SyntaxError(f"jdf: cannot tokenize at {src[i:i+40]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        toks.append(Tok(kind, m.group(), m.start()))
+    toks.append(Tok("eof", "", len(src)))
+    return toks
+
+
+# ------------------------------------------------------------------ AST
+
+class JdfGlobal:
+    def __init__(self, name, typ="int", hidden=False, default=None):
+        self.name, self.typ, self.hidden, self.default = \
+            name, typ, hidden, default
+
+
+class JdfDepTarget:
+    def __init__(self, kind, name=None, flow=None, args=None):
+        self.kind = kind  # "task" | "mem" | "new" | "null"
+        self.name = name  # task or collection name
+        self.flow = flow  # flow name on the peer (task kind)
+        self.args = args or []
+
+
+class JdfDep:
+    def __init__(self, direction, guard, target, alt=None):
+        self.direction = direction  # 0 in, 1 out
+        self.guard = guard          # Expr | None
+        self.target = target        # JdfDepTarget
+        self.alt = alt              # else-branch target
+
+
+class JdfFlow:
+    def __init__(self, access, name):
+        self.access = access
+        self.name = name
+        self.deps: List[JdfDep] = []
+
+
+class JdfBody:
+    def __init__(self, props, code):
+        self.props = props  # dict
+        self.code = code
+
+
+class JdfTask:
+    def __init__(self, name, params):
+        self.name = name
+        self.params = params  # [str]
+        self.locals: List[Tuple[str, object]] = []  # (name, Range|Expr)
+        self.affinity: Optional[Tuple[str, list]] = None
+        self.priority = None
+        self.flows: List[JdfFlow] = []
+        self.bodies: List[JdfBody] = []
+
+
+class JdfProgram:
+    def __init__(self):
+        self.prologue = ""
+        self.globals: List[JdfGlobal] = []
+        self.tasks: List[JdfTask] = []
+
+
+# ------------------------------------------------------------------ parser
+
+_ACCESS = {"RW": "RW", "READ": "READ", "WRITE": "WRITE", "CTL": "CTL"}
+
+
+_BODY_RE = re.compile(
+    r"BODY(?P<props>\s*\[[^\]]*\])?\s*\{(?P<code>.*?)\}\s*END",
+    re.DOTALL)
+
+
+def _extract_bodies(src: str):
+    """Replace BODY [...] { python } END blocks with `BODY <idx>` markers so
+    the JDF lexer never sees Python code."""
+    bodies = []
+
+    def repl(m):
+        bodies.append((m.group("props") or "", m.group("code")))
+        return f"BODY {len(bodies) - 1}\n"
+
+    return _BODY_RE.sub(repl, src), bodies
+
+
+class _Parser:
+    def __init__(self, toks: List[Tok], src: str, bodies):
+        self.toks = toks
+        self.i = 0
+        self.src = src
+        self.bodies = bodies
+
+    def peek(self, k=0) -> Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, val) -> Tok:
+        t = self.next()
+        if t.val != val:
+            raise SyntaxError(f"jdf: expected {val!r}, got {t.val!r} "
+                              f"near {self.src[t.pos:t.pos+40]!r}")
+        return t
+
+    def accept(self, val) -> bool:
+        if self.peek().val == val:
+            self.i += 1
+            return True
+        return False
+
+    # ------------------------------------------------------- program level
+    def parse(self) -> JdfProgram:
+        prog = JdfProgram()
+        while self.peek().kind != "eof":
+            t = self.peek()
+            if t.kind == "id" and t.val == "extern":
+                self.next()
+                self.expect('"C"') if self.peek().val == '"C"' else None
+                esc = self.next()
+                if esc.kind != "escape":
+                    raise SyntaxError("jdf: expected %{ ... %} after extern")
+                prog.prologue += esc.val[2:-2] + "\n"
+            elif t.kind == "escape":
+                self.next()
+                prog.prologue += t.val[2:-2] + "\n"
+            elif t.kind == "id" and self.peek(1).val == "[":
+                prog.globals.append(self._parse_global())
+            elif t.kind == "id" and self.peek(1).val == "(":
+                prog.tasks.append(self._parse_task())
+            elif t.kind == "id":
+                # global without properties: NAME
+                prog.globals.append(JdfGlobal(self.next().val))
+            else:
+                raise SyntaxError(f"jdf: unexpected {t.val!r}")
+        return prog
+
+    def _parse_props(self) -> Dict[str, str]:
+        props: Dict[str, str] = {}
+        self.expect("[")
+        while not self.accept("]"):
+            key = self.next().val
+            self.expect("=")
+            vals = []
+            while self.peek().val not in ("]",) and not (
+                    self.peek().kind == "id" and self.peek(1).val == "="):
+                vals.append(self.next().val)
+            props[key] = " ".join(vals)
+        return props
+
+    def _parse_global(self) -> JdfGlobal:
+        name = self.next().val
+        props = self._parse_props()
+        typ = props.get("type", '"int"').strip('"')
+        hidden = props.get("hidden", "off") in ("on", "ON", "true")
+        default = props.get("default")
+        return JdfGlobal(name, typ, hidden, default)
+
+    # ------------------------------------------------------- task level
+    def _parse_task(self) -> JdfTask:
+        name = self.next().val
+        self.expect("(")
+        params = []
+        while not self.accept(")"):
+            params.append(self.next().val)
+            self.accept(",")
+        task = JdfTask(name, params)
+        # locals until ':' (affinity) — every line `id = ...`
+        while True:
+            t = self.peek()
+            if t.val == ":":
+                break
+            if t.kind == "id" and self.peek(1).val == "=":
+                nm = self.next().val
+                self.expect("=")
+                first = self._parse_expr()
+                if self.accept(".."):
+                    hi = self._parse_expr()
+                    step = self._parse_expr() if self.accept("..") else 1
+                    if nm == "priority":
+                        raise SyntaxError("jdf: priority cannot be a range")
+                    task.locals.append((nm, E.Range(first, hi, step)))
+                elif nm == "priority":
+                    task.priority = first
+                else:
+                    task.locals.append((nm, first))
+            else:
+                break
+        if self.accept(":"):
+            coll = self.next().val
+            self.expect("(")
+            args = []
+            while not self.accept(")"):
+                args.append(self._parse_expr())
+                self.accept(",")
+            task.affinity = (coll, args)
+        # priority may also follow affinity
+        while self.peek().kind == "id" and self.peek().val == "priority" \
+                and self.peek(1).val == "=":
+            self.next()
+            self.expect("=")
+            task.priority = self._parse_expr()
+        # flows
+        while self.peek().kind == "id" and self.peek().val in _ACCESS:
+            task.flows.append(self._parse_flow())
+        # bodies
+        while self.peek().kind == "id" and self.peek().val == "BODY":
+            task.bodies.append(self._parse_body())
+        if not task.bodies:
+            raise SyntaxError(f"jdf: task {name} has no BODY")
+        return task
+
+    def _parse_flow(self) -> JdfFlow:
+        access = self.next().val
+        name = self.next().val
+        fl = JdfFlow(_ACCESS[access], name)
+        while self.peek().val in ("<-", "->"):
+            direction = 0 if self.next().val == "<-" else 1
+            fl.deps.append(self._parse_dep(direction))
+        return fl
+
+    def _parse_dep(self, direction: int) -> JdfDep:
+        guard = None
+        alt = None
+        # `(guard) ? target [: target]`  — need lookahead: a '(' could also
+        # open a parenthesized expression... in JDF a dep starts either with
+        # '(' guard or an identifier (flow/coll/NEW/NULL).
+        if self.peek().val == "(":
+            # or-level, not ternary: the dep's own `?` must stay unconsumed
+            guard = self._or()
+            self.expect("?")
+            target = self._parse_target()
+            if self.accept(":"):
+                alt = self._parse_target()
+        else:
+            target = self._parse_target()
+        return JdfDep(direction, guard, target, alt)
+
+    def _parse_target(self) -> JdfDepTarget:
+        t = self.next()
+        if t.kind != "id":
+            raise SyntaxError(f"jdf: bad dep target {t.val!r}")
+        if t.val == "NEW":
+            return JdfDepTarget("new")
+        if t.val == "NULL":
+            return JdfDepTarget("null")
+        if self.peek().val == "(":
+            # collection reference: coll(args)
+            self.expect("(")
+            args = []
+            while not self.accept(")"):
+                args.append(self._parse_range_or_expr())
+                self.accept(",")
+            return JdfDepTarget("mem", name=t.val, args=args)
+        # flow Task(args)
+        flow = t.val
+        tname = self.next().val
+        self.expect("(")
+        args = []
+        while not self.accept(")"):
+            args.append(self._parse_range_or_expr())
+            self.accept(",")
+        return JdfDepTarget("task", name=tname, flow=flow, args=args)
+
+    def _parse_body(self) -> JdfBody:
+        """Bodies are pre-extracted (their code is Python, not lexable as
+        JDF): the preprocessor replaced each with `BODY <idx>`."""
+        self.next()  # BODY
+        idx = int(self.next().val)
+        props_str, code = self.bodies[idx]
+        props = dict(re.findall(r'(\w+)\s*=\s*("[^"]*"|[^\s\]]+)', props_str))
+        props = {k: v.strip('"') for k, v in props.items()}
+        return JdfBody(props, code)
+
+    # ------------------------------------------------------- expressions
+    def _parse_range_or_expr(self):
+        e = self._parse_expr()
+        if self.accept(".."):
+            hi = self._parse_expr()
+            step = self._parse_expr() if self.accept("..") else 1
+            return E.Range(e, hi, step)
+        return e
+
+    def _parse_expr(self):
+        return self._ternary()
+
+    def _ternary(self):
+        c = self._or()
+        if self.accept("?"):
+            a = self._ternary()
+            self.expect(":")
+            b = self._ternary()
+            return E.select(c, a, b)
+        return c
+
+    def _or(self):
+        a = self._and()
+        while self.peek().val == "||":
+            self.next()
+            a = E.BinOp(E.N.OP_OR, a, self._and())
+        return a
+
+    def _and(self):
+        a = self._cmp()
+        while self.peek().val == "&&":
+            self.next()
+            a = E.BinOp(E.N.OP_AND, a, self._cmp())
+        return a
+
+    _CMPOPS = {"==": E.N.OP_EQ, "!=": E.N.OP_NE, "<": E.N.OP_LT,
+               "<=": E.N.OP_LE, ">": E.N.OP_GT, ">=": E.N.OP_GE}
+
+    def _cmp(self):
+        a = self._add()
+        while self.peek().val in self._CMPOPS:
+            op = self.next().val
+            a = E.BinOp(self._CMPOPS[op], a, self._add())
+        return a
+
+    def _add(self):
+        a = self._mul()
+        while self.peek().val in ("+", "-"):
+            op = self.next().val
+            b = self._mul()
+            a = E.BinOp(E.N.OP_ADD if op == "+" else E.N.OP_SUB, a, b)
+        return a
+
+    def _mul(self):
+        a = self._unary()
+        while self.peek().val in ("*", "/", "%"):
+            op = self.next().val
+            b = self._unary()
+            a = E.BinOp({"*": E.N.OP_MUL, "/": E.N.OP_DIV,
+                         "%": E.N.OP_MOD}[op], a, b)
+        return a
+
+    def _unary(self):
+        if self.accept("-"):
+            return E.UnOp(E.N.OP_NEG, self._unary())
+        if self.accept("!"):
+            return E.UnOp(E.N.OP_NOT, self._unary())
+        return self._primary()
+
+    def _primary(self):
+        t = self.next()
+        if t.kind == "num":
+            return E.Const(int(t.val))
+        if t.kind == "escape":
+            code = t.val[2:-2].strip()
+            if code.startswith("return"):
+                code = code[len("return"):].strip().rstrip(";")
+            return _PyEscape(code)
+        if t.kind == "id":
+            return _Name(t.val)
+        if t.val == "(":
+            e = self._parse_expr()
+            self.expect(")")
+            return e
+        raise SyntaxError(f"jdf: bad expression token {t.val!r}")
+
+
+class _Name(E.Expr):
+    """Deferred local-or-global reference, resolved at build time."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def _emit(self, out, ctx):
+        if self.name in ctx.locals:
+            out += [E.N.OP_LOCAL, ctx.locals[self.name]]
+        elif self.name in ctx.globals:
+            out += [E.N.OP_GLOBAL, ctx.globals[self.name]]
+        else:
+            raise KeyError(f"jdf: unknown symbol {self.name!r}")
+
+
+class _PyEscape(E.Expr):
+    """%{ python expr %}: evaluated with (locals_list, globals_dict) via a
+    registered callback; the expression sees names `locals` (dict by name)
+    and every global by name."""
+
+    def __init__(self, code):
+        self.code = code
+        self._names: List[str] = []
+
+    def _emit(self, out, ctx):
+        names = {v: k for k, v in ctx.locals.items()}
+        code = compile(self.code, "<jdf-escape>", "eval")
+
+        def fn(locs, globs):
+            env = dict(globs)
+            env.update({names[i]: v for i, v in enumerate(locs)
+                        if i in names})
+            return int(eval(code, {}, env))
+
+        cb_id = ctx.register_call(fn)
+        out += [E.N.OP_CALL, cb_id]
+
+
+# ------------------------------------------------------------------ build
+
+def parse_jdf(src: str) -> JdfProgram:
+    stripped, bodies = _extract_bodies(src)
+    return _Parser(_lex(stripped), stripped, bodies).parse()
+
+
+def _target_to_builder(t: JdfDepTarget, flow_name: str):
+    if t.kind == "new":
+        return None  # pure allocation (arena on the flow)
+    if t.kind == "null":
+        return None
+    if t.kind == "mem":
+        return Mem(t.name, *t.args)
+    return Ref(t.name, *t.args, flow=t.flow)
+
+
+class JdfTaskpoolBuilder:
+    """Instantiate a parsed JDF program as a ready-to-run Taskpool."""
+
+    def __init__(self, prog: JdfProgram, ctx, globals: Dict[str, int],
+                 dtype=np.uint8, shapes: Optional[Dict] = None,
+                 arenas: Optional[Dict[str, str]] = None, dev=None):
+        self.prog = prog
+        self.ctx = ctx
+        self.dtype = np.dtype(dtype)
+        self.shapes = shapes or {}
+        self.arenas = arenas or {}
+        self.dev = dev
+        # program scope: prologue definitions + globals
+        self.scope: Dict[str, object] = {"np": np}
+        if prog.prologue:
+            exec(prog.prologue, self.scope)
+        gvals: Dict[str, int] = {}
+        for g in prog.globals:
+            if g.name in globals:
+                gvals[g.name] = int(globals[g.name])
+            elif g.default is not None:
+                gvals[g.name] = int(eval(str(g.default).strip("()"),
+                                         dict(self.scope), dict(gvals)))
+            else:
+                raise ValueError(f"jdf: global {g.name} has no value")
+        self.gvals = gvals
+        self.tp = Taskpool(ctx, globals=gvals)
+        for jt in prog.tasks:
+            self._build_task(jt)
+
+    def _build_task(self, jt: JdfTask):
+        tc = self.tp.task_class(jt.name)
+        for (nm, payload) in jt.locals:
+            if isinstance(payload, E.Range):
+                tc.locals.append((nm, True, payload))
+            else:
+                tc.locals.append((nm, False, payload))
+        if jt.affinity:
+            tc.affinity(jt.affinity[0], *jt.affinity[1])
+        if jt.priority is not None:
+            tc.priority(jt.priority)
+        for fl in jt.flows:
+            deps = []
+            for d in fl.deps:
+                mk = In if d.direction == 0 else Out
+                tgt = _target_to_builder(d.target, fl.name)
+                if d.alt is not None:
+                    alt = _target_to_builder(d.alt, fl.name)
+                    deps.append(mk(tgt, guard=d.guard))
+                    deps.append(mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard)))
+                else:
+                    deps.append(mk(tgt, guard=d.guard))
+            tc.flow(fl.name, fl.access, *deps,
+                    arena=self.arenas.get(fl.name))
+        self._attach_bodies(jt, tc)
+
+    def _attach_bodies(self, jt: JdfTask, tc: TaskClass):
+        param_names = [nm for (nm, is_r, _) in tc.locals]
+        data_flows = [f.name for f in jt.flows if f.access != "CTL"]
+        for body in jt.bodies:
+            btype = body.props.get("type", "CPU").upper()
+            if btype == "TPU" and self.dev is not None:
+                reads = [s.strip() for s in
+                         body.props.get("reads", ",".join(data_flows))
+                         .split(",") if s.strip()]
+                writes = [s.strip() for s in
+                          body.props.get("writes", "").split(",")
+                          if s.strip()]
+                if not writes:
+                    writes = [f.name for f in jt.flows
+                              if f.access in ("RW", "WRITE")]
+                code = compile(body.code, f"<jdf-{jt.name}-tpu>", "exec")
+
+                def kernel(*arrs, _code=code, _reads=tuple(reads),
+                           _writes=tuple(writes), _scope=self.scope):
+                    env = dict(_scope)
+                    import jax.numpy as jnp
+                    env["jnp"] = jnp
+                    env.update(dict(zip(_reads, arrs)))
+                    exec(_code, env)
+                    outs = tuple(env[w] for w in _writes)
+                    return outs if len(outs) > 1 else outs[0]
+
+                self.dev.attach(tc, self.tp, kernel=kernel, reads=reads,
+                                writes=writes, shapes=self.shapes,
+                                dtype=self.dtype)
+            elif btype == "TPU":
+                continue  # no device available: skip this incarnation
+            else:
+                code = compile(body.code, f"<jdf-{jt.name}>", "exec")
+
+                def pybody(view, _code=code, _params=tuple(param_names),
+                           _flows=tuple(data_flows), _scope=self.scope):
+                    env = dict(_scope)
+                    env["this"] = view
+                    env.update({p: view.local(p) for p in _params})
+                    env.update(self.gvals)
+                    for f in _flows:
+                        try:
+                            env[f] = view.data(f, self.dtype,
+                                               self.shapes.get(f))
+                        except RuntimeError:
+                            env[f] = None
+                    exec(_code, env)
+
+                tc.body(pybody)
+
+    def run(self):
+        self.tp.run()
+        return self.tp
+
+
+def compile_jdf(src: str, ctx, globals: Dict[str, int], **kw):
+    """Parse + instantiate: returns a JdfTaskpoolBuilder (call .run())."""
+    return JdfTaskpoolBuilder(parse_jdf(src), ctx, globals, **kw)

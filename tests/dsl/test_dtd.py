@@ -1,0 +1,137 @@
+"""DTD tests (reference: tests/dsl/dtd — insertion, RAW/WAR/WAW chains,
+window throttling, device task insertion = BASELINE rung 2)."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.dsl import INOUT, INPUT, OUTPUT, DtdTaskpool
+
+
+def test_dtd_chain_raw():
+    """N tasks RW-chained on one datum execute in insertion order."""
+    with pt.Context(nb_workers=2) as ctx:
+        buf = np.zeros(1, dtype=np.int64)
+        d = ctx.data(0, buf)
+        dtd = DtdTaskpool(ctx)
+        t = dtd.tile_of(d)
+        NB = 100
+
+        def add1(v):
+            v.data(0, np.int64)[0] += 1
+
+        for _ in range(NB):
+            dtd.insert_task(add1, (t, "INOUT"))
+        dtd.wait()
+        dtd.destroy()
+    assert buf[0] == NB
+
+
+def test_dtd_war_readers_before_writer():
+    """Readers inserted before a writer must all see the pre-write value."""
+    with pt.Context(nb_workers=3) as ctx:
+        buf = np.array([5], dtype=np.int64)
+        d = ctx.data(0, buf)
+        seen = []
+        import threading
+        lock = threading.Lock()
+        dtd = DtdTaskpool(ctx)
+        t = dtd.tile_of(d)
+
+        def read(v):
+            with lock:
+                seen.append(int(v.data(0, np.int64)[0]))
+
+        def write(v):
+            v.data(0, np.int64)[0] = 99
+
+        for _ in range(10):
+            dtd.insert_task(read, (t, "INPUT"))
+        dtd.insert_task(write, (t, "INOUT"))
+        for _ in range(10):
+            dtd.insert_task(read, (t, "INPUT"))
+        dtd.wait()
+        dtd.destroy()
+    assert sorted(seen) == [5] * 10 + [99] * 10
+
+
+def test_dtd_multi_tile_diamond():
+    """c = f(a) + g(b): diamond joins via two tiles."""
+    with pt.Context(nb_workers=2) as ctx:
+        a = ctx.data(0, np.array([3.0], dtype=np.float64))
+        b = ctx.data(1, np.array([4.0], dtype=np.float64))
+        c = ctx.data(2, np.zeros(1, dtype=np.float64))
+        dtd = DtdTaskpool(ctx)
+        ta, tb, tc_ = dtd.tile_of(a), dtd.tile_of(b), dtd.tile_of(c)
+
+        def square(v):
+            v.data(0, np.float64)[0] **= 2
+
+        def add(v):
+            v.data(2, np.float64)[0] = (v.data(0, np.float64)[0] +
+                                        v.data(1, np.float64)[0])
+
+        dtd.insert_task(square, (ta, "INOUT"))
+        dtd.insert_task(square, (tb, "INOUT"))
+        dtd.insert_task(add, (ta, "INPUT"), (tb, "INPUT"), (tc_, "OUTPUT"))
+        dtd.wait()
+        dtd.destroy()
+    assert c.array[0] == 25.0
+
+
+def test_dtd_window_throttle():
+    """A tiny window still completes (insertion blocks, never deadlocks)."""
+    with pt.Context(nb_workers=2) as ctx:
+        buf = np.zeros(1, dtype=np.int64)
+        d = ctx.data(0, buf)
+        dtd = DtdTaskpool(ctx, window=4)
+        t = dtd.tile_of(d)
+
+        def add1(v):
+            v.data(0, np.int64)[0] += 1
+
+        for _ in range(200):
+            dtd.insert_task(add1, (t, "INOUT"))
+        dtd.wait()
+        dtd.destroy()
+    assert buf[0] == 200
+
+
+def test_dtd_tiled_gemm_on_device():
+    """BASELINE rung 2: DTD tiled GEMM dispatched on the (CPU-platform)
+    device module as cached XLA executables."""
+    from parsec_tpu.data import TwoDimBlockCyclic
+    from parsec_tpu.device import TpuDevice
+    nt, nb = 3, 8
+    N = nt * nb
+    rng = np.random.default_rng(7)
+    with pt.Context(nb_workers=1) as ctx:
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        B = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        C = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(rng.standard_normal((N, N), dtype=np.float32))
+        B.from_dense(rng.standard_normal((N, N), dtype=np.float32))
+        C.from_dense(np.zeros((N, N), dtype=np.float32))
+        A.register(ctx, "A")
+        B.register(ctx, "B")
+        C.register(ctx, "C")
+        dev = TpuDevice(ctx)
+        dtd = DtdTaskpool(ctx)
+
+        def k_gemm(a, b, c):
+            return c + a @ b
+
+        for m in range(nt):
+            for n in range(nt):
+                for k in range(nt):
+                    dtd.insert_tpu_task(
+                        dev, k_gemm,
+                        (dtd.tile_of(A, m, k), "INPUT"),
+                        (dtd.tile_of(B, k, n), "INPUT"),
+                        (dtd.tile_of(C, m, n), "INOUT"),
+                        shapes={i: (nb, nb) for i in range(3)})
+        dtd.wait()
+        dev.flush()
+        dev.stop()
+        ref = A.to_dense() @ B.to_dense()
+        np.testing.assert_allclose(C.to_dense(), ref, rtol=1e-3, atol=1e-3)
+        dtd.destroy()
