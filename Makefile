@@ -5,9 +5,12 @@ LIB := $(BUILD)/libparsec_core.so
 
 all: $(LIB)
 
-$(LIB): native/core.cpp native/parsec_core.h
+SRCS := native/core.cpp native/sched.cpp native/comm.cpp
+HDRS := native/parsec_core.h native/runtime_internal.h
+
+$(LIB): $(SRCS) $(HDRS)
 	@mkdir -p $(BUILD)
-	$(CXX) $(CXXFLAGS) -shared -o $@ native/core.cpp
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
 
 clean:
 	rm -rf $(BUILD)

@@ -1,0 +1,748 @@
+/* comm.cpp — native communication engine (the L4 layer).
+ *
+ * Reference: parsec/parsec_comm_engine.h vtable + parsec_mpi_funnelled.c +
+ * parsec/remote_dep.c (SURVEY.md §2.5/§3.3).  The reference funnels all MPI
+ * traffic into one comm thread owning a command queue; dependency
+ * activations (ACTIVATE), data pulls and memory puts ride tagged messages.
+ *
+ * TPU-native redesign: there is no MPI in this build.  The control plane —
+ * activations, memory write-backs, DTD completion broadcasts, fences — is a
+ * host-side full-mesh TCP transport (the DCN analog; multi-rank-per-host
+ * tests run it over loopback, exactly how the reference tests multi-node
+ * via mpirun-on-one-host, SURVEY.md §4).  Bulk device-resident tile
+ * payloads between chips of one pod ride ICI via the device layer's cached
+ * collective-permute/send-recv executables (parsec_tpu/parallel/ici.py);
+ * this module carries host-resident payloads eagerly inline.
+ *
+ * One comm thread per context (reference: remote_dep_dequeue_main,
+ * parsec/remote_dep_mpi.c:478): workers enqueue serialized frames, the
+ * thread polls sockets, parses incoming frames and re-enters the runtime
+ * through ptc_deliver_dep_local / ptc_dtd_shadow_ready.
+ *
+ * Wire format (native endianness — single-host / homogeneous pod):
+ *   frame  := [u32 body_len][u8 type][body]
+ *   ACTIVATE (1) := [i32 tp_id][i32 flow_idx][u32 nb_targets]
+ *                   ([i32 class_id][u8 nb_params][i64 params]*)*
+ *                   [u64 payload_len][payload]
+ *   PUT      (2) := [i32 dc_id][i32 nidx][i64 idx]* [u64 len][payload]
+ *   DTD_DONE (3) := [i32 tp_id][u64 seq][u64 len]
+ *                   ([u32 flow][u64 len][bytes])*
+ *   FENCE    (4) := [u64 generation]
+ */
+
+#include "runtime_internal.h"
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdio>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+enum {
+  MSG_ACTIVATE = 1,
+  MSG_PUT = 2,
+  MSG_DTD_DONE = 3,
+  MSG_FENCE = 4,
+};
+
+struct Frame {
+  std::vector<uint8_t> bytes; /* full frame: len+type+body */
+};
+
+struct Peer {
+  int fd = -1;
+  std::vector<uint8_t> inbuf;
+  size_t in_off = 0; /* consumed prefix of inbuf */
+  std::deque<std::vector<uint8_t>> out; /* pending frames */
+  size_t out_off = 0; /* sent prefix of out.front() */
+  uint64_t fence_gen = 0; /* highest fence generation received */
+};
+
+struct Writer {
+  std::vector<uint8_t> &b;
+  void raw(const void *p, size_t n) {
+    const uint8_t *c = (const uint8_t *)p;
+    b.insert(b.end(), c, c + n);
+  }
+  void u8(uint8_t v) { raw(&v, 1); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void u64(uint64_t v) { raw(&v, 8); }
+  void i64(int64_t v) { raw(&v, 8); }
+};
+
+struct Reader {
+  const uint8_t *p, *end;
+  bool ok = true;
+  void raw(void *out, size_t n) {
+    if ((size_t)(end - p) < n) { ok = false; std::memset(out, 0, n); return; }
+    std::memcpy(out, p, n);
+    p += n;
+  }
+  uint8_t u8() { uint8_t v; raw(&v, 1); return v; }
+  uint32_t u32() { uint32_t v; raw(&v, 4); return v; }
+  int32_t i32() { int32_t v; raw(&v, 4); return v; }
+  uint64_t u64() { uint64_t v; raw(&v, 8); return v; }
+  int64_t i64() { int64_t v; raw(&v, 8); return v; }
+};
+
+} // namespace
+
+struct CommEngine {
+  ptc_context *ctx = nullptr;
+  uint32_t myrank = 0, nodes = 1;
+  std::vector<Peer> peers; /* indexed by rank; peers[myrank].fd == -1 */
+  int listen_fd = -1;
+  int wake_pipe[2] = {-1, -1};
+  std::thread thread;
+  std::atomic<bool> running{false};
+  std::atomic<bool> stop{false};
+
+  std::mutex lock; /* protects peers[].out + fence state */
+  std::condition_variable fence_cv;
+  uint64_t fence_next = 1; /* next generation to issue */
+
+  /* stats (reference: parsec/remote_dep.c counters) */
+  std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
+  std::atomic<uint64_t> bytes_sent{0}, bytes_recv{0};
+
+  ~CommEngine() {
+    for (Peer &p : peers)
+      if (p.fd >= 0) close(p.fd);
+    if (listen_fd >= 0) close(listen_fd);
+    if (wake_pipe[0] >= 0) close(wake_pipe[0]);
+    if (wake_pipe[1] >= 0) close(wake_pipe[1]);
+  }
+};
+
+namespace {
+
+static void comm_wake(CommEngine *ce) {
+  uint8_t b = 1;
+  ssize_t n = write(ce->wake_pipe[1], &b, 1);
+  (void)n;
+}
+
+/* enqueue a finished frame for `rank` (worker threads call this) */
+static void comm_post(CommEngine *ce, uint32_t rank,
+                      std::vector<uint8_t> &&frame) {
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    ce->peers[rank].out.push_back(std::move(frame));
+  }
+  ce->msgs_sent.fetch_add(1, std::memory_order_relaxed);
+  comm_wake(ce);
+}
+
+static std::vector<uint8_t> frame_begin(uint8_t type) {
+  std::vector<uint8_t> b;
+  b.resize(4); /* length patched at finish */
+  b.push_back(type);
+  return b;
+}
+
+static void frame_finish(std::vector<uint8_t> &b) {
+  uint32_t body_len = (uint32_t)(b.size() - 4);
+  std::memcpy(b.data(), &body_len, 4);
+}
+
+/* ---------------- incoming dispatch (comm thread) ---------------- */
+
+static ptc_taskpool *find_tp(ptc_context *ctx, int32_t tp_id) {
+  std::lock_guard<std::mutex> g(ctx->tp_reg_lock);
+  auto it = ctx->tp_registry.find(tp_id);
+  return it == ctx->tp_registry.end() ? nullptr : it->second;
+}
+
+/* body excludes the type byte */
+static void handle_activate_body(ptc_context *ctx, const uint8_t *body,
+                                 size_t len, bool allow_park) {
+  Reader r{body, body + len};
+  int32_t tp_id = r.i32();
+  int32_t flow_idx = r.i32();
+  uint32_t nb_targets = r.u32();
+  ptc_taskpool *tp = find_tp(ctx, tp_id);
+  if (!tp) {
+    if (allow_park) {
+      /* taskpool not registered yet (SPMD skew): park [type][raw body]
+       * (reference: dep_activates_noobj_fifo, remote_dep_mpi.c:92).
+       * Re-check the registry under the lock: add_taskpool may have
+       * registered + drained between find_tp and here — parking after
+       * the drain would lose the frame forever. */
+      std::unique_lock<std::mutex> g(ctx->tp_reg_lock);
+      auto it = ctx->tp_registry.find(tp_id);
+      if (it != ctx->tp_registry.end()) {
+        tp = it->second;
+        g.unlock();
+        /* fall through to normal delivery below */
+      } else {
+        std::vector<uint8_t> parked;
+        parked.reserve(len + 1);
+        parked.push_back(MSG_ACTIVATE);
+        parked.insert(parked.end(), body, body + len);
+        ctx->tp_early[tp_id].push_back(std::move(parked));
+        return;
+      }
+    } else {
+      std::fprintf(stderr, "ptc-comm: activation for unknown taskpool %d "
+                           "dropped\n", tp_id);
+      return;
+    }
+  }
+  struct Target {
+    int32_t class_id;
+    std::vector<int64_t> params;
+  };
+  std::vector<Target> targets;
+  targets.reserve(nb_targets);
+  for (uint32_t i = 0; i < nb_targets && r.ok; i++) {
+    Target t;
+    t.class_id = r.i32();
+    uint8_t np = r.u8();
+    t.params.resize(np);
+    for (uint8_t k = 0; k < np; k++) t.params[k] = r.i64();
+    targets.push_back(std::move(t));
+  }
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
+    return;
+  }
+  ptc_copy *copy = nullptr;
+  if (plen > 0) {
+    copy = new ptc_copy();
+    copy->ptr = std::malloc((size_t)plen);
+    copy->size = (int64_t)plen;
+    copy->owns_ptr = true;
+    std::memcpy(copy->ptr, r.p, (size_t)plen);
+  }
+  for (Target &t : targets) {
+    std::vector<int64_t> params(t.params);
+    ptc_deliver_dep_local(ctx, -1, tp, t.class_id, std::move(params),
+                          flow_idx, copy);
+  }
+  if (copy) ptc_copy_release_internal(ctx, copy); /* stages hold refs now */
+}
+
+static void handle_put_body(ptc_context *ctx, const uint8_t *body, size_t len) {
+  Reader r{body, body + len};
+  int32_t dc_id = r.i32();
+  int32_t nidx = r.i32();
+  if (nidx < 0 || nidx > PTC_MAX_LOCALS) return;
+  int64_t idx[PTC_MAX_LOCALS] = {0};
+  for (int32_t i = 0; i < nidx; i++) idx[i] = r.i64();
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed PUT frame dropped\n");
+    return;
+  }
+  ptc_data *d = ptc_collection_data_of(ctx, dc_id, idx, nidx);
+  if (d && d->host_copy && d->host_copy->ptr) {
+    std::memcpy(d->host_copy->ptr, r.p,
+                (size_t)std::min<uint64_t>(plen, (uint64_t)d->host_copy->size));
+    d->host_copy->version.fetch_add(1, std::memory_order_release);
+  }
+}
+
+static void handle_dtd_done_body(ptc_context *ctx, const uint8_t *body,
+                                 size_t len) {
+  Reader r{body, body + len};
+  int32_t tp_id = r.i32();
+  uint64_t seq = r.u64();
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed DTD_DONE frame dropped\n");
+    return;
+  }
+  ptc_taskpool *tp = find_tp(ctx, tp_id);
+  if (!tp) {
+    /* DTD pools are created before insertion starts on every rank; a
+     * completion for an unknown pool means SPMD skew at startup — park it
+     * (re-checking the registry under the lock, as in handle_activate) */
+    std::unique_lock<std::mutex> g(ctx->tp_reg_lock);
+    auto it = ctx->tp_registry.find(tp_id);
+    if (it != ctx->tp_registry.end()) {
+      tp = it->second;
+      g.unlock();
+    } else {
+      std::vector<uint8_t> parked;
+      parked.reserve(len + 1);
+      parked.push_back(MSG_DTD_DONE);
+      parked.insert(parked.end(), body, body + len);
+      ctx->tp_early[tp_id].push_back(std::move(parked));
+      return;
+    }
+  }
+  ptc_dtd_shadow_ready(ctx, tp, seq, r.p, (size_t)plen);
+}
+
+static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
+                         const uint8_t *body, size_t len) {
+  ptc_context *ctx = ce->ctx;
+  ce->msgs_recv.fetch_add(1, std::memory_order_relaxed);
+  switch (type) {
+  case MSG_ACTIVATE:
+    handle_activate_body(ctx, body, len, /*allow_park=*/true);
+    break;
+  case MSG_PUT:
+    handle_put_body(ctx, body, len);
+    break;
+  case MSG_DTD_DONE:
+    handle_dtd_done_body(ctx, body, len);
+    break;
+  case MSG_FENCE: {
+    Reader r{body, body + len};
+    uint64_t gen = r.u64();
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      if (gen > ce->peers[from].fence_gen) ce->peers[from].fence_gen = gen;
+    }
+    ce->fence_cv.notify_all();
+    break;
+  }
+  default:
+    std::fprintf(stderr, "ptc-comm: unknown message type %d\n", (int)type);
+  }
+}
+
+/* parse all complete frames in a peer's inbuf */
+static void parse_inbuf(CommEngine *ce, uint32_t rank) {
+  Peer &p = ce->peers[rank];
+  while (true) {
+    size_t avail = p.inbuf.size() - p.in_off;
+    if (avail < 5) break;
+    uint32_t body_len;
+    std::memcpy(&body_len, p.inbuf.data() + p.in_off, 4);
+    if (body_len < 1 || body_len > (1u << 30)) {
+      /* desynchronized stream: resyncing is impossible — drop the peer
+       * rather than misinterpreting payload bytes as frame headers */
+      std::fprintf(stderr, "ptc-comm: bad frame length %u from rank %u; "
+                           "closing connection\n", body_len, rank);
+      close(p.fd);
+      p.fd = -1;
+      p.inbuf.clear();
+      p.in_off = 0;
+      return;
+    }
+    if (avail < 4 + (size_t)body_len) break;
+    const uint8_t *frame = p.inbuf.data() + p.in_off + 4;
+    uint8_t type = frame[0];
+    ce->bytes_recv.fetch_add(4 + body_len, std::memory_order_relaxed);
+    handle_frame(ce, rank, type, frame + 1, body_len - 1);
+    p.in_off += 4 + body_len;
+  }
+  if (p.in_off > 0 && p.in_off == p.inbuf.size()) {
+    p.inbuf.clear();
+    p.in_off = 0;
+  } else if (p.in_off > (1u << 20)) {
+    p.inbuf.erase(p.inbuf.begin(), p.inbuf.begin() + (long)p.in_off);
+    p.in_off = 0;
+  }
+}
+
+/* ---------------- comm thread ---------------- */
+
+static void comm_main(CommEngine *ce) {
+  std::vector<struct pollfd> pfds;
+  std::vector<uint32_t> pfd_rank;
+  uint8_t rbuf[1 << 16];
+  int64_t stop_deadline = 0;
+  while (true) {
+    /* on stop, keep going until every deliverable out-queue drained (a
+     * fence posted just before shutdown must reach the wire) — bounded
+     * by a 5 s grace period */
+    if (ce->stop.load(std::memory_order_acquire)) {
+      if (stop_deadline == 0) stop_deadline = ptc_now_ns() + 5000000000ll;
+      bool pending = false;
+      {
+        std::lock_guard<std::mutex> g(ce->lock);
+        for (Peer &p : ce->peers)
+          if (p.fd >= 0 && !p.out.empty()) pending = true;
+      }
+      if (!pending || ptc_now_ns() > stop_deadline) break;
+    }
+    pfds.clear();
+    pfd_rank.clear();
+    pfds.push_back({ce->wake_pipe[0], POLLIN, 0});
+    pfd_rank.push_back(UINT32_MAX);
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      for (uint32_t r = 0; r < ce->nodes; r++) {
+        Peer &p = ce->peers[r];
+        if (p.fd < 0) continue;
+        short ev = POLLIN;
+        if (!p.out.empty()) ev |= POLLOUT;
+        pfds.push_back({p.fd, ev, 0});
+        pfd_rank.push_back(r);
+      }
+    }
+    int rc = poll(pfds.data(), (nfds_t)pfds.size(), 50);
+    if (rc < 0 && errno != EINTR) break;
+    /* drain wakeup pipe */
+    if (pfds[0].revents & POLLIN) {
+      while (read(ce->wake_pipe[0], rbuf, sizeof(rbuf)) > 0) {}
+    }
+    for (size_t i = 1; i < pfds.size(); i++) {
+      uint32_t r = pfd_rank[i];
+      Peer &p = ce->peers[r];
+      if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+        while (true) {
+          ssize_t n = recv(p.fd, rbuf, sizeof(rbuf), 0);
+          if (n > 0) {
+            p.inbuf.insert(p.inbuf.end(), rbuf, rbuf + n);
+            if ((size_t)n < sizeof(rbuf)) break;
+          } else if (n == 0) {
+            /* peer closed; expected at shutdown */
+            close(p.fd);
+            p.fd = -1;
+            break;
+          } else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+              std::fprintf(stderr, "ptc-comm: recv from rank %u: %s\n", r,
+                           strerror(errno));
+            break;
+          }
+        }
+        if (p.fd >= 0) parse_inbuf(ce, r);
+      }
+      if (p.fd >= 0 && (pfds[i].revents & POLLOUT)) {
+        std::unique_lock<std::mutex> g(ce->lock);
+        while (!p.out.empty()) {
+          std::vector<uint8_t> &f = p.out.front();
+          size_t todo = f.size() - p.out_off;
+          g.unlock();
+          ssize_t n = send(p.fd, f.data() + p.out_off, todo, MSG_NOSIGNAL);
+          g.lock();
+          if (n > 0) {
+            ce->bytes_sent.fetch_add((uint64_t)n, std::memory_order_relaxed);
+            p.out_off += (size_t)n;
+            if (p.out_off == f.size()) {
+              p.out.pop_front();
+              p.out_off = 0;
+            }
+            if ((size_t)n < todo) break; /* kernel buffer full */
+          } else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+              std::fprintf(stderr, "ptc-comm: send to rank %u: %s\n", r,
+                           strerror(errno));
+            break;
+          }
+        }
+      }
+    }
+  }
+}
+
+/* ---------------- connection setup ---------------- */
+
+static int make_listen(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (struct sockaddr *)&addr, sizeof(addr)) < 0 ||
+      listen(fd, 64) < 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+static int connect_retry(int port, int timeout_ms) {
+  int waited = 0;
+  while (true) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    struct sockaddr_in addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)port);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof(addr)) == 0) return fd;
+    close(fd);
+    if (waited >= timeout_ms) return -1;
+    usleep(20000);
+    waited += 20;
+  }
+}
+
+static void set_sock_opts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+} // namespace
+
+/* ------------------------------------------------------------------ */
+/* outgoing hooks (called from core.cpp; no-ops when comm is off)      */
+/* ------------------------------------------------------------------ */
+
+void ptc_comm_send_activate_batch(
+    ptc_context *ctx, uint32_t rank, ptc_taskpool *tp, int32_t flow_idx,
+    ptc_copy *copy,
+    const std::vector<std::pair<int32_t, std::vector<int64_t>>> &targets) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) {
+    static std::atomic<bool> warned{false};
+    if (!warned.exchange(true))
+      std::fprintf(stderr, "ptc: remote successor with no comm engine "
+                           "(nodes>1 but ptc_comm_init not called); "
+                           "activations dropped\n");
+    return;
+  }
+  std::vector<uint8_t> f = frame_begin(MSG_ACTIVATE);
+  Writer w{f};
+  w.i32(tp->id);
+  w.i32(flow_idx);
+  w.u32((uint32_t)targets.size());
+  for (const auto &t : targets) {
+    w.i32(t.first);
+    w.u8((uint8_t)t.second.size());
+    for (int64_t v : t.second) w.i64(v);
+  }
+  if (copy && copy->ptr && copy->size > 0) {
+    w.u64((uint64_t)copy->size);
+    w.raw(copy->ptr, (size_t)copy->size);
+  } else {
+    w.u64(0);
+  }
+  frame_finish(f);
+  comm_post(ce, rank, std::move(f));
+}
+
+void ptc_comm_send_activate(ptc_context *ctx, uint32_t rank, ptc_taskpool *tp,
+                            int32_t class_id,
+                            const std::vector<int64_t> &params,
+                            int32_t flow_idx, ptc_copy *copy) {
+  std::vector<std::pair<int32_t, std::vector<int64_t>>> targets;
+  targets.emplace_back(class_id, params);
+  ptc_comm_send_activate_batch(ctx, rank, tp, flow_idx, copy, targets);
+}
+
+void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
+                           const int64_t *idx, int32_t nidx, ptc_copy *copy) {
+  CommEngine *ce = ctx->comm;
+  if (!ce || !copy || !copy->ptr) return;
+  std::vector<uint8_t> f = frame_begin(MSG_PUT);
+  Writer w{f};
+  w.i32(dc_id);
+  w.i32(nidx);
+  for (int32_t i = 0; i < nidx; i++) w.i64(idx[i]);
+  w.u64((uint64_t)copy->size);
+  w.raw(copy->ptr, (size_t)copy->size);
+  frame_finish(f);
+  comm_post(ce, rank, std::move(f));
+}
+
+void ptc_comm_send_dtd_complete(ptc_context *ctx, ptc_taskpool *tp,
+                                ptc_task *t) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return;
+  DynExt *dx = t->dyn;
+  /* payload: written-tile contents, one record per OUTPUT flow */
+  std::vector<uint8_t> payload;
+  Writer pw{payload};
+  for (int fi = 0; fi < dx->nb_flows; fi++) {
+    if (!(dx->modes[fi] & PTC_DTD_OUTPUT)) continue;
+    ptc_copy *c = t->data[fi];
+    if (!c || !c->ptr) continue;
+    pw.u32((uint32_t)fi);
+    pw.u64((uint64_t)c->size);
+    pw.raw(c->ptr, (size_t)c->size);
+  }
+  for (uint32_t r = 0; r < ce->nodes; r++) {
+    if (r == ce->myrank) continue;
+    std::vector<uint8_t> f = frame_begin(MSG_DTD_DONE);
+    Writer w{f};
+    w.i32(tp->id);
+    w.u64(dx->seq);
+    w.u64((uint64_t)payload.size());
+    w.raw(payload.data(), payload.size());
+    frame_finish(f);
+    comm_post(ce, r, std::move(f));
+  }
+}
+
+void ptc_comm_drain_early(ptc_context *ctx, ptc_taskpool *tp) {
+  if (!ctx->comm) return;
+  std::vector<std::vector<uint8_t>> frames;
+  {
+    std::lock_guard<std::mutex> g(ctx->tp_reg_lock);
+    auto it = ctx->tp_early.find(tp->id);
+    if (it == ctx->tp_early.end()) return;
+    frames = std::move(it->second);
+    ctx->tp_early.erase(it);
+  }
+  for (auto &body : frames) {
+    /* parked bodies are ACTIVATE or DTD_DONE; disambiguate: both start
+     * with i32 tp_id — ACTIVATE parked from handle_activate_body, DTD from
+     * handle_dtd_done_body.  We re-dispatch through the same handlers by
+     * trying ACTIVATE first only if it parses; instead, store the type in
+     * the parked bytes: body[0] is the original type tag (see parkers). */
+    if (body.empty()) continue;
+    uint8_t type = body[0];
+    if (type == MSG_ACTIVATE)
+      handle_activate_body(ctx, body.data() + 1, body.size() - 1,
+                           /*allow_park=*/false);
+    else if (type == MSG_DTD_DONE)
+      handle_dtd_done_body(ctx, body.data() + 1, body.size() - 1);
+  }
+}
+
+void ptc_comm_shutdown(ptc_context *ctx) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return;
+  ce->stop.store(true, std::memory_order_release);
+  ce->fence_cv.notify_all(); /* unblock any in-flight fence */
+  comm_wake(ce);
+  if (ce->thread.joinable()) ce->thread.join();
+  ctx->comm = nullptr;
+  delete ce; /* destructor closes sockets + pipe */
+}
+
+/* ------------------------------------------------------------------ */
+/* public C API                                                        */
+/* ------------------------------------------------------------------ */
+
+extern "C" {
+
+int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
+  if (ctx->nodes <= 1) return 0; /* single process: nothing to do */
+  if (ctx->comm) return 0;
+  CommEngine *ce = new CommEngine();
+  ce->ctx = ctx;
+  ce->myrank = ctx->myrank;
+  ce->nodes = ctx->nodes;
+  ce->peers.resize(ctx->nodes);
+  if (pipe(ce->wake_pipe) != 0) {
+    delete ce;
+    return -1;
+  }
+  {
+    int fl = fcntl(ce->wake_pipe[0], F_GETFL, 0);
+    fcntl(ce->wake_pipe[0], F_SETFL, fl | O_NONBLOCK);
+  }
+  /* rank r listens on base+r; connects to all lower ranks, accepts from
+   * all higher ranks.  Loopback full mesh (DCN analog). */
+  ce->listen_fd = make_listen(base_port + (int)ce->myrank);
+  if (ce->listen_fd < 0) {
+    std::fprintf(stderr, "ptc-comm: cannot listen on port %d: %s\n",
+                 base_port + (int)ce->myrank, strerror(errno));
+    delete ce;
+    return -1;
+  }
+  for (uint32_t r = 0; r < ce->myrank; r++) {
+    int fd = connect_retry(base_port + (int)r, 30000);
+    if (fd < 0) {
+      std::fprintf(stderr, "ptc-comm: cannot connect to rank %u\n", r);
+      delete ce;
+      return -1;
+    }
+    uint32_t me = ce->myrank;
+    if (send(fd, &me, 4, 0) != 4) {
+      close(fd);
+      delete ce;
+      return -1;
+    }
+    set_sock_opts(fd);
+    ce->peers[r].fd = fd;
+  }
+  /* accept until every higher rank has handshaken; stray connections
+   * (port scanners, test port probes) are rejected without consuming a
+   * peer slot */
+  uint32_t accepted = 0, expected = ce->nodes - 1 - ce->myrank;
+  int strays = 0;
+  while (accepted < expected) {
+    int fd = accept(ce->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      std::fprintf(stderr, "ptc-comm: accept failed: %s\n", strerror(errno));
+      delete ce;
+      return -1;
+    }
+    uint32_t who = 0;
+    ssize_t got = recv(fd, &who, 4, MSG_WAITALL);
+    if (got != 4 || who <= ce->myrank || who >= ce->nodes ||
+        ce->peers[who].fd >= 0) {
+      std::fprintf(stderr, "ptc-comm: rejecting bad peer handshake\n");
+      close(fd);
+      if (++strays > 256) { /* give up rather than loop forever */
+        delete ce;
+        return -1;
+      }
+      continue;
+    }
+    set_sock_opts(fd);
+    ce->peers[who].fd = fd;
+    accepted++;
+  }
+  ce->running.store(true);
+  ctx->comm = ce;
+  ce->thread = std::thread(comm_main, ce);
+  return 0;
+}
+
+/* Fence: flush all queued sends + wait until every peer's fence of this
+ * generation arrived.  TCP per-peer FIFO + in-order frame processing give
+ * the flush guarantee: once FENCE(gen) from peer p is processed, every
+ * earlier message from p has been applied.  (Reference: comm barrier +
+ * termdet flush semantics.) */
+int32_t ptc_comm_fence(ptc_context_t *ctx) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return 0;
+  uint64_t gen;
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    gen = ce->fence_next++;
+  }
+  for (uint32_t r = 0; r < ce->nodes; r++) {
+    if (r == ce->myrank) continue;
+    std::vector<uint8_t> f = frame_begin(MSG_FENCE);
+    Writer w{f};
+    w.u64(gen);
+    frame_finish(f);
+    comm_post(ce, r, std::move(f));
+  }
+  std::unique_lock<std::mutex> g(ce->lock);
+  ce->fence_cv.wait(g, [&] {
+    if (ce->stop.load(std::memory_order_acquire)) return true;
+    for (uint32_t r = 0; r < ce->nodes; r++) {
+      if (r == ce->myrank) continue;
+      if (ce->peers[r].fence_gen < gen) return false;
+    }
+    return true;
+  });
+  return 0;
+}
+
+int32_t ptc_comm_enabled(ptc_context_t *ctx) { return ctx->comm ? 1 : 0; }
+
+int32_t ptc_comm_fini(ptc_context_t *ctx) {
+  if (!ctx->comm) return 0;
+  ptc_comm_fence(ctx);
+  ptc_comm_shutdown(ctx);
+  return 0;
+}
+
+/* per-context comm statistics (reference: device/comm statistics dumps) */
+void ptc_comm_stats(ptc_context_t *ctx, int64_t *out4) {
+  CommEngine *ce = ctx->comm;
+  out4[0] = ce ? (int64_t)ce->msgs_sent.load() : 0;
+  out4[1] = ce ? (int64_t)ce->msgs_recv.load() : 0;
+  out4[2] = ce ? (int64_t)ce->bytes_sent.load() : 0;
+  out4[3] = ce ? (int64_t)ce->bytes_recv.load() : 0;
+}
+
+} /* extern "C" */

@@ -1,13 +1,12 @@
 /* core.cpp — native runtime core of tpu-parsec.
  *
- * Single translation unit implementing the C API in parsec_core.h:
+ * Implements the C API in parsec_core.h (structs in runtime_internal.h):
  *   - expression VM (guards / ranges / indices / priorities as bytecode)
  *   - table-driven task classes (the interpreter replacing the reference's
  *     jdf2c code generator, parsec/interfaces/ptg/ptg-compiler/jdf2c.c)
  *   - sharded dependency table (reference: hash dep tracking,
  *     parsec/parsec_internal.h:224-229 + parsec.c release path)
- *   - ready-task schedulers: lfq (per-worker deque + steal), gd (global
- *     dequeue), ap (global priority heap) — reference parsec/mca/sched
+ *   - ready-task schedulers (reference parsec/mca/sched)
  *   - worker threads + chore execution protocol (reference
  *     parsec/scheduling.c:124-203, 470-531)
  *   - local termination detection (counter; reference mca/termdet/local)
@@ -15,459 +14,80 @@
  *     drains (reference: CUDA manager thread, device_cuda_module.c:2537+)
  *   - minimal paired-event profiling buffers (reference: parsec/profiling.c)
  *
+ * Remote successors are handed to the comm engine (comm.cpp) as batched
+ * ACTIVATE sends; incoming activations re-enter through
+ * ptc_deliver_dep_local.  (Reference: parsec/remote_dep.c:454 activation
+ * fan-out + remote_dep_mpi.c incoming path — SURVEY.md §3.3.)
+ *
  * Design note: behavior follows SURVEY.md §2/§3; the implementation is new
  * and intentionally different from the reference (interpreted specs instead
  * of generated C; push-based data delivery into successor dep entries
  * instead of repo lookups at prepare_input).
  */
 
-#include "parsec_core.h"
+#include "runtime_internal.h"
 
-#include <atomic>
-#include <condition_variable>
-#include <cstdio>
-#include <cstdlib>
-#include <cstring>
-#include <deque>
-#include <mutex>
-#include <string>
-#include <thread>
-#include <unordered_map>
-#include <unordered_set>
-#include <vector>
 #include <algorithm>
 #include <chrono>
+#include <cstdio>
+#include <cstdlib>
 
-namespace {
-
-static inline int64_t now_ns() {
+int64_t ptc_now_ns() {
   return std::chrono::duration_cast<std::chrono::nanoseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
 
 /* ------------------------------------------------------------------ */
-/* expressions                                                         */
+/* arena                                                               */
 /* ------------------------------------------------------------------ */
 
-struct Expr {
-  std::vector<int64_t> code; /* empty == constant 0 (or "true" for guards) */
-  bool empty() const { return code.empty(); }
-};
-
-struct ExprCb {
-  ptc_expr_cb fn;
-  void *user;
-};
-
-} // namespace
-
-/* forward decls of the public structs (must be at global scope) */
-struct ptc_copy {
-  ptc_data *data = nullptr;
-  void *ptr = nullptr;
-  int64_t size = 0;
-  int64_t handle = 0; /* opaque Python-side id (e.g. jax buffer) */
-  std::atomic<int32_t> refcount{1};
-  std::atomic<int32_t> version{0};
-  int32_t arena_id = -1; /* >=0: return to arena freelist on release */
-  bool owns_ptr = false;
-};
-
-struct ptc_data {
-  int64_t key = 0;
-  int64_t size = 0;
-  ptc_copy *host_copy = nullptr;
-};
-
-namespace {
-
-/* ------------------------------------------------------------------ */
-/* spec structures (decoded blobs)                                     */
-/* ------------------------------------------------------------------ */
-
-enum DepKind { DEP_NONE = 0, DEP_TASK = 1, DEP_MEM = 2 };
-
-struct DepParam {
-  bool is_range = false;
-  Expr value;      /* when !is_range */
-  Expr lo, hi, st; /* when is_range */
-};
-
-struct Dep {
-  int32_t direction = 0; /* 0 in, 1 out */
-  Expr guard;            /* empty == always true */
-  int32_t kind = DEP_NONE;
-  /* DEP_TASK */
-  int32_t peer_class = -1;
-  int32_t peer_flow = -1;
-  std::vector<DepParam> params;
-  /* DEP_MEM */
-  int32_t dc_id = -1;
-  std::vector<Expr> idx;
-  int32_t arena_id = -1;
-};
-
-struct Flow {
-  int32_t flags = 0; /* PTC_FLOW_* */
-  int32_t arena_id = -1;
-  std::vector<Dep> in_deps, out_deps;
-};
-
-struct Local {
-  bool is_range = false;
-  Expr lo, hi, st; /* range */
-  Expr value;      /* derived */
-};
-
-struct Chore {
-  int32_t device_type = PTC_DEV_CPU;
-  int32_t body_kind = PTC_BODY_NOOP;
-  int64_t body_arg = 0;
-  std::atomic<bool> disabled{false};
-  Chore() = default;
-  Chore(const Chore &o)
-      : device_type(o.device_type), body_kind(o.body_kind),
-        body_arg(o.body_arg), disabled(o.disabled.load()) {}
-};
-
-struct TaskClass {
-  std::string name;
-  int32_t id = 0;
-  std::vector<Local> locals;
-  std::vector<int32_t> range_locals; /* indices of range locals, in order */
-  int32_t aff_dc = -1;
-  std::vector<Expr> aff_idx;
-  Expr priority;
-  std::vector<Flow> flows;
-  std::vector<Chore> chores;
-};
-
-/* ------------------------------------------------------------------ */
-/* containers                                                          */
-/* ------------------------------------------------------------------ */
-
-struct BodyCb {
-  ptc_body_cb fn;
-  void *user;
-};
-
-struct Collection {
-  uint32_t nodes = 1, myrank = 0;
-  ptc_rank_of_cb rank_of = nullptr;
-  ptc_data_of_cb data_of = nullptr;
-  void *user = nullptr;
-  /* builtin linear collection */
-  bool linear = false;
-  char *base = nullptr;
-  int64_t nb_elems = 0, elem_size = 0;
-  std::vector<ptc_data *> linear_data; /* lazily created */
-  std::mutex linear_lock;
-};
-
-struct Arena {
-  int64_t elem_size = 0;
-  std::vector<void *> freelist;
-  std::mutex lock;
-  void *alloc() {
-    {
-      std::lock_guard<std::mutex> g(lock);
-      if (!freelist.empty()) {
-        void *p = freelist.back();
-        freelist.pop_back();
-        return p;
-      }
-    }
-    return std::malloc((size_t)elem_size);
-  }
-  void dealloc(void *p) {
+void *Arena::alloc() {
+  {
     std::lock_guard<std::mutex> g(lock);
-    freelist.push_back(p);
-  }
-  ~Arena() {
-    for (void *p : freelist) std::free(p);
-  }
-};
-
-} // namespace
-
-/* ------------------------------------------------------------------ */
-/* task                                                                */
-/* ------------------------------------------------------------------ */
-
-/* Dynamic-task extension (DTD): explicit successor lists instead of
- * expression-derived deps.  Reference: parsec/interfaces/dtd.  */
-struct DynExt {
-  std::mutex lock;
-  std::vector<ptc_task *> succs;  /* registered, not yet released */
-  std::atomic<int32_t> remaining{1}; /* +1 submission hold */
-  std::atomic<int32_t> refs{1};      /* runtime ref; tiles add refs */
-  bool completed = false;
-  int32_t nb_flows = 0;
-  int32_t body_kind = 0; /* PTC_BODY_* */
-  int64_t body_arg = 0;
-  int32_t modes[PTC_MAX_FLOWS] = {0}; /* PTC_DTD_* per flow */
-};
-
-struct ptc_task {
-  ptc_taskpool *tp = nullptr;
-  int32_t class_id = 0;
-  int32_t priority = 0;
-  int32_t chore_idx = 0;
-  int32_t status = 0;
-  int64_t locals[PTC_MAX_LOCALS];
-  ptc_copy *data[PTC_MAX_FLOWS];
-  ptc_task *next = nullptr; /* freelist link */
-  DynExt *dyn = nullptr;    /* non-null for DTD tasks */
-};
-
-namespace {
-
-struct DepKey {
-  int32_t class_id;
-  uint64_t hash;
-  std::vector<int64_t> params;
-  bool operator==(const DepKey &o) const {
-    return class_id == o.class_id && params == o.params;
-  }
-};
-struct DepKeyHash {
-  size_t operator()(const DepKey &k) const { return (size_t)k.hash; }
-};
-
-static uint64_t fnv_hash(int32_t class_id, const std::vector<int64_t> &params) {
-  uint64_t h = 1469598103934665603ull;
-  auto mix = [&](int64_t v) {
-    for (int i = 0; i < 8; i++) {
-      h ^= (uint64_t)(v >> (8 * i)) & 0xff;
-      h *= 1099511628211ull;
+    if (!freelist.empty()) {
+      void *p = freelist.back();
+      freelist.pop_back();
+      return p;
     }
-  };
-  mix(class_id);
-  for (int64_t p : params) mix(p);
-  return h;
+  }
+  return std::malloc((size_t)elem_size);
 }
 
-/* A pending successor: data copies staged by producers until all task-input
- * dependencies are satisfied, then promoted to a ready task.  (Reference
- * analog: parsec_hashable_dependency_t entries + datarepo retention.) */
-struct DepEntry {
-  int32_t remaining = 0;
-  bool initialized = false;
-  ptc_copy *staged[PTC_MAX_FLOWS] = {nullptr};
-};
+void Arena::dealloc(void *p) {
+  std::lock_guard<std::mutex> g(lock);
+  freelist.push_back(p);
+}
 
-struct DepShard {
-  std::mutex lock;
-  std::unordered_map<DepKey, DepEntry, DepKeyHash> map;
-  /* 64-bit key-hashes of already-promoted instances: over-delivery detection
-   * at 8 bytes/task instead of retaining whole entries (a false positive
-   * needs an FNV-64 collision between two live keys — ~n^2/2^64). */
-  std::unordered_set<uint64_t> promoted;
-};
-constexpr int NB_SHARDS = 64;
+Arena::~Arena() {
+  for (void *p : freelist) std::free(p);
+}
 
 /* ------------------------------------------------------------------ */
-/* schedulers                                                          */
+/* context teardown                                                    */
 /* ------------------------------------------------------------------ */
 
-struct Scheduler {
-  virtual ~Scheduler() {}
-  virtual void install(int nb_workers) = 0;
-  virtual void schedule(int worker, ptc_task *t) = 0;
-  virtual ptc_task *select(int worker) = 0;
-};
-
-/* lfq: per-worker deques, LIFO local pop for cache warmth, FIFO steals.
- * (Reference: mca/sched/lfq local flat queues + hbbuffer hierarchy.) */
-struct SchedLFQ : Scheduler {
-  struct Q {
-    std::mutex lock;
-    std::deque<ptc_task *> dq;
-  };
-  std::vector<Q> qs;
-  void install(int n) override { qs = std::vector<Q>((size_t)std::max(1, n)); }
-  void schedule(int w, ptc_task *t) override {
-    Q &q = qs[(size_t)(w % (int)qs.size())];
-    std::lock_guard<std::mutex> g(q.lock);
-    q.dq.push_back(t);
+ptc_context::~ptc_context() {
+  for (auto *c : collections) delete c;
+  for (auto *a : arenas) delete a;
+  for (auto *q : dev_queues) delete q;
+  for (auto *p : prof) delete p;
+  delete sched;
+  ptc_task *t = free_list;
+  while (t) {
+    ptc_task *n = t->next;
+    delete t;
+    t = n;
   }
-  ptc_task *select(int w) override {
-    int n = (int)qs.size();
-    {
-      Q &q = qs[(size_t)(w % n)];
-      std::lock_guard<std::mutex> g(q.lock);
-      if (!q.dq.empty()) {
-        ptc_task *t = q.dq.back();
-        q.dq.pop_back();
-        return t;
-      }
-    }
-    for (int i = 1; i < n; i++) { /* steal oldest from victims */
-      Q &q = qs[(size_t)((w + i) % n)];
-      std::lock_guard<std::mutex> g(q.lock);
-      if (!q.dq.empty()) {
-        ptc_task *t = q.dq.front();
-        q.dq.pop_front();
-        return t;
-      }
-    }
-    return nullptr;
-  }
-};
-
-/* gd: one global dequeue (reference: mca/sched/gd) */
-struct SchedGD : Scheduler {
-  std::mutex lock;
-  std::deque<ptc_task *> dq;
-  void install(int) override {}
-  void schedule(int, ptc_task *t) override {
-    std::lock_guard<std::mutex> g(lock);
-    dq.push_back(t);
-  }
-  ptc_task *select(int) override {
-    std::lock_guard<std::mutex> g(lock);
-    if (dq.empty()) return nullptr;
-    ptc_task *t = dq.front();
-    dq.pop_front();
-    return t;
-  }
-};
-
-/* ap: global absolute-priority ordering (reference: mca/sched/ap) */
-struct SchedAP : Scheduler {
-  struct Cmp {
-    bool operator()(ptc_task *a, ptc_task *b) const {
-      return a->priority < b->priority;
-    }
-  };
-  std::mutex lock;
-  std::vector<ptc_task *> heap;
-  void install(int) override {}
-  void schedule(int, ptc_task *t) override {
-    std::lock_guard<std::mutex> g(lock);
-    heap.push_back(t);
-    std::push_heap(heap.begin(), heap.end(), Cmp{});
-  }
-  ptc_task *select(int) override {
-    std::lock_guard<std::mutex> g(lock);
-    if (heap.empty()) return nullptr;
-    std::pop_heap(heap.begin(), heap.end(), Cmp{});
-    ptc_task *t = heap.back();
-    heap.pop_back();
-    return t;
-  }
-};
-
-/* ------------------------------------------------------------------ */
-/* device queues                                                       */
-/* ------------------------------------------------------------------ */
-
-struct DeviceQueue {
-  std::mutex lock;
-  std::condition_variable cv;
-  std::deque<ptc_task *> dq;
-};
-
-/* ------------------------------------------------------------------ */
-/* profiling                                                           */
-/* ------------------------------------------------------------------ */
-
-struct ProfBuf {
-  std::mutex lock;
-  std::vector<int64_t> words; /* 5 words per event */
-};
-
-enum { PROF_KEY_EXEC = 0 };
-
-} // namespace
-
-/* ------------------------------------------------------------------ */
-/* taskpool + context                                                  */
-/* ------------------------------------------------------------------ */
-
-struct ptc_taskpool {
-  ptc_context *ctx = nullptr;
-  std::vector<int64_t> globals;
-  std::vector<TaskClass> classes;
-  std::atomic<int64_t> nb_tasks{0};      /* remaining local tasks */
-  std::atomic<int64_t> nb_total{0};      /* counted at startup */
-  std::atomic<int64_t> nb_errors{0};     /* failed/dropped tasks */
-  std::atomic<bool> open{false};         /* DTD: dynamic insertion */
-  std::atomic<bool> completed{false};
-  std::atomic<bool> added{false};
-  DepShard shards[NB_SHARDS];
-  std::mutex done_lock;
-  std::condition_variable done_cv;
-  /* DTD insertion-window throttle */
-  std::mutex window_lock;
-  std::condition_variable window_cv;
-};
-
-struct ptc_context {
-  int nb_workers = 1;
-  std::vector<std::thread> workers;
-  std::atomic<bool> started{false};
-  std::atomic<bool> shutdown{false};
-  Scheduler *sched = nullptr;
-  std::string sched_name = "lfq";
-
-  /* idle-worker parking */
-  std::mutex idle_lock;
-  std::condition_variable idle_cv;
-  std::atomic<int64_t> work_signal{0};
-
-  /* registries */
-  std::vector<ExprCb> expr_cbs;
-  std::vector<BodyCb> body_cbs;
-  std::vector<Collection *> collections;
-  std::vector<Arena *> arenas;
-  std::vector<DeviceQueue *> dev_queues;
-  std::mutex reg_lock;
-
-  uint32_t myrank = 0, nodes = 1;
-
-  /* active taskpools */
-  std::atomic<int64_t> active_tps{0};
-  std::mutex wait_lock;
-  std::condition_variable wait_cv;
-
-  /* task freelist (mempool stand-in; reference parsec/mempool.c) */
-  std::mutex free_lock;
-  ptc_task *free_list = nullptr;
-
-  /* device-layer hook: copy with handle released */
-  ptc_copy_release_cb copy_release_cb = nullptr;
-  void *copy_release_user = nullptr;
-
-  /* profiling */
-  std::atomic<bool> prof_enabled{false};
-  std::vector<ProfBuf *> prof;
-
-  ~ptc_context() {
-    for (auto *c : collections) delete c;
-    for (auto *a : arenas) delete a;
-    for (auto *q : dev_queues) delete q;
-    for (auto *p : prof) delete p;
-    delete sched;
-    ptc_task *t = free_list;
-    while (t) {
-      ptc_task *n = t->next;
-      delete t;
-      t = n;
-    }
-  }
-};
-
-namespace {
+}
 
 /* ------------------------------------------------------------------ */
 /* expression evaluation                                               */
 /* ------------------------------------------------------------------ */
 
-static int64_t eval_expr(const Expr &e, ptc_context *ctx,
-                         const int64_t *locals, int nb_locals,
-                         const int64_t *globals, int64_t empty_value = 0) {
+int64_t ptc_eval_expr(const Expr &e, ptc_context *ctx, const int64_t *locals,
+                      int nb_locals, const int64_t *globals,
+                      int64_t empty_value) {
   if (e.empty()) return empty_value;
   constexpr int STACK_MAX = 64;
   int64_t stack[STACK_MAX];
@@ -520,15 +140,40 @@ static int64_t eval_expr(const Expr &e, ptc_context *ctx,
   return sp > 0 ? stack[sp - 1] : 0;
 }
 
+namespace {
+
+static inline int64_t eval_expr(const Expr &e, ptc_context *ctx,
+                                const int64_t *locals, int nb_locals,
+                                const int64_t *globals, int64_t ev = 0) {
+  return ptc_eval_expr(e, ctx, locals, nb_locals, globals, ev);
+}
+
 static inline bool eval_guard(const Expr &e, ptc_context *ctx,
                               const int64_t *locals, int nb_locals,
                               const int64_t *globals) {
-  return eval_expr(e, ctx, locals, nb_locals, globals, /*empty=*/1) != 0;
+  return ptc_eval_expr(e, ctx, locals, nb_locals, globals, /*empty=*/1) != 0;
+}
+
+} // namespace
+
+uint64_t ptc_fnv_hash(int32_t class_id, const std::vector<int64_t> &params) {
+  uint64_t h = 1469598103934665603ull;
+  auto mix = [&](int64_t v) {
+    for (int i = 0; i < 8; i++) {
+      h ^= (uint64_t)(v >> (8 * i)) & 0xff;
+      h *= 1099511628211ull;
+    }
+  };
+  mix(class_id);
+  for (int64_t p : params) mix(p);
+  return h;
 }
 
 /* ------------------------------------------------------------------ */
 /* spec decoding                                                       */
 /* ------------------------------------------------------------------ */
+
+namespace {
 
 struct SpecReader {
   const int64_t *p;
@@ -623,15 +268,17 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
   return r.ok;
 }
 
+} // namespace
+
 /* ------------------------------------------------------------------ */
 /* data helpers                                                        */
 /* ------------------------------------------------------------------ */
 
-static void copy_retain(ptc_copy *c) {
+void ptc_copy_retain(ptc_copy *c) {
   if (c) c->refcount.fetch_add(1, std::memory_order_relaxed);
 }
 
-static void copy_release(ptc_context *ctx, ptc_copy *c) {
+void ptc_copy_release_internal(ptc_context *ctx, ptc_copy *c) {
   if (!c) return;
   if (c->refcount.fetch_sub(1, std::memory_order_acq_rel) == 1) {
     if (c->handle != 0 && ctx->copy_release_cb)
@@ -644,8 +291,15 @@ static void copy_release(ptc_context *ctx, ptc_copy *c) {
   }
 }
 
-static ptc_data *collection_data_of(ptc_context *ctx, int32_t dc_id,
-                                    const int64_t *idx, int32_t n) {
+namespace {
+static inline void copy_retain(ptc_copy *c) { ptc_copy_retain(c); }
+static inline void copy_release(ptc_context *ctx, ptc_copy *c) {
+  ptc_copy_release_internal(ctx, c);
+}
+} // namespace
+
+ptc_data *ptc_collection_data_of(ptc_context *ctx, int32_t dc_id,
+                                 const int64_t *idx, int32_t n) {
   Collection *dc = ctx->collections[(size_t)dc_id];
   if (dc->linear) {
     int64_t k = n > 0 ? idx[0] : 0;
@@ -661,8 +315,8 @@ static ptc_data *collection_data_of(ptc_context *ctx, int32_t dc_id,
   return dc->data_of ? dc->data_of(dc->user, idx, n) : nullptr;
 }
 
-static uint32_t collection_rank_of(ptc_context *ctx, int32_t dc_id,
-                                   const int64_t *idx, int32_t n) {
+uint32_t ptc_collection_rank_of(ptc_context *ctx, int32_t dc_id,
+                                const int64_t *idx, int32_t n) {
   Collection *dc = ctx->collections[(size_t)dc_id];
   if (dc->linear) return dc->nodes ? (uint32_t)((n > 0 ? idx[0] : 0) % dc->nodes) : 0;
   return dc->rank_of ? dc->rank_of(dc->user, idx, n) : 0;
@@ -671,6 +325,8 @@ static uint32_t collection_rank_of(ptc_context *ctx, int32_t dc_id,
 /* ------------------------------------------------------------------ */
 /* runtime: creation, scheduling, execution, release                   */
 /* ------------------------------------------------------------------ */
+
+namespace {
 
 static ptc_task *task_alloc(ptc_context *ctx) {
   {
@@ -690,7 +346,6 @@ static void task_free(ptc_context *ctx, ptc_task *t) {
   ctx->free_list = t;
 }
 
-static void schedule_task(ptc_context *ctx, int worker, ptc_task *t);
 static void complete_task(ptc_context *ctx, int worker, ptc_task *t);
 static void execute_task(ptc_context *ctx, int worker, ptc_task *t);
 
@@ -762,31 +417,70 @@ static ptc_task *make_task(ptc_context *ctx, ptc_taskpool *tp,
   return t;
 }
 
-/* Deliver one dependency release to a successor task instance: find or
- * create its dep entry, stage the copy, and promote to a ready task when
- * the last expected input arrives. */
+/* A batch of remote activations accumulated during one release_deps pass:
+ * successors of the same output copy heading to the same rank share one
+ * ACTIVATE message (reference: per-rank output bitmaps + forward mask,
+ * parsec/remote_dep.h:143-177). */
+struct RemoteSend {
+  uint32_t rank;
+  int32_t flow_idx;
+  ptc_copy *copy;
+  std::vector<std::pair<int32_t, std::vector<int64_t>>> targets;
+};
+
+/* Compute the placement rank of a successor instance (affinity expr over
+ * its collection); myrank when the class has no affinity. */
+static uint32_t successor_rank(ptc_context *ctx, ptc_taskpool *tp,
+                               const TaskClass &tc,
+                               const std::vector<int64_t> &params) {
+  if (tc.aff_dc < 0 || ctx->nodes <= 1) return ctx->myrank;
+  int64_t locals[PTC_MAX_LOCALS] = {0};
+  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+    locals[tc.range_locals[(size_t)i]] = params[i];
+  fill_derived_locals(ctx, tp, tc, locals);
+  int64_t idx[PTC_MAX_LOCALS];
+  int ni = (int)tc.aff_idx.size();
+  for (int i = 0; i < ni; i++)
+    idx[i] = eval_expr(tc.aff_idx[(size_t)i], ctx, locals,
+                       (int)tc.locals.size(), tp->globals.data());
+  return ptc_collection_rank_of(ctx, tc.aff_dc, idx, ni);
+}
+
+/* Deliver one dependency release to a successor task instance: local
+ * successors stage into the dep table; remote successors batch into an
+ * ACTIVATE send (or go out immediately when batch == nullptr). */
 static void deliver_dep(ptc_context *ctx, int worker, ptc_taskpool *tp,
                         int32_t class_id, std::vector<int64_t> &&params,
-                        int32_t flow_idx, ptc_copy *copy) {
+                        int32_t flow_idx, ptc_copy *copy,
+                        std::vector<RemoteSend> *batch) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
-
-  /* owner-computes filter: successors placed on another rank are not built
-   * here — the comm layer turns these into remote ACTIVATE messages. */
-  if (ctx->nodes > 1 && tc.aff_dc >= 0) {
-    int64_t locals[PTC_MAX_LOCALS] = {0};
-    for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
-      locals[tc.range_locals[(size_t)i]] = params[i];
-    fill_derived_locals(ctx, tp, tc, locals);
-    int64_t idx[PTC_MAX_LOCALS];
-    int ni = (int)tc.aff_idx.size();
-    for (int i = 0; i < ni; i++)
-      idx[i] = eval_expr(tc.aff_idx[(size_t)i], ctx, locals,
-                         (int)tc.locals.size(), tp->globals.data());
-    if (collection_rank_of(ctx, tc.aff_dc, idx, ni) != ctx->myrank)
-      return;
+  uint32_t rank = successor_rank(ctx, tp, tc, params);
+  if (rank != ctx->myrank) {
+    if (batch) {
+      for (RemoteSend &rs : *batch) {
+        if (rs.rank == rank && rs.flow_idx == flow_idx && rs.copy == copy) {
+          rs.targets.emplace_back(class_id, std::move(params));
+          return;
+        }
+      }
+      batch->push_back(RemoteSend{rank, flow_idx, copy, {}});
+      batch->back().targets.emplace_back(class_id, std::move(params));
+    } else {
+      ptc_comm_send_activate(ctx, rank, tp, class_id, params, flow_idx, copy);
+    }
+    return;
   }
+  ptc_deliver_dep_local(ctx, worker, tp, class_id, std::move(params),
+                        flow_idx, copy);
+}
 
-  DepKey key{class_id, fnv_hash(class_id, params), std::move(params)};
+} // namespace
+
+void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
+                           int32_t class_id, std::vector<int64_t> &&params,
+                           int32_t flow_idx, ptc_copy *copy) {
+  const TaskClass &tc = tp->classes[(size_t)class_id];
+  DepKey key{class_id, ptc_fnv_hash(class_id, params), std::move(params)};
   DepShard &shard = tp->shards[key.hash % NB_SHARDS];
 
   ptc_task *ready = nullptr;
@@ -821,8 +515,10 @@ static void deliver_dep(ptc_context *ctx, int worker, ptc_taskpool *tp,
       shard.map.erase(key);
     }
   }
-  if (ready) schedule_task(ctx, worker, ready);
+  if (ready) ptc_schedule_task(ctx, worker, ready);
 }
+
+namespace {
 
 /* prepare_input: resolve memory-input deps and allocate WRITE-only flows.
  * (Reference: data_lookup/prepare_input generated hooks.) */
@@ -845,7 +541,15 @@ static int prepare_input(ptc_context *ctx, ptc_task *t) {
       int ni = (int)sel->idx.size();
       for (int i = 0; i < ni; i++)
         idx[i] = eval_expr(sel->idx[(size_t)i], ctx, t->locals, nb_locals, g);
-      ptc_data *d = collection_data_of(ctx, sel->dc_id, idx, ni);
+      if (ctx->nodes > 1 &&
+          ptc_collection_rank_of(ctx, sel->dc_id, idx, ni) != ctx->myrank) {
+        /* memory reads must be affine with task placement (DPLASMA-style
+         * JDFs are; remote initial reads would need a GET protocol) */
+        std::fprintf(stderr,
+                     "ptc: task %s reads remote collection data; place the "
+                     "task at its data (affinity) instead\n", tc.name.c_str());
+      }
+      ptc_data *d = ptc_collection_data_of(ctx, sel->dc_id, idx, ni);
       if (d && d->host_copy) {
         copy_retain(d->host_copy);
         t->data[f] = d->host_copy;
@@ -867,13 +571,14 @@ static int prepare_input(ptc_context *ctx, ptc_task *t) {
 
 /* release_deps: after a task body ran, walk every flow's output deps and
  * fan out: task targets get the flow's current copy delivered; memory
- * targets get written back.  (Reference: iterate_successors +
- * parsec_release_dep_fct, parsec/parsec.c:1912.) */
+ * targets get written back (remote ones via comm PUT).  (Reference:
+ * iterate_successors + parsec_release_dep_fct, parsec/parsec.c:1912.) */
 static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
   ptc_taskpool *tp = t->tp;
   const TaskClass &tc = tp->classes[(size_t)t->class_id];
   int nb_locals = (int)tc.locals.size();
   const int64_t *g = tp->globals.data();
+  std::vector<RemoteSend> batch;
 
   for (size_t f = 0; f < tc.flows.size(); f++) {
     const Flow &fl = tc.flows[f];
@@ -896,7 +601,8 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
         if (range_idx.empty()) {
           std::vector<int64_t> pv(vals);
           deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                      d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy);
+                      d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
+                      &batch);
         } else {
           /* nested iteration over up to a few range params */
           struct R { int64_t lo, hi, st, cur; };
@@ -920,7 +626,8 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
               vals[range_idx[i]] = rs[i].cur;
             std::vector<int64_t> pv(vals);
             deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                        d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy);
+                        d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
+                        &batch);
             /* advance odometer */
             size_t i = 0;
             for (; i < rs.size(); i++) {
@@ -938,7 +645,14 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
         int ni = (int)d.idx.size();
         for (int i = 0; i < ni; i++)
           idx[i] = eval_expr(d.idx[(size_t)i], ctx, t->locals, nb_locals, g);
-        ptc_data *dst = collection_data_of(ctx, d.dc_id, idx, ni);
+        if (ctx->nodes > 1) {
+          uint32_t r = ptc_collection_rank_of(ctx, d.dc_id, idx, ni);
+          if (r != ctx->myrank) {
+            ptc_comm_send_put_mem(ctx, r, d.dc_id, idx, ni, copy);
+            continue;
+          }
+        }
+        ptc_data *dst = ptc_collection_data_of(ctx, d.dc_id, idx, ni);
         if (dst && dst->host_copy && dst->host_copy->ptr != copy->ptr)
           std::memcpy(dst->host_copy->ptr, copy->ptr,
                       (size_t)std::min(dst->host_copy->size, copy->size));
@@ -947,6 +661,9 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
       }
     }
   }
+  for (RemoteSend &rs : batch)
+    ptc_comm_send_activate_batch(ctx, rs.rank, tp, rs.flow_idx, rs.copy,
+                                 rs.targets);
 }
 
 static void wake_workers(ptc_context *ctx) {
@@ -954,9 +671,17 @@ static void wake_workers(ptc_context *ctx) {
   ctx->idle_cv.notify_all();
 }
 
-static void schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
+} // namespace
+
+void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
   ctx->sched->schedule(worker < 0 ? 0 : worker, t);
   wake_workers(ctx);
+}
+
+namespace {
+
+static inline void schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
+  ptc_schedule_task(ctx, worker, t);
 }
 
 /* Mark a taskpool complete exactly once: notify tp waiters and, when it was
@@ -1015,6 +740,10 @@ static void dyn_complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   for (int f = 0; f < dx->nb_flows; f++)
     if (t->data[f] && (dx->modes[f] & PTC_DTD_OUTPUT))
       t->data[f]->version.fetch_add(1, std::memory_order_release);
+  /* distributed: tell every shadow of this task that it finished (carries
+   * the written-tile payloads) before releasing local successors */
+  if (!dx->shadow && ctx->nodes > 1)
+    ptc_comm_send_dtd_complete(ctx, tp, t);
   std::vector<ptc_task *> succs;
   {
     std::lock_guard<std::mutex> g(dx->lock);
@@ -1069,7 +798,7 @@ static void prof_event(ptc_context *ctx, int worker, int64_t key, int64_t phase,
   b->words.push_back(phase);
   b->words.push_back(t ? t->class_id : -1);
   b->words.push_back(t ? t->locals[0] : 0);
-  b->words.push_back(now_ns());
+  b->words.push_back(ptc_now_ns());
 }
 
 /* DTD failure: same taskpool-abort semantics as fail_task */
@@ -1094,6 +823,13 @@ static void dyn_fail_task(ptc_context *ctx, ptc_task *t) {
 static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
   DynExt *dx = t->dyn;
   int32_t rc = PTC_HOOK_DONE;
+  if (dx->shadow) {
+    /* shadow of a remote task: its "body" is the arrival of the owner's
+     * completion message.  All local predecessor deps are satisfied here;
+     * the message dep was registered at insertion (comm.cpp releases it). */
+    complete_task(ctx, worker, t);
+    return;
+  }
   switch (dx->body_kind) {
   case PTC_BODY_NOOP:
     prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
@@ -1276,7 +1012,8 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
       int ni = (int)tc.aff_idx.size();
       for (int i = 0; i < ni; i++)
         idx[i] = eval_expr(tc.aff_idx[(size_t)i], ctx, locals, nb_locals, g);
-      if (collection_rank_of(ctx, tc.aff_dc, idx, ni) != ctx->myrank) return;
+      if (ptc_collection_rank_of(ctx, tc.aff_dc, idx, ni) != ctx->myrank)
+        return;
     }
     st.nb_local++;
     if (count_task_inputs(ctx, tp, tc, locals) == 0) {
@@ -1320,17 +1057,48 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
 } // namespace
 
 /* ------------------------------------------------------------------ */
-/* DTD tiles                                                           */
+/* DTD distributed: shadow release (called from comm.cpp)              */
 /* ------------------------------------------------------------------ */
 
-/* Per-tile accessor chain (reference: parsec_dtd_tile_t last_user /
- * last_writer under per-tile locks, insert_function_internal.h:110-139) */
-struct ptc_dtile {
-  std::mutex lock;
-  ptc_copy *copy = nullptr;
-  ptc_task *last_writer = nullptr;
-  std::vector<ptc_task *> readers;
-};
+/* Payload framing (see comm.cpp dtd_complete): sequence of
+ * [u32 flow][u64 len][bytes] records for every OUTPUT-mode flow. */
+void ptc_dtd_apply_complete(ptc_context *ctx, ptc_task *t,
+                            const uint8_t *payload, size_t len) {
+  /* apply written-tile payloads into the local copies */
+  size_t off = 0;
+  while (off + 12 <= len) {
+    uint32_t flow;
+    uint64_t plen;
+    std::memcpy(&flow, payload + off, 4);
+    std::memcpy(&plen, payload + off + 4, 8);
+    off += 12;
+    if (off + plen > len) break;
+    if (flow < PTC_MAX_FLOWS && t->data[flow] && t->data[flow]->ptr)
+      std::memcpy(t->data[flow]->ptr, payload + off,
+                  (size_t)std::min<uint64_t>(plen, (uint64_t)t->data[flow]->size));
+    off += plen;
+  }
+  /* drop the message hold; schedule if local predecessors are also done */
+  if (t->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
+    ptc_schedule_task(ctx, -1, t);
+}
+
+void ptc_dtd_shadow_ready(ptc_context *ctx, ptc_taskpool *tp, uint64_t seq,
+                          const uint8_t *payload, size_t len) {
+  ptc_task *t = nullptr;
+  {
+    std::lock_guard<std::mutex> g(tp->dtd_lock);
+    auto it = tp->dtd_shadows.find(seq);
+    if (it == tp->dtd_shadows.end()) {
+      /* message beat the insertion: park the payload */
+      tp->dtd_early[seq] = std::vector<uint8_t>(payload, payload + len);
+      return;
+    }
+    t = it->second;
+    tp->dtd_shadows.erase(it);
+  }
+  ptc_dtd_apply_complete(ctx, t, payload, len);
+}
 
 /* ------------------------------------------------------------------ */
 /* C API                                                               */
@@ -1338,7 +1106,7 @@ struct ptc_dtile {
 
 extern "C" {
 
-const char *ptc_version(void) { return "tpu-parsec-core 0.1"; }
+const char *ptc_version(void) { return "tpu-parsec-core 0.2"; }
 
 ptc_context_t *ptc_context_new(int32_t nb_workers) {
   ptc_context *ctx = new ptc_context();
@@ -1362,9 +1130,7 @@ int32_t ptc_context_set_scheduler(ptc_context_t *ctx, const char *name) {
 int32_t ptc_context_start(ptc_context_t *ctx) {
   bool expected = false;
   if (!ctx->started.compare_exchange_strong(expected, true)) return 0;
-  if (ctx->sched_name == "gd") ctx->sched = new SchedGD();
-  else if (ctx->sched_name == "ap") ctx->sched = new SchedAP();
-  else ctx->sched = new SchedLFQ();
+  ctx->sched = ptc_sched_create(ctx->sched_name);
   ctx->sched->install(ctx->nb_workers);
   for (int i = 0; i < ctx->nb_workers; i++)
     ctx->workers.emplace_back(worker_main, ctx, i);
@@ -1382,11 +1148,14 @@ int32_t ptc_context_test(ptc_context_t *ctx) {
 }
 
 void ptc_context_destroy(ptc_context_t *ctx) {
+  /* workers first: they may still call ptc_comm_send_* from release_deps,
+   * so the comm engine must outlive them */
   ctx->shutdown.store(true, std::memory_order_release);
   ctx->idle_cv.notify_all();
   for (auto *q : ctx->dev_queues) q->cv.notify_all();
   for (auto &w : ctx->workers)
     if (w.joinable()) w.join();
+  ptc_comm_shutdown(ctx); /* no-op when comm was never initialized */
   delete ctx;
 }
 
@@ -1453,6 +1222,10 @@ ptc_taskpool_t *ptc_tp_new(ptc_context_t *ctx, int32_t nb_globals,
 }
 
 void ptc_tp_destroy(ptc_taskpool_t *tp) {
+  if (tp->id >= 0) {
+    std::lock_guard<std::mutex> g(tp->ctx->tp_reg_lock);
+    tp->ctx->tp_registry.erase(tp->id);
+  }
   for (auto &shard : tp->shards) {
     std::lock_guard<std::mutex> g(shard.lock);
     for (auto &kv : shard.map)
@@ -1473,6 +1246,8 @@ int32_t ptc_tp_add_class(ptc_taskpool_t *tp, const char *name,
   return (int32_t)tp->classes.size() - 1;
 }
 
+int32_t ptc_tp_id(ptc_taskpool_t *tp) { return tp->id; }
+
 int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp) {
   bool expected = false;
   if (!tp->added.compare_exchange_strong(expected, true)) return -1;
@@ -1481,11 +1256,21 @@ int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp) {
   for (const TaskClass &tc : tp->classes) enumerate_class(ctx, tp, tc, st);
   tp->nb_total.store(st.nb_local);
   tp->nb_tasks.store(st.nb_local);
+  /* distributed registration: ids follow SPMD creation order (reference:
+   * taskpool id sync, parsec/runtime.h:480-491) */
+  {
+    std::lock_guard<std::mutex> g(ctx->tp_reg_lock);
+    tp->id = ctx->next_tp_id++;
+    ctx->tp_registry[tp->id] = tp;
+  }
   if (st.nb_local == 0 && !tp->open.load()) {
     tp_mark_complete(ctx, tp);
+    ptc_comm_drain_early(ctx, tp);
     return 0;
   }
   ptc_context_start(ctx);
+  /* activations that arrived before this pool existed */
+  ptc_comm_drain_early(ctx, tp);
   for (ptc_task *t : st.ready) schedule_task(ctx, 0, t);
   return 0;
 }
@@ -1615,6 +1400,10 @@ ptc_dtile_t *ptc_dtile_new(ptc_context_t *ctx, ptc_data_t *d) {
   return tile;
 }
 
+void ptc_dtile_set_owner(ptc_dtile_t *tile, uint32_t rank) {
+  if (tile) tile->owner = rank;
+}
+
 void ptc_dtile_destroy(ptc_context_t *ctx, ptc_dtile_t *tile) {
   if (!tile) return;
   {
@@ -1639,6 +1428,8 @@ ptc_task_t *ptc_dtask_begin(ptc_taskpool_t *tp, int32_t body_kind,
   t->dyn = new DynExt();
   t->dyn->body_kind = body_kind;
   t->dyn->body_arg = body_arg;
+  t->dyn->seq = tp->dtd_seq.fetch_add(1, std::memory_order_relaxed);
+  t->dyn->rank = UINT32_MAX; /* unset: resolved at submit */
   return t;
 }
 
@@ -1647,6 +1438,7 @@ int32_t ptc_dtask_arg(ptc_task_t *t, ptc_dtile_t *tile, int32_t mode) {
   if (!dx || dx->nb_flows >= PTC_MAX_FLOWS) return -1;
   int f = dx->nb_flows++;
   dx->modes[f] = mode;
+  dx->tiles[f] = tile;
   std::lock_guard<std::mutex> g(tile->lock);
   copy_retain(tile->copy);
   t->data[f] = tile->copy;
@@ -1693,8 +1485,50 @@ int32_t ptc_dtask_arg(ptc_task_t *t, ptc_dtile_t *tile, int32_t mode) {
   return f;
 }
 
+/* Declare the placement rank of a dynamic task (default: first OUTPUT
+ * tile's owner; fallback myrank).  Must be called before submit. */
+void ptc_dtask_set_rank(ptc_task_t *t, int32_t rank) {
+  if (t && t->dyn && rank >= 0) t->dyn->rank = (uint32_t)rank;
+}
+
 int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
   ptc_taskpool *tp = t->tp;
+  DynExt *dx = t->dyn;
+  /* distributed placement: explicit rank (ptc_dtask_set_rank), else the
+   * first OUTPUT tile's owner, else this rank (reference: DTD remote-task
+   * shadows, parsec/interfaces/dtd/insert_function.c) */
+  std::vector<uint8_t> early_payload;
+  bool have_early = false;
+  if (ctx->nodes > 1) {
+    uint32_t rank = dx->rank;
+    if (rank == UINT32_MAX) {
+      rank = ctx->myrank;
+      for (int f = 0; f < dx->nb_flows; f++) {
+        if (dx->modes[f] & PTC_DTD_OUTPUT) {
+          rank = dx->tiles[f] ? dx->tiles[f]->owner : ctx->myrank;
+          break;
+        }
+      }
+    }
+    dx->rank = rank;
+    dx->shadow = rank != ctx->myrank;
+    if (dx->shadow) {
+      /* +1 message dep: released by the owner's completion broadcast */
+      dx->remaining.fetch_add(1, std::memory_order_relaxed);
+      std::lock_guard<std::mutex> g(tp->dtd_lock);
+      auto it = tp->dtd_early.find(dx->seq);
+      if (it != tp->dtd_early.end()) {
+        /* the completion beat the insertion: apply after bookkeeping */
+        early_payload = std::move(it->second);
+        tp->dtd_early.erase(it);
+        have_early = true;
+      } else {
+        tp->dtd_shadows[dx->seq] = t;
+      }
+    }
+  } else {
+    dx->rank = ctx->myrank;
+  }
   if (window > 0) {
     std::unique_lock<std::mutex> lk(tp->window_lock);
     tp->window_cv.wait(lk, [&] {
@@ -1705,18 +1539,25 @@ int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
   }
   if (tp->completed.load(std::memory_order_acquire)) {
     /* pool aborted (a body failed): refuse the insertion */
-    ptc_task_t *dead = t;
-    for (int f = 0; f < dead->dyn->nb_flows; f++)
-      if (dead->data[f]) copy_release(ctx, dead->data[f]);
-    dyn_release(dead);
+    if (dx->shadow && !have_early) {
+      std::lock_guard<std::mutex> g(tp->dtd_lock);
+      tp->dtd_shadows.erase(dx->seq);
+    }
+    for (int f = 0; f < dx->nb_flows; f++)
+      if (t->data[f]) copy_release(ctx, t->data[f]);
+    dyn_release(t);
     return -1;
   }
   tp->nb_tasks.fetch_add(1, std::memory_order_acq_rel);
   tp->nb_total.fetch_add(1, std::memory_order_relaxed);
   ptc_context_start(ctx);
   /* drop the submission hold; schedule if all preds already done */
-  if (t->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
+  if (dx->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
     schedule_task(ctx, 0, t);
+  /* apply an early-arrived completion now the counts are consistent
+   * (drops the message hold; may schedule the shadow) */
+  if (have_early)
+    ptc_dtd_apply_complete(ctx, t, early_payload.data(), early_payload.size());
   return 0;
 }
 

@@ -237,6 +237,31 @@ void ptc_set_copy_release_cb(ptc_context_t *ctx, ptc_copy_release_cb cb,
  * zero for transient arena-backed copies */
 int32_t ptc_copy_is_persistent(ptc_copy_t *c);
 
+/* ------------------------------------------------------- comm engine
+ * Distributed control plane (reference: parsec_comm_engine.h vtable +
+ * remote_dep protocol — SURVEY.md §2.5).  Ranks form a loopback/DCN TCP
+ * full mesh; dependency activations, memory write-backs and DTD completion
+ * broadcasts ride it.  Call ptc_context_set_rank first; then:            */
+/* bring up the transport (rank r listens on base_port + r); no-op when
+ * nodes <= 1.  Blocks until the full mesh is connected. */
+int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port);
+/* flush queued sends + wait for every peer's matching fence: after this,
+ * all messages sent before any rank's fence have been applied everywhere */
+int32_t ptc_comm_fence(ptc_context_t *ctx);
+/* fence + stop the comm thread (idempotent) */
+int32_t ptc_comm_fini(ptc_context_t *ctx);
+int32_t ptc_comm_enabled(ptc_context_t *ctx);
+/* out4 = {msgs_sent, msgs_recv, bytes_sent, bytes_recv} */
+void ptc_comm_stats(ptc_context_t *ctx, int64_t *out4);
+
+/* distributed taskpool id (SPMD creation order; assigned at add_taskpool) */
+int32_t ptc_tp_id(ptc_taskpool_t *tp);
+
+/* DTD distributed placement: a tile's owning rank (default 0) and an
+ * explicit per-task rank override (default: first OUTPUT tile's owner) */
+void ptc_dtile_set_owner(ptc_dtile_t *tile, uint32_t rank);
+void ptc_dtask_set_rank(ptc_task_t *t, int32_t rank);
+
 /* version / build info */
 const char *ptc_version(void);
 

@@ -1,0 +1,421 @@
+/* runtime_internal.h — shared internal structures of the native core.
+ *
+ * Split out of core.cpp so the communication engine (comm.cpp) and future
+ * native subsystems (devices, tracing) can reach the runtime internals
+ * without going through the public C ABI.  Everything here is
+ * implementation detail; the public surface stays parsec_core.h.
+ *
+ * Reference analog: parsec/parsec_internal.h (task/taskpool/task-class
+ * model) + parsec/remote_dep.h (comm seam) — see SURVEY.md §2.4/§2.5.
+ */
+#ifndef PTC_RUNTIME_INTERNAL_H
+#define PTC_RUNTIME_INTERNAL_H
+
+#include "parsec_core.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+/* ------------------------------------------------------------------ */
+/* expressions                                                         */
+/* ------------------------------------------------------------------ */
+
+struct Expr {
+  std::vector<int64_t> code; /* empty == constant 0 (or "true" for guards) */
+  bool empty() const { return code.empty(); }
+};
+
+struct ExprCb {
+  ptc_expr_cb fn;
+  void *user;
+};
+
+/* ------------------------------------------------------------------ */
+/* data                                                                */
+/* ------------------------------------------------------------------ */
+
+struct ptc_copy {
+  ptc_data *data = nullptr;
+  void *ptr = nullptr;
+  int64_t size = 0;
+  int64_t handle = 0; /* opaque Python-side id (e.g. jax buffer) */
+  std::atomic<int32_t> refcount{1};
+  std::atomic<int32_t> version{0};
+  int32_t arena_id = -1; /* >=0: return to arena freelist on release */
+  bool owns_ptr = false;
+};
+
+struct ptc_data {
+  int64_t key = 0;
+  int64_t size = 0;
+  ptc_copy *host_copy = nullptr;
+};
+
+/* ------------------------------------------------------------------ */
+/* spec structures (decoded blobs)                                     */
+/* ------------------------------------------------------------------ */
+
+enum DepKind { DEP_NONE = 0, DEP_TASK = 1, DEP_MEM = 2 };
+
+struct DepParam {
+  bool is_range = false;
+  Expr value;      /* when !is_range */
+  Expr lo, hi, st; /* when is_range */
+};
+
+struct Dep {
+  int32_t direction = 0; /* 0 in, 1 out */
+  Expr guard;            /* empty == always true */
+  int32_t kind = DEP_NONE;
+  /* DEP_TASK */
+  int32_t peer_class = -1;
+  int32_t peer_flow = -1;
+  std::vector<DepParam> params;
+  /* DEP_MEM */
+  int32_t dc_id = -1;
+  std::vector<Expr> idx;
+  int32_t arena_id = -1;
+};
+
+struct Flow {
+  int32_t flags = 0; /* PTC_FLOW_* */
+  int32_t arena_id = -1;
+  std::vector<Dep> in_deps, out_deps;
+};
+
+struct Local {
+  bool is_range = false;
+  Expr lo, hi, st; /* range */
+  Expr value;      /* derived */
+};
+
+struct Chore {
+  int32_t device_type = PTC_DEV_CPU;
+  int32_t body_kind = PTC_BODY_NOOP;
+  int64_t body_arg = 0;
+  std::atomic<bool> disabled{false};
+  Chore() = default;
+  Chore(const Chore &o)
+      : device_type(o.device_type), body_kind(o.body_kind),
+        body_arg(o.body_arg), disabled(o.disabled.load()) {}
+};
+
+struct TaskClass {
+  std::string name;
+  int32_t id = 0;
+  std::vector<Local> locals;
+  std::vector<int32_t> range_locals; /* indices of range locals, in order */
+  int32_t aff_dc = -1;
+  std::vector<Expr> aff_idx;
+  Expr priority;
+  std::vector<Flow> flows;
+  std::vector<Chore> chores;
+};
+
+/* ------------------------------------------------------------------ */
+/* registries                                                          */
+/* ------------------------------------------------------------------ */
+
+struct BodyCb {
+  ptc_body_cb fn;
+  void *user;
+};
+
+struct Collection {
+  uint32_t nodes = 1, myrank = 0;
+  ptc_rank_of_cb rank_of = nullptr;
+  ptc_data_of_cb data_of = nullptr;
+  void *user = nullptr;
+  /* builtin linear collection */
+  bool linear = false;
+  char *base = nullptr;
+  int64_t nb_elems = 0, elem_size = 0;
+  std::vector<ptc_data *> linear_data; /* lazily created */
+  std::mutex linear_lock;
+};
+
+struct Arena {
+  int64_t elem_size = 0;
+  std::vector<void *> freelist;
+  std::mutex lock;
+  void *alloc();
+  void dealloc(void *p);
+  ~Arena();
+};
+
+/* ------------------------------------------------------------------ */
+/* task                                                                */
+/* ------------------------------------------------------------------ */
+
+/* Dynamic-task extension (DTD): explicit successor lists instead of
+ * expression-derived deps.  Reference: parsec/interfaces/dtd.  */
+struct DynExt {
+  std::mutex lock;
+  std::vector<ptc_task *> succs;     /* registered, not yet released */
+  std::atomic<int32_t> remaining{1}; /* +1 submission hold */
+  std::atomic<int32_t> refs{1};      /* runtime ref; tiles add refs */
+  bool completed = false;
+  int32_t nb_flows = 0;
+  int32_t body_kind = 0; /* PTC_BODY_* */
+  int64_t body_arg = 0;
+  int32_t modes[PTC_MAX_FLOWS] = {0}; /* PTC_DTD_* per flow */
+  /* distributed DTD */
+  uint64_t seq = 0;           /* global insertion sequence number */
+  uint32_t rank = 0;          /* placement rank */
+  bool shadow = false;        /* placed on another rank */
+  ptc_dtile *tiles[PTC_MAX_FLOWS] = {nullptr}; /* arg tiles (borrowed) */
+};
+
+struct ptc_task {
+  ptc_taskpool *tp = nullptr;
+  int32_t class_id = 0;
+  int32_t priority = 0;
+  int32_t chore_idx = 0;
+  int32_t status = 0;
+  int64_t locals[PTC_MAX_LOCALS];
+  ptc_copy *data[PTC_MAX_FLOWS];
+  ptc_task *next = nullptr; /* freelist link */
+  DynExt *dyn = nullptr;    /* non-null for DTD tasks */
+};
+
+/* Per-tile accessor chain (reference: parsec_dtd_tile_t last_user /
+ * last_writer under per-tile locks, insert_function_internal.h:110-139) */
+struct ptc_dtile {
+  std::mutex lock;
+  ptc_copy *copy = nullptr;
+  ptc_task *last_writer = nullptr;
+  std::vector<ptc_task *> readers;
+  uint32_t owner = 0; /* owning rank (distributed DTD placement) */
+};
+
+/* ------------------------------------------------------------------ */
+/* dependency tracking                                                 */
+/* ------------------------------------------------------------------ */
+
+struct DepKey {
+  int32_t class_id;
+  uint64_t hash;
+  std::vector<int64_t> params;
+  bool operator==(const DepKey &o) const {
+    return class_id == o.class_id && params == o.params;
+  }
+};
+struct DepKeyHash {
+  size_t operator()(const DepKey &k) const { return (size_t)k.hash; }
+};
+
+uint64_t ptc_fnv_hash(int32_t class_id, const std::vector<int64_t> &params);
+
+/* A pending successor: data copies staged by producers until all task-input
+ * dependencies are satisfied, then promoted to a ready task.  (Reference
+ * analog: parsec_hashable_dependency_t entries + datarepo retention.) */
+struct DepEntry {
+  int32_t remaining = 0;
+  bool initialized = false;
+  ptc_copy *staged[PTC_MAX_FLOWS] = {nullptr};
+};
+
+struct DepShard {
+  std::mutex lock;
+  std::unordered_map<DepKey, DepEntry, DepKeyHash> map;
+  /* 64-bit key-hashes of already-promoted instances: over-delivery detection
+   * at 8 bytes/task instead of retaining whole entries (a false positive
+   * needs an FNV-64 collision between two live keys — ~n^2/2^64). */
+  std::unordered_set<uint64_t> promoted;
+};
+constexpr int NB_SHARDS = 64;
+
+/* ------------------------------------------------------------------ */
+/* schedulers                                                          */
+/* ------------------------------------------------------------------ */
+
+struct Scheduler {
+  virtual ~Scheduler() {}
+  virtual void install(int nb_workers) = 0;
+  virtual void schedule(int worker, ptc_task *t) = 0;
+  virtual ptc_task *select(int worker) = 0;
+};
+
+/* registered by name; see sched table in core.cpp */
+Scheduler *ptc_sched_create(const std::string &name);
+
+/* ------------------------------------------------------------------ */
+/* device queues, profiling                                            */
+/* ------------------------------------------------------------------ */
+
+struct DeviceQueue {
+  std::mutex lock;
+  std::condition_variable cv;
+  std::deque<ptc_task *> dq;
+};
+
+struct ProfBuf {
+  std::mutex lock;
+  std::vector<int64_t> words; /* 5 words per event */
+};
+
+enum { PROF_KEY_EXEC = 0 };
+
+/* ------------------------------------------------------------------ */
+/* taskpool + context                                                  */
+/* ------------------------------------------------------------------ */
+
+struct CommEngine; /* defined in comm.cpp */
+
+struct ptc_taskpool {
+  ptc_context *ctx = nullptr;
+  int32_t id = -1; /* distributed taskpool id (SPMD creation order) */
+  std::vector<int64_t> globals;
+  std::vector<TaskClass> classes;
+  std::atomic<int64_t> nb_tasks{0};  /* remaining local tasks */
+  std::atomic<int64_t> nb_total{0};  /* counted at startup */
+  std::atomic<int64_t> nb_errors{0}; /* failed/dropped tasks */
+  std::atomic<bool> open{false};     /* DTD: dynamic insertion */
+  std::atomic<bool> completed{false};
+  std::atomic<bool> added{false};
+  DepShard shards[NB_SHARDS];
+  std::mutex done_lock;
+  std::condition_variable done_cv;
+  /* DTD insertion-window throttle */
+  std::mutex window_lock;
+  std::condition_variable window_cv;
+  /* DTD distributed: insertion sequence counter + remote completions that
+   * arrived before their shadow task was inserted (seq → payload frame) */
+  std::atomic<uint64_t> dtd_seq{0};
+  std::mutex dtd_lock;
+  std::unordered_map<uint64_t, ptc_task *> dtd_shadows; /* seq → waiting */
+  std::unordered_map<uint64_t, std::vector<uint8_t>> dtd_early;
+};
+
+struct ptc_context {
+  int nb_workers = 1;
+  std::vector<std::thread> workers;
+  std::atomic<bool> started{false};
+  std::atomic<bool> shutdown{false};
+  Scheduler *sched = nullptr;
+  std::string sched_name = "lfq";
+
+  /* idle-worker parking */
+  std::mutex idle_lock;
+  std::condition_variable idle_cv;
+  std::atomic<int64_t> work_signal{0};
+
+  /* registries */
+  std::vector<ExprCb> expr_cbs;
+  std::vector<BodyCb> body_cbs;
+  std::vector<Collection *> collections;
+  std::vector<Arena *> arenas;
+  std::vector<DeviceQueue *> dev_queues;
+  std::mutex reg_lock;
+
+  uint32_t myrank = 0, nodes = 1;
+
+  /* active taskpools */
+  std::atomic<int64_t> active_tps{0};
+  std::mutex wait_lock;
+  std::condition_variable wait_cv;
+
+  /* distributed taskpool registry (id → pool) + parked early activations */
+  std::mutex tp_reg_lock;
+  int32_t next_tp_id = 0;
+  std::unordered_map<int32_t, ptc_taskpool *> tp_registry;
+  std::unordered_map<int32_t, std::vector<std::vector<uint8_t>>> tp_early;
+
+  /* task freelist (mempool stand-in; reference parsec/mempool.c) */
+  std::mutex free_lock;
+  ptc_task *free_list = nullptr;
+
+  /* device-layer hook: copy with handle released */
+  ptc_copy_release_cb copy_release_cb = nullptr;
+  void *copy_release_user = nullptr;
+
+  /* profiling */
+  std::atomic<bool> prof_enabled{false};
+  std::vector<ProfBuf *> prof;
+
+  /* communication engine (nullptr when single-process) */
+  CommEngine *comm = nullptr;
+
+  ~ptc_context();
+};
+
+/* ------------------------------------------------------------------ */
+/* runtime internals shared across translation units                   */
+/* ------------------------------------------------------------------ */
+
+int64_t ptc_now_ns();
+
+int64_t ptc_eval_expr(const Expr &e, ptc_context *ctx, const int64_t *locals,
+                      int nb_locals, const int64_t *globals,
+                      int64_t empty_value = 0);
+
+void ptc_copy_retain(ptc_copy *c);
+void ptc_copy_release_internal(ptc_context *ctx, ptc_copy *c);
+
+ptc_data *ptc_collection_data_of(ptc_context *ctx, int32_t dc_id,
+                                 const int64_t *idx, int32_t n);
+uint32_t ptc_collection_rank_of(ptc_context *ctx, int32_t dc_id,
+                                const int64_t *idx, int32_t n);
+
+/* schedule a ready task (wakes idle workers) */
+void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t);
+
+/* deliver one dependency release to a local successor instance (the
+ * incoming half of the remote ACTIVATE path calls this) */
+void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
+                           int32_t class_id, std::vector<int64_t> &&params,
+                           int32_t flow_idx, ptc_copy *copy);
+
+/* DTD: complete a shadow task whose remote original finished; `payload`
+ * holds the serialized written-tile contents (comm.cpp framing:
+ * [u32 flow][u64 len][bytes]*) */
+void ptc_dtd_shadow_ready(ptc_context *ctx, ptc_taskpool *tp, uint64_t seq,
+                          const uint8_t *payload, size_t len);
+/* apply a completion payload to a known shadow task + drop its message hold */
+void ptc_dtd_apply_complete(ptc_context *ctx, ptc_task *t,
+                            const uint8_t *payload, size_t len);
+
+/* ------------------------------------------------------------------ */
+/* comm engine hooks (implemented in comm.cpp; safe no-ops when
+ * ctx->comm == nullptr)                                               */
+/* ------------------------------------------------------------------ */
+
+/* outgoing PTG activation: deliver (class_id, params, flow, copy bytes) to
+ * `rank`'s matching taskpool */
+void ptc_comm_send_activate(ptc_context *ctx, uint32_t rank, ptc_taskpool *tp,
+                            int32_t class_id,
+                            const std::vector<int64_t> &params,
+                            int32_t flow_idx, ptc_copy *copy);
+
+/* batched form: several successor instances sharing one payload copy
+ * (reference: per-rank output bitmaps, parsec/remote_dep.h:143-177) */
+void ptc_comm_send_activate_batch(
+    ptc_context *ctx, uint32_t rank, ptc_taskpool *tp, int32_t flow_idx,
+    ptc_copy *copy,
+    const std::vector<std::pair<int32_t, std::vector<int64_t>>> &targets);
+
+/* replay activations that arrived before `tp` was registered locally */
+void ptc_comm_drain_early(ptc_context *ctx, ptc_taskpool *tp);
+
+/* stop the comm thread + close sockets (idempotent; no-op if never up) */
+void ptc_comm_shutdown(ptc_context *ctx);
+
+/* outgoing memory write-back to a collection datum owned by `rank` */
+void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
+                           const int64_t *idx, int32_t nidx, ptc_copy *copy);
+
+/* outgoing DTD completion broadcast (real task finished; shadows on every
+ * other rank release their successors + apply written-tile payloads) */
+void ptc_comm_send_dtd_complete(ptc_context *ctx, ptc_taskpool *tp,
+                                ptc_task *t);
+
+#endif /* PTC_RUNTIME_INTERNAL_H */

@@ -1,0 +1,261 @@
+/* sched.cpp — pluggable ready-task schedulers.
+ *
+ * Reference: parsec/mca/sched — 10 modules selected by priority with a
+ * common install/schedule/select vtable (parsec/mca/sched/sched.h:265-340,
+ * SURVEY.md §2.4).  Same menu idea here, selected by name via
+ * ptc_context_set_scheduler:
+ *
+ *   lfq  per-worker deque, LIFO local pop, FIFO steal    (default; ref lfq)
+ *   ll   per-worker LIFO + LIFO steal                    (ref ll)
+ *   ltq  per-worker priority heap + steal                (ref ltq maxheap)
+ *   pbq  per-worker FIFO "NUMA" queues + steal           (ref pbq, flat)
+ *   gd   global dequeue                                  (ref gd)
+ *   ap   global absolute-priority heap                   (ref ap)
+ *   spq  global priority queue, FIFO within priority     (ref spq)
+ *   ip   global LIFO (inverse priority / newest first)   (ref ip)
+ *   rnd  global pool, random pick                        (ref rnd)
+ */
+
+#include "runtime_internal.h"
+
+#include <algorithm>
+#include <random>
+
+namespace {
+
+/* ---------------- per-worker family ---------------- */
+
+/* lfq: per-worker deques, LIFO local pop for cache warmth, FIFO steals.
+ * (Reference: mca/sched/lfq local flat queues + hbbuffer hierarchy.) */
+struct SchedLFQ : Scheduler {
+  struct Q {
+    std::mutex lock;
+    std::deque<ptc_task *> dq;
+  };
+  std::vector<Q> qs;
+  void install(int n) override { qs = std::vector<Q>((size_t)std::max(1, n)); }
+  void schedule(int w, ptc_task *t) override {
+    Q &q = qs[(size_t)(w % (int)qs.size())];
+    std::lock_guard<std::mutex> g(q.lock);
+    q.dq.push_back(t);
+  }
+  ptc_task *select(int w) override {
+    int n = (int)qs.size();
+    {
+      Q &q = qs[(size_t)(w % n)];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.dq.empty()) {
+        ptc_task *t = q.dq.back();
+        q.dq.pop_back();
+        return t;
+      }
+    }
+    for (int i = 1; i < n; i++) { /* steal oldest from victims */
+      Q &q = qs[(size_t)((w + i) % n)];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.dq.empty()) {
+        ptc_task *t = q.dq.front();
+        q.dq.pop_front();
+        return t;
+      }
+    }
+    return nullptr;
+  }
+};
+
+/* ll: local LIFO; steals also LIFO (newest) — reference
+ * parsec/mca/sched/ll/sched_ll_module.c:129-171 */
+struct SchedLL : SchedLFQ {
+  ptc_task *select(int w) override {
+    int n = (int)qs.size();
+    for (int i = 0; i < n; i++) {
+      Q &q = qs[(size_t)((w + i) % n)];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.dq.empty()) {
+        ptc_task *t = q.dq.back();
+        q.dq.pop_back();
+        return t;
+      }
+    }
+    return nullptr;
+  }
+};
+
+/* ltq: per-worker priority heaps + steal (reference ltq local tree queues
+ * with maxheap, parsec/maxheap.c) */
+struct SchedLTQ : Scheduler {
+  struct Cmp {
+    bool operator()(ptc_task *a, ptc_task *b) const {
+      return a->priority < b->priority;
+    }
+  };
+  struct Q {
+    std::mutex lock;
+    std::vector<ptc_task *> heap;
+  };
+  std::vector<Q> qs;
+  void install(int n) override { qs = std::vector<Q>((size_t)std::max(1, n)); }
+  void schedule(int w, ptc_task *t) override {
+    Q &q = qs[(size_t)(w % (int)qs.size())];
+    std::lock_guard<std::mutex> g(q.lock);
+    q.heap.push_back(t);
+    std::push_heap(q.heap.begin(), q.heap.end(), Cmp{});
+  }
+  ptc_task *select(int w) override {
+    int n = (int)qs.size();
+    for (int i = 0; i < n; i++) {
+      Q &q = qs[(size_t)((w + i) % n)];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.heap.empty()) {
+        std::pop_heap(q.heap.begin(), q.heap.end(), Cmp{});
+        ptc_task *t = q.heap.back();
+        q.heap.pop_back();
+        return t;
+      }
+    }
+    return nullptr;
+  }
+};
+
+/* pbq: per-worker FIFO queues, FIFO steal (reference pbq NUMA queues,
+ * flattened: one queue per worker = one "NUMA node" per worker) */
+struct SchedPBQ : SchedLFQ {
+  ptc_task *select(int w) override {
+    int n = (int)qs.size();
+    for (int i = 0; i < n; i++) {
+      Q &q = qs[(size_t)((w + i) % n)];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.dq.empty()) {
+        ptc_task *t = q.dq.front();
+        q.dq.pop_front();
+        return t;
+      }
+    }
+    return nullptr;
+  }
+};
+
+/* ---------------- global family ---------------- */
+
+/* gd: one global dequeue (reference: mca/sched/gd) */
+struct SchedGD : Scheduler {
+  std::mutex lock;
+  std::deque<ptc_task *> dq;
+  void install(int) override {}
+  void schedule(int, ptc_task *t) override {
+    std::lock_guard<std::mutex> g(lock);
+    dq.push_back(t);
+  }
+  ptc_task *select(int) override {
+    std::lock_guard<std::mutex> g(lock);
+    if (dq.empty()) return nullptr;
+    ptc_task *t = dq.front();
+    dq.pop_front();
+    return t;
+  }
+};
+
+/* ip: global LIFO — newest first (reference: mca/sched/ip) */
+struct SchedIP : SchedGD {
+  ptc_task *select(int) override {
+    std::lock_guard<std::mutex> g(lock);
+    if (dq.empty()) return nullptr;
+    ptc_task *t = dq.back();
+    dq.pop_back();
+    return t;
+  }
+};
+
+/* ap: global absolute-priority ordering (reference: mca/sched/ap) */
+struct SchedAP : Scheduler {
+  struct Cmp {
+    bool operator()(ptc_task *a, ptc_task *b) const {
+      return a->priority < b->priority;
+    }
+  };
+  std::mutex lock;
+  std::vector<ptc_task *> heap;
+  void install(int) override {}
+  void schedule(int, ptc_task *t) override {
+    std::lock_guard<std::mutex> g(lock);
+    heap.push_back(t);
+    std::push_heap(heap.begin(), heap.end(), Cmp{});
+  }
+  ptc_task *select(int) override {
+    std::lock_guard<std::mutex> g(lock);
+    if (heap.empty()) return nullptr;
+    std::pop_heap(heap.begin(), heap.end(), Cmp{});
+    ptc_task *t = heap.back();
+    heap.pop_back();
+    return t;
+  }
+};
+
+/* spq: global priority queue, FIFO within equal priority (reference: spq).
+ * Stable tie-break via an insertion counter. */
+struct SchedSPQ : Scheduler {
+  struct Item {
+    ptc_task *t;
+    uint64_t seq;
+  };
+  struct Cmp {
+    bool operator()(const Item &a, const Item &b) const {
+      if (a.t->priority != b.t->priority)
+        return a.t->priority < b.t->priority;
+      return a.seq > b.seq; /* older first */
+    }
+  };
+  std::mutex lock;
+  std::vector<Item> heap;
+  uint64_t next_seq = 0;
+  void install(int) override {}
+  void schedule(int, ptc_task *t) override {
+    std::lock_guard<std::mutex> g(lock);
+    heap.push_back({t, next_seq++});
+    std::push_heap(heap.begin(), heap.end(), Cmp{});
+  }
+  ptc_task *select(int) override {
+    std::lock_guard<std::mutex> g(lock);
+    if (heap.empty()) return nullptr;
+    std::pop_heap(heap.begin(), heap.end(), Cmp{});
+    ptc_task *t = heap.back().t;
+    heap.pop_back();
+    return t;
+  }
+};
+
+/* rnd: global pool, uniformly random pick (reference: mca/sched/rnd —
+ * a scheduler-fairness fuzzer more than a production choice) */
+struct SchedRND : Scheduler {
+  std::mutex lock;
+  std::vector<ptc_task *> pool;
+  std::mt19937 rng{0x9e3779b9u};
+  void install(int) override {}
+  void schedule(int, ptc_task *t) override {
+    std::lock_guard<std::mutex> g(lock);
+    pool.push_back(t);
+  }
+  ptc_task *select(int) override {
+    std::lock_guard<std::mutex> g(lock);
+    if (pool.empty()) return nullptr;
+    size_t i = rng() % pool.size();
+    ptc_task *t = pool[i];
+    pool[i] = pool.back();
+    pool.pop_back();
+    return t;
+  }
+};
+
+} // namespace
+
+Scheduler *ptc_sched_create(const std::string &name) {
+  if (name == "gd") return new SchedGD();
+  if (name == "ap") return new SchedAP();
+  if (name == "ll") return new SchedLL();
+  if (name == "ltq") return new SchedLTQ();
+  if (name == "pbq" || name == "lhq") return new SchedPBQ();
+  if (name == "ip") return new SchedIP();
+  if (name == "spq") return new SchedSPQ();
+  if (name == "rnd") return new SchedRND();
+  return new SchedLFQ(); /* default, also "lfq" */
+}

@@ -14,7 +14,10 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(_REPO, "build", "libparsec_core.so")
 _SOURCES = [
     os.path.join(_REPO, "native", "core.cpp"),
+    os.path.join(_REPO, "native", "sched.cpp"),
+    os.path.join(_REPO, "native", "comm.cpp"),
     os.path.join(_REPO, "native", "parsec_core.h"),
+    os.path.join(_REPO, "native", "runtime_internal.h"),
 ]
 
 # hook protocol (parsec_core.h)
@@ -147,6 +150,14 @@ _sigs = {
     "ptc_task_get_tag": (C.c_int64, [C.c_void_p]),
     "ptc_profile_enable": (None, [C.c_void_p, C.c_int32]),
     "ptc_profile_take": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
+    "ptc_comm_init": (C.c_int32, [C.c_void_p, C.c_int32]),
+    "ptc_comm_fence": (C.c_int32, [C.c_void_p]),
+    "ptc_comm_fini": (C.c_int32, [C.c_void_p]),
+    "ptc_comm_enabled": (C.c_int32, [C.c_void_p]),
+    "ptc_comm_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
+    "ptc_tp_id": (C.c_int32, [C.c_void_p]),
+    "ptc_dtile_set_owner": (None, [C.c_void_p, C.c_uint32]),
+    "ptc_dtask_set_rank": (None, [C.c_void_p, C.c_int32]),
 }
 
 for _name, (_res, _args) in _sigs.items():

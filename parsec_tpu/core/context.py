@@ -42,6 +42,7 @@ class Data:
 class Context:
     def __init__(self, nb_workers: int = 0, scheduler: str = "lfq"):
         self._ptr = N.lib.ptc_context_new(nb_workers)
+        self.myrank, self.nodes = 0, 1
         if scheduler != "lfq":
             N.lib.ptc_context_set_scheduler(self._ptr, scheduler.encode())
         # keep-alives: ctypes callbacks must outlive the native context
@@ -88,7 +89,37 @@ class Context:
         return N.lib.ptc_context_nb_workers(self._ptr)
 
     def set_rank(self, myrank: int, nodes: int):
+        self.myrank, self.nodes = myrank, nodes
         N.lib.ptc_context_set_rank(self._ptr, myrank, nodes)
+
+    # ------------------------------------------------------------ comm (L4)
+    def comm_init(self, base_port: int = 29650):
+        """Bring up the distributed control plane: a full-mesh loopback/DCN
+        TCP transport carrying dependency activations, memory write-backs,
+        DTD completion broadcasts and fences (reference: the MPI-funnelled
+        comm engine + remote_dep protocol, parsec/parsec_comm_engine.h,
+        parsec/remote_dep.c — SURVEY.md §2.5).  Call set_rank first;
+        blocks until all ranks are connected."""
+        if N.lib.ptc_comm_init(self._ptr, base_port) != 0:
+            raise RuntimeError("comm engine init failed")
+
+    def comm_fence(self):
+        """Flush + all-to-all fence: on return, every message sent by any
+        rank before its fence has been applied everywhere."""
+        N.lib.ptc_comm_fence(self._ptr)
+
+    def comm_fini(self):
+        N.lib.ptc_comm_fini(self._ptr)
+
+    @property
+    def comm_enabled(self) -> bool:
+        return bool(N.lib.ptc_comm_enabled(self._ptr))
+
+    def comm_stats(self) -> dict:
+        buf = (C.c_int64 * 4)()
+        N.lib.ptc_comm_stats(self._ptr, buf)
+        return {"msgs_sent": buf[0], "msgs_recv": buf[1],
+                "bytes_sent": buf[2], "bytes_recv": buf[3]}
 
     # ------------------------------------------------------------ registries
     def register_expr_cb(self, fn: Callable) -> int:

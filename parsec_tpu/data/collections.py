@@ -74,16 +74,19 @@ class TwoDimBlockCyclic(Collection):
         return rows, cols
 
     def tile(self, m: int, n: int) -> np.ndarray:
-        """The local tile array (allocating on first touch)."""
+        """The local tile array (allocating on first touch).  Remote tiles
+        get local mirror buffers in distributed mode (DTD shadow copies /
+        staging); in single-rank mode a remote touch is a bug."""
         key = (m, n)
         t = self._tiles.get(key)
         if t is None:
-            if self.rank_of(m, n) != self.myrank:
+            local = self.rank_of(m, n) == self.myrank
+            if not local and self.nodes == 1:
                 raise KeyError(f"tile {key} is remote (rank {self.rank_of(m, n)})")
             # full mb×nb allocation (simplifies device staging); logical
             # shape may be smaller on boundary tiles
             t = np.zeros((self.mb, self.nb), dtype=self.dtype)
-            if self._init is not None:
+            if local and self._init is not None:
                 rows, cols = self.tile_shape(m, n)
                 t[:rows, :cols] = self._init(self, m, n)[:rows, :cols]
             self._tiles[key] = t

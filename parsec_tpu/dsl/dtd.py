@@ -56,13 +56,18 @@ class DtdView:
 
 
 class DtdTile:
-    """Handle to a tracked datum (reference: parsec_dtd_tile_of)."""
+    """Handle to a tracked datum (reference: parsec_dtd_tile_of).  `owner`
+    is the rank that executes tasks writing this tile (distributed DTD
+    placement; other ranks keep shadow tasks + mirror copies)."""
 
-    __slots__ = ("_ptr", "data")
+    __slots__ = ("_ptr", "data", "owner")
 
-    def __init__(self, ctx: Context, data: Data):
+    def __init__(self, ctx: Context, data: Data, owner: int = 0):
         self.data = data
+        self.owner = owner
         self._ptr = N.lib.ptc_dtile_new(ctx._ptr, data._ptr)
+        if owner:
+            N.lib.ptc_dtile_set_owner(self._ptr, owner)
 
 
 class DtdTaskpool:
@@ -77,17 +82,20 @@ class DtdTaskpool:
         self._closed = False
 
     # ------------------------------------------------------------- tiles
-    def tile_of(self, source, *key) -> DtdTile:
-        """Tile for a Data object or a (collection, key...) pair."""
+    def tile_of(self, source, *key, owner: Optional[int] = None) -> DtdTile:
+        """Tile for a Data object or a (collection, key...) pair.  The
+        owning rank defaults to the collection's rank_of (Data objects
+        default to rank 0 unless `owner=` is given)."""
         if isinstance(source, Data):
             k = (id(source), None)
             if k not in self._tiles:
-                self._tiles[k] = DtdTile(self.ctx, source)
+                self._tiles[k] = DtdTile(self.ctx, source, owner or 0)
             return self._tiles[k]
         k = (id(source), key)
         if k not in self._tiles:
             d = source.data_of(*key)
-            self._tiles[k] = DtdTile(self.ctx, d)
+            own = owner if owner is not None else source.rank_of(*key)
+            self._tiles[k] = DtdTile(self.ctx, d, own)
         return self._tiles[k]
 
     # ------------------------------------------------------------- insert
@@ -108,10 +116,14 @@ class DtdTaskpool:
             self._body_ids[fn] = bid
         return bid
 
-    def insert_task(self, fn: Callable, *args, priority: int = 0):
+    def insert_task(self, fn: Callable, *args, priority: int = 0,
+                    rank: Optional[int] = None):
         """insert_task(body, (tile, "INPUT"), (tile2, "INOUT"), ...).
 
-        body(view) runs on a worker; view.data(i) is the i-th argument."""
+        body(view) runs on a worker; view.data(i) is the i-th argument.
+        In distributed mode every rank inserts the same stream; the task
+        executes on `rank` (default: first OUTPUT tile's owner) and other
+        ranks keep a shadow released by the owner's completion broadcast."""
         if self._closed:
             raise RuntimeError("taskpool already closed")
         bid = self._body_id(fn)
@@ -121,6 +133,8 @@ class DtdTaskpool:
             if N.lib.ptc_dtask_arg(t, tile._ptr, m) < 0:
                 raise ValueError(
                     "insert_task: too many arguments (max 20)")
+        if rank is not None:
+            N.lib.ptc_dtask_set_rank(t, rank)
         if N.lib.ptc_dtask_submit(self.ctx._ptr, t, self.window) != 0:
             raise RuntimeError("taskpool aborted: insertion refused")
         return t

@@ -1,0 +1,191 @@
+"""Per-rank worker programs for the multi-rank comm-engine tests.
+
+Each worker runs the same SPMD program on its rank (the reference tests
+multi-node exactly this way: multiple ranks on one host over a real
+transport, SURVEY.md §4 — mpirun there, loopback TCP here).  Workers
+assert internally and push ("ok", rank) / ("err", rank, traceback) onto a
+multiprocessing queue.
+"""
+from __future__ import annotations
+
+import traceback
+
+import numpy as np
+
+
+def _mk_ctx(rank: int, nodes: int, port: int, nb_workers: int = 2,
+            scheduler: str = "lfq"):
+    import parsec_tpu as pt
+
+    ctx = pt.Context(nb_workers=nb_workers, scheduler=scheduler)
+    ctx.set_rank(rank, nodes)
+    ctx.comm_init(port)
+    return pt, ctx
+
+
+def run(worker_fn, rank, nodes, port, q, **kw):
+    try:
+        worker_fn(rank, nodes, port, **kw)
+        q.put(("ok", rank))
+    except Exception:
+        q.put(("err", rank, traceback.format_exc()))
+
+
+def ptg_chain(rank: int, nodes: int, port: int, nb: int = 32):
+    """Ex04-style RW chain where consecutive tasks live on different ranks:
+    Task(k) runs on rank k%nodes; the datum hops rank-to-rank via remote
+    ACTIVATE; the last task writes back to A(0) (a remote PUT when
+    nb % nodes != 0)."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        arr = np.zeros(nodes, dtype=np.int64)  # element r owned by rank r
+        ctx.register_linear_collection("A", arr, elem_size=8, nodes=nodes,
+                                       myrank=rank)
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+                pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                arena="t")
+
+        def body(view):
+            view.data("A", dtype=np.int64)[0] += 1
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        mine = sum(1 for i in range(nb + 1) if i % nodes == rank)
+        assert tp.nb_total_tasks == mine, (tp.nb_total_tasks, mine)
+        if rank == 0:
+            assert arr[0] == nb + 1, arr
+        stats = ctx.comm_stats()
+        assert stats["msgs_sent"] > 0
+        ctx.comm_fini()
+
+
+def ptg_broadcast(rank: int, nodes: int, port: int, nt: int = 12):
+    """Ex05-style broadcast: Root (rank 0) produces a value; Recv(k) for
+    k=0..nt-1 runs on rank k%nodes and stores the value into its local
+    element.  One ACTIVATE per rank carries the payload (batched
+    targets)."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        arr = np.zeros(nt, dtype=np.int64)
+        ctx.register_linear_collection("V", arr, elem_size=8, nodes=nodes,
+                                       myrank=rank)
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NT": nt})
+        k = pt.L("k")
+        root = tp.task_class("Root")
+        root.affinity("V", 0)
+        recv = tp.task_class("Recv")
+        recv.param("k", 0, pt.G("NT") - 1)
+        recv.affinity("V", k)
+
+        def root_body(view):
+            view.data("X", dtype=np.int64)[0] = 42
+
+        root.flow("X", "W",
+                  pt.Out(pt.Ref("Recv", pt.Range(0, pt.G("NT") - 1),
+                                flow="X")),
+                  arena="t")
+        root.body(root_body)
+
+        def recv_body(view):
+            assert view.data("X", dtype=np.int64)[0] == 42
+            view.data("Y", dtype=np.int64)[0] = 42 + view["k"]
+
+        recv.flow("X", "R", pt.In(pt.Ref("Root", flow="X")), arena="t")
+        recv.flow("Y", "W", pt.Out(pt.Mem("V", k)), arena="t")
+        recv.body(recv_body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        for i in range(nt):
+            if i % nodes == rank:
+                assert arr[i] == 42 + i, (i, arr)
+        ctx.comm_fini()
+
+
+def dtd_chain(rank: int, nodes: int, port: int, nb_tiles: int = 4,
+              rounds: int = 6):
+    """Distributed DTD: every rank inserts the same stream; task r writes
+    tile t (owner t%nodes) reading tile t-1 — a wavefront crossing ranks.
+    Shadows release via the owner's completion broadcast."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.dsl.dtd import DtdTaskpool
+
+    with ctx:
+        datas = [ctx.data(i, np.zeros(4, dtype=np.int64))
+                 for i in range(nb_tiles)]
+        dtp = DtdTaskpool(ctx, window=64)
+        tiles = [dtp.tile_of(d, owner=i % nodes)
+                 for i, d in enumerate(datas)]
+
+        def step(view):
+            src = view.data(0, dtype=np.int64)
+            dst = view.data(1, dtype=np.int64)
+            dst[0] = src[0] + 1
+
+        # wavefront: each round bumps every tile to prev tile's value + 1
+        for _ in range(rounds):
+            for t in range(1, nb_tiles):
+                dtp.insert_task(step, (tiles[t - 1], "INPUT"),
+                                (tiles[t], "INOUT"))
+        dtp.wait()
+        ctx.comm_fence()
+        # tile k's final value: after each round tile k = tile[k-1]+1 at
+        # time of execution; sequentially that converges to k per round
+        # count >= nb_tiles; with rounds >= nb_tiles, tile k == k.
+        for i, d in enumerate(datas):
+            if i % nodes == rank and rounds >= nb_tiles:
+                v = np.frombuffer(d.array, dtype=np.int64)[0]
+                assert v == i, (i, v, d.array)
+        dtp.destroy()
+        ctx.comm_fini()
+
+
+def ptg_block_cyclic_scale(rank: int, nodes: int, port: int, mt: int = 4,
+                           nt: int = 4):
+    """Owner-computes over a 2D block-cyclic collection: Scale(m,n) doubles
+    its tile in place on the owning rank; pure local compute, validates
+    affinity enumeration + collection vtables across ranks."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    with ctx:
+        P = 2 if nodes % 2 == 0 else 1
+        Q = nodes // P
+        A = TwoDimBlockCyclic(M=mt * 8, N=nt * 8, mb=8, nb=8, P=P, Q=Q,
+                              nodes=nodes, myrank=rank, dtype=np.float32,
+                              init=lambda c, m, n: np.full((8, 8), m + n + 1,
+                                                           np.float32))
+        A.register(ctx, "A")
+        tp = pt.Taskpool(ctx, globals={"MT": mt - 1, "NT": nt - 1})
+        m, n = pt.L("m"), pt.L("n")
+        tc = tp.task_class("Scale")
+        tc.param("m", 0, pt.G("MT")).param("n", 0, pt.G("NT"))
+        tc.affinity("A", m, n)
+        tc.flow("A", "RW", pt.In(pt.Mem("A", m, n)),
+                pt.Out(pt.Mem("A", m, n)))
+
+        def body(view):
+            view.data("A", dtype=np.float32)[:] *= 2.0
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        for mm in range(mt):
+            for nn in range(nt):
+                if A.rank_of(mm, nn) == rank:
+                    np.testing.assert_allclose(A.tile(mm, nn),
+                                               2.0 * (mm + nn + 1))
+        ctx.comm_fini()

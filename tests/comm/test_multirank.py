@@ -1,0 +1,79 @@
+"""Multi-rank comm-engine tests: N SPMD processes over loopback TCP.
+
+Mirrors the reference's test strategy (SURVEY.md §4): multi-node is tested
+as multi-rank on one host over the real transport — mpirun there, the
+native comm engine's loopback full mesh here.
+"""
+import multiprocessing as mp
+import socket
+
+import pytest
+
+from . import _workers
+
+
+def _pick_base_port(n: int) -> int:
+    """Find a base port with n consecutive free ports."""
+    import random
+
+    for _ in range(64):
+        base = random.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def _run_spmd(worker, nodes: int, timeout: float = 90.0, **kw):
+    port = _pick_base_port(nodes)
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    procs = [
+        mpctx.Process(target=_workers.run,
+                      args=(worker, r, nodes, port, q), kwargs=kw)
+        for r in range(nodes)
+    ]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nodes):
+            results.append(q.get(timeout=timeout))
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in results if r[0] != "ok"]
+    assert not errs, "\n".join(str(e) for e in errs)
+
+
+def test_ptg_chain_2ranks():
+    _run_spmd(_workers.ptg_chain, 2, nb=33)
+
+
+def test_ptg_chain_4ranks():
+    _run_spmd(_workers.ptg_chain, 4, nb=40)
+
+
+def test_ptg_broadcast_4ranks():
+    _run_spmd(_workers.ptg_broadcast, 4, nt=12)
+
+
+def test_dtd_chain_2ranks():
+    _run_spmd(_workers.dtd_chain, 2, nb_tiles=4, rounds=6)
+
+
+@pytest.mark.parametrize("nodes", [2, 4])
+def test_ptg_block_cyclic_scale(nodes):
+    _run_spmd(_workers.ptg_block_cyclic_scale, nodes)
