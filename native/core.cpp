@@ -124,6 +124,8 @@ int64_t ptc_eval_expr(const Expr &e, ptc_context *ctx, const int64_t *locals,
       stack[sp++] = cnd ? a : b;
       break;
     }
+    case PTC_OP_SHL: sp--; stack[sp - 1] = (int64_t)((uint64_t)stack[sp - 1] << std::min<int64_t>(std::max<int64_t>(stack[sp], 0), 62)); break;
+    case PTC_OP_SHR: sp--; stack[sp - 1] = stack[sp - 1] >> std::min<int64_t>(std::max<int64_t>(stack[sp], 0), 62); break; /* arithmetic on gcc/clang */
     case PTC_OP_MIN: sp--; stack[sp - 1] = std::min(stack[sp - 1], stack[sp]); break;
     case PTC_OP_MAX: sp--; stack[sp - 1] = std::max(stack[sp - 1], stack[sp]); break;
     case PTC_OP_CALL: {

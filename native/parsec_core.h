@@ -93,7 +93,9 @@ enum {
   PTC_OP_SELECT = 19,  /* pop b, a, c; push c ? a : b                   */
   PTC_OP_MIN    = 20,
   PTC_OP_MAX    = 21,
-  PTC_OP_CALL   = 22   /* push expr-callback(operand)(locals, globals)  */
+  PTC_OP_CALL   = 22,  /* push expr-callback(operand)(locals, globals)  */
+  PTC_OP_SHL    = 23,  /* pop b, a; push a << b (b clamped to [0,62])   */
+  PTC_OP_SHR    = 24   /* pop b, a; push a >> b (arithmetic)            */
 };
 
 /* ------------------------------------------------------- opaque types */

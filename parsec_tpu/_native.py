@@ -64,6 +64,8 @@ OP_SELECT = 19
 OP_MIN = 20
 OP_MAX = 21
 OP_CALL = 22
+OP_SHL = 23
+OP_SHR = 24
 
 
 def _needs_build() -> bool:

@@ -1,10 +1,12 @@
 from .context import Context, Data
-from .expr import G, L, Range, call, compile_expr, maximum, minimum, select
+from .expr import (G, L, Range, call, compile_expr, maximum, minimum, select,
+                   shl, shr)
 from .taskclass import In, Mem, Out, Ref, TaskClass, TaskView
 from .taskpool import Taskpool
 
 __all__ = [
     "Context", "Data", "Taskpool", "TaskClass", "TaskView",
     "In", "Out", "Mem", "Ref",
-    "L", "G", "Range", "select", "call", "minimum", "maximum", "compile_expr",
+    "L", "G", "Range", "select", "call", "minimum", "maximum", "shl", "shr",
+    "compile_expr",
 ]

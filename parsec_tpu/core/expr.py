@@ -137,6 +137,16 @@ def maximum(a: ExprLike, b: ExprLike) -> Expr:
     return BinOp(N.OP_MAX, a, b)
 
 
+def shl(a: ExprLike, b: ExprLike) -> Expr:
+    """a << b (used for power-of-two tree-reduction index math)."""
+    return BinOp(N.OP_SHL, a, b)
+
+
+def shr(a: ExprLike, b: ExprLike) -> Expr:
+    """a >> b (arithmetic)."""
+    return BinOp(N.OP_SHR, a, b)
+
+
 class Call(Expr):
     """Escape hatch: evaluate a Python callable(locals_dict, globals_dict).
 
