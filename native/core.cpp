@@ -693,6 +693,9 @@ static inline void schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
 static void tp_mark_complete(ptc_context *ctx, ptc_taskpool *tp) {
   bool expected = false;
   if (!tp->completed.compare_exchange_strong(expected, true)) return;
+  /* composition callback first: if it adds a follow-up taskpool, active_tps
+   * never hits 0 between the pools and ptc_context_wait stays blocked */
+  if (tp->complete_cb) tp->complete_cb(tp->complete_user, tp);
   {
     std::lock_guard<std::mutex> g(tp->done_lock);
   }
@@ -1285,6 +1288,7 @@ int32_t ptc_tp_wait(ptc_taskpool_t *tp) {
 
 int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp) { return tp->nb_tasks.load(); }
 int64_t ptc_tp_nb_total_tasks(ptc_taskpool_t *tp) { return tp->nb_total.load(); }
+int64_t ptc_tp_nb_errors(ptc_taskpool_t *tp) { return tp->nb_errors.load(); }
 
 void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open) {
   tp->open.store(open != 0, std::memory_order_seq_cst);
@@ -1293,6 +1297,12 @@ void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open) {
   if (!open && tp->added.load(std::memory_order_acquire) &&
       tp->nb_tasks.load(std::memory_order_seq_cst) == 0)
     tp_mark_complete(tp->ctx, tp);
+}
+
+void ptc_tp_set_on_complete(ptc_taskpool_t *tp, ptc_tp_complete_cb cb,
+                            void *user) {
+  tp->complete_cb = cb;
+  tp->complete_user = user;
 }
 
 int64_t ptc_tp_global(ptc_taskpool_t *tp, int32_t i) {
@@ -1390,6 +1400,14 @@ ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) 
 
 void ptc_task_complete(ptc_context_t *ctx, ptc_task_t *task) {
   complete_task(ctx, -1, task);
+}
+
+void ptc_task_fail(ptc_context_t *ctx, ptc_task_t *task) {
+  std::fprintf(stderr, "ptc: async task failed; aborting taskpool\n");
+  if (task->dyn)
+    dyn_fail_task(ctx, task);
+  else
+    fail_task(ctx, task);
 }
 
 /* ------------------------------------------------------------ DTD API */

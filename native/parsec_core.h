@@ -157,9 +157,21 @@ int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp);
 int32_t ptc_tp_wait(ptc_taskpool_t *tp);
 int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp);       /* remaining local tasks */
 int64_t ptc_tp_nb_total_tasks(ptc_taskpool_t *tp); /* as counted at startup */
+int64_t ptc_tp_nb_errors(ptc_taskpool_t *tp);      /* failed/dropped tasks  */
 /* keep a taskpool alive for dynamic insertion (DTD): while open, reaching
  * zero remaining tasks does not complete it */
 void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open);
+
+/* Completion callback, fired exactly once when the taskpool completes —
+ * BEFORE the context's active-pool count drops, so a callback that adds a
+ * follow-up taskpool keeps ptc_context_wait blocked across the seam.  This
+ * is the sequential-composition seam (reference: tp->on_complete used by
+ * parsec_compose, parsec/compound.c:25-95) and the recursive-task seam
+ * (parsec/recursive.h).  Runs on whichever thread completes the pool; it
+ * must not block on the pool itself. */
+typedef void (*ptc_tp_complete_cb)(void *user, ptc_taskpool_t *tp);
+void ptc_tp_set_on_complete(ptc_taskpool_t *tp, ptc_tp_complete_cb cb,
+                            void *user);
 
 /* ------------------------------------------------------- data */
 /* create a host-backed datum with a single host copy */
@@ -192,6 +204,9 @@ int32_t ptc_device_queue_new(ptc_context_t *ctx);
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms);
 /* completion entry point for ASYNC owners (any thread) */
 void ptc_task_complete(ptc_context_t *ctx, ptc_task_t *task);
+/* failure entry point for ASYNC owners: aborts the task's taskpool
+ * (successors are never released; waiters observe the error) */
+void ptc_task_fail(ptc_context_t *ctx, ptc_task_t *task);
 
 /* ------------------------------------------------------- profiling
  * Minimal paired-event trace: per-worker buffers of (key, begin/end,

@@ -282,6 +282,8 @@ struct ptc_taskpool {
   std::atomic<bool> open{false};     /* DTD: dynamic insertion */
   std::atomic<bool> completed{false};
   std::atomic<bool> added{false};
+  ptc_tp_complete_cb complete_cb = nullptr; /* compose/recursive seam */
+  void *complete_user = nullptr;
   DepShard shards[NB_SHARDS];
   std::mutex done_lock;
   std::condition_variable done_cv;

@@ -92,6 +92,7 @@ BODY_CB_T = C.CFUNCTYPE(C.c_int32, C.c_void_p, C.c_void_p)
 RANK_OF_CB_T = C.CFUNCTYPE(C.c_uint32, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
 DATA_OF_CB_T = C.CFUNCTYPE(C.c_void_p, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
 COPY_RELEASE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
+TP_COMPLETE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_void_p)
 
 _sigs = {
     "ptc_version": (C.c_char_p, []),
@@ -119,7 +120,11 @@ _sigs = {
     "ptc_tp_wait": (C.c_int32, [C.c_void_p]),
     "ptc_tp_nb_tasks": (C.c_int64, [C.c_void_p]),
     "ptc_tp_nb_total_tasks": (C.c_int64, [C.c_void_p]),
+    "ptc_tp_nb_errors": (C.c_int64, [C.c_void_p]),
+    "ptc_task_fail": (None, [C.c_void_p, C.c_void_p]),
     "ptc_tp_set_open": (None, [C.c_void_p, C.c_int32]),
+    "ptc_tp_set_on_complete": (None, [C.c_void_p, TP_COMPLETE_CB_T,
+                                      C.c_void_p]),
     "ptc_tp_global": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_data_new": (C.c_void_p, [C.c_int64, C.c_void_p, C.c_int64]),
     "ptc_data_destroy": (None, [C.c_void_p]),

@@ -1,3 +1,4 @@
+from .compose import Compound, compose, recursive_call
 from .context import Context, Data
 from .expr import (G, L, Range, call, compile_expr, maximum, minimum, select,
                    shl, shr)
@@ -8,5 +9,5 @@ __all__ = [
     "Context", "Data", "Taskpool", "TaskClass", "TaskView",
     "In", "Out", "Mem", "Ref",
     "L", "G", "Range", "select", "call", "minimum", "maximum", "shl", "shr",
-    "compile_expr",
+    "compile_expr", "Compound", "compose", "recursive_call",
 ]

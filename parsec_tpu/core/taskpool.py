@@ -111,6 +111,21 @@ class Taskpool:
     def set_open(self, open_: bool):
         N.lib.ptc_tp_set_open(self._ptr, 1 if open_ else 0)
 
+    def on_complete(self, fn: Callable[[], None]):
+        """Fire fn() exactly once when this taskpool completes (reference:
+        tp->on_complete, the seam parsec_compose and recursive tasks build
+        on — parsec/compound.c, parsec/recursive.h).  Runs on the
+        completing thread; must not block on this pool."""
+        def _cb(user, tp_ptr):
+            try:
+                fn()
+            except Exception:
+                traceback.print_exc()
+
+        cb = N.TP_COMPLETE_CB_T(_cb)
+        self._complete_cb = cb  # keep-alive
+        N.lib.ptc_tp_set_on_complete(self._ptr, cb, None)
+
     def destroy(self):
         if not self._destroyed:
             self._destroyed = True

@@ -1,7 +1,10 @@
-from .collections import (Collection, HashDatadist, SymTwoDimBlockCyclic,
-                          TwoDimBlockCyclic, TwoDimTabular, VectorCyclic)
+from .collections import (Collection, HashDatadist, SubtileView,
+                          SymTwoDimBlockCyclic, SymTwoDimBlockCyclicBand,
+                          TwoDimBlockCyclic, TwoDimBlockCyclicBand,
+                          TwoDimTabular, VectorCyclic)
 
 __all__ = [
     "Collection", "TwoDimBlockCyclic", "SymTwoDimBlockCyclic",
+    "TwoDimBlockCyclicBand", "SymTwoDimBlockCyclicBand", "SubtileView",
     "TwoDimTabular", "VectorCyclic", "HashDatadist",
 ]

@@ -38,6 +38,65 @@ class Collection:
         self._ctx = ctx
         return ctx.register_collection(name, self)
 
+    # ---------------------------------------------- matrix-family helpers
+    # (used by every tiled subclass; need M/N/mb/nb/mt/nt/tile attrs)
+    def stored(self, m: int, n: int) -> bool:
+        """Whether tile (m, n) is physically stored (sym variants override)."""
+        return True
+
+    def tile_shape(self, m: int, n: int) -> Tuple[int, int]:
+        rows = min(self.mb, self.M - m * self.mb)
+        cols = min(self.nb, self.N - n * self.nb)
+        return rows, cols
+
+    def fill(self, fn: Callable[[int, int], np.ndarray]):
+        """Materialize every local stored tile via fn(m, n) -> array."""
+        for m in range(self.mt):
+            for n in range(self.nt):
+                if self.stored(m, n) and self.rank_of(m, n) == self.myrank:
+                    rows, cols = self.tile_shape(m, n)
+                    self.tile(m, n)[:rows, :cols] = \
+                        np.asarray(fn(m, n))[:rows, :cols]
+
+    def to_dense(self) -> np.ndarray:
+        """Gather stored tiles into a dense matrix (single-rank only)."""
+        assert self.nodes == 1
+        A = np.zeros((self.M, self.N), dtype=self.dtype)
+        for m in range(self.mt):
+            for n in range(self.nt):
+                if not self.stored(m, n):
+                    continue
+                rows, cols = self.tile_shape(m, n)
+                A[m * self.mb:m * self.mb + rows,
+                  n * self.nb:n * self.nb + cols] = \
+                    self.tile(m, n)[:rows, :cols]
+        return A
+
+    def from_dense(self, A: np.ndarray):
+        for m in range(self.mt):
+            for n in range(self.nt):
+                if not self.stored(m, n):
+                    continue
+                if self.nodes > 1 and self.rank_of(m, n) != self.myrank:
+                    continue
+                rows, cols = self.tile_shape(m, n)
+                self.tile(m, n)[:rows, :cols] = \
+                    A[m * self.mb:m * self.mb + rows,
+                      n * self.nb:n * self.nb + cols]
+
+
+class _SymStorage:
+    """Triangular-storage mixin shared by the sym variants: only one
+    triangle's tiles exist (reference: sym_two_dim_rectangle_cyclic.c)."""
+
+    def stored(self, m: int, n: int) -> bool:
+        return n <= m if self.uplo == "lower" else m <= n
+
+    def tile(self, m: int, n: int) -> np.ndarray:
+        if not self.stored(m, n):
+            raise KeyError(f"tile ({m},{n}) not stored ({self.uplo})")
+        return super().tile(m, n)
+
 
 class TwoDimBlockCyclic(Collection):
     """2D block-cyclic tiled matrix over a P×Q process grid.
@@ -68,11 +127,6 @@ class TwoDimBlockCyclic(Collection):
     def key_of(self, m: int, n: int) -> int:
         return m * self.nt + n
 
-    def tile_shape(self, m: int, n: int) -> Tuple[int, int]:
-        rows = min(self.mb, self.M - m * self.mb)
-        cols = min(self.nb, self.N - n * self.nb)
-        return rows, cols
-
     def tile(self, m: int, n: int) -> np.ndarray:
         """The local tile array (allocating on first touch).  Remote tiles
         get local mirror buffers in distributed mode (DTD shadow copies /
@@ -100,54 +154,68 @@ class TwoDimBlockCyclic(Collection):
             self._datas[key] = d
         return d
 
-    # -------------------------------------------------------------- helpers
-    def fill(self, fn: Callable[[int, int], np.ndarray]):
-        """Materialize every local tile via fn(m, n) -> (mb, nb) array."""
-        for m in range(self.mt):
-            for n in range(self.nt):
-                if self.rank_of(m, n) == self.myrank:
-                    rows, cols = self.tile_shape(m, n)
-                    self.tile(m, n)[:rows, :cols] = \
-                        np.asarray(fn(m, n))[:rows, :cols]
-
-    def to_dense(self) -> np.ndarray:
-        """Gather local tiles into a dense matrix (single-rank only)."""
-        assert self.nodes == 1
-        A = np.zeros((self.M, self.N), dtype=self.dtype)
-        for m in range(self.mt):
-            for n in range(self.nt):
-                rows, cols = self.tile_shape(m, n)
-                A[m * self.mb:m * self.mb + rows,
-                  n * self.nb:n * self.nb + cols] = self.tile(m, n)[:rows, :cols]
-        return A
-
-    def from_dense(self, A: np.ndarray):
-        for m in range(self.mt):
-            for n in range(self.nt):
-                if self.rank_of(m, n) == self.myrank:
-                    rows, cols = self.tile_shape(m, n)
-                    self.tile(m, n)[:rows, :cols] = \
-                        A[m * self.mb:m * self.mb + rows,
-                          n * self.nb:n * self.nb + cols]
-
-
-class SymTwoDimBlockCyclic(TwoDimBlockCyclic):
-    """Symmetric/lower(upper)-storage variant: only tiles of one triangle are
-    stored; rank/data of (m, n) with n > m (lower) map to... the stored
-    triangle is addressed directly — tasks only reference stored tiles.
-    Placement cycles over the triangle like the reference's sym 2D BC."""
+class SymTwoDimBlockCyclic(_SymStorage, TwoDimBlockCyclic):
+    """Symmetric/lower(upper)-storage variant: only one triangle's tiles
+    are stored and addressed — tasks only reference stored tiles.
+    Placement cycles over the triangle like the reference's sym 2D BC
+    (sym_two_dim_rectangle_cyclic.c)."""
 
     def __init__(self, *args, uplo: str = "lower", **kw):
         super().__init__(*args, **kw)
         self.uplo = uplo
 
-    def stored(self, m: int, n: int) -> bool:
-        return n <= m if self.uplo == "lower" else m <= n
+
+class TwoDimBlockCyclicBand(Collection):
+    """Band distribution: tiles within the band (|m - n| < band_size) live in
+    a dedicated block-cyclic descriptor distributed along the band; off-band
+    tiles use a regular 2D block-cyclic.  Reference:
+    parsec/data_dist/matrix/two_dim_rectangle_cyclic_band.{h,c} — the
+    composite dispatches rank_of/data_of on band membership.
+    """
+
+    def __init__(self, M: int, N: int, mb: int, nb: int, band_size: int = 1,
+                 P: int = 1, Q: int = 1, nodes: int = 1, myrank: int = 0,
+                 dtype=np.float32):
+        self.band_size = band_size
+        # band tiles distributed 1D-cyclically along the band over all
+        # nodes (reference band desc: P = nodes, Q = 1)
+        self.band = TwoDimBlockCyclic(M, N, mb, nb, P=1, Q=1, nodes=1,
+                                      myrank=0, dtype=dtype)
+        self.off_band = TwoDimBlockCyclic(M, N, mb, nb, P=P, Q=Q,
+                                          nodes=nodes, myrank=myrank,
+                                          dtype=dtype)
+        self.M, self.N, self.mb, self.nb = M, N, mb, nb
+        self.mt, self.nt = self.off_band.mt, self.off_band.nt
+        self.nodes, self.myrank = nodes, myrank
+        self.dtype = np.dtype(dtype)
+
+    def in_band(self, m: int, n: int) -> bool:
+        return abs(m - n) < self.band_size
+
+    def rank_of(self, m: int, n: int) -> int:
+        if self.in_band(m, n):
+            # cyclic along the band diagonal
+            return min(m, n) % self.nodes
+        return self.off_band.rank_of(m, n)
 
     def tile(self, m: int, n: int) -> np.ndarray:
-        if not self.stored(m, n):
-            raise KeyError(f"tile ({m},{n}) not stored ({self.uplo})")
-        return super().tile(m, n)
+        part = self.band if self.in_band(m, n) else self.off_band
+        return part.tile(m, n)
+
+    def data_of(self, m: int, n: int) -> Optional[Data]:
+        part = self.band if self.in_band(m, n) else self.off_band
+        part._ctx = self._ctx
+        return part.data_of(m, n)
+
+
+class SymTwoDimBlockCyclicBand(_SymStorage, TwoDimBlockCyclicBand):
+    """Symmetric band variant (reference:
+    sym_two_dim_rectangle_cyclic_band.{h,c}): only one triangle is stored;
+    band dispatch as in TwoDimBlockCyclicBand."""
+
+    def __init__(self, *args, uplo: str = "lower", **kw):
+        super().__init__(*args, **kw)
+        self.uplo = uplo
 
 
 class TwoDimTabular(Collection):
@@ -206,6 +274,27 @@ class VectorCyclic(Collection):
         if k not in self._datas:
             self._datas[k] = self._ctx.data(k, self.seg(k))
         return self._datas[k]
+
+
+class SubtileView(TwoDimBlockCyclic):
+    """Sub-tiled view of ONE tile, for recursive algorithms (reference:
+    parsec/data_dist/matrix/subtile.c — a descriptor over a single tile of
+    a parent collection, consumed by parsec_recursivecall).
+
+    The parent tile's contents are copied into sub-tiles on construction;
+    `writeback()` copies the (factored) sub-tiles back into the parent
+    tile.  Always single-rank: recursive pools run where the parent task
+    ran.
+    """
+
+    def __init__(self, parent_tile: np.ndarray, mb: int, nb: int):
+        M, N = parent_tile.shape
+        super().__init__(M, N, mb, nb, dtype=parent_tile.dtype)
+        self._parent = parent_tile
+        self.from_dense(parent_tile)
+
+    def writeback(self):
+        self._parent[...] = self.to_dense()
 
 
 class HashDatadist(Collection):

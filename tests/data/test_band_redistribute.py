@@ -1,0 +1,130 @@
+"""Band collections + randomized redistribute (reference:
+two_dim_rectangle_cyclic_band.c, redistribute/ incl. the randomized
+testing_redistribute_random.c)."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.algos import redistribute
+from parsec_tpu.data import (SymTwoDimBlockCyclicBand, TwoDimBlockCyclic,
+                             TwoDimBlockCyclicBand)
+
+
+def test_band_dispatch():
+    B = TwoDimBlockCyclicBand(64, 64, 16, 16, band_size=1)
+    assert B.in_band(0, 0) and B.in_band(2, 2)
+    assert not B.in_band(0, 1)
+    B2 = TwoDimBlockCyclicBand(64, 64, 16, 16, band_size=2)
+    assert B2.in_band(0, 1) and B2.in_band(1, 0)
+    assert not B2.in_band(0, 2)
+    # band and off-band tiles live in distinct descriptors
+    t_band = B.tile(1, 1)
+    t_off = B.tile(0, 1)
+    assert t_band is B.band.tile(1, 1)
+    assert t_off is B.off_band.tile(0, 1)
+    # dense round-trip covers both parts
+    M = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+    B.from_dense(M)
+    np.testing.assert_array_equal(B.to_dense(), M)
+
+
+def test_sym_band_stored():
+    S = SymTwoDimBlockCyclicBand(64, 64, 16, 16, band_size=1, uplo="lower")
+    S.tile(2, 1)  # stored
+    with pytest.raises(KeyError):
+        S.tile(1, 2)
+
+
+def test_band_as_collection_in_dag():
+    """A band collection works as a task affinity/data target."""
+    with pt.Context(nb_workers=2) as ctx:
+        B = TwoDimBlockCyclicBand(32, 32, 16, 16, band_size=1)
+        B.from_dense(np.ones((32, 32), np.float32))
+        B.register(ctx, "B")
+        tp = pt.Taskpool(ctx, globals={"NT": 1})
+        m, n = pt.L("m"), pt.L("n")
+        tc = tp.task_class("SCALE")
+        tc.param("m", 0, pt.G("NT"))
+        tc.param("n", 0, pt.G("NT"))
+        tc.affinity("B", m, n)
+        tc.flow("T", "RW", pt.In(pt.Mem("B", m, n)),
+                pt.Out(pt.Mem("B", m, n)))
+
+        def body(t):
+            t.data("T", np.float32, (16, 16))[...] *= 5.0
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        np.testing.assert_array_equal(B.to_dense(),
+                                      np.full((32, 32), 5.0, np.float32))
+
+
+def test_redistribute_same_grid():
+    with pt.Context(nb_workers=2) as ctx:
+        rng = np.random.default_rng(0)
+        M = rng.standard_normal((64, 48)).astype(np.float32)
+        S = TwoDimBlockCyclic(64, 48, 16, 16, dtype=np.float32)
+        S.from_dense(M)
+        S.register(ctx, "S")
+        D = TwoDimBlockCyclic(64, 48, 16, 16, dtype=np.float32)
+        D.register(ctx, "D")
+        redistribute(ctx, S, D, 64, 48)
+        np.testing.assert_array_equal(D.to_dense(), M)
+
+
+def test_redistribute_resize_tiles():
+    """Different tile sizes on both sides + nonzero displacements."""
+    with pt.Context(nb_workers=2) as ctx:
+        rng = np.random.default_rng(1)
+        M = rng.standard_normal((60, 60)).astype(np.float32)
+        S = TwoDimBlockCyclic(60, 60, 13, 9, dtype=np.float32)
+        S.from_dense(M)
+        S.register(ctx, "S")
+        D = TwoDimBlockCyclic(70, 70, 17, 11, dtype=np.float32)
+        D.register(ctx, "D")
+        redistribute(ctx, S, D, 40, 30, disi_src=7, disj_src=12,
+                     disi_dst=23, disj_dst=5)
+        got = D.to_dense()
+        np.testing.assert_array_equal(got[23:63, 5:35], M[7:47, 12:42])
+        # untouched region stays zero
+        assert got[0:23, :].sum() == 0.0
+
+
+@pytest.mark.parametrize("seed", [2, 3, 4])
+def test_redistribute_random(seed):
+    """Randomized geometry sweep (reference:
+    testing_redistribute_random.c)."""
+    rng = np.random.default_rng(seed)
+    sM, sN = int(rng.integers(30, 80)), int(rng.integers(30, 80))
+    dM, dN = int(rng.integers(30, 80)), int(rng.integers(30, 80))
+    smb, snb = int(rng.integers(4, 20)), int(rng.integers(4, 20))
+    dmb, dnb = int(rng.integers(4, 20)), int(rng.integers(4, 20))
+    size_r = int(rng.integers(1, min(sM, dM)))
+    size_c = int(rng.integers(1, min(sN, dN)))
+    dis = [int(rng.integers(0, sM - size_r + 1)),
+           int(rng.integers(0, sN - size_c + 1)),
+           int(rng.integers(0, dM - size_r + 1)),
+           int(rng.integers(0, dN - size_c + 1))]
+    M = rng.standard_normal((sM, sN)).astype(np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        S = TwoDimBlockCyclic(sM, sN, smb, snb, dtype=np.float32)
+        S.from_dense(M)
+        S.register(ctx, "S")
+        D = TwoDimBlockCyclic(dM, dN, dmb, dnb, dtype=np.float32)
+        D.register(ctx, "D")
+        redistribute(ctx, S, D, size_r, size_c, *dis)
+        got = D.to_dense()
+    np.testing.assert_array_equal(
+        got[dis[2]:dis[2] + size_r, dis[3]:dis[3] + size_c],
+        M[dis[0]:dis[0] + size_r, dis[1]:dis[1] + size_c])
+
+
+def test_redistribute_bounds_check():
+    with pt.Context(nb_workers=1) as ctx:
+        S = TwoDimBlockCyclic(32, 32, 16, 16)
+        S.register(ctx, "S")
+        D = TwoDimBlockCyclic(32, 32, 16, 16)
+        D.register(ctx, "D")
+        with pytest.raises(ValueError):
+            redistribute(ctx, S, D, 33, 10)

@@ -1,0 +1,226 @@
+"""Sequential composition + recursive (nested-taskpool) tasks.
+
+Reference: parsec_compose (parsec/compound.c), parsec_recursivecall
+(parsec/recursive.h), subtile views (subtile.c), exercised like
+tests/api/compose.c and the recursive DTD tests."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.data import SubtileView, TwoDimBlockCyclic
+
+
+def _chain_pool(ctx, buf, start, count, scale):
+    """count tasks appending scaled indices to buf sequentially."""
+    tp = pt.Taskpool(ctx, globals={"NB": count - 1})
+    k = pt.L("k")
+    tc = tp.task_class("T")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref("T", k - 1, flow="A")),
+            pt.Out(pt.Ref("T", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+            arena="t")
+
+    def body(t, base=start):
+        buf.append(base + t.local("k") * scale)
+
+    tc.body(body)
+    return tp
+
+
+def test_compose_sequential_order():
+    """Pools run strictly one after the other; a later pool's tasks never
+    interleave with an earlier pool's."""
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_arena("t", 8)
+        log = []
+        tps = [_chain_pool(ctx, log, i * 100, 5, 1) for i in range(3)]
+        c = pt.compose(*tps)
+        c.run()
+        c.wait()
+    assert len(log) == 15
+    # all of pool i precedes all of pool i+1
+    assert log == sorted(log)
+    assert c.nb_total_tasks == 15
+
+
+def test_compose_context_wait_blocks_across_seams():
+    """Context.wait() must not return between composed pools."""
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_arena("t", 8)
+        log = []
+        tps = [_chain_pool(ctx, log, i * 100, 4, 1) for i in range(2)]
+        pt.compose(*tps).run()
+        ctx.wait()  # returns only when ALL pools are done
+        assert len(log) == 8
+
+
+def test_compose_then():
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        log = []
+        c = pt.compose(_chain_pool(ctx, log, 0, 2, 1))
+        c.then(_chain_pool(ctx, log, 10, 2, 1))
+        c.run()
+        c.wait()
+    assert log == [0, 1, 10, 11]
+
+
+def test_recursive_task_nested_potrf():
+    """A coarse-tile Cholesky where the diagonal factorization recurses
+    into a nested taskpool over sub-tiles (the reference's
+    PARSEC_DEV_RECURSIVE pattern)."""
+    from parsec_tpu.algos import build_potrf
+    rng = np.random.default_rng(3)
+    n = 32
+    x = rng.standard_normal((n, n))
+    M = (x @ x.T + n * np.eye(n)).astype(np.float32)
+
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(n, n, n, n, dtype=np.float32)  # ONE tile
+        A.from_dense(M)
+        A.register(ctx, "A")
+        tp = pt.Taskpool(ctx, globals={})
+        tc = tp.task_class("FACTOR")
+        tc.param("k", 0, 0)
+        tc.affinity("A", pt.L("k"), pt.L("k"))
+        tc.flow("T", "RW", pt.In(pt.Mem("A", pt.L("k"), pt.L("k"))),
+                pt.Out(pt.Mem("A", pt.L("k"), pt.L("k"))))
+
+        def body(t):
+            tile = t.data("T", np.float32, (n, n))
+            sub = SubtileView(tile, 8, 8)
+            sub.register(ctx, "SUB")
+            inner = build_potrf(ctx, sub, name="SUB")
+            return pt.recursive_call(t, inner, on_done=sub.writeback)
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        got = np.tril(A.to_dense())
+    ref = np.linalg.cholesky(M.astype(np.float64))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_compose_failure_stops_chain():
+    """A failing pool aborts the compound: later pools never run and
+    wait() raises."""
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        log = []
+
+        bad = pt.Taskpool(ctx, globals={})
+        btc = bad.task_class("BAD")
+        btc.param("k", 0, 0)
+
+        def boom(t):
+            raise RuntimeError("intentional")
+
+        btc.body(boom)
+        good = _chain_pool(ctx, log, 0, 3, 1)
+        c = pt.compose(bad, good)
+        c.run()
+        with pytest.raises(RuntimeError, match="compound aborted"):
+            c.wait()
+        assert log == []  # second pool never started
+
+
+def test_recursive_inner_failure_fails_outer():
+    """An aborting inner pool fails the generator task -> outer aborts."""
+    with pt.Context(nb_workers=2) as ctx:
+        tp = pt.Taskpool(ctx, globals={})
+        tc = tp.task_class("ROOT")
+        tc.param("k", 0, 0)
+
+        def make_bad():
+            inner = pt.Taskpool(ctx, globals={})
+            itc = inner.task_class("BAD")
+            itc.param("k", 0, 0)
+
+            def boom(t):
+                raise RuntimeError("inner failure")
+
+            itc.body(boom)
+            return inner
+
+        wrote = []
+
+        def body(t):
+            return pt.recursive_call(t, make_bad(),
+                                     on_done=lambda: wrote.append(1))
+
+        tc.body(body)
+        tp.run()
+        with pytest.raises(RuntimeError):
+            tp.wait()
+        assert wrote == []  # on_done (e.g. writeback) must NOT run
+
+
+def test_sym_band_dense_roundtrip():
+    """Sym variants' to_dense/from_dense skip non-stored tiles instead of
+    crashing (regression)."""
+    from parsec_tpu.data import SymTwoDimBlockCyclic, SymTwoDimBlockCyclicBand
+    M = np.arange(32 * 32, dtype=np.float32).reshape(32, 32)
+    for cls in (SymTwoDimBlockCyclic, SymTwoDimBlockCyclicBand):
+        S = cls(32, 32, 16, 16, uplo="lower")
+        S.from_dense(M)
+        got = S.to_dense()
+        # lower triangle (by tiles) round-trips; strict-upper tiles zero
+        np.testing.assert_array_equal(got[16:, :], M[16:, :])
+        np.testing.assert_array_equal(got[:16, :16], M[:16, :16])
+        assert got[:16, 16:].sum() == 0.0
+
+
+def test_redistribute_without_register():
+    """redistribute works on collections never register()-ed (regression:
+    ctx binding)."""
+    from parsec_tpu.algos import redistribute
+    from parsec_tpu.data import TwoDimBlockCyclic
+    with pt.Context(nb_workers=1) as ctx:
+        S = TwoDimBlockCyclic(32, 32, 16, 16, dtype=np.float32)
+        S.from_dense(np.ones((32, 32), np.float32))
+        D = TwoDimBlockCyclic(32, 32, 16, 16, dtype=np.float32)
+        redistribute(ctx, S, D, 32, 32)
+        np.testing.assert_array_equal(D.to_dense(),
+                                      np.ones((32, 32), np.float32))
+
+
+def test_recursive_task_two_levels():
+    """Recursion nests: outer task -> inner pool whose task recurses again."""
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_arena("t", 8)
+        log = []
+
+        def leaf_pool():
+            tp = pt.Taskpool(ctx, globals={})
+            tc = tp.task_class("LEAF")
+            tc.param("k", 0, 2)
+            tc.body(lambda t: log.append(("leaf", t.local("k"))))
+            return tp
+
+        def mid_pool():
+            tp = pt.Taskpool(ctx, globals={})
+            tc = tp.task_class("MID")
+            tc.param("k", 0, 0)
+
+            def body(t):
+                return pt.recursive_call(t, leaf_pool())
+
+            tc.body(body)
+            return tp
+
+        tp = pt.Taskpool(ctx, globals={})
+        tc = tp.task_class("ROOT")
+        tc.param("k", 0, 0)
+
+        def root_body(t):
+            return pt.recursive_call(t, mid_pool(),
+                                     on_done=lambda: log.append("mid-done"))
+
+        tc.body(root_body)
+        tp.run()
+        tp.wait()
+    assert sorted(x for x in log if x != "mid-done") == \
+        [("leaf", 0), ("leaf", 1), ("leaf", 2)]
+    assert "mid-done" in log
