@@ -1,0 +1,26 @@
+"""Sequence/context + expert parallelism libraries (SPMD, mesh-native).
+
+SURVEY.md §5 "Long-context / sequence parallelism": the reference has no
+named SP/CP/EP features — its building blocks are the chain-pipeline
+broadcast topology (parsec/remote_dep.c:39-47), neighbor-wise JDF
+dependencies (tests/apps/stencil/stencil_1D.jdf) and the redistribute
+all-to-all (parsec/data_dist/matrix/redistribute/redistribute.jdf).  This
+package supplies the TPU-native equivalents as *library algorithms* over a
+`jax.sharding.Mesh`: ring attention (neighbor ppermute pipeline = the chain
+topology on the ICI torus), Ulysses attention (all-to-all head<->sequence
+resharding = redistribute), and the named ML strategies (dp/tp/pp/sp/ep)
+composed from shardings — the way §2.10's checklist prescribes.
+"""
+from .mesh import MeshSpec, make_mesh
+from .collectives import (ring_permute, seq_all_gather, seq_reduce_scatter,
+                          seq_all_to_all)
+from .ring_attention import ring_attention, blockwise_attention_reference
+from .ulysses import ulysses_attention
+from .expert import moe_ffn, moe_ffn_reference
+
+__all__ = [
+    "MeshSpec", "make_mesh",
+    "ring_permute", "seq_all_gather", "seq_reduce_scatter", "seq_all_to_all",
+    "ring_attention", "blockwise_attention_reference", "ulysses_attention",
+    "moe_ffn", "moe_ffn_reference",
+]

@@ -1,0 +1,84 @@
+"""Sequence-sharding collectives as library functions.
+
+SURVEY.md §5 requires ring / all-gather / P2P-permute sequence-sharding
+collectives over ICI as library operations (the reference's analogs are the
+chain/binomial broadcast topologies of parsec/remote_dep.c:39-47 and the
+redistribute all-to-all of redistribute.jdf).  Each helper here wraps the
+XLA collective in a `shard_map` so callers hand in a *globally sharded*
+array and get one back — XLA lowers the inner op onto ICI.
+"""
+from functools import partial
+
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_perm(n: int, shift: int = 1):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def ring_permute(x, mesh: Mesh, axis: str, shift: int = 1, shard_dim: int = 0):
+    """Rotate shards one step around the `axis` ring (chain topology:
+    parsec/remote_dep.c:43 `remote_dep_bcast_chain_child`).  Device i's
+    shard moves to device (i+shift) mod n via `lax.ppermute` (ICI
+    neighbor traffic on TPU)."""
+    n = mesh.shape[axis]
+    spec = [None] * x.ndim
+    spec[shard_dim] = axis
+    pspec = P(*spec)
+
+    @partial(shard_map, mesh=mesh, in_specs=pspec, out_specs=pspec, check_vma=False)
+    def _f(xs):
+        return lax.ppermute(xs, axis, _ring_perm(n, shift))
+
+    return _f(x)
+
+
+def seq_all_gather(x, mesh: Mesh, axis: str, shard_dim: int = 0):
+    """Gather the sequence-sharded dim onto every device (star topology
+    analog: parsec/remote_dep.c:47).  Returns the replicated full array."""
+    spec = [None] * x.ndim
+    spec[shard_dim] = axis
+    in_spec = P(*spec)
+    out_spec = P(*([None] * x.ndim))
+
+    @partial(shard_map, mesh=mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False)
+    def _f(xs):
+        return lax.all_gather(xs, axis, axis=shard_dim, tiled=True)
+
+    return _f(x)
+
+
+def seq_reduce_scatter(x, mesh: Mesh, axis: str, shard_dim: int = 0):
+    """Sum-reduce a replicated array and scatter shards along `shard_dim`
+    (the tree-reduction taskpools of the reference's
+    parsec/data_dist/matrix/reduce_col.jdf, fused into one XLA op)."""
+    spec = [None] * x.ndim
+    out_sp = list(spec)
+    out_sp[shard_dim] = axis
+
+    @partial(shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*out_sp), check_vma=False)
+    def _f(xs):
+        return lax.psum_scatter(xs, axis, scatter_dimension=shard_dim,
+                                tiled=True)
+
+    return _f(x)
+
+
+def seq_all_to_all(x, mesh: Mesh, axis: str, split_dim: int, concat_dim: int):
+    """Reshard: split `split_dim` across `axis` while gathering the
+    previously sharded `concat_dim` — one XLA all-to-all.  This is the
+    reference's generic redistribute (redistribute.jdf) restricted to the
+    uniform case, and the core move of Ulysses attention."""
+    in_sp = [None] * x.ndim
+    in_sp[concat_dim] = axis
+    out_sp = [None] * x.ndim
+    out_sp[split_dim] = axis
+
+    @partial(shard_map, mesh=mesh, in_specs=P(*in_sp), out_specs=P(*out_sp), check_vma=False)
+    def _f(xs):
+        return lax.all_to_all(xs, axis, split_axis=split_dim,
+                              concat_axis=concat_dim, tiled=True)
+
+    return _f(x)
