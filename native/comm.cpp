@@ -223,6 +223,10 @@ static void handle_activate_body(ptc_context *ctx, const uint8_t *body,
     std::memcpy(copy->ptr, r.p, (size_t)plen);
   }
   for (Target &t : targets) {
+    ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
+                     t.params.size() > 0 ? t.params[0] : 0,
+                     t.params.size() > 1 ? t.params[1] : 0,
+                     copy ? copy->size : 0);
     std::vector<int64_t> params(t.params);
     ptc_deliver_dep_local(ctx, -1, tp, t.class_id, std::move(params),
                           flow_idx, copy);
@@ -520,6 +524,11 @@ void ptc_comm_send_activate_batch(
     w.u64(0);
   }
   frame_finish(f);
+  for (const auto &t : targets)
+    ptc_prof_instant(ctx, PROF_KEY_COMM_SEND, (int64_t)t.first,
+                     t.second.size() > 0 ? t.second[0] : 0,
+                     t.second.size() > 1 ? t.second[1] : 0,
+                     copy ? copy->size : 0);
   comm_post(ce, rank, std::move(f));
 }
 

@@ -350,6 +350,13 @@ static void task_free(ptc_context *ctx, ptc_task *t) {
 
 static void complete_task(ptc_context *ctx, int worker, ptc_task *t);
 static void execute_task(ptc_context *ctx, int worker, ptc_task *t);
+static void prof_event(ptc_context *ctx, int worker, int64_t key,
+                       int64_t phase, ptc_task *t);
+static void prof_edge(ptc_context *ctx, int worker, ptc_task *src,
+                      int64_t dst_class, int64_t dl0, int64_t dl1);
+static void prof_edge_params(ptc_context *ctx, int worker, ptc_task *src,
+                             ptc_taskpool *tp, int32_t peer_class,
+                             const std::vector<int64_t> &params);
 
 /* Fill derived locals given range-local values already in `locals`. */
 static void fill_derived_locals(ptc_context *ctx, ptc_taskpool *tp,
@@ -602,6 +609,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
             vals[i] = eval_expr(d.params[i].value, ctx, t->locals, nb_locals, g);
         if (range_idx.empty()) {
           std::vector<int64_t> pv(vals);
+          prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
           deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
                       d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
                       &batch);
@@ -627,6 +635,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
             for (size_t i = 0; i < rs.size(); i++)
               vals[range_idx[i]] = rs[i].cur;
             std::vector<int64_t> pv(vals);
+            prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
             deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
                         d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
                         &batch);
@@ -725,6 +734,73 @@ static void tp_abort(ptc_context *ctx, ptc_taskpool *tp) {
 }
 
 /* -------- DTD task lifetime + completion -------- */
+} // namespace
+
+/* ---- paired-event trace (reference: parsec/profiling.c + the PINS hook
+ * points of parsec/mca/pins/pins.h:26-54; format doc at PROF_WORDS).    */
+void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
+                   int64_t class_id, int64_t l0, int64_t l1, int64_t aux) {
+  if (ctx->prof_level.load(std::memory_order_relaxed) < 1) return;
+  ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
+  std::lock_guard<std::mutex> g(b->lock);
+  int64_t w[PROF_WORDS] = {key,         phase, class_id, l0, l1,
+                           (int64_t)worker, aux,   ptc_now_ns()};
+  b->words.insert(b->words.end(), w, w + PROF_WORDS);
+}
+
+void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
+                      int64_t l0, int64_t l1, int64_t aux) {
+  if (ctx->prof_level.load(std::memory_order_relaxed) < 1) return;
+  ProfBuf *b = ctx->prof[0];
+  std::lock_guard<std::mutex> g(b->lock);
+  int64_t now = ptc_now_ns();
+  int64_t w[2 * PROF_WORDS] = {key, 0, class_id, l0, l1, -1, aux, now,
+                               key, 1, class_id, l0, l1, -1, aux, now};
+  b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
+}
+
+namespace {
+
+static void prof_event(ptc_context *ctx, int worker, int64_t key,
+                       int64_t phase, ptc_task *t) {
+  ptc_prof_push(ctx, worker, key, phase, t ? t->class_id : -1,
+                t ? t->locals[0] : 0, t ? t->locals[1] : 0, 0);
+}
+
+/* dep edge = consecutive src/dst event pair, pushed under ONE lock so a
+ * concurrent pusher on the same buffer cannot interleave them.  dst
+ * identity is the peer task's declaration-order (locals[0], locals[1]) —
+ * the same identity its own EXEC/src events carry. */
+static void prof_edge(ptc_context *ctx, int worker, ptc_task *src,
+                      int64_t dst_class, int64_t dl0, int64_t dl1) {
+  if (ctx->prof_level.load(std::memory_order_relaxed) < 2) return;
+  ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
+  std::lock_guard<std::mutex> g(b->lock);
+  int64_t now = ptc_now_ns();
+  int64_t w[2 * PROF_WORDS] = {
+      PROF_KEY_EDGE, 0, src ? src->class_id : -1,
+      src ? src->locals[0] : 0, src ? src->locals[1] : 0,
+      (int64_t)worker, 0, now,
+      PROF_KEY_EDGE, 1, dst_class, dl0, dl1,
+      (int64_t)worker, 0, now};
+  b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
+}
+
+/* PTG-path edge: dep params arrive in range-param order; translate them
+ * through the peer class's range_locals (+ derived locals) so the dst
+ * node matches that task's EXEC identity in the captured DAG. */
+static void prof_edge_params(ptc_context *ctx, int worker, ptc_task *src,
+                             ptc_taskpool *tp, int32_t peer_class,
+                             const std::vector<int64_t> &params) {
+  if (ctx->prof_level.load(std::memory_order_relaxed) < 2) return;
+  const TaskClass &tc = tp->classes[(size_t)peer_class];
+  int64_t locals[PTC_MAX_LOCALS] = {0};
+  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+    locals[tc.range_locals[(size_t)i]] = params[i];
+  fill_derived_locals(ctx, tp, tc, locals);
+  prof_edge(ctx, worker, src, peer_class, locals[0], locals[1]);
+}
+
 static void dyn_retain(ptc_task *t) {
   t->dyn->refs.fetch_add(1, std::memory_order_relaxed);
 }
@@ -756,6 +832,7 @@ static void dyn_complete_task(ptc_context *ctx, int worker, ptc_task *t) {
     succs.swap(dx->succs);
   }
   for (ptc_task *s : succs) {
+    prof_edge(ctx, worker, t, s->class_id, s->locals[0], s->locals[1]);
     if (s->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
       schedule_task(ctx, worker, s);
   }
@@ -776,7 +853,9 @@ static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   }
   ptc_taskpool *tp = t->tp;
   const TaskClass &tc = tp->classes[(size_t)t->class_id];
+  prof_event(ctx, worker, PROF_KEY_RELEASE, 0, t);
   release_deps(ctx, worker, t);
+  prof_event(ctx, worker, PROF_KEY_RELEASE, 1, t);
   for (size_t f = 0; f < tc.flows.size(); f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   task_free(ctx, t);
@@ -794,17 +873,7 @@ static void fail_task(ptc_context *ctx, ptc_task *t) {
   tp_abort(ctx, tp);
 }
 
-static void prof_event(ptc_context *ctx, int worker, int64_t key, int64_t phase,
-                       ptc_task *t) {
-  if (!ctx->prof_enabled.load(std::memory_order_relaxed)) return;
-  ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
-  std::lock_guard<std::mutex> g(b->lock);
-  b->words.push_back(key);
-  b->words.push_back(phase);
-  b->words.push_back(t ? t->class_id : -1);
-  b->words.push_back(t ? t->locals[0] : 0);
-  b->words.push_back(ptc_now_ns());
-}
+/* (prof_event / ptc_prof_push defined above dyn_complete_task) */
 
 /* DTD failure: same taskpool-abort semantics as fail_task */
 static void dyn_fail_task(ptc_context *ctx, ptc_task *t) {
@@ -1583,7 +1652,7 @@ int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
 
 /* profiling */
 void ptc_profile_enable(ptc_context_t *ctx, int32_t enable) {
-  ctx->prof_enabled.store(enable != 0, std::memory_order_release);
+  ctx->prof_level.store(enable, std::memory_order_release);
 }
 
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap) {
@@ -1592,7 +1661,7 @@ int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap) {
     std::lock_guard<std::mutex> g(b->lock);
     int64_t n = (int64_t)b->words.size();
     int64_t take = std::min(n, cap - written);
-    take -= take % 5;
+    take -= take % PROF_WORDS;
     if (take > 0) {
       std::memcpy(out + written, b->words.data(), (size_t)take * 8);
       written += take;

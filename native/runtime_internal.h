@@ -260,10 +260,23 @@ struct DeviceQueue {
 
 struct ProfBuf {
   std::mutex lock;
-  std::vector<int64_t> words; /* 5 words per event */
+  std::vector<int64_t> words; /* PROF_WORDS words per event */
 };
 
-enum { PROF_KEY_EXEC = 0 };
+/* Paired-event trace keys (reference: the profiling dictionary +
+ * PINS event points, parsec/mca/pins/pins.h:26-54, SURVEY.md §5).
+ * Event = (key, phase, class_id, l0, l1, worker, aux, t_ns); EDGE events
+ * come in consecutive src(phase=0)/dst(phase=1) pairs.                  */
+enum {
+  PROF_KEY_EXEC = 0,      /* task body begin/end                      */
+  PROF_KEY_RELEASE = 1,   /* release_deps begin/end                   */
+  PROF_KEY_EDGE = 2,      /* dep edge src->dst (pair of events)       */
+  PROF_KEY_COMM_SEND = 3, /* per-target activation send: instant span
+                           * (begin+end, same t), aux = payload bytes */
+  PROF_KEY_COMM_RECV = 4, /* per-target activation delivery: instant
+                           * span, aux = payload bytes                */
+};
+enum { PROF_WORDS = 8 };
 
 /* ------------------------------------------------------------------ */
 /* taskpool + context                                                  */
@@ -341,7 +354,7 @@ struct ptc_context {
   void *copy_release_user = nullptr;
 
   /* profiling */
-  std::atomic<bool> prof_enabled{false};
+  std::atomic<int32_t> prof_level{0}; /* 0 off, 1 spans, 2 +edges */
   std::vector<ProfBuf *> prof;
 
   /* communication engine (nullptr when single-process) */
@@ -370,6 +383,15 @@ uint32_t ptc_collection_rank_of(ptc_context *ctx, int32_t dc_id,
 
 /* schedule a ready task (wakes idle workers) */
 void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t);
+
+/* trace push (core.cpp): event = (key, phase, class, l0, l1, worker,
+ * aux, t_ns); no-op unless profiling enabled */
+void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
+                   int64_t class_id, int64_t l0, int64_t l1, int64_t aux);
+/* instant span: begin+end with the SAME timestamp, one lock (comm thread
+ * events; buffer 0 is shared with worker 0) */
+void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
+                      int64_t l0, int64_t l1, int64_t aux);
 
 /* deliver one dependency release to a local successor instance (the
  * incoming half of the remote ACTIVATE path calls this) */

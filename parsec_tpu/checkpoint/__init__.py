@@ -1,0 +1,21 @@
+"""Checkpoint / resume.
+
+The reference has NO checkpointing (SURVEY.md §5 "Checkpoint / resume:
+Not present") — flagged there as a gap that is mandatory on TPU pods
+(preemptions, ICI link flaps).  This package closes it at the two natural
+boundaries of the framework:
+
+  collections  quiescent-point checkpoint of distributed data collections
+               (tile payloads + versions) — the task-DAG state lives in
+               the data between taskpool runs, so save-after-wait /
+               load-before-rebuild gives exact resume of any algorithm
+               expressed as a sequence of taskpools.
+  train state  jax pytree save/restore (params + opt state + step) with
+               sharding re-application on load — the model-side analog,
+               safe under jit because it round-trips through host numpy.
+"""
+from .checkpoint import (save_collections, load_collections,
+                         save_train_state, load_train_state)
+
+__all__ = ["save_collections", "load_collections",
+           "save_train_state", "load_train_state"]

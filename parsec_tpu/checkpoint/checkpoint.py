@@ -1,0 +1,100 @@
+"""Quiescent-point collection checkpoints + jax train-state save/restore.
+
+Format: one .npz per (path, collection name) holding every *local* tile
+keyed "m_n", plus a JSON-ish meta array (geometry, rank) used to validate
+the resume target — mismatched geometry is an error, not silent
+corruption.  Multi-rank runs write per-rank files (path.rank<k>.npz), the
+same per-rank-file scheme as the reference's dbp profiles
+(parsec/parsec_binary_profile.h) and standard for pod checkpoints.
+"""
+import json
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def _coll_meta(coll) -> dict:
+    return {
+        "M": coll.M, "N": coll.N, "mb": coll.mb, "nb": coll.nb,
+        "P": getattr(coll, "P", 1), "Q": getattr(coll, "Q", 1),
+        "nodes": coll.nodes, "myrank": coll.myrank,
+        "dtype": np.dtype(coll.dtype).str,
+    }
+
+
+def _path_for(path: str, name: str, rank: int, nodes: int) -> str:
+    base = f"{path}.{name}"
+    return f"{base}.rank{rank}.npz" if nodes > 1 else f"{base}.npz"
+
+
+def save_collections(path: str, named_colls: Dict[str, object]):
+    """Checkpoint local tiles of each collection.  Call at a quiescent
+    point (after tp.wait() / ctx.wait()) — tile buffers are then the
+    complete algorithm state."""
+    for name, coll in named_colls.items():
+        arrays = {}
+        for (m, n), tile in coll._tiles.items():
+            if coll.rank_of(m, n) == coll.myrank:
+                arrays[f"{m}_{n}"] = tile
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps(_coll_meta(coll)).encode(), dtype=np.uint8)
+        np.savez(_path_for(path, name, coll.myrank, coll.nodes), **arrays)
+
+
+def load_collections(path: str, named_colls: Dict[str, object]):
+    """Restore local tiles into freshly-constructed collections with the
+    same geometry.  Raises ValueError on geometry mismatch."""
+    for name, coll in named_colls.items():
+        f = np.load(_path_for(path, name, coll.myrank, coll.nodes))
+        meta = json.loads(bytes(f["__meta__"]).decode())
+        want = _coll_meta(coll)
+        for k in ("M", "N", "mb", "nb", "P", "Q", "nodes", "dtype"):
+            if meta[k] != want[k]:
+                raise ValueError(
+                    f"checkpoint {name}: geometry mismatch on {k}: "
+                    f"saved {meta[k]!r} vs target {want[k]!r}")
+        for key in f.files:
+            if key == "__meta__":
+                continue
+            m, n = (int(x) for x in key.split("_"))
+            coll.tile(m, n)[...] = f[key]
+
+
+# ------------------------------------------------------------------ model
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, object]]:
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(kp), leaf) for kp, leaf in flat]
+
+
+def save_train_state(path: str, state):
+    """Save a jax pytree (params / optimizer state / step) to one .npz.
+    Device/sharded arrays are gathered to host first."""
+    import jax
+    arrays = {}
+    for keystr, leaf in _flatten_with_paths(state):
+        arrays[keystr] = np.asarray(jax.device_get(leaf))
+    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+
+
+def load_train_state(path: str, like, shardings=None):
+    """Restore into the structure of `like` (a pytree with the target
+    treedef).  `shardings`: optional matching pytree of NamedShardings to
+    device_put each leaf back onto the mesh."""
+    import jax
+    f = np.load(path if path.endswith(".npz") else path + ".npz")
+    leaves_like, treedef = jax.tree_util.tree_flatten(like)
+    del leaves_like
+    paths = [jax.tree_util.keystr(kp) for kp, _ in
+             jax.tree_util.tree_flatten_with_path(like)[0]]
+    leaves = []
+    for p in paths:
+        if p not in f.files:
+            raise ValueError(f"checkpoint missing leaf {p}")
+        leaves.append(f[p])
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    if shardings is not None:
+        state = jax.tree.map(jax.device_put, state, shardings)
+    return state

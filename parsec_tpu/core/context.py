@@ -40,11 +40,22 @@ class Data:
 
 
 class Context:
-    def __init__(self, nb_workers: int = 0, scheduler: str = "lfq"):
+    def __init__(self, nb_workers: Optional[int] = None,
+                 scheduler: Optional[str] = None):
+        """Explicit arguments win over the MCA param registry
+        (parsec_tpu.utils.params: runtime.nb_workers / runtime.sched /
+        runtime.profile) which itself resolves files < env < set()."""
+        from ..utils import params as _mca
+        if nb_workers is None:
+            nb_workers = _mca.get("runtime.nb_workers")
+        if scheduler is None:
+            scheduler = _mca.get("runtime.sched")
         self._ptr = N.lib.ptc_context_new(nb_workers)
         self.myrank, self.nodes = 0, 1
         if scheduler != "lfq":
             N.lib.ptc_context_set_scheduler(self._ptr, scheduler.encode())
+        if _mca.get("runtime.profile"):
+            N.lib.ptc_profile_enable(self._ptr, 1)
         # keep-alives: ctypes callbacks must outlive the native context
         self._expr_cbs: List = []
         self._body_cbs: List = []
@@ -93,13 +104,16 @@ class Context:
         N.lib.ptc_context_set_rank(self._ptr, myrank, nodes)
 
     # ------------------------------------------------------------ comm (L4)
-    def comm_init(self, base_port: int = 29650):
+    def comm_init(self, base_port: Optional[int] = None):
         """Bring up the distributed control plane: a full-mesh loopback/DCN
         TCP transport carrying dependency activations, memory write-backs,
         DTD completion broadcasts and fences (reference: the MPI-funnelled
         comm engine + remote_dep protocol, parsec/parsec_comm_engine.h,
         parsec/remote_dep.c — SURVEY.md §2.5).  Call set_rank first;
         blocks until all ranks are connected."""
+        if base_port is None:
+            from ..utils import params as _mca
+            base_port = _mca.get("comm.base_port")
         if N.lib.ptc_comm_init(self._ptr, base_port) != 0:
             raise RuntimeError("comm engine init failed")
 
@@ -192,14 +206,21 @@ class Context:
         N.lib.ptc_task_complete(self._ptr, task_ptr)
 
     # ------------------------------------------------------------ profiling
-    def profile_enable(self, enable: bool = True):
-        N.lib.ptc_profile_enable(self._ptr, 1 if enable else 0)
+    def profile_enable(self, enable=True):
+        """Tracing level: 0/False off; 1 span events only (EXEC/RELEASE/
+        COMM_SEND/RECV — cheapest, what bench.py uses); 2/True adds dep-EDGE pairs
+        for DAG capture (parsec_tpu.profiling.to_dot)."""
+        level = 2 if enable is True else int(enable)
+        N.lib.ptc_profile_enable(self._ptr, level)
 
     def profile_take(self) -> np.ndarray:
-        """Drain profiling buffers; returns an (n, 5) int64 array of
-        (key, phase, class_id, local0, t_ns).  Loops with a fixed-size
-        buffer until the native side reports empty."""
-        chunk_words = (1 << 16) * 5
+        """Drain profiling buffers; returns an (n, 8) int64 array of
+        (key, phase, class_id, local0, local1, worker, aux, t_ns).
+        Loops with a fixed-size buffer until the native side reports
+        empty.  See parsec_tpu.profiling for the dictionary + trace
+        tooling built on top."""
+        words = 8
+        chunk_words = (1 << 16) * words
         buf = (C.c_int64 * chunk_words)()
         parts = []
         while True:
@@ -211,5 +232,5 @@ class Context:
             if n < chunk_words:
                 break
         if not parts:
-            return np.empty((0, 5), dtype=np.int64)
-        return np.concatenate(parts).reshape(-1, 5)
+            return np.empty((0, words), dtype=np.int64)
+        return np.concatenate(parts).reshape(-1, words)

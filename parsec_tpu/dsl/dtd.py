@@ -71,7 +71,10 @@ class DtdTile:
 
 
 class DtdTaskpool:
-    def __init__(self, ctx: Context, window: int = 8000):
+    def __init__(self, ctx: Context, window: Optional[int] = None):
+        if window is None:
+            from ..utils import params as _mca
+            window = _mca.get("dtd.window_size")
         self.ctx = ctx
         self.window = window
         self.tp = Taskpool(ctx)

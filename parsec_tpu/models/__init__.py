@@ -1,0 +1,16 @@
+"""Model families built on the framework's parallelism libraries.
+
+The reference ships dense-LA algorithm families on top of its runtime
+(DPLASMA-style potrf/gemm — our parsec_tpu.algos); this package adds the
+ML model families the TPU framework is expected to serve, composed from
+the same mesh axes: a transformer LM with dp/tp/sp(/ep) sharding and an
+optional GPipe pipeline over the block stack.
+"""
+from .transformer import (TransformerConfig, init_params, forward, loss_fn,
+                          train_step, make_sharded_train_step,
+                          pipelined_forward)
+
+__all__ = [
+    "TransformerConfig", "init_params", "forward", "loss_fn", "train_step",
+    "make_sharded_train_step", "pipelined_forward",
+]

@@ -1,0 +1,251 @@
+"""Decoder-only transformer LM, mesh-native.
+
+Parallelism is composed the way SURVEY.md §2.10 prescribes for the new
+framework: named strategies as libraries over a `jax.sharding.Mesh` —
+  dp  batch sharding (owner-computes over the batch, the analog of the
+      reference's rank_of affinity, parsec/include/parsec/data_distribution.h:40)
+  tp  head/ffn sharding with XLA-inserted psum (the PxQ grid analog,
+      parsec/data_dist/matrix/grid_2Dcyclic.c)
+  sp  sequence sharding via ring attention (parallel/ring_attention.py)
+  ep  expert sharding via all-to-all MoE (parallel/expert.py), riding the
+      dp axis (tokens are already batch-local there)
+  pp  GPipe over the block stack (parallel/pipeline.py, pipelined_forward)
+
+Everything under jit; GSPMD propagates tp shardings from the parameter
+PartitionSpecs, only the sp ring and the ep all-to-all are explicit
+shard_map regions.  bf16 matmuls with f32 accumulation for the MXU.
+"""
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import (ring_attention,
+                                       blockwise_attention_reference)
+from ..parallel.expert import moe_ffn
+from ..parallel.pipeline import gpipe
+
+
+@dataclass
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    head_dim: int = 16
+    n_layers: int = 4
+    d_ff: int = 512
+    n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
+    moe_k: int = 2
+    dtype: object = jnp.float32
+    # mesh axis names (None = strategy unused)
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = "tp"
+    sp_axis: Optional[str] = "sp"
+    ep_axis: Optional[str] = "ep"   # commonly == dp_axis
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def _rotary(q, k):
+    """Rotary position embedding over the full (global) sequence."""
+    b, s, h, d = q.shape
+    half = d // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        xr = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+        return xr.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def init_params(cfg: TransformerConfig, key):
+    """Block params stacked on a leading n_layers dim (scan/pp friendly)."""
+    ks = jax.random.split(key, 8)
+    L, D, H, Dh, F = (cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.head_dim,
+                      cfg.d_ff)
+    dt = cfg.dtype
+    p = {
+        "embed": (jax.random.normal(ks[0], (cfg.vocab, D)) * 0.02).astype(dt),
+        "ln_f": jnp.ones((D,), dt),
+        "blocks": {
+            "ln1": jnp.ones((L, D), dt),
+            "ln2": jnp.ones((L, D), dt),
+            "wqkv": (jax.random.normal(ks[1], (L, D, 3, H, Dh))
+                     * D ** -0.5).astype(dt),
+            "wo": (jax.random.normal(ks[2], (L, H, Dh, D))
+                   * (H * Dh) ** -0.5).astype(dt),
+        },
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        p["blocks"]["wg"] = (jax.random.normal(ks[3], (L, D, E))
+                             * 0.02).astype(dt)
+        p["blocks"]["wu"] = (jax.random.normal(ks[4], (L, E, D, F))
+                             * D ** -0.5).astype(dt)
+        p["blocks"]["wd"] = (jax.random.normal(ks[5], (L, E, F, D))
+                             * F ** -0.5).astype(dt)
+    else:
+        p["blocks"]["w1"] = (jax.random.normal(ks[3], (L, D, F))
+                             * D ** -0.5).astype(dt)
+        p["blocks"]["w2"] = (jax.random.normal(ks[4], (L, F, D))
+                             * F ** -0.5).astype(dt)
+    return p
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh):
+    """NamedShardings mirroring init_params' tree: tp on heads/ffn, ep on
+    experts, everything else replicated (GSPMD derives the rest)."""
+    tp, ep = cfg.tp_axis, cfg.ep_axis
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    blocks = {
+        "ln1": ns(None, None), "ln2": ns(None, None),
+        "wqkv": ns(None, None, None, tp, None),
+        "wo": ns(None, tp, None, None),
+    }
+    if cfg.n_experts:
+        blocks["wg"] = ns(None, None, None)
+        blocks["wu"] = ns(None, ep, None, None)
+        blocks["wd"] = ns(None, ep, None, None)
+    else:
+        blocks["w1"] = ns(None, None, tp)
+        blocks["w2"] = ns(None, tp, None)
+    return {"embed": ns(None, None), "ln_f": ns(None), "blocks": blocks}
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    if mesh is not None and cfg.sp_axis and mesh.shape.get(cfg.sp_axis, 1) > 1:
+        spec = P(cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, None)
+        return ring_attention(q, k, v, mesh, cfg.sp_axis, causal=True,
+                              spec=spec)
+    return blockwise_attention_reference(q, k, v, causal=True)
+
+
+def _block(x, bp, cfg: TransformerConfig, mesh: Optional[Mesh]):
+    h = _rms_norm(x, bp["ln1"])
+    qkv = jnp.einsum("bsd,dchn->bschn", h, bp["wqkv"],
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q, k = _rotary(q, k)
+    o = _attention(q, k, v, cfg, mesh)
+    x = x + jnp.einsum("bshn,hnd->bsd", o, bp["wo"],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+    h = _rms_norm(x, bp["ln2"])
+    if cfg.n_experts:
+        if mesh is not None and cfg.ep_axis and \
+                mesh.shape.get(cfg.ep_axis, 1) > 1:
+            if cfg.ep_axis != cfg.dp_axis:
+                raise ValueError(
+                    "expert parallelism rides the dp axis (tokens are "
+                    f"batch-local there); got ep_axis={cfg.ep_axis!r} != "
+                    f"dp_axis={cfg.dp_axis!r}")
+            xsp = P(cfg.ep_axis, cfg.sp_axis, None)
+            f = moe_ffn(h, bp["wg"], bp["wu"], bp["wd"], mesh, cfg.ep_axis,
+                        k=cfg.moe_k, x_spec=xsp)
+        else:
+            from ..parallel.expert import moe_ffn_reference
+            f = moe_ffn_reference(h, bp["wg"], bp["wu"], bp["wd"],
+                                  k=cfg.moe_k).astype(x.dtype)
+    else:
+        u = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, bp["w1"],
+                        preferred_element_type=jnp.float32).astype(x.dtype))
+        f = jnp.einsum("bsf,fd->bsd", u, bp["w2"],
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+    return x + f
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None):
+    """tokens [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    x = params["embed"][tokens]
+    if mesh is not None:
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(cfg.dp_axis, cfg.sp_axis, None)))
+
+    def body(xc, bp):
+        return _block(xc, bp, cfg, mesh), None
+
+    # scan over the stacked layer dim; shard_map regions nest fine inside
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                      params["embed"].astype(jnp.float32))
+
+
+def pipelined_forward(params, tokens, cfg: TransformerConfig, mesh: Mesh,
+                      pp_axis: str = "pp", n_microbatch: int = 4):
+    """forward() with the block stack run as a GPipe pipeline over
+    `pp_axis`.  n_layers must divide by the pp axis size; the embedding
+    and final norm run replicated outside the pipeline."""
+    n_stages = mesh.shape[pp_axis]
+    L = cfg.n_layers
+    assert L % n_stages == 0, (L, n_stages)
+    per = L // n_stages
+    x = params["embed"][tokens]
+    b = x.shape[0]
+    assert b % n_microbatch == 0, (b, n_microbatch)
+    x_mb = x.reshape(n_microbatch, b // n_microbatch, *x.shape[1:])
+    # restack blocks: [L, ...] -> [n_stages, per, ...]
+    stages = jax.tree.map(
+        lambda a: a.reshape(n_stages, per, *a.shape[1:]), params["blocks"])
+
+    def stage_fn(bp_stage, xc):
+        def body(c, bp):
+            return _block(c, bp, cfg, mesh=None), None
+        out, _ = lax.scan(body, xc, bp_stage)
+        return out
+
+    y = gpipe(stage_fn, stages, x_mb, mesh, pp_axis)
+    y = y.reshape(b, *y.shape[2:])
+    y = _rms_norm(y, params["ln_f"])
+    return jnp.einsum("bsd,vd->bsv", y.astype(jnp.float32),
+                      params["embed"].astype(jnp.float32))
+
+
+def loss_fn(params, batch, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None):
+    """Next-token cross-entropy; batch = (tokens, targets) [B, S]."""
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg, mesh)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def train_step(params, batch, cfg: TransformerConfig,
+               mesh: Optional[Mesh] = None, lr: float = 1e-2):
+    """One SGD step (the driver's dryrun vehicle; real training loops wrap
+    this in optax, see tests/models)."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+    new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                              params, grads)
+    return new_params, loss
+
+
+def make_sharded_train_step(cfg: TransformerConfig, mesh: Mesh,
+                            lr: float = 1e-2):
+    """jit train_step with parameter/batch shardings bound (GSPMD does the
+    tp collectives; sp/ep run their explicit shard_map regions)."""
+    pshard = param_shardings(cfg, mesh)
+    bshard = (NamedSharding(mesh, P(cfg.dp_axis, cfg.sp_axis)),) * 2
+
+    @partial(jax.jit, in_shardings=(pshard, bshard),
+             out_shardings=(pshard, NamedSharding(mesh, P())))
+    def step(params, batch):
+        return train_step(params, batch, cfg, mesh, lr)
+
+    return step

@@ -56,10 +56,13 @@ def _dispatch_combine(logits, k: int, capacity: int):
 def moe_ffn(x, w_gate, w_up, w_down, mesh: Mesh, axis: str = "ep",
             k: int = 2, capacity_factor: float = 1.25,
             activation=jax.nn.gelu,
-            capacity: Optional[int] = None):
+            capacity: Optional[int] = None,
+            x_spec: Optional[P] = None):
     """Mixture-of-experts FFN, expert-parallel over mesh axis `axis`.
 
-    x:      [B, S, D]   batch-sharded over `axis`
+    x:      [B, S, D]   batch-sharded over `axis` (or per `x_spec` when
+                        batch/sequence are additionally dp/sp-sharded —
+                        routing is then local per shard, hierarchical EP)
     w_gate: [D, E]      replicated router
     w_up:   [E, D, F]   experts sharded over `axis` (E = n * E_local)
     w_down: [E, F, D]   experts sharded over `axis`
@@ -70,12 +73,18 @@ def moe_ffn(x, w_gate, w_up, w_down, mesh: Mesh, axis: str = "ep",
     if e_total % n != 0:
         raise ValueError(f"n_experts ({e_total}) must divide over "
                          f"'{axis}' size ({n})")
+    xs = x_spec if x_spec is not None else P(axis, None, None)
+    # local token count after every sharded dim of x_spec is applied
+    shard = 1
+    for ax in xs[:2]:
+        if ax is not None:
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                shard *= mesh.shape[a]
     b, s_len, d = x.shape
-    t_loc = (b // n) * s_len
+    t_loc = max(1, (b * s_len) // shard)
     cap = capacity if capacity is not None else max(
         1, int(capacity_factor * k * t_loc / e_total))
 
-    xs = P(axis, None, None)
     ws = P(axis, None, None)
 
     @partial(shard_map, mesh=mesh,

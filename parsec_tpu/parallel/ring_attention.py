@@ -66,16 +66,19 @@ def _ring_block_step(q, k_blk, v_blk, o, m, l, q_off, k_off, causal, scale):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   spec: Optional[P] = None):
     """Exact attention with q,k,v sequence-sharded on mesh axis `axis`.
 
     q,k,v: [B, L, H, D] with L sharded over `axis` (n_sp shards).
-    Returns [B, L, H, D] with the same sharding.
+    `spec` overrides the q/k/v partition spec when batch/heads are also
+    sharded (e.g. P('dp', 'sp', 'tp', None) in the transformer); the ring
+    still only rotates along `axis`.  Returns [B, L, H, D], same sharding.
     """
     d = q.shape[-1]
     scale = (d ** -0.5) if scale is None else scale
     n = mesh.shape[axis]
-    pspec = P(None, axis, None, None)
+    pspec = spec if spec is not None else P(None, axis, None, None)
 
     @partial(shard_map, mesh=mesh, in_specs=(pspec, pspec, pspec),
              out_specs=pspec, check_vma=False)

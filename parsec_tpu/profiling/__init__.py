@@ -1,0 +1,22 @@
+"""Observability: paired-event binary traces, trace tables, DOT grapher.
+
+The reference's L7 stack (SURVEY.md §5): per-thread event buffers flushed
+to a per-rank binary profile ("dbp", parsec/parsec_binary_profile.h:45)
+whose events are paired begin/end keys from a global dictionary
+(parsec/profiling.c:580,791), converted offline to pandas trace tables by
+the Cython pbt2ptt (tools/profiling/python/pbt2ptt.pyx), plus a DOT DAG
+grapher (parsec/parsec_prof_grapher.c:86-135).  This package is the
+TPU-native equivalent over the native core's 8-word event stream
+(native/runtime_internal.h PROF_WORDS):
+
+  Dictionary     event-key registry with names/colors
+  Trace          take/save/load/merge + to_pandas() trace tables
+  to_dot         executed-DAG capture from EDGE event pairs
+"""
+from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
+                    KEY_COMM_SEND, KEY_COMM_RECV,
+                    Dictionary, Trace, take_trace, to_dot)
+
+__all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
+           "KEY_COMM_SEND", "KEY_COMM_RECV",
+           "Dictionary", "Trace", "take_trace", "to_dot"]

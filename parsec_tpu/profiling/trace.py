@@ -1,0 +1,205 @@
+"""Binary trace format + pandas trace tables + DOT grapher.
+
+File format (".ptt", the dbp analog — parsec/parsec_binary_profile.h:45
+magic "#PARSEC BINARY PROFILE" becomes "#PTCPROF"):
+  bytes 0..7   magic b"#PTCPROF"
+  bytes 8..11  version (u32 LE) = 1
+  bytes 12..15 header length H (u32 LE)
+  bytes 16..16+H  JSON header {rank, dictionary:{key:{name,color}}, meta}
+  rest         int64 LE event words, 8 per event:
+               (key, phase, class_id, l0, l1, worker, aux, t_ns)
+Per-rank files merge by concatenation of event tables (rank column added),
+the same property the reference's dbp merge tooling relies on.
+"""
+import json
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+KEY_EXEC = 0       # task body begin/end
+KEY_RELEASE = 1    # release_deps begin/end
+KEY_EDGE = 2       # dep edge, consecutive src(phase0)/dst(phase1) pair
+KEY_COMM_SEND = 3  # per-target activation send (instant span), aux = bytes
+KEY_COMM_RECV = 4  # per-target activation delivery (instant span)
+
+_MAGIC = b"#PTCPROF"
+_VERSION = 1
+
+_DEFAULT_KEYS = {
+    KEY_EXEC: ("EXEC", "#00ff00"),
+    KEY_RELEASE: ("RELEASE_DEPS", "#0000ff"),
+    KEY_EDGE: ("EDGE", "#888888"),
+    KEY_COMM_SEND: ("COMM_SEND", "#ff0000"),
+    KEY_COMM_RECV: ("COMM_RECV", "#ff8800"),
+}
+
+
+class Dictionary:
+    """Event-key registry (reference: parsec/profiling.c dictionary with
+    name + color + typed info, consumed by pbt2ptt)."""
+
+    def __init__(self):
+        self.keys: Dict[int, dict] = {
+            k: {"name": n, "color": c} for k, (n, c) in _DEFAULT_KEYS.items()}
+
+    def add(self, key: int, name: str, color: str = "#cccccc"):
+        self.keys[int(key)] = {"name": name, "color": color}
+        return key
+
+    def name(self, key: int) -> str:
+        return self.keys.get(int(key), {}).get("name", f"KEY{key}")
+
+    def to_json(self):
+        return {str(k): v for k, v in self.keys.items()}
+
+    @classmethod
+    def from_json(cls, d):
+        out = cls()
+        for k, v in d.items():
+            out.keys[int(k)] = dict(v)
+        return out
+
+
+class Trace:
+    """An event table + dictionary for one or more ranks."""
+
+    def __init__(self, events: np.ndarray, dictionary: Optional[Dictionary]
+                 = None, rank: int = 0, meta: Optional[dict] = None,
+                 class_names: Optional[List[str]] = None):
+        assert events.ndim == 2 and events.shape[1] == 8, events.shape
+        self.events = events.astype(np.int64, copy=False)
+        self.dict = dictionary or Dictionary()
+        self.rank = rank
+        self.meta = meta or {}
+        self.class_names = class_names or []
+        # per-event rank column (merged traces carry several ranks)
+        self.ranks = np.full(len(events), rank, dtype=np.int64)
+
+    # ---------------------------------------------------------- file IO
+    def save(self, path: str):
+        header = json.dumps({
+            "rank": self.rank, "dictionary": self.dict.to_json(),
+            "meta": self.meta, "class_names": self.class_names,
+        }).encode()
+        with open(path, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<II", _VERSION, len(header)))
+            f.write(header)
+            f.write(self.events.astype("<i8").tobytes())
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        with open(path, "rb") as f:
+            raw = f.read()
+        if raw[:8] != _MAGIC:
+            raise ValueError(f"{path}: not a ptt trace (bad magic)")
+        ver, hlen = struct.unpack("<II", raw[8:16])
+        if ver != _VERSION:
+            raise ValueError(f"{path}: unsupported trace version {ver}")
+        hdr = json.loads(raw[16:16 + hlen])
+        ev = np.frombuffer(raw[16 + hlen:], dtype="<i8").reshape(-1, 8)
+        return cls(ev.copy(), Dictionary.from_json(hdr["dictionary"]),
+                   hdr.get("rank", 0), hdr.get("meta"),
+                   hdr.get("class_names"))
+
+    @classmethod
+    def merge(cls, traces: List["Trace"]) -> "Trace":
+        """Concatenate per-rank traces (the dbp-merge analog)."""
+        out = cls(np.concatenate([t.events for t in traces]),
+                  traces[0].dict, traces[0].rank,
+                  {"merged_ranks": [t.rank for t in traces]},
+                  traces[0].class_names)
+        out.ranks = np.concatenate([t.ranks for t in traces])
+        return out
+
+    # ----------------------------------------------------- trace tables
+    def to_pandas(self):
+        """Paired begin/end events -> one row per span (the reference's
+        pbt2ptt "trace tables": tools/profiling/python/pbt2ptt.pyx).
+
+        Returns a DataFrame with columns: rank, worker, key, name, class_id,
+        class_name, l0, l1, aux, begin_ns, end_ns, dur_ns.  EDGE events are
+        excluded (use edges()/to_dot)."""
+        import pandas as pd
+        ev = self.events
+        rows = []
+        # pair per (rank, worker, key, class, l0, l1) with a begin stack
+        open_spans: Dict[tuple, list] = {}
+        for i in range(len(ev)):
+            key, phase, cid, l0, l1, worker, aux, t = ev[i]
+            if key == KEY_EDGE:
+                continue
+            sig = (self.ranks[i], worker, key, cid, l0, l1)
+            if phase == 0:
+                open_spans.setdefault(sig, []).append((aux, t))
+            else:
+                st = open_spans.get(sig)
+                if st:
+                    aux0, t0 = st.pop()
+                    rows.append((self.ranks[i], worker, key,
+                                 self.dict.name(key), cid,
+                                 self._cname(cid), l0, l1, max(aux, aux0),
+                                 t0, t, t - t0))
+        return pd.DataFrame(rows, columns=[
+            "rank", "worker", "key", "name", "class_id", "class_name",
+            "l0", "l1", "aux", "begin_ns", "end_ns", "dur_ns"])
+
+    def _cname(self, cid: int) -> str:
+        if 0 <= cid < len(self.class_names):
+            return self.class_names[cid]
+        return f"class{cid}"
+
+    def edges(self):
+        """EDGE pairs -> list of ((src_cid, l0, l1), (dst_cid, l0, l1))."""
+        ev = self.events
+        out = []
+        i = 0
+        n = len(ev)
+        while i < n:
+            if ev[i][0] == KEY_EDGE and ev[i][1] == 0 and i + 1 < n \
+                    and ev[i + 1][0] == KEY_EDGE and ev[i + 1][1] == 1:
+                s, d = ev[i], ev[i + 1]
+                out.append(((int(s[2]), int(s[3]), int(s[4])),
+                            (int(d[2]), int(d[3]), int(d[4]))))
+                i += 2
+            else:
+                i += 1
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        """Event counts per key name — the cheap oracle used by trace
+        assertions (reference: tests/profiling/check-comms.py)."""
+        out: Dict[str, int] = {}
+        for k in np.unique(self.events[:, 0]):
+            out[self.dict.name(int(k))] = int(
+                np.sum((self.events[:, 0] == k) & (self.events[:, 1] == 0)))
+        return out
+
+
+def take_trace(ctx, rank: int = 0, class_names: Optional[List[str]] = None,
+               meta: Optional[dict] = None) -> Trace:
+    """Drain a Context's native profiling buffers into a Trace."""
+    return Trace(ctx.profile_take(), rank=rank, class_names=class_names,
+                 meta=meta)
+
+
+def _node_id(cid, l0, l1, cname):
+    return f"{cname(cid)}_{l0}_{l1}"
+
+
+def to_dot(trace: Trace, name: str = "dag") -> str:
+    """Executed-DAG capture as DOT (reference:
+    parsec/parsec_prof_grapher.c:86-135, the --parsec dot flag)."""
+    lines = [f"digraph {name} {{"]
+    seen = set()
+    for (sc, sl0, sl1), (dc, dl0, dl1) in trace.edges():
+        a = _node_id(sc, sl0, sl1, trace._cname)
+        b = _node_id(dc, dl0, dl1, trace._cname)
+        for nd in (a, b):
+            if nd not in seen:
+                seen.add(nd)
+                lines.append(f'  "{nd}";')
+        lines.append(f'  "{a}" -> "{b}";')
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,9 @@
+"""Utility services: the MCA-style typed parameter registry.
+
+Reference: parsec/utils/mca_param.c (SURVEY.md §2.1 "MCA params") —
+typed named parameters sourced from defaults < config files < environment
+< explicit set, with a help dump.
+"""
+from .config import Params, params, register, get, set_param, dump_help
+
+__all__ = ["Params", "params", "register", "get", "set_param", "dump_help"]

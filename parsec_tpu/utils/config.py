@@ -1,0 +1,148 @@
+"""Typed parameter registry (the MCA param system, TPU-native edition).
+
+Reference behavior being reproduced (parsec/utils/mca_param.c:2606,
+mca_parse_paramfile.c, SURVEY.md §5 "Config / flag system"): parameters
+are registered with type+default anywhere in the stack and resolved with
+ascending priority
+    defaults  <  config files  <  environment  <  programmatic set
+Environment spelling: PTC_MCA_<name with '.' -> '_'>, the analog of the
+reference's PARSEC_MCA_*.  Config files: ~/.ptc/mca-params.conf then
+./ptc.conf, "name = value" lines, '#' comments.  `dump_help()` is the
+`--parsec help` listing (parsec/parsec.c:912-924).
+"""
+import os
+from typing import Any, Callable, Dict, Optional
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+_BOOL_FALSE = {"0", "false", "no", "off"}
+
+
+def _coerce(raw: str, ty: type):
+    if ty is bool:
+        low = str(raw).strip().lower()
+        if low in _BOOL_TRUE:
+            return True
+        if low in _BOOL_FALSE:
+            return False
+        raise ValueError(f"not a boolean: {raw!r}")
+    return ty(raw)
+
+
+class Param:
+    __slots__ = ("name", "default", "type", "help", "value", "source")
+
+    def __init__(self, name, default, ty, help_):
+        self.name = name
+        self.default = default
+        self.type = ty
+        self.help = help_
+        self.value = None     # programmatic override
+        self.source = "default"
+
+
+class Params:
+    def __init__(self, env_prefix: str = "PTC_MCA_",
+                 files: Optional[list] = None):
+        self.env_prefix = env_prefix
+        self.files = files if files is not None else [
+            os.path.expanduser("~/.ptc/mca-params.conf"), "ptc.conf"]
+        self._reg: Dict[str, Param] = {}
+        self._file_vals: Optional[Dict[str, str]] = None
+
+    # ------------------------------------------------------------ sources
+    def _load_files(self) -> Dict[str, str]:
+        if self._file_vals is None:
+            vals: Dict[str, str] = {}
+            for path in self.files:
+                try:
+                    with open(path) as f:
+                        for line in f:
+                            line = line.split("#", 1)[0].strip()
+                            if not line or "=" not in line:
+                                continue
+                            k, v = line.split("=", 1)
+                            vals[k.strip()] = v.strip()
+                except OSError:
+                    continue
+            self._file_vals = vals
+        return self._file_vals
+
+    def _env_name(self, name: str) -> str:
+        return self.env_prefix + name.replace(".", "_")
+
+    # ---------------------------------------------------------------- API
+    def register(self, name: str, default: Any, ty: Optional[type] = None,
+                 help: str = "") -> str:
+        """Idempotent: re-registering keeps the first definition."""
+        if name not in self._reg:
+            self._reg[name] = Param(name, default,
+                                    ty or type(default), help)
+        return name
+
+    def get(self, name: str) -> Any:
+        p = self._reg[name]
+        if p.value is not None or p.source == "set":
+            return p.value
+        env = os.environ.get(self._env_name(name))
+        if env is not None:
+            return _coerce(env, p.type)
+        fv = self._load_files().get(name)
+        if fv is not None:
+            return _coerce(fv, p.type)
+        return p.default
+
+    def source_of(self, name: str) -> str:
+        p = self._reg[name]
+        if p.source == "set":
+            return "set"
+        if os.environ.get(self._env_name(name)) is not None:
+            return "env"
+        if name in self._load_files():
+            return "file"
+        return "default"
+
+    def set(self, name: str, value: Any):
+        p = self._reg[name]
+        p.value = _coerce(str(value), p.type) if not isinstance(
+            value, p.type) else value
+        p.source = "set"
+
+    def unset(self, name: str):
+        p = self._reg[name]
+        p.value = None
+        p.source = "default"
+
+    def reload_files(self):
+        self._file_vals = None
+
+    def dump_help(self, write: Callable[[str], None] = None) -> str:
+        lines = []
+        for name in sorted(self._reg):
+            p = self._reg[name]
+            lines.append(f"{name} <{p.type.__name__}> "
+                         f"[{self.get(name)!r} from {self.source_of(name)}]"
+                         f"  {p.help}")
+        text = "\n".join(lines)
+        if write:
+            write(text)
+        return text
+
+
+# process-global registry, like the reference's single MCA namespace
+params = Params()
+register = params.register
+get = params.get
+set_param = params.set
+dump_help = params.dump_help
+
+# core runtime knobs (mirrors of the reference's most-used MCA params)
+register("runtime.sched", "lfq", str,
+         "scheduler module (reference: --mca sched <m>)")
+register("runtime.nb_workers", 0, int,
+         "worker threads; 0 = hardware count")
+register("runtime.profile", False, bool, "enable event tracing at init")
+register("comm.base_port", 29650, int, "TCP rendezvous base port")
+register("dtd.window_size", 8000, int,
+         "DTD discovery window (reference: parsec_dtd_window_size)")
+register("device.tpu_enabled", True, bool,
+         "allow TPU device module (reference: --mca device_cuda_enabled)")

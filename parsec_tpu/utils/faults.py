@@ -1,0 +1,70 @@
+"""Fault injection + failure-detection harness.
+
+SURVEY.md §5 "Failure detection": the reference's whole story is the
+chore protocol — a failing incarnation returns DISABLE, the device/chore
+is disabled and the task respawns on the next incarnation
+(parsec/scheduling.c:507-509, device_cuda_module.c:2757-2762); tasks out
+of incarnations are dropped with a warning (scheduling.c:142-149).  The
+survey flags the missing piece: a fault-injection harness to *test* those
+paths (mandatory on TPU pods — preemptions, ICI link flaps).  This module
+is that harness: wrap any task body to inject chore failures (DISABLE /
+NEXT) or hard body errors at chosen invocations, then assert on the
+runtime's recovery behavior.
+"""
+import threading
+from typing import Callable, Optional
+
+from .._native import HOOK_DISABLE, HOOK_NEXT
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a wrapped body in 'error' mode (aborts the taskpool)."""
+
+
+class FaultInjector:
+    """Deterministic fault injection for chore bodies.
+
+    mode:
+      "disable"  fail like a broken device: the runtime disables this
+                 chore for the whole class and retries the task on the
+                 next incarnation (reference: PARSEC_HOOK_RETURN_DISABLE)
+      "next"     fail this execution only; the task moves to its next
+                 incarnation, the chore stays enabled (HOOK_RETURN_NEXT)
+      "error"    raise InjectedFault: the body errors, the runtime aborts
+                 the taskpool and waiters observe the failure
+    at_invocation: fire on the k-th call of the wrapped body (0-based);
+                   None = fire on every call.
+    """
+
+    def __init__(self, mode: str = "disable",
+                 at_invocation: Optional[int] = None):
+        assert mode in ("disable", "next", "error"), mode
+        self.mode = mode
+        self.at_invocation = at_invocation
+        self.calls = 0
+        self.injected = 0
+        self.executed = 0
+        self._lock = threading.Lock()
+
+    def _should_fire(self) -> bool:
+        with self._lock:
+            me = self.calls
+            self.calls += 1
+            fire = (self.at_invocation is None or
+                    me == self.at_invocation)
+            if fire:
+                self.injected += 1
+            else:
+                self.executed += 1
+            return fire
+
+    def wrap(self, fn: Callable) -> Callable:
+        def wrapped(view):
+            if self._should_fire():
+                if self.mode == "disable":
+                    return HOOK_DISABLE
+                if self.mode == "next":
+                    return HOOK_NEXT
+                raise InjectedFault("injected body failure")
+            return fn(view)
+        return wrapped

@@ -1,0 +1,73 @@
+"""Transformer LM: sharded forward parity vs single-device, training-loss
+descent, MoE + pipeline variants — all on the virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parsec_tpu.parallel import make_mesh
+from parsec_tpu.models import (TransformerConfig, init_params, forward,
+                               loss_fn, pipelined_forward,
+                               make_sharded_train_step)
+
+
+def _data(cfg, b=8, s=32, key=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(key))
+    toks = jax.random.randint(k1, (b, s), 0, cfg.vocab)
+    tgts = jnp.roll(toks, -1, axis=1)
+    return toks, tgts
+
+
+def test_forward_parity_dp_tp_sp():
+    """dp=2 x tp=2 x sp=2 sharded forward == unsharded forward."""
+    cfg = TransformerConfig(vocab=64, d_model=64, n_heads=4, head_dim=16,
+                            n_layers=2, d_ff=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks, _ = _data(cfg)
+    ref = forward(params, toks, cfg, mesh=None)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    out = forward(params, toks, cfg, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_train_step_descends():
+    cfg = TransformerConfig(vocab=64, d_model=64, n_heads=4, head_dim=16,
+                            n_layers=2, d_ff=128)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    step = make_sharded_train_step(cfg, mesh, lr=0.05)
+    batch = _data(cfg)
+    losses = []
+    for _ in range(8):
+        params, loss = step(params, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_moe_transformer_runs():
+    """ep rides the dp axis; MoE layer output must stay finite and the
+    sharded loss must match the dense-oracle loss within capacity slack."""
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, head_dim=16,
+                            n_layers=2, d_ff=64, n_experts=4, moe_k=2,
+                            ep_axis="dp")
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    toks, tgts = _data(cfg, b=4, s=16, key=3)
+    loss = loss_fn(params, (toks, tgts), cfg, mesh)
+    assert np.isfinite(float(loss))
+    ref = loss_fn(params, (toks, tgts), cfg, mesh=None)
+    # capacity drops allow small divergence from the no-drop oracle
+    assert abs(float(loss) - float(ref)) < 0.5
+
+
+def test_pipelined_forward_parity():
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=2, head_dim=16,
+                            n_layers=4, d_ff=64)
+    params = init_params(cfg, jax.random.PRNGKey(4))
+    toks, _ = _data(cfg, b=8, s=16, key=5)
+    ref = forward(params, toks, cfg, mesh=None)
+    mesh = make_mesh(pp=4)
+    out = pipelined_forward(params, toks, cfg, mesh, "pp", n_microbatch=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

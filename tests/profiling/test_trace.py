@@ -1,0 +1,77 @@
+"""Trace-based integration oracles, the reference's profiling test style
+(tests/profiling/check-comms.py pandas assertions on event counts)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import (KEY_EXEC, KEY_RELEASE, KEY_EDGE, Trace,
+                                  take_trace, to_dot)
+
+
+def _run_chain(nb=10):
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.profile_enable(True)
+        ctx.register_arena("int", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                arena="int")
+        tc.body(lambda t: None)
+        tp.run()
+        tp.wait()
+        return take_trace(ctx, class_names=["Task"])
+
+
+def test_exec_spans_and_counts():
+    nb = 10
+    tr = _run_chain(nb)
+    counts = tr.counts()
+    assert counts["EXEC"] == nb + 1, counts
+    assert counts["RELEASE_DEPS"] == nb + 1, counts
+    df = tr.to_pandas()
+    ex = df[df["key"] == KEY_EXEC]
+    assert len(ex) == nb + 1
+    assert (ex["dur_ns"] >= 0).all()
+    assert (ex["class_name"] == "Task").all()
+    # spans nest: every release follows its exec on the same worker
+    rel = df[df["key"] == KEY_RELEASE]
+    assert len(rel) == nb + 1
+
+
+def test_edges_capture_chain_dag():
+    nb = 8
+    tr = _run_chain(nb)
+    edges = tr.edges()
+    # chain: Task(k) -> Task(k+1) for k=0..nb-1
+    got = {(s[1], d[1]) for s, d in edges}
+    assert got == {(k, k + 1) for k in range(nb)}, got
+    dot = to_dot(tr)
+    assert "Task_0_0" in dot and "->" in dot
+
+
+def test_trace_save_load_merge(tmp_path):
+    tr = _run_chain(5)
+    p = str(tmp_path / "r0.ptt")
+    tr.save(p)
+    lt = Trace.load(p)
+    np.testing.assert_array_equal(lt.events, tr.events)
+    assert lt.dict.name(KEY_EXEC) == "EXEC"
+    tr2 = _run_chain(3)
+    tr2.rank = 1
+    tr2.ranks[:] = 1
+    m = Trace.merge([tr, tr2])
+    assert len(m.events) == len(tr.events) + len(tr2.events)
+    df = m.to_pandas()
+    assert set(df["rank"].unique()) == {0, 1}
+    # per-rank exec counts survive the merge
+    assert len(df[(df["rank"] == 0) & (df["key"] == KEY_EXEC)]) == 6
+    assert len(df[(df["rank"] == 1) & (df["key"] == KEY_EXEC)]) == 4

@@ -1,0 +1,70 @@
+"""MCA-style param registry: source precedence, coercion, help dump."""
+import os
+
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.utils.config import Params
+
+
+@pytest.fixture
+def reg(tmp_path):
+    p = Params(env_prefix="PTCTEST_MCA_", files=[str(tmp_path / "conf")])
+    p.register("a.x", 7, int, "an int knob")
+    p.register("a.flag", False, bool, "a bool knob")
+    p.register("a.name", "lfq", str, "a str knob")
+    return p
+
+
+def test_default_and_set(reg):
+    assert reg.get("a.x") == 7
+    reg.set("a.x", 9)
+    assert reg.get("a.x") == 9
+    assert reg.source_of("a.x") == "set"
+    reg.unset("a.x")
+    assert reg.get("a.x") == 7
+
+
+def test_env_overrides_file(reg, tmp_path, monkeypatch):
+    (tmp_path / "conf").write_text("a.x = 11  # comment\na.name=gd\n")
+    reg.reload_files()
+    assert reg.get("a.x") == 11
+    assert reg.source_of("a.x") == "file"
+    assert reg.get("a.name") == "gd"
+    monkeypatch.setenv("PTCTEST_MCA_a_x", "13")
+    assert reg.get("a.x") == 13
+    assert reg.source_of("a.x") == "env"
+
+
+def test_set_beats_env(reg, monkeypatch):
+    monkeypatch.setenv("PTCTEST_MCA_a_x", "13")
+    reg.set("a.x", 21)
+    assert reg.get("a.x") == 21
+
+
+def test_bool_coercion(reg, monkeypatch):
+    monkeypatch.setenv("PTCTEST_MCA_a_flag", "yes")
+    assert reg.get("a.flag") is True
+    monkeypatch.setenv("PTCTEST_MCA_a_flag", "off")
+    assert reg.get("a.flag") is False
+    monkeypatch.setenv("PTCTEST_MCA_a_flag", "maybe")
+    with pytest.raises(ValueError):
+        reg.get("a.flag")
+
+
+def test_dump_help(reg):
+    text = reg.dump_help()
+    assert "a.x <int>" in text and "an int knob" in text
+
+
+def test_context_reads_registry(monkeypatch):
+    """runtime.sched flows from env into Context (the --mca sched path)."""
+    monkeypatch.setenv("PTC_MCA_runtime_sched", "gd")
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("T")
+        ran = []
+        tc.body(lambda t: ran.append(1))
+        tp.run()
+        tp.wait()
+    assert ran == [1]

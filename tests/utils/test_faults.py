@@ -1,0 +1,79 @@
+"""Failure-detection paths under injected faults (the harness the
+reference lacks; chore protocol per parsec/scheduling.c:124-203)."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.utils.faults import FaultInjector, InjectedFault
+
+
+def _chain_class(tp, nb):
+    k = pt.L("k")
+    tc = tp.task_class("Task")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref("Task", k - 1, flow="A")),
+            pt.Out(pt.Ref("Task", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+            arena="t")
+    return tc
+
+
+def test_chore_disable_falls_back():
+    """Primary chore always DISABLEs -> every task runs the fallback chore
+    (the nvlink.jdf CPU-fallback pattern)."""
+    nb = 10
+    inj = FaultInjector("disable")
+    ran_fallback = []
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        tc = _chain_class(tp, nb)
+        tc.body(inj.wrap(lambda v: None))          # primary: poisoned
+        tc.body(lambda v: ran_fallback.append(v["k"]))  # fallback
+        tp.run()
+        tp.wait()
+    assert sorted(ran_fallback) == list(range(nb + 1))
+    # chore disabled on first hit: at most a few tasks probe the primary
+    assert inj.injected >= 1
+    assert inj.executed == 0
+
+
+def test_hook_next_single_task():
+    """NEXT skips the primary for ONE task only; others still use it."""
+    nb = 10
+    inj = FaultInjector("next", at_invocation=3)
+    primary, fallback = [], []
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        tc = _chain_class(tp, nb)
+        tc.body(inj.wrap(lambda v: primary.append(v["k"])))
+        tc.body(lambda v: fallback.append(v["k"]))
+        tp.run()
+        tp.wait()
+    assert len(fallback) == 1
+    assert len(primary) == nb
+    assert sorted(primary + fallback) == list(range(nb + 1))
+
+
+def test_body_error_aborts_pool():
+    """A hard body failure aborts the pool; wait() raises, the context
+    survives and can run another pool (elastic-recovery baseline)."""
+    inj = FaultInjector("error", at_invocation=5)
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": 10})
+        tc = _chain_class(tp, 10)
+        tc.body(inj.wrap(lambda v: None))
+        tp.run()
+        with pytest.raises(RuntimeError, match="abort"):
+            tp.wait()
+        # context still usable: run a clean pool on it
+        tp2 = pt.Taskpool(ctx, globals={"NB": 5})
+        tc2 = _chain_class(tp2, 5)
+        done = []
+        tc2.body(lambda v: done.append(v["k"]))
+        tp2.run()
+        tp2.wait()
+    assert sorted(done) == list(range(6))

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Trace(s) -> pandas trace tables on disk (reference: the Cython
+pbt2ptt converter + profile2h5.py, tools/profiling/python/).
+
+Usage: python tools/ptt2tables.py out.h5 rank0.ptt rank1.ptt ...
+Merges per-rank traces and writes one table; falls back to CSV when no
+HDF5 backend is available in the environment.
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from parsec_tpu.profiling import Trace  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("out")
+    ap.add_argument("traces", nargs="+")
+    args = ap.parse_args(argv)
+    traces = [Trace.load(p) for p in args.traces]
+    merged = Trace.merge(traces) if len(traces) > 1 else traces[0]
+    df = merged.to_pandas()
+    if args.out.endswith(".csv"):
+        df.to_csv(args.out, index=False)
+    else:
+        try:
+            df.to_hdf(args.out, key="events", mode="w")
+        except ImportError:
+            csv = args.out.rsplit(".", 1)[0] + ".csv"
+            print(f"no HDF5 backend; writing {csv}", file=sys.stderr)
+            df.to_csv(csv, index=False)
+    print(f"{len(df)} spans from {len(traces)} rank(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Trace inspector (reference: tools/profiling/dbpinfos.c).
+
+Usage: python tools/ptt_info.py trace.ptt [more.ptt ...]
+Prints per-file dictionary, event counts, span statistics per task class.
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from parsec_tpu.profiling import Trace  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+")
+    args = ap.parse_args(argv)
+    for path in args.traces:
+        t = Trace.load(path)
+        print(f"== {path} (rank {t.rank}, {len(t.events)} events)")
+        for k, v in sorted(t.dict.keys.items()):
+            print(f"   key {k}: {v['name']} {v['color']}")
+        for name, cnt in sorted(t.counts().items()):
+            print(f"   {name}: {cnt}")
+        df = t.to_pandas()
+        if len(df):
+            g = df.groupby("class_name")["dur_ns"]
+            for cname, stats in g.agg(["count", "median", "sum"]).iterrows():
+                print(f"   {cname}: n={int(stats['count'])} "
+                      f"p50={stats['median'] / 1e3:.2f}us "
+                      f"total={stats['sum'] / 1e6:.3f}ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
