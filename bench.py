@@ -101,23 +101,50 @@ def bench_spotrf(N=16384, nb=1024):
     return potrf_flops(N) / best / 1e9
 
 
+def _dispatch_json():
+    p50_us = bench_dispatch_chain()
+    return json.dumps({
+        "metric": "task_dispatch_p50",
+        "value": round(p50_us, 3),
+        "unit": "us",
+        "vs_baseline": round(5.0 / p50_us, 3),
+    })
+
+
 def main():
     if "--dispatch" in sys.argv:
-        p50_us = bench_dispatch_chain()
+        print(_dispatch_json())
+        return 0
+    if "--spotrf-child" in sys.argv:
+        gflops = bench_spotrf()
         print(json.dumps({
-            "metric": "task_dispatch_p50",
-            "value": round(p50_us, 3),
-            "unit": "us",
-            "vs_baseline": round(5.0 / p50_us, 3),
+            "metric": "spotrf_gflops_per_chip",
+            "value": round(gflops, 1),
+            "unit": "GFLOP/s",
+            "vs_baseline": round(gflops / 7000.0, 4),
         }))
         return 0
-    gflops = bench_spotrf()
-    print(json.dumps({
-        "metric": "spotrf_gflops_per_chip",
-        "value": round(gflops, 1),
-        "unit": "GFLOP/s",
-        "vs_baseline": round(gflops / 7000.0, 4),
-    }))
+    # Headline spotrf runs on the real chip through the axon tunnel, which
+    # can wedge at backend init.  Run it in a watchdog subprocess; if it
+    # cannot produce a number in time, fall back to the rung-1 dispatch
+    # metric (BASELINE.md ladder) so the driver always gets its JSON line.
+    import os
+    import subprocess
+    budget = int(os.environ.get("PTC_BENCH_TIMEOUT_S", "480"))
+    try:
+        r = subprocess.run(
+            [sys.executable, __file__, "--spotrf-child"],
+            timeout=budget, capture_output=True, text=True)
+        for line in reversed((r.stdout or "").strip().splitlines()):
+            if line.startswith("{"):
+                print(line)
+                return 0
+        sys.stderr.write(f"spotrf child failed (rc={r.returncode}): "
+                         f"{(r.stderr or '')[-400:]}\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"spotrf child exceeded {budget}s "
+                         "(TPU tunnel unreachable?); falling back\n")
+    print(_dispatch_json())
     return 0
 
 

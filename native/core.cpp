@@ -918,6 +918,7 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
   }
   case PTC_BODY_DEVICE: {
     DeviceQueue *q = ctx->dev_queues[(size_t)dx->body_arg];
+    q->depth.fetch_add(1, std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> g(q->lock);
       q->dq.push_back(t);
@@ -955,6 +956,34 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
   ptc_taskpool *tp = t->tp;
   TaskClass &tc = tp->classes[(size_t)t->class_id];
   prepare_input(ctx, t);
+  /* best-device selection (reference: parsec_get_best_device,
+   * parsec/mca/device/device.c:79-160): when a class offers several
+   * enabled DEVICE chores and the first enabled chore is one of them,
+   * route to the queue with the lowest load/weight instead of blindly
+   * taking declaration order.  CPU-first classes are untouched. */
+  if (t->chore_idx == 0) {
+    int32_t best = -1, n_dev = 0;
+    double best_load = 0.0;
+    bool first_enabled_is_device = false;
+    for (int32_t i = 0; i < (int32_t)tc.chores.size(); i++) {
+      Chore &ch = tc.chores[(size_t)i];
+      if (ch.disabled.load(std::memory_order_relaxed)) continue;
+      bool is_dev = (ch.body_kind == PTC_BODY_DEVICE);
+      if (n_dev == 0 && best == -1 && !is_dev) break; /* CPU first: keep */
+      if (!is_dev) continue;
+      if (best == -1) first_enabled_is_device = true;
+      DeviceQueue *q = ctx->dev_queues[(size_t)ch.body_arg];
+      double w = q->weight.load(std::memory_order_relaxed);
+      /* projected completion load INCLUDING this task (+1): an idle slow
+       * device must not tie with a fast one (reference folds the task's
+       * own weight in the same way, device.c:129-141) */
+      double load = (1.0 + (double)q->depth.load(std::memory_order_relaxed))
+                    / (w > 0.0 ? w : 1e-9);
+      if (best == -1 || load < best_load) { best = i; best_load = load; }
+      n_dev++;
+    }
+    if (first_enabled_is_device && n_dev >= 2) t->chore_idx = best;
+  }
   while (t->chore_idx < (int32_t)tc.chores.size()) {
     Chore &ch = tc.chores[(size_t)t->chore_idx];
     if (ch.disabled.load(std::memory_order_relaxed)) { t->chore_idx++; continue; }
@@ -972,6 +1001,7 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
     }
     case PTC_BODY_DEVICE: {
       DeviceQueue *q = ctx->dev_queues[(size_t)ch.body_arg];
+      q->depth.fetch_add(1, std::memory_order_relaxed);
       {
         std::lock_guard<std::mutex> g(q->lock);
         q->dq.push_back(t);
@@ -1453,6 +1483,16 @@ int32_t ptc_device_queue_new(ptc_context_t *ctx) {
   return (int32_t)ctx->dev_queues.size() - 1;
 }
 
+void ptc_device_queue_set_weight(ptc_context_t *ctx, int32_t qid, double w) {
+  if (qid < 0 || (size_t)qid >= ctx->dev_queues.size()) return;
+  ctx->dev_queues[(size_t)qid]->weight.store(w, std::memory_order_relaxed);
+}
+
+int64_t ptc_device_queue_depth(ptc_context_t *ctx, int32_t qid) {
+  if (qid < 0 || (size_t)qid >= ctx->dev_queues.size()) return -1;
+  return ctx->dev_queues[(size_t)qid]->depth.load(std::memory_order_relaxed);
+}
+
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) {
   DeviceQueue *q = ctx->dev_queues[(size_t)qid];
   std::unique_lock<std::mutex> lk(q->lock);
@@ -1467,11 +1507,31 @@ ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) 
   return t;
 }
 
+/* depth bookkeeping for load balancing: resolve which device queue an
+ * ASYNC task was routed to (PTG: its current chore; DTD: its body) */
+static void device_task_done(ptc_context *ctx, ptc_task *t) {
+  int64_t qid = -1;
+  if (t->dyn) {
+    if (t->dyn->body_kind == PTC_BODY_DEVICE) qid = t->dyn->body_arg;
+  } else {
+    const TaskClass &tc = t->tp->classes[(size_t)t->class_id];
+    if (t->chore_idx < (int32_t)tc.chores.size()) {
+      const Chore &ch = tc.chores[(size_t)t->chore_idx];
+      if (ch.body_kind == PTC_BODY_DEVICE) qid = ch.body_arg;
+    }
+  }
+  if (qid >= 0 && qid < (int64_t)ctx->dev_queues.size())
+    ctx->dev_queues[(size_t)qid]->depth.fetch_sub(
+        1, std::memory_order_relaxed);
+}
+
 void ptc_task_complete(ptc_context_t *ctx, ptc_task_t *task) {
+  device_task_done(ctx, task);
   complete_task(ctx, -1, task);
 }
 
 void ptc_task_fail(ptc_context_t *ctx, ptc_task_t *task) {
+  device_task_done(ctx, task);
   std::fprintf(stderr, "ptc: async task failed; aborting taskpool\n");
   if (task->dyn)
     dyn_fail_task(ctx, task);

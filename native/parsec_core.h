@@ -200,6 +200,11 @@ int64_t  ptc_tp_global(ptc_taskpool_t *tp, int32_t i);
  * This is the seam the TPU device module plugs into (reference analog:
  * the CUDA manager thread + pending fifo, device_cuda_module.c:2563).  */
 int32_t ptc_device_queue_new(ptc_context_t *ctx);
+/* load balancing (reference: parsec_get_best_device, device.c:79): when a
+ * task class offers several enabled device chores, the runtime routes each
+ * task to the queue minimising depth/weight; weight = relative speed */
+void ptc_device_queue_set_weight(ptc_context_t *ctx, int32_t qid, double w);
+int64_t ptc_device_queue_depth(ptc_context_t *ctx, int32_t qid);
 /* blocking pop with timeout (ms); NULL on timeout or shutdown */
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms);
 /* completion entry point for ASYNC owners (any thread) */

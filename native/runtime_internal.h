@@ -256,6 +256,11 @@ struct DeviceQueue {
   std::mutex lock;
   std::condition_variable cv;
   std::deque<ptc_task *> dq;
+  /* load-balancing inputs (reference: parsec_get_best_device's
+   * flop-rate weights + per-device load, parsec/mca/device/device.c:79;
+   * weights device.h:137-140) */
+  std::atomic<int64_t> depth{0};     /* tasks queued, not yet completed */
+  std::atomic<double> weight{1.0};   /* relative device speed */
 };
 
 struct ProfBuf {

@@ -144,6 +144,8 @@ _sigs = {
     "ptc_task_copy": (C.c_void_p, [C.c_void_p, C.c_int32]),
     "ptc_task_taskpool": (C.c_void_p, [C.c_void_p]),
     "ptc_device_queue_new": (C.c_int32, [C.c_void_p]),
+    "ptc_device_queue_set_weight": (None, [C.c_void_p, C.c_int32, C.c_double]),
+    "ptc_device_queue_depth": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_device_pop": (C.c_void_p, [C.c_void_p, C.c_int32, C.c_int32]),
     "ptc_task_complete": (None, [C.c_void_p, C.c_void_p]),
     "ptc_dtile_new": (C.c_void_p, [C.c_void_p, C.c_void_p]),

@@ -55,7 +55,8 @@ class Context:
         if scheduler != "lfq":
             N.lib.ptc_context_set_scheduler(self._ptr, scheduler.encode())
         if _mca.get("runtime.profile"):
-            N.lib.ptc_profile_enable(self._ptr, 1)
+            # same meaning as profile_enable(True): full tracing incl. EDGE
+            N.lib.ptc_profile_enable(self._ptr, 2)
         # keep-alives: ctypes callbacks must outlive the native context
         self._expr_cbs: List = []
         self._body_cbs: List = []
@@ -196,6 +197,14 @@ class Context:
         return aid
 
     # ------------------------------------------------------------ devices
+    def device_queue_set_weight(self, qid: int, weight: float):
+        """Relative device speed for best-device routing (reference:
+        the per-device flop-rate weights, parsec/mca/device/device.h:137)."""
+        N.lib.ptc_device_queue_set_weight(self._ptr, qid, float(weight))
+
+    def device_queue_depth(self, qid: int) -> int:
+        return N.lib.ptc_device_queue_depth(self._ptr, qid)
+
     def device_queue_new(self) -> int:
         return N.lib.ptc_device_queue_new(self._ptr)
 

@@ -103,8 +103,14 @@ class Params:
 
     def set(self, name: str, value: Any):
         p = self._reg[name]
-        p.value = _coerce(str(value), p.type) if not isinstance(
-            value, p.type) else value
+        # bool is an int subclass: route bools given for int params (and
+        # any non-exact type) through the param's constructor, not str()
+        if type(value) is p.type:
+            p.value = value
+        elif isinstance(value, str):
+            p.value = _coerce(value, p.type)
+        else:
+            p.value = p.type(value)
         p.source = "set"
 
     def unset(self, name: str):

@@ -1,0 +1,63 @@
+"""Reshape paths: dtype conversion (map_operator) + regridding
+(redistribute) — reference: parsec/parsec_reshape.c + the 14-JDF reshape
+suite in tests/collections/reshape/ (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.algos import build_reshape_dtype, reshape_geometry
+from parsec_tpu.data import TwoDimBlockCyclic
+
+
+def test_dtype_cast_f32_to_f64():
+    with pt.Context(nb_workers=1) as ctx:
+        src = TwoDimBlockCyclic(48, 48, 16, 16, dtype=np.float32)
+        dst = TwoDimBlockCyclic(48, 48, 16, 16, dtype=np.float64)
+        src.register(ctx, "RSsrc")
+        dst.register(ctx, "RSdst")
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((48, 48)).astype(np.float32)
+        src.from_dense(a)
+        tp = build_reshape_dtype(ctx, src, dst)
+        tp.run()
+        tp.wait()
+        out = dst.to_dense()
+    assert out.dtype == np.float64
+    np.testing.assert_allclose(out, a.astype(np.float64))
+
+
+def test_dtype_cast_with_transform():
+    with pt.Context(nb_workers=1) as ctx:
+        src = TwoDimBlockCyclic(32, 32, 8, 8, dtype=np.float32)
+        dst = TwoDimBlockCyclic(32, 32, 8, 8, dtype=np.int32)
+        src.register(ctx, "RSsrc")
+        dst.register(ctx, "RSdst")
+        a = np.arange(1024, dtype=np.float32).reshape(32, 32) / 7.0
+        src.from_dense(a)
+        tp = build_reshape_dtype(ctx, src, dst, cast=np.floor)
+        tp.run()
+        tp.wait()
+        out = dst.to_dense()
+    np.testing.assert_array_equal(out, np.floor(a).astype(np.int32))
+
+
+def test_geometry_mismatch_rejected():
+    with pt.Context(nb_workers=1) as ctx:
+        src = TwoDimBlockCyclic(32, 32, 8, 8)
+        dst = TwoDimBlockCyclic(32, 32, 16, 16)
+        src.register(ctx, "RSsrc")
+        dst.register(ctx, "RSdst")
+        with pytest.raises(ValueError, match="matching tile grids"):
+            build_reshape_dtype(ctx, src, dst)
+
+
+def test_regrid_via_redistribute():
+    with pt.Context(nb_workers=1) as ctx:
+        src = TwoDimBlockCyclic(40, 40, 8, 8, dtype=np.float32)
+        dst = TwoDimBlockCyclic(40, 40, 16, 16, dtype=np.float32)
+        src.register(ctx, "src")
+        dst.register(ctx, "dst")
+        a = np.arange(1600, dtype=np.float32).reshape(40, 40)
+        src.from_dense(a)
+        reshape_geometry(ctx, src, dst)
+        np.testing.assert_array_equal(dst.to_dense(), a)

@@ -1,0 +1,63 @@
+"""Best-device routing: tasks with several device chores go to the queue
+minimising depth/weight (reference: parsec_get_best_device,
+parsec/mca/device/device.c:79-160 with flop-rate weights)."""
+import threading
+
+import pytest
+
+import parsec_tpu as pt
+
+
+def _manager(ctx, qid, counts, delay_lock):
+    """Pop + complete loop standing in for a device manager thread."""
+    while True:
+        t = ctx.device_pop(qid, timeout_ms=50)
+        if t is None:
+            if counts.get("stop"):
+                return
+            continue
+        counts[qid] = counts.get(qid, 0) + 1
+        ctx.task_complete(t)
+
+
+def _run_fan(weights, nb=60):
+    counts = {}
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        q0 = ctx.device_queue_new()
+        q1 = ctx.device_queue_new()
+        ctx.device_queue_set_weight(q0, weights[0])
+        ctx.device_queue_set_weight(q1, weights[1])
+        thr = [threading.Thread(target=_manager, args=(ctx, q, counts, None),
+                                daemon=True) for q in (q0, q1)]
+        for t in thr:
+            t.start()
+        tp = pt.Taskpool(ctx, globals={"NB": nb - 1})
+        k = pt.L("k")
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW", pt.In(None), arena="t")
+        tc.body_device(q0)
+        tc.body_device(q1)
+        tp.run()
+        tp.wait()
+        counts["stop"] = True
+        for t in thr:
+            t.join()
+        assert ctx.device_queue_depth(q0) == 0
+        assert ctx.device_queue_depth(q1) == 0
+    return counts.get(0, 0), counts.get(1, 0)
+
+
+def test_balanced_weights_split_work():
+    c0, c1 = _run_fan((1.0, 1.0))
+    assert c0 + c1 == 60
+    # the independent fan floods both queues; (depth+1)/weight routing
+    # then alternates, so neither queue may starve
+    assert min(c0, c1) >= 5, (c0, c1)
+
+
+def test_skewed_weights_prefer_fast_device():
+    c0, c1 = _run_fan((1000.0, 0.001))
+    assert c0 + c1 == 60
+    assert c0 >= 55, (c0, c1)   # nearly everything routes to the fast queue

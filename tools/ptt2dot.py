@@ -6,9 +6,10 @@ Usage: python tools/ptt2dot.py out.dot rank0.ptt [rank1.ptt ...] \
            [--classes Name0,Name1,...]
 Needs traces taken at profile level 2 (EDGE events)."""
 import argparse
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from parsec_tpu.profiling import Trace, to_dot  # noqa: E402
 

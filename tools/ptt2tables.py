@@ -7,9 +7,10 @@ Merges per-rank traces and writes one table; falls back to CSV when no
 HDF5 backend is available in the environment.
 """
 import argparse
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from parsec_tpu.profiling import Trace  # noqa: E402
 

@@ -5,9 +5,10 @@ Usage: python tools/ptt_info.py trace.ptt [more.ptt ...]
 Prints per-file dictionary, event counts, span statistics per task class.
 """
 import argparse
+import os
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from parsec_tpu.profiling import Trace  # noqa: E402
 
