@@ -28,11 +28,19 @@
  *   DTD_DONE (3) := [i32 tp_id][u64 seq][u64 len]
  *                   ([u32 flow][u64 len][bytes])*
  *   FENCE    (4) := [u64 generation]
+ *   ACTIVATE_BCAST (5) := [i32 tp_id][i32 flow_idx][u8 topo][u32 nb_groups]
+ *                   ([u32 rank][u32 nb_targets] targets*)* [u64 plen][payload]
+ *     — activation propagation along a broadcast topology (reference:
+ *     runtime_comm_coll_bcast chain/binomial, parsec/remote_dep.c:39-47):
+ *     each receiving rank takes group[0] (its own), re-forwards the
+ *     remaining groups to its children per `topo`, re-rooting the payload.
  */
 
 #include "runtime_internal.h"
 
+#include <algorithm>
 #include <arpa/inet.h>
+#include <map>
 #include <cerrno>
 #include <cstdio>
 #include <fcntl.h>
@@ -49,6 +57,7 @@ enum {
   MSG_PUT = 2,
   MSG_DTD_DONE = 3,
   MSG_FENCE = 4,
+  MSG_ACTIVATE_BCAST = 5,
 };
 
 struct Frame {
@@ -62,6 +71,10 @@ struct Peer {
   std::deque<std::vector<uint8_t>> out; /* pending frames */
   size_t out_off = 0; /* sent prefix of out.front() */
   uint64_t fence_gen = 0; /* highest fence generation received */
+  /* per-generation activity flags of this peer's fences (pruned by the
+   * fence waiter); needed because a fast peer may already be a round
+   * ahead when we read its flag */
+  std::map<uint64_t, uint8_t> fence_dirty;
 };
 
 struct Writer {
@@ -107,6 +120,10 @@ struct CommEngine {
   std::mutex lock; /* protects peers[].out + fence state */
   std::condition_variable fence_cv;
   uint64_t fence_next = 1; /* next generation to issue */
+  /* payload-bearing sends (everything but FENCE frames), incl. relayed
+   * broadcast forwards; drives the multi-round fence (see ptc_comm_fence) */
+  std::atomic<uint64_t> activity{0};
+  uint64_t fence_prev_activity = 0; /* under lock; last round's snapshot */
 
   /* stats (reference: parsec/remote_dep.c counters) */
   std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
@@ -132,10 +149,13 @@ static void comm_wake(CommEngine *ce) {
 /* enqueue a finished frame for `rank` (worker threads call this) */
 static void comm_post(CommEngine *ce, uint32_t rank,
                       std::vector<uint8_t> &&frame) {
+  bool is_fence = frame.size() > 4 && frame[4] == MSG_FENCE;
   {
     std::lock_guard<std::mutex> g(ce->lock);
     ce->peers[rank].out.push_back(std::move(frame));
   }
+  if (!is_fence)
+    ce->activity.fetch_add(1, std::memory_order_relaxed);
   ce->msgs_sent.fetch_add(1, std::memory_order_relaxed);
   comm_wake(ce);
 }
@@ -158,6 +178,66 @@ static ptc_taskpool *find_tp(ptc_context *ctx, int32_t tp_id) {
   std::lock_guard<std::mutex> g(ctx->tp_reg_lock);
   auto it = ctx->tp_registry.find(tp_id);
   return it == ctx->tp_registry.end() ? nullptr : it->second;
+}
+
+struct WireTarget {
+  int32_t class_id;
+  std::vector<int64_t> params;
+};
+
+/* parse nb_targets serialized targets ([i32 class][u8 np][i64 params]*) */
+static std::vector<WireTarget> parse_targets(Reader &r, uint32_t nb_targets) {
+  std::vector<WireTarget> targets;
+  targets.reserve(nb_targets);
+  for (uint32_t i = 0; i < nb_targets && r.ok; i++) {
+    WireTarget t;
+    t.class_id = r.i32();
+    uint8_t np = r.u8();
+    t.params.resize(np);
+    for (uint8_t k = 0; k < np; k++) t.params[k] = r.i64();
+    targets.push_back(std::move(t));
+  }
+  return targets;
+}
+
+/* Deliver parsed targets: ONE ptc_copy is materialized from the wire
+ * payload (the stages then hold refs), each target's dep is released
+ * locally.  Shared by the direct ACTIVATE path and the broadcast relay
+ * path (which must not pay an extra payload copy per hop). */
+static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
+                            int32_t flow_idx,
+                            std::vector<WireTarget> &&targets,
+                            const uint8_t *payload, uint64_t plen) {
+  ptc_copy *copy = nullptr;
+  if (plen > 0) {
+    copy = new ptc_copy();
+    copy->ptr = std::malloc((size_t)plen);
+    copy->size = (int64_t)plen;
+    copy->owns_ptr = true;
+    std::memcpy(copy->ptr, payload, (size_t)plen);
+  }
+  for (WireTarget &t : targets) {
+    ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
+                     t.params.size() > 0 ? t.params[0] : 0,
+                     t.params.size() > 1 ? t.params[1] : 0,
+                     copy ? copy->size : 0);
+    ptc_deliver_dep_local(ctx, -1, tp, t.class_id, std::move(t.params),
+                          flow_idx, copy);
+  }
+  if (copy) ptc_copy_release_internal(ctx, copy); /* stages hold refs now */
+}
+
+/* parse [u32-already-read nb_targets] targets + [u64 plen][payload] */
+static void deliver_targets_wire(ptc_context *ctx, ptc_taskpool *tp,
+                                 int32_t flow_idx, uint32_t nb_targets,
+                                 Reader &r) {
+  std::vector<WireTarget> targets = parse_targets(r, nb_targets);
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
+    return;
+  }
+  deliver_targets(ctx, tp, flow_idx, std::move(targets), r.p, plen);
 }
 
 /* body excludes the type byte */
@@ -195,43 +275,7 @@ static void handle_activate_body(ptc_context *ctx, const uint8_t *body,
       return;
     }
   }
-  struct Target {
-    int32_t class_id;
-    std::vector<int64_t> params;
-  };
-  std::vector<Target> targets;
-  targets.reserve(nb_targets);
-  for (uint32_t i = 0; i < nb_targets && r.ok; i++) {
-    Target t;
-    t.class_id = r.i32();
-    uint8_t np = r.u8();
-    t.params.resize(np);
-    for (uint8_t k = 0; k < np; k++) t.params[k] = r.i64();
-    targets.push_back(std::move(t));
-  }
-  uint64_t plen = r.u64();
-  if (!r.ok || (size_t)(r.end - r.p) < plen) {
-    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
-    return;
-  }
-  ptc_copy *copy = nullptr;
-  if (plen > 0) {
-    copy = new ptc_copy();
-    copy->ptr = std::malloc((size_t)plen);
-    copy->size = (int64_t)plen;
-    copy->owns_ptr = true;
-    std::memcpy(copy->ptr, r.p, (size_t)plen);
-  }
-  for (Target &t : targets) {
-    ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
-                     t.params.size() > 0 ? t.params[0] : 0,
-                     t.params.size() > 1 ? t.params[1] : 0,
-                     copy ? copy->size : 0);
-    std::vector<int64_t> params(t.params);
-    ptc_deliver_dep_local(ctx, -1, tp, t.class_id, std::move(params),
-                          flow_idx, copy);
-  }
-  if (copy) ptc_copy_release_internal(ctx, copy); /* stages hold refs now */
+  deliver_targets_wire(ctx, tp, flow_idx, nb_targets, r);
 }
 
 static void handle_put_body(ptc_context *ctx, const uint8_t *body, size_t len) {
@@ -286,6 +330,114 @@ static void handle_dtd_done_body(ptc_context *ctx, const uint8_t *body,
   ptc_dtd_shadow_ready(ctx, tp, seq, r.p, (size_t)plen);
 }
 
+/* ---- broadcast-topology fanout -----------------------------------
+ * `groups` is an ordered slice of (rank, serialized-targets) pairs; the
+ * fanout sends slice [i, i+take) to groups[i].rank where take = all
+ * (chain: one child relays everything) or half (binomial: log-depth
+ * tree).  Topology ids: 0 star (never framed), 1 chain, 2 binomial.   */
+struct BcastWireGroup {
+  uint32_t rank;
+  std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
+  int32_t first_class = -1;           /* for COMM_SEND events */
+};
+
+static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
+                         uint8_t topo,
+                         const std::vector<BcastWireGroup> &groups,
+                         size_t i0, const uint8_t *payload, uint64_t plen) {
+  size_t i = i0;
+  while (i < groups.size()) {
+    size_t n = groups.size() - i;
+    size_t take = (topo == 2) ? (n + 1) / 2 : n;
+    std::vector<uint8_t> f = frame_begin(MSG_ACTIVATE_BCAST);
+    Writer w{f};
+    w.i32(tp_id);
+    w.i32(flow_idx);
+    w.u8(topo);
+    w.u32((uint32_t)take);
+    for (size_t k = i; k < i + take; k++) {
+      w.u32(groups[k].rank);
+      w.raw(groups[k].targets_bytes.data(), groups[k].targets_bytes.size());
+    }
+    w.u64(plen);
+    if (plen) w.raw(payload, (size_t)plen);
+    frame_finish(f);
+    ptc_prof_instant(ce->ctx, PROF_KEY_COMM_SEND, groups[i].first_class,
+                     (int64_t)groups[i].rank, (int64_t)(take - 1),
+                     (int64_t)plen);
+    comm_post(ce, groups[i].rank, std::move(f));
+    i += take;
+  }
+}
+
+static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
+                                       size_t len) {
+  ptc_context *ctx = ce->ctx;
+  Reader r{body, body + len};
+  int32_t tp_id = r.i32();
+  int32_t flow_idx = r.i32();
+  uint8_t topo = r.u8();
+  uint32_t nb_groups = r.u32();
+  std::vector<BcastWireGroup> groups;
+  groups.reserve(nb_groups);
+  std::vector<uint8_t> my_targets; /* serialized targets of my group */
+  for (uint32_t gidx = 0; gidx < nb_groups && r.ok; gidx++) {
+    uint32_t rank = r.u32();
+    const uint8_t *start = r.p;
+    uint32_t nb_targets = r.u32();
+    int32_t first_class = -1;
+    for (uint32_t t = 0; t < nb_targets && r.ok; t++) {
+      int32_t cid = r.i32();
+      if (t == 0) first_class = cid;
+      uint8_t np = r.u8();
+      for (uint8_t k = 0; k < np; k++) (void)r.i64();
+    }
+    if (!r.ok) break;
+    std::vector<uint8_t> bytes(start, r.p);
+    if (rank == ce->myrank && my_targets.empty()) {
+      my_targets = std::move(bytes);
+    } else {
+      groups.push_back(BcastWireGroup{rank, std::move(bytes), first_class});
+    }
+  }
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE_BCAST dropped\n");
+    return;
+  }
+  /* forward FIRST (latency: children start their pulls while we deliver;
+   * forwarding needs no taskpool knowledge, so SPMD skew cannot stall
+   * the tree) */
+  bcast_fanout(ce, tp_id, flow_idx, topo, groups, 0, r.p, plen);
+  if (my_targets.empty()) {
+    std::fprintf(stderr, "ptc-comm: ACTIVATE_BCAST without my group; "
+                         "forwarded only\n");
+    return;
+  }
+  ptc_taskpool *tp = find_tp(ctx, tp_id);
+  if (tp) {
+    /* common path: deliver straight from the wire buffer — no extra
+     * payload copy per relay hop */
+    Reader tr{my_targets.data(), my_targets.data() + my_targets.size()};
+    uint32_t nb_targets = tr.u32();
+    deliver_targets(ctx, tp, flow_idx, parse_targets(tr, nb_targets),
+                    r.p, plen);
+    return;
+  }
+  /* unknown taskpool (SPMD skew): synthesize a plain ACTIVATE body and
+   * reuse its delivery + parking path (a parked frame must NOT re-forward
+   * on replay — the synthesized frame cannot) */
+  std::vector<uint8_t> synth;
+  synth.reserve(8 + my_targets.size() + 8 + (size_t)plen);
+  Writer w{synth};
+  w.i32(tp_id);
+  w.i32(flow_idx);
+  w.raw(my_targets.data(), my_targets.size());
+  w.u64(plen);
+  if (plen) w.raw(r.p, (size_t)plen);
+  handle_activate_body(ctx, synth.data(), synth.size(), /*allow_park=*/true);
+}
+
 static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
                          const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
@@ -293,6 +445,9 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   switch (type) {
   case MSG_ACTIVATE:
     handle_activate_body(ctx, body, len, /*allow_park=*/true);
+    break;
+  case MSG_ACTIVATE_BCAST:
+    handle_activate_bcast_body(ce, body, len);
     break;
   case MSG_PUT:
     handle_put_body(ctx, body, len);
@@ -303,9 +458,11 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   case MSG_FENCE: {
     Reader r{body, body + len};
     uint64_t gen = r.u64();
+    uint8_t dirty = r.u8();
     {
       std::lock_guard<std::mutex> g(ce->lock);
       if (gen > ce->peers[from].fence_gen) ce->peers[from].fence_gen = gen;
+      ce->peers[from].fence_dirty[gen] = dirty;
     }
     ce->fence_cv.notify_all();
     break;
@@ -541,6 +698,48 @@ void ptc_comm_send_activate(ptc_context *ctx, uint32_t rank, ptc_taskpool *tp,
   ptc_comm_send_activate_batch(ctx, rank, tp, flow_idx, copy, targets);
 }
 
+void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
+                                  int32_t flow_idx, ptc_copy *copy,
+                                  int32_t topo,
+                                  std::vector<PtcBcastRankGroup> &&groups) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) {
+    static std::atomic<bool> warned{false};
+    if (!warned.exchange(true))
+      std::fprintf(stderr, "ptc: remote successors with no comm engine; "
+                           "broadcast dropped\n");
+    return;
+  }
+  /* ring order from this rank so the chain walks rank+1, rank+2, ...
+   * (reference chain child computation, remote_dep.c:43) */
+  std::sort(groups.begin(), groups.end(),
+            [&](const PtcBcastRankGroup &a, const PtcBcastRankGroup &b) {
+              uint32_t da = (a.rank + ce->nodes - ce->myrank) % ce->nodes;
+              uint32_t db = (b.rank + ce->nodes - ce->myrank) % ce->nodes;
+              return da < db;
+            });
+  std::vector<BcastWireGroup> wire;
+  wire.reserve(groups.size());
+  for (PtcBcastRankGroup &g : groups) {
+    BcastWireGroup wg;
+    wg.rank = g.rank;
+    wg.first_class = g.targets.empty() ? -1 : g.targets[0].first;
+    Writer w{wg.targets_bytes};
+    w.u32((uint32_t)g.targets.size());
+    for (auto &t : g.targets) {
+      w.i32(t.first);
+      w.u8((uint8_t)t.second.size());
+      for (int64_t v : t.second) w.i64(v);
+    }
+    wire.push_back(std::move(wg));
+  }
+  const uint8_t *payload =
+      (copy && copy->ptr && copy->size > 0) ? (const uint8_t *)copy->ptr
+                                            : nullptr;
+  uint64_t plen = payload ? (uint64_t)copy->size : 0;
+  bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, payload, plen);
+}
+
 void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
                            const int64_t *idx, int32_t nidx, ptc_copy *copy) {
   CommEngine *ce = ctx->comm;
@@ -703,37 +902,71 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
   return 0;
 }
 
-/* Fence: flush all queued sends + wait until every peer's fence of this
- * generation arrived.  TCP per-peer FIFO + in-order frame processing give
- * the flush guarantee: once FENCE(gen) from peer p is processed, every
- * earlier message from p has been applied.  (Reference: comm barrier +
- * termdet flush semantics.) */
+void ptc_comm_set_topology(ptc_context_t *ctx, int32_t topo) {
+  ctx->comm_topo.store(topo < 0 ? 0 : (topo > 2 ? 0 : topo),
+                       std::memory_order_relaxed);
+}
+
+/* Fence: repeated all-to-all rounds until a round observes NO
+ * payload-bearing send anywhere since the previous round.
+ *
+ * Round r: every rank posts FENCE(r, dirty) where dirty = "I posted a
+ * non-fence frame since my round r-1 snapshot", then waits for all
+ * FENCE(r).  TCP per-peer FIFO + in-order frame processing guarantee
+ * that every direct message posted before a rank's FENCE(r) is applied
+ * at its target before the target completes round r; a message RELAYED
+ * by a forwarding rank (chain/binomial ACTIVATE_BCAST) after that rank's
+ * FENCE(r) went out flips its round-r+1 dirty flag instead.  Hence an
+ * all-clean round proves global quiescence, including multi-hop relays.
+ * The dirty decision is uniform (every rank sees the same flag set), so
+ * all ranks run the same number of rounds.  (Reference: comm barrier +
+ * termdet flush; the round protocol is a simplified Mattern/fourcounter
+ * wave, parsec/mca/termdet/fourcounter.) */
 int32_t ptc_comm_fence(ptc_context_t *ctx) {
   CommEngine *ce = ctx->comm;
   if (!ce) return 0;
-  uint64_t gen;
-  {
-    std::lock_guard<std::mutex> g(ce->lock);
-    gen = ce->fence_next++;
-  }
-  for (uint32_t r = 0; r < ce->nodes; r++) {
-    if (r == ce->myrank) continue;
-    std::vector<uint8_t> f = frame_begin(MSG_FENCE);
-    Writer w{f};
-    w.u64(gen);
-    frame_finish(f);
-    comm_post(ce, r, std::move(f));
-  }
-  std::unique_lock<std::mutex> g(ce->lock);
-  ce->fence_cv.wait(g, [&] {
-    if (ce->stop.load(std::memory_order_acquire)) return true;
+  while (true) {
+    uint64_t gen;
+    uint8_t mydirty;
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      gen = ce->fence_next++;
+      uint64_t act = ce->activity.load(std::memory_order_relaxed);
+      mydirty = (act != ce->fence_prev_activity) ? 1 : 0;
+      ce->fence_prev_activity = act;
+    }
     for (uint32_t r = 0; r < ce->nodes; r++) {
       if (r == ce->myrank) continue;
-      if (ce->peers[r].fence_gen < gen) return false;
+      std::vector<uint8_t> f = frame_begin(MSG_FENCE);
+      Writer w{f};
+      w.u64(gen);
+      w.u8(mydirty);
+      frame_finish(f);
+      comm_post(ce, r, std::move(f));
     }
-    return true;
-  });
-  return 0;
+    bool any_dirty = mydirty != 0;
+    {
+      std::unique_lock<std::mutex> g(ce->lock);
+      ce->fence_cv.wait(g, [&] {
+        if (ce->stop.load(std::memory_order_acquire)) return true;
+        for (uint32_t r = 0; r < ce->nodes; r++) {
+          if (r == ce->myrank) continue;
+          if (ce->peers[r].fence_gen < gen ||
+              !ce->peers[r].fence_dirty.count(gen))
+            return false;
+        }
+        return true;
+      });
+      if (ce->stop.load(std::memory_order_acquire)) return 0;
+      for (uint32_t r = 0; r < ce->nodes; r++) {
+        if (r == ce->myrank) continue;
+        auto &m = ce->peers[r].fence_dirty;
+        any_dirty = any_dirty || (m.count(gen) && m[gen]);
+        m.erase(m.begin(), m.upper_bound(gen));
+      }
+    }
+    if (!any_dirty) return 0;
+  }
 }
 
 int32_t ptc_comm_enabled(ptc_context_t *ctx) { return ctx->comm ? 1 : 0; }

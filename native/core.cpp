@@ -672,9 +672,40 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
       }
     }
   }
-  for (RemoteSend &rs : batch)
-    ptc_comm_send_activate_batch(ctx, rs.rank, tp, rs.flow_idx, rs.copy,
-                                 rs.targets);
+  int32_t topo = ctx->comm_topo.load(std::memory_order_relaxed);
+  if (topo == 0) {
+    for (RemoteSend &rs : batch)
+      ptc_comm_send_activate_batch(ctx, rs.rank, tp, rs.flow_idx, rs.copy,
+                                   rs.targets);
+  } else {
+    /* chain/binomial propagation: sends of the SAME output copy to several
+     * ranks become one broadcast the comm layer forwards along the
+     * topology (reference: remote_dep_bcast_*_child, remote_dep.c:39-47) */
+    for (size_t i = 0; i < batch.size(); i++) {
+      if (batch[i].rank == UINT32_MAX) continue;
+      std::vector<PtcBcastRankGroup> groups;
+      groups.push_back(
+          PtcBcastRankGroup{batch[i].rank, std::move(batch[i].targets)});
+      for (size_t j = i + 1; j < batch.size(); j++) {
+        if (batch[j].rank != UINT32_MAX &&
+            batch[j].flow_idx == batch[i].flow_idx &&
+            batch[j].copy == batch[i].copy) {
+          groups.push_back(
+              PtcBcastRankGroup{batch[j].rank, std::move(batch[j].targets)});
+          batch[j].rank = UINT32_MAX;
+        }
+      }
+      if (groups.size() >= 2) {
+        ptc_comm_send_activate_bcast(ctx, tp, batch[i].flow_idx,
+                                     batch[i].copy, topo, std::move(groups));
+      } else {
+        ptc_comm_send_activate_batch(ctx, batch[i].rank, tp,
+                                     batch[i].flow_idx, batch[i].copy,
+                                     groups[0].targets);
+      }
+      batch[i].rank = UINT32_MAX;
+    }
+  }
 }
 
 static void wake_workers(ptc_context *ctx) {

@@ -270,6 +270,9 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port);
 /* flush queued sends + wait for every peer's matching fence: after this,
  * all messages sent before any rank's fence have been applied everywhere */
 int32_t ptc_comm_fence(ptc_context_t *ctx);
+/* activation-broadcast topology: 0 star (direct per-rank sends, default),
+ * 1 chain pipeline, 2 binomial tree (reference: runtime_comm_coll_bcast) */
+void ptc_comm_set_topology(ptc_context_t *ctx, int32_t topo);
 /* fence + stop the comm thread (idempotent) */
 int32_t ptc_comm_fini(ptc_context_t *ctx);
 int32_t ptc_comm_enabled(ptc_context_t *ctx);

@@ -338,6 +338,9 @@ struct ptc_context {
   std::mutex reg_lock;
 
   uint32_t myrank = 0, nodes = 1;
+  /* activation-broadcast topology: 0 star (direct sends), 1 chain,
+   * 2 binomial (reference: runtime_comm_coll_bcast, remote_dep.c:39-47) */
+  std::atomic<int32_t> comm_topo{0};
 
   /* active taskpools */
   std::atomic<int64_t> active_tps{0};
@@ -427,6 +430,18 @@ void ptc_comm_send_activate(ptc_context *ctx, uint32_t rank, ptc_taskpool *tp,
 
 /* batched form: several successor instances sharing one payload copy
  * (reference: per-rank output bitmaps, parsec/remote_dep.h:143-177) */
+/* one rank's targets within an activation broadcast */
+struct PtcBcastRankGroup {
+  uint32_t rank;
+  std::vector<std::pair<int32_t, std::vector<int64_t>>> targets;
+};
+/* propagate one output copy's activations to several ranks along the
+ * chain/binomial topology (topo 1/2); caller keeps ownership of copy */
+void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
+                                  int32_t flow_idx, ptc_copy *copy,
+                                  int32_t topo,
+                                  std::vector<PtcBcastRankGroup> &&groups);
+
 void ptc_comm_send_activate_batch(
     ptc_context *ctx, uint32_t rank, ptc_taskpool *tp, int32_t flow_idx,
     ptc_copy *copy,

@@ -112,11 +112,30 @@ class Context:
         comm engine + remote_dep protocol, parsec/parsec_comm_engine.h,
         parsec/remote_dep.c — SURVEY.md §2.5).  Call set_rank first;
         blocks until all ranks are connected."""
+        from ..utils import params as _mca
         if base_port is None:
-            from ..utils import params as _mca
             base_port = _mca.get("comm.base_port")
         if N.lib.ptc_comm_init(self._ptr, base_port) != 0:
             raise RuntimeError("comm engine init failed")
+        topo = _mca.get("comm.bcast_topo")
+        if topo != "star":
+            self.comm_set_topology(topo)
+
+    def comm_set_topology(self, topo):
+        """Activation-broadcast propagation topology: "star" (direct
+        per-rank sends), "chain" (pipeline along the ring), "binomial"
+        (log-depth tree).  Reference: runtime_comm_coll_bcast,
+        parsec/remote_dep.c:39-47."""
+        names = {"star": 0, "chain": 1, "binomial": 2}
+        if isinstance(topo, str):
+            if topo not in names:
+                raise ValueError(
+                    f"unknown broadcast topology {topo!r} "
+                    f"(comm.bcast_topo): expected one of {sorted(names)}")
+            t = names[topo]
+        else:
+            t = int(topo)
+        N.lib.ptc_comm_set_topology(self._ptr, t)
 
     def comm_fence(self):
         """Flush + all-to-all fence: on return, every message sent by any

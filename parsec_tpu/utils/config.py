@@ -148,6 +148,9 @@ register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")
 register("runtime.profile", False, bool, "enable event tracing at init")
 register("comm.base_port", 29650, int, "TCP rendezvous base port")
+register("comm.bcast_topo", "star", str,
+         "activation broadcast topology: star|chain|binomial "
+         "(reference: runtime_comm_coll_bcast)")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
 register("device.tpu_enabled", True, bool,

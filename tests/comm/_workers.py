@@ -14,12 +14,14 @@ import numpy as np
 
 
 def _mk_ctx(rank: int, nodes: int, port: int, nb_workers: int = 2,
-            scheduler: str = "lfq"):
+            scheduler: str = "lfq", topo: str = "star"):
     import parsec_tpu as pt
 
     ctx = pt.Context(nb_workers=nb_workers, scheduler=scheduler)
     ctx.set_rank(rank, nodes)
     ctx.comm_init(port)
+    if topo != "star":
+        ctx.comm_set_topology(topo)
     return pt, ctx
 
 
@@ -31,12 +33,13 @@ def run(worker_fn, rank, nodes, port, q, **kw):
         q.put(("err", rank, traceback.format_exc()))
 
 
-def ptg_chain(rank: int, nodes: int, port: int, nb: int = 32):
+def ptg_chain(rank: int, nodes: int, port: int, nb: int = 32,
+              topo: str = "star"):
     """Ex04-style RW chain where consecutive tasks live on different ranks:
     Task(k) runs on rank k%nodes; the datum hops rank-to-rank via remote
     ACTIVATE; the last task writes back to A(0) (a remote PUT when
     nb % nodes != 0)."""
-    pt, ctx = _mk_ctx(rank, nodes, port)
+    pt, ctx = _mk_ctx(rank, nodes, port, topo=topo)
     with ctx:
         arr = np.zeros(nodes, dtype=np.int64)  # element r owned by rank r
         ctx.register_linear_collection("A", arr, elem_size=8, nodes=nodes,
@@ -70,12 +73,14 @@ def ptg_chain(rank: int, nodes: int, port: int, nb: int = 32):
         ctx.comm_fini()
 
 
-def ptg_broadcast(rank: int, nodes: int, port: int, nt: int = 12):
+def ptg_broadcast(rank: int, nodes: int, port: int, nt: int = 12,
+                  topo: str = "star"):
     """Ex05-style broadcast: Root (rank 0) produces a value; Recv(k) for
     k=0..nt-1 runs on rank k%nodes and stores the value into its local
-    element.  One ACTIVATE per rank carries the payload (batched
-    targets)."""
-    pt, ctx = _mk_ctx(rank, nodes, port)
+    element.  topo="star": one ACTIVATE per rank (batched targets);
+    "chain"/"binomial": one ACTIVATE_BCAST propagated rank-to-rank along
+    the topology (reference: remote_dep.c:39-47)."""
+    pt, ctx = _mk_ctx(rank, nodes, port, topo=topo)
     with ctx:
         arr = np.zeros(nt, dtype=np.int64)
         ctx.register_linear_collection("V", arr, elem_size=8, nodes=nodes,

@@ -70,6 +70,21 @@ def test_ptg_broadcast_4ranks():
     _run_spmd(_workers.ptg_broadcast, 4, nt=12)
 
 
+@pytest.mark.parametrize("topo", ["chain", "binomial"])
+def test_ptg_broadcast_topologies(topo):
+    """Activation propagation along chain/binomial instead of star:
+    forwarding ranks re-root the payload (remote_dep.c:39-47 behavior)."""
+    _run_spmd(_workers.ptg_broadcast, 4, nt=12, topo=topo)
+
+
+@pytest.mark.parametrize("topo", ["chain", "binomial"])
+def test_ptg_chain_topology_on_chain_dag(topo):
+    """A rank-hopping RW chain under chain/binomial topologies: every
+    remote activation has a single target rank, so the bcast path must
+    degrade to plain per-rank sends without corruption."""
+    _run_spmd(_workers.ptg_chain, 3, nb=30, topo=topo)
+
+
 def test_dtd_chain_2ranks():
     _run_spmd(_workers.dtd_chain, 2, nb_tiles=4, rounds=6)
 
