@@ -153,9 +153,12 @@ static void comm_post(CommEngine *ce, uint32_t rank,
   {
     std::lock_guard<std::mutex> g(ce->lock);
     ce->peers[rank].out.push_back(std::move(frame));
+    /* activity MUST tick inside the lock: a fence snapshot (also under
+     * the lock) may otherwise see the queued frame but miss the count
+     * and declare a relayed broadcast hop quiescent */
+    if (!is_fence)
+      ce->activity.fetch_add(1, std::memory_order_relaxed);
   }
-  if (!is_fence)
-    ce->activity.fetch_add(1, std::memory_order_relaxed);
   ce->msgs_sent.fetch_add(1, std::memory_order_relaxed);
   comm_wake(ce);
 }
@@ -381,8 +384,10 @@ static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
   std::vector<BcastWireGroup> groups;
   groups.reserve(nb_groups);
   std::vector<uint8_t> my_targets; /* serialized targets of my group */
+  bool bad_rank = false;
   for (uint32_t gidx = 0; gidx < nb_groups && r.ok; gidx++) {
     uint32_t rank = r.u32();
+    if (rank >= ce->nodes) { bad_rank = true; break; }
     const uint8_t *start = r.p;
     uint32_t nb_targets = r.u32();
     int32_t first_class = -1;
@@ -401,7 +406,7 @@ static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
     }
   }
   uint64_t plen = r.u64();
-  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+  if (!r.ok || bad_rank || (size_t)(r.end - r.p) < plen) {
     std::fprintf(stderr, "ptc-comm: malformed ACTIVATE_BCAST dropped\n");
     return;
   }
@@ -965,6 +970,11 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
         m.erase(m.begin(), m.upper_bound(gen));
       }
     }
+    /* star topology has no relays: per-link FIFO already makes one round
+     * a complete flush, so skip the extra all-clean round.  (Decision is
+     * uniform: comm_topo is set SPMD-symmetrically before traffic; when
+     * switching topologies mid-run, fence BEFORE the switch.) */
+    if (ctx->comm_topo.load(std::memory_order_relaxed) == 0) return 0;
     if (!any_dirty) return 0;
   }
 }

@@ -135,6 +135,10 @@ class Context:
             t = names[topo]
         else:
             t = int(topo)
+            if t not in (0, 1, 2):
+                raise ValueError(
+                    f"unknown broadcast topology {topo!r}: expected 0 (star),"
+                    " 1 (chain), 2 (binomial)")
         N.lib.ptc_comm_set_topology(self._ptr, t)
 
     def comm_fence(self):

@@ -41,6 +41,7 @@ class TransformerConfig:
     n_experts: int = 0          # 0 = dense FFN; >0 = MoE every layer
     moe_k: int = 2
     dtype: object = jnp.float32
+    use_flash: bool = False     # Pallas flash kernel for local attention
     # mesh axis names (None = strategy unused)
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = "tp"
@@ -132,6 +133,9 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
         spec = P(cfg.dp_axis, cfg.sp_axis, cfg.tp_axis, None)
         return ring_attention(q, k, v, mesh, cfg.sp_axis, causal=True,
                               spec=spec)
+    if cfg.use_flash:
+        from ..ops import flash_attention
+        return flash_attention(q, k, v, causal=True)
     return blockwise_attention_reference(q, k, v, causal=True)
 
 

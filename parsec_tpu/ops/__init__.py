@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference's analog is its CUDA chore bodies (dyld'd cublas kernels,
+SURVEY.md §2.6); here the hot paths are hand-written Pallas kernels that
+the higher layers (parallel/, models/, device/) pick up when running on
+TPU, with jnp reference fallbacks everywhere else.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -22,16 +22,21 @@ from .ring_attention import blockwise_attention_reference
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                      causal: bool = False, scale: Optional[float] = None):
+                      causal: bool = False, scale: Optional[float] = None,
+                      local_attn=None):
     """Exact attention with q,k,v sequence-sharded on mesh axis `axis`.
 
     q,k,v: [B, L, H, D], L sharded over `axis`; H % mesh.shape[axis] == 0.
-    Returns [B, L, H, D] with the same sharding."""
+    `local_attn(q, k, v, causal=..., scale=...)` overrides the per-device
+    attention over the gathered sequence (e.g. ops.flash_attention — the
+    Pallas kernel — on TPU).  Returns [B, L, H, D], same sharding."""
     n = mesh.shape[axis]
     h = q.shape[2]
     if h % n != 0:
         raise ValueError(f"ulysses needs n_heads ({h}) divisible by "
                          f"mesh axis '{axis}' size ({n})")
+    attn = local_attn if local_attn is not None else \
+        blockwise_attention_reference
     pspec = P(None, axis, None, None)
 
     @partial(shard_map, mesh=mesh, in_specs=(pspec, pspec, pspec),
@@ -43,8 +48,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                                   tiled=True)
 
         qf, kf, vf = fwd(q_loc), fwd(k_loc), fwd(v_loc)
-        of = blockwise_attention_reference(qf, kf, vf, causal=causal,
-                                           scale=scale)
+        of = attn(qf, kf, vf, causal=causal, scale=scale)
         # [B, L, H/n, D] -> [B, L/n, H, D]: back to sequence sharding.
         return lax.all_to_all(of, axis, split_axis=1, concat_axis=2,
                               tiled=True)

@@ -1,0 +1,64 @@
+"""Pallas flash attention vs the dense oracle (interpret mode on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parsec_tpu.ops import flash_attention
+from parsec_tpu.parallel import blockwise_attention_reference
+
+
+def _qkv(b=2, l=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, l, h, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = blockwise_attention_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_multi_block_causal():
+    q, k, v = _qkv(b=1, l=512, h=1, d=32, seed=3)
+    ref = blockwise_attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_small_seq_fallback():
+    q, k, v = _qkv(l=32, seed=1)
+    ref = blockwise_attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_match():
+    q, k, v = _qkv(b=1, l=128, h=1, d=32, seed=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            blockwise_attention_reference(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_bad_block_divisibility():
+    q, k, v = _qkv(l=200)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
