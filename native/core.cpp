@@ -72,6 +72,7 @@ ptc_context::~ptc_context() {
   for (auto *a : arenas) delete a;
   for (auto *q : dev_queues) delete q;
   for (auto *p : prof) delete p;
+  for (auto *c : worker_executed) delete c;
   delete sched;
   ptc_task *t = free_list;
   while (t) {
@@ -1086,6 +1087,8 @@ static void worker_main(ptc_context *ctx, int worker) {
     ptc_task *t = ctx->sched->select(worker);
     if (t) {
       misses = 0;
+      ctx->worker_executed[(size_t)worker]->fetch_add(
+          1, std::memory_order_relaxed);
       execute_task(ctx, worker, t);
       continue;
     }
@@ -1250,8 +1253,22 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
     nb_workers = hc > 0 ? (int32_t)hc : 1;
   }
   ctx->nb_workers = nb_workers;
-  for (int i = 0; i < nb_workers; i++) ctx->prof.push_back(new ProfBuf());
+  for (int i = 0; i < nb_workers; i++) {
+    ctx->prof.push_back(new ProfBuf());
+    ctx->worker_executed.push_back(new std::atomic<int64_t>(0));
+  }
   return ctx;
+}
+
+/* per-worker selected-task counters (scheduler pops; AGAIN re-schedules
+ * tick once per pass, ASYNC device chores tick at dispatch); returns
+ * workers written (<= cap).  (Reference: PAPI-SDE TASKS_SCHEDULED,
+ * parsec/scheduling.c:319-323.) */
+int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
+  int64_t n = 0;
+  for (; n < (int64_t)ctx->worker_executed.size() && n < cap; n++)
+    out[n] = ctx->worker_executed[(size_t)n]->load(std::memory_order_relaxed);
+  return n;
 }
 
 int32_t ptc_context_nb_workers(ptc_context_t *ctx) { return ctx->nb_workers; }

@@ -217,6 +217,9 @@ void ptc_task_fail(ptc_context_t *ctx, ptc_task_t *task);
  * Minimal paired-event trace: per-worker buffers of (key, begin/end,
  * class, taskhash, t_ns).  ptc_profile_take copies out and clears.      */
 void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
+/* per-worker SELECTED-task counters (scheduler pops; the PAPI-SDE
+ * TASKS_SCHEDULED analog) -> out[0..cap); returns count */
+int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
 

@@ -364,6 +364,10 @@ struct ptc_context {
   /* profiling */
   std::atomic<int32_t> prof_level{0}; /* 0 off, 1 spans, 2 +edges */
   std::vector<ProfBuf *> prof;
+  /* per-worker selected-task counters (reference: the PAPI-SDE
+   * scheduled/retired counters + per-thread rusage dumps,
+   * parsec/scheduling.c:45-86,319-323) */
+  std::vector<std::atomic<int64_t> *> worker_executed;
 
   /* communication engine (nullptr when single-process) */
   CommEngine *comm = nullptr;

@@ -153,6 +153,29 @@ class Context:
     def comm_enabled(self) -> bool:
         return bool(N.lib.ptc_comm_enabled(self._ptr))
 
+    def worker_stats(self) -> list:
+        """Selected-task count per worker thread: scheduler pops, the
+        PAPI-SDE TASKS_SCHEDULED analog (parsec/scheduling.c:319-323).
+        AGAIN re-schedules count once per pass; ASYNC device chores count
+        at dispatch (their execution happens on the device manager)."""
+        cap = max(1, self.nb_workers)
+        buf = (C.c_int64 * cap)()
+        n = N.lib.ptc_worker_stats(self._ptr, buf, cap)
+        return [buf[i] for i in range(n)]
+
+    def stats_dump(self) -> str:
+        """Human-readable counter dump (the --mca device_show_statistics /
+        dump_and_reset analog, parsec/mca/device/device.h:224)."""
+        lines = [f"workers (selected tasks): {self.worker_stats()}"]
+        for i, dev in enumerate(self._devices):
+            qid = getattr(dev, "qid", None)
+            if qid is not None:
+                lines.append(f"device[{i}] queue={qid} "
+                             f"depth={self.device_queue_depth(qid)}")
+        if self.comm_enabled:
+            lines.append(f"comm: {self.comm_stats()}")
+        return "\n".join(lines)
+
     def comm_stats(self) -> dict:
         buf = (C.c_int64 * 4)()
         N.lib.ptc_comm_stats(self._ptr, buf)

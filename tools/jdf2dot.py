@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Enumerate a JDF program's task DAG and emit DOT + per-class counts
+(reference: tools/dagenum.c + the --parsec dot grapher).
+
+Usage: python tools/jdf2dot.py prog.jdf out.dot [--global N=10 ...]
+Bodies are replaced with no-ops; the program runs once on a throwaway
+context with full tracing and the executed DAG is captured from EDGE
+events.
+"""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import parsec_tpu as pt  # noqa: E402
+from parsec_tpu.dsl.jdf import compile_jdf  # noqa: E402
+from parsec_tpu.profiling import take_trace, to_dot  # noqa: E402
+
+
+def _noopify(src: str) -> str:
+    """Replace every BODY{...}END block's code with 'pass'."""
+    return re.sub(r"BODY\s*\{.*?\}\s*END", "BODY\n{\npass\n}\nEND", src,
+                  flags=re.S)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("jdf")
+    ap.add_argument("out")
+    ap.add_argument("--global", dest="globs", action="append", default=[],
+                    metavar="NAME=VALUE")
+    ap.add_argument("--collection", default="mydata",
+                    help="name bound to memory references (default mydata)")
+    ap.add_argument("--size", type=int, default=256,
+                    help="elements in the throwaway collection")
+    args = ap.parse_args(argv)
+
+    src = _noopify(open(args.jdf).read())
+    globs = {}
+    for g in args.globs:
+        k, v = g.split("=", 1)
+        globs[k.strip()] = int(v)
+    globs.setdefault("NB", 10)
+    globs.setdefault("N", 10)
+
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.profile_enable(True)
+        buf = np.zeros(args.size, dtype=np.int64)
+        ctx.register_linear_collection(args.collection, buf, elem_size=8)
+        ctx.register_arena("default", 64)
+        b = compile_jdf(src, ctx, globals=globs, dtype=np.int64,
+                        arenas={"A": "default"})
+        tp = b.run()
+        tp.wait()
+        names = [t.name for t in b.prog.tasks]
+        tr = take_trace(ctx, class_names=names)
+
+    dot = to_dot(tr)
+    with open(args.out, "w") as f:
+        f.write(dot + "\n")
+    counts = tr.counts()
+    print(f"{tp.nb_total_tasks} tasks, {dot.count('->')} edges -> "
+          f"{args.out}; events: {counts}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
