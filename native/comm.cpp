@@ -10,8 +10,8 @@
  * host-side full-mesh TCP transport (the DCN analog; multi-rank-per-host
  * tests run it over loopback, exactly how the reference tests multi-node
  * via mpirun-on-one-host, SURVEY.md §4).  Bulk device-resident tile
- * payloads between chips of one pod ride ICI via the device layer's cached
- * collective-permute/send-recv executables (parsec_tpu/parallel/ici.py);
+ * payloads between chips of one pod ride ICI via XLA collectives
+ * (parsec_tpu/parallel/collectives.py — ppermute/all-to-all/all-gather);
  * this module carries host-resident payloads eagerly inline.
  *
  * One comm thread per context (reference: remote_dep_dequeue_main,

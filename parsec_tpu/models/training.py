@@ -1,0 +1,109 @@
+"""Training harness for the model families: optax optimization, gradient
+clipping, LR schedules, periodic checkpointing — the loop a reference
+user would otherwise hand-roll around train_step.
+
+Composes the framework's own pieces: models.transformer for the sharded
+loss, checkpoint/ for resume (closing the reference's declared
+checkpoint gap, SURVEY.md §5), parallel/ meshes for placement.
+"""
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .transformer import (TransformerConfig, init_params, loss_fn,
+                          param_shardings)
+from ..checkpoint import save_train_state, load_train_state
+
+
+@dataclass
+class TrainConfig:
+    lr: float = 1e-3
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    clip_norm: float = 1.0
+    weight_decay: float = 0.0
+    ckpt_path: Optional[str] = None
+    ckpt_every: int = 0          # 0 = never
+
+
+def make_optimizer(tc: TrainConfig):
+    import optax
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, tc.lr, tc.warmup_steps, max(tc.total_steps, tc.warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(tc.clip_norm),
+        optax.adamw(sched, weight_decay=tc.weight_decay),
+    )
+
+
+def init_train_state(cfg: TransformerConfig, tc: TrainConfig, key):
+    params = init_params(cfg, key)
+    opt = make_optimizer(tc)
+    return {"params": params, "opt": opt.init(params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def make_train_step(cfg: TransformerConfig, tc: TrainConfig,
+                    mesh: Optional[Mesh] = None):
+    """jitted (state, batch) -> (state, loss) with sharding bound when a
+    mesh is given (tp from param_shardings; dp/sp on the batch)."""
+    opt = make_optimizer(tc)
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, cfg, mesh)
+        updates, new_opt = opt.update(grads, state["opt"], state["params"])
+        import optax
+        new_params = optax.apply_updates(state["params"], updates)
+        return {"params": new_params, "opt": new_opt,
+                "step": state["step"] + 1}, loss
+
+    if mesh is None:
+        return jax.jit(step)
+    # tp shardings pinned on params; the optimizer state mirrors the param
+    # tree so GSPMD propagates matching shardings (None = unconstrained)
+    pshard = param_shardings(cfg, mesh)
+    bshard = (NamedSharding(mesh, P(cfg.dp_axis, cfg.sp_axis)),) * 2
+    return jax.jit(step, in_shardings=(
+        {"params": pshard, "opt": None, "step": NamedSharding(mesh, P())},
+        bshard))
+
+
+def train(cfg: TransformerConfig, tc: TrainConfig, batches: Iterable,
+          mesh: Optional[Mesh] = None, key=None, state=None,
+          on_step: Optional[Callable[[int, float], None]] = None):
+    """Run the loop over `batches`; returns the final state and losses.
+
+    Resume: pass `state` (e.g. from resume_train_state).  Checkpoints are
+    written every tc.ckpt_every steps to tc.ckpt_path."""
+    if state is None:
+        state = init_train_state(cfg, tc, key if key is not None
+                                 else jax.random.PRNGKey(0))
+    step_fn = make_train_step(cfg, tc, mesh)
+    losses = []
+    # track the step in Python: blocking on state["step"] (or float(loss))
+    # every iteration would serialize jax's async dispatch and stall the
+    # device between steps
+    n = int(state["step"])
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        losses.append(loss)
+        n += 1
+        if on_step:
+            on_step(n, float(loss))
+        if tc.ckpt_path and tc.ckpt_every and n % tc.ckpt_every == 0:
+            save_train_state(tc.ckpt_path, state)
+    return state, [float(l) for l in losses]
+
+
+def resume_train_state(cfg: TransformerConfig, tc: TrainConfig, path: str,
+                       key=None):
+    """Rebuild the state STRUCTURE (abstract, no weights materialized —
+    eval_shape) and load a checkpoint into it."""
+    k = key if key is not None else jax.random.PRNGKey(0)
+    like = jax.eval_shape(lambda: init_train_state(cfg, tc, k))
+    return load_train_state(path, like)

@@ -17,8 +17,10 @@ from .collectives import (ring_permute, seq_all_gather, seq_reduce_scatter,
 from .ring_attention import ring_attention, blockwise_attention_reference
 from .ulysses import ulysses_attention
 from .expert import moe_ffn, moe_ffn_reference
+from .multihost import init_distributed, process_info
 
 __all__ = [
+    "init_distributed", "process_info",
     "MeshSpec", "make_mesh",
     "ring_permute", "seq_all_gather", "seq_reduce_scatter", "seq_all_to_all",
     "ring_attention", "blockwise_attention_reference", "ulysses_attention",

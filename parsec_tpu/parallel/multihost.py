@@ -1,0 +1,47 @@
+"""Multi-host bring-up for the SPMD compute path.
+
+The reference scales across hosts through its MPI comm engine
+(SURVEY.md §2.5); the TPU-native compute path scales through jax's
+distributed runtime instead: every host calls `init_distributed`, after
+which `jax.devices()` spans the whole pod slice and the meshes built by
+parallel.make_mesh carry dp/tp/sp/ep axes across hosts — XLA routes
+collectives over ICI within a slice and DCN between slices.  The task
+runtime's own control plane (native/comm.cpp) is independent: point its
+ranks at the same hosts for the task-DAG traffic.
+"""
+from typing import Optional
+
+import jax
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None) -> int:
+    """Initialize jax's multi-host runtime (no-op single-host).
+
+    Returns the global device count.  On TPU pods the three arguments are
+    discovered from the environment automatically; on CPU/loopback tests
+    pass them explicitly (coordinator "host:port", world size, rank).
+    """
+    if num_processes == 1:
+        return len(jax.devices())
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+    except Exception:
+        # Explicit multi-process arguments must not fail silently; the
+        # no-arg path falls back to single-host when the environment has
+        # no cluster to auto-discover (dev boxes, unit tests).
+        if num_processes is not None:
+            raise
+    return len(jax.devices())
+
+
+def process_info():
+    """(process_id, num_processes, local device count) of this host."""
+    return (jax.process_index(), jax.process_count(),
+            len(jax.local_devices()))

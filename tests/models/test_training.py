@@ -1,0 +1,63 @@
+"""Training harness: loss descends under optax, checkpoints resume
+bit-exact, sharded path runs on the 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from parsec_tpu.models import TransformerConfig
+from parsec_tpu.models.training import (TrainConfig, init_train_state,
+                                        make_train_step, train,
+                                        resume_train_state)
+from parsec_tpu.parallel import make_mesh
+
+
+def _cfg():
+    return TransformerConfig(vocab=64, d_model=32, n_heads=2, head_dim=16,
+                             n_layers=2, d_ff=64)
+
+
+def _batches(cfg, n, b=8, s=16, seed=0, fixed=True):
+    """fixed=True repeats one batch (memorization: loss must descend);
+    fixed=False streams fresh random tokens (nothing learnable)."""
+    k = jax.random.PRNGKey(seed)
+    for i in range(n):
+        toks = jax.random.randint(jax.random.fold_in(k, 0 if fixed else i),
+                                  (b, s), 0, cfg.vocab)
+        yield toks, jnp.roll(toks, -1, axis=1)
+
+
+def test_loss_descends_single_device():
+    cfg, tc = _cfg(), TrainConfig(lr=2e-2, warmup_steps=2, total_steps=40)
+    state, losses = train(cfg, tc, _batches(cfg, 40))
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+    assert int(state["step"]) == 40
+
+
+def test_sharded_training_runs():
+    cfg, tc = _cfg(), TrainConfig(lr=1e-2, warmup_steps=2, total_steps=10)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    state, losses = train(cfg, tc, _batches(cfg, 10), mesh=mesh)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_resume_bitexact(tmp_path):
+    cfg = _cfg()
+    p = str(tmp_path / "ck")
+    tc = TrainConfig(lr=5e-3, warmup_steps=2, total_steps=20,
+                     ckpt_path=p, ckpt_every=10)
+    # run 10 steps, checkpointing at step 10
+    state_a, _ = train(cfg, tc, _batches(cfg, 10), key=jax.random.PRNGKey(1))
+    # resume and run 10 more
+    resumed = resume_train_state(cfg, tc, p)
+    assert int(resumed["step"]) == 10
+    state_b, _ = train(cfg, tc, _batches(cfg, 10, seed=99), state=resumed)
+    # straight-through run over the same 20 batches
+    state_c, _ = train(cfg, tc, list(_batches(cfg, 10)) +
+                       list(_batches(cfg, 10, seed=99)),
+                       key=jax.random.PRNGKey(1))
+    for a, b in zip(jax.tree_util.tree_leaves(state_b["params"]),
+                    jax.tree_util.tree_leaves(state_c["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
