@@ -1,0 +1,211 @@
+"""Mini-app tier (reference: tests/apps — stencil_1D, pingpong, all2all,
+merge_sort, haar_tree, generalized reduction; SURVEY.md §4).  Each app is
+a small real algorithm exercising a dataflow shape the unit tests don't:
+neighbor exchanges, tree merges, dynamic-tree DTD discovery."""
+import threading
+
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.dsl.dtd import DtdTaskpool
+
+
+def test_stencil_1d_jacobi():
+    """T timesteps of a 3-point Jacobi average over tiled 1D data —
+    neighbor dependencies left/right per step (tests/apps/stencil)."""
+    nt, T, tile = 8, 6, 4
+    data = np.arange(nt * tile, dtype=np.float64)
+    expect = data.copy()
+    for _ in range(T):
+        nxt = expect.copy()
+        nxt[1:-1] = (expect[:-2] + expect[1:-1] + expect[2:]) / 3.0
+        expect = nxt
+
+    tiles = {(0, i): data[i * tile:(i + 1) * tile].copy()
+             for i in range(nt)}
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_arena("tile", tile * 8)
+        tp = pt.Taskpool(ctx, globals={"NT": nt - 1, "T": T})
+        t, i = pt.L("t"), pt.L("i")
+
+        # Step(t, i): self RW chain in time + CTL ghost-exchange with the
+        # t-1 neighbors (the stencil_1D neighbor dependency shape)
+        st = tp.task_class("Step")
+        st.param("t", 1, pt.G("T")).param("i", 0, pt.G("NT"))
+        st.flow("A", "RW",
+                pt.In(pt.Ref("Step", t - 1, i, flow="A"), guard=(t > 1)),
+                pt.In(None, guard=(t == 1)),
+                pt.Out(pt.Ref("Step", t + 1, i, flow="A"),
+                       guard=(t < pt.G("T"))),
+                arena="tile")
+        st.flow("X", "CTL",
+                pt.In(pt.Ref("Step", t - 1, i - 1, flow="X"),
+                      guard=(t > 1) & (i > 0)),
+                pt.In(pt.Ref("Step", t - 1, i + 1, flow="X"),
+                      guard=(t > 1) & (i < pt.G("NT"))),
+                pt.Out(pt.Ref("Step", t + 1, i - 1, flow="X"),
+                       guard=(t < pt.G("T")) & (i > 0)),
+                pt.Out(pt.Ref("Step", t + 1, i + 1, flow="X"),
+                       guard=(t < pt.G("T")) & (i < pt.G("NT"))))
+
+        lock = threading.Lock()
+
+        def body(view):
+            tt, ii = view["t"], view["i"]
+            with lock:
+                cur = tiles[(tt - 1, ii)]
+                left = tiles[(tt - 1, ii - 1)][-1] if ii > 0 else None
+                right = tiles[(tt - 1, ii + 1)][0] if ii < nt - 1 else None
+                ext = np.concatenate(
+                    [[left] if left is not None else [],
+                     cur,
+                     [right] if right is not None else []])
+                new = cur.copy()
+                off = 1 if ii > 0 else 0
+                for j in range(len(cur)):
+                    gj = ii * tile + j
+                    if 0 < gj < nt * tile - 1:
+                        new[j] = (ext[j + off - 1] + ext[j + off] +
+                                  ext[j + off + 1]) / 3.0
+                tiles[(tt, ii)] = new
+
+        st.body(body)
+        tp.run()
+        tp.wait()
+
+    got = np.concatenate([tiles[(T, i)] for i in range(nt)])
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+
+def test_priority_ordering_ap_scheduler():
+    """Priority expressions drive execution order: a Gate releases a fan
+    of independent tasks with priority k; its release_deps enqueues ALL
+    of them before the single worker's next select, so the "ap" global
+    absolute-priority scheduler must run them in strictly descending k
+    (reference: priority exprs + sched/ap, SURVEY.md §2.4)."""
+    n = 12
+    order = []
+    with pt.Context(nb_workers=1, scheduler="ap") as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": n})
+        k = pt.L("k")
+        gate = tp.task_class("Gate")
+        gate.flow("X", "CTL",
+                  pt.Out(pt.Ref("Fan", pt.Range(0, pt.G("N")), flow="X")))
+        gate.body(lambda v: None)
+        fan = tp.task_class("Fan")
+        fan.param("k", 0, pt.G("N"))
+        fan.priority(k)
+        fan.flow("X", "CTL", pt.In(pt.Ref("Gate", flow="X")))
+        fan.body(lambda v: order.append(v["k"]))
+        tp.run()
+        tp.wait()
+    assert order == list(range(n, -1, -1)), order
+
+
+def test_pingpong_alternation():
+    """Ping-pong between two task classes: strict alternation under the
+    dataflow chain (tests/apps/pingpong behavior)."""
+    n = 20
+    order = []
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"N": n})
+        k = pt.L("k")
+        ping = tp.task_class("Ping")
+        ping.param("k", 0, pt.G("N"))
+        ping.flow("A", "RW",
+                  pt.In(None, guard=(k == 0)),
+                  pt.In(pt.Ref("Pong", k - 1, flow="A")),
+                  pt.Out(pt.Ref("Pong", k, flow="A")),
+                  arena="t")
+        ping.body(lambda v: order.append(("ping", v["k"])))
+
+        pong = tp.task_class("Pong")
+        pong.param("k", 0, pt.G("N"))
+        pong.flow("A", "RW",
+                  pt.In(pt.Ref("Ping", k, flow="A")),
+                  pt.Out(pt.Ref("Ping", k + 1, flow="A"),
+                         guard=(k < pt.G("N"))),
+                  arena="t")
+        pong.body(lambda v: order.append(("pong", v["k"])))
+        tp.run()
+        tp.wait()
+    expect = []
+    for k in range(n + 1):
+        expect += [("ping", k), ("pong", k)]
+    assert order == expect
+
+
+def test_haar_tree_dtd():
+    """Haar-style wavelet tree built bottom-up with DTD: level l node j
+    sums its two children — dynamic tree discovery
+    (tests/apps/haar_tree behavior)."""
+    leaves = 16
+    vals = np.arange(leaves, dtype=np.int64)
+    with pt.Context(nb_workers=2) as ctx:
+        datas = {}
+        for j, v in enumerate(vals):
+            datas[(0, j)] = ctx.data(j, np.array([v], dtype=np.int64))
+        dtp = DtdTaskpool(ctx, window=64)
+        tiles = {k: dtp.tile_of(d) for k, d in datas.items()}
+        level, width = 0, leaves
+        key = leaves
+        while width > 1:
+            for j in range(width // 2):
+                dst = ctx.data(key, np.zeros(1, dtype=np.int64))
+                key += 1
+                datas[(level + 1, j)] = dst
+                tiles[(level + 1, j)] = dtp.tile_of(dst)
+
+                def merge(view):
+                    a = view.data(0, dtype=np.int64)
+                    b = view.data(1, dtype=np.int64)
+                    o = view.data(2, dtype=np.int64)
+                    o[0] = a[0] + b[0]
+
+                dtp.insert_task(merge,
+                                (tiles[(level, 2 * j)], "INPUT"),
+                                (tiles[(level, 2 * j + 1)], "INPUT"),
+                                (tiles[(level + 1, j)], "OUTPUT"))
+            level += 1
+            width //= 2
+        dtp.wait()
+        root = datas[(level, 0)].array[0]
+        dtp.destroy()
+    assert root == vals.sum()
+
+
+def test_all2all_ctl():
+    """All-to-all dependency cross: N producers each gate N consumers via
+    CTL flows; every consumer runs after ALL producers
+    (tests/apps/all2all shape)."""
+    n = 6
+    produced, consumed = [], []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=2) as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": n - 1})
+        k = pt.L("k")
+        prod = tp.task_class("Prod")
+        prod.param("k", 0, pt.G("N"))
+        prod.flow("X", "CTL",
+                  pt.Out(pt.Ref("Cons", pt.Range(0, pt.G("N")), flow="X")))
+
+        def pbody(v):
+            with lock:
+                produced.append(v["k"])
+
+        prod.body(pbody)
+        cons = tp.task_class("Cons")
+        cons.param("k", 0, pt.G("N"))
+        cons.flow("X", "CTL",
+                  pt.In(pt.Ref("Prod", pt.Range(0, pt.G("N")), flow="X")))
+
+        def cbody(v):
+            with lock:
+                assert len(produced) == n, (produced, v["k"])
+                consumed.append(v["k"])
+
+        cons.body(cbody)
+        tp.run()
+        tp.wait()
+    assert sorted(consumed) == list(range(n))
