@@ -1,0 +1,57 @@
+"""Every scheduler module runs the same workloads correctly — the
+reference exercises each sched with the ep (embarrassingly parallel)
+vehicle (tests/runtime/scheduling/ep.jdf; module menu SURVEY.md §2.4)."""
+import threading
+
+import pytest
+
+import parsec_tpu as pt
+
+SCHEDULERS = ["lfq", "ll", "gd", "ap", "ltq", "pbq", "lhq", "ip", "spq",
+              "rnd"]
+
+
+@pytest.mark.parametrize("sched", SCHEDULERS)
+def test_ep_fan_all_schedulers(sched):
+    """ep: N independent tasks, 2 workers; all must run exactly once."""
+    n = 200
+    done = []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=2, scheduler=sched) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"N": n - 1})
+        k = pt.L("k")
+        tc = tp.task_class("Ep")
+        tc.param("k", 0, pt.G("N"))
+        tc.flow("A", "RW", pt.In(None), arena="t")
+
+        def body(v):
+            with lock:
+                done.append(v["k"])
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+    assert sorted(done) == list(range(n))
+
+
+@pytest.mark.parametrize("sched", SCHEDULERS)
+def test_chain_all_schedulers(sched):
+    """A strict RW chain must serialize under every scheduler."""
+    n = 60
+    order = []
+    with pt.Context(nb_workers=2, scheduler=sched) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"N": n})
+        k = pt.L("k")
+        tc = tp.task_class("C")
+        tc.param("k", 0, pt.G("N"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("C", k - 1, flow="A")),
+                pt.Out(pt.Ref("C", k + 1, flow="A"), guard=(k < pt.G("N"))),
+                arena="t")
+        tc.body(lambda v: order.append(v["k"]))
+        tp.run()
+        tp.wait()
+    assert order == list(range(n + 1))
