@@ -1275,8 +1275,12 @@ int32_t ptc_context_nb_workers(ptc_context_t *ctx) { return ctx->nb_workers; }
 
 int32_t ptc_context_set_scheduler(ptc_context_t *ctx, const char *name) {
   if (ctx->started.load()) return -1;
-  ctx->sched_name = name ? name : "lfq";
+  ctx->sched_name = ptc_sched_canonical(name);
   return 0;
+}
+
+const char *ptc_context_get_scheduler(ptc_context_t *ctx) {
+  return ctx->sched_name.c_str();
 }
 
 int32_t ptc_context_start(ptc_context_t *ctx) {

@@ -125,8 +125,11 @@ int32_t ptc_context_start(ptc_context_t *ctx);
 int32_t ptc_context_wait(ptc_context_t *ctx);
 /* non-blocking: 1 if all taskpools complete, 0 otherwise */
 int32_t ptc_context_test(ptc_context_t *ctx);
-/* scheduler selection, by name ("lfq", "gd", "ap"); default lfq */
+/* scheduler selection, by name ("lfq", "gd", "ap"); default lfq.
+ * Unknown names fall back to lfq; aliases collapse ("lhq" -> "pbq"). */
 int32_t ptc_context_set_scheduler(ptc_context_t *ctx, const char *name);
+/* canonical name of the module that will run (valid until ctx destroy) */
+const char *ptc_context_get_scheduler(ptc_context_t *ctx);
 
 /* registries: return non-negative id, or -1 on error */
 int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user);

@@ -215,6 +215,9 @@ struct DepKeyHash {
 
 uint64_t ptc_fnv_hash(int32_t class_id, const std::vector<int64_t> &params);
 
+/* sched.cpp: canonical module name a request resolves to */
+const char *ptc_sched_canonical(const char *name);
+
 /* A pending successor: data copies staged by producers until all task-input
  * dependencies are satisfied, then promoted to a ready task.  (Reference
  * analog: parsec_hashable_dependency_t entries + datarepo retention.) */

@@ -248,6 +248,21 @@ struct SchedRND : Scheduler {
 
 } // namespace
 
+/* canonical module name a request resolves to: aliases collapse
+ * ("lhq" -> "pbq"), unknown names fall back to the default "lfq" —
+ * exposed so callers/tests can observe which module actually runs */
+const char *ptc_sched_canonical(const char *name) {
+  static const char *known[] = {"gd", "ap",  "ll",  "ltq", "pbq",
+                                "ip", "spq", "rnd", "lfq"};
+  if (name) {
+    std::string n(name);
+    if (n == "lhq") return "pbq";
+    for (const char *k : known)
+      if (n == k) return k;
+  }
+  return "lfq";
+}
+
 Scheduler *ptc_sched_create(const std::string &name) {
   if (name == "gd") return new SchedGD();
   if (name == "ap") return new SchedAP();

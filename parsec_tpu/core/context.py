@@ -100,6 +100,12 @@ class Context:
     def nb_workers(self) -> int:
         return N.lib.ptc_context_nb_workers(self._ptr)
 
+    @property
+    def scheduler_name(self) -> str:
+        """Canonical name of the scheduler module that runs (unknown
+        requests fall back to "lfq"; "lhq" is the "pbq" module)."""
+        return N.lib.ptc_context_get_scheduler(self._ptr).decode()
+
     def set_rank(self, myrank: int, nodes: int):
         self.myrank, self.nodes = myrank, nodes
         N.lib.ptc_context_set_rank(self._ptr, myrank, nodes)

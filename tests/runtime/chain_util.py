@@ -1,0 +1,15 @@
+"""Shared builder for the Ex02-style RW chain used across runtime tests."""
+import parsec_tpu as pt
+
+
+def chain_task_class(tp, name="Task", arena="t"):
+    """Task(k), k=0..NB: RW chain Task(k-1) -> Task(k) -> Task(k+1)."""
+    k = pt.L("k")
+    tc = tp.task_class(name)
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref(name, k - 1, flow="A")),
+            pt.Out(pt.Ref(name, k + 1, flow="A"), guard=(k < pt.G("NB"))),
+            arena=arena)
+    return tc

@@ -6,21 +6,30 @@ import threading
 import pytest
 
 import parsec_tpu as pt
+from .chain_util import chain_task_class
 
-SCHEDULERS = ["lfq", "ll", "gd", "ap", "ltq", "pbq", "lhq", "ip", "spq",
-              "rnd"]
+# requested name -> canonical module that must actually run
+SCHEDULERS = {"lfq": "lfq", "ll": "ll", "gd": "gd", "ap": "ap",
+              "ltq": "ltq", "pbq": "pbq", "lhq": "pbq", "ip": "ip",
+              "spq": "spq", "rnd": "rnd"}
 
 
-@pytest.mark.parametrize("sched", SCHEDULERS)
+def test_unknown_scheduler_falls_back_to_lfq():
+    with pt.Context(nb_workers=1, scheduler="bogus") as ctx:
+        assert ctx.scheduler_name == "lfq"
+
+
+@pytest.mark.parametrize("sched", sorted(SCHEDULERS))
 def test_ep_fan_all_schedulers(sched):
-    """ep: N independent tasks, 2 workers; all must run exactly once."""
+    """ep: N independent tasks, 2 workers; all must run exactly once —
+    and the requested module (not a silent fallback) must be active."""
     n = 200
     done = []
     lock = threading.Lock()
     with pt.Context(nb_workers=2, scheduler=sched) as ctx:
+        assert ctx.scheduler_name == SCHEDULERS[sched]
         ctx.register_arena("t", 8)
         tp = pt.Taskpool(ctx, globals={"N": n - 1})
-        k = pt.L("k")
         tc = tp.task_class("Ep")
         tc.param("k", 0, pt.G("N"))
         tc.flow("A", "RW", pt.In(None), arena="t")
@@ -35,22 +44,16 @@ def test_ep_fan_all_schedulers(sched):
     assert sorted(done) == list(range(n))
 
 
-@pytest.mark.parametrize("sched", SCHEDULERS)
+@pytest.mark.parametrize("sched", sorted(SCHEDULERS))
 def test_chain_all_schedulers(sched):
     """A strict RW chain must serialize under every scheduler."""
     n = 60
     order = []
     with pt.Context(nb_workers=2, scheduler=sched) as ctx:
+        assert ctx.scheduler_name == SCHEDULERS[sched]
         ctx.register_arena("t", 8)
-        tp = pt.Taskpool(ctx, globals={"N": n})
-        k = pt.L("k")
-        tc = tp.task_class("C")
-        tc.param("k", 0, pt.G("N"))
-        tc.flow("A", "RW",
-                pt.In(None, guard=(k == 0)),
-                pt.In(pt.Ref("C", k - 1, flow="A")),
-                pt.Out(pt.Ref("C", k + 1, flow="A"), guard=(k < pt.G("N"))),
-                arena="t")
+        tp = pt.Taskpool(ctx, globals={"NB": n})
+        tc = chain_task_class(tp)
         tc.body(lambda v: order.append(v["k"]))
         tp.run()
         tp.wait()
