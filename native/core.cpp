@@ -1438,6 +1438,20 @@ int32_t ptc_tp_wait(ptc_taskpool_t *tp) {
 }
 
 int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp) { return tp->nb_tasks.load(); }
+
+/* Drain: block until every task inserted so far has completed, WITHOUT
+ * closing the pool — insertion may continue afterwards.  (Reference:
+ * parsec_dtd_data_flush's wait-for-writers semantics,
+ * parsec/interfaces/dtd/parsec_dtd_data_flush.c — SURVEY.md §2.7.) */
+int32_t ptc_tp_drain(ptc_taskpool_t *tp) {
+  std::unique_lock<std::mutex> lk(tp->window_lock);
+  tp->window_cv.wait(lk, [&] {
+    return tp->nb_tasks.load(std::memory_order_seq_cst) == 0 ||
+           tp->completed.load(std::memory_order_acquire) ||
+           tp->ctx->shutdown.load(std::memory_order_acquire);
+  });
+  return tp->completed.load(std::memory_order_acquire) ? -1 : 0;
+}
 int64_t ptc_tp_nb_total_tasks(ptc_taskpool_t *tp) { return tp->nb_total.load(); }
 int64_t ptc_tp_nb_errors(ptc_taskpool_t *tp) { return tp->nb_errors.load(); }
 

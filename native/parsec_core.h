@@ -164,6 +164,9 @@ int64_t ptc_tp_nb_errors(ptc_taskpool_t *tp);      /* failed/dropped tasks  */
 /* keep a taskpool alive for dynamic insertion (DTD): while open, reaching
  * zero remaining tasks does not complete it */
 void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open);
+/* block until every task inserted so far completed, WITHOUT closing the
+ * pool (the DTD data-flush quiescence point); -1 if the pool aborted */
+int32_t ptc_tp_drain(ptc_taskpool_t *tp);
 
 /* Completion callback, fired exactly once when the taskpool completes —
  * BEFORE the context's active-pool count drops, so a callback that adds a

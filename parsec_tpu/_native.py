@@ -123,6 +123,7 @@ _sigs = {
     "ptc_tp_nb_errors": (C.c_int64, [C.c_void_p]),
     "ptc_task_fail": (None, [C.c_void_p, C.c_void_p]),
     "ptc_tp_set_open": (None, [C.c_void_p, C.c_int32]),
+    "ptc_tp_drain": (C.c_int32, [C.c_void_p]),
     "ptc_tp_set_on_complete": (None, [C.c_void_p, TP_COMPLETE_CB_T,
                                       C.c_void_p]),
     "ptc_tp_global": (C.c_int64, [C.c_void_p, C.c_int32]),
