@@ -84,13 +84,13 @@ def _potrf_once(N, nb, seed=0, check=False):
         return dt, resid
 
 
-def bench_spotrf(N=16384, nb=1024):
+def bench_spotrf(N=16384, nb=1024, reps=2):
     from parsec_tpu.algos import potrf_flops
     # warmup: compiles the 4 kernels at (nb, nb) + generator + small graph
     _potrf_once(4 * nb, nb, seed=1)
     best = None
     resid = None
-    for rep in range(2):
+    for rep in range(reps):
         dt, r = _potrf_once(N, nb, seed=0, check=(rep == 0))
         if rep == 0:
             resid = r
@@ -111,39 +111,90 @@ def _dispatch_json():
     })
 
 
+def _arg_after(flag, default):
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+def _probe_tpu(timeout_s: int) -> bool:
+    """Cheap liveness check: the axon tunnel has multi-hour outages during
+    which even jax.devices() hangs at backend init.  Probe in a subprocess
+    so a wedged backend cannot take the bench down with it."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     if "--dispatch" in sys.argv:
         print(_dispatch_json())
         return 0
     if "--spotrf-child" in sys.argv:
-        gflops = bench_spotrf()
+        n = _arg_after("--n", 16384)
+        nb = _arg_after("--nb", 1024)
+        gflops = bench_spotrf(n, nb)
         print(json.dumps({
             "metric": "spotrf_gflops_per_chip",
             "value": round(gflops, 1),
             "unit": "GFLOP/s",
             "vs_baseline": round(gflops / 7000.0, 4),
+            "config": {"N": n, "NB": nb},
         }))
         return 0
     # Headline spotrf runs on the real chip through the axon tunnel, which
-    # can wedge at backend init.  Run it in a watchdog subprocess; if it
-    # cannot produce a number in time, fall back to the rung-1 dispatch
-    # metric (BASELINE.md ladder) so the driver always gets its JSON line.
+    # can wedge at backend init.  Probe first (fast fail), then climb the
+    # size ladder toward the BASELINE.json config (N=65536, NB=512) while
+    # the time budget lasts, reporting the best rung that completed.  If
+    # nothing lands, fall back to the rung-1 dispatch metric so the driver
+    # always gets its JSON line.
     import os
     import subprocess
     budget = int(os.environ.get("PTC_BENCH_TIMEOUT_S", "480"))
-    try:
-        r = subprocess.run(
-            [sys.executable, __file__, "--spotrf-child"],
-            timeout=budget, capture_output=True, text=True)
-        for line in reversed((r.stdout or "").strip().splitlines()):
-            if line.startswith("{"):
-                print(line)
-                return 0
-        sys.stderr.write(f"spotrf child failed (rc={r.returncode}): "
-                         f"{(r.stderr or '')[-400:]}\n")
-    except subprocess.TimeoutExpired:
-        sys.stderr.write(f"spotrf child exceeded {budget}s "
-                         "(TPU tunnel unreachable?); falling back\n")
+    probe_s = int(os.environ.get("PTC_BENCH_PROBE_S", "90"))
+    deadline = time.monotonic() + budget
+    if not _probe_tpu(min(probe_s, budget)):
+        sys.stderr.write(f"TPU probe failed within {probe_s}s "
+                         "(axon tunnel down?); falling back to dispatch\n")
+        print(_dispatch_json())
+        return 0
+    ladder = [(16384, 1024), (32768, 512), (65536, 512)]
+    if os.environ.get("PTC_BENCH_N"):
+        ladder = [(int(os.environ["PTC_BENCH_N"]),
+                   int(os.environ.get("PTC_BENCH_NB", "512")))]
+    best_line = None
+    for n, nb in ladder:
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            break
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--spotrf-child",
+                 "--n", str(n), "--nb", str(nb)],
+                timeout=remaining, capture_output=True, text=True)
+            got = None
+            for line in reversed((r.stdout or "").strip().splitlines()):
+                if line.startswith("{"):
+                    got = line
+                    break
+            if got is None:
+                sys.stderr.write(f"spotrf child N={n} failed "
+                                 f"(rc={r.returncode}): "
+                                 f"{(r.stderr or '')[-400:]}\n")
+                break
+            best_line = got  # larger N supersedes: closer to BASELINE config
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"spotrf child N={n} exceeded budget; "
+                             "keeping best completed rung\n")
+            break
+    if best_line is not None:
+        print(best_line)
+        return 0
     print(_dispatch_json())
     return 0
 

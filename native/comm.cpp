@@ -399,7 +399,11 @@ static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
     }
     if (!r.ok) break;
     std::vector<uint8_t> bytes(start, r.p);
-    if (rank == ce->myrank && my_targets.empty()) {
+    if (rank == ce->myrank) {
+      /* a second self group would be forwarded via comm_post to the
+       * never-connected self peer, permanently ticking `activity` and
+       * keeping every later fence dirty — reject the frame instead */
+      if (!my_targets.empty()) { bad_rank = true; break; }
       my_targets = std::move(bytes);
     } else {
       groups.push_back(BcastWireGroup{rank, std::move(bytes), first_class});
@@ -680,6 +684,7 @@ void ptc_comm_send_activate_batch(
     for (int64_t v : t.second) w.i64(v);
   }
   if (copy && copy->ptr && copy->size > 0) {
+    ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
     w.u64((uint64_t)copy->size);
     w.raw(copy->ptr, (size_t)copy->size);
   } else {
@@ -742,6 +747,8 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
       (copy && copy->ptr && copy->size > 0) ? (const uint8_t *)copy->ptr
                                             : nullptr;
   uint64_t plen = payload ? (uint64_t)copy->size : 0;
+  if (payload)
+    ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
   bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, payload, plen);
 }
 
@@ -772,6 +779,7 @@ void ptc_comm_send_dtd_complete(ptc_context *ctx, ptc_taskpool *tp,
     if (!(dx->modes[fi] & PTC_DTD_OUTPUT)) continue;
     ptc_copy *c = t->data[fi];
     if (!c || !c->ptr) continue;
+    ptc_copy_sync_for_host(ctx, c); /* coherence: pull device mirror */
     pw.u32((uint32_t)fi);
     pw.u64((uint64_t)c->size);
     pw.raw(c->ptr, (size_t)c->size);

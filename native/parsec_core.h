@@ -264,6 +264,14 @@ int64_t ptc_task_get_tag(ptc_task_t *t);
 typedef void (*ptc_copy_release_cb)(void *user, int64_t handle);
 void ptc_set_copy_release_cb(ptc_context_t *ctx, ptc_copy_release_cb cb,
                              void *user);
+/* Coherence pull: called (same thread) right before the runtime reads the
+ * host bytes of a copy with a nonzero handle — comm payload serialization
+ * and collection memory write-back.  The device layer writes its dirty
+ * device mirror back to the host buffer, making CPU-after-TPU reads
+ * automatic (no manual flush()). */
+typedef void (*ptc_copy_sync_cb)(void *user, int64_t handle);
+void ptc_set_copy_sync_cb(ptc_context_t *ctx, ptc_copy_sync_cb cb,
+                          void *user);
 /* nonzero if the copy is backed by persistent user data (ptc_data_new),
  * zero for transient arena-backed copies */
 int32_t ptc_copy_is_persistent(ptc_copy_t *c);

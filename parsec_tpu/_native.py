@@ -92,6 +92,7 @@ BODY_CB_T = C.CFUNCTYPE(C.c_int32, C.c_void_p, C.c_void_p)
 RANK_OF_CB_T = C.CFUNCTYPE(C.c_uint32, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
 DATA_OF_CB_T = C.CFUNCTYPE(C.c_void_p, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
 COPY_RELEASE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
+COPY_SYNC_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 TP_COMPLETE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_void_p)
 
 _sigs = {
@@ -138,6 +139,8 @@ _sigs = {
     "ptc_copy_is_persistent": (C.c_int32, [C.c_void_p]),
     "ptc_set_copy_release_cb": (None, [C.c_void_p, COPY_RELEASE_CB_T,
                                        C.c_void_p]),
+    "ptc_set_copy_sync_cb": (None, [C.c_void_p, COPY_SYNC_CB_T,
+                                    C.c_void_p]),
     "ptc_task_local": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_task_class": (C.c_int32, [C.c_void_p]),
     "ptc_task_priority": (C.c_int32, [C.c_void_p]),

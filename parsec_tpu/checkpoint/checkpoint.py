@@ -33,9 +33,17 @@ def save_collections(path: str, named_colls: Dict[str, object]):
     complete algorithm state."""
     for name, coll in named_colls.items():
         arrays = {}
-        for (m, n), tile in coll._tiles.items():
-            if coll.rank_of(m, n) == coll.myrank:
-                arrays[f"{m}_{n}"] = tile
+        # Enumerate through the public API (the same walk Collection.fill
+        # uses) so band/sym collections — whose tiles live in nested
+        # descriptors, not a flat _tiles dict — checkpoint correctly, and
+        # lazily-allocated tiles materialize instead of being dropped.
+        for m in range(coll.mt):
+            for n in range(coll.nt):
+                if not coll.stored(m, n):
+                    continue
+                if coll.rank_of(m, n) != coll.myrank:
+                    continue
+                arrays[f"{m}_{n}"] = coll.tile(m, n)
         arrays["__meta__"] = np.frombuffer(
             json.dumps(_coll_meta(coll)).encode(), dtype=np.uint8)
         np.savez(_path_for(path, name, coll.myrank, coll.nodes), **arrays)

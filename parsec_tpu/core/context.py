@@ -266,6 +266,11 @@ class Context:
     def task_complete(self, task_ptr):
         N.lib.ptc_task_complete(self._ptr, task_ptr)
 
+    def task_fail(self, task_ptr):
+        """Fail an ASYNC-owned task: aborts its taskpool (successors are
+        never released; waiters observe the error)."""
+        N.lib.ptc_task_fail(self._ptr, task_ptr)
+
     # ------------------------------------------------------------ profiling
     def profile_enable(self, enable=True):
         """Tracing level: 0/False off; 1 span events only (EXEC/RELEASE/

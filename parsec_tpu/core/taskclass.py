@@ -242,14 +242,24 @@ class TaskView:
     def data_ptr(self, flow: str) -> int:
         return N.lib.ptc_task_data_ptr(self._ptr, self._tc.flow_index(flow))
 
-    def data(self, flow: str, dtype=np.uint8, shape=None) -> np.ndarray:
-        """Numpy view over the flow's buffer (host copies)."""
+    def data(self, flow: str, dtype=np.uint8, shape=None,
+             sync: bool = True) -> np.ndarray:
+        """Numpy view over the flow's buffer (host copies).
+
+        sync=True (the default) pulls a newer device-resident copy back to
+        host first, so CPU chores never read stale memory after a TPU
+        producer.  The device module passes sync=False for its own reads —
+        its cache mirror IS the fresh copy."""
         fi = self._tc.flow_index(flow)
         ptr = N.lib.ptc_task_data_ptr(self._ptr, fi)
         if not ptr:
             raise RuntimeError(
                 f"{self._tc.name}: flow {flow!r} has no data attached")
-        size = N.lib.ptc_copy_size(N.lib.ptc_task_copy(self._ptr, fi))
+        cptr = N.lib.ptc_task_copy(self._ptr, fi)
+        if sync:
+            from ..device.tpu import maybe_sync_copy
+            maybe_sync_copy(cptr)
+        size = N.lib.ptc_copy_size(cptr)
         dt = np.dtype(dtype)
         count = size // dt.itemsize
         buf = (C.c_char * size).from_address(ptr)

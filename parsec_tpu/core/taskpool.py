@@ -111,6 +111,13 @@ class Taskpool:
     def set_open(self, open_: bool):
         N.lib.ptc_tp_set_open(self._ptr, 1 if open_ else 0)
 
+    def drain(self) -> bool:
+        """Block until every task counted so far has completed, without
+        closing the pool (insertion may continue — reference:
+        parsec_dtd_data_flush wait-for-writers semantics).  Returns False
+        if the pool already completed/aborted instead."""
+        return N.lib.ptc_tp_drain(self._ptr) == 0
+
     def on_complete(self, fn: Callable[[], None]):
         """Fire fn() exactly once when this taskpool completes (reference:
         tp->on_complete, the seam parsec_compose and recursive tasks build

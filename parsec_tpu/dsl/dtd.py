@@ -43,12 +43,17 @@ class DtdView:
     def data_ptr(self, i: int) -> int:
         return N.lib.ptc_task_data_ptr(self._ptr, i)
 
-    def data(self, i: int, dtype=np.uint8, shape=None) -> np.ndarray:
+    def data(self, i: int, dtype=np.uint8, shape=None,
+             sync: bool = True) -> np.ndarray:
         import ctypes as C
         ptr = N.lib.ptc_task_data_ptr(self._ptr, i)
         if not ptr:
             raise RuntimeError(f"dtd task: argument {i} has no data")
-        size = N.lib.ptc_copy_size(N.lib.ptc_task_copy(self._ptr, i))
+        cptr = N.lib.ptc_task_copy(self._ptr, i)
+        if sync:
+            from ..device.tpu import maybe_sync_copy
+            maybe_sync_copy(cptr)
+        size = N.lib.ptc_copy_size(cptr)
         dt = np.dtype(dtype)
         buf = (C.c_char * size).from_address(ptr)
         arr = np.frombuffer(buf, dtype=dt, count=size // dt.itemsize)

@@ -9,9 +9,21 @@ collectives over ICI within a slice and DCN between slices.  The task
 runtime's own control plane (native/comm.cpp) is independent: point its
 ranks at the same hosts for the task-DAG traffic.
 """
+import logging
+import os
 from typing import Optional
 
 import jax
+
+logger = logging.getLogger("parsec_tpu.multihost")
+
+# Env vars any of which indicate a cluster jax.distributed can
+# auto-discover (TPU pod metadata, SLURM, Open MPI, user-set coordinator).
+_CLUSTER_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "MEGASCALE_COORDINATOR_ADDRESS",
+    "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -26,18 +38,28 @@ def init_distributed(coordinator_address: Optional[str] = None,
     """
     if num_processes == 1:
         return len(jax.devices())
+    if (coordinator_address is None and num_processes is None
+            and not any(os.environ.get(v) for v in _CLUSTER_ENV_VARS)):
+        # Nothing to auto-discover: stay single-host without even trying,
+        # so a genuine pod bring-up failure is never mistaken for this.
+        return len(jax.devices())
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
             local_device_ids=local_device_ids)
-    except Exception:
-        # Explicit multi-process arguments must not fail silently; the
-        # no-arg path falls back to single-host when the environment has
-        # no cluster to auto-discover (dev boxes, unit tests).
-        if num_processes is not None:
+    except Exception as e:
+        # Explicit multi-process arguments must not fail silently.
+        if num_processes is not None or coordinator_address is not None:
             raise
+        # Auto-discovery env present but bring-up failed: this is a real
+        # cluster problem — degrading to single-host silently would later
+        # hang collectives on a partial device set with no hint why.
+        logger.warning(
+            "jax.distributed.initialize() failed despite cluster env "
+            "(%s); continuing single-host: %s",
+            ", ".join(v for v in _CLUSTER_ENV_VARS if os.environ.get(v)), e)
     return len(jax.devices())
 
 
