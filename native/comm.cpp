@@ -58,23 +58,26 @@ enum {
   MSG_DTD_DONE = 3,
   MSG_FENCE = 4,
   MSG_ACTIVATE_BCAST = 5,
+  MSG_GET = 6,      /* rendezvous pull request (reference: GET_DATA) */
+  MSG_PUT_DATA = 7, /* rendezvous payload response (reference: PUT_END) */
 };
 
-struct Frame {
-  std::vector<uint8_t> bytes; /* full frame: len+type+body */
+/* ACTIVATE payload kinds (reference: short/eager piggy-back vs GET
+ * rendezvous, parsec/remote_dep.h:50-65 + remote_dep_mpi.c:241-253) */
+enum {
+  PK_NONE = 0,   /* CTL-only activation */
+  PK_EAGER = 1,  /* payload inline: [u64 len][bytes] */
+  PK_GET = 2,    /* host rendezvous: [u64 src_handle][u64 len] */
+  PK_DEVICE = 3, /* device rendezvous: same wire shape; the payload is
+                    served from / delivered to the device layer */
 };
 
-struct Peer {
+struct TcpPeer {
   int fd = -1;
   std::vector<uint8_t> inbuf;
   size_t in_off = 0; /* consumed prefix of inbuf */
   std::deque<std::vector<uint8_t>> out; /* pending frames */
   size_t out_off = 0; /* sent prefix of out.front() */
-  uint64_t fence_gen = 0; /* highest fence generation received */
-  /* per-generation activity flags of this peer's fences (pruned by the
-   * fence waiter); needed because a fast peer may already be a round
-   * ahead when we read its flag */
-  std::map<uint64_t, uint8_t> fence_dirty;
 };
 
 struct Writer {
@@ -105,32 +108,32 @@ struct Reader {
   int64_t i64() { int64_t v; raw(&v, 8); return v; }
 };
 
-} // namespace
+/* Transport vtable (reference seam: parsec_comm_engine.h:139-160 — the
+ * CE ops a module must implement; here the AM layer moves whole frames
+ * and the put/get rendezvous is framed on top, so the transport surface
+ * reduces to start / post / wake / stop).  New transports (DCN gRPC, a
+ * host-shared-memory engine) slot in beside `TCP_OPS` and are selected
+ * by the `comm.engine` MCA param (env PTC_MCA_comm_engine). */
+struct CeOps {
+  const char *name;
+  /* bring up links to all peers; spawn the progress thread */
+  int32_t (*start)(CommEngine *ce, int base_port);
+  /* queue one framed message for `rank` (any thread) */
+  void (*post)(CommEngine *ce, uint32_t rank, std::vector<uint8_t> &&frame);
+  /* kick the progress thread (posted work / shutdown) */
+  void (*wake)(CommEngine *ce);
+  /* drain deliverable queues, join the thread, close links */
+  void (*stop)(CommEngine *ce);
+};
 
-struct CommEngine {
-  ptc_context *ctx = nullptr;
-  uint32_t myrank = 0, nodes = 1;
-  std::vector<Peer> peers; /* indexed by rank; peers[myrank].fd == -1 */
+struct TcpTransport {
+  std::vector<TcpPeer> peers; /* indexed by rank; peers[myrank].fd == -1 */
   int listen_fd = -1;
   int wake_pipe[2] = {-1, -1};
   std::thread thread;
-  std::atomic<bool> running{false};
-  std::atomic<bool> stop{false};
 
-  std::mutex lock; /* protects peers[].out + fence state */
-  std::condition_variable fence_cv;
-  uint64_t fence_next = 1; /* next generation to issue */
-  /* payload-bearing sends (everything but FENCE frames), incl. relayed
-   * broadcast forwards; drives the multi-round fence (see ptc_comm_fence) */
-  std::atomic<uint64_t> activity{0};
-  uint64_t fence_prev_activity = 0; /* under lock; last round's snapshot */
-
-  /* stats (reference: parsec/remote_dep.c counters) */
-  std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
-  std::atomic<uint64_t> bytes_sent{0}, bytes_recv{0};
-
-  ~CommEngine() {
-    for (Peer &p : peers)
+  ~TcpTransport() {
+    for (TcpPeer &p : peers)
       if (p.fd >= 0) close(p.fd);
     if (listen_fd >= 0) close(listen_fd);
     if (wake_pipe[0] >= 0) close(wake_pipe[0]);
@@ -138,29 +141,81 @@ struct CommEngine {
   }
 };
 
+/* host-rendezvous source registration: a snapshot of the payload bytes
+ * retained until every expected GET was served (reference: the remote
+ * memory handle an ACTIVATE advertises, parsec/remote_dep.h:59-65) */
+struct MemReg {
+  std::vector<uint8_t> bytes;
+  ptc_copy *src = nullptr; /* retained: keeps pointer identity stable */
+  int32_t expected = 0;
+  int32_t served = 0;
+  uint8_t pk = PK_GET;
+};
+
+/* receiver side: a dep delivery whose payload is still being pulled */
+struct PendingGet {
+  int32_t tp_id;
+  int32_t flow_idx;
+  std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
+  uint8_t pk;
+};
+
+} // namespace
+
+struct CommEngine {
+  ptc_context *ctx = nullptr;
+  uint32_t myrank = 0, nodes = 1;
+  const CeOps *ops = nullptr;
+  TcpTransport tcp; /* transport state for TCP_OPS (inline: one engine
+                       per context; a second transport would switch on
+                       ops and use its own member) */
+  std::atomic<bool> running{false};
+  std::atomic<bool> stop{false};
+
+  std::mutex lock; /* protects tcp out-queues + fence + rendezvous state */
+  std::condition_variable fence_cv;
+  uint64_t fence_next = 1; /* next generation to issue */
+  /* per-peer fence progress (generic across transports) */
+  std::vector<uint64_t> fence_gen; /* highest generation received */
+  /* per-generation activity flags of each peer's fences (pruned by the
+   * fence waiter); a fast peer may already be a round ahead */
+  std::vector<std::map<uint64_t, uint8_t>> fence_dirty;
+  /* payload-bearing sends (everything but FENCE frames), incl. relayed
+   * broadcast forwards; drives the multi-round fence (see ptc_comm_fence) */
+  std::atomic<uint64_t> activity{0};
+  uint64_t fence_prev_activity = 0; /* under lock; last round's snapshot */
+
+  /* rendezvous state (under `lock`) */
+  uint64_t next_handle = 1, next_cookie = 1;
+  std::unordered_map<uint64_t, MemReg> mem_reg;
+  std::unordered_map<ptc_copy *, uint64_t> mem_by_copy;
+  std::unordered_map<uint64_t, PendingGet> pending_gets;
+  int64_t eager_limit = 64 * 1024; /* PTC_MCA_comm_eager_limit; <0 = off */
+
+  /* stats (reference: parsec/remote_dep.c counters) */
+  std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
+  std::atomic<uint64_t> bytes_sent{0}, bytes_recv{0};
+  std::atomic<uint64_t> gets_sent{0}, gets_served{0};
+  std::atomic<uint64_t> mem_reg_bytes{0}; /* currently registered */
+};
+
 namespace {
 
-static void comm_wake(CommEngine *ce) {
-  uint8_t b = 1;
-  ssize_t n = write(ce->wake_pipe[1], &b, 1);
-  (void)n;
-}
+static void comm_wake(CommEngine *ce) { ce->ops->wake(ce); }
 
 /* enqueue a finished frame for `rank` (worker threads call this) */
 static void comm_post(CommEngine *ce, uint32_t rank,
                       std::vector<uint8_t> &&frame) {
   bool is_fence = frame.size() > 4 && frame[4] == MSG_FENCE;
-  {
+  if (!is_fence) {
+    /* activity ticks before the transport enqueues: a fence snapshot
+     * must never see the queued frame but miss the count (the transport
+     * post takes ce->lock, so the snapshot orders after the tick) */
     std::lock_guard<std::mutex> g(ce->lock);
-    ce->peers[rank].out.push_back(std::move(frame));
-    /* activity MUST tick inside the lock: a fence snapshot (also under
-     * the lock) may otherwise see the queued frame but miss the count
-     * and declare a relayed broadcast hop quiescent */
-    if (!is_fence)
-      ce->activity.fetch_add(1, std::memory_order_relaxed);
+    ce->activity.fetch_add(1, std::memory_order_relaxed);
   }
   ce->msgs_sent.fetch_add(1, std::memory_order_relaxed);
-  comm_wake(ce);
+  ce->ops->post(ce, rank, std::move(frame));
 }
 
 static std::vector<uint8_t> frame_begin(uint8_t type) {
@@ -210,7 +265,8 @@ static std::vector<WireTarget> parse_targets(Reader &r, uint32_t nb_targets) {
 static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             int32_t flow_idx,
                             std::vector<WireTarget> &&targets,
-                            const uint8_t *payload, uint64_t plen) {
+                            const uint8_t *payload, uint64_t plen,
+                            int64_t device_uid = 0) {
   ptc_copy *copy = nullptr;
   if (plen > 0) {
     copy = new ptc_copy();
@@ -218,6 +274,9 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
     copy->size = (int64_t)plen;
     copy->owns_ptr = true;
     std::memcpy(copy->ptr, payload, (size_t)plen);
+    /* data plane delivered this payload into the device cache too: stamp
+     * its uid so a device-chore consumer hits the cache (no re-stage) */
+    copy->handle = device_uid;
   }
   for (WireTarget &t : targets) {
     ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
@@ -230,55 +289,131 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
   if (copy) ptc_copy_release_internal(ctx, copy); /* stages hold refs now */
 }
 
-/* parse [u32-already-read nb_targets] targets + [u64 plen][payload] */
-static void deliver_targets_wire(ptc_context *ctx, ptc_taskpool *tp,
-                                 int32_t flow_idx, uint32_t nb_targets,
-                                 Reader &r) {
-  std::vector<WireTarget> targets = parse_targets(r, nb_targets);
-  uint64_t plen = r.u64();
-  if (!r.ok || (size_t)(r.end - r.p) < plen) {
-    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
-    return;
-  }
-  deliver_targets(ctx, tp, flow_idx, std::move(targets), r.p, plen);
-}
-
-/* body excludes the type byte */
-static void handle_activate_body(ptc_context *ctx, const uint8_t *body,
-                                 size_t len, bool allow_park) {
-  Reader r{body, body + len};
-  int32_t tp_id = r.i32();
-  int32_t flow_idx = r.i32();
-  uint32_t nb_targets = r.u32();
+/* Deliver targets to taskpool `tp_id`, parking [type][raw ACTIVATE body]
+ * if the pool is not registered yet (SPMD skew; reference:
+ * dep_activates_noobj_fifo, remote_dep_mpi.c:92).  `targets_bytes` is the
+ * serialized [u32 nb_targets] targets* slice; `payload` the materialized
+ * bytes (eager or pulled); `device_uid` a device-cache id for the
+ * payload copy (data plane) or 0. */
+static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
+                            const uint8_t *targets_bytes, size_t targets_len,
+                            const uint8_t *payload, uint64_t plen,
+                            int64_t device_uid, bool allow_park) {
   ptc_taskpool *tp = find_tp(ctx, tp_id);
   if (!tp) {
-    if (allow_park) {
-      /* taskpool not registered yet (SPMD skew): park [type][raw body]
-       * (reference: dep_activates_noobj_fifo, remote_dep_mpi.c:92).
-       * Re-check the registry under the lock: add_taskpool may have
-       * registered + drained between find_tp and here — parking after
-       * the drain would lose the frame forever. */
-      std::unique_lock<std::mutex> g(ctx->tp_reg_lock);
-      auto it = ctx->tp_registry.find(tp_id);
-      if (it != ctx->tp_registry.end()) {
-        tp = it->second;
-        g.unlock();
-        /* fall through to normal delivery below */
-      } else {
-        std::vector<uint8_t> parked;
-        parked.reserve(len + 1);
-        parked.push_back(MSG_ACTIVATE);
-        parked.insert(parked.end(), body, body + len);
-        ctx->tp_early[tp_id].push_back(std::move(parked));
-        return;
+    /* Re-check the registry under the lock: add_taskpool may have
+     * registered + drained between find_tp and here — parking after
+     * the drain would lose the frame forever. */
+    std::unique_lock<std::mutex> g(ctx->tp_reg_lock);
+    auto it = ctx->tp_registry.find(tp_id);
+    if (it != ctx->tp_registry.end()) {
+      tp = it->second;
+      g.unlock();
+    } else if (allow_park) {
+      /* park a self-contained eager-form ACTIVATE body (replayed by
+       * ptc_comm_drain_early; device_uid is dropped — replay stages the
+       * host bytes, the device re-stages on first use) */
+      std::vector<uint8_t> parked;
+      parked.push_back(MSG_ACTIVATE);
+      Writer w{parked};
+      w.i32(tp_id);
+      w.i32(flow_idx);
+      w.raw(targets_bytes, targets_len);
+      w.u8(plen ? PK_EAGER : PK_NONE);
+      if (plen) {
+        w.u64(plen);
+        w.raw(payload, (size_t)plen);
       }
+      ctx->tp_early[tp_id].push_back(std::move(parked));
+      return;
     } else {
       std::fprintf(stderr, "ptc-comm: activation for unknown taskpool %d "
                            "dropped\n", tp_id);
       return;
     }
   }
-  deliver_targets_wire(ctx, tp, flow_idx, nb_targets, r);
+  Reader tr{targets_bytes, targets_bytes + targets_len};
+  uint32_t nb_targets = tr.u32();
+  std::vector<WireTarget> targets = parse_targets(tr, nb_targets);
+  if (!tr.ok) {
+    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE targets dropped\n");
+    return;
+  }
+  deliver_targets(ctx, tp, flow_idx, std::move(targets), payload, plen,
+                  device_uid);
+}
+
+/* body excludes the type byte.  `from` is the sending rank (rendezvous
+ * pulls go back to it); parked replays pass UINT32_MAX — parked bodies
+ * are always eager-form, so no pull can target it. */
+static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
+                                 uint32_t from, const uint8_t *body,
+                                 size_t len, bool allow_park) {
+  Reader r{body, body + len};
+  int32_t tp_id = r.i32();
+  int32_t flow_idx = r.i32();
+  const uint8_t *targets_start = r.p;
+  uint32_t nb_targets = r.u32();
+  (void)parse_targets(r, nb_targets); /* skip to measure the slice */
+  const uint8_t *targets_end = r.p;
+  uint8_t pk = r.u8();
+  if (!r.ok) {
+    std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
+    return;
+  }
+  switch (pk) {
+  case PK_NONE:
+    deliver_or_park(ctx, tp_id, flow_idx, targets_start,
+                    (size_t)(targets_end - targets_start), nullptr, 0, 0,
+                    allow_park);
+    return;
+  case PK_EAGER: {
+    uint64_t plen = r.u64();
+    if (!r.ok || (size_t)(r.end - r.p) < plen) {
+      std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
+      return;
+    }
+    deliver_or_park(ctx, tp_id, flow_idx, targets_start,
+                    (size_t)(targets_end - targets_start), r.p, plen, 0,
+                    allow_park);
+    return;
+  }
+  case PK_GET:
+  case PK_DEVICE: {
+    uint64_t src_handle = r.u64();
+    uint64_t plen = r.u64();
+    (void)plen;
+    if (!r.ok || !ce || from >= ce->nodes) {
+      std::fprintf(stderr, "ptc-comm: malformed rendezvous ACTIVATE "
+                           "dropped\n");
+      return;
+    }
+    /* park the delivery against a cookie, pull the payload.  The pool
+     * may be unknown yet — resolution happens at PUT_DATA time. */
+    uint64_t cookie;
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      cookie = ce->next_cookie++;
+      PendingGet pg;
+      pg.tp_id = tp_id;
+      pg.flow_idx = flow_idx;
+      pg.targets_bytes.assign(targets_start, targets_end);
+      pg.pk = pk;
+      ce->pending_gets.emplace(cookie, std::move(pg));
+    }
+    std::vector<uint8_t> f = frame_begin(MSG_GET);
+    Writer w{f};
+    w.u64(src_handle);
+    w.u64(cookie);
+    frame_finish(f);
+    ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
+    comm_post(ce, from, std::move(f));
+    return;
+  }
+  default:
+    std::fprintf(stderr, "ptc-comm: unknown ACTIVATE payload kind %d\n",
+                 (int)pk);
+  }
 }
 
 static void handle_put_body(ptc_context *ctx, const uint8_t *body, size_t len) {
@@ -433,18 +568,109 @@ static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
                     r.p, plen);
     return;
   }
-  /* unknown taskpool (SPMD skew): synthesize a plain ACTIVATE body and
-   * reuse its delivery + parking path (a parked frame must NOT re-forward
-   * on replay — the synthesized frame cannot) */
-  std::vector<uint8_t> synth;
-  synth.reserve(8 + my_targets.size() + 8 + (size_t)plen);
-  Writer w{synth};
-  w.i32(tp_id);
-  w.i32(flow_idx);
-  w.raw(my_targets.data(), my_targets.size());
-  w.u64(plen);
-  if (plen) w.raw(r.p, (size_t)plen);
-  handle_activate_body(ctx, synth.data(), synth.size(), /*allow_park=*/true);
+  /* unknown taskpool (SPMD skew): park via the shared eager-form path (a
+   * parked frame must NOT re-forward on replay — this form cannot) */
+  deliver_or_park(ctx, tp_id, flow_idx, my_targets.data(), my_targets.size(),
+                  r.p, plen, 0, /*allow_park=*/true);
+}
+
+/* serve a rendezvous pull: respond with the registered payload bytes */
+static void handle_get_body(CommEngine *ce, uint32_t from,
+                            const uint8_t *body, size_t len) {
+  ptc_context *ctx = ce->ctx;
+  Reader r{body, body + len};
+  uint64_t src_handle = r.u64();
+  uint64_t cookie = r.u64();
+  if (!r.ok) return;
+  std::vector<uint8_t> f = frame_begin(MSG_PUT_DATA);
+  Writer w{f};
+  w.u64(cookie);
+  uint8_t pk = PK_GET;
+  bool device_served = false;
+  {
+    std::unique_lock<std::mutex> g(ce->lock);
+    auto it = ce->mem_reg.find(src_handle);
+    if (it == ce->mem_reg.end()) {
+      g.unlock();
+      std::fprintf(stderr, "ptc-comm: GET for unknown handle %llu from "
+                           "rank %u; dropped\n",
+                   (unsigned long long)src_handle, from);
+      return;
+    }
+    MemReg &m = it->second;
+    pk = m.pk;
+    if (m.pk == PK_DEVICE) {
+      device_served = true; /* serve outside the lock (calls into Python) */
+    } else {
+      w.u8(m.pk);
+      w.u64((uint64_t)m.bytes.size());
+      w.raw(m.bytes.data(), m.bytes.size());
+    }
+    m.served++;
+    ptc_copy *rel = nullptr;
+    if (m.served >= m.expected) { /* last pull: drop the registration */
+      ce->mem_reg_bytes.fetch_sub(m.bytes.size(), std::memory_order_relaxed);
+      rel = m.src;
+      if (rel) ce->mem_by_copy.erase(rel);
+      ce->mem_reg.erase(it);
+    }
+    g.unlock();
+    if (rel) ptc_copy_release_internal(ctx, rel);
+  }
+  if (device_served) {
+    /* device-resident source: the device layer produces the bytes (on a
+     * TPU pod this is where the transfer rides ICI instead) */
+    void *ptr = nullptr;
+    int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user,
+                                              (int64_t)src_handle, &ptr)
+                              : -1;
+    if (n < 0 || !ptr) {
+      std::fprintf(stderr, "ptc-comm: data plane could not serve tag "
+                           "%llu\n", (unsigned long long)src_handle);
+      return;
+    }
+    w.u8(pk);
+    w.u64((uint64_t)n);
+    w.raw(ptr, (size_t)n);
+    if (ctx->dp_serve_done)
+      ctx->dp_serve_done(ctx->dp_user, (int64_t)src_handle);
+  }
+  frame_finish(f);
+  ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+  comm_post(ce, from, std::move(f));
+}
+
+/* rendezvous payload arrived: release the parked delivery */
+static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
+                                 size_t len) {
+  ptc_context *ctx = ce->ctx;
+  Reader r{body, body + len};
+  uint64_t cookie = r.u64();
+  uint8_t pk = r.u8();
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed PUT_DATA dropped\n");
+    return;
+  }
+  PendingGet pg;
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    auto it = ce->pending_gets.find(cookie);
+    if (it == ce->pending_gets.end()) {
+      std::fprintf(stderr, "ptc-comm: PUT_DATA for unknown cookie %llu "
+                           "dropped\n", (unsigned long long)cookie);
+      return;
+    }
+    pg = std::move(it->second);
+    ce->pending_gets.erase(it);
+  }
+  int64_t device_uid = 0;
+  if (pk == PK_DEVICE && ctx->dp_deliver)
+    device_uid = ctx->dp_deliver(ctx->dp_user, r.p, (int64_t)plen,
+                                 (int64_t)cookie);
+  deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
+                  pg.targets_bytes.size(), r.p, plen, device_uid,
+                  /*allow_park=*/true);
 }
 
 static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
@@ -453,7 +679,13 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   ce->msgs_recv.fetch_add(1, std::memory_order_relaxed);
   switch (type) {
   case MSG_ACTIVATE:
-    handle_activate_body(ctx, body, len, /*allow_park=*/true);
+    handle_activate_body(ce, ctx, from, body, len, /*allow_park=*/true);
+    break;
+  case MSG_GET:
+    handle_get_body(ce, from, body, len);
+    break;
+  case MSG_PUT_DATA:
+    handle_put_data_body(ce, body, len);
     break;
   case MSG_ACTIVATE_BCAST:
     handle_activate_bcast_body(ce, body, len);
@@ -470,8 +702,8 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     uint8_t dirty = r.u8();
     {
       std::lock_guard<std::mutex> g(ce->lock);
-      if (gen > ce->peers[from].fence_gen) ce->peers[from].fence_gen = gen;
-      ce->peers[from].fence_dirty[gen] = dirty;
+      if (gen > ce->fence_gen[from]) ce->fence_gen[from] = gen;
+      ce->fence_dirty[from][gen] = dirty;
     }
     ce->fence_cv.notify_all();
     break;
@@ -483,7 +715,7 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
 
 /* parse all complete frames in a peer's inbuf */
 static void parse_inbuf(CommEngine *ce, uint32_t rank) {
-  Peer &p = ce->peers[rank];
+  TcpPeer &p = ce->tcp.peers[rank];
   while (true) {
     size_t avail = p.inbuf.size() - p.in_off;
     if (avail < 5) break;
@@ -519,6 +751,7 @@ static void parse_inbuf(CommEngine *ce, uint32_t rank) {
 /* ---------------- comm thread ---------------- */
 
 static void comm_main(CommEngine *ce) {
+  TcpTransport &tt = ce->tcp;
   std::vector<struct pollfd> pfds;
   std::vector<uint32_t> pfd_rank;
   uint8_t rbuf[1 << 16];
@@ -532,19 +765,19 @@ static void comm_main(CommEngine *ce) {
       bool pending = false;
       {
         std::lock_guard<std::mutex> g(ce->lock);
-        for (Peer &p : ce->peers)
+        for (TcpPeer &p : tt.peers)
           if (p.fd >= 0 && !p.out.empty()) pending = true;
       }
       if (!pending || ptc_now_ns() > stop_deadline) break;
     }
     pfds.clear();
     pfd_rank.clear();
-    pfds.push_back({ce->wake_pipe[0], POLLIN, 0});
+    pfds.push_back({tt.wake_pipe[0], POLLIN, 0});
     pfd_rank.push_back(UINT32_MAX);
     {
       std::lock_guard<std::mutex> g(ce->lock);
       for (uint32_t r = 0; r < ce->nodes; r++) {
-        Peer &p = ce->peers[r];
+        TcpPeer &p = tt.peers[r];
         if (p.fd < 0) continue;
         short ev = POLLIN;
         if (!p.out.empty()) ev |= POLLOUT;
@@ -556,11 +789,11 @@ static void comm_main(CommEngine *ce) {
     if (rc < 0 && errno != EINTR) break;
     /* drain wakeup pipe */
     if (pfds[0].revents & POLLIN) {
-      while (read(ce->wake_pipe[0], rbuf, sizeof(rbuf)) > 0) {}
+      while (read(tt.wake_pipe[0], rbuf, sizeof(rbuf)) > 0) {}
     }
     for (size_t i = 1; i < pfds.size(); i++) {
       uint32_t r = pfd_rank[i];
-      Peer &p = ce->peers[r];
+      TcpPeer &p = tt.peers[r];
       if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
         while (true) {
           ssize_t n = recv(p.fd, rbuf, sizeof(rbuf), 0);
@@ -654,6 +887,99 @@ static void set_sock_opts(int fd) {
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
 }
 
+/* ---------------- TCP transport ops (the one built-in CeOps) -------- */
+
+static void tcp_wake(CommEngine *ce) {
+  uint8_t b = 1;
+  ssize_t n = write(ce->tcp.wake_pipe[1], &b, 1);
+  (void)n;
+}
+
+static void tcp_post(CommEngine *ce, uint32_t rank,
+                     std::vector<uint8_t> &&frame) {
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    ce->tcp.peers[rank].out.push_back(std::move(frame));
+  }
+  tcp_wake(ce);
+}
+
+static int32_t tcp_start(CommEngine *ce, int base_port) {
+  TcpTransport &tt = ce->tcp;
+  tt.peers.resize(ce->nodes);
+  if (pipe(tt.wake_pipe) != 0) return -1;
+  {
+    int fl = fcntl(tt.wake_pipe[0], F_GETFL, 0);
+    fcntl(tt.wake_pipe[0], F_SETFL, fl | O_NONBLOCK);
+  }
+  /* rank r listens on base+r; connects to all lower ranks, accepts from
+   * all higher ranks.  Loopback full mesh (DCN analog). */
+  tt.listen_fd = make_listen(base_port + (int)ce->myrank);
+  if (tt.listen_fd < 0) {
+    std::fprintf(stderr, "ptc-comm: cannot listen on port %d: %s\n",
+                 base_port + (int)ce->myrank, strerror(errno));
+    return -1;
+  }
+  for (uint32_t r = 0; r < ce->myrank; r++) {
+    int fd = connect_retry(base_port + (int)r, 30000);
+    if (fd < 0) {
+      std::fprintf(stderr, "ptc-comm: cannot connect to rank %u\n", r);
+      return -1;
+    }
+    uint32_t me = ce->myrank;
+    if (send(fd, &me, 4, 0) != 4) {
+      close(fd);
+      return -1;
+    }
+    set_sock_opts(fd);
+    tt.peers[r].fd = fd;
+  }
+  /* accept until every higher rank has handshaken; stray connections
+   * (port scanners, test port probes) are rejected without consuming a
+   * peer slot */
+  uint32_t accepted = 0, expected = ce->nodes - 1 - ce->myrank;
+  int strays = 0;
+  while (accepted < expected) {
+    int fd = accept(tt.listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      std::fprintf(stderr, "ptc-comm: accept failed: %s\n", strerror(errno));
+      return -1;
+    }
+    uint32_t who = 0;
+    ssize_t got = recv(fd, &who, 4, MSG_WAITALL);
+    if (got != 4 || who <= ce->myrank || who >= ce->nodes ||
+        tt.peers[who].fd >= 0) {
+      std::fprintf(stderr, "ptc-comm: rejecting bad peer handshake\n");
+      close(fd);
+      if (++strays > 256) return -1; /* give up rather than loop forever */
+      continue;
+    }
+    set_sock_opts(fd);
+    tt.peers[who].fd = fd;
+    accepted++;
+  }
+  tt.thread = std::thread(comm_main, ce);
+  return 0;
+}
+
+static void tcp_stop(CommEngine *ce) {
+  tcp_wake(ce);
+  if (ce->tcp.thread.joinable()) ce->tcp.thread.join();
+}
+
+static const CeOps TCP_OPS = {"tcp", tcp_start, tcp_post, tcp_wake, tcp_stop};
+
+/* transport registry (MCA-style selection by name) */
+static const CeOps *CE_REGISTRY[] = {&TCP_OPS};
+
+static const CeOps *ce_select(const char *name) {
+  for (const CeOps *ops : CE_REGISTRY)
+    if (!name || !*name || std::strcmp(ops->name, name) == 0) return ops;
+  std::fprintf(stderr, "ptc-comm: unknown comm engine '%s'; using %s\n",
+               name, CE_REGISTRY[0]->name);
+  return CE_REGISTRY[0];
+}
+
 } // namespace
 
 /* ------------------------------------------------------------------ */
@@ -683,12 +1009,64 @@ void ptc_comm_send_activate_batch(
     w.u8((uint8_t)t.second.size());
     for (int64_t v : t.second) w.i64(v);
   }
-  if (copy && copy->ptr && copy->size > 0) {
+  bool has_payload = copy && copy->ptr && copy->size > 0;
+  bool big = has_payload && ce->eager_limit >= 0 &&
+             copy->size > ce->eager_limit;
+  int64_t dp_tag = 0;
+  if (big && ctx->dp_register && copy->handle != 0) {
+    /* device-resident source: advertise a transfer tag; the payload never
+     * touches this host's memory (the loopback transport serves a d2h at
+     * pull time; on a pod this is the ICI ride).  0 = no current mirror,
+     * fall through to the host paths. */
+    dp_tag = ctx->dp_register(ctx->dp_user, copy->handle,
+                              copy->version.load(), copy->size);
+  }
+  if (!has_payload) {
+    w.u8(PK_NONE);
+  } else if (dp_tag > 0) {
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      MemReg &m = ce->mem_reg[(uint64_t)dp_tag];
+      m.pk = PK_DEVICE;
+      m.expected++;
+    }
+    w.u8(PK_DEVICE);
+    w.u64((uint64_t)dp_tag);
+    w.u64((uint64_t)copy->size);
+  } else if (big) {
+    /* host rendezvous: register a snapshot once per copy (fan-out ranks
+     * share it — per-rank payload dedup) and advertise the handle */
+    ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshotting */
+    uint64_t h;
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      auto itc = ce->mem_by_copy.find(copy);
+      if (itc != ce->mem_by_copy.end()) {
+        h = itc->second;
+        ce->mem_reg[h].expected++;
+      } else {
+        h = ce->next_handle++;
+        MemReg m;
+        m.pk = PK_GET;
+        m.expected = 1;
+        m.src = copy;
+        ptc_copy_retain(copy); /* pointer identity pin until last pull */
+        m.bytes.assign((const uint8_t *)copy->ptr,
+                       (const uint8_t *)copy->ptr + copy->size);
+        ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+                                    std::memory_order_relaxed);
+        ce->mem_reg.emplace(h, std::move(m));
+        ce->mem_by_copy.emplace(copy, h);
+      }
+    }
+    w.u8(PK_GET);
+    w.u64(h);
+    w.u64((uint64_t)copy->size);
+  } else {
     ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
+    w.u8(PK_EAGER);
     w.u64((uint64_t)copy->size);
     w.raw(copy->ptr, (size_t)copy->size);
-  } else {
-    w.u64(0);
   }
   frame_finish(f);
   for (const auto &t : targets)
@@ -816,8 +1194,9 @@ void ptc_comm_drain_early(ptc_context *ctx, ptc_taskpool *tp) {
     if (body.empty()) continue;
     uint8_t type = body[0];
     if (type == MSG_ACTIVATE)
-      handle_activate_body(ctx, body.data() + 1, body.size() - 1,
-                           /*allow_park=*/false);
+      /* parked bodies are always eager-form — `from` is never needed */
+      handle_activate_body(ctx->comm, ctx, UINT32_MAX, body.data() + 1,
+                           body.size() - 1, /*allow_park=*/false);
     else if (type == MSG_DTD_DONE)
       handle_dtd_done_body(ctx, body.data() + 1, body.size() - 1);
   }
@@ -828,10 +1207,12 @@ void ptc_comm_shutdown(ptc_context *ctx) {
   if (!ce) return;
   ce->stop.store(true, std::memory_order_release);
   ce->fence_cv.notify_all(); /* unblock any in-flight fence */
-  comm_wake(ce);
-  if (ce->thread.joinable()) ce->thread.join();
+  ce->ops->stop(ce);        /* drains, joins, transport dtor closes fds */
+  /* release rendezvous sources that were never fully pulled */
+  for (auto &kv : ce->mem_reg)
+    if (kv.second.src) ptc_copy_release_internal(ctx, kv.second.src);
   ctx->comm = nullptr;
-  delete ce; /* destructor closes sockets + pipe */
+  delete ce;
 }
 
 /* ------------------------------------------------------------------ */
@@ -847,71 +1228,17 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
   ce->ctx = ctx;
   ce->myrank = ctx->myrank;
   ce->nodes = ctx->nodes;
-  ce->peers.resize(ctx->nodes);
-  if (pipe(ce->wake_pipe) != 0) {
+  ce->fence_gen.assign(ctx->nodes, 0);
+  ce->fence_dirty.resize(ctx->nodes);
+  ce->ops = ce_select(std::getenv("PTC_MCA_comm_engine"));
+  if (const char *e = std::getenv("PTC_MCA_comm_eager_limit"))
+    ce->eager_limit = std::atoll(e);
+  if (ce->ops->start(ce, base_port) != 0) {
     delete ce;
     return -1;
-  }
-  {
-    int fl = fcntl(ce->wake_pipe[0], F_GETFL, 0);
-    fcntl(ce->wake_pipe[0], F_SETFL, fl | O_NONBLOCK);
-  }
-  /* rank r listens on base+r; connects to all lower ranks, accepts from
-   * all higher ranks.  Loopback full mesh (DCN analog). */
-  ce->listen_fd = make_listen(base_port + (int)ce->myrank);
-  if (ce->listen_fd < 0) {
-    std::fprintf(stderr, "ptc-comm: cannot listen on port %d: %s\n",
-                 base_port + (int)ce->myrank, strerror(errno));
-    delete ce;
-    return -1;
-  }
-  for (uint32_t r = 0; r < ce->myrank; r++) {
-    int fd = connect_retry(base_port + (int)r, 30000);
-    if (fd < 0) {
-      std::fprintf(stderr, "ptc-comm: cannot connect to rank %u\n", r);
-      delete ce;
-      return -1;
-    }
-    uint32_t me = ce->myrank;
-    if (send(fd, &me, 4, 0) != 4) {
-      close(fd);
-      delete ce;
-      return -1;
-    }
-    set_sock_opts(fd);
-    ce->peers[r].fd = fd;
-  }
-  /* accept until every higher rank has handshaken; stray connections
-   * (port scanners, test port probes) are rejected without consuming a
-   * peer slot */
-  uint32_t accepted = 0, expected = ce->nodes - 1 - ce->myrank;
-  int strays = 0;
-  while (accepted < expected) {
-    int fd = accept(ce->listen_fd, nullptr, nullptr);
-    if (fd < 0) {
-      std::fprintf(stderr, "ptc-comm: accept failed: %s\n", strerror(errno));
-      delete ce;
-      return -1;
-    }
-    uint32_t who = 0;
-    ssize_t got = recv(fd, &who, 4, MSG_WAITALL);
-    if (got != 4 || who <= ce->myrank || who >= ce->nodes ||
-        ce->peers[who].fd >= 0) {
-      std::fprintf(stderr, "ptc-comm: rejecting bad peer handshake\n");
-      close(fd);
-      if (++strays > 256) { /* give up rather than loop forever */
-        delete ce;
-        return -1;
-      }
-      continue;
-    }
-    set_sock_opts(fd);
-    ce->peers[who].fd = fd;
-    accepted++;
   }
   ce->running.store(true);
   ctx->comm = ce;
-  ce->thread = std::thread(comm_main, ce);
   return 0;
 }
 
@@ -945,7 +1272,11 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
       std::lock_guard<std::mutex> g(ce->lock);
       gen = ce->fence_next++;
       uint64_t act = ce->activity.load(std::memory_order_relaxed);
-      mydirty = (act != ce->fence_prev_activity) ? 1 : 0;
+      /* in-flight rendezvous keeps the fence looping: a pulled payload
+       * not yet applied means the system is not quiescent even if no
+       * frame was posted since the last snapshot */
+      mydirty = (act != ce->fence_prev_activity ||
+                 !ce->pending_gets.empty() || !ce->mem_reg.empty()) ? 1 : 0;
       ce->fence_prev_activity = act;
     }
     for (uint32_t r = 0; r < ce->nodes; r++) {
@@ -964,8 +1295,7 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
         if (ce->stop.load(std::memory_order_acquire)) return true;
         for (uint32_t r = 0; r < ce->nodes; r++) {
           if (r == ce->myrank) continue;
-          if (ce->peers[r].fence_gen < gen ||
-              !ce->peers[r].fence_dirty.count(gen))
+          if (ce->fence_gen[r] < gen || !ce->fence_dirty[r].count(gen))
             return false;
         }
         return true;
@@ -973,16 +1303,17 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
       if (ce->stop.load(std::memory_order_acquire)) return 0;
       for (uint32_t r = 0; r < ce->nodes; r++) {
         if (r == ce->myrank) continue;
-        auto &m = ce->peers[r].fence_dirty;
+        auto &m = ce->fence_dirty[r];
         any_dirty = any_dirty || (m.count(gen) && m[gen]);
         m.erase(m.begin(), m.upper_bound(gen));
       }
     }
-    /* star topology has no relays: per-link FIFO already makes one round
-     * a complete flush, so skip the extra all-clean round.  (Decision is
-     * uniform: comm_topo is set SPMD-symmetrically before traffic; when
-     * switching topologies mid-run, fence BEFORE the switch.) */
-    if (ctx->comm_topo.load(std::memory_order_relaxed) == 0) return 0;
+    /* Loop until an all-clean round: per-link FIFO makes every direct
+     * message posted before FENCE(r) apply before its target finishes
+     * round r, and relays / rendezvous round-trips flip a later round's
+     * dirty flag, so an all-clean round proves global quiescence.  (The
+     * round count is uniform: every rank computes any_dirty over the
+     * same flag set.) */
     if (!any_dirty) return 0;
   }
 }
@@ -1003,6 +1334,22 @@ void ptc_comm_stats(ptc_context_t *ctx, int64_t *out4) {
   out4[1] = ce ? (int64_t)ce->msgs_recv.load() : 0;
   out4[2] = ce ? (int64_t)ce->bytes_sent.load() : 0;
   out4[3] = ce ? (int64_t)ce->bytes_recv.load() : 0;
+}
+
+/* rendezvous statistics: gets sent/served, currently-registered snapshot
+ * bytes, pending pulls (the last two must be 0 after a fence — the
+ * bounded-memory invariant of the GET protocol) */
+void ptc_comm_rdv_stats(ptc_context_t *ctx, int64_t *out4) {
+  CommEngine *ce = ctx->comm;
+  out4[0] = ce ? (int64_t)ce->gets_sent.load() : 0;
+  out4[1] = ce ? (int64_t)ce->gets_served.load() : 0;
+  out4[2] = ce ? (int64_t)ce->mem_reg_bytes.load() : 0;
+  int64_t pend = 0;
+  if (ce) {
+    std::lock_guard<std::mutex> g(ce->lock);
+    pend = (int64_t)ce->pending_gets.size();
+  }
+  out4[3] = pend;
 }
 
 } /* extern "C" */

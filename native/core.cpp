@@ -1591,6 +1591,16 @@ void ptc_copy_sync_for_host(ptc_context *ctx, ptc_copy *c) {
   if (cb) cb(ctx->copy_sync_user, c->handle);
 }
 
+void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,
+                       ptc_dp_serve_cb serve, ptc_dp_serve_done_cb done,
+                       ptc_dp_deliver_cb deliver, void *user) {
+  ctx->dp_register = reg;
+  ctx->dp_serve = serve;
+  ctx->dp_serve_done = done;
+  ctx->dp_deliver = deliver;
+  ctx->dp_user = user;
+}
+
 /* task accessors */
 int64_t ptc_task_local(ptc_task_t *t, int32_t i) {
   return (t && i >= 0 && i < PTC_MAX_LOCALS) ? t->locals[i] : 0;

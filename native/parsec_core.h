@@ -272,6 +272,29 @@ void ptc_set_copy_release_cb(ptc_context_t *ctx, ptc_copy_release_cb cb,
 typedef void (*ptc_copy_sync_cb)(void *user, int64_t handle);
 void ptc_set_copy_sync_cb(ptc_context_t *ctx, ptc_copy_sync_cb cb,
                           void *user);
+
+/* ---- device data plane (ICI seam) ----------------------------------
+ * When registered, remote dependency payloads whose copy is device-
+ * resident skip the host eager path: the ACTIVATE advertises a transfer
+ * tag, the consumer pulls, and the payload is served from / delivered to
+ * the device layer (reference seam: comm-engine put/get on registered
+ * memory, parsec_comm_engine.h:139-160; on TPU pods the serve/deliver
+ * pair rides ICI instead of host TCP).
+ *   dp_register(copy_handle, size) -> tag>0 if a device mirror exists
+ *                                     (the payload source), else 0
+ *   dp_serve(tag, &ptr)  -> byte size; ptr valid until dp_serve_done(tag)
+ *   dp_deliver(ptr, size, tag) -> device-cache uid for the delivered
+ *                                 payload (stamped on the new host copy)
+ */
+typedef int64_t (*ptc_dp_register_cb)(void *user, int64_t copy_handle,
+                                      int64_t version, int64_t size);
+typedef int64_t (*ptc_dp_serve_cb)(void *user, int64_t tag, void **ptr_out);
+typedef void (*ptc_dp_serve_done_cb)(void *user, int64_t tag);
+typedef int64_t (*ptc_dp_deliver_cb)(void *user, const void *ptr,
+                                     int64_t size, int64_t tag);
+void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,
+                       ptc_dp_serve_cb serve, ptc_dp_serve_done_cb done,
+                       ptc_dp_deliver_cb deliver, void *user);
 /* nonzero if the copy is backed by persistent user data (ptc_data_new),
  * zero for transient arena-backed copies */
 int32_t ptc_copy_is_persistent(ptc_copy_t *c);
@@ -295,6 +318,8 @@ int32_t ptc_comm_fini(ptc_context_t *ctx);
 int32_t ptc_comm_enabled(ptc_context_t *ctx);
 /* out4 = {msgs_sent, msgs_recv, bytes_sent, bytes_recv} */
 void ptc_comm_stats(ptc_context_t *ctx, int64_t *out4);
+/* rendezvous: [gets_sent, gets_served, registered_bytes, pending_pulls] */
+void ptc_comm_rdv_stats(ptc_context_t *ctx, int64_t *out4);
 
 /* distributed taskpool id (SPMD creation order; assigned at add_taskpool) */
 int32_t ptc_tp_id(ptc_taskpool_t *tp);

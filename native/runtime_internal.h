@@ -389,6 +389,13 @@ struct ptc_context {
   ptc_copy_sync_cb copy_sync_cb = nullptr;
   void *copy_sync_user = nullptr;
 
+  /* device data plane (ICI seam; see parsec_core.h) */
+  ptc_dp_register_cb dp_register = nullptr;
+  ptc_dp_serve_cb dp_serve = nullptr;
+  ptc_dp_serve_done_cb dp_serve_done = nullptr;
+  ptc_dp_deliver_cb dp_deliver = nullptr;
+  void *dp_user = nullptr;
+
   /* profiling */
   std::atomic<int32_t> prof_level{0}; /* 0 off, 1 spans, 2 +edges */
   std::vector<ProfBuf *> prof;

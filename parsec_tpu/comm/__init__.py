@@ -1,0 +1,27 @@
+"""Communication layer, Python side.
+
+The native comm engine (native/comm.cpp — transport vtable, ACTIVATE/GET
+rendezvous, device data plane) is driven through Context.comm_init.  This
+package adds:
+
+- `init(ctx)`: join the multi-rank job described by PTC_RANK / PTC_WORLD /
+  PTC_PORT (set by `python -m parsec_tpu.comm.launch`, the mpirun analog
+  of the reference's test harness, SURVEY.md §4)
+- `ici`: cached device-to-device transfer programs for single-controller
+  deployments (collective-permute executables over a mesh; device_put
+  between devices of one client — ICI traffic on a TPU slice)
+"""
+import os
+
+
+def init(ctx, base_port=None):
+    """Initialize the native comm engine from launcher-provided env.
+    No-op (returns rank 0, world 1) outside a launched job."""
+    rank = int(os.environ.get("PTC_RANK", "0"))
+    world = int(os.environ.get("PTC_WORLD", "1"))
+    port = base_port if base_port is not None else int(
+        os.environ.get("PTC_PORT", "29650"))
+    if world > 1:
+        ctx.set_rank(rank, world)
+        ctx.comm_init(port)
+    return rank, world

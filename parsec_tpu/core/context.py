@@ -188,6 +188,14 @@ class Context:
         return {"msgs_sent": buf[0], "msgs_recv": buf[1],
                 "bytes_sent": buf[2], "bytes_recv": buf[3]}
 
+    def comm_rdv_stats(self) -> dict:
+        """Rendezvous-protocol counters.  After a fence, registered_bytes
+        and pending_pulls must both be 0 (bounded comm memory)."""
+        buf = (C.c_int64 * 4)()
+        N.lib.ptc_comm_rdv_stats(self._ptr, buf)
+        return {"gets_sent": buf[0], "gets_served": buf[1],
+                "registered_bytes": buf[2], "pending_pulls": buf[3]}
+
     # ------------------------------------------------------------ registries
     def register_expr_cb(self, fn: Callable) -> int:
         cb = N.EXPR_CB_T(fn)

@@ -103,6 +103,97 @@ def maybe_sync_copy(cptr) -> None:
         sync_copy_handle(h)
 
 
+# ---------------------------------------------------------------- data plane
+# Device side of the comm engine's PK_DEVICE rendezvous (native seam:
+# ptc_set_dataplane, reference: comm-engine put/get on registered memory,
+# parsec_comm_engine.h:139-160).  A remote dep whose copy has a current
+# device mirror is advertised as a transfer tag; the payload is served
+# from the mirror at pull time (one d2h on the loopback transport — on a
+# single-controller pod slice this is a device-to-device hop, and a
+# multi-host ICI engine slots in behind the same three callbacks) and
+# delivered into the consumer's device cache, so the producing host copy
+# is never written and the consuming device chore re-stages nothing.
+
+_DP_LOCK = threading.Lock()
+_DP_STATE = {"next_tag": 1}
+_DP_REG: Dict[int, object] = {}      # tag -> device array (payload source)
+_DP_SERVING: Dict[int, object] = {}  # tag -> host bytes pinned during serve
+
+
+def _dp_register(user, copy_handle, version, size) -> int:
+    """A remote send asks: is there a current device mirror for this copy?
+    Returns a transfer tag (>0) or 0 to fall back to the host path."""
+    try:
+        for dev in list(_ALL_DEVICES):
+            with dev._lock:
+                ent = dev._cache.get(copy_handle)
+                if ent is not None and ent.version == version:
+                    with _DP_LOCK:
+                        tag = _DP_STATE["next_tag"]
+                        _DP_STATE["next_tag"] += 1
+                        _DP_REG[tag] = ent.arr
+                    dev.stats["dp_sends"] = dev.stats.get("dp_sends", 0) + 1
+                    return tag
+        return 0
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return 0  # host path takes over
+
+
+def _dp_serve(user, tag, ptr_out) -> int:
+    """Materialize the payload bytes for one pull.  The loopback transport
+    rides host TCP, so this is the d2h point; an ICI transport would hand
+    the device array to a collective instead."""
+    try:
+        with _DP_LOCK:
+            arr = _DP_REG.get(tag)
+        if arr is None:
+            return -1
+        buf = np.ascontiguousarray(np.asarray(arr))
+        with _DP_LOCK:
+            _DP_SERVING[tag] = buf  # pin until serve_done
+        ptr_out[0] = buf.ctypes.data
+        return buf.nbytes
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return -1
+
+
+def _dp_serve_done(user, tag) -> None:
+    with _DP_LOCK:
+        _DP_SERVING.pop(tag, None)
+        _DP_REG.pop(tag, None)  # one pull per tag (native dedups per rank)
+
+
+def _dp_deliver(user, ptr, size, tag) -> int:
+    """Payload arrived for a device-plane dep: place it on the local
+    device (raw bytes; consumers reinterpret at stage-in) and return the
+    cache uid stamped on the new host copy."""
+    try:
+        import ctypes as C
+        devs = list(_ALL_DEVICES)
+        if not devs or size <= 0:
+            return 0
+        dev = devs[0]
+        src = (C.c_uint8 * size).from_address(ptr)
+        host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
+        darr = dev._jax.device_put(host, dev.device)
+        with dev._lock:
+            uid = dev._next_uid
+            dev._next_uid += 1
+        # version 0 matches the fresh wire-materialized ptc_copy; raw=True
+        # makes stage-in reinterpret to the consumer's dtype/shape on device
+        dev._cache_put(uid, 0, darr, size, raw=True)
+        dev.stats["dp_recv_bytes"] = dev.stats.get("dp_recv_bytes", 0) + size
+        return uid
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        return 0  # consumer falls back to staging the host bytes
+
+
 def _get_jitted(jax_mod, kernel: Callable) -> Callable:
     j = _JIT_CACHE.get(kernel)
     if j is None:
@@ -125,10 +216,11 @@ def local_tile_index(coll):
 
 
 class _CacheEnt:
-    __slots__ = ("version", "arr", "nbytes", "dirty", "host", "persistent")
+    __slots__ = ("version", "arr", "nbytes", "dirty", "host", "persistent",
+                 "raw")
 
     def __init__(self, version, arr, nbytes, dirty=False, host=None,
-                 persistent=True):
+                 persistent=True, raw=False):
         self.version = version
         self.arr = arr
         self.nbytes = nbytes
@@ -137,6 +229,9 @@ class _CacheEnt:
         # persistent: backed by user Data (host buffer cannot be freed
         # mid-flush); transient arena copies are never host-flushed
         self.persistent = persistent
+        # raw: data-plane arrival as flat uint8; stage-in reinterprets to
+        # the consumer's dtype/shape (device-side bitcast, no h2d)
+        self.raw = raw
 
 
 class TpuDevice:
@@ -183,6 +278,14 @@ class TpuDevice:
             ctx._copy_sync_cb = N.COPY_SYNC_CB_T(
                 lambda user, handle: sync_copy_handle(handle))
             N.lib.ptc_set_copy_sync_cb(ctx._ptr, ctx._copy_sync_cb, None)
+        # device data plane: remote deps with a current device mirror ride
+        # PK_DEVICE rendezvous instead of the host eager/GET paths
+        if getattr(ctx, "_dp_cbs", None) is None:
+            ctx._dp_cbs = (N.DP_REGISTER_CB_T(_dp_register),
+                           N.DP_SERVE_CB_T(_dp_serve),
+                           N.DP_SERVE_DONE_CB_T(_dp_serve_done),
+                           N.DP_DELIVER_CB_T(_dp_deliver))
+            N.lib.ptc_set_dataplane(ctx._ptr, *ctx._dp_cbs, None)
         ctx._devices.append(self)  # stopped before the native ctx dies
         _ALL_DEVICES.append(self)
         self.start()
@@ -205,13 +308,13 @@ class TpuDevice:
                 self.stats["dead_drops"] += 1
 
     def _cache_put(self, uid, version, arr, nbytes, dirty=False, host=None,
-                   persistent=True):
+                   persistent=True, raw=False):
         with self._lock:
             old = self._cache.pop(uid, None)
             if old is not None:
                 self._cache_used -= old.nbytes
             self._cache[uid] = _CacheEnt(version, arr, nbytes, dirty, host,
-                                         persistent)
+                                         persistent, raw)
             self._cache_used += nbytes
             evict = []
             if self._cache_used > self._cache_bytes:
@@ -233,6 +336,35 @@ class TpuDevice:
                 self._cache.move_to_end(uid)
                 return ent.arr
         return None
+
+    def _cache_get_typed(self, uid, version, dtype, shape):
+        """Cache lookup that reinterprets raw data-plane arrivals (flat
+        uint8) to the consumer's dtype/shape — a device-side bitcast, so
+        a pulled payload is consumed with no h2d at all."""
+        with self._lock:
+            ent = self._cache.get(uid)
+            if ent is None or ent.version != version:
+                return None
+            self._cache.move_to_end(uid)
+            arr, raw = ent.arr, ent.raw
+        if not raw:
+            return arr
+        conv = self._reinterpret(arr, dtype, shape)
+        with self._lock:
+            ent2 = self._cache.get(uid)
+            if ent2 is not None and ent2.version == version and ent2.raw:
+                ent2.arr = conv  # memoize the typed view
+                ent2.raw = False
+        return conv
+
+    def _reinterpret(self, arr_u8, dtype, shape):
+        import jax
+        dt = np.dtype(dtype)
+        out = arr_u8
+        if dt.itemsize > 1:
+            out = jax.lax.bitcast_convert_type(
+                arr_u8.reshape(-1, dt.itemsize), dt)
+        return out.reshape(shape) if shape is not None else out
 
     def sync_handle(self, uid: int) -> None:
         """Coherence pull for ONE copy: if its device mirror is dirty,
@@ -381,7 +513,8 @@ class TpuDevice:
         cptr = N.lib.ptc_task_copy(view._ptr, fi)
         uid = self._copy_uid(cptr)
         ver = N.lib.ptc_copy_version(cptr)
-        arr = self._cache_get(uid, ver)
+        arr = self._cache_get_typed(uid, ver, body.dtypes[flow],
+                                    body.shapes.get(flow))
         if arr is not None:
             self.stats["h2d_hits"] += 1
             return arr

@@ -151,6 +151,13 @@ register("comm.base_port", 29650, int, "TCP rendezvous base port")
 register("comm.bcast_topo", "star", str,
          "activation broadcast topology: star|chain|binomial "
          "(reference: runtime_comm_coll_bcast)")
+register("comm.engine", "tcp", str,
+         "comm-engine transport module (reference: the parsec_comm_engine "
+         "vtable seam, parsec_comm_engine.h:14-21)")
+register("comm.eager_limit", 64 * 1024, int,
+         "payloads <= this ride inline in ACTIVATE; larger ones are pulled "
+         "via GET rendezvous (reference: runtime_comm_short_limit, "
+         "remote_dep_mpi.c:241-253); negative disables rendezvous")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
 register("device.tpu_enabled", True, bool,

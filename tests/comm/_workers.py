@@ -157,6 +157,167 @@ def dtd_chain(rank: int, nodes: int, port: int, nb_tiles: int = 4,
         ctx.comm_fini()
 
 
+def ptg_chain_rendezvous(rank: int, nodes: int, port: int, nb: int = 12,
+                         elems: int = 4096):
+    """RW chain with payloads far above the eager limit: every hop rides
+    the GET rendezvous (ACTIVATE advertises a handle, the consumer pulls,
+    PUT_DATA answers — reference: remote_dep.h:59-65).  After the fence,
+    no snapshot bytes or pending pulls may remain (bounded comm memory)."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "1024"
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        esize = elems * 8
+        arr = np.zeros((nodes, elems), dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=esize,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", esize)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+                pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                arena="t")
+
+        def body(view):
+            d = view.data("A", dtype=np.int64)
+            d[0] += 1
+            d[-1] = d[0]  # tail must survive every rendezvous hop intact
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        if rank == 0:
+            assert arr[0, 0] == nb + 1, arr[0, 0]
+            assert arr[0, -1] == nb + 1, arr[0, -1]
+        rdv = ctx.comm_rdv_stats()
+        # every inter-rank hop pulled (nodes>1 => most hops are remote)
+        assert rdv["gets_sent"] > 0 or rdv["gets_served"] > 0, rdv
+        assert rdv["registered_bytes"] == 0, rdv
+        assert rdv["pending_pulls"] == 0, rdv
+        ctx.comm_fini()
+
+
+def ptg_bcast_rendezvous_dedup(rank: int, nodes: int, port: int,
+                               elems: int = 2048):
+    """Star fan-out of ONE big payload to every rank: the source must keep
+    a single registered snapshot (per-rank payload dedup), served once per
+    peer rank, and drop it after the last pull."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "1024"
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        esize = elems * 8
+        arr = np.zeros((nodes, elems), dtype=np.int64)
+        ctx.register_linear_collection("V", arr, elem_size=esize,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", esize)
+        tp = pt.Taskpool(ctx, globals={"NR": nodes - 1})
+        k = pt.L("k")
+        root = tp.task_class("Root")
+        root.affinity("V", 0)
+        recv = tp.task_class("Recv")
+        recv.param("k", 0, pt.G("NR"))
+        recv.affinity("V", k)
+
+        def root_body(view):
+            d = view.data("X", dtype=np.int64)
+            d[0] = 7
+            d[-1] = 7
+
+        root.flow("X", "W",
+                  pt.Out(pt.Ref("Recv", pt.Range(0, pt.G("NR")), flow="X")),
+                  arena="t")
+        root.body(root_body)
+
+        def recv_body(view):
+            d = view.data("X", dtype=np.int64)
+            assert d[0] == 7 and d[-1] == 7, (d[0], d[-1])
+            view.data("Y", dtype=np.int64)[0] = 7
+
+        recv.flow("X", "R", pt.In(pt.Ref("Root", flow="X")), arena="t")
+        recv.flow("Y", "W", pt.Out(pt.Mem("V", k)), arena="t")
+        recv.body(recv_body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        if rank == 0:
+            rdv = ctx.comm_rdv_stats()
+            # one snapshot served once per remote rank, then dropped
+            assert rdv["gets_served"] == nodes - 1, rdv
+            assert rdv["registered_bytes"] == 0, rdv
+        assert arr[rank, 0] == 7, arr[rank, 0]
+        ctx.comm_fini()
+
+
+def device_dataplane(rank: int, nodes: int, port: int, elems: int = 1024):
+    """TPU-produced tile consumed by a device chore on another rank via the
+    PK_DEVICE data plane: the producing host copy is never written (no
+    d2h on rank 0) and the consumer stages nothing (no h2d on rank 1) —
+    the payload moves mirror-to-mirror through the comm engine's
+    rendezvous (on a pod: ICI)."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # loopback test: no tunnel
+    os.environ["PTC_MCA_comm_eager_limit"] = "1024"
+    pt, ctx = _mk_ctx(rank, nodes, port, nb_workers=1)
+    from parsec_tpu.device import TpuDevice
+
+    with ctx:
+        esize = elems * 4
+        arr = np.zeros((nodes, elems), dtype=np.float32)
+        if rank == 0:
+            arr[0, :] = 2.0
+        ctx.register_linear_collection("A", arr, elem_size=esize,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", esize)
+        dev = TpuDevice(ctx)
+        tp = pt.Taskpool(ctx)
+        k = pt.L("k")
+        prod = tp.task_class("Prod")
+        prod.param("k", 0, 0)
+        prod.affinity("A", 0)
+        cons = tp.task_class("Cons")
+        cons.param("k", 0, 0)
+        cons.affinity("A", 1)
+        prod.flow("X", "RW", pt.In(pt.Mem("A", 0)),
+                  pt.Out(pt.Ref("Cons", k, flow="X")))
+        cons.flow("X", "R", pt.In(pt.Ref("Prod", k, flow="X")), arena="t")
+        cons.flow("Y", "W", pt.Out(pt.Mem("A", 1)), arena="t")
+        dev.attach(prod, tp, kernel=lambda x: x * 3.0, reads=["X"],
+                   writes=["X"], shapes={"X": (elems,)}, dtype=np.float32)
+        dev.attach(cons, tp, kernel=lambda x: x + 1.0, reads=["X"],
+                   writes=["Y"], shapes={"X": (elems,), "Y": (elems,)},
+                   dtype=np.float32)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        if rank == 0:
+            assert dev.stats.get("dp_sends", 0) >= 1, dev.stats
+            # payload was served from the device mirror: the producing
+            # host copy was never written back
+            assert dev.stats["d2h_bytes"] == 0, dev.stats
+            assert arr[0, 0] == 2.0, arr[0, 0]  # host tile untouched
+        if rank == 1:
+            assert dev.stats.get("dp_recv_bytes", 0) == esize, dev.stats
+            # consumer read the delivered mirror straight from the cache
+            assert dev.stats["h2d_bytes"] == 0, dev.stats
+        dev.stop()
+        if rank == 1:
+            np.testing.assert_allclose(arr[1], 7.0)  # 2*3 + 1
+        ctx.comm_fini()
+
+
 def ptg_block_cyclic_scale(rank: int, nodes: int, port: int, mt: int = 4,
                            nt: int = 4):
     """Owner-computes over a 2D block-cyclic collection: Scale(m,n) doubles

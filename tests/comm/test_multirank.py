@@ -89,6 +89,28 @@ def test_dtd_chain_2ranks():
     _run_spmd(_workers.dtd_chain, 2, nb_tiles=4, rounds=6)
 
 
+def test_ptg_chain_rendezvous_2ranks():
+    """Payloads above the eager limit ride the GET/PUT_DATA rendezvous;
+    comm memory must be fully drained after the fence."""
+    _run_spmd(_workers.ptg_chain_rendezvous, 2, nb=12)
+
+
+def test_ptg_chain_rendezvous_3ranks():
+    _run_spmd(_workers.ptg_chain_rendezvous, 3, nb=12)
+
+
+def test_ptg_bcast_rendezvous_dedup_3ranks():
+    """One big payload fanned out to every rank: a single registered
+    snapshot serves all pulls (per-rank payload dedup)."""
+    _run_spmd(_workers.ptg_bcast_rendezvous_dedup, 3)
+
+
+def test_device_dataplane_2ranks():
+    """Device-resident tile crosses ranks without touching the producing
+    host copy and without a consumer-side restage (PK_DEVICE plane)."""
+    _run_spmd(_workers.device_dataplane, 2, timeout=180.0)
+
+
 @pytest.mark.parametrize("nodes", [2, 4])
 def test_ptg_block_cyclic_scale(nodes):
     _run_spmd(_workers.ptg_block_cyclic_scale, nodes)
