@@ -56,42 +56,58 @@ def bench_dispatch_chain(nb_tasks: int = 20000, reps: int = 5):
     return min(p50s)
 
 
-def _potrf_once(N, nb, seed=0, check=False):
+def _potrf_once(N, nb, seed=0, check=False, profile=False):
     """One spotrf run with device-resident data; returns (seconds, resid)."""
-    import jax
+    import os
     from parsec_tpu.algos import build_potrf
     from parsec_tpu.data import TwoDimBlockCyclic
     from parsec_tpu.device import TpuDevice
-    from parsec_tpu.device.bench_utils import (gather_device_tiles,
-                                               generate_spd_on_device,
-                                               potrf_residual)
-    with pt.Context(nb_workers=1) as ctx:
+    from parsec_tpu.device.bench_utils import (generate_spd_on_device,
+                                               potrf_residual,
+                                               wait_device_tiles)
+    workers = int(os.environ.get("PTC_BENCH_WORKERS", "4"))
+    cache_gb = int(os.environ.get("PTC_BENCH_CACHE_GB", "64"))
+    with pt.Context(nb_workers=workers) as ctx:
         A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
         A.register(ctx, "A")
-        dev = TpuDevice(ctx)
+        dev = TpuDevice(ctx, cache_bytes=cache_gb << 30)
+        t_g0 = time.perf_counter()
         a_stacked = generate_spd_on_device(dev, A, seed=seed)
         a_stacked.block_until_ready()
+        t_g1 = time.perf_counter()
         tp = build_potrf(ctx, A, dev=dev)
         t0 = time.perf_counter()
         tp.run()
         tp.wait()
-        # the factorization is done when the last tile's value materializes
-        out = gather_device_tiles(dev, A)
-        out.block_until_ready()
+        t_w = time.perf_counter()
+        # all tasks enqueued; done when every tile's device value lands
+        wait_device_tiles(dev, A)
         dt = time.perf_counter() - t0
+        if profile:
+            s = dev.stats
+            sys.stderr.write(
+                f"[profile] N={N} nb={nb} gen={t_g1 - t_g0:.2f}s "
+                f"enqueue={t_w - t0:.2f}s total={dt:.2f}s "
+                f"tasks={s['tasks']} batches={s.get('batches', 0)} "
+                f"batched={s.get('batched_tasks', 0)} "
+                f"h2d={s['h2d_bytes']} d2h={s['d2h_bytes']}\n")
         resid = potrf_residual(dev, A, a_stacked) if check else 0.0
         dev.stop()
         return dt, resid
 
 
 def bench_spotrf(N=16384, nb=1024, reps=2):
+    import os
     from parsec_tpu.algos import potrf_flops
-    # warmup: compiles the 4 kernels at (nb, nb) + generator + small graph
-    _potrf_once(4 * nb, nb, seed=1)
+    profile = bool(os.environ.get("PTC_BENCH_PROFILE"))
+    # warmup: compiles the 4 kernels at (nb, nb) + generator + small graph;
+    # 16*nb gives nt=16 so the batched buckets up to 16 pre-compile too
+    _potrf_once(16 * nb, nb, seed=1)
     best = None
     resid = None
     for rep in range(reps):
-        dt, r = _potrf_once(N, nb, seed=0, check=(rep == 0))
+        dt, r = _potrf_once(N, nb, seed=0, check=(rep == 0),
+                            profile=profile)
         if rep == 0:
             resid = r
         if best is None or dt < best:

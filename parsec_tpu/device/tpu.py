@@ -30,6 +30,7 @@ Manual flush() remains for bulk host reads (to_dense etc.).
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -45,7 +46,7 @@ class _DeviceBody:
     def __init__(self, kernel: Callable, reads: Sequence,
                  writes: Sequence, shapes: Dict, dtypes: Dict,
                  tc: Optional[TaskClass], tp: Optional[Taskpool],
-                 nb_flows: int = 0):
+                 nb_flows: int = 0, batch: bool = False):
         self.kernel = kernel
         self.reads = list(reads)
         self.writes = list(writes)
@@ -54,6 +55,7 @@ class _DeviceBody:
         self.tc = tc
         self.tp = tp
         self.nb_flows = nb_flows
+        self.batch = batch  # kernel is elementwise over tiles: vmap-able
         # flows whose output deps include a memory writeback: their host
         # copy must be coherent at completion (release_deps may memcpy it)
         self.mem_out_flows = set()
@@ -79,6 +81,11 @@ class _DeviceBody:
 # the wrapper global makes every (kernel, shape, dtype) compile exactly once
 # per process (plus the on-disk jax compilation cache across processes).
 _JIT_CACHE: Dict[object, Callable] = {}
+
+# batched variants: kernel fn -> jit(vmap(kernel)).  One executable per
+# (kernel, bucket size, tile shape/dtype); bucket padding (powers of two)
+# keeps the number of compiles logarithmic in the max batch.
+_VMAP_CACHE: Dict[object, Callable] = {}
 
 # live devices, for copy-handle coherence sync (handles are stamped only by
 # devices, so a zero handle short-circuits before ever reaching this)
@@ -131,7 +138,7 @@ def _dp_register(user, copy_handle, version, size) -> int:
                     with _DP_LOCK:
                         tag = _DP_STATE["next_tag"]
                         _DP_STATE["next_tag"] += 1
-                        _DP_REG[tag] = ent.arr
+                        _DP_REG[tag] = _conc(ent)
                     dev.stats["dp_sends"] = dev.stats.get("dp_sends", 0) + 1
                     return tag
         return 0
@@ -202,6 +209,40 @@ def _get_jitted(jax_mod, kernel: Callable) -> Callable:
     return j
 
 
+def _get_vmapped(jax_mod, kernel: Callable) -> Callable:
+    j = _VMAP_CACHE.get(kernel)
+    if j is None:
+        j = jax_mod.jit(jax_mod.vmap(kernel))
+        _VMAP_CACHE[kernel] = j
+    return j
+
+
+def _bucket(n: int) -> int:
+    """Round a batch size up to a power of two: stacked shapes then come
+    from a log-bounded set, so XLA compiles each batched kernel O(log B)
+    times instead of once per distinct wave width."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class _StackRef:
+    """Lazy slice of a stacked batch result.  Batched dispatch produces ONE
+    device array for a whole task group; per-task cache entries reference
+    (stack, index) so the common consumer — the next batched group — can
+    gather straight from the stack with a single device op, and nothing is
+    sliced out unless a host sync or an unbatched consumer asks for it."""
+    __slots__ = ("stack", "idx")
+
+    def __init__(self, stack, idx: int):
+        self.stack = stack
+        self.idx = idx
+
+    def materialize(self):
+        return self.stack[self.idx]
+
+
 def local_tile_index(coll):
     """Row-major (m, n) list of this rank's stored local tiles."""
     out = []
@@ -215,15 +256,29 @@ def local_tile_index(coll):
     return out
 
 
+def _conc(ent: "_CacheEnt"):
+    """Concrete device array for a cache entry, slicing a _StackRef out of
+    its batch stack on first use (memoized; benign if raced)."""
+    a = ent.arr
+    if isinstance(a, _StackRef):
+        a = a.materialize()
+        ent.arr = a
+    return a
+
+
 class _CacheEnt:
     __slots__ = ("version", "arr", "nbytes", "dirty", "host", "persistent",
-                 "raw")
+                 "raw", "stack")
 
     def __init__(self, version, arr, nbytes, dirty=False, host=None,
                  persistent=True, raw=False):
         self.version = version
         self.arr = arr
         self.nbytes = nbytes
+        # batch-stack pin: entries born as _StackRef keep the whole stack
+        # alive (and accounted) until the entry itself dies — HBM
+        # accounting charges the stack once, per stack, not per slice
+        self.stack = arr.stack if isinstance(arr, _StackRef) else None
         self.dirty = dirty  # device newer than host; host view kept to flush
         self.host = host
         # persistent: backed by user Data (host buffer cannot be freed
@@ -254,6 +309,8 @@ class TpuDevice:
         self.device = jax_device or jax.devices()[0]
         self.qid = ctx.device_queue_new()
         self.pipeline_depth = pipeline_depth
+        # max tasks fused into one vmapped dispatch (power-of-two padded)
+        self.batch_max = int(os.environ.get("PTC_DEVICE_BATCH", "128"))
         self.bodies: Dict[Tuple[int, int], _DeviceBody] = {}
         self._dtd_bodies: Dict[int, _DeviceBody] = {}
         self._tp_by_ptr: Dict[int, Taskpool] = {}
@@ -262,6 +319,8 @@ class TpuDevice:
         self._cache: "OrderedDict[int, _CacheEnt]" = OrderedDict()
         self._cache_bytes = cache_bytes
         self._cache_used = 0
+        # id(stack) -> [refcount, stack]; the strong ref keeps id() stable
+        self._stacks: Dict[int, list] = {}
         self._next_uid = 1
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -300,11 +359,38 @@ class TpuDevice:
                 N.lib.ptc_copy_set_handle(cptr, h)
             return h
 
+    def _charge(self, ent: _CacheEnt):
+        """Account an entry's device bytes.  Slices of a batch stack charge
+        the WHOLE stack exactly once (per-stack refcount): evicting one
+        slice of a live stack frees nothing, and the accounting must say
+        so or the LRU believes it is under budget while HBM is not."""
+        if ent.stack is not None:
+            rec = self._stacks.get(id(ent.stack))
+            if rec is None:
+                self._stacks[id(ent.stack)] = [1, ent.stack]
+                self._cache_used += ent.stack.nbytes
+            else:
+                rec[0] += 1
+        else:
+            self._cache_used += ent.nbytes
+
+    def _uncharge(self, ent: _CacheEnt):
+        if ent.stack is not None:
+            key = id(ent.stack)
+            rec = self._stacks.get(key)
+            if rec is not None:
+                rec[0] -= 1
+                if rec[0] == 0:
+                    del self._stacks[key]
+                    self._cache_used -= ent.stack.nbytes
+        else:
+            self._cache_used -= ent.nbytes
+
     def _on_copy_released(self, user, handle):
         with self._lock:
             ent = self._cache.pop(handle, None)
             if ent is not None:
-                self._cache_used -= ent.nbytes
+                self._uncharge(ent)
                 self.stats["dead_drops"] += 1
 
     def _cache_put(self, uid, version, arr, nbytes, dirty=False, host=None,
@@ -312,20 +398,21 @@ class TpuDevice:
         with self._lock:
             old = self._cache.pop(uid, None)
             if old is not None:
-                self._cache_used -= old.nbytes
-            self._cache[uid] = _CacheEnt(version, arr, nbytes, dirty, host,
-                                         persistent, raw)
-            self._cache_used += nbytes
+                self._uncharge(old)
+            ent = _CacheEnt(version, arr, nbytes, dirty, host,
+                            persistent, raw)
+            self._cache[uid] = ent
+            self._charge(ent)
             evict = []
             if self._cache_used > self._cache_bytes:
-                for k, ent in self._cache.items():
+                for k, e in self._cache.items():
                     if self._cache_used <= self._cache_bytes:
                         break
-                    if ent.dirty or k == uid:
+                    if e.dirty or k == uid:
                         continue  # dirty entries are pinned until flushed
-                    evict.append(k)
-                    self._cache_used -= ent.nbytes
-                for k in evict:
+                    evict.append((k, e))
+                    self._uncharge(e)
+                for k, e in evict:
                     del self._cache[k]
                     self.stats["evictions"] += 1
 
@@ -334,7 +421,17 @@ class TpuDevice:
             ent = self._cache.get(uid)
             if ent is not None and ent.version == version:
                 self._cache.move_to_end(uid)
-                return ent.arr
+                return _conc(ent)
+        return None
+
+    def _cache_ent(self, uid, version) -> Optional["_CacheEnt"]:
+        """Entry lookup without materializing _StackRefs (batched stage-in
+        gathers straight from the underlying stacks)."""
+        with self._lock:
+            ent = self._cache.get(uid)
+            if ent is not None and ent.version == version:
+                self._cache.move_to_end(uid)
+                return ent
         return None
 
     def _cache_get_typed(self, uid, version, dtype, shape):
@@ -346,7 +443,7 @@ class TpuDevice:
             if ent is None or ent.version != version:
                 return None
             self._cache.move_to_end(uid)
-            arr, raw = ent.arr, ent.raw
+            arr, raw = _conc(ent), ent.raw
         if not raw:
             return arr
         conv = self._reinterpret(arr, dtype, shape)
@@ -377,7 +474,7 @@ class TpuDevice:
             ent = self._cache.get(uid)
             if ent is None or not ent.dirty:
                 return
-        res = np.asarray(ent.arr)  # blocks until the XLA result is ready
+        res = np.asarray(_conc(ent))  # blocks until the XLA result is ready
         ent.host[...] = res.reshape(ent.host.shape)
         self.stats["d2h_bytes"] += res.nbytes
         with self._lock:
@@ -398,7 +495,7 @@ class TpuDevice:
         for uid, ent in dirty:
             by_shape.setdefault(tuple(ent.host.shape), []).append(ent)
         for shape, ents in by_shape.items():
-            stacked = np.asarray(jnp.stack([e.arr for e in ents]))
+            stacked = np.asarray(jnp.stack([_conc(e) for e in ents]))
             for e, res in zip(ents, stacked):
                 e.host[...] = res.reshape(e.host.shape)
                 self.stats["d2h_bytes"] += res.nbytes
@@ -410,18 +507,26 @@ class TpuDevice:
                reads: Sequence[str], writes: Sequence[str],
                shapes: Dict[str, tuple], dtype=np.float32,
                dtypes: Optional[Dict[str, np.dtype]] = None,
-               sync_mem_out: bool = False):
+               sync_mem_out: bool = False, batch: bool = True):
         """Attach a TPU chore: kernel(*read_arrays) -> write_array(s).
 
         sync_mem_out=True forces a blocking d2h before task completion for
         flows with memory-output deps — required only when the DAG writes a
         flow into a *different* collection tile (cross-collection memcpy at
         release); same-tile pass-through writebacks are no-ops natively and
-        are satisfied lazily by flush()."""
+        are satisfied lazily by flush().
+
+        batch=True (default) lets the manager fuse a group of ready tasks
+        of this class into ONE vmapped executable call — the TPU answer to
+        µs-grained MIMD dispatch (SURVEY §7 hard-part 1: batch same-class
+        ready tasks).  Requires the kernel to be elementwise over tiles
+        (true for map-style bodies and all dense-LA update kernels); set
+        False for kernels with cross-tile semantics."""
         if dtypes is None:
             dtypes = {f: np.dtype(dtype) for f in set(reads) | set(writes)}
         tc.body_device(self.qid, device="tpu")
-        body = _DeviceBody(kernel, reads, writes, shapes, dtypes, tc, tp)
+        body = _DeviceBody(kernel, reads, writes, shapes, dtypes, tc, tp,
+                           batch=batch)
         if not sync_mem_out:
             body.mem_out_flows = set()
         self.bodies[(id(tp), tc.id)] = body
@@ -475,11 +580,45 @@ class TpuDevice:
         """Dispatch loop.  XLA queues kernels asynchronously, so completing
         a task here only means 'enqueued after its inputs' — device-side
         consumers chain correctly, and host coherence points (mem-out
-        flows / flush) block on the actual results."""
+        flows / flush) block on the actual results.
+
+        The loop drains every ready task before dispatching, then fuses
+        same-class groups into one vmapped call each — per-wave dispatch
+        cost is O(classes), not O(tasks)."""
         while not self._stop.is_set():
             task = self.ctx.device_pop(self.qid, timeout_ms=50)
-            if task:
+            if not task:
+                continue
+            batch = [task]
+            while len(batch) < self.batch_max:
+                t2 = self.ctx.device_pop(self.qid, timeout_ms=0)
+                if not t2:
+                    break
+                batch.append(t2)
+            if len(batch) == 1:
                 self._dispatch(task)
+                continue
+            # group by body, preserving pop order within each group
+            groups: List[Tuple[Optional[_DeviceBody], List]] = []
+            index: Dict[int, int] = {}
+            for t in batch:
+                body = self._body_for(t)
+                key = id(body)
+                gi = index.get(key)
+                if gi is None or body is None or not body.batch:
+                    index[key] = len(groups)
+                    groups.append((body, [t]))
+                else:
+                    groups[gi][1].append(t)
+            for body, ts in groups:
+                if body is None:
+                    for t in ts:
+                        self.ctx.task_complete(t)
+                elif len(ts) == 1 or not body.batch:
+                    for t in ts:
+                        self._dispatch_one(body, t)
+                else:
+                    self._dispatch_group(body, ts)
 
     def register_dtd_task(self, task_ptr, kernel, reads, writes, shapes,
                           dtype, nb_flows):
@@ -530,6 +669,94 @@ class TpuDevice:
         if body is None:
             self.ctx.task_complete(task)
             return
+        self._dispatch_one(body, task)
+
+    def _flow_uid_ver(self, view, body, flow):
+        fi = body.flow_index(flow)
+        cptr = N.lib.ptc_task_copy(view._ptr, fi)
+        return cptr, self._copy_uid(cptr), N.lib.ptc_copy_version(cptr)
+
+    def _gather_flow(self, views, body, flow, bucket):
+        """Stage one read flow for a whole group as a stacked device array
+        (padded to `bucket` rows).  If every per-task entry is a lazy slice
+        of one producer stack, gather straight from it with a single take;
+        otherwise stack the per-task arrays."""
+        jnp = self._jax.numpy
+        ents = []
+        for view in views:
+            cptr, uid, ver = self._flow_uid_ver(view, body, flow)
+            ent = self._cache_ent(uid, ver)
+            if ent is None or ent.raw:
+                # host stage-in / raw reinterpret: same path as unbatched
+                ents.append(self._stage_in(view, body, flow))
+            else:
+                self.stats["h2d_hits"] += 1
+                ents.append(ent.arr)  # may be a _StackRef: resolved below
+        stacks = {id(e.stack) for e in ents if isinstance(e, _StackRef)}
+        if len(stacks) == 1 and all(isinstance(e, _StackRef) for e in ents):
+            stack = ents[0].stack
+            idxs = [e.idx for e in ents]
+            idxs += [idxs[0]] * (bucket - len(idxs))
+            return jnp.take(stack, jnp.asarray(idxs, dtype=jnp.int32),
+                            axis=0)
+        mats = [e.materialize() if isinstance(e, _StackRef) else e
+                for e in ents]
+        mats += [mats[0]] * (bucket - len(mats))
+        return jnp.stack(mats)
+
+    def _dispatch_group(self, body: _DeviceBody, tasks: List):
+        """One vmapped executable call for a group of ready tasks of the
+        same class.  Inputs are gathered per flow into (bucket, *tile)
+        stacks; outputs stay stacked, with per-task cache entries holding
+        lazy slices — the next batched consumer gathers from them without
+        any intermediate slicing."""
+        views = [body.make_view(t) for t in tasks]
+        bucket = _bucket(len(tasks))
+        try:
+            ins = [self._gather_flow(views, body, f, bucket)
+                   for f in body.reads]
+            out = _get_vmapped(self._jax, body.kernel)(*ins)
+            outs = out if isinstance(out, tuple) else (out,)
+            for f, ostack in zip(body.writes, outs):
+                sync_host = f in body.mem_out_flows
+                res = np.asarray(ostack) if sync_host else None
+                for i, view in enumerate(views):
+                    cptr, uid, ver = self._flow_uid_ver(view, body, f)
+                    host = view.data(f, dtype=body.dtypes[f],
+                                     shape=body.shapes.get(f), sync=False)
+                    persistent = bool(N.lib.ptc_copy_is_persistent(cptr))
+                    if sync_host:
+                        host[...] = res[i].reshape(host.shape)
+                        self.stats["d2h_bytes"] += res[i].nbytes
+                        self._cache_put(uid, ver + 1, _StackRef(ostack, i),
+                                        host.nbytes, persistent=persistent)
+                    else:
+                        self._cache_put(uid, ver + 1, _StackRef(ostack, i),
+                                        host.nbytes, dirty=True, host=host,
+                                        persistent=persistent)
+            self.stats["tasks"] += len(tasks)
+            self.stats["batches"] = self.stats.get("batches", 0) + 1
+            self.stats["batched_tasks"] = \
+                self.stats.get("batched_tasks", 0) + len(tasks)
+        except Exception:
+            # a vmap-incompatible kernel (no batching rule, shape-dependent
+            # callback, ...) must not abort the pool: fall back to strict
+            # per-task dispatch, where genuine kernel errors still fail the
+            # task through the unbatched error path
+            import traceback
+            traceback.print_exc()
+            import sys as _sys
+            _sys.stderr.write("ptc: batched dispatch failed for "
+                              f"{getattr(body.tc, 'name', '?')}; "
+                              "falling back to per-task dispatch\n")
+            body.batch = False
+            for t in tasks:
+                self._dispatch_one(body, t)
+            return
+        for t in tasks:
+            self.ctx.task_complete(t)
+
+    def _dispatch_one(self, body, task):
         view = body.make_view(task)
         try:
             jitted = _get_jitted(self._jax, body.kernel)

@@ -574,7 +574,8 @@ class JdfTaskpoolBuilder:
 
                 self.dev.attach(tc, self.tp, kernel=kernel, reads=reads,
                                 writes=writes, shapes=self.shapes,
-                                dtype=self.dtype)
+                                dtype=self.dtype,
+                                batch=body.props.get("batch", "1") != "0")
             elif btype == "TPU":
                 continue  # no device available: skip this incarnation
             else:
