@@ -266,17 +266,34 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             int32_t flow_idx,
                             std::vector<WireTarget> &&targets,
                             const uint8_t *payload, uint64_t plen,
-                            int64_t device_uid = 0) {
+                            int64_t device_uid = 0,
+                            uint64_t alloc_len = 0) {
+  if (alloc_len == 0) alloc_len = plen;
   ptc_copy *copy = nullptr;
-  if (plen > 0) {
+  if (alloc_len > 0) {
     copy = new ptc_copy();
-    copy->ptr = std::malloc((size_t)plen);
-    copy->size = (int64_t)plen;
+    copy->ptr = std::malloc((size_t)alloc_len);
+    copy->size = (int64_t)alloc_len;
     copy->owns_ptr = true;
-    std::memcpy(copy->ptr, payload, (size_t)plen);
+    if (plen == alloc_len) {
+      std::memcpy(copy->ptr, payload, (size_t)plen);
+    } else if (device_uid == 0) {
+      /* by-reference payload that the device layer could not place: the
+       * copy would be garbage — flag loudly (contract: colocated peers
+       * run a device) */
+      std::fprintf(stderr, "ptc-comm: by-ref payload (%llu bytes) had no "
+                           "device to land on; data undefined\n",
+                   (unsigned long long)alloc_len);
+      std::memset(copy->ptr, 0, (size_t)alloc_len);
+    }
     /* data plane delivered this payload into the device cache too: stamp
      * its uid so a device-chore consumer hits the cache (no re-stage) */
     copy->handle = device_uid;
+    /* let the device layer bind the host buffer of its mirror: a by-ref
+     * delivery materializes on host lazily (coherence pull), a byte
+     * delivery gets a writeback target for later device writes */
+    if (device_uid != 0 && ctx->dp_bound)
+      ctx->dp_bound(ctx->dp_user, device_uid, copy->ptr, copy->size);
   }
   for (WireTarget &t : targets) {
     ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
@@ -298,7 +315,8 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
 static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
                             const uint8_t *targets_bytes, size_t targets_len,
                             const uint8_t *payload, uint64_t plen,
-                            int64_t device_uid, bool allow_park) {
+                            int64_t device_uid, bool allow_park,
+                            uint64_t alloc_len = 0) {
   ptc_taskpool *tp = find_tp(ctx, tp_id);
   if (!tp) {
     /* Re-check the registry under the lock: add_taskpool may have
@@ -310,12 +328,21 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
       tp = it->second;
       g.unlock();
     } else if (allow_park) {
+      if (alloc_len && alloc_len != plen) {
+        /* by-ref payload for an unknown pool: cannot be parked as bytes
+         * (rendezvous ACTIVATEs park before the GET, so this is a
+         * teardown race, not startup skew) */
+        std::fprintf(stderr, "ptc-comm: by-ref payload for unknown "
+                             "taskpool %d dropped\n", tp_id);
+        return;
+      }
       /* park a self-contained eager-form ACTIVATE body (replayed by
        * ptc_comm_drain_early; device_uid is dropped — replay stages the
        * host bytes, the device re-stages on first use) */
       std::vector<uint8_t> parked;
       parked.push_back(MSG_ACTIVATE);
       Writer w{parked};
+      w.u32(UINT32_MAX); /* parked `from`: eager-form needs no pull */
       w.i32(tp_id);
       w.i32(flow_idx);
       w.raw(targets_bytes, targets_len);
@@ -340,12 +367,12 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
     return;
   }
   deliver_targets(ctx, tp, flow_idx, std::move(targets), payload, plen,
-                  device_uid);
+                  device_uid, alloc_len);
 }
 
 /* body excludes the type byte.  `from` is the sending rank (rendezvous
- * pulls go back to it); parked replays pass UINT32_MAX — parked bodies
- * are always eager-form, so no pull can target it. */
+ * pulls go back to it); parked rendezvous bodies carry their original
+ * `from` in the parked frame so the replayed GET still targets it. */
 static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
                                  uint32_t from, const uint8_t *body,
                                  size_t len, bool allow_park) {
@@ -388,8 +415,22 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
                            "dropped\n");
       return;
     }
-    /* park the delivery against a cookie, pull the payload.  The pool
-     * may be unknown yet — resolution happens at PUT_DATA time. */
+    if (!find_tp(ctx, tp_id) && allow_park) {
+      /* unknown pool: park the whole rendezvous ACTIVATE (with its
+       * `from`) BEFORE pulling — replay re-sends the GET once the pool
+       * registers, so by-ref payloads never need byte-parking */
+      std::unique_lock<std::mutex> g(ctx->tp_reg_lock);
+      if (ctx->tp_registry.find(tp_id) == ctx->tp_registry.end()) {
+        std::vector<uint8_t> parked;
+        parked.push_back(MSG_ACTIVATE);
+        Writer w{parked};
+        w.u32(from);
+        w.raw(body, len);
+        ctx->tp_early[tp_id].push_back(std::move(parked));
+        return;
+      }
+    }
+    /* park the delivery against a cookie, pull the payload. */
     uint64_t cookie;
     {
       std::lock_guard<std::mutex> g(ce->lock);
@@ -458,9 +499,11 @@ static void handle_dtd_done_body(ptc_context *ctx, const uint8_t *body,
       g.unlock();
     } else {
       std::vector<uint8_t> parked;
-      parked.reserve(len + 1);
+      parked.reserve(len + 5);
       parked.push_back(MSG_DTD_DONE);
-      parked.insert(parked.end(), body, body + len);
+      Writer w{parked};
+      w.u32(UINT32_MAX); /* parked `from` (unused for DTD_DONE) */
+      w.raw(body, len);
       ctx->tp_early[tp_id].push_back(std::move(parked));
       return;
     }
@@ -618,19 +661,24 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     if (rel) ptc_copy_release_internal(ctx, rel);
   }
   if (device_served) {
-    /* device-resident source: the device layer produces the bytes (on a
-     * TPU pod this is where the transfer rides ICI instead) */
+    /* device-resident source: the device layer produces the bytes, or —
+     * for a colocated consumer — a small by-reference token whose payload
+     * rides the device fabric (ICI) instead of this host transport */
     void *ptr = nullptr;
+    int64_t real = 0;
     int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user,
-                                              (int64_t)src_handle, &ptr)
+                                              (int64_t)src_handle,
+                                              (int32_t)from, &ptr, &real)
                               : -1;
     if (n < 0 || !ptr) {
       std::fprintf(stderr, "ptc-comm: data plane could not serve tag "
                            "%llu\n", (unsigned long long)src_handle);
       return;
     }
+    if (real <= 0) real = n;
     w.u8(pk);
-    w.u64((uint64_t)n);
+    w.u64((uint64_t)real); /* true payload size (consumer-side alloc) */
+    w.u64((uint64_t)n);    /* bytes on this wire (== real, or a token) */
     w.raw(ptr, (size_t)n);
     if (ctx->dp_serve_done)
       ctx->dp_serve_done(ctx->dp_user, (int64_t)src_handle);
@@ -647,7 +695,10 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
   Reader r{body, body + len};
   uint64_t cookie = r.u64();
   uint8_t pk = r.u8();
+  uint64_t real_len = 0;
+  if (pk == PK_DEVICE) real_len = r.u64(); /* true payload size */
   uint64_t plen = r.u64();
+  if (pk != PK_DEVICE) real_len = plen;
   if (!r.ok || (size_t)(r.end - r.p) < plen) {
     std::fprintf(stderr, "ptc-comm: malformed PUT_DATA dropped\n");
     return;
@@ -668,9 +719,12 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
   if (pk == PK_DEVICE && ctx->dp_deliver)
     device_uid = ctx->dp_deliver(ctx->dp_user, r.p, (int64_t)plen,
                                  (int64_t)cookie);
+  /* by-reference delivery (real_len != plen): the payload rode the device
+   * fabric; the host copy is allocated at real_len and materialized
+   * lazily from the device mirror via the coherence pull */
   deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
                   pg.targets_bytes.size(), r.p, plen, device_uid,
-                  /*allow_park=*/true);
+                  /*allow_park=*/true, real_len);
 }
 
 static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
@@ -1186,19 +1240,18 @@ void ptc_comm_drain_early(ptc_context *ctx, ptc_taskpool *tp) {
     ctx->tp_early.erase(it);
   }
   for (auto &body : frames) {
-    /* parked bodies are ACTIVATE or DTD_DONE; disambiguate: both start
-     * with i32 tp_id — ACTIVATE parked from handle_activate_body, DTD from
-     * handle_dtd_done_body.  We re-dispatch through the same handlers by
-     * trying ACTIVATE first only if it parses; instead, store the type in
-     * the parked bytes: body[0] is the original type tag (see parkers). */
-    if (body.empty()) continue;
+    /* parked frame: [type byte][u32 from][original body].  `from` is the
+     * sender for parked rendezvous ACTIVATEs (replay re-sends the GET to
+     * it), UINT32_MAX for eager-form and DTD_DONE parks. */
+    if (body.size() < 5) continue;
     uint8_t type = body[0];
+    uint32_t from;
+    std::memcpy(&from, body.data() + 1, 4);
     if (type == MSG_ACTIVATE)
-      /* parked bodies are always eager-form — `from` is never needed */
-      handle_activate_body(ctx->comm, ctx, UINT32_MAX, body.data() + 1,
-                           body.size() - 1, /*allow_park=*/false);
+      handle_activate_body(ctx->comm, ctx, from, body.data() + 5,
+                           body.size() - 5, /*allow_park=*/false);
     else if (type == MSG_DTD_DONE)
-      handle_dtd_done_body(ctx, body.data() + 1, body.size() - 1);
+      handle_dtd_done_body(ctx, body.data() + 5, body.size() - 5);
   }
 }
 

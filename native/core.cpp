@@ -1593,11 +1593,13 @@ void ptc_copy_sync_for_host(ptc_context *ctx, ptc_copy *c) {
 
 void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,
                        ptc_dp_serve_cb serve, ptc_dp_serve_done_cb done,
-                       ptc_dp_deliver_cb deliver, void *user) {
+                       ptc_dp_deliver_cb deliver, ptc_dp_bound_cb bound,
+                       void *user) {
   ctx->dp_register = reg;
   ctx->dp_serve = serve;
   ctx->dp_serve_done = done;
   ctx->dp_deliver = deliver;
+  ctx->dp_bound = bound;
   ctx->dp_user = user;
 }
 

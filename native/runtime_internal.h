@@ -394,6 +394,7 @@ struct ptc_context {
   ptc_dp_serve_cb dp_serve = nullptr;
   ptc_dp_serve_done_cb dp_serve_done = nullptr;
   ptc_dp_deliver_cb dp_deliver = nullptr;
+  ptc_dp_bound_cb dp_bound = nullptr;
   void *dp_user = nullptr;
 
   /* profiling */

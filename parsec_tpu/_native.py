@@ -95,11 +95,13 @@ COPY_RELEASE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 COPY_SYNC_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 DP_REGISTER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64, C.c_int64,
                                C.c_int64)
-DP_SERVE_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64,
-                            C.POINTER(C.c_void_p))
+DP_SERVE_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64, C.c_int32,
+                            C.POINTER(C.c_void_p), C.POINTER(C.c_int64))
 DP_SERVE_DONE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 DP_DELIVER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_void_p, C.c_int64,
                               C.c_int64)
+DP_BOUND_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64, C.c_void_p,
+                            C.c_int64)
 TP_COMPLETE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_void_p)
 
 _sigs = {
@@ -150,7 +152,7 @@ _sigs = {
                                     C.c_void_p]),
     "ptc_set_dataplane": (None, [C.c_void_p, DP_REGISTER_CB_T, DP_SERVE_CB_T,
                                  DP_SERVE_DONE_CB_T, DP_DELIVER_CB_T,
-                                 C.c_void_p]),
+                                 DP_BOUND_CB_T, C.c_void_p]),
     "ptc_task_local": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_task_class": (C.c_int32, [C.c_void_p]),
     "ptc_task_priority": (C.c_int32, [C.c_void_p]),

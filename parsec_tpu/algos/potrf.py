@@ -123,15 +123,20 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
             pt.Out(pt.Ref("GEMM", k + 1, m, n, flow="C"), guard=(n > k + 1)))
 
     # --------------------------------------------------------------- chores
-    if dev is not None:
-        dev.attach(po, tp, kernel=k_potrf, reads=["T"], writes=["T"],
-                   shapes={"T": shp}, dtype=dt)
-        dev.attach(tr, tp, kernel=k_trsm, reads=["L", "C"], writes=["C"],
-                   shapes={"L": shp, "C": shp}, dtype=dt)
-        dev.attach(sy, tp, kernel=k_syrk, reads=["A", "T"], writes=["T"],
-                   shapes={"A": shp, "T": shp}, dtype=dt)
-        dev.attach(ge, tp, kernel=k_gemm, reads=["A", "B", "C"], writes=["C"],
-                   shapes={"A": shp, "B": shp, "C": shp}, dtype=dt)
+    # one or several devices: each attach adds a device chore; the native
+    # best-device routing load-balances task instances across the queues
+    # (reference: parsec_get_best_device, device.c:79-160), and sibling
+    # mirrors stage D2D over the fabric
+    for d in ([dev] if dev is not None and not isinstance(dev, (list, tuple))
+              else (dev or [])):
+        d.attach(po, tp, kernel=k_potrf, reads=["T"], writes=["T"],
+                 shapes={"T": shp}, dtype=dt)
+        d.attach(tr, tp, kernel=k_trsm, reads=["L", "C"], writes=["C"],
+                 shapes={"L": shp, "C": shp}, dtype=dt)
+        d.attach(sy, tp, kernel=k_syrk, reads=["A", "T"], writes=["T"],
+                 shapes={"A": shp, "T": shp}, dtype=dt)
+        d.attach(ge, tp, kernel=k_gemm, reads=["A", "B", "C"], writes=["C"],
+                 shapes={"A": shp, "B": shp, "C": shp}, dtype=dt)
 
     def b_potrf(t):
         a = t.data("T", dt, shp)
@@ -165,8 +170,10 @@ def run_potrf(ctx, A, dev=None):
     tp = build_potrf(ctx, A, dev)
     tp.run()
     tp.wait()
-    if dev is not None:
-        dev.flush()
+    devs = ([dev] if dev is not None and not isinstance(dev, (list, tuple))
+            else (dev or []))
+    for d in devs:
+        d.flush()
 
 
 def potrf_flops(N: int) -> float:

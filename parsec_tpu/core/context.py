@@ -66,6 +66,7 @@ class Context:
         self.collections: Dict[str, int] = {}
         self.arenas: Dict[str, int] = {}
         self._devices: List = []  # TpuDevice instances (stopped on destroy)
+        self._colocated: set = set()  # ranks sharing this accel client
         self._destroyed = False
 
     # ------------------------------------------------------------ lifecycle
@@ -126,6 +127,16 @@ class Context:
         topo = _mca.get("comm.bcast_topo")
         if topo != "star":
             self.comm_set_topology(topo)
+
+    def comm_set_colocated(self, ranks):
+        """Declare peer ranks whose devices share this process's
+        accelerator client (single-controller pod slice; in tests,
+        multiple contexts over one jax CPU mesh).  PK_DEVICE payloads
+        to/from them are handed off by reference and ride the device
+        fabric (ICI) instead of the host transport — the colocated peers
+        MUST run a TpuDevice.  Reference seam: comm-engine put/get on
+        registered memory, parsec_comm_engine.h:139-160."""
+        self._colocated = {int(r) for r in ranks}
 
     def comm_set_topology(self, topo):
         """Activation-broadcast propagation topology: "star" (direct

@@ -88,8 +88,20 @@ _JIT_CACHE: Dict[object, Callable] = {}
 _VMAP_CACHE: Dict[object, Callable] = {}
 
 # live devices, for copy-handle coherence sync (handles are stamped only by
-# devices, so a zero handle short-circuits before ever reaching this)
+# devices, so a zero handle short-circuits before ever reaching this).
+# Device-cache uids are allocated from ONE process-wide counter, so a uid
+# identifies its device unambiguously even with several contexts/devices
+# in one process (4-chip hosts, colocated-rank tests).
 _ALL_DEVICES: List["TpuDevice"] = []
+_UID_LOCK = threading.Lock()
+_UID_STATE = {"next": 1}
+
+
+def _next_uid() -> int:
+    with _UID_LOCK:
+        u = _UID_STATE["next"]
+        _UID_STATE["next"] += 1
+        return u
 
 
 def sync_copy_handle(handle: int) -> None:
@@ -114,91 +126,168 @@ def maybe_sync_copy(cptr) -> None:
 # Device side of the comm engine's PK_DEVICE rendezvous (native seam:
 # ptc_set_dataplane, reference: comm-engine put/get on registered memory,
 # parsec_comm_engine.h:139-160).  A remote dep whose copy has a current
-# device mirror is advertised as a transfer tag; the payload is served
-# from the mirror at pull time (one d2h on the loopback transport — on a
-# single-controller pod slice this is a device-to-device hop, and a
-# multi-host ICI engine slots in behind the same three callbacks) and
-# delivered into the consumer's device cache, so the producing host copy
-# is never written and the consuming device chore re-stages nothing.
+# device mirror is advertised as a transfer tag; at pull time the payload
+# is served EITHER as bytes (d2h once, host transport carries them) OR —
+# when the pulling rank is colocated (same process, devices of one
+# accelerator client: a pod slice under a single controller, the 8-CPU
+# test mesh) — as a 16-byte by-reference token, and the tile itself moves
+# device-to-device over the fabric (jax.device_put == ICI DMA on TPU; see
+# comm/ici.py).  Consumer-side host bytes then materialize lazily through
+# the ordinary dirty-mirror coherence pull.
 
 _DP_LOCK = threading.Lock()
 _DP_STATE = {"next_tag": 1}
-_DP_REG: Dict[int, object] = {}      # tag -> device array (payload source)
+# tag -> [device array, refcount, key]; tags are shared per
+# (copy_handle, version) across send batches so a fan-out pins ONE array
+_DP_REG: Dict[int, list] = {}
+_DP_BY_KEY: Dict[tuple, int] = {}
 _DP_SERVING: Dict[int, object] = {}  # tag -> host bytes pinned during serve
+# colocated by-reference handoff: tag -> device array (same process)
+_DP_XFER: Dict[int, object] = {}
+_DP_REF_MAGIC = b"PTCDPRF1"
 
 
-def _dp_register(user, copy_handle, version, size) -> int:
-    """A remote send asks: is there a current device mirror for this copy?
-    Returns a transfer tag (>0) or 0 to fall back to the host path."""
-    try:
-        for dev in list(_ALL_DEVICES):
-            with dev._lock:
-                ent = dev._cache.get(copy_handle)
-                if ent is not None and ent.version == version:
-                    with _DP_LOCK:
-                        tag = _DP_STATE["next_tag"]
-                        _DP_STATE["next_tag"] += 1
-                        _DP_REG[tag] = _conc(ent)
-                    dev.stats["dp_sends"] = dev.stats.get("dp_sends", 0) + 1
-                    return tag
-        return 0
-    except Exception:
-        import traceback
-        traceback.print_exc()
-        return 0  # host path takes over
+def _make_dp_callbacks(ctx):
+    """Per-context data-plane callbacks (closing over ctx._devices and
+    ctx._colocated — no cross-context scans)."""
 
-
-def _dp_serve(user, tag, ptr_out) -> int:
-    """Materialize the payload bytes for one pull.  The loopback transport
-    rides host TCP, so this is the d2h point; an ICI transport would hand
-    the device array to a collective instead."""
-    try:
-        with _DP_LOCK:
-            arr = _DP_REG.get(tag)
-        if arr is None:
-            return -1
-        buf = np.ascontiguousarray(np.asarray(arr))
-        with _DP_LOCK:
-            _DP_SERVING[tag] = buf  # pin until serve_done
-        ptr_out[0] = buf.ctypes.data
-        return buf.nbytes
-    except Exception:
-        import traceback
-        traceback.print_exc()
-        return -1
-
-
-def _dp_serve_done(user, tag) -> None:
-    with _DP_LOCK:
-        _DP_SERVING.pop(tag, None)
-        _DP_REG.pop(tag, None)  # one pull per tag (native dedups per rank)
-
-
-def _dp_deliver(user, ptr, size, tag) -> int:
-    """Payload arrived for a device-plane dep: place it on the local
-    device (raw bytes; consumers reinterpret at stage-in) and return the
-    cache uid stamped on the new host copy."""
-    try:
-        import ctypes as C
-        devs = list(_ALL_DEVICES)
-        if not devs or size <= 0:
+    def dp_register(user, copy_handle, version, size) -> int:
+        """A remote send asks: is there a current device mirror for this
+        copy?  Returns a transfer tag (>0) or 0 for the host path.  The
+        same (copy, version) advertised to several ranks/batches shares
+        one tag (refcounted) — k-way fan-out pins one device array."""
+        try:
+            for dev in list(ctx._devices):
+                with dev._lock:
+                    ent = dev._cache.get(copy_handle)
+                    if ent is not None and ent.version == version:
+                        key = (copy_handle, version)
+                        with _DP_LOCK:
+                            tag = _DP_BY_KEY.get(key)
+                            if tag is not None and tag in _DP_REG:
+                                _DP_REG[tag][1] += 1
+                            else:
+                                tag = _DP_STATE["next_tag"]
+                                _DP_STATE["next_tag"] += 1
+                                _DP_REG[tag] = [_conc(ent), 1, key]
+                                _DP_BY_KEY[key] = tag
+                        dev.stats["dp_sends"] = \
+                            dev.stats.get("dp_sends", 0) + 1
+                        return tag
             return 0
-        dev = devs[0]
-        src = (C.c_uint8 * size).from_address(ptr)
-        host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
-        darr = dev._jax.device_put(host, dev.device)
-        with dev._lock:
-            uid = dev._next_uid
-            dev._next_uid += 1
-        # version 0 matches the fresh wire-materialized ptc_copy; raw=True
-        # makes stage-in reinterpret to the consumer's dtype/shape on device
-        dev._cache_put(uid, 0, darr, size, raw=True)
-        dev.stats["dp_recv_bytes"] = dev.stats.get("dp_recv_bytes", 0) + size
-        return uid
-    except Exception:
-        import traceback
-        traceback.print_exc()
-        return 0  # consumer falls back to staging the host bytes
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return 0  # host path takes over
+
+    def dp_serve(user, tag, from_rank, ptr_out, real_out) -> int:
+        """Produce one pull's wire bytes: the payload itself, or — for a
+        colocated consumer — a by-reference token (the array is handed
+        off in-process and the transfer rides the device fabric)."""
+        try:
+            with _DP_LOCK:
+                rec = _DP_REG.get(tag)
+            if rec is None:
+                return -1
+            arr = rec[0]
+            if from_rank in ctx._colocated:
+                # one handoff slot per PULL (not per tag): a fan-out to
+                # several colocated consumers serves several tokens, each
+                # resolving independently
+                with _DP_LOCK:
+                    pull_id = _DP_STATE["next_tag"]
+                    _DP_STATE["next_tag"] += 1
+                    _DP_XFER[pull_id] = arr
+                buf = np.frombuffer(
+                    _DP_REF_MAGIC + int(pull_id).to_bytes(8, "little"),
+                    dtype=np.uint8).copy()
+            else:
+                buf = np.ascontiguousarray(np.asarray(arr))
+            with _DP_LOCK:
+                _DP_SERVING[tag] = buf  # pin until serve_done
+            ptr_out[0] = buf.ctypes.data
+            real_out[0] = arr.nbytes
+            return buf.nbytes
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return -1
+
+    def dp_serve_done(user, tag) -> None:
+        with _DP_LOCK:
+            _DP_SERVING.pop(tag, None)
+            rec = _DP_REG.get(tag)
+            if rec is not None:
+                rec[1] -= 1
+                if rec[1] <= 0:
+                    _DP_REG.pop(tag, None)
+                    _DP_BY_KEY.pop(rec[2], None)
+
+    def dp_deliver(user, ptr, size, tag) -> int:
+        """Payload (or by-ref token) arrived for a device-plane dep:
+        place it on this context's least-loaded device and return the
+        cache uid stamped on the new host copy."""
+        try:
+            import ctypes as C
+            devs = list(ctx._devices)
+            if not devs or size <= 0:
+                return 0
+            # route to the least-loaded device (by native queue depth),
+            # not devs[0]; sibling devices can still D2D-stage from it
+            dev = min(devs, key=lambda d: ctx.device_queue_depth(d.qid))
+            src = (C.c_uint8 * size).from_address(ptr)
+            raw = bytes(src)
+            if size == 16 and raw[:8] == _DP_REF_MAGIC:
+                xtag = int.from_bytes(raw[8:], "little")
+                with _DP_LOCK:
+                    arr = _DP_XFER.pop(xtag, None)
+                if arr is None:
+                    return 0
+                from ..comm.ici import device_transfer
+                darr = device_transfer(arr, dev.device)
+                uid = _next_uid()
+                # typed array (producer's tile): no raw reinterpret needed
+                dev._cache_put(uid, 0, darr, arr.nbytes)
+                dev.stats["dp_d2d_bytes"] = \
+                    dev.stats.get("dp_d2d_bytes", 0) + arr.nbytes
+                return uid
+            host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
+            darr = dev._jax.device_put(host, dev.device)
+            uid = _next_uid()
+            # version 0 matches the fresh wire-materialized ptc_copy;
+            # raw=True: stage-in reinterprets to the consumer's dtype/shape
+            dev._cache_put(uid, 0, darr, size, raw=True)
+            dev.stats["dp_recv_bytes"] = \
+                dev.stats.get("dp_recv_bytes", 0) + size
+            return uid
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return 0  # consumer falls back to staging the host bytes
+
+    def dp_bound(user, uid, ptr, size) -> None:
+        """The consumer-side host copy now exists: bind it as the mirror's
+        writeback target.  By-ref deliveries are marked dirty so any host
+        read materializes them through the coherence pull."""
+        try:
+            import ctypes as C
+            for dev in list(ctx._devices):
+                with dev._lock:
+                    ent = dev._cache.get(uid)
+                    if ent is None:
+                        continue
+                    view = np.ctypeslib.as_array(
+                        (C.c_uint8 * size).from_address(ptr))
+                    ent.host = view
+                    if not ent.raw:
+                        ent.dirty = True  # by-ref: host bytes not written
+                    ent.persistent = False  # wire copy, not user Data
+                    return
+        except Exception:
+            import traceback
+            traceback.print_exc()
+
+    return dp_register, dp_serve, dp_serve_done, dp_deliver, dp_bound
 
 
 def _get_jitted(jax_mod, kernel: Callable) -> Callable:
@@ -266,6 +355,17 @@ def _conc(ent: "_CacheEnt"):
     return a
 
 
+def _host_write(ent: "_CacheEnt", res: np.ndarray) -> None:
+    """Write a device result into the entry's bound host buffer.  The
+    host binding may be a typed tile view or a flat uint8 view of a wire
+    copy (dp_bound) — bytes are bytes either way."""
+    if ent.host.dtype != res.dtype:
+        ent.host[...] = np.ascontiguousarray(res).view(
+            np.uint8).reshape(ent.host.shape)
+    else:
+        ent.host[...] = res.reshape(ent.host.shape)
+
+
 class _CacheEnt:
     __slots__ = ("version", "arr", "nbytes", "dirty", "host", "persistent",
                  "raw", "stack")
@@ -321,29 +421,43 @@ class TpuDevice:
         self._cache_used = 0
         # id(stack) -> [refcount, stack]; the strong ref keeps id() stable
         self._stacks: Dict[int, list] = {}
-        self._next_uid = 1
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stats = {"tasks": 0, "h2d_bytes": 0, "d2h_bytes": 0,
                       "h2d_hits": 0, "evictions": 0, "dead_drops": 0}
         # native hook: copies dying with a device mirror drop it (a dead
-        # dirty mirror is garbage by definition — no consumer remains)
-        self._release_cb = N.COPY_RELEASE_CB_T(self._on_copy_released)
-        N.lib.ptc_set_copy_release_cb(ctx._ptr, self._release_cb, None)
+        # dirty mirror is garbage by definition — no consumer remains).
+        # ONE callback per context fanning out to all its devices — a
+        # per-device registration would overwrite the slot and leak every
+        # earlier device's entries.
+        if getattr(ctx, "_copy_release_cb", None) is None:
+            def _ctx_release(user, handle, _ctx=ctx):
+                for d in list(_ctx._devices):
+                    d._on_copy_released(user, handle)
+            ctx._copy_release_cb = N.COPY_RELEASE_CB_T(_ctx_release)
+            N.lib.ptc_set_copy_release_cb(ctx._ptr, ctx._copy_release_cb,
+                                          None)
         # native coherence pull: comm sends / collection memcpys of a
-        # device-dirty copy write the mirror back first (one cb per ctx)
+        # device-dirty copy write the mirror back first.  Uids are
+        # process-unique, so scanning this context's devices suffices.
         if getattr(ctx, "_copy_sync_cb", None) is None:
-            ctx._copy_sync_cb = N.COPY_SYNC_CB_T(
-                lambda user, handle: sync_copy_handle(handle))
+            def _ctx_sync(user, handle, _ctx=ctx):
+                for d in list(_ctx._devices):
+                    d.sync_handle(handle)
+            ctx._copy_sync_cb = N.COPY_SYNC_CB_T(_ctx_sync)
             N.lib.ptc_set_copy_sync_cb(ctx._ptr, ctx._copy_sync_cb, None)
         # device data plane: remote deps with a current device mirror ride
         # PK_DEVICE rendezvous instead of the host eager/GET paths
+        if not hasattr(ctx, "_colocated"):
+            ctx._colocated = set()
         if getattr(ctx, "_dp_cbs", None) is None:
-            ctx._dp_cbs = (N.DP_REGISTER_CB_T(_dp_register),
-                           N.DP_SERVE_CB_T(_dp_serve),
-                           N.DP_SERVE_DONE_CB_T(_dp_serve_done),
-                           N.DP_DELIVER_CB_T(_dp_deliver))
+            reg, srv, done, dlv, bnd = _make_dp_callbacks(ctx)
+            ctx._dp_cbs = (N.DP_REGISTER_CB_T(reg),
+                           N.DP_SERVE_CB_T(srv),
+                           N.DP_SERVE_DONE_CB_T(done),
+                           N.DP_DELIVER_CB_T(dlv),
+                           N.DP_BOUND_CB_T(bnd))
             N.lib.ptc_set_dataplane(ctx._ptr, *ctx._dp_cbs, None)
         ctx._devices.append(self)  # stopped before the native ctx dies
         _ALL_DEVICES.append(self)
@@ -354,8 +468,7 @@ class TpuDevice:
         with self._lock:  # races: manager vs stage_collection/gather
             h = N.lib.ptc_copy_handle(cptr)
             if h == 0:
-                h = self._next_uid
-                self._next_uid += 1
+                h = _next_uid()
                 N.lib.ptc_copy_set_handle(cptr, h)
             return h
 
@@ -416,6 +529,24 @@ class TpuDevice:
                     del self._cache[k]
                     self.stats["evictions"] += 1
 
+    def _invalidate_siblings(self, uid: int) -> None:
+        """Writer-side invalidation (MOESI 'owned' takeover): after this
+        device produces a new version of `uid`, sibling mirrors hold a
+        stale version — drop them so a later flush/sync cannot write
+        stale bytes over the newer host state.  In-flight readers are
+        unaffected (jax arrays are immutable; only the cache entry dies).
+        Reference: coherency version/ownership flips,
+        device_cuda_module.c:2365-2420."""
+        for sib in list(getattr(self.ctx, "_devices", [])):
+            if sib is self:
+                continue
+            with sib._lock:
+                ent = sib._cache.pop(uid, None)
+                if ent is not None:
+                    sib._uncharge(ent)
+                    sib.stats["invalidations"] = \
+                        sib.stats.get("invalidations", 0) + 1
+
     def _cache_get(self, uid, version) -> Optional[object]:
         with self._lock:
             ent = self._cache.get(uid)
@@ -475,7 +606,7 @@ class TpuDevice:
             if ent is None or not ent.dirty:
                 return
         res = np.asarray(_conc(ent))  # blocks until the XLA result is ready
-        ent.host[...] = res.reshape(ent.host.shape)
+        _host_write(ent, res)
         self.stats["d2h_bytes"] += res.nbytes
         with self._lock:
             ent.dirty = False
@@ -497,7 +628,7 @@ class TpuDevice:
         for shape, ents in by_shape.items():
             stacked = np.asarray(jnp.stack([_conc(e) for e in ents]))
             for e, res in zip(ents, stacked):
-                e.host[...] = res.reshape(e.host.shape)
+                _host_write(e, res)
                 self.stats["d2h_bytes"] += res.nbytes
                 with self._lock:
                     e.dirty = False
@@ -627,8 +758,7 @@ class TpuDevice:
         be reused by later tasks (same ABA issue the copy cache guards)."""
         dtypes = {i: np.dtype(dtype) for i in range(nb_flows)}
         with self._lock:
-            tag = self._next_uid
-            self._next_uid += 1
+            tag = _next_uid()
             N.lib.ptc_task_set_tag(task_ptr, tag)
             self._dtd_bodies[tag] = _DeviceBody(
                 kernel, reads, writes, shapes, dtypes, None, None, nb_flows)
@@ -657,6 +787,21 @@ class TpuDevice:
         if arr is not None:
             self.stats["h2d_hits"] += 1
             return arr
+        # D2D: a sibling device of this context may hold the current
+        # mirror — stage device-to-device over the fabric instead of
+        # round-tripping the host (reference: CUDA peer stage-in,
+        # device_cuda_module.c:1261)
+        for sib in list(self.ctx._devices):
+            if sib is self:
+                continue
+            sarr = sib._cache_get_typed(uid, ver, body.dtypes[flow],
+                                        body.shapes.get(flow))
+            if sarr is not None:
+                darr = self._jax.device_put(sarr, self.device)
+                self._cache_put(uid, ver, darr, sarr.nbytes)
+                self.stats["d2d_bytes"] = \
+                    self.stats.get("d2d_bytes", 0) + sarr.nbytes
+                return darr
         host = view.data(flow, dtype=body.dtypes[flow],
                          shape=body.shapes.get(flow), sync=False)
         darr = self._jax.device_put(host, self.device)
@@ -704,6 +849,25 @@ class TpuDevice:
         mats += [mats[0]] * (bucket - len(mats))
         return jnp.stack(mats)
 
+    def _write_out(self, view, body: _DeviceBody, flow, arr, res) -> None:
+        """Install one task's output in the cache (and, for mem-out flows
+        where `res` is the materialized host result, write the host copy
+        synchronously — release_deps may memcpy it into another
+        collection tile).  Shared by batched and per-task dispatch."""
+        cptr, uid, ver = self._flow_uid_ver(view, body, flow)
+        host = view.data(flow, dtype=body.dtypes[flow],
+                         shape=body.shapes.get(flow), sync=False)
+        persistent = bool(N.lib.ptc_copy_is_persistent(cptr))
+        if res is not None:
+            host[...] = res.reshape(host.shape)
+            self.stats["d2h_bytes"] += res.nbytes
+            self._cache_put(uid, ver + 1, arr, host.nbytes,
+                            persistent=persistent)
+        else:
+            self._cache_put(uid, ver + 1, arr, host.nbytes,
+                            dirty=True, host=host, persistent=persistent)
+        self._invalidate_siblings(uid)
+
     def _dispatch_group(self, body: _DeviceBody, tasks: List):
         """One vmapped executable call for a group of ready tasks of the
         same class.  Inputs are gathered per flow into (bucket, *tile)
@@ -719,21 +883,12 @@ class TpuDevice:
             outs = out if isinstance(out, tuple) else (out,)
             for f, ostack in zip(body.writes, outs):
                 sync_host = f in body.mem_out_flows
-                res = np.asarray(ostack) if sync_host else None
+                # slice off the bucket padding before the blocking d2h
+                res = (np.asarray(ostack[:len(views)]) if sync_host
+                       else None)
                 for i, view in enumerate(views):
-                    cptr, uid, ver = self._flow_uid_ver(view, body, f)
-                    host = view.data(f, dtype=body.dtypes[f],
-                                     shape=body.shapes.get(f), sync=False)
-                    persistent = bool(N.lib.ptc_copy_is_persistent(cptr))
-                    if sync_host:
-                        host[...] = res[i].reshape(host.shape)
-                        self.stats["d2h_bytes"] += res[i].nbytes
-                        self._cache_put(uid, ver + 1, _StackRef(ostack, i),
-                                        host.nbytes, persistent=persistent)
-                    else:
-                        self._cache_put(uid, ver + 1, _StackRef(ostack, i),
-                                        host.nbytes, dirty=True, host=host,
-                                        persistent=persistent)
+                    self._write_out(view, body, f, _StackRef(ostack, i),
+                                    res[i] if sync_host else None)
             self.stats["tasks"] += len(tasks)
             self.stats["batches"] = self.stats.get("batches", 0) + 1
             self.stats["batched_tasks"] = \
@@ -764,25 +919,9 @@ class TpuDevice:
             out = jitted(*ins)  # async: returns immediately
             outs = out if isinstance(out, tuple) else (out,)
             for f, arr in zip(body.writes, outs):
-                fi = body.flow_index(f)
-                cptr = N.lib.ptc_task_copy(view._ptr, fi)
-                uid = self._copy_uid(cptr)
-                ver = N.lib.ptc_copy_version(cptr)
-                host = view.data(f, dtype=body.dtypes[f],
-                                 shape=body.shapes.get(f), sync=False)
-                persistent = bool(N.lib.ptc_copy_is_persistent(cptr))
-                if f in body.mem_out_flows:
-                    # host copy must be coherent before release_deps may
-                    # memcpy it into another collection tile
-                    res = np.asarray(arr)
-                    host[...] = res.reshape(host.shape)
-                    self.stats["d2h_bytes"] += res.nbytes
-                    self._cache_put(uid, ver + 1, arr, host.nbytes,
-                                    persistent=persistent)
-                else:
-                    self._cache_put(uid, ver + 1, arr, host.nbytes,
-                                    dirty=True, host=host,
-                                    persistent=persistent)
+                sync_host = f in body.mem_out_flows
+                self._write_out(view, body, f, arr,
+                                np.asarray(arr) if sync_host else None)
             self.stats["tasks"] += 1
         except Exception:
             # A failed kernel must NOT complete the task — successors
