@@ -117,6 +117,51 @@ def bench_spotrf(N=16384, nb=1024, reps=2):
     return potrf_flops(N) / best / 1e9
 
 
+def bench_ep(nb_tasks=100000, workers=(1, 2, 4, 8), scheds=None):
+    """Embarrassingly-parallel scheduler throughput (reference vehicle:
+    tests/runtime/scheduling/ep.jdf — the benchmark every scheduler is
+    judged by).  Native noop bodies: no GIL, pure dispatch path.  Prints
+    a (scheduler x workers) tasks/s table to stderr and returns the
+    matrix."""
+    if scheds is None:
+        scheds = ["lfq", "ll", "ltq", "pbq", "gd", "ap", "spq", "ip", "rnd"]
+    results = {}
+    for w in workers:
+        for s in scheds:
+            with pt.Context(nb_workers=w, scheduler=s) as ctx:
+                tp = pt.Taskpool(ctx, globals={"NB": nb_tasks - 1})
+                tc = tp.task_class("EP")
+                tc.param("k", 0, pt.G("NB"))
+                tc.body_noop()
+                t0 = time.perf_counter()
+                tp.run()
+                tp.wait()
+                dt = time.perf_counter() - t0
+            results[(s, w)] = nb_tasks / dt
+    sys.stderr.write("ep tasks/s (%d tasks)\n%-6s" % (nb_tasks, "sched"))
+    for w in workers:
+        sys.stderr.write(f"{w:>12d}w")
+    sys.stderr.write("\n")
+    for s in scheds:
+        sys.stderr.write("%-6s" % s)
+        for w in workers:
+            sys.stderr.write(f"{results[(s, w)]:>13,.0f}")
+        sys.stderr.write("\n")
+    return results
+
+
+def _ep_json():
+    res = bench_ep()
+    best = max(res, key=res.get)
+    return json.dumps({
+        "metric": "ep_tasks_per_sec",
+        "value": round(res[best], 0),
+        "unit": "tasks/s",
+        "vs_baseline": round(res[best] / 1e6, 3),  # 1M tasks/s target
+        "config": {"sched": best[0], "workers": best[1]},
+    })
+
+
 def _dispatch_json():
     p50_us = bench_dispatch_chain()
     return json.dumps({
@@ -150,6 +195,9 @@ def _probe_tpu(timeout_s: int) -> bool:
 def main():
     if "--dispatch" in sys.argv:
         print(_dispatch_json())
+        return 0
+    if "--ep" in sys.argv:
+        print(_ep_json())
         return 0
     if "--spotrf-child" in sys.argv:
         n = _arg_after("--n", 16384)

@@ -29,11 +29,17 @@
  *                   ([u32 flow][u64 len][bytes])*
  *   FENCE    (4) := [u64 generation]
  *   ACTIVATE_BCAST (5) := [i32 tp_id][i32 flow_idx][u8 topo][u32 nb_groups]
- *                   ([u32 rank][u32 nb_targets] targets*)* [u64 plen][payload]
+ *                   ([u32 rank][u32 nb_targets] targets*)* [u8 pk]
+ *                   (PK_EAGER: [u64 plen][payload] |
+ *                    PK_GET/PK_DEVICE: [u64 handle][u64 size])
  *     — activation propagation along a broadcast topology (reference:
  *     runtime_comm_coll_bcast chain/binomial, parsec/remote_dep.c:39-47):
  *     each receiving rank takes group[0] (its own), re-forwards the
- *     remaining groups to its children per `topo`, re-rooting the payload.
+ *     remaining groups to its children per `topo`, re-rooting the
+ *     payload; above the eager limit each hop PULLS from its parent and
+ *     re-registers what it pulled (rendezvous broadcast, reference
+ *     remote_dep_mpi.c:241-253), so big tiles never ride the ACTIVATE
+ *     frames and device-resident tiles never touch the producing host.
  */
 
 #include "runtime_internal.h"
@@ -70,6 +76,10 @@ enum {
   PK_GET = 2,    /* host rendezvous: [u64 src_handle][u64 len] */
   PK_DEVICE = 3, /* device rendezvous: same wire shape; the payload is
                     served from / delivered to the device layer */
+  PK_PARKED_DEVICE = 9, /* parked-frame only (never on the wire): a
+                    resolved by-ref delivery whose pool was unknown —
+                    [u64 device_uid][u64 alloc_len], bytes live in the
+                    device cache */
 };
 
 struct TcpPeer {
@@ -153,11 +163,25 @@ struct MemReg {
 };
 
 /* receiver side: a dep delivery whose payload is still being pulled */
+/* one (rank, targets) group of a topology broadcast */
+struct BcastWireGroup {
+  uint32_t rank;
+  std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
+  int32_t first_class = -1;           /* for COMM_SEND events */
+};
+
 struct PendingGet {
   int32_t tp_id;
   int32_t flow_idx;
   std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
   uint8_t pk;
+  /* broadcast-relay rendezvous: once the pull resolves, deliver locally
+   * AND re-root — re-register the payload and forward to these children
+   * along `topo` (reference: re-rooted bcast data movement,
+   * remote_dep.c:39-47, remote_dep_mpi.c:241-253) */
+  bool bcast = false;
+  uint8_t topo = 0;
+  std::vector<BcastWireGroup> groups;
 };
 
 } // namespace
@@ -290,10 +314,12 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
      * its uid so a device-chore consumer hits the cache (no re-stage) */
     copy->handle = device_uid;
     /* let the device layer bind the host buffer of its mirror: a by-ref
-     * delivery materializes on host lazily (coherence pull), a byte
-     * delivery gets a writeback target for later device writes */
+     * delivery (host bytes never written) materializes on host lazily
+     * via the coherence pull; a byte delivery gets a writeback target
+     * for later device writes */
     if (device_uid != 0 && ctx->dp_bound)
-      ctx->dp_bound(ctx->dp_user, device_uid, copy->ptr, copy->size);
+      ctx->dp_bound(ctx->dp_user, device_uid, copy->ptr, copy->size,
+                    plen == alloc_len ? 1 : 0);
   }
   for (WireTarget &t : targets) {
     ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
@@ -328,28 +354,34 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
       tp = it->second;
       g.unlock();
     } else if (allow_park) {
-      if (alloc_len && alloc_len != plen) {
-        /* by-ref payload for an unknown pool: cannot be parked as bytes
-         * (rendezvous ACTIVATEs park before the GET, so this is a
-         * teardown race, not startup skew) */
-        std::fprintf(stderr, "ptc-comm: by-ref payload for unknown "
-                             "taskpool %d dropped\n", tp_id);
-        return;
-      }
-      /* park a self-contained eager-form ACTIVATE body (replayed by
-       * ptc_comm_drain_early; device_uid is dropped — replay stages the
-       * host bytes, the device re-stages on first use) */
+      /* park a self-contained ACTIVATE body (replayed by
+       * ptc_comm_drain_early).  Byte payloads park eager-form (the
+       * device_uid is dropped; the device re-stages on first use).  A
+       * by-ref payload has no host bytes — park the device uid itself
+       * (PK_PARKED_DEVICE; the device cache holds the tile). */
       std::vector<uint8_t> parked;
       parked.push_back(MSG_ACTIVATE);
       Writer w{parked};
-      w.u32(UINT32_MAX); /* parked `from`: eager-form needs no pull */
+      w.u32(UINT32_MAX); /* parked `from`: replay never pulls */
       w.i32(tp_id);
       w.i32(flow_idx);
       w.raw(targets_bytes, targets_len);
-      w.u8(plen ? PK_EAGER : PK_NONE);
-      if (plen) {
-        w.u64(plen);
-        w.raw(payload, (size_t)plen);
+      if (alloc_len && alloc_len != plen) {
+        if (device_uid == 0) {
+          std::fprintf(stderr, "ptc-comm: by-ref payload for unknown "
+                               "taskpool %d had no device uid; dropped\n",
+                       tp_id);
+          return;
+        }
+        w.u8(PK_PARKED_DEVICE);
+        w.u64((uint64_t)device_uid);
+        w.u64(alloc_len);
+      } else {
+        w.u8(plen ? PK_EAGER : PK_NONE);
+        if (plen) {
+          w.u64(plen);
+          w.raw(payload, (size_t)plen);
+        }
       }
       ctx->tp_early[tp_id].push_back(std::move(parked));
       return;
@@ -403,6 +435,25 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), r.p, plen, 0,
                     allow_park);
+    return;
+  }
+  case PK_PARKED_DEVICE: {
+    /* parked-frame replay of a by-ref delivery: the tile lives in the
+     * device cache under `uid`; the host copy is created at alloc_len
+     * and materializes lazily.  NEVER valid from the network — a peer
+     * frame must not name local device-cache uids (parked replays carry
+     * from == UINT32_MAX). */
+    if (from != UINT32_MAX) {
+      std::fprintf(stderr, "ptc-comm: PK_PARKED_DEVICE from the wire "
+                           "(rank %u) dropped\n", from);
+      return;
+    }
+    uint64_t uid = r.u64();
+    uint64_t alloc_len = r.u64();
+    if (!r.ok) return;
+    deliver_or_park(ctx, tp_id, flow_idx, targets_start,
+                    (size_t)(targets_end - targets_start), nullptr, 0,
+                    (int64_t)uid, allow_park, alloc_len);
     return;
   }
   case PK_GET:
@@ -515,17 +566,32 @@ static void handle_dtd_done_body(ptc_context *ctx, const uint8_t *body,
  * `groups` is an ordered slice of (rank, serialized-targets) pairs; the
  * fanout sends slice [i, i+take) to groups[i].rank where take = all
  * (chain: one child relays everything) or half (binomial: log-depth
- * tree).  Topology ids: 0 star (never framed), 1 chain, 2 binomial.   */
-struct BcastWireGroup {
-  uint32_t rank;
-  std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
-  int32_t first_class = -1;           /* for COMM_SEND events */
-};
+ * tree).  Topology ids: 0 star (never framed), 1 chain, 2 binomial.
+ *
+ * Payload section after the groups: [u8 pk] then
+ *   PK_NONE   —
+ *   PK_EAGER  [u64 plen][payload]
+ *   PK_GET / PK_DEVICE  [u64 handle][u64 size] — the handle is valid at
+ *     the SENDING rank (each relay pulls from its parent, re-registers
+ *     what it pulled, and forwards its own handle: re-rooted data
+ *     movement, reference remote_dep.c:39-47). */
+
+/* number of direct child frames the fanout will emit */
+static size_t bcast_frame_count(size_t ngroups, uint8_t topo) {
+  size_t frames = 0, n = ngroups;
+  while (n > 0) {
+    size_t take = (topo == 2) ? (n + 1) / 2 : n;
+    frames++;
+    n -= take;
+  }
+  return frames;
+}
 
 static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
                          uint8_t topo,
                          const std::vector<BcastWireGroup> &groups,
-                         size_t i0, const uint8_t *payload, uint64_t plen) {
+                         size_t i0, uint8_t pk, uint64_t handle,
+                         const uint8_t *payload, uint64_t plen) {
   size_t i = i0;
   while (i < groups.size()) {
     size_t n = groups.size() - i;
@@ -540,8 +606,14 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
       w.u32(groups[k].rank);
       w.raw(groups[k].targets_bytes.data(), groups[k].targets_bytes.size());
     }
-    w.u64(plen);
-    if (plen) w.raw(payload, (size_t)plen);
+    w.u8(pk);
+    if (pk == PK_EAGER) {
+      w.u64(plen);
+      if (plen) w.raw(payload, (size_t)plen);
+    } else if (pk == PK_GET || pk == PK_DEVICE) {
+      w.u64(handle);
+      w.u64(plen); /* true payload size */
+    }
     frame_finish(f);
     ptc_prof_instant(ce->ctx, PROF_KEY_COMM_SEND, groups[i].first_class,
                      (int64_t)groups[i].rank, (int64_t)(take - 1),
@@ -551,8 +623,8 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
   }
 }
 
-static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
-                                       size_t len) {
+static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
+                                       const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
   Reader r{body, body + len};
   int32_t tp_id = r.i32();
@@ -587,15 +659,56 @@ static void handle_activate_bcast_body(CommEngine *ce, const uint8_t *body,
       groups.push_back(BcastWireGroup{rank, std::move(bytes), first_class});
     }
   }
-  uint64_t plen = r.u64();
-  if (!r.ok || bad_rank || (size_t)(r.end - r.p) < plen) {
+  uint8_t pk = r.u8();
+  uint64_t plen = 0, src_handle = 0;
+  if (pk == PK_EAGER) {
+    plen = r.u64();
+  } else if (pk == PK_GET || pk == PK_DEVICE) {
+    src_handle = r.u64();
+    plen = r.u64(); /* true payload size (at the parent) */
+  } else if (pk != PK_NONE) {
+    bad_rank = true;
+  }
+  bool payload_inline = (pk == PK_EAGER || pk == PK_NONE);
+  if (!r.ok || bad_rank ||
+      (payload_inline && (size_t)(r.end - r.p) < plen)) {
     std::fprintf(stderr, "ptc-comm: malformed ACTIVATE_BCAST dropped\n");
     return;
   }
-  /* forward FIRST (latency: children start their pulls while we deliver;
-   * forwarding needs no taskpool knowledge, so SPMD skew cannot stall
-   * the tree) */
-  bcast_fanout(ce, tp_id, flow_idx, topo, groups, 0, r.p, plen);
+  if (!payload_inline) {
+    /* rendezvous broadcast: pull from the parent FIRST, then deliver and
+     * re-root to the children (each hop re-registers what it pulled —
+     * reference: re-rooted bcast data movement, remote_dep_mpi.c:241-253).
+     * Children wait behind our pull: that is the pipeline the chain
+     * topology is for. */
+    if (from >= ce->nodes) return;
+    uint64_t cookie;
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      cookie = ce->next_cookie++;
+      PendingGet pg;
+      pg.tp_id = tp_id;
+      pg.flow_idx = flow_idx;
+      pg.targets_bytes = std::move(my_targets);
+      pg.pk = pk;
+      pg.bcast = true;
+      pg.topo = topo;
+      pg.groups = std::move(groups);
+      ce->pending_gets.emplace(cookie, std::move(pg));
+    }
+    std::vector<uint8_t> f = frame_begin(MSG_GET);
+    Writer w{f};
+    w.u64(src_handle);
+    w.u64(cookie);
+    frame_finish(f);
+    ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
+    comm_post(ce, from, std::move(f));
+    return;
+  }
+  /* inline payload: forward FIRST (latency: children deliver while we
+   * do; forwarding needs no taskpool knowledge, so SPMD skew cannot
+   * stall the tree) */
+  bcast_fanout(ce, tp_id, flow_idx, topo, groups, 0, pk, 0, r.p, plen);
   if (my_targets.empty()) {
     std::fprintf(stderr, "ptc-comm: ACTIVATE_BCAST without my group; "
                          "forwarded only\n");
@@ -719,12 +832,54 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
   if (pk == PK_DEVICE && ctx->dp_deliver)
     device_uid = ctx->dp_deliver(ctx->dp_user, r.p, (int64_t)plen,
                                  (int64_t)cookie);
+  if (pg.bcast && !pg.groups.empty()) {
+    /* re-root: register what we pulled and forward our own handle to the
+     * children (reference: each forwarding rank re-roots data movement,
+     * remote_dep.c:39-47) */
+    size_t nframes = bcast_frame_count(pg.groups.size(), pg.topo);
+    uint8_t fpk = 0;
+    uint64_t fh = 0;
+    int64_t tag = 0;
+    if (device_uid && ctx->dp_register) {
+      /* one register per child frame: the device layer refcounts pulls */
+      for (size_t q = 0; q < nframes; q++)
+        tag = ctx->dp_register(ctx->dp_user, device_uid, 0,
+                               (int64_t)real_len);
+    }
+    if (tag > 0) {
+      std::lock_guard<std::mutex> g(ce->lock);
+      MemReg &m = ce->mem_reg[(uint64_t)tag];
+      m.pk = PK_DEVICE;
+      m.expected += (int32_t)nframes;
+      fpk = PK_DEVICE;
+      fh = (uint64_t)tag;
+    } else if (plen == real_len) {
+      std::lock_guard<std::mutex> g(ce->lock);
+      fh = ce->next_handle++;
+      MemReg m;
+      m.pk = PK_GET;
+      m.expected = (int32_t)nframes;
+      m.bytes.assign(r.p, r.p + plen);
+      ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+                                  std::memory_order_relaxed);
+      ce->mem_reg.emplace(fh, std::move(m));
+      fpk = PK_GET;
+    } else {
+      std::fprintf(stderr, "ptc-comm: bcast relay cannot re-serve a "
+                           "by-ref payload with no device; children "
+                           "dropped\n");
+    }
+    if (fpk)
+      bcast_fanout(ce, pg.tp_id, pg.flow_idx, pg.topo, pg.groups, 0,
+                   fpk, fh, nullptr, real_len);
+  }
   /* by-reference delivery (real_len != plen): the payload rode the device
    * fabric; the host copy is allocated at real_len and materialized
    * lazily from the device mirror via the coherence pull */
-  deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
-                  pg.targets_bytes.size(), r.p, plen, device_uid,
-                  /*allow_park=*/true, real_len);
+  if (!pg.targets_bytes.empty())
+    deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
+                    pg.targets_bytes.size(), r.p, plen, device_uid,
+                    /*allow_park=*/true, real_len);
 }
 
 static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
@@ -742,7 +897,7 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     handle_put_data_body(ce, body, len);
     break;
   case MSG_ACTIVATE_BCAST:
-    handle_activate_bcast_body(ce, body, len);
+    handle_activate_bcast_body(ce, from, body, len);
     break;
   case MSG_PUT:
     handle_put_body(ctx, body, len);
@@ -1179,9 +1334,64 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
       (copy && copy->ptr && copy->size > 0) ? (const uint8_t *)copy->ptr
                                             : nullptr;
   uint64_t plen = payload ? (uint64_t)copy->size : 0;
+  bool big = payload && ce->eager_limit >= 0 &&
+             (int64_t)plen > (int64_t)ce->eager_limit;
+  size_t nframes = bcast_frame_count(wire.size(), (uint8_t)topo);
+  if (big && nframes) {
+    /* rendezvous broadcast: advertise a handle, let the direct children
+     * pull (and re-root for theirs) — a big tile never rides the
+     * ACTIVATE frames, and a device-resident tile is never materialized
+     * on this host (PK_DEVICE) */
+    int64_t tag = 0;
+    if (ctx->dp_register && copy->handle != 0)
+      for (size_t q = 0; q < nframes; q++)
+        tag = ctx->dp_register(ctx->dp_user, copy->handle,
+                               copy->version.load(), copy->size);
+    if (tag > 0) {
+      {
+        std::lock_guard<std::mutex> g(ce->lock);
+        MemReg &m = ce->mem_reg[(uint64_t)tag];
+        m.pk = PK_DEVICE;
+        m.expected += (int32_t)nframes;
+      }
+      bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
+                   PK_DEVICE, (uint64_t)tag, nullptr, plen);
+      return;
+    }
+    ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshotting */
+    uint64_t h;
+    {
+      /* share the per-copy snapshot with point-to-point sends (and with
+       * other broadcasts of the same copy): one mem_by_copy entry, one
+       * byte buffer, expected bumped per pull */
+      std::lock_guard<std::mutex> g(ce->lock);
+      auto itc = ce->mem_by_copy.find(copy);
+      if (itc != ce->mem_by_copy.end()) {
+        h = itc->second;
+        ce->mem_reg[h].expected += (int32_t)nframes;
+      } else {
+        h = ce->next_handle++;
+        MemReg m;
+        m.pk = PK_GET;
+        m.expected = (int32_t)nframes;
+        m.src = copy;
+        ptc_copy_retain(copy);
+        m.bytes.assign((const uint8_t *)copy->ptr,
+                       (const uint8_t *)copy->ptr + copy->size);
+        ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+                                    std::memory_order_relaxed);
+        ce->mem_reg.emplace(h, std::move(m));
+        ce->mem_by_copy.emplace(copy, h);
+      }
+    }
+    bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, PK_GET, h,
+                 nullptr, plen);
+    return;
+  }
   if (payload)
     ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
-  bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, payload, plen);
+  bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
+               payload ? PK_EAGER : PK_NONE, 0, payload, plen);
 }
 
 void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,

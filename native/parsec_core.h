@@ -303,7 +303,7 @@ typedef void (*ptc_dp_serve_done_cb)(void *user, int64_t tag);
 typedef int64_t (*ptc_dp_deliver_cb)(void *user, const void *ptr,
                                      int64_t size, int64_t tag);
 typedef void (*ptc_dp_bound_cb)(void *user, int64_t uid, void *ptr,
-                                int64_t size);
+                                int64_t size, int32_t host_valid);
 void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,
                        ptc_dp_serve_cb serve, ptc_dp_serve_done_cb done,
                        ptc_dp_deliver_cb deliver, ptc_dp_bound_cb bound,

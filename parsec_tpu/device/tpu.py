@@ -169,7 +169,8 @@ def _make_dp_callbacks(ctx):
                             else:
                                 tag = _DP_STATE["next_tag"]
                                 _DP_STATE["next_tag"] += 1
-                                _DP_REG[tag] = [_conc(ent), 1, key]
+                                _DP_REG[tag] = [_conc(ent), 1, key,
+                                                ent.raw]
                                 _DP_BY_KEY[key] = tag
                         dev.stats["dp_sends"] = \
                             dev.stats.get("dp_sends", 0) + 1
@@ -197,7 +198,7 @@ def _make_dp_callbacks(ctx):
                 with _DP_LOCK:
                     pull_id = _DP_STATE["next_tag"]
                     _DP_STATE["next_tag"] += 1
-                    _DP_XFER[pull_id] = arr
+                    _DP_XFER[pull_id] = (arr, rec[3])
                 buf = np.frombuffer(
                     _DP_REF_MAGIC + int(pull_id).to_bytes(8, "little"),
                     dtype=np.uint8).copy()
@@ -240,14 +241,16 @@ def _make_dp_callbacks(ctx):
             if size == 16 and raw[:8] == _DP_REF_MAGIC:
                 xtag = int.from_bytes(raw[8:], "little")
                 with _DP_LOCK:
-                    arr = _DP_XFER.pop(xtag, None)
-                if arr is None:
+                    hand = _DP_XFER.pop(xtag, None)
+                if hand is None:
                     return 0
+                arr, was_raw = hand
                 from ..comm.ici import device_transfer
                 darr = device_transfer(arr, dev.device)
                 uid = _next_uid()
-                # typed array (producer's tile): no raw reinterpret needed
-                dev._cache_put(uid, 0, darr, arr.nbytes)
+                # rawness travels with the array: a relay's raw-bytes
+                # mirror stays raw (consumers reinterpret at stage-in)
+                dev._cache_put(uid, 0, darr, arr.nbytes, raw=was_raw)
                 dev.stats["dp_d2d_bytes"] = \
                     dev.stats.get("dp_d2d_bytes", 0) + arr.nbytes
                 return uid
@@ -265,10 +268,11 @@ def _make_dp_callbacks(ctx):
             traceback.print_exc()
             return 0  # consumer falls back to staging the host bytes
 
-    def dp_bound(user, uid, ptr, size) -> None:
+    def dp_bound(user, uid, ptr, size, host_valid) -> None:
         """The consumer-side host copy now exists: bind it as the mirror's
-        writeback target.  By-ref deliveries are marked dirty so any host
-        read materializes them through the coherence pull."""
+        writeback target.  host_valid=0 (by-ref delivery: the host buffer
+        was never written) marks the mirror dirty so any host read
+        materializes it through the coherence pull."""
         try:
             import ctypes as C
             for dev in list(ctx._devices):
@@ -279,8 +283,8 @@ def _make_dp_callbacks(ctx):
                     view = np.ctypeslib.as_array(
                         (C.c_uint8 * size).from_address(ptr))
                     ent.host = view
-                    if not ent.raw:
-                        ent.dirty = True  # by-ref: host bytes not written
+                    if not host_valid:
+                        ent.dirty = True
                     ent.persistent = False  # wire copy, not user Data
                     return
         except Exception:

@@ -355,3 +355,86 @@ def ptg_block_cyclic_scale(rank: int, nodes: int, port: int, mt: int = 4,
                     np.testing.assert_allclose(A.tile(mm, nn),
                                                2.0 * (mm + nn + 1))
         ctx.comm_fini()
+
+
+def ptg_bcast_rendezvous_topo(rank: int, nodes: int, port: int,
+                              topo: str = "chain", elems: int = 2048,
+                              device: bool = False):
+    """ONE payload far above the eager limit broadcast to every rank along
+    a chain/binomial topology: the ACTIVATE_BCAST frames carry only a
+    handle; every hop pulls from its parent and re-registers what it
+    pulled for its own children (re-rooted rendezvous broadcast,
+    reference: remote_dep.c:39-47, remote_dep_mpi.c:241-253).  Post-fence
+    every rank's registration table must be empty (bounded comm memory).
+    With device=True the root produces the tile on its device and the
+    broadcast must never materialize it on the producing host."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "1024"
+    pt, ctx = _mk_ctx(rank, nodes, port, nb_workers=1, topo=topo)
+    dev = None
+    if device:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from parsec_tpu.device import TpuDevice
+
+        dev = TpuDevice(ctx)
+    with ctx:
+        esize = elems * 4
+        arr = np.zeros((nodes, elems), dtype=np.float32)
+        ctx.register_linear_collection("V", arr, elem_size=esize,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", esize)
+        tp = pt.Taskpool(ctx, globals={"NR": nodes - 1})
+        k = pt.L("k")
+        root = tp.task_class("Root")
+        root.affinity("V", 0)
+        recv = tp.task_class("Recv")
+        # device variant: no local consumer on the root — a rank-0 CPU
+        # read would (correctly) pull the mirror and the d2h==0 assertion
+        # below is specifically about the BROADCAST not materializing it
+        k0 = 1 if dev is not None else 0
+        recv.param("k", k0, pt.G("NR"))
+        recv.affinity("V", k)
+        root.flow("X", "W",
+                  pt.Out(pt.Ref("Recv", pt.Range(k0, pt.G("NR")), flow="X")),
+                  arena="t")
+        if dev is not None:
+            import jax.numpy as jnp
+
+            dev.attach(root, tp,
+                       kernel=lambda: jnp.full((elems,), 7.0, jnp.float32),
+                       reads=[], writes=["X"], shapes={"X": (elems,)},
+                       dtype=np.float32)
+
+        def root_body(view):
+            d = view.data("X", dtype=np.float32)
+            d[...] = 7.0
+
+        root.body(root_body)
+
+        def recv_body(view):
+            d = view.data("X", dtype=np.float32)
+            assert d[0] == 7.0 and d[-1] == 7.0, (d[0], d[-1])
+            view.data("Y", dtype=np.float32)[0] = float(d[elems // 2])
+
+        recv.flow("X", "R", pt.In(pt.Ref("Root", flow="X")), arena="t")
+        recv.flow("Y", "W", pt.Out(pt.Mem("V", k)), arena="t")
+        recv.body(recv_body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        rdv = ctx.comm_rdv_stats()
+        # bounded comm memory on EVERY rank (root and relays alike)
+        assert rdv["registered_bytes"] == 0, (rank, rdv)
+        assert rdv["pending_pulls"] == 0, (rank, rdv)
+        if rank >= k0:
+            assert arr[rank, 0] == 7.0, arr[rank, 0]
+        if dev is not None:
+            if rank == 0:
+                # device-resident broadcast: producer host copy untouched
+                assert dev.stats["d2h_bytes"] == 0, dev.stats
+                assert dev.stats.get("dp_sends", 0) >= 1, dev.stats
+            dev.stop()
+        ctx.comm_fini()

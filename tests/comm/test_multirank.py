@@ -114,3 +114,20 @@ def test_device_dataplane_2ranks():
 @pytest.mark.parametrize("nodes", [2, 4])
 def test_ptg_block_cyclic_scale(nodes):
     _run_spmd(_workers.ptg_block_cyclic_scale, nodes)
+
+
+@pytest.mark.parametrize("topo", ["chain", "binomial"])
+def test_bcast_rendezvous_topologies_4ranks(topo):
+    """Big-tile broadcast above the eager limit: handle-only ACTIVATE
+    frames, per-hop pull + re-registration, empty registration tables
+    post-fence on every rank."""
+    _run_spmd(_workers.ptg_bcast_rendezvous_topo, 4, timeout=150.0,
+              topo=topo)
+
+
+@pytest.mark.parametrize("topo", ["chain", "binomial"])
+def test_bcast_rendezvous_device_resident(topo):
+    """Device-resident tile broadcast: the producing host copy is never
+    materialized (PK_DEVICE rendezvous reaches broadcasts too)."""
+    _run_spmd(_workers.ptg_bcast_rendezvous_topo, 3, timeout=150.0,
+              topo=topo, device=True)
