@@ -82,6 +82,13 @@ enum {
                     device cache */
 };
 
+/* Device-plane tags (allocated by the device layer's own counter) and
+ * host rendezvous handles (ce->next_handle) are independent sequences:
+ * flag device tags in the shared mem_reg keyspace / on the wire so they
+ * can never collide with a live host registration.  Strip before
+ * handing the tag back to dp_serve/dp_serve_done. */
+static constexpr uint64_t DP_HANDLE_FLAG = 1ULL << 63;
+
 struct TcpPeer {
   int fd = -1;
   std::vector<uint8_t> inbuf;
@@ -280,6 +287,24 @@ static std::vector<WireTarget> parse_targets(Reader &r, uint32_t nb_targets) {
     targets.push_back(std::move(t));
   }
   return targets;
+}
+
+/* park a pending rendezvous delivery and pull its payload from `from` */
+static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
+                                 uint64_t src_handle, PendingGet &&pg) {
+  uint64_t cookie;
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    cookie = ce->next_cookie++;
+    ce->pending_gets.emplace(cookie, std::move(pg));
+  }
+  std::vector<uint8_t> f = frame_begin(MSG_GET);
+  Writer w{f};
+  w.u64(src_handle);
+  w.u64(cookie);
+  frame_finish(f);
+  ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
+  comm_post(ce, from, std::move(f));
 }
 
 /* Deliver parsed targets: ONE ptc_copy is materialized from the wire
@@ -481,25 +506,13 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
         return;
       }
     }
-    /* park the delivery against a cookie, pull the payload. */
-    uint64_t cookie;
-    {
-      std::lock_guard<std::mutex> g(ce->lock);
-      cookie = ce->next_cookie++;
-      PendingGet pg;
-      pg.tp_id = tp_id;
-      pg.flow_idx = flow_idx;
-      pg.targets_bytes.assign(targets_start, targets_end);
-      pg.pk = pk;
-      ce->pending_gets.emplace(cookie, std::move(pg));
-    }
-    std::vector<uint8_t> f = frame_begin(MSG_GET);
-    Writer w{f};
-    w.u64(src_handle);
-    w.u64(cookie);
-    frame_finish(f);
-    ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
-    comm_post(ce, from, std::move(f));
+    /* park the delivery against a cookie, pull the payload */
+    PendingGet pg;
+    pg.tp_id = tp_id;
+    pg.flow_idx = flow_idx;
+    pg.targets_bytes.assign(targets_start, targets_end);
+    pg.pk = pk;
+    send_rendezvous_pull(ce, from, src_handle, std::move(pg));
     return;
   }
   default:
@@ -682,27 +695,15 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
      * Children wait behind our pull: that is the pipeline the chain
      * topology is for. */
     if (from >= ce->nodes) return;
-    uint64_t cookie;
-    {
-      std::lock_guard<std::mutex> g(ce->lock);
-      cookie = ce->next_cookie++;
-      PendingGet pg;
-      pg.tp_id = tp_id;
-      pg.flow_idx = flow_idx;
-      pg.targets_bytes = std::move(my_targets);
-      pg.pk = pk;
-      pg.bcast = true;
-      pg.topo = topo;
-      pg.groups = std::move(groups);
-      ce->pending_gets.emplace(cookie, std::move(pg));
-    }
-    std::vector<uint8_t> f = frame_begin(MSG_GET);
-    Writer w{f};
-    w.u64(src_handle);
-    w.u64(cookie);
-    frame_finish(f);
-    ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
-    comm_post(ce, from, std::move(f));
+    PendingGet pg;
+    pg.tp_id = tp_id;
+    pg.flow_idx = flow_idx;
+    pg.targets_bytes = std::move(my_targets);
+    pg.pk = pk;
+    pg.bcast = true;
+    pg.topo = topo;
+    pg.groups = std::move(groups);
+    send_rendezvous_pull(ce, from, src_handle, std::move(pg));
     return;
   }
   /* inline payload: forward FIRST (latency: children deliver while we
@@ -779,8 +780,8 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
      * rides the device fabric (ICI) instead of this host transport */
     void *ptr = nullptr;
     int64_t real = 0;
-    int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user,
-                                              (int64_t)src_handle,
+    int64_t tag = (int64_t)(src_handle & ~DP_HANDLE_FLAG);
+    int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user, tag,
                                               (int32_t)from, &ptr, &real)
                               : -1;
     if (n < 0 || !ptr) {
@@ -794,7 +795,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     w.u64((uint64_t)n);    /* bytes on this wire (== real, or a token) */
     w.raw(ptr, (size_t)n);
     if (ctx->dp_serve_done)
-      ctx->dp_serve_done(ctx->dp_user, (int64_t)src_handle);
+      ctx->dp_serve_done(ctx->dp_user, tag);
   }
   frame_finish(f);
   ce->gets_served.fetch_add(1, std::memory_order_relaxed);
@@ -848,11 +849,11 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
     }
     if (tag > 0) {
       std::lock_guard<std::mutex> g(ce->lock);
-      MemReg &m = ce->mem_reg[(uint64_t)tag];
+      fh = (uint64_t)tag | DP_HANDLE_FLAG;
+      MemReg &m = ce->mem_reg[fh];
       m.pk = PK_DEVICE;
       m.expected += (int32_t)nframes;
       fpk = PK_DEVICE;
-      fh = (uint64_t)tag;
     } else if (plen == real_len) {
       std::lock_guard<std::mutex> g(ce->lock);
       fh = ce->next_handle++;
@@ -1233,14 +1234,15 @@ void ptc_comm_send_activate_batch(
   if (!has_payload) {
     w.u8(PK_NONE);
   } else if (dp_tag > 0) {
+    uint64_t dp_h = (uint64_t)dp_tag | DP_HANDLE_FLAG;
     {
       std::lock_guard<std::mutex> g(ce->lock);
-      MemReg &m = ce->mem_reg[(uint64_t)dp_tag];
+      MemReg &m = ce->mem_reg[dp_h];
       m.pk = PK_DEVICE;
       m.expected++;
     }
     w.u8(PK_DEVICE);
-    w.u64((uint64_t)dp_tag);
+    w.u64(dp_h);
     w.u64((uint64_t)copy->size);
   } else if (big) {
     /* host rendezvous: register a snapshot once per copy (fan-out ranks
@@ -1348,14 +1350,15 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
         tag = ctx->dp_register(ctx->dp_user, copy->handle,
                                copy->version.load(), copy->size);
     if (tag > 0) {
+      uint64_t dp_h = (uint64_t)tag | DP_HANDLE_FLAG;
       {
         std::lock_guard<std::mutex> g(ce->lock);
-        MemReg &m = ce->mem_reg[(uint64_t)tag];
+        MemReg &m = ce->mem_reg[dp_h];
         m.pk = PK_DEVICE;
         m.expected += (int32_t)nframes;
       }
       bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
-                   PK_DEVICE, (uint64_t)tag, nullptr, plen);
+                   PK_DEVICE, dp_h, nullptr, plen);
       return;
     }
     ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshotting */

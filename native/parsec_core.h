@@ -291,9 +291,11 @@ void ptc_set_copy_sync_cb(ptc_context_t *ctx, ptc_copy_sync_cb cb,
  *       mirror).  For byte serves, real == returned size.
  *   dp_deliver(ptr, size, tag) -> device-cache uid for the delivered
  *                                 payload (stamped on the new host copy)
- *   dp_bound(uid, ptr, size) -> called after the consumer-side host copy
- *       exists, so the device layer can bind its mirror's host buffer
- *       (lazy coherence for by-reference deliveries)
+ *   dp_bound(uid, ptr, size, host_valid) -> called after the consumer-
+ *       side host copy exists, so the device layer can bind it as the
+ *       mirror's writeback target.  host_valid=0 means the buffer was
+ *       never written (by-reference delivery): the binding MUST mark the
+ *       mirror dirty so host reads materialize it via the coherence pull.
  */
 typedef int64_t (*ptc_dp_register_cb)(void *user, int64_t copy_handle,
                                       int64_t version, int64_t size);

@@ -137,8 +137,10 @@ def maybe_sync_copy(cptr) -> None:
 
 _DP_LOCK = threading.Lock()
 _DP_STATE = {"next_tag": 1}
-# tag -> [device array, refcount, key]; tags are shared per
-# (copy_handle, version) across send batches so a fan-out pins ONE array
+# tag -> [device array, refcount, key, raw]; tags are shared per
+# (copy_handle, version) across send batches so a fan-out pins ONE array;
+# `raw` (flat-uint8 mirror) travels with by-ref handoffs so relayed
+# payloads keep their reinterpret-at-stage-in semantics
 _DP_REG: Dict[int, list] = {}
 _DP_BY_KEY: Dict[tuple, int] = {}
 _DP_SERVING: Dict[int, object] = {}  # tag -> host bytes pinned during serve

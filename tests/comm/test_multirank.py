@@ -131,3 +131,17 @@ def test_bcast_rendezvous_device_resident(topo):
     materialized (PK_DEVICE rendezvous reaches broadcasts too)."""
     _run_spmd(_workers.ptg_bcast_rendezvous_topo, 3, timeout=150.0,
               topo=topo, device=True)
+
+
+def test_ring_attention_2ranks():
+    _run_spmd(_workers.ring_attention_spmd, 2, timeout=150.0)
+
+
+def test_ring_attention_4ranks():
+    _run_spmd(_workers.ring_attention_spmd, 4, timeout=150.0)
+
+
+def test_ring_attention_2ranks_device():
+    """K/V hops between ranks with device-resident production: the blocks
+    travel via the PK_DEVICE data plane."""
+    _run_spmd(_workers.ring_attention_spmd, 2, timeout=150.0, device=True)
