@@ -1,0 +1,104 @@
+/* lockfree.h — lock-free containers for the scheduler hot path.
+ *
+ * Reference analog: parsec/hbbuffer.c + parsec/class/lifo.h — the
+ * local-queue schedulers' work-stealing structures (SURVEY.md §2.1
+ * "barrier, backoff, maxheap, hbbuffer").  Rebuilt here as a Chase–Lev
+ * work-stealing deque: the owner pushes/pops at the bottom with plain
+ * loads/stores, thieves race a CAS at the top.  Memory ordering follows
+ * Lê/Pop/Cohen-Fradet, "Correct and Efficient Work-Stealing for Weak
+ * Memory Models" (PPoPP'13).
+ */
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <vector>
+
+template <typename T> class WSDeque {
+  struct Buf {
+    int64_t cap, mask;
+    std::atomic<T> *a;
+    explicit Buf(int64_t c)
+        : cap(c), mask(c - 1), a(new std::atomic<T>[(size_t)c]) {}
+    ~Buf() { delete[] a; }
+    T get(int64_t i) const {
+      return a[i & mask].load(std::memory_order_relaxed);
+    }
+    void put(int64_t i, T v) {
+      a[i & mask].store(v, std::memory_order_relaxed);
+    }
+  };
+  std::atomic<int64_t> top_{0}, bottom_{0};
+  std::atomic<Buf *> buf_;
+  std::vector<Buf *> retired_; /* grown-out buffers: freed at dtor only —
+                                  a stalled thief may still read them */
+
+public:
+  explicit WSDeque(int64_t cap = 256) : buf_(new Buf(cap)) {}
+  WSDeque(const WSDeque &) = delete;
+  WSDeque &operator=(const WSDeque &) = delete;
+  ~WSDeque() {
+    delete buf_.load(std::memory_order_relaxed);
+    for (Buf *b : retired_)
+      delete b;
+  }
+
+  /* owner thread only */
+  void push(T v) {
+    int64_t b = bottom_.load(std::memory_order_relaxed);
+    int64_t t = top_.load(std::memory_order_acquire);
+    Buf *a = buf_.load(std::memory_order_relaxed);
+    if (b - t > a->cap - 1) {
+      Buf *na = new Buf(a->cap * 2);
+      for (int64_t i = t; i < b; i++)
+        na->put(i, a->get(i));
+      retired_.push_back(a);
+      buf_.store(na, std::memory_order_release);
+      a = na;
+    }
+    a->put(b, v);
+    std::atomic_thread_fence(std::memory_order_release);
+    bottom_.store(b + 1, std::memory_order_relaxed);
+  }
+
+  /* owner thread only; returns T{} when empty */
+  T pop() {
+    int64_t b = bottom_.load(std::memory_order_relaxed) - 1;
+    Buf *a = buf_.load(std::memory_order_relaxed);
+    bottom_.store(b, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t t = top_.load(std::memory_order_relaxed);
+    T v{};
+    if (t <= b) {
+      v = a->get(b);
+      if (t == b) {
+        /* last element: race the thieves for it */
+        if (!top_.compare_exchange_strong(t, t + 1,
+                                          std::memory_order_seq_cst,
+                                          std::memory_order_relaxed))
+          v = T{};
+        bottom_.store(b + 1, std::memory_order_relaxed);
+      }
+    } else {
+      bottom_.store(b + 1, std::memory_order_relaxed);
+    }
+    return v;
+  }
+
+  /* any thread; returns T{} when empty or lost the race */
+  T steal() {
+    int64_t t = top_.load(std::memory_order_acquire);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    int64_t b = bottom_.load(std::memory_order_acquire);
+    T v{};
+    if (t < b) {
+      Buf *a = buf_.load(std::memory_order_acquire);
+      v = a->get(t);
+      if (!top_.compare_exchange_strong(t, t + 1,
+                                        std::memory_order_seq_cst,
+                                        std::memory_order_relaxed))
+        return T{};
+    }
+    return v;
+  }
+};

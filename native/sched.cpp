@@ -6,6 +6,7 @@
  * ptc_context_set_scheduler:
  *
  *   lfq  per-worker deque, LIFO local pop, FIFO steal    (default; ref lfq)
+ *   lws  lock-free Chase-Lev work stealing + inject queue (ref hbbuffer)
  *   ll   per-worker LIFO + LIFO steal                    (ref ll)
  *   ltq  per-worker priority heap + steal                (ref ltq maxheap)
  *   pbq  per-worker FIFO "NUMA" queues + steal           (ref pbq, flat)
@@ -18,10 +19,72 @@
 
 #include "runtime_internal.h"
 
+#include "lockfree.h"
+
 #include <algorithm>
 #include <random>
 
 namespace {
+
+/* set by select(w): which scheduler INSTANCE's deque w this thread owns.
+ * schedule() uses the pair to tell owner pushes (lock-free bottom push)
+ * from external producers — the main thread (startup/DTD insert), the
+ * comm thread, device managers, and workers of OTHER contexts in the
+ * same process — which all go through the inject queue. */
+thread_local const void *tls_owner = nullptr;
+thread_local int tls_worker = -1;
+
+/* lws: per-worker Chase–Lev deque + multi-producer inject queue
+ * (reference analog: hbbuffer local queues + system queue, SURVEY §2.4
+ * sched lfq).  Owner pop is LIFO (cache warmth), steals are FIFO. */
+struct SchedLWS : Scheduler {
+  std::vector<WSDeque<ptc_task *> *> dq;
+  std::mutex inj_lock;
+  std::deque<ptc_task *> inj; /* external producers */
+  std::atomic<int64_t> inj_count{0}; /* lock-free emptiness check */
+  void install(int n) override {
+    for (auto *d : dq)
+      delete d;
+    dq.clear();
+    for (int i = 0; i < std::max(1, n); i++)
+      dq.push_back(new WSDeque<ptc_task *>());
+  }
+  ~SchedLWS() override {
+    for (auto *d : dq)
+      delete d;
+  }
+  void schedule(int w, ptc_task *t) override {
+    int n = (int)dq.size();
+    if (w >= 0 && w < n && tls_owner == this && tls_worker == w) {
+      dq[(size_t)w]->push(t);
+      return;
+    }
+    std::lock_guard<std::mutex> g(inj_lock);
+    inj.push_back(t);
+    inj_count.fetch_add(1, std::memory_order_release);
+  }
+  ptc_task *select(int w) override {
+    int n = (int)dq.size();
+    tls_owner = this;
+    tls_worker = w % n;
+    ptc_task *t = dq[(size_t)(w % n)]->pop();
+    if (t) return t;
+    if (inj_count.load(std::memory_order_acquire) > 0) {
+      std::lock_guard<std::mutex> g(inj_lock);
+      if (!inj.empty()) {
+        t = inj.front();
+        inj.pop_front();
+        inj_count.fetch_sub(1, std::memory_order_relaxed);
+        return t;
+      }
+    }
+    for (int i = 1; i < n; i++) {
+      t = dq[(size_t)((w + i) % n)]->steal();
+      if (t) return t;
+    }
+    return nullptr;
+  }
+};
 
 /* ---------------- per-worker family ---------------- */
 
@@ -253,7 +316,7 @@ struct SchedRND : Scheduler {
  * exposed so callers/tests can observe which module actually runs */
 const char *ptc_sched_canonical(const char *name) {
   static const char *known[] = {"gd", "ap",  "ll",  "ltq", "pbq",
-                                "ip", "spq", "rnd", "lfq"};
+                                "ip", "spq", "rnd", "lfq", "lws"};
   if (name) {
     std::string n(name);
     if (n == "lhq") return "pbq";
@@ -264,6 +327,7 @@ const char *ptc_sched_canonical(const char *name) {
 }
 
 Scheduler *ptc_sched_create(const std::string &name) {
+  if (name == "lws") return new SchedLWS();
   if (name == "gd") return new SchedGD();
   if (name == "ap") return new SchedAP();
   if (name == "ll") return new SchedLL();

@@ -142,7 +142,14 @@ set_param = params.set
 dump_help = params.dump_help
 
 # core runtime knobs (mirrors of the reference's most-used MCA params)
-register("runtime.sched", "lfq", str,
+# default backed by the bench.py --ep matrix (BASELINE.md): the
+# lock-free Chase-Lev lws beats the mutex-deque lfq at every worker
+# count measured (2026-07-29).  Caveat recorded there too: the matrix
+# ran on a 1-core container (timesharing, x86-TSO); the orderings follow
+# the PPoPP'13 Chase-Lev paper and the full suite soaks on lws, but true
+# multi-core contention has not been measured yet.  lfq stays one flag
+# away (PTC_MCA_runtime_sched=lfq).
+register("runtime.sched", "lws", str,
          "scheduler module (reference: --mca sched <m>)")
 register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")

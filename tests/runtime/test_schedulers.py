@@ -9,9 +9,9 @@ import parsec_tpu as pt
 from .chain_util import chain_task_class
 
 # requested name -> canonical module that must actually run
-SCHEDULERS = {"lfq": "lfq", "ll": "ll", "gd": "gd", "ap": "ap",
-              "ltq": "ltq", "pbq": "pbq", "lhq": "pbq", "ip": "ip",
-              "spq": "spq", "rnd": "rnd"}
+SCHEDULERS = {"lfq": "lfq", "lws": "lws", "ll": "ll", "gd": "gd",
+              "ap": "ap", "ltq": "ltq", "pbq": "pbq", "lhq": "pbq",
+              "ip": "ip", "spq": "spq", "rnd": "rnd"}
 
 
 def test_unknown_scheduler_falls_back_to_lfq():
