@@ -500,10 +500,108 @@ static void deliver_dep(ptc_context *ctx, int worker, ptc_taskpool *tp,
 
 } // namespace
 
+namespace {
+
+/* dense-engine promoted-slot sentinel (never a valid heap pointer) */
+DepEntry *const DENSE_PROMOTED = reinterpret_cast<DepEntry *>(1);
+
+/* first touch of a dependency entry: compute how many task-inputs this
+ * instance expects, per consumer flow (exact over-delivery detection) */
+static void init_dep_entry(ptc_context *ctx, ptc_taskpool *tp,
+                           const TaskClass &tc,
+                           const std::vector<int64_t> &params, DepEntry &e) {
+  int64_t locals[PTC_MAX_LOCALS] = {0};
+  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+    locals[tc.range_locals[(size_t)i]] = params[i];
+  fill_derived_locals(ctx, tp, tc, locals);
+  e.remaining = count_task_inputs(ctx, tp, tc, locals, e.flow_remaining);
+  e.initialized = true;
+}
+
+/* one delivery applied to an entry (shared by both engines).  Returns
+ * 0 = keep waiting, 1 = fire the task, -1 = duplicate (dropped). */
+static int apply_delivery(ptc_context *ctx, const TaskClass &tc, DepEntry &e,
+                          int32_t flow_idx, ptc_copy *copy) {
+  if (flow_idx >= 0 && flow_idx < PTC_MAX_FLOWS) {
+    if (e.flow_remaining[flow_idx] <= 0) {
+      /* this flow already received every delivery it expects: duplicate
+       * (over-delivering output dep, or a comm-layer re-delivery).
+       * Dropping it instead of decrementing keeps the task from firing
+       * with a missing input on another flow. */
+      std::fprintf(stderr,
+                   "ptc: duplicate dependency delivery to %s flow %d; "
+                   "ignored\n", tc.name.c_str(), flow_idx);
+      return -1;
+    }
+    e.flow_remaining[flow_idx] -= 1;
+  }
+  if (copy && flow_idx >= 0 && flow_idx < PTC_MAX_FLOWS) {
+    copy_retain(copy);
+    if (e.staged[flow_idx]) copy_release(ctx, e.staged[flow_idx]);
+    e.staged[flow_idx] = copy;
+  }
+  e.remaining -= 1;
+  return e.remaining == 0 ? 1 : 0;
+}
+
+/* linearized slot index within the class's bounding box, or -1 */
+static int64_t dense_index(const DenseDeps &dd,
+                           const std::vector<int64_t> &params) {
+  if (params.size() != dd.lo.size()) return -1;
+  int64_t idx = 0;
+  for (size_t i = 0; i < params.size(); i++) {
+    int64_t d = params[i] - dd.lo[i];
+    if (d < 0 || d >= dd.span[i]) return -1;
+    idx = idx * dd.span[i] + d;
+  }
+  return idx;
+}
+
+} // namespace
+
 void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
                            int32_t class_id, std::vector<int64_t> &&params,
                            int32_t flow_idx, ptc_copy *copy) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
+
+  /* dense engine: O(1) slot in the class's bounding box (reference:
+   * parsec_default_find_deps over the dense deps array vs
+   * parsec_hash_find_deps, parsec_internal.h:343-346) */
+  if ((size_t)class_id < tp->dense.size() &&
+      tp->dense[(size_t)class_id].enabled) {
+    DenseDeps &dd = tp->dense[(size_t)class_id];
+    int64_t sidx = dense_index(dd, params);
+    if (sidx >= 0) {
+      DepShard &shard = tp->shards[(size_t)(sidx % NB_SHARDS)];
+      ptc_task *ready = nullptr;
+      {
+        std::lock_guard<std::mutex> g(shard.lock);
+        DepEntry *e = dd.slots[sidx].load(std::memory_order_relaxed);
+        if (e == DENSE_PROMOTED) {
+          std::fprintf(stderr, "ptc: duplicate dependency delivery to "
+                               "already-fired %s; ignored\n",
+                       tc.name.c_str());
+          return;
+        }
+        if (!e) {
+          e = new DepEntry();
+          init_dep_entry(ctx, tp, tc, params, *e);
+          dd.slots[sidx].store(e, std::memory_order_relaxed);
+        }
+        int rc = apply_delivery(ctx, tc, *e, flow_idx, copy);
+        if (rc < 0) return;
+        if (rc > 0) {
+          ready = make_task(ctx, tp, tc, params, e->staged);
+          delete e;
+          dd.slots[sidx].store(DENSE_PROMOTED, std::memory_order_relaxed);
+        }
+      }
+      if (ready) ptc_schedule_task(ctx, worker, ready);
+      return;
+    }
+    /* out-of-box instance (shouldn't happen): hash path below is exact */
+  }
+
   DepKey key{class_id, ptc_fnv_hash(class_id, params), std::move(params)};
   DepShard &shard = tp->shards[key.hash % NB_SHARDS];
 
@@ -517,36 +615,10 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
       return;
     }
     DepEntry &e = shard.map[key];
-    if (!e.initialized) {
-      /* first touch: compute how many task-inputs this instance expects,
-       * per consumer flow (exact over-delivery detection below) */
-      int64_t locals[PTC_MAX_LOCALS] = {0};
-      for (size_t i = 0; i < tc.range_locals.size() && i < key.params.size(); i++)
-        locals[tc.range_locals[(size_t)i]] = key.params[i];
-      fill_derived_locals(ctx, tp, tc, locals);
-      e.remaining = count_task_inputs(ctx, tp, tc, locals, e.flow_remaining);
-      e.initialized = true;
-    }
-    if (flow_idx >= 0 && flow_idx < PTC_MAX_FLOWS) {
-      if (e.flow_remaining[flow_idx] <= 0) {
-        /* this flow already received every delivery it expects: duplicate
-         * (over-delivering output dep, or a comm-layer re-delivery).
-         * Dropping it instead of decrementing keeps the task from firing
-         * with a missing input on another flow. */
-        std::fprintf(stderr,
-                     "ptc: duplicate dependency delivery to %s flow %d; "
-                     "ignored\n", tc.name.c_str(), flow_idx);
-        return;
-      }
-      e.flow_remaining[flow_idx] -= 1;
-    }
-    if (copy && flow_idx >= 0 && flow_idx < PTC_MAX_FLOWS) {
-      copy_retain(copy);
-      if (e.staged[flow_idx]) copy_release(ctx, e.staged[flow_idx]);
-      e.staged[flow_idx] = copy;
-    }
-    e.remaining -= 1;
-    if (e.remaining == 0) {
+    if (!e.initialized) init_dep_entry(ctx, tp, tc, key.params, e);
+    int rc = apply_delivery(ctx, tc, e, flow_idx, copy);
+    if (rc < 0) return;
+    if (rc > 0) {
       /* refs transfer to the task; the entry is erased and only a
        * bounded, full-key recent-promotions record remains */
       ready = make_task(ctx, tp, tc, key.params, e.staged);
@@ -1179,6 +1251,11 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
   int nb_locals = (int)tc.locals.size();
   const int64_t *g = tp->globals.data();
   int64_t locals[PTC_MAX_LOCALS] = {0};
+  /* bounding box over ALL instances (pre-affinity: remote deliveries
+   * target local tasks, a superset box is always safe) — feeds the
+   * dense dependency engine (parsec_internal.h:201-216 analog) */
+  std::vector<int64_t> bmin(nb_range, INT64_MAX), bmax(nb_range, INT64_MIN);
+  int64_t visited = 0;
 
   /* odometer over range locals, honoring declaration order so later ranges
    * may reference earlier locals (incl. derived ones in between) */
@@ -1201,6 +1278,12 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
 
   auto visit = [&]() {
     fill_derived_locals(ctx, tp, tc, locals);
+    visited++;
+    for (size_t i = 0; i < nb_range; i++) {
+      int64_t v = locals[tc.range_locals[i]];
+      if (v < bmin[i]) bmin[i] = v;
+      if (v > bmax[i]) bmax[i] = v;
+    }
     /* affinity filter (owner-computes; reference ": desc(m,n)" placement) */
     if (tc.aff_dc >= 0 && ctx->nodes > 1) {
       int64_t idx[PTC_MAX_LOCALS];
@@ -1223,28 +1306,51 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
     visit();
     return;
   }
-  /* init all ranges; empty range -> no tasks */
-  size_t level = 0;
-  if (!init_range(0)) return;
-  while (true) {
-    if (level + 1 < nb_range) {
-      if (init_range(level + 1)) {
-        level++;
-        continue;
-      }
-      /* inner range empty for this outer value: fall through to advance */
-    } else {
-      visit();
-    }
-    /* advance deepest live level */
+  auto walk = [&]() {
+    /* init all ranges; empty range -> no tasks */
+    size_t level = 0;
+    if (!init_range(0)) return;
     while (true) {
-      R &r = rs[level];
-      r.cur += r.st;
-      locals[tc.range_locals[level]] = r.cur;
-      bool live = (r.st > 0) ? r.cur <= r.hi : r.cur >= r.hi;
-      if (live) break;
-      if (level == 0) return;
-      level--;
+      if (level + 1 < nb_range) {
+        if (init_range(level + 1)) {
+          level++;
+          continue;
+        }
+        /* inner range empty for this outer value: fall through to advance */
+      } else {
+        visit();
+      }
+      /* advance deepest live level */
+      while (true) {
+        R &r = rs[level];
+        r.cur += r.st;
+        locals[tc.range_locals[level]] = r.cur;
+        bool live = (r.st > 0) ? r.cur <= r.hi : r.cur >= r.hi;
+        if (live) break;
+        if (level == 0) return;
+        level--;
+      }
+    }
+  };
+  walk();
+  /* enable the dense dependency engine when the class's instances fit a
+   * bounded box (auto-chosen; PTC_MCA_deptable_dense_max=0 disables) */
+  if (visited > 0 && (size_t)tc.id < tp->dense.size()) {
+    DenseDeps &dd = tp->dense[(size_t)tc.id];
+    int64_t prod = 1;
+    bool ok = ctx->dense_max_slots > 0;
+    std::vector<int64_t> span(nb_range);
+    for (size_t i = 0; ok && i < nb_range; i++) {
+      span[i] = bmax[i] - bmin[i] + 1;
+      if (span[i] <= 0 || prod > ctx->dense_max_slots / span[i]) ok = false;
+      else prod *= span[i];
+    }
+    if (ok && prod <= ctx->dense_max_slots) {
+      dd.lo = std::move(bmin);
+      dd.span = std::move(span);
+      dd.nb_slots = prod;
+      dd.slots.reset(new std::atomic<DepEntry *>[(size_t)prod]());
+      dd.enabled = true;
     }
   }
 }
@@ -1314,6 +1420,12 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
     ctx->prof.push_back(new ProfBuf());
     ctx->worker_executed.push_back(new std::atomic<int64_t>(0));
   }
+  if (const char *e = std::getenv("PTC_MCA_deptable_dense_max"))
+    ctx->dense_max_slots = std::atoll(e);
+  /* the weak-hash sanitizer targets the HASH engine: force it (same
+   * value parse as ptc_fnv_hash — "0" means off) */
+  if (const char *wh = std::getenv("PTC_DEBUG_WEAK_HASH"))
+    if (*wh && *wh != '0') ctx->dense_max_slots = 0;
   return ctx;
 }
 
@@ -1446,6 +1558,16 @@ void ptc_tp_destroy(ptc_taskpool_t *tp) {
         if (kv.second.staged[f]) copy_release(tp->ctx, kv.second.staged[f]);
     shard.map.clear();
   }
+  for (DenseDeps &dd : tp->dense) {
+    if (!dd.enabled) continue;
+    for (int64_t i = 0; i < dd.nb_slots; i++) {
+      DepEntry *e = dd.slots[i].load(std::memory_order_relaxed);
+      if (!e || e == DENSE_PROMOTED) continue;
+      for (int f = 0; f < PTC_MAX_FLOWS; f++)
+        if (e->staged[f]) copy_release(tp->ctx, e->staged[f]);
+      delete e;
+    }
+  }
   delete tp;
 }
 
@@ -1461,11 +1583,19 @@ int32_t ptc_tp_add_class(ptc_taskpool_t *tp, const char *name,
 
 int32_t ptc_tp_id(ptc_taskpool_t *tp) { return tp->id; }
 
+int32_t ptc_tp_dense_classes(ptc_taskpool_t *tp) {
+  int32_t n = 0;
+  for (const DenseDeps &dd : tp->dense)
+    if (dd.enabled) n++;
+  return n;
+}
+
 int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp) {
   bool expected = false;
   if (!tp->added.compare_exchange_strong(expected, true)) return -1;
   ctx->active_tps.fetch_add(1);
   StartupStats st;
+  tp->dense.resize(tp->classes.size());
   for (const TaskClass &tc : tp->classes) enumerate_class(ctx, tp, tc, st);
   tp->nb_total.store(st.nb_local);
   tp->nb_tasks.store(st.nb_local);

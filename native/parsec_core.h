@@ -161,6 +161,10 @@ int32_t ptc_tp_wait(ptc_taskpool_t *tp);
 int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp);       /* remaining local tasks */
 int64_t ptc_tp_nb_total_tasks(ptc_taskpool_t *tp); /* as counted at startup */
 int64_t ptc_tp_nb_errors(ptc_taskpool_t *tp);      /* failed/dropped tasks  */
+/* classes whose dependency tracking runs on the dense-array engine
+ * (auto-chosen at startup when instances fit a bounded box; reference:
+ * parsec_internal.h:201-216 dense vs hash find_deps) */
+int32_t ptc_tp_dense_classes(ptc_taskpool_t *tp);
 /* keep a taskpool alive for dynamic insertion (DTD): while open, reaching
  * zero remaining tasks does not complete it */
 void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open);

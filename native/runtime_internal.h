@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -237,6 +238,24 @@ struct DepEntry {
   ptc_copy *staged[PTC_MAX_FLOWS] = {nullptr};
 };
 
+/* Dense dependency engine (reference: the per-task-class choice between
+ * a dense multi-dim dependency array and a hash table,
+ * parsec/parsec_internal.h:201-216 + parsec_default_find_deps:343):
+ * when startup enumeration finds a class's instances fit a bounded box,
+ * deliveries index an O(1) slot array instead of the sharded hash —
+ * no key allocation, no hashing, no map rebalance on the hot path.
+ * Slot values: nullptr (untouched) / live DepEntry* / PROMOTED sentinel
+ * (exact duplicate detection for the WHOLE run, memory already paid by
+ * the slot array).  Slots are guarded by the taskpool's shard mutexes,
+ * striped by slot index. */
+struct DepEntry;
+struct DenseDeps {
+  bool enabled = false;
+  std::vector<int64_t> lo, span; /* per range-local bounding box */
+  int64_t nb_slots = 0;
+  std::unique_ptr<std::atomic<DepEntry *>[]> slots;
+};
+
 struct DepShard {
   std::mutex lock;
   std::unordered_map<DepKey, DepEntry, DepKeyHash> map;
@@ -320,6 +339,7 @@ struct ptc_taskpool {
   ptc_tp_complete_cb complete_cb = nullptr; /* compose/recursive seam */
   void *complete_user = nullptr;
   DepShard shards[NB_SHARDS];
+  std::vector<DenseDeps> dense; /* per class; enabled by enumeration */
   std::mutex done_lock;
   std::condition_variable done_cv;
   /* DTD insertion-window throttle; drain_waiters gates the notify in the
@@ -343,6 +363,9 @@ struct ptc_context {
   std::atomic<bool> shutdown{false};
   Scheduler *sched = nullptr;
   std::string sched_name = "lfq";
+  /* dense dep engine budget (slots per class); 0 disables.  Env:
+   * PTC_MCA_deptable_dense_max */
+  int64_t dense_max_slots = 1 << 22;
 
   /* idle-worker parking */
   std::mutex idle_lock;

@@ -131,6 +131,7 @@ _sigs = {
     "ptc_tp_nb_tasks": (C.c_int64, [C.c_void_p]),
     "ptc_tp_nb_total_tasks": (C.c_int64, [C.c_void_p]),
     "ptc_tp_nb_errors": (C.c_int64, [C.c_void_p]),
+    "ptc_tp_dense_classes": (C.c_int32, [C.c_void_p]),
     "ptc_task_fail": (None, [C.c_void_p, C.c_void_p]),
     "ptc_tp_set_open": (None, [C.c_void_p, C.c_int32]),
     "ptc_tp_drain": (C.c_int32, [C.c_void_p]),

@@ -108,6 +108,12 @@ class Taskpool:
     def nb_total_tasks(self) -> int:
         return N.lib.ptc_tp_nb_total_tasks(self._ptr)
 
+    @property
+    def dense_classes(self) -> int:
+        """Task classes whose dependency tracking runs on the dense-array
+        engine (auto-chosen; reference: parsec_internal.h:201-216)."""
+        return N.lib.ptc_tp_dense_classes(self._ptr)
+
     def set_open(self, open_: bool):
         N.lib.ptc_tp_set_open(self._ptr, 1 if open_ else 0)
 

@@ -1,0 +1,98 @@
+"""Dense-array dependency engine (VERDICT r2 item 6; reference: the
+per-task-class dense-vs-hash find_deps choice, parsec_internal.h:201-216,
+343-346): startup enumeration derives each class's bounding box and
+affine-range classes get O(1) slot lookup; irregular/oversized classes
+stay on the sharded hash engine.  Results must be identical."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.algos import build_potrf
+from parsec_tpu.data import TwoDimBlockCyclic
+
+
+def test_dense_engine_selected_for_potrf():
+    N, nb = 128, 16
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((N, N), dtype=np.float32)
+    spd = M @ M.T + N * np.eye(N, dtype=np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(spd)
+        A.register(ctx, "A")
+        tp = build_potrf(ctx, A)
+        tp.run()
+        tp.wait()
+        # all four classes are affine boxes: every one runs dense
+        assert tp.dense_classes == 4, tp.dense_classes
+        np.testing.assert_allclose(np.tril(A.to_dense()),
+                                   np.linalg.cholesky(spd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_dense_duplicate_detection_whole_run():
+    """Promoted slots keep an exact duplicate record for the whole run
+    (the hash engine's bounded FIFO can forget; the dense sentinel
+    cannot) — chain results must be exact and every task fire once."""
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": 2000})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                arena="t")
+        seen = set()
+
+        def body(t):
+            kk = t.local("k")
+            assert kk not in seen
+            seen.add(kk)
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        assert tp.dense_classes == 1
+        assert len(seen) == 2001
+
+
+_ENV_SCRIPT = r"""
+import parsec_tpu as pt
+with pt.Context(nb_workers=1) as ctx:
+    ctx.register_arena("t", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": 50})
+    k = pt.L("k")
+    tc = tp.task_class("Task")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref("Task", k - 1, flow="A")),
+            pt.Out(pt.Ref("Task", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+            arena="t")
+    tc.body_noop()
+    tp.run()
+    tp.wait()
+    print("DENSE=%d" % tp.dense_classes)
+"""
+
+
+def _run_env(**env):
+    e = dict(os.environ, JAX_PLATFORMS="cpu", **env)
+    r = subprocess.run([sys.executable, "-c", _ENV_SCRIPT], env=e,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    return r.stdout
+
+
+def test_dense_opt_out_env():
+    assert "DENSE=0" in _run_env(PTC_MCA_deptable_dense_max="0")
+    assert "DENSE=1" in _run_env()
+    # the weak-hash sanitizer must exercise the HASH engine
+    assert "DENSE=0" in _run_env(PTC_DEBUG_WEAK_HASH="1")
