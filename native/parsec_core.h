@@ -328,7 +328,13 @@ int32_t ptc_copy_is_persistent(ptc_copy_t *c);
 int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port);
 /* flush queued sends + wait for every peer's matching fence: after this,
  * all messages sent before any rank's fence have been applied everywhere */
+/* returns 0 on quiescence, -1 on timeout (PTC_MCA_comm_fence_timeout_s,
+ * default 120, 0 = infinite) or peer loss */
 int32_t ptc_comm_fence(ptc_context_t *ctx);
+/* counting termination detection (fourcounter analog): double wave of
+ * (app msgs sent, received, idle).  tp limits the idle predicate to one
+ * pool (NULL = context-wide).  Same error contract as the fence. */
+int32_t ptc_comm_quiesce(ptc_context_t *ctx, ptc_taskpool_t *tp);
 /* activation-broadcast topology: 0 star (direct per-rank sends, default),
  * 1 chain pipeline, 2 binomial tree (reference: runtime_comm_coll_bcast) */
 void ptc_comm_set_topology(ptc_context_t *ctx, int32_t topo);

@@ -180,6 +180,7 @@ _sigs = {
     "ptc_context_get_scheduler": (C.c_char_p, [C.c_void_p]),
     "ptc_comm_init": (C.c_int32, [C.c_void_p, C.c_int32]),
     "ptc_comm_fence": (C.c_int32, [C.c_void_p]),
+    "ptc_comm_quiesce": (C.c_int32, [C.c_void_p, C.c_void_p]),
     "ptc_comm_set_topology": (None, [C.c_void_p, C.c_int32]),
     "ptc_comm_fini": (C.c_int32, [C.c_void_p]),
     "ptc_comm_enabled": (C.c_int32, [C.c_void_p]),

@@ -481,3 +481,84 @@ def ring_attention_spmd(rank: int, nodes: int, port: int, S: int = 4,
             assert dev.stats["tasks"] > 0, dev.stats
             dev.stop()
         ctx.comm_fini()
+
+
+def dtd_chain_counting_termdet(rank: int, nodes: int, port: int,
+                               nb_tiles: int = 4, rounds: int = 6,
+                               device: bool = False):
+    """Distributed DTD quiesced by the COUNTING termdet module instead of
+    the fence (reference: fourcounter global TD for DSLs that cannot
+    count tasks a priori, termdet_fourcounter.h:16-59) — with optional
+    device-async completion (device chores complete from the manager
+    thread while the wave runs)."""
+    if device:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.dsl.dtd import DtdTaskpool
+    dev = None
+    if device:
+        from parsec_tpu.device import TpuDevice
+
+        dev = TpuDevice(ctx)
+    with ctx:
+        datas = [ctx.data(i, np.zeros(4, dtype=np.float32))
+                 for i in range(nb_tiles)]
+        dtp = DtdTaskpool(ctx, window=64)
+        tiles = [dtp.tile_of(d, owner=i % nodes)
+                 for i, d in enumerate(datas)]
+
+        def step(view):
+            src = view.data(0, dtype=np.float32)
+            dst = view.data(1, dtype=np.float32)
+            dst[0] = src[0] + 1.0
+
+        for _ in range(rounds):
+            for t in range(1, nb_tiles):
+                if dev is not None and t % 2 == 0:
+                    dtp.insert_tpu_task(
+                        dev, lambda a, b: a + 1.0,
+                        (tiles[t - 1], "INPUT"), (tiles[t], "INOUT"),
+                        shapes={0: (4,), 1: (4,)}, dtype=np.float32)
+                else:
+                    dtp.insert_task(step, (tiles[t - 1], "INPUT"),
+                                    (tiles[t], "INOUT"))
+        dtp.wait()
+        ctx.comm_quiesce(dtp.tp)
+        if dev is not None:
+            dev.flush()
+        for i, d in enumerate(datas):
+            if i % nodes == rank and rounds >= nb_tiles:
+                v = np.frombuffer(d.array, dtype=np.float32)[0]
+                assert v == i, (i, v)
+        if dev is not None:
+            dev.stop()
+        dtp.destroy()
+        ctx.comm_fini()
+
+
+def fence_lost_peer(rank: int, nodes: int, port: int):
+    """Rank 1 tears down without fencing (crash stand-in: its connection
+    just closes); rank 0's fence must ERROR (peer-lost detection) instead
+    of spinning forever."""
+    import time
+
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    arr = np.zeros(nodes, dtype=np.int64)
+    ctx.register_linear_collection("A", arr, elem_size=8,
+                                   nodes=nodes, myrank=rank)
+    if rank == 1:
+        time.sleep(1.0)  # let rank 0 reach its fence first
+        ctx.destroy()    # abrupt teardown: no fence, no goodbye
+        return
+    t0 = time.monotonic()
+    try:
+        ctx.comm_fence()
+        raise AssertionError("fence returned despite dead peer")
+    except RuntimeError as e:
+        # fail-FAST detection, not a timeout fallback
+        assert "peer lost" in str(e), e
+        assert time.monotonic() - t0 < 30.0, "detection too slow"
+    finally:
+        ctx.destroy()

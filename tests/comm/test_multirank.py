@@ -145,3 +145,20 @@ def test_ring_attention_2ranks_device():
     """K/V hops between ranks with device-resident production: the blocks
     travel via the PK_DEVICE data plane."""
     _run_spmd(_workers.ring_attention_spmd, 2, timeout=150.0, device=True)
+
+
+def test_dtd_counting_termdet_3ranks():
+    """Distributed DTD quiesced by the counting termdet (fourcounter
+    analog), not the fence."""
+    _run_spmd(_workers.dtd_chain_counting_termdet, 3, timeout=150.0)
+
+
+def test_dtd_counting_termdet_device_async():
+    """Counting termdet with device-async completions in flight."""
+    _run_spmd(_workers.dtd_chain_counting_termdet, 2, timeout=150.0,
+              device=True)
+
+
+def test_fence_errors_on_lost_peer():
+    """A crashed rank fails the survivors' fence instead of hanging it."""
+    _run_spmd(_workers.fence_lost_peer, 2, timeout=120.0)
