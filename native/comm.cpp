@@ -994,6 +994,23 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   }
 }
 
+/* Close a peer connection and mark the rank lost (unless shutting down)
+ * so fences/TD waves fail fast instead of waiting for frames that can
+ * never arrive.  One helper for all three paths — clean FIN, fatal recv
+ * error, desynchronized stream — so loss handling cannot drift. */
+static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
+  if (p.fd >= 0) close(p.fd);
+  p.fd = -1;
+  p.inbuf.clear();
+  p.in_off = 0;
+  if (!ce->stop.load(std::memory_order_acquire)) {
+    std::lock_guard<std::mutex> g(ce->lock);
+    ce->peer_lost[rank] = 1;
+    std::fprintf(stderr, "ptc-comm: rank %u connection lost\n", rank);
+  }
+  ce->fence_cv.notify_all();
+}
+
 /* parse all complete frames in a peer's inbuf */
 static void parse_inbuf(CommEngine *ce, uint32_t rank) {
   TcpPeer &p = ce->tcp.peers[rank];
@@ -1007,10 +1024,7 @@ static void parse_inbuf(CommEngine *ce, uint32_t rank) {
        * rather than misinterpreting payload bytes as frame headers */
       std::fprintf(stderr, "ptc-comm: bad frame length %u from rank %u; "
                            "closing connection\n", body_len, rank);
-      close(p.fd);
-      p.fd = -1;
-      p.inbuf.clear();
-      p.in_off = 0;
+      mark_peer_lost(ce, p, rank);
       return;
     }
     if (avail < 4 + (size_t)body_len) break;
@@ -1082,22 +1096,21 @@ static void comm_main(CommEngine *ce) {
             p.inbuf.insert(p.inbuf.end(), rbuf, rbuf + n);
             if ((size_t)n < sizeof(rbuf)) break;
           } else if (n == 0) {
-            /* peer closed: expected at shutdown, a failure otherwise —
-             * mark it so fences/TD waves error instead of hanging */
-            close(p.fd);
-            p.fd = -1;
-            if (!ce->stop.load(std::memory_order_acquire)) {
-              std::lock_guard<std::mutex> g(ce->lock);
-              ce->peer_lost[r] = 1;
-              std::fprintf(stderr, "ptc-comm: rank %u connection lost\n",
-                           r);
-            }
-            ce->fence_cv.notify_all();
+            /* peer closed (clean FIN): expected at shutdown, a failure
+             * otherwise */
+            mark_peer_lost(ce, p, r);
             break;
           } else {
-            if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+            if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+              /* fatal socket error (ECONNRESET is the usual crash
+               * signature — a dead peer with unread data sends RST, not
+               * FIN): treat exactly like the n==0 close, else the fd
+               * stays polled (POLLERR busy-loop) and fences/TD waves
+               * never see the loss and hang */
               std::fprintf(stderr, "ptc-comm: recv from rank %u: %s\n", r,
                            strerror(errno));
+              mark_peer_lost(ce, p, r);
+            }
             break;
           }
         }

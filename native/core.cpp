@@ -376,12 +376,156 @@ static void fill_derived_locals(ptc_context *ctx, ptc_taskpool *tp,
   }
 }
 
+/* True when the expression references no task locals and no Python
+ * escapes — its value is fixed for the life of the taskpool. */
+static bool expr_pool_const(const Expr &e) {
+  const std::vector<int64_t> &c = e.code;
+  for (size_t i = 0; i < c.size(); i++) {
+    switch (c[i]) {
+    case PTC_OP_LOCAL:
+    case PTC_OP_CALL:
+      return false;
+    case PTC_OP_IMM:
+    case PTC_OP_GLOBAL:
+      i++; /* skip operand */
+      break;
+    default:
+      break;
+    }
+  }
+  return true;
+}
+
+/* stride-range membership: v in {lo, lo+st, ...} bounded by hi */
+static inline bool in_range(int64_t v, int64_t lo, int64_t hi, int64_t st) {
+  if (st > 0) return v >= lo && v <= hi && (v - lo) % st == 0;
+  return v <= lo && v >= hi && (lo - v) % (-st) == 0;
+}
+
+/* Is `params` inside the class's enumerated parameter domain?  The
+ * reference's generated iterate_successors/predecessors bound-check every
+ * peer (jdf2c emits per-param min/max guards around each release), so an
+ * unguarded JDF edge aimed at an out-of-range instance is DROPPED by
+ * language semantics — tests/dsl/ptg/choice/choice.jdf's unguarded
+ * `-> D Choice(k+1)` from TA(NT) relies on exactly this.  Classes whose
+ * bounds depend only on pool globals take a cached-constant fast path
+ * (state: 0 unknown, 3 being decided, 1 cached, 2 dynamic). */
+static bool task_params_in_domain(ptc_context *ctx, ptc_taskpool *tp,
+                                  const TaskClass &tc,
+                                  const int64_t *params, size_t nparams) {
+  size_t nb_range = tc.range_locals.size();
+  if (nparams != nb_range) return false;
+  int nb_locals = (int)tc.locals.size();
+  const int64_t *g = tp->globals.data();
+  int cs = tc.domain_cache_state.load(std::memory_order_acquire);
+  if (cs == 0) {
+    int expected = 0;
+    if (tc.domain_cache_state.compare_exchange_strong(expected, 3)) {
+      bool constb = true;
+      for (size_t i = 0; constb && i < nb_range; i++) {
+        const Local &l = tc.locals[(size_t)tc.range_locals[(size_t)i]];
+        constb = expr_pool_const(l.lo) && expr_pool_const(l.hi) &&
+                 expr_pool_const(l.st);
+      }
+      /* derived locals feeding nothing here: const bounds read none */
+      if (constb) {
+        int64_t zero[PTC_MAX_LOCALS] = {0};
+        tc.domain_lo.resize(nb_range);
+        tc.domain_hi.resize(nb_range);
+        tc.domain_st.resize(nb_range);
+        for (size_t i = 0; i < nb_range; i++) {
+          const Local &l = tc.locals[(size_t)tc.range_locals[(size_t)i]];
+          tc.domain_lo[i] = eval_expr(l.lo, ctx, zero, nb_locals, g);
+          tc.domain_hi[i] = eval_expr(l.hi, ctx, zero, nb_locals, g);
+          int64_t st = eval_expr(l.st, ctx, zero, nb_locals, g, 1);
+          tc.domain_st[i] = st ? st : 1;
+        }
+        tc.domain_cache_state.store(1, std::memory_order_release);
+        cs = 1;
+      } else {
+        tc.domain_cache_state.store(2, std::memory_order_release);
+        cs = 2;
+      }
+    } else {
+      cs = tc.domain_cache_state.load(std::memory_order_acquire);
+    }
+  }
+  if (cs == 1) {
+    for (size_t i = 0; i < nb_range; i++)
+      if (!in_range(params[i], tc.domain_lo[i], tc.domain_hi[i],
+                    tc.domain_st[i]))
+        return false;
+    return true;
+  }
+  /* dynamic bounds (triangular ranges etc.): evaluate in declaration
+   * order with the candidate params bound */
+  int64_t locals[PTC_MAX_LOCALS] = {0};
+  for (size_t i = 0; i < nb_range; i++)
+    locals[tc.range_locals[(size_t)i]] = params[i];
+  fill_derived_locals(ctx, tp, tc, locals);
+  for (size_t i = 0; i < nb_range; i++) {
+    const Local &l = tc.locals[(size_t)tc.range_locals[(size_t)i]];
+    int64_t lo = eval_expr(l.lo, ctx, locals, nb_locals, g);
+    int64_t hi = eval_expr(l.hi, ctx, locals, nb_locals, g);
+    int64_t st = eval_expr(l.st, ctx, locals, nb_locals, g, 1);
+    if (st == 0) st = 1;
+    if (!in_range(params[i], lo, hi, st)) return false;
+  }
+  return true;
+}
+
+static inline bool task_params_in_domain(ptc_context *ctx, ptc_taskpool *tp,
+                                         const TaskClass &tc,
+                                         const std::vector<int64_t> &params) {
+  return task_params_in_domain(ctx, tp, tc, params.data(), params.size());
+}
+
+/* Evaluate a DEP_TASK input dep's producer instance; true when that
+ * producer exists (is in its class's domain).  Scalar-param fast path;
+ * range params (CTL gathers) are checked per expanded instance by the
+ * caller. */
+static bool dep_producer_in_domain(ptc_context *ctx, ptc_taskpool *tp,
+                                   const Dep &d, const int64_t *locals,
+                                   int nb_locals, const int64_t *g) {
+  if (d.peer_class < 0 || (size_t)d.peer_class >= tp->classes.size())
+    return false;
+  const TaskClass &peer = tp->classes[(size_t)d.peer_class];
+  /* stack array, not a vector: this runs per DEP_TASK dep of every task
+   * instance (counting + prepare_input hot paths) */
+  int64_t pv[PTC_MAX_LOCALS];
+  size_t np = d.params.size() < (size_t)PTC_MAX_LOCALS
+                  ? d.params.size() : (size_t)PTC_MAX_LOCALS;
+  for (size_t i = 0; i < np; i++) {
+    if (d.params[i].is_range) return true; /* caller expands + checks */
+    pv[i] = eval_expr(d.params[i].value, ctx, locals, nb_locals, g);
+  }
+  return task_params_in_domain(ctx, tp, peer, pv, np);
+}
+
+/* The input dep selected for a non-CTL flow: the first dep that is
+ * guard-true AND (for task sources) whose producer instance exists —
+ * the reference's implicit range guard on every dep composes with the
+ * explicit guard, so selection falls through to the next alternative. */
+static const Dep *select_input_dep(ptc_context *ctx, ptc_taskpool *tp,
+                                   const Flow &fl, const int64_t *locals,
+                                   int nb_locals, const int64_t *g) {
+  for (const Dep &d : fl.in_deps) {
+    if (!eval_guard(d.guard, ctx, locals, nb_locals, g)) continue;
+    if (d.kind == DEP_TASK &&
+        !dep_producer_in_domain(ctx, tp, d, locals, nb_locals, g))
+      continue;
+    return &d;
+  }
+  return nullptr;
+}
+
 /* Count the task-input dependencies of one task instance: for every non-CTL
- * IN flow the *first* guard-true dep selects the source (JDF alternative
- * semantics); for CTL flows every guard-true input dep counts, expanding
- * ranges (control-gather).  Returns the total number of expected releases
- * and, when `per_flow` is non-null, the expected count per consumer flow
- * (exact duplicate-delivery accounting — see DepEntry). */
+ * IN flow the *first* guard-true dep with an existing producer selects the
+ * source (JDF alternative semantics); for CTL flows every guard-true input
+ * dep counts, expanding ranges (control-gather) and skipping out-of-domain
+ * producers.  Returns the total number of expected releases and, when
+ * `per_flow` is non-null, the expected count per consumer flow (exact
+ * duplicate-delivery accounting — see DepEntry). */
 static int32_t count_task_inputs(ptc_context *ctx, ptc_taskpool *tp,
                                  const TaskClass &tc, const int64_t *locals,
                                  int32_t *per_flow = nullptr) {
@@ -395,24 +539,55 @@ static int32_t count_task_inputs(ptc_context *ctx, ptc_taskpool *tp,
       for (const Dep &d : fl.in_deps) {
         if (d.kind != DEP_TASK) continue;
         if (!eval_guard(d.guard, ctx, locals, nb_locals, g)) continue;
-        int64_t count = 1;
-        for (const DepParam &pm : d.params) {
-          if (!pm.is_range) continue;
-          int64_t lo = eval_expr(pm.lo, ctx, locals, nb_locals, g);
-          int64_t hi = eval_expr(pm.hi, ctx, locals, nb_locals, g);
-          int64_t st = eval_expr(pm.st, ctx, locals, nb_locals, g, 1);
-          if (st == 0) st = 1;
-          int64_t n = st > 0 ? (hi - lo) / st + 1 : (lo - hi) / (-st) + 1;
-          count *= std::max<int64_t>(0, n);
+        const TaskClass &peer = tp->classes[(size_t)d.peer_class];
+        size_t np = d.params.size();
+        std::vector<int64_t> vals(np, 0);
+        std::vector<size_t> range_idx;
+        for (size_t i = 0; i < np; i++) {
+          if (d.params[i].is_range)
+            range_idx.push_back(i);
+          else
+            vals[i] = eval_expr(d.params[i].value, ctx, locals, nb_locals, g);
         }
-        flow_count += (int32_t)count;
+        if (range_idx.empty()) {
+          if (task_params_in_domain(ctx, tp, peer, vals)) flow_count += 1;
+          continue;
+        }
+        /* odometer over range params, domain-checking each producer */
+        struct R { int64_t lo, hi, st, cur; };
+        std::vector<R> rs;
+        bool live = true;
+        for (size_t ri : range_idx) {
+          const DepParam &pm = d.params[ri];
+          R r;
+          r.lo = eval_expr(pm.lo, ctx, locals, nb_locals, g);
+          r.hi = eval_expr(pm.hi, ctx, locals, nb_locals, g);
+          r.st = eval_expr(pm.st, ctx, locals, nb_locals, g, 1);
+          if (r.st == 0) r.st = 1;
+          r.cur = r.lo;
+          if ((r.st > 0 && r.cur > r.hi) || (r.st < 0 && r.cur < r.hi))
+            live = false;
+          rs.push_back(r);
+        }
+        while (live) {
+          for (size_t i = 0; i < rs.size(); i++)
+            vals[range_idx[i]] = rs[i].cur;
+          if (task_params_in_domain(ctx, tp, peer, vals)) flow_count += 1;
+          size_t lvl = rs.size();
+          while (lvl > 0) {
+            R &r = rs[lvl - 1];
+            r.cur += r.st;
+            bool ok = (r.st > 0) ? r.cur <= r.hi : r.cur >= r.hi;
+            if (ok) break;
+            r.cur = r.lo;
+            lvl--;
+          }
+          if (lvl == 0) live = false;
+        }
       }
     } else {
-      for (const Dep &d : fl.in_deps) {
-        if (!eval_guard(d.guard, ctx, locals, nb_locals, g)) continue;
-        if (d.kind == DEP_TASK) flow_count = 1;
-        break; /* first guard-true dep selects the source */
-      }
+      const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals, g);
+      if (sel && sel->kind == DEP_TASK) flow_count = 1;
     }
     if (per_flow && fi < PTC_MAX_FLOWS) per_flow[fi] = flow_count;
     remaining += flow_count;
@@ -564,6 +739,12 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
                            int32_t flow_idx, ptc_copy *copy) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
 
+  if (!task_params_in_domain(ctx, tp, tc, params)) {
+    /* out-of-domain successor: dropped by JDF semantics (see
+     * task_params_in_domain).  Not an error. */
+    return;
+  }
+
   /* dense engine: O(1) slot in the class's bounding box (reference:
    * parsec_default_find_deps over the dense deps array vs
    * parsec_hash_find_deps, parsec_internal.h:343-346) */
@@ -647,11 +828,11 @@ static int prepare_input(ptc_context *ctx, ptc_task *t) {
     const Flow &fl = tc.flows[f];
     if (fl.flags & PTC_FLOW_CTL) continue;
     if (t->data[f]) continue; /* staged by a producer */
-    /* find first guard-true input dep */
-    const Dep *sel = nullptr;
-    for (const Dep &d : fl.in_deps) {
-      if (eval_guard(d.guard, ctx, t->locals, nb_locals, g)) { sel = &d; break; }
-    }
+    /* same selection rule as the counting side: first guard-true dep with
+     * an existing producer (out-of-domain task sources fall through to
+     * the next alternative — memory read or WRITE allocation) */
+    const Dep *sel =
+        select_input_dep(ctx, tp, fl, t->locals, nb_locals, g);
     if (sel && sel->kind == DEP_MEM) {
       int64_t idx[PTC_MAX_LOCALS];
       int ni = (int)sel->idx.size();
@@ -718,8 +899,15 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
         for (size_t i = 0; i < np; i++)
           if (!d.params[i].is_range)
             vals[i] = eval_expr(d.params[i].value, ctx, t->locals, nb_locals, g);
+        /* out-of-domain successors are dropped HERE, before the edge is
+         * traced or the successor's rank is computed: a negative param
+         * through a modulo rank_of would index garbage, and a remote
+         * send would serialize a frame the receiver immediately drops.
+         * (ptc_deliver_dep_local re-checks as wire defense.) */
+        const TaskClass &peer_tc = tp->classes[(size_t)d.peer_class];
         if (range_idx.empty()) {
           std::vector<int64_t> pv(vals);
+          if (!task_params_in_domain(ctx, tp, peer_tc, pv)) continue;
           prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
           deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
                       d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
@@ -746,10 +934,13 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
             for (size_t i = 0; i < rs.size(); i++)
               vals[range_idx[i]] = rs[i].cur;
             std::vector<int64_t> pv(vals);
-            prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
-            deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                        d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
-                        &batch);
+            if (task_params_in_domain(ctx, tp, peer_tc, pv)) {
+              prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
+              deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
+                          d.peer_flow,
+                          (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
+                          &batch);
+            }
             /* advance odometer */
             size_t i = 0;
             for (; i < rs.size(); i++) {
@@ -896,24 +1087,62 @@ static void tp_abort(ptc_context *ctx, ptc_taskpool *tp) {
 
 /* ---- paired-event trace (reference: parsec/profiling.c + the PINS hook
  * points of parsec/mca/pins/pins.h:26-54; format doc at PROF_WORDS).    */
+/* PINS: synchronous instrumentation callback chain at the event points
+ * (reference: parsec/mca/pins/pins.h:26-54 — modules hook task
+ * select/exec/complete; here one registered sink fans out to the Python
+ * module chain).  Disabled = one relaxed load + branch. */
+static inline void pins_fire(ptc_context *ctx, int64_t key,
+                             const int64_t w[PROF_WORDS]) {
+  /* acquire pairs with the exchange in ptc_set_pins_cb; cb+user+mask are
+   * one immutable block, so no torn pairing across a swap */
+  ptc_context::PinsState *st =
+      ctx->pins_state.load(std::memory_order_acquire);
+  if (st && ((st->mask >> key) & 1)) st->cb(st->user, w);
+}
+
+void ptc_set_pins_cb(ptc_context_t *ctx, ptc_pins_cb cb, void *user,
+                     uint64_t key_mask) {
+  /* Callers must keep the old cb's trampoline alive for the context's
+   * lifetime: a reader that loaded the old block may still invoke it
+   * briefly after the swap.  Old blocks are retired, not freed, for the
+   * same reason (installs are rare; freed at context destroy). */
+  ptc_context::PinsState *ns =
+      cb ? new ptc_context::PinsState{cb, user, key_mask} : nullptr;
+  ptc_context::PinsState *old =
+      ctx->pins_state.exchange(ns, std::memory_order_acq_rel);
+  if (old) {
+    std::lock_guard<std::mutex> g(ctx->pins_lock);
+    ctx->pins_retired.push_back(old);
+  }
+}
+
 void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
                    int64_t class_id, int64_t l0, int64_t l1, int64_t aux) {
-  if (ctx->prof_level.load(std::memory_order_relaxed) < 1) return;
-  ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
-  std::lock_guard<std::mutex> g(b->lock);
+  bool trace = ctx->prof_level.load(std::memory_order_relaxed) >= 1;
+  bool pins = ctx->pins_state.load(std::memory_order_relaxed) != nullptr;
+  if (!trace && !pins) return;
   int64_t w[PROF_WORDS] = {key,         phase, class_id, l0, l1,
                            (int64_t)worker, aux,   ptc_now_ns()};
-  b->words.insert(b->words.end(), w, w + PROF_WORDS);
+  if (trace) {
+    ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
+    std::lock_guard<std::mutex> g(b->lock);
+    b->words.insert(b->words.end(), w, w + PROF_WORDS);
+  }
+  if (pins) pins_fire(ctx, key, w);
 }
 
 void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
                       int64_t l0, int64_t l1, int64_t aux) {
-  if (ctx->prof_level.load(std::memory_order_relaxed) < 1) return;
-  ProfBuf *b = ctx->prof[0];
-  std::lock_guard<std::mutex> g(b->lock);
+  bool trace = ctx->prof_level.load(std::memory_order_relaxed) >= 1;
+  bool pins = ctx->pins_state.load(std::memory_order_relaxed) != nullptr;
+  if (!trace && !pins) return;
   int64_t now = ptc_now_ns();
   int64_t w[2 * PROF_WORDS] = {key, 0, class_id, l0, l1, -1, aux, now,
                                key, 1, class_id, l0, l1, -1, aux, now};
+  if (pins) pins_fire(ctx, key, w); /* begin event only: instant span */
+  if (!trace) return;
+  ProfBuf *b = ctx->prof[0];
+  std::lock_guard<std::mutex> g(b->lock);
   b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
 }
 
@@ -1481,6 +1710,8 @@ void ptc_context_destroy(ptc_context_t *ctx) {
   for (auto &w : ctx->workers)
     if (w.joinable()) w.join();
   ptc_comm_shutdown(ctx); /* no-op when comm was never initialized */
+  delete ctx->pins_state.load(std::memory_order_relaxed);
+  for (auto *st : ctx->pins_retired) delete st;
   delete ctx;
 }
 
@@ -1625,6 +1856,20 @@ int32_t ptc_tp_wait(ptc_taskpool_t *tp) {
 }
 
 int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp) { return tp->nb_tasks.load(); }
+
+/* Body-driven task-count adjustment (reference: the termination-detection
+ * module's taskpool_addto_nb_tasks, used by "choice"-style DAGs whose
+ * bodies retire tasks that will never become ready —
+ * tests/dsl/ptg/choice/choice.jdf — and by %option nb_local_tasks_fn
+ * overrides, tests/dsl/ptg/user-defined-functions/udf.jdf). */
+int64_t ptc_tp_addto_nb_tasks(ptc_taskpool_t *tp, int64_t delta) {
+  int64_t now =
+      tp->nb_tasks.fetch_add(delta, std::memory_order_seq_cst) + delta;
+  if (now == 0 && !tp->open.load(std::memory_order_seq_cst))
+    tp_mark_complete(tp->ctx, tp);
+  notify_drain_waiters(tp);
+  return now;
+}
 
 /* Drain: block until every task inserted so far has completed, WITHOUT
  * closing the pool — insertion may continue afterwards.  (Reference:

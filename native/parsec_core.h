@@ -159,6 +159,7 @@ int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp);
 /* block until this taskpool completed */
 int32_t ptc_tp_wait(ptc_taskpool_t *tp);
 int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp);       /* remaining local tasks */
+int64_t ptc_tp_addto_nb_tasks(ptc_taskpool_t *tp, int64_t delta);
 int64_t ptc_tp_nb_total_tasks(ptc_taskpool_t *tp); /* as counted at startup */
 int64_t ptc_tp_nb_errors(ptc_taskpool_t *tp);      /* failed/dropped tasks  */
 /* classes whose dependency tracking runs on the dense-array engine
@@ -232,6 +233,14 @@ void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
 int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
+
+/* PINS: pluggable instrumentation callback at the trace event points
+ * (reference: parsec/mca/pins/pins.h:26-54).  cb receives the 8-word
+ * event record; key_mask selects event keys (bit k = PROF key k).
+ * cb = NULL uninstalls.  Works with tracing off. */
+typedef void (*ptc_pins_cb)(void *user, const int64_t *words);
+void ptc_set_pins_cb(ptc_context_t *ctx, ptc_pins_cb cb, void *user,
+                     uint64_t key_mask);
 
 /* ------------------------------------------------------- DTD (dynamic)
  * Dynamic task discovery: tasks are inserted one by one with explicit
@@ -329,7 +338,7 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port);
 /* flush queued sends + wait for every peer's matching fence: after this,
  * all messages sent before any rank's fence have been applied everywhere */
 /* returns 0 on quiescence, -1 on timeout (PTC_MCA_comm_fence_timeout_s,
- * default 120, 0 = infinite) or peer loss */
+ * default 0 = wait forever; set seconds to arm) or peer loss */
 int32_t ptc_comm_fence(ptc_context_t *ctx);
 /* counting termination detection (fourcounter analog): double wave of
  * (app msgs sent, received, idle).  tp limits the idle predicate to one

@@ -120,6 +120,17 @@ struct TaskClass {
   Expr priority;
   std::vector<Flow> flows;
   std::vector<Chore> chores;
+  /* domain-check fast path (task_params_in_domain): when every range
+   * bound depends only on pool globals, [lo,hi,st] per range local are
+   * cached here on first use (classes live per-taskpool, so globals are
+   * fixed).  state: 0 unknown, 1 cached, 2 dynamic bounds. */
+  mutable std::atomic<int> domain_cache_state{0};
+  mutable std::vector<int64_t> domain_lo, domain_hi, domain_st;
+  TaskClass() = default;
+  TaskClass(const TaskClass &o)
+      : name(o.name), id(o.id), locals(o.locals),
+        range_locals(o.range_locals), aff_dc(o.aff_dc), aff_idx(o.aff_idx),
+        priority(o.priority), flows(o.flows), chores(o.chores) {}
 };
 
 /* ------------------------------------------------------------------ */
@@ -423,6 +434,14 @@ struct ptc_context {
   /* profiling */
   std::atomic<int32_t> prof_level{0}; /* 0 off, 1 spans, 2 +edges */
   std::vector<ProfBuf *> prof;
+  /* PINS instrumentation sink (pins.h:26-54 analog; see pins_fire).
+   * cb/user/mask live in one atomically-swapped block so a racing reader
+   * can never pair an old callback with a new user pointer; retired
+   * blocks are freed at context destroy (installs are rare). */
+  struct PinsState { ptc_pins_cb cb; void *user; uint64_t mask; };
+  std::atomic<PinsState *> pins_state{nullptr};
+  std::vector<PinsState *> pins_retired;
+  std::mutex pins_lock;
   /* per-worker selected-task counters (reference: the PAPI-SDE
    * scheduled/retired counters + per-thread rusage dumps,
    * parsec/scheduling.c:45-86,319-323) */

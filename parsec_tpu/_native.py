@@ -103,6 +103,7 @@ DP_DELIVER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_void_p, C.c_int64,
 DP_BOUND_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64, C.c_void_p,
                             C.c_int64, C.c_int32)
 TP_COMPLETE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_void_p)
+PINS_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.POINTER(C.c_int64))
 
 _sigs = {
     "ptc_version": (C.c_char_p, []),
@@ -129,6 +130,7 @@ _sigs = {
     "ptc_context_add_taskpool": (C.c_int32, [C.c_void_p, C.c_void_p]),
     "ptc_tp_wait": (C.c_int32, [C.c_void_p]),
     "ptc_tp_nb_tasks": (C.c_int64, [C.c_void_p]),
+    "ptc_tp_addto_nb_tasks": (C.c_int64, [C.c_void_p, C.c_int64]),
     "ptc_tp_nb_total_tasks": (C.c_int64, [C.c_void_p]),
     "ptc_tp_nb_errors": (C.c_int64, [C.c_void_p]),
     "ptc_tp_dense_classes": (C.c_int32, [C.c_void_p]),
@@ -137,6 +139,8 @@ _sigs = {
     "ptc_tp_drain": (C.c_int32, [C.c_void_p]),
     "ptc_tp_set_on_complete": (None, [C.c_void_p, TP_COMPLETE_CB_T,
                                       C.c_void_p]),
+    "ptc_set_pins_cb": (None, [C.c_void_p, PINS_CB_T, C.c_void_p,
+                               C.c_uint64]),
     "ptc_tp_global": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_data_new": (C.c_void_p, [C.c_int64, C.c_void_p, C.c_int64]),
     "ptc_data_destroy": (None, [C.c_void_p]),

@@ -57,6 +57,10 @@ class Context:
         if _mca.get("runtime.profile"):
             # same meaning as profile_enable(True): full tracing incl. EDGE
             N.lib.ptc_profile_enable(self._ptr, 2)
+        self._pins_chain = None
+        if _mca.get("runtime.pins"):
+            from ..profiling.pins import enable_from_param
+            enable_from_param(self, _mca.get("runtime.pins"))
         # keep-alives: ctypes callbacks must outlive the native context
         self._expr_cbs: List = []
         self._body_cbs: List = []

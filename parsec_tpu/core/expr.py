@@ -179,10 +179,13 @@ class CompileCtx:
     """Name→index resolution + Python-callback registration for one class."""
 
     def __init__(self, locals_map: Dict[str, int], globals_map: Dict[str, int],
-                 register_call: Callable[[Callable], int]):
+                 register_call: Callable[[Callable], int], scope=None):
         self.locals = locals_map
         self.globals = globals_map
         self._register_call = register_call
+        # program scope (JDF prologue definitions + user objects): names
+        # visible to %{ ... %} escape expressions beyond int globals
+        self.scope = scope
 
     def register_call(self, fn: Callable) -> int:
         return self._register_call(fn)

@@ -148,7 +148,8 @@ class TaskClass:
     def compile(self, tp) -> List[int]:
         """Serialize to the native spec blob (version-1 layout)."""
         locals_map = {n: i for i, (n, _, _) in enumerate(self.locals)}
-        cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call)
+        cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call,
+                          scope=getattr(tp, "jdf_scope", None))
         spec: List[int] = [1, len(self.locals)]
         for (_, is_range, payload) in self.locals:
             spec.append(1 if is_range else 0)

@@ -108,6 +108,13 @@ class Taskpool:
     def nb_total_tasks(self) -> int:
         return N.lib.ptc_tp_nb_total_tasks(self._ptr)
 
+    def addto_nb_tasks(self, delta: int) -> int:
+        """Adjust the pending-task count from a body or a user hook
+        (reference: tdm.module->taskpool_addto_nb_tasks — lets a DAG retire
+        tasks that will never become ready, tests/dsl/ptg/choice).  Returns
+        the new count."""
+        return N.lib.ptc_tp_addto_nb_tasks(self._ptr, delta)
+
     @property
     def dense_classes(self) -> int:
         """Task classes whose dependency tracking runs on the dense-array

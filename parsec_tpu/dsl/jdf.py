@@ -12,20 +12,33 @@ code (`BODY [type=TPU]`) instead of inline C.
 Supported grammar (subset, expanding):
 
     extern "C" %{ <python prologue> %}      # exec'd into the program scope
+    %option name = value                     # taskpool options (parsec.y
+                                             #   jdf_set_default_properties)
     NAME [type="int"] [hidden=on] [default=<expr>]
-    Task(k, m)
+    Task(k, m) [ make_key_fn = fn startup_fn = fn ... ]   # class properties
     k = lo .. hi [.. step]                   # range parameter
     loc = <expr>                             # derived local
     : coll(<expr>, ...)                      # affinity
     priority = <expr>                        # optional
     RW|READ|WRITE|CTL F <- <dep>  -> <dep> ...
-    BODY [type=TPU] { <python/jax code> } END / BODY { ... } END
+    BODY [type=TPU weight=<e>] { <python/jax code> } END / BODY END
 
-    <dep> := [(guard) ?] <target> [: <target>]
+    <dep> := [(guard) | %{..%} ?] <target> [: <target>] [ [props] ]
     <target> := F Task(e, lo..hi, ...) | coll(e, ...) | NEW | NULL
 
 Expressions: C-style with ? :, && || !, comparisons, + - * / %, and
-`%{ <python expr> %}` escapes evaluated with (locals, globals) dicts.
+`%{ <python expr> %}` escapes evaluated over locals, int globals, and the
+program scope (prologue definitions + objects bound via builder.scope).
+
+User-defined functions (reference: tests/dsl/ptg/user-defined-functions):
+  %option nb_local_tasks_fn = fn   — fn(taskpool) -> int overrides the
+      enumerated local-task count used for termination detection.
+  startup_fn = fn (class property) — fn(taskpool, class_name) hook invoked
+      at run() before tasks execute.
+  make_key_fn / hash_struct — parsed and validated against the program
+      scope, then intentionally unused: the native dependency engine keys
+      on the exact parameter vector (collision-safe full-key record,
+      native/core.cpp DepEntry), so user key packing has nothing to fix.
 """
 from __future__ import annotations
 
@@ -96,11 +109,12 @@ class JdfDepTarget:
 
 
 class JdfDep:
-    def __init__(self, direction, guard, target, alt=None):
+    def __init__(self, direction, guard, target, alt=None, props=None):
         self.direction = direction  # 0 in, 1 out
         self.guard = guard          # Expr | None
         self.target = target        # JdfDepTarget
         self.alt = alt              # else-branch target
+        self.props = props or {}    # [type=.. layout=.. count=.. displ=..]
 
 
 class JdfFlow:
@@ -117,9 +131,10 @@ class JdfBody:
 
 
 class JdfTask:
-    def __init__(self, name, params):
+    def __init__(self, name, params, props=None):
         self.name = name
         self.params = params  # [str]
+        self.props = props or {}  # class properties [make_key_fn = ...]
         self.locals: List[Tuple[str, object]] = []  # (name, Range|Expr)
         self.affinity: Optional[Tuple[str, list]] = None
         self.priority = None
@@ -130,6 +145,7 @@ class JdfTask:
 class JdfProgram:
     def __init__(self):
         self.prologue = ""
+        self.options: Dict[str, str] = {}  # %option lines
         self.globals: List[JdfGlobal] = []
         self.tasks: List[JdfTask] = []
 
@@ -138,9 +154,15 @@ class JdfProgram:
 
 _ACCESS = {"RW": "RW", "READ": "READ", "WRITE": "WRITE", "CTL": "CTL"}
 
+# %option names accepted at program level (reference: parsec.y
+# jdf_set_default_properties; no_taskpool_instance et al.)
+_KNOWN_OPTIONS = {"no_taskpool_instance", "nb_local_tasks_fn"}
 
+
+# braces are optional: `BODY\nEND` is an empty body (reference:
+# tests/dsl/ptg/complex_deps.jdf FCT1..FCT5)
 _BODY_RE = re.compile(
-    r"BODY(?P<props>\s*\[[^\]]*\])?\s*\{(?P<code>.*?)\}\s*END",
+    r"BODY(?P<props>\s*\[[^\]]*\])?\s*(?:\{(?P<code>.*?)\}\s*)?END",
     re.DOTALL)
 
 
@@ -150,7 +172,7 @@ def _extract_bodies(src: str):
     bodies = []
 
     def repl(m):
-        bodies.append((m.group("props") or "", m.group("code")))
+        bodies.append((m.group("props") or "", m.group("code") or "pass"))
         return f"BODY {len(bodies) - 1}\n"
 
     return _BODY_RE.sub(repl, src), bodies
@@ -199,6 +221,19 @@ class _Parser:
             elif t.kind == "escape":
                 self.next()
                 prog.prologue += t.val[2:-2] + "\n"
+            elif t.val == "%" and self.peek(1).val == "option":
+                # %option name = value (value: one id/num/string token)
+                self.next()
+                self.next()
+                name = self.next().val
+                if name not in _KNOWN_OPTIONS:
+                    # a typo'd option (e.g. nb_local_task_fn) silently
+                    # ignored can hang a DAG relying on it — fail loudly
+                    raise SyntaxError(
+                        f"jdf: unknown %option {name!r}; known: "
+                        f"{sorted(_KNOWN_OPTIONS)}")
+                self.expect("=")
+                prog.options[name] = self.next().val.strip('"')
             elif t.kind == "id" and self.peek(1).val == "[":
                 prog.globals.append(self._parse_global())
             elif t.kind == "id" and self.peek(1).val == "(":
@@ -239,7 +274,8 @@ class _Parser:
         while not self.accept(")"):
             params.append(self.next().val)
             self.accept(",")
-        task = JdfTask(name, params)
+        props = self._parse_props() if self.peek().val == "[" else {}
+        task = JdfTask(name, params, props)
         # locals until ':' (affinity) — every line `id = ...`
         while True:
             t = self.peek()
@@ -300,8 +336,10 @@ class _Parser:
         # `(guard) ? target [: target]`  — need lookahead: a '(' could also
         # open a parenthesized expression... in JDF a dep starts either with
         # '(' guard or an identifier (flow/coll/NEW/NULL).
-        if self.peek().val == "(":
-            # or-level, not ternary: the dep's own `?` must stay unconsumed
+        if self.peek().val == "(" or self.peek().kind == "escape":
+            # or-level, not ternary: the dep's own `?` must stay unconsumed.
+            # A %{ ... %} escape can itself be the whole guard (reference:
+            # tests/dsl/ptg/choice/choice.jdf).
             guard = self._or()
             self.expect("?")
             target = self._parse_target()
@@ -309,7 +347,9 @@ class _Parser:
                 alt = self._parse_target()
         else:
             target = self._parse_target()
-        return JdfDep(direction, guard, target, alt)
+        # trailing dep properties: [type = X displ_remote = e ...]
+        props = self._parse_props() if self.peek().val == "[" else {}
+        return JdfDep(direction, guard, target, alt, props)
 
     def _parse_target(self) -> JdfDepTarget:
         t = self.next()
@@ -450,9 +490,11 @@ class _Name(E.Expr):
 
 
 class _PyEscape(E.Expr):
-    """%{ python expr %}: evaluated with (locals_list, globals_dict) via a
-    registered callback; the expression sees names `locals` (dict by name)
-    and every global by name."""
+    """%{ python expr %}: evaluated via a registered callback; the
+    expression sees task locals by name, int globals by name, and the
+    program scope (prologue definitions + objects the caller bound via
+    builder.scope — reference: JDF inline C sees taskpool globals of any
+    type, e.g. the `decision` array of tests/dsl/ptg/choice)."""
 
     def __init__(self, code):
         self.code = code
@@ -461,12 +503,16 @@ class _PyEscape(E.Expr):
     def _emit(self, out, ctx):
         names = {v: k for k, v in ctx.locals.items()}
         code = compile(self.code, "<jdf-escape>", "eval")
+        scope = ctx.scope  # live dict: later caller bindings stay visible
 
         def fn(locs, globs):
+            # live scope as eval-globals: no per-call copy of the program
+            # scope (it can be large), and later caller bindings stay
+            # visible; int globals and task locals shadow it via env
             env = dict(globs)
             env.update({names[i]: v for i, v in enumerate(locs)
                         if i in names})
-            return int(eval(code, {}, env))
+            return int(eval(code, scope if scope is not None else {}, env))
 
         cb_id = ctx.register_call(fn)
         out += [E.N.OP_CALL, cb_id]
@@ -494,9 +540,11 @@ class JdfTaskpoolBuilder:
 
     def __init__(self, prog: JdfProgram, ctx, globals: Dict[str, int],
                  dtype=np.uint8, shapes: Optional[Dict] = None,
-                 arenas: Optional[Dict[str, str]] = None, dev=None):
+                 arenas: Optional[Dict[str, str]] = None, dev=None,
+                 late_bound: Optional[List[str]] = None):
         self.prog = prog
         self.ctx = ctx
+        self.late_bound = set(late_bound or [])
         self.dtype = np.dtype(dtype)
         self.shapes = shapes or {}
         self.arenas = arenas or {}
@@ -507,6 +555,24 @@ class JdfTaskpoolBuilder:
             exec(prog.prologue, self.scope)
         gvals: Dict[str, int] = {}
         for g in prog.globals:
+            if g.typ.rstrip().endswith("*"):
+                # pointer-typed global (reference: collections / user arrays
+                # like `decision [type = "int *"]`, tests/dsl/ptg/choice):
+                # lives in the program scope, not the int-global table.
+                # Must be satisfiable: a registered collection, a caller
+                # value, a prologue definition, or a late builder.scope
+                # binding (promised via late_bound=[names]).
+                if g.name in globals:
+                    self.scope[g.name] = globals[g.name]
+                elif g.name not in ctx.collections and \
+                        g.name not in self.scope and \
+                        g.name not in self.late_bound:
+                    raise ValueError(
+                        f"jdf: pointer global {g.name!r} has no value: "
+                        "register a collection under that name, pass it in "
+                        "globals=, define it in the prologue, or list it "
+                        "in late_bound= and set builder.scope[name]")
+                continue
             if g.name in globals:
                 gvals[g.name] = int(globals[g.name])
             elif g.default is not None:
@@ -516,11 +582,26 @@ class JdfTaskpoolBuilder:
                 raise ValueError(f"jdf: global {g.name} has no value")
         self.gvals = gvals
         self.tp = Taskpool(ctx, globals=gvals)
+        # escapes compiled at commit() read this live dict (CompileCtx.scope)
+        self.tp.jdf_scope = self.scope
+        self._startup_hooks: List[Tuple[str, str]] = []  # (class, fn name)
         for jt in prog.tasks:
             self._build_task(jt)
 
+    # nb_local_tasks_fn is deliberately NOT here: it is a %option (pool
+    # scope), and accepting it per class would validate-then-ignore it
+    _CLASS_PROPS = ("make_key_fn", "startup_fn", "hash_struct",
+                    "high_priority")
+
     def _build_task(self, jt: JdfTask):
         tc = self.tp.task_class(jt.name)
+        tc.jdf_props = dict(jt.props)
+        for k in jt.props:
+            if k not in self._CLASS_PROPS:
+                raise ValueError(f"jdf: {jt.name}: unknown class property "
+                                 f"{k!r}")
+        if "startup_fn" in jt.props:
+            self._startup_hooks.append((jt.name, jt.props["startup_fn"]))
         for (nm, payload) in jt.locals:
             if isinstance(payload, E.Range):
                 tc.locals.append((nm, True, payload))
@@ -585,6 +666,7 @@ class JdfTaskpoolBuilder:
                            _flows=tuple(data_flows), _scope=self.scope):
                     env = dict(_scope)
                     env["this"] = view
+                    env["taskpool"] = self.tp  # bodies may addto_nb_tasks
                     env.update({p: view.local(p) for p in _params})
                     env.update(self.gvals)
                     for f in _flows:
@@ -597,8 +679,53 @@ class JdfTaskpoolBuilder:
 
                 tc.body(pybody)
 
+    def _scope_fn(self, name: str, what: str):
+        fn = self.scope.get(name)
+        if not callable(fn):
+            raise ValueError(f"jdf: {what} = {name!r} is not a callable in "
+                             "the program scope")
+        return fn
+
     def run(self):
-        self.tp.run()
+        # class startup hooks (reference: startup_fn property,
+        # tests/dsl/ptg/user-defined-functions/udf.jdf — there it replaces
+        # the generated startup enumerator; here enumeration is interpreted
+        # natively, so the hook runs for its side effects before tasks do)
+        for name in self.late_bound:
+            if name not in self.scope:
+                raise ValueError(
+                    f"jdf: late_bound global {name!r} was never bound: set "
+                    "builder.scope[name] before run() (an unbound name "
+                    "would make every escape referencing it evaluate to 0)")
+        for cls_name, fn_name in self._startup_hooks:
+            self._scope_fn(fn_name, "startup_fn")(self.tp, cls_name)
+        # make_key_fn / hash_struct: validated, then intentionally unused —
+        # the native engine keys on the exact parameter vector (see module
+        # docstring)
+        for jt in self.prog.tasks:
+            if "make_key_fn" in jt.props:
+                self._scope_fn(jt.props["make_key_fn"], "make_key_fn")
+            if "hash_struct" in jt.props and \
+                    jt.props["hash_struct"] not in self.scope:
+                raise ValueError(f"jdf: hash_struct = "
+                                 f"{jt.props['hash_struct']!r} not in scope")
+        nbfn_name = self.prog.options.get("nb_local_tasks_fn")
+        if nbfn_name is not None:
+            # %option nb_local_tasks_fn: the user count overrides the
+            # enumerated one for termination detection.  Hold the pool open
+            # so it cannot complete before the adjustment is applied.
+            nbfn = self._scope_fn(nbfn_name, "nb_local_tasks_fn")
+            self.tp.set_open(True)
+            try:
+                self.tp.run()
+                delta = int(nbfn(self.tp)) - self.tp.nb_total_tasks
+                if delta:
+                    self.tp.addto_nb_tasks(delta)
+            finally:
+                # a raising count fn must not leave the pool open forever
+                self.tp.set_open(False)
+        else:
+            self.tp.run()
         return self.tp
 
 

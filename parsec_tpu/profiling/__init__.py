@@ -10,13 +10,20 @@ TPU-native equivalent over the native core's 8-word event stream
 (native/runtime_internal.h PROF_WORDS):
 
   Dictionary     event-key registry with names/colors
-  Trace          take/save/load/merge + to_pandas() trace tables
+  Trace          take/save/load/merge + to_pandas() trace tables +
+                 to_perfetto() standard-tool sink (the OTF2-writer analog)
   to_dot         executed-DAG capture from EDGE event pairs
+  pins           pluggable instrumentation-module chain at the event
+                 points (parsec/mca/pins/pins.h analog), MCA-selected
 """
 from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
                     KEY_COMM_SEND, KEY_COMM_RECV,
                     Dictionary, Trace, take_trace, to_dot)
+from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
+                   CommVolume, REGISTRY, enable_pins)
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "KEY_COMM_SEND", "KEY_COMM_RECV",
-           "Dictionary", "Trace", "take_trace", "to_dot"]
+           "Dictionary", "Trace", "take_trace", "to_dot",
+           "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
+           "CommVolume", "REGISTRY", "enable_pins"]

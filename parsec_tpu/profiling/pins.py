@@ -1,0 +1,197 @@
+"""PINS: pluggable instrumentation modules at the runtime's event points.
+
+Reference: the PINS MCA framework (parsec/mca/pins/pins.h:26-54) — small
+instrumentation modules (task_counter, task_profiler, print_steals, papi,
+alperf) chain callbacks onto task lifecycle points, selected by the
+`--mca pins <list>` parameter.  Here the native core exposes one
+synchronous sink at the trace event points (native ptc_set_pins_cb, fired
+from ptc_prof_push/ptc_prof_instant with the 8-word event record); a
+PinsChain fans it out to the registered Python modules.  Disabled cost is
+one relaxed load + branch per event point; enabling does NOT require
+tracing to be on (and vice versa).
+
+Selection mirrors the reference: the MCA param `runtime.pins` (env
+`PTC_MCA_runtime_pins`) holds a comma-separated module-name list applied
+at Context init, or modules are attached explicitly with enable_pins().
+"""
+from __future__ import annotations
+
+import ctypes as C
+import threading
+from typing import Dict, List, Optional, Type
+
+from .. import _native as N
+from .trace import (KEY_COMM_RECV, KEY_COMM_SEND, KEY_EDGE, KEY_EXEC,
+                    KEY_RELEASE)
+
+PINS_CB_T = N.PINS_CB_T
+
+
+class PinsModule:
+    """Base instrumentation module.  Override `mask` (bitmask of event
+    keys to receive) and `on_event`.  on_event runs synchronously on
+    worker/comm threads — keep it tiny and non-blocking."""
+
+    name = "module"
+    mask = (1 << KEY_EXEC) | (1 << KEY_RELEASE) | (1 << KEY_COMM_SEND) | \
+           (1 << KEY_COMM_RECV)
+
+    def on_event(self, key: int, phase: int, class_id: int, l0: int,
+                 l1: int, worker: int, aux: int, t_ns: int) -> None:
+        raise NotImplementedError
+
+
+class TaskCounter(PinsModule):
+    """Per-class executed-task counts (reference: mca/pins/task_counter)."""
+
+    name = "task_counter"
+    mask = 1 << KEY_EXEC
+
+    def __init__(self):
+        self.counts: Dict[int, int] = {}
+        # events arrive concurrently from every worker thread; dict RMW
+        # spans bytecodes, so a GIL switch between load and store would
+        # lose increments without the lock
+        self._lock = threading.Lock()
+
+    def on_event(self, key, phase, class_id, l0, l1, worker, aux, t_ns):
+        if phase == 1:
+            with self._lock:
+                self.counts[class_id] = self.counts.get(class_id, 0) + 1
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+
+class TaskProfiler(PinsModule):
+    """Per-(worker, class) execution-time accumulation (reference:
+    mca/pins/task_profiler)."""
+
+    name = "task_profiler"
+    mask = 1 << KEY_EXEC
+
+    def __init__(self):
+        self._open: Dict[tuple, int] = {}
+        self.stats: Dict[int, dict] = {}  # class_id -> count/total/min/max
+        self._lock = threading.Lock()  # see TaskCounter
+
+    def on_event(self, key, phase, class_id, l0, l1, worker, aux, t_ns):
+        sig = (worker, class_id, l0, l1)
+        with self._lock:
+            if phase == 0:
+                self._open[sig] = t_ns
+                return
+            t0 = self._open.pop(sig, None)
+            if t0 is None:
+                return
+            d = t_ns - t0
+            s = self.stats.setdefault(
+                class_id,
+                {"count": 0, "total_ns": 0, "min_ns": d, "max_ns": d})
+            s["count"] += 1
+            s["total_ns"] += d
+            s["min_ns"] = min(s["min_ns"], d)
+            s["max_ns"] = max(s["max_ns"], d)
+
+
+class CommVolume(PinsModule):
+    """Bytes + message counts by direction (reference: mca/pins/alperf's
+    bandwidth accounting; the check-comms oracle counts the same events)."""
+
+    name = "comm_volume"
+    mask = (1 << KEY_COMM_SEND) | (1 << KEY_COMM_RECV)
+
+    def __init__(self):
+        self.sent_msgs = 0
+        self.sent_bytes = 0
+        self.recv_msgs = 0
+        self.recv_bytes = 0
+        self._lock = threading.Lock()  # see TaskCounter
+
+    def on_event(self, key, phase, class_id, l0, l1, worker, aux, t_ns):
+        with self._lock:
+            if key == KEY_COMM_SEND:
+                self.sent_msgs += 1
+                self.sent_bytes += aux
+            else:
+                self.recv_msgs += 1
+                self.recv_bytes += aux
+
+
+REGISTRY: Dict[str, Type[PinsModule]] = {
+    TaskCounter.name: TaskCounter,
+    TaskProfiler.name: TaskProfiler,
+    CommVolume.name: CommVolume,
+}
+
+
+class PinsChain:
+    """The installed module chain for one Context (reference: the
+    pins module linked list walked at each event point)."""
+
+    def __init__(self, ctx, modules: List[PinsModule]):
+        self._ctx = ctx
+        self.modules = list(modules)
+        mask = 0
+        for m in self.modules:
+            mask |= m.mask
+        self._mask = mask
+
+        def _cb(user, words):
+            w = words[:8]
+            for m in self.modules:
+                if (m.mask >> w[0]) & 1:
+                    try:
+                        m.on_event(*w)
+                    except Exception:
+                        # exceptions cannot cross the ctypes boundary; a
+                        # raising module must not mute the rest of the
+                        # chain (same guard as Taskpool._register_call)
+                        import traceback
+                        traceback.print_exc()
+
+        self._cb = PINS_CB_T(_cb)
+        # the trampoline must outlive the context, not just this chain: a
+        # worker that loaded the pointer right before an uninstall may
+        # still invoke it (see ptc_set_pins_cb ordering note)
+        if not hasattr(ctx, "_pins_keepalive"):
+            ctx._pins_keepalive = []
+        ctx._pins_keepalive.append(self._cb)
+        N.lib.ptc_set_pins_cb(ctx._ptr, self._cb, None, mask)
+
+    def uninstall(self):
+        N.lib.ptc_set_pins_cb(self._ctx._ptr, C.cast(None, PINS_CB_T),
+                              None, 0)
+        self._ctx._pins_chain = None
+
+    def __getitem__(self, name: str) -> PinsModule:
+        for m in self.modules:
+            if m.name == name:
+                return m
+        raise KeyError(name)
+
+
+def enable_pins(ctx, *modules) -> PinsChain:
+    """Install instrumentation modules on a Context.  Accepts PinsModule
+    instances or registry names; returns the chain (also stored on
+    ctx._pins_chain for keep-alive)."""
+    insts: List[PinsModule] = []
+    for m in modules:
+        if isinstance(m, str):
+            if m not in REGISTRY:
+                raise KeyError(f"unknown pins module {m!r}; "
+                               f"have {sorted(REGISTRY)}")
+            insts.append(REGISTRY[m]())
+        else:
+            insts.append(m)
+    chain = PinsChain(ctx, insts)
+    ctx._pins_chain = chain
+    return chain
+
+
+def enable_from_param(ctx, spec: str) -> Optional[PinsChain]:
+    names = [s.strip() for s in spec.split(",") if s.strip()]
+    if not names:
+        return None
+    return enable_pins(ctx, *names)

@@ -114,33 +114,41 @@ class Trace:
         return out
 
     # ----------------------------------------------------- trace tables
-    def to_pandas(self):
-        """Paired begin/end events -> one row per span (the reference's
-        pbt2ptt "trace tables": tools/profiling/python/pbt2ptt.pyx).
-
-        Returns a DataFrame with columns: rank, worker, key, name, class_id,
-        class_name, l0, l1, aux, begin_ns, end_ns, dur_ns.  EDGE events are
-        excluded (use edges()/to_dot)."""
-        import pandas as pd
+    def spans(self):
+        """Pair begin/end events into spans — the single pairing rule
+        shared by to_pandas and to_perfetto.  Yields tuples
+        (rank, worker, key, class_id, l0, l1, aux, begin_ns, end_ns);
+        EDGE events are excluded (use edges()/to_dot).  Pairing is per
+        (rank, worker, key, class, l0, l1) with a begin stack; aux is the
+        max of the begin/end words."""
         ev = self.events
-        rows = []
-        # pair per (rank, worker, key, class, l0, l1) with a begin stack
         open_spans: Dict[tuple, list] = {}
         for i in range(len(ev)):
-            key, phase, cid, l0, l1, worker, aux, t = ev[i]
+            key, phase, cid, l0, l1, worker, aux, t = (int(x) for x in ev[i])
             if key == KEY_EDGE:
                 continue
-            sig = (self.ranks[i], worker, key, cid, l0, l1)
+            rank = int(self.ranks[i])
+            sig = (rank, worker, key, cid, l0, l1)
             if phase == 0:
                 open_spans.setdefault(sig, []).append((aux, t))
             else:
                 st = open_spans.get(sig)
                 if st:
                     aux0, t0 = st.pop()
-                    rows.append((self.ranks[i], worker, key,
-                                 self.dict.name(key), cid,
-                                 self._cname(cid), l0, l1, max(aux, aux0),
-                                 t0, t, t - t0))
+                    yield (rank, worker, key, cid, l0, l1, max(aux, aux0),
+                           t0, t)
+
+    def to_pandas(self):
+        """Paired begin/end events -> one row per span (the reference's
+        pbt2ptt "trace tables": tools/profiling/python/pbt2ptt.pyx).
+
+        Returns a DataFrame with columns: rank, worker, key, name, class_id,
+        class_name, l0, l1, aux, begin_ns, end_ns, dur_ns."""
+        import pandas as pd
+        rows = [(rank, worker, key, self.dict.name(key), cid,
+                 self._cname(cid), l0, l1, aux, t0, t1, t1 - t0)
+                for (rank, worker, key, cid, l0, l1, aux, t0, t1)
+                in self.spans()]
         return pd.DataFrame(rows, columns=[
             "rank", "worker", "key", "name", "class_id", "class_name",
             "l0", "l1", "aux", "begin_ns", "end_ns", "dur_ns"])
@@ -166,6 +174,40 @@ class Trace:
             else:
                 i += 1
         return out
+
+    def to_perfetto(self, path: Optional[str] = None):
+        """Standard-tool sink: Chrome/Perfetto trace-event JSON (the
+        reference ships an OTF2 writer, parsec/profiling_otf2.c, for
+        Vampir/Score-P interop; Perfetto's trace-event format is the
+        TPU-era equivalent — ui.perfetto.dev opens it directly).
+
+        Spans become "X" complete events with pid=rank / tid=worker;
+        COMM instant spans (begin==end) become "i" instant events.
+        Returns the JSON object; writes it to `path` when given."""
+        out = []
+        for (rank, worker, key, cid, l0, l1, aux, t0, t1) in self.spans():
+            name = (self._cname(cid) if key == KEY_EXEC and cid >= 0
+                    else self.dict.name(key))
+            rec = {
+                "name": name,
+                "cat": self.dict.name(key),
+                "pid": rank,
+                "tid": worker,
+                "ts": t0 / 1e3,          # perfetto wants microseconds
+                "args": {"l0": l0, "l1": l1, "bytes": aux},
+            }
+            if t1 == t0:
+                rec["ph"] = "i"
+                rec["s"] = "t"  # thread-scoped instant
+            else:
+                rec["ph"] = "X"
+                rec["dur"] = (t1 - t0) / 1e3
+            out.append(rec)
+        doc = {"traceEvents": out, "displayTimeUnit": "ns"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
 
     def counts(self) -> Dict[str, int]:
         """Event counts per key name — the cheap oracle used by trace

@@ -154,6 +154,10 @@ register("runtime.sched", "lws", str,
 register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")
 register("runtime.profile", False, bool, "enable event tracing at init")
+register("runtime.pins", "", str,
+         "comma-separated PINS instrumentation modules to install at init "
+         "(reference: --mca pins <list>, parsec/mca/pins/pins.h); "
+         "names from parsec_tpu.profiling.pins.REGISTRY")
 register("comm.base_port", 29650, int, "TCP rendezvous base port")
 register("comm.bcast_topo", "star", str,
          "activation broadcast topology: star|chain|binomial "

@@ -1,0 +1,103 @@
+"""PINS instrumentation-chain tests (reference: the pins MCA framework,
+parsec/mca/pins/pins.h:26-54 — task_counter/task_profiler modules) and
+the Perfetto standard-tool sink (the OTF2-writer analog,
+parsec/profiling_otf2.c)."""
+import json
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import (TaskCounter, TaskProfiler, enable_pins,
+                                  take_trace)
+from parsec_tpu.utils import params as mca
+
+
+def _run_chain(ctx, nb):
+    ctx.register_arena("int", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": nb})
+    k = pt.L("k")
+    tc = tp.task_class("Task")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref("Task", k - 1, flow="A")),
+            pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                   guard=(k < pt.G("NB"))),
+            arena="int")
+    tc.body(lambda t: None)
+    tp.run()
+    tp.wait()
+    return tp
+
+
+def test_pins_task_counter_and_profiler_without_tracing():
+    """Modules see every EXEC event even with tracing OFF (the native
+    sink is independent of the trace buffers)."""
+    nb = 24
+    with pt.Context(nb_workers=2) as ctx:
+        chain = enable_pins(ctx, TaskCounter(), TaskProfiler())
+        _run_chain(ctx, nb - 1)
+        assert ctx.profile_take().shape[0] == 0  # tracing was off
+    counter = chain["task_counter"]
+    prof = chain["task_profiler"]
+    assert counter.total == nb
+    assert counter.counts == {0: nb}
+    st = prof.stats[0]
+    assert st["count"] == nb
+    assert 0 <= st["min_ns"] <= st["max_ns"]
+    assert st["total_ns"] >= st["max_ns"]
+
+
+def test_pins_chain_uninstall_stops_events():
+    with pt.Context(nb_workers=1) as ctx:
+        chain = enable_pins(ctx, "task_counter")
+        _run_chain(ctx, 4)
+        seen = chain["task_counter"].total
+        assert seen == 5
+        chain.uninstall()
+        _run_chain(ctx, 4)
+        assert chain["task_counter"].total == seen  # no new events
+
+
+def test_pins_mca_param_install(monkeypatch):
+    monkeypatch.setenv("PTC_MCA_runtime_pins", "task_counter,comm_volume")
+    mca.reload_files()
+    try:
+        with pt.Context(nb_workers=1) as ctx:
+            assert ctx._pins_chain is not None
+            _run_chain(ctx, 9)
+            assert ctx._pins_chain["task_counter"].total == 10
+            names = [m.name for m in ctx._pins_chain.modules]
+            assert names == ["task_counter", "comm_volume"]
+    finally:
+        monkeypatch.delenv("PTC_MCA_runtime_pins")
+        mca.reload_files()
+
+
+def test_pins_unknown_module_rejected():
+    with pt.Context(nb_workers=1) as ctx:
+        with pytest.raises(KeyError, match="no_such_module"):
+            enable_pins(ctx, "no_such_module")
+
+
+def test_perfetto_sink(tmp_path):
+    nb = 8
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.profile_enable(True)
+        _run_chain(ctx, nb - 1)
+        tr = take_trace(ctx, class_names=["Task"])
+    path = tmp_path / "trace.json"
+    doc = tr.to_perfetto(str(path))
+    on_disk = json.loads(path.read_text())
+    assert on_disk == doc
+    evs = doc["traceEvents"]
+    execs = [e for e in evs if e["cat"] == "EXEC"]
+    assert len(execs) == nb
+    for e in execs:
+        assert e["ph"] == "X" and e["dur"] >= 0
+        assert e["name"] == "Task"
+        assert e["pid"] == 0 and isinstance(e["tid"], int)
+    # spans are well-formed perfetto: ts strictly increasing per chain dep
+    ts = sorted(e["ts"] for e in execs)
+    assert ts == [e["ts"] for e in sorted(execs, key=lambda x: x["ts"])]
