@@ -170,6 +170,12 @@ struct MemReg {
   int32_t expected = 0;
   int32_t served = 0;
   uint8_t pk = PK_GET;
+  /* true when mem_by_copy[src] maps to THIS handle (raw snapshots only;
+   * packed layout-specific snapshots have their own dedup map keyed by
+   * (src, packed_dtype), and must not erase a live raw registration's
+   * mapping on last pull) */
+  bool in_by_copy = false;
+  int32_t packed_dtype = -1; /* >= 0: mem_by_packed[{src, dtype}] == h */
 };
 
 /* receiver side: a dep delivery whose payload is still being pulled */
@@ -223,6 +229,9 @@ struct CommEngine {
   uint64_t next_handle = 1, next_cookie = 1;
   std::unordered_map<uint64_t, MemReg> mem_reg;
   std::unordered_map<ptc_copy *, uint64_t> mem_by_copy;
+  /* packed-snapshot dedup: same (copy, dtype) implies identical packed
+   * bytes, so fan-outs share one registration like the raw path */
+  std::map<std::pair<ptc_copy *, int32_t>, uint64_t> mem_by_packed;
   std::unordered_map<uint64_t, PendingGet> pending_gets;
   int64_t eager_limit = 64 * 1024; /* PTC_MCA_comm_eager_limit; <0 = off */
 
@@ -365,7 +374,12 @@ static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
 /* Deliver parsed targets: ONE ptc_copy is materialized from the wire
  * payload (the stages then hold refs), each target's dep is released
  * locally.  Shared by the direct ACTIVATE path and the broadcast relay
- * path (which must not pay an extra payload copy per hop). */
+ * path (which must not pay an extra payload copy per hop).  When a
+ * consumer's selecting IN dep declares a wire datatype, the contiguous
+ * wire bytes are scattered into that layout here — per TARGET, since a
+ * batch may mix consumers with different (or no) receive layouts
+ * (relays forward the raw wire form; unpack happens exactly once, at
+ * final delivery). */
 static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             int32_t flow_idx,
                             std::vector<WireTarget> &&targets,
@@ -374,6 +388,76 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             uint64_t alloc_len = 0) {
   if (alloc_len == 0) alloc_len = plen;
   ptc_copy *copy = nullptr;
+  /* ptc_has_dtypes: zero-registered-datatype workloads skip the
+   * per-target selection below (it evaluates guards — possibly Python
+   * escapes — on the comm thread) */
+  if (alloc_len > 0 && !targets.empty() && ptc_has_dtypes(ctx)) {
+    /* per-target receive datatype (guard/domain-aware selection) */
+    std::vector<int32_t> dts(targets.size(), -1);
+    bool any_dt = false;
+    for (size_t i = 0; i < targets.size(); i++) {
+      dts[i] = ptc_consumer_recv_dtype(ctx, tp, targets[i].class_id,
+                                       targets[i].params, flow_idx);
+      if (dts[i] >= 0) any_dt = true;
+    }
+    if (any_dt && (plen != alloc_len || device_uid != 0)) {
+      /* device-delivered payload (by-ref, or bytes already landed in the
+       * device cache): scattering would orphan the cache binding, and a
+       * by-ref payload has no host bytes to scatter — loud, not silent */
+      std::fprintf(stderr,
+                   "ptc-comm: consumer declares a receive datatype but the "
+                   "payload rode the device path; delivering raw (declare "
+                   "no IN type or keep the producer on the host path)\n");
+    } else if (any_dt) {
+      /* one materialized copy per distinct receive layout */
+      std::vector<int32_t> done;
+      for (size_t i = 0; i < targets.size(); i++) {
+        int32_t dt = dts[i];
+        bool seen = false;
+        for (int32_t d : done) seen |= (d == dt);
+        if (seen) continue;
+        done.push_back(dt);
+        DtypeDef dtv;
+        const DtypeDef *rdt = ptc_dtype_get(ctx, dt, &dtv) ? &dtv : nullptr;
+        if (rdt && (int64_t)plen != rdt->packed()) {
+          std::fprintf(stderr,
+                       "ptc-comm: payload (%llu B) does not match the "
+                       "consumer datatype's packed size (%lld B); "
+                       "delivering raw\n", (unsigned long long)plen,
+                       (long long)rdt->packed());
+          rdt = nullptr;
+        }
+        ptc_copy *c = new ptc_copy();
+        if (rdt) {
+          c->size = rdt->extent();
+          c->ptr = std::malloc((size_t)c->size);
+          c->owns_ptr = true;
+          std::memset(c->ptr, 0, (size_t)c->size); /* gaps defined */
+          uint8_t *dst = (uint8_t *)c->ptr;
+          for (int64_t k = 0; k < rdt->count; k++)
+            std::memcpy(dst + k * rdt->stride, payload + k * rdt->elem,
+                        (size_t)rdt->elem);
+        } else {
+          c->size = (int64_t)plen;
+          c->ptr = std::malloc((size_t)plen);
+          c->owns_ptr = true;
+          std::memcpy(c->ptr, payload, (size_t)plen);
+        }
+        for (size_t j = i; j < targets.size(); j++) {
+          if (dts[j] != dt) continue;
+          WireTarget &t = targets[j];
+          ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
+                           t.params.size() > 0 ? t.params[0] : 0,
+                           t.params.size() > 1 ? t.params[1] : 0,
+                           (int64_t)plen /* wire bytes, not extent */);
+          ptc_deliver_dep_local(ctx, -1, tp, t.class_id,
+                                std::move(t.params), flow_idx, c);
+        }
+        ptc_copy_release_internal(ctx, c);
+      }
+      return;
+    }
+  }
   if (alloc_len > 0) {
     copy = new ptc_copy();
     copy->ptr = std::malloc((size_t)alloc_len);
@@ -823,7 +907,9 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     if (m.served >= m.expected) { /* last pull: drop the registration */
       ce->mem_reg_bytes.fetch_sub(m.bytes.size(), std::memory_order_relaxed);
       rel = m.src;
-      if (rel) ce->mem_by_copy.erase(rel);
+      if (rel && m.in_by_copy) ce->mem_by_copy.erase(rel);
+      if (rel && m.packed_dtype >= 0)
+        ce->mem_by_packed.erase({rel, m.packed_dtype});
       ce->mem_reg.erase(it);
     }
     g.unlock();
@@ -1288,10 +1374,31 @@ static const CeOps *ce_select(const char *name) {
 /* outgoing hooks (called from core.cpp; no-ops when comm is off)      */
 /* ------------------------------------------------------------------ */
 
+/* gather a strided producer layout into contiguous wire bytes */
+static bool dtype_pack(ptc_context *ctx, int32_t dtype_id,
+                       const ptc_copy *copy, std::vector<uint8_t> &out) {
+  DtypeDef dt;
+  if (!ptc_dtype_get(ctx, dtype_id, &dt)) return false;
+  if (dt.extent() > copy->size) {
+    std::fprintf(stderr,
+                 "ptc-comm: datatype extent %lld exceeds copy size %lld; "
+                 "sending raw\n", (long long)dt.extent(),
+                 (long long)copy->size);
+    return false;
+  }
+  out.resize((size_t)dt.packed());
+  const uint8_t *src = (const uint8_t *)copy->ptr;
+  for (int64_t i = 0; i < dt.count; i++)
+    std::memcpy(out.data() + i * dt.elem, src + i * dt.stride,
+                (size_t)dt.elem);
+  return true;
+}
+
 void ptc_comm_send_activate_batch(
     ptc_context *ctx, uint32_t rank, ptc_taskpool *tp, int32_t flow_idx,
     ptc_copy *copy,
-    const std::vector<std::pair<int32_t, std::vector<int64_t>>> &targets) {
+    const std::vector<std::pair<int32_t, std::vector<int64_t>>> &targets,
+    int32_t send_dtype) {
   CommEngine *ce = ctx->comm;
   if (!ce) {
     static std::atomic<bool> warned{false};
@@ -1312,10 +1419,21 @@ void ptc_comm_send_activate_batch(
     for (int64_t v : t.second) w.i64(v);
   }
   bool has_payload = copy && copy->ptr && copy->size > 0;
+  /* OUT-dep wire datatype: pack the strided layout to contiguous bytes
+   * (host path — a packed send needs host access, so the device by-ref
+   * shortcut is skipped below) */
+  std::vector<uint8_t> packed;
+  bool is_packed = false;
+  if (has_payload && send_dtype >= 0) {
+    ptc_copy_sync_for_host(ctx, copy);
+    is_packed = dtype_pack(ctx, send_dtype, copy, packed);
+  }
+  int64_t payload_size = is_packed ? (int64_t)packed.size() :
+                         (has_payload ? copy->size : 0);
   bool big = has_payload && ce->eager_limit >= 0 &&
-             copy->size > ce->eager_limit;
+             payload_size > ce->eager_limit;
   int64_t dp_tag = 0;
-  if (big && ctx->dp_register && copy->handle != 0) {
+  if (big && !is_packed && ctx->dp_register && copy->handle != 0) {
     /* device-resident source: advertise a transfer tag; the payload never
      * touches this host's memory (the loopback transport serves a d2h at
      * pull time; on a pod this is the ICI ride).  0 = no current mirror,
@@ -1338,61 +1456,88 @@ void ptc_comm_send_activate_batch(
     w.u64((uint64_t)copy->size);
   } else if (big) {
     /* host rendezvous: register a snapshot once per copy (fan-out ranks
-     * share it — per-rank payload dedup) and advertise the handle */
-    ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshotting */
+     * share it — per-rank payload dedup) and advertise the handle.
+     * Packed sends register a layout-specific snapshot (no cross-dep
+     * sharing: another dep may pack the same copy differently). */
+    if (!is_packed)
+      ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshot */
     uint64_t h;
     {
       std::lock_guard<std::mutex> g(ce->lock);
-      auto itc = ce->mem_by_copy.find(copy);
-      if (itc != ce->mem_by_copy.end()) {
-        h = itc->second;
-        ce->mem_reg[h].expected++;
+      bool found = false;
+      if (is_packed) {
+        auto itp = ce->mem_by_packed.find({copy, send_dtype});
+        if (itp != ce->mem_by_packed.end()) {
+          h = itp->second;
+          ce->mem_reg[h].expected++;
+          found = true;
+        }
       } else {
+        auto itc = ce->mem_by_copy.find(copy);
+        if (itc != ce->mem_by_copy.end()) {
+          h = itc->second;
+          ce->mem_reg[h].expected++;
+          found = true;
+        }
+      }
+      if (!found) {
         h = ce->next_handle++;
         MemReg m;
         m.pk = PK_GET;
         m.expected = 1;
         m.src = copy;
         ptc_copy_retain(copy); /* pointer identity pin until last pull */
-        m.bytes.assign((const uint8_t *)copy->ptr,
-                       (const uint8_t *)copy->ptr + copy->size);
+        if (is_packed)
+          m.bytes = std::move(packed);
+        else
+          m.bytes.assign((const uint8_t *)copy->ptr,
+                         (const uint8_t *)copy->ptr + copy->size);
+        m.in_by_copy = !is_packed;
+        m.packed_dtype = is_packed ? send_dtype : -1;
         ce->mem_reg_bytes.fetch_add(m.bytes.size(),
                                     std::memory_order_relaxed);
         ce->mem_reg.emplace(h, std::move(m));
-        ce->mem_by_copy.emplace(copy, h);
+        if (is_packed)
+          ce->mem_by_packed.emplace(std::make_pair(copy, send_dtype), h);
+        else
+          ce->mem_by_copy.emplace(copy, h);
       }
     }
     w.u8(PK_GET);
     w.u64(h);
-    w.u64((uint64_t)copy->size);
+    w.u64((uint64_t)payload_size);
   } else {
-    ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
+    if (!is_packed)
+      ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
     w.u8(PK_EAGER);
-    w.u64((uint64_t)copy->size);
-    w.raw(copy->ptr, (size_t)copy->size);
+    w.u64((uint64_t)payload_size);
+    w.raw(is_packed ? (const void *)packed.data() : copy->ptr,
+          (size_t)payload_size);
   }
   frame_finish(f);
   for (const auto &t : targets)
     ptc_prof_instant(ctx, PROF_KEY_COMM_SEND, (int64_t)t.first,
                      t.second.size() > 0 ? t.second[0] : 0,
-                     t.second.size() > 1 ? t.second[1] : 0,
-                     copy ? copy->size : 0);
+                     t.second.size() > 1 ? t.second[1] : 0, payload_size);
   comm_post(ce, rank, std::move(f));
 }
 
 void ptc_comm_send_activate(ptc_context *ctx, uint32_t rank, ptc_taskpool *tp,
                             int32_t class_id,
                             const std::vector<int64_t> &params,
-                            int32_t flow_idx, ptc_copy *copy) {
+                            int32_t flow_idx, ptc_copy *copy,
+                            int32_t send_dtype) {
   std::vector<std::pair<int32_t, std::vector<int64_t>>> targets;
   targets.emplace_back(class_id, params);
-  ptc_comm_send_activate_batch(ctx, rank, tp, flow_idx, copy, targets);
+  ptc_comm_send_activate_batch(ctx, rank, tp, flow_idx, copy, targets,
+                               send_dtype);
 }
 
 void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
                                   int32_t flow_idx, ptc_copy *copy,
                                   int32_t topo,
-                                  std::vector<PtcBcastRankGroup> &&groups) {
+                                  std::vector<PtcBcastRankGroup> &&groups,
+                                  int32_t send_dtype) {
   CommEngine *ce = ctx->comm;
   if (!ce) {
     static std::atomic<bool> warned{false};
@@ -1424,10 +1569,21 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
     }
     wire.push_back(std::move(wg));
   }
+  /* OUT-dep wire datatype: pack once; all hops forward the packed wire
+   * form, each consumer unpacks at final delivery (deliver_targets) */
+  std::vector<uint8_t> packed;
+  bool is_packed = false;
+  if (copy && copy->ptr && copy->size > 0 && send_dtype >= 0) {
+    ptc_copy_sync_for_host(ctx, copy);
+    is_packed = dtype_pack(ctx, send_dtype, copy, packed);
+  }
   const uint8_t *payload =
-      (copy && copy->ptr && copy->size > 0) ? (const uint8_t *)copy->ptr
-                                            : nullptr;
-  uint64_t plen = payload ? (uint64_t)copy->size : 0;
+      is_packed ? packed.data()
+                : ((copy && copy->ptr && copy->size > 0)
+                       ? (const uint8_t *)copy->ptr
+                       : nullptr);
+  uint64_t plen = is_packed ? (uint64_t)packed.size()
+                            : (payload ? (uint64_t)copy->size : 0);
   bool big = payload && ce->eager_limit >= 0 &&
              (int64_t)plen > (int64_t)ce->eager_limit;
   size_t nframes = bcast_frame_count(wire.size(), (uint8_t)topo);
@@ -1435,9 +1591,10 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
     /* rendezvous broadcast: advertise a handle, let the direct children
      * pull (and re-root for theirs) — a big tile never rides the
      * ACTIVATE frames, and a device-resident tile is never materialized
-     * on this host (PK_DEVICE) */
+     * on this host (PK_DEVICE; skipped for packed sends, which need the
+     * host form) */
     int64_t tag = 0;
-    if (ctx->dp_register && copy->handle != 0)
+    if (!is_packed && ctx->dp_register && copy->handle != 0)
       for (size_t q = 0; q < nframes; q++)
         tag = ctx->dp_register(ctx->dp_user, copy->handle,
                                copy->version.load(), copy->size);
@@ -1453,37 +1610,59 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
                    PK_DEVICE, dp_h, nullptr, plen);
       return;
     }
-    ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshotting */
+    if (!is_packed)
+      ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshot */
     uint64_t h;
     {
       /* share the per-copy snapshot with point-to-point sends (and with
        * other broadcasts of the same copy): one mem_by_copy entry, one
-       * byte buffer, expected bumped per pull */
+       * byte buffer, expected bumped per pull.  Packed sends register a
+       * layout-specific snapshot (no cross-dep sharing). */
       std::lock_guard<std::mutex> g(ce->lock);
-      auto itc = ce->mem_by_copy.find(copy);
-      if (itc != ce->mem_by_copy.end()) {
-        h = itc->second;
-        ce->mem_reg[h].expected += (int32_t)nframes;
+      bool found = false;
+      if (is_packed) {
+        auto itp = ce->mem_by_packed.find({copy, send_dtype});
+        if (itp != ce->mem_by_packed.end()) {
+          h = itp->second;
+          ce->mem_reg[h].expected += (int32_t)nframes;
+          found = true;
+        }
       } else {
+        auto itc = ce->mem_by_copy.find(copy);
+        if (itc != ce->mem_by_copy.end()) {
+          h = itc->second;
+          ce->mem_reg[h].expected += (int32_t)nframes;
+          found = true;
+        }
+      }
+      if (!found) {
         h = ce->next_handle++;
         MemReg m;
         m.pk = PK_GET;
         m.expected = (int32_t)nframes;
         m.src = copy;
         ptc_copy_retain(copy);
-        m.bytes.assign((const uint8_t *)copy->ptr,
-                       (const uint8_t *)copy->ptr + copy->size);
+        if (is_packed)
+          m.bytes = std::move(packed);
+        else
+          m.bytes.assign((const uint8_t *)copy->ptr,
+                         (const uint8_t *)copy->ptr + copy->size);
+        m.in_by_copy = !is_packed;
+        m.packed_dtype = is_packed ? send_dtype : -1;
         ce->mem_reg_bytes.fetch_add(m.bytes.size(),
                                     std::memory_order_relaxed);
         ce->mem_reg.emplace(h, std::move(m));
-        ce->mem_by_copy.emplace(copy, h);
+        if (is_packed)
+          ce->mem_by_packed.emplace(std::make_pair(copy, send_dtype), h);
+        else
+          ce->mem_by_copy.emplace(copy, h);
       }
     }
     bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, PK_GET, h,
                  nullptr, plen);
     return;
   }
-  if (payload)
+  if (payload && !is_packed)
     ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
   bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
                payload ? PK_EAGER : PK_NONE, 0, payload, plen);

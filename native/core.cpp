@@ -30,6 +30,10 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
+#if defined(__linux__)
+#include <pthread.h>
+#include <sched.h>
+#endif
 #include <cstdlib>
 
 int64_t ptc_now_ns() {
@@ -73,6 +77,7 @@ ptc_context::~ptc_context() {
   for (auto *q : dev_queues) delete q;
   for (auto *p : prof) delete p;
   for (auto *c : worker_executed) delete c;
+  for (auto *c : worker_cpu) delete c;
   delete sched;
   ptc_task *t = free_list;
   while (t) {
@@ -206,7 +211,8 @@ struct SpecReader {
 static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
   SpecReader r{spec, spec + len};
   int64_t version = r.next();
-  if (version != 1) return false;
+  /* v2 adds a wire-datatype id per dep after the arena slot */
+  if (version != 1 && version != 2) return false;
   int64_t nb_locals = r.next();
   if (nb_locals < 0 || nb_locals > PTC_MAX_LOCALS) return false;
   for (int64_t i = 0; i < nb_locals; i++) {
@@ -260,6 +266,7 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
         for (int64_t k = 0; k < ni && r.ok; k++) dep.idx.push_back(r.expr());
       }
       dep.arena_id = (int32_t)r.next();
+      if (version >= 2) dep.dtype_id = (int32_t)r.next();
       if (dep.direction == 0)
         fl.in_deps.push_back(std::move(dep));
       else
@@ -624,6 +631,7 @@ struct RemoteSend {
   uint32_t rank;
   int32_t flow_idx;
   ptc_copy *copy;
+  int32_t send_dtype; /* OUT dep's wire datatype, -1 = raw bytes */
   std::vector<std::pair<int32_t, std::vector<int64_t>>> targets;
 };
 
@@ -651,26 +659,34 @@ static uint32_t successor_rank(ptc_context *ctx, ptc_taskpool *tp,
 static void deliver_dep(ptc_context *ctx, int worker, ptc_taskpool *tp,
                         int32_t class_id, std::vector<int64_t> &&params,
                         int32_t flow_idx, ptc_copy *copy,
-                        std::vector<RemoteSend> *batch) {
+                        std::vector<RemoteSend> *batch,
+                        int32_t send_dtype = -1) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
   uint32_t rank = successor_rank(ctx, tp, tc, params);
   if (rank != ctx->myrank) {
     if (batch) {
       for (RemoteSend &rs : *batch) {
-        if (rs.rank == rank && rs.flow_idx == flow_idx && rs.copy == copy) {
+        if (rs.rank == rank && rs.flow_idx == flow_idx && rs.copy == copy &&
+            rs.send_dtype == send_dtype) {
           rs.targets.emplace_back(class_id, std::move(params));
           return;
         }
       }
-      batch->push_back(RemoteSend{rank, flow_idx, copy, {}});
+      batch->push_back(RemoteSend{rank, flow_idx, copy, send_dtype, {}});
       batch->back().targets.emplace_back(class_id, std::move(params));
     } else {
-      ptc_comm_send_activate(ctx, rank, tp, class_id, params, flow_idx, copy);
+      ptc_comm_send_activate(ctx, rank, tp, class_id, params, flow_idx, copy,
+                             send_dtype);
     }
     return;
   }
+  /* local successors read the producer's copy directly: wire datatypes
+   * apply only at the rank boundary (reference does the same — the
+   * datatype engine sits in the remote-dep path).  release_deps already
+   * domain-checked these params (domain_checked=true skips the re-check
+   * — with dynamic bounds it would re-fire Python escape evaluations). */
   ptc_deliver_dep_local(ctx, worker, tp, class_id, std::move(params),
-                        flow_idx, copy);
+                        flow_idx, copy, /*domain_checked=*/true);
 }
 
 } // namespace
@@ -734,12 +750,51 @@ static int64_t dense_index(const DenseDeps &dd,
 
 } // namespace
 
+/* locked copy-out of a datatype definition (registration may reallocate
+ * the vector concurrently on another thread) */
+bool ptc_dtype_get(ptc_context *ctx, int32_t id, DtypeDef *out) {
+  if (id < 0) return false;
+  std::lock_guard<std::mutex> g(ctx->reg_lock);
+  if ((size_t)id >= ctx->dtypes.size()) return false;
+  *out = ctx->dtypes[(size_t)id];
+  return true;
+}
+
+bool ptc_has_dtypes(ptc_context *ctx) {
+  return ctx->has_dtypes.load(std::memory_order_acquire);
+}
+
+/* The wire datatype of the IN dep that selects this delivery for one
+ * consumer instance (guard- and domain-aware, same selection rule as
+ * count_task_inputs), or -1.  Used by the comm layer to scatter wire
+ * bytes into the consumer's layout (reference: per-dep MPI datatype
+ * selection on the receive side, remote_dep_mpi.c). */
+int32_t ptc_consumer_recv_dtype(ptc_context *ctx, ptc_taskpool *tp,
+                                int32_t class_id,
+                                const std::vector<int64_t> &params,
+                                int32_t flow_idx) {
+  if (class_id < 0 || (size_t)class_id >= tp->classes.size()) return -1;
+  const TaskClass &tc = tp->classes[(size_t)class_id];
+  if (flow_idx < 0 || (size_t)flow_idx >= tc.flows.size()) return -1;
+  int nb_locals = (int)tc.locals.size();
+  int64_t locals[PTC_MAX_LOCALS] = {0};
+  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+    locals[tc.range_locals[(size_t)i]] = params[i];
+  fill_derived_locals(ctx, tp, tc, locals);
+  const Flow &fl = tc.flows[(size_t)flow_idx];
+  if (fl.flags & PTC_FLOW_CTL) return -1;
+  const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
+                                    tp->globals.data());
+  return sel ? sel->dtype_id : -1;
+}
+
 void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
                            int32_t class_id, std::vector<int64_t> &&params,
-                           int32_t flow_idx, ptc_copy *copy) {
+                           int32_t flow_idx, ptc_copy *copy,
+                           bool domain_checked) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
 
-  if (!task_params_in_domain(ctx, tp, tc, params)) {
+  if (!domain_checked && !task_params_in_domain(ctx, tp, tc, params)) {
     /* out-of-domain successor: dropped by JDF semantics (see
      * task_params_in_domain).  Not an error. */
     return;
@@ -911,7 +966,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
           prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
           deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
                       d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
-                      &batch);
+                      &batch, d.dtype_id);
         } else {
           /* nested iteration over up to a few range params */
           struct R { int64_t lo, hi, st, cur; };
@@ -939,7 +994,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
               deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
                           d.peer_flow,
                           (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
-                          &batch);
+                          &batch, d.dtype_id);
             }
             /* advance odometer */
             size_t i = 0;
@@ -981,7 +1036,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
   if (topo == 0) {
     for (RemoteSend &rs : batch)
       ptc_comm_send_activate_batch(ctx, rs.rank, tp, rs.flow_idx, rs.copy,
-                                   rs.targets);
+                                   rs.targets, rs.send_dtype);
   } else {
     /* chain/binomial propagation: sends of the SAME output copy to several
      * ranks become one broadcast the comm layer forwards along the
@@ -994,7 +1049,8 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
       for (size_t j = i + 1; j < batch.size(); j++) {
         if (batch[j].rank != UINT32_MAX &&
             batch[j].flow_idx == batch[i].flow_idx &&
-            batch[j].copy == batch[i].copy) {
+            batch[j].copy == batch[i].copy &&
+            batch[j].send_dtype == batch[i].send_dtype) {
           groups.push_back(
               PtcBcastRankGroup{batch[j].rank, std::move(batch[j].targets)});
           batch[j].rank = UINT32_MAX;
@@ -1002,11 +1058,12 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
       }
       if (groups.size() >= 2) {
         ptc_comm_send_activate_bcast(ctx, tp, batch[i].flow_idx,
-                                     batch[i].copy, topo, std::move(groups));
+                                     batch[i].copy, topo, std::move(groups),
+                                     batch[i].send_dtype);
       } else {
         ptc_comm_send_activate_batch(ctx, batch[i].rank, tp,
                                      batch[i].flow_idx, batch[i].copy,
-                                     groups[0].targets);
+                                     groups[0].targets, batch[i].send_dtype);
       }
       batch[i].rank = UINT32_MAX;
     }
@@ -1437,9 +1494,41 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
   fail_task(ctx, t);
 }
 
+/* Pin this thread to one core (reference: the hwloc thread-binding layer,
+ * parsec/parsec_hwloc.c + bindthread.c — workers bound round-robin over
+ * the allowed cpuset).  Returns the bound cpu or -1. */
+static int bind_worker_thread(int worker) {
+#if defined(__linux__)
+  cpu_set_t allowed;
+  CPU_ZERO(&allowed);
+  if (sched_getaffinity(0, sizeof(allowed), &allowed) != 0) return -1;
+  int ncpu = CPU_COUNT(&allowed);
+  if (ncpu <= 0) return -1;
+  int pick = worker % ncpu, seen = 0, cpu = -1;
+  for (int c = 0; c < CPU_SETSIZE; c++) {
+    if (!CPU_ISSET(c, &allowed)) continue;
+    if (seen++ == pick) { cpu = c; break; }
+  }
+  if (cpu < 0) return -1;
+  cpu_set_t one;
+  CPU_ZERO(&one);
+  CPU_SET(cpu, &one);
+  if (pthread_setaffinity_np(pthread_self(), sizeof(one), &one) != 0)
+    return -1;
+  return cpu;
+#else
+  (void)worker;
+  return -1;
+#endif
+}
+
 /* worker main loop (reference: __parsec_context_wait,
  * parsec/scheduling.c:535-666) */
 static void worker_main(ptc_context *ctx, int worker) {
+  if (ctx->bind_mode == 1) {
+    int cpu = bind_worker_thread(worker);
+    ctx->worker_cpu[(size_t)worker]->store(cpu, std::memory_order_relaxed);
+  }
   int misses = 0;
   while (!ctx->shutdown.load(std::memory_order_acquire)) {
     ptc_task *t = ctx->sched->select(worker);
@@ -1648,6 +1737,7 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
   for (int i = 0; i < nb_workers; i++) {
     ctx->prof.push_back(new ProfBuf());
     ctx->worker_executed.push_back(new std::atomic<int64_t>(0));
+    ctx->worker_cpu.push_back(new std::atomic<int32_t>(-1));
   }
   if (const char *e = std::getenv("PTC_MCA_deptable_dense_max"))
     ctx->dense_max_slots = std::atoll(e);
@@ -1720,6 +1810,15 @@ void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes) {
   ctx->nodes = nodes ? nodes : 1;
 }
 
+void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode) {
+  ctx->bind_mode = mode;
+}
+
+int32_t ptc_worker_binding(ptc_context_t *ctx, int32_t worker) {
+  if (worker < 0 || (size_t)worker >= ctx->worker_cpu.size()) return -1;
+  return ctx->worker_cpu[(size_t)worker]->load(std::memory_order_relaxed);
+}
+
 int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user) {
   std::lock_guard<std::mutex> g(ctx->reg_lock);
   ctx->expr_cbs.push_back({cb, user});
@@ -1767,6 +1866,15 @@ int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size) {
   a->elem_size = elem_size;
   ctx->arenas.push_back(a);
   return (int32_t)ctx->arenas.size() - 1;
+}
+
+int32_t ptc_register_datatype(ptc_context_t *ctx, int64_t elem_bytes,
+                              int64_t count, int64_t stride_bytes) {
+  if (elem_bytes <= 0 || count <= 0 || stride_bytes < elem_bytes) return -1;
+  std::lock_guard<std::mutex> g(ctx->reg_lock);
+  ctx->dtypes.push_back(DtypeDef{elem_bytes, count, stride_bytes});
+  ctx->has_dtypes.store(true, std::memory_order_release);
+  return (int32_t)ctx->dtypes.size() - 1;
 }
 
 ptc_taskpool_t *ptc_tp_new(ptc_context_t *ctx, int32_t nb_globals,

@@ -144,8 +144,25 @@ int32_t ptc_register_linear_collection(ptc_context_t *ctx, uint32_t nodes,
 /* arena: size-class allocator for WRITE-only flow outputs */
 int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size);
 
+/* wire datatype: `count` blocks of `elem_bytes` spaced `stride_bytes`
+ * apart (contiguous when stride == elem).  Attached per dep (JDF
+ * `[type = name]`): OUT deps pack to contiguous wire bytes, IN deps
+ * scatter into the consumer layout — the MPI-datatype analog
+ * (reference: parsec/datatype/datatype_mpi.c).  SPMD creation order
+ * defines the id, like arenas/collections. */
+int32_t ptc_register_datatype(ptc_context_t *ctx, int64_t elem_bytes,
+                              int64_t count, int64_t stride_bytes);
+
 /* set my rank / world for affinity filtering (default 0/1) */
 void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes);
+
+/* worker thread binding (reference: parsec_hwloc.c + bindthread.c):
+ * mode 0 = unbound (default), 1 = round-robin core pinning over the
+ * process's allowed cpuset.  Call before the first taskpool runs. */
+void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode);
+/* the cpu worker w was bound to, or -1 (unbound / binding failed /
+ * worker not started yet) */
+int32_t ptc_worker_binding(ptc_context_t *ctx, int32_t worker);
 
 /* ------------------------------------------------------- taskpool */
 ptc_taskpool_t *ptc_tp_new(ptc_context_t *ctx, int32_t nb_globals,

@@ -85,6 +85,21 @@ struct Dep {
   int32_t dc_id = -1;
   std::vector<Expr> idx;
   int32_t arena_id = -1;
+  /* wire datatype (JDF `[type = ...]`): OUT deps pack the producer's
+   * strided layout to contiguous wire bytes, IN deps scatter wire bytes
+   * into the consumer's layout (reference: the MPI datatype construction
+   * per dep, parsec/datatype/datatype_mpi.c) */
+  int32_t dtype_id = -1;
+};
+
+/* strided-vector wire datatype: `count` blocks of `elem` bytes spaced
+ * `stride` bytes apart in memory; contiguous when stride == elem */
+struct DtypeDef {
+  int64_t elem = 0, count = 0, stride = 0;
+  int64_t packed() const { return elem * count; }
+  int64_t extent() const {
+    return count > 0 ? (count - 1) * stride + elem : 0;
+  }
 };
 
 struct Flow {
@@ -388,6 +403,9 @@ struct ptc_context {
   std::vector<BodyCb> body_cbs;
   std::vector<Collection *> collections;
   std::vector<Arena *> arenas;
+  std::vector<DtypeDef> dtypes; /* wire datatypes — ALWAYS read via
+                                 * ptc_dtype_get (reg_lock-guarded) */
+  std::atomic<bool> has_dtypes{false};
   std::vector<DeviceQueue *> dev_queues;
   std::mutex reg_lock;
 
@@ -446,6 +464,10 @@ struct ptc_context {
    * scheduled/retired counters + per-thread rusage dumps,
    * parsec/scheduling.c:45-86,319-323) */
   std::vector<std::atomic<int64_t> *> worker_executed;
+  /* thread binding (hwloc analog): 0 = unbound, 1 = round-robin core
+   * pinning; worker_cpu[w] = bound cpu id or -1 */
+  int32_t bind_mode = 0;
+  std::vector<std::atomic<int32_t> *> worker_cpu;
 
   /* communication engine (nullptr when single-process) */
   CommEngine *comm = nullptr;
@@ -484,10 +506,27 @@ void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
                       int64_t l0, int64_t l1, int64_t aux);
 
 /* deliver one dependency release to a local successor instance (the
- * incoming half of the remote ACTIVATE path calls this) */
+ * incoming half of the remote ACTIVATE path calls this).
+ * domain_checked = true skips the re-validation when the caller (the
+ * local release path) already ran task_params_in_domain — wire arrivals
+ * must leave it false (defense against malformed frames). */
 void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
                            int32_t class_id, std::vector<int64_t> &&params,
-                           int32_t flow_idx, ptc_copy *copy);
+                           int32_t flow_idx, ptc_copy *copy,
+                           bool domain_checked = false);
+
+/* the selecting IN dep's wire datatype for one consumer instance, or -1
+ * (guard/domain-aware; comm receive-side scatter) */
+int32_t ptc_consumer_recv_dtype(ptc_context *ctx, ptc_taskpool *tp,
+                                int32_t class_id,
+                                const std::vector<int64_t> &params,
+                                int32_t flow_idx);
+
+/* copy a datatype definition out under reg_lock (registration may
+ * reallocate the vector concurrently); false when id is invalid */
+bool ptc_dtype_get(ptc_context *ctx, int32_t id, DtypeDef *out);
+/* true when any datatype is registered (cheap comm-path early-out) */
+bool ptc_has_dtypes(ptc_context *ctx);
 
 /* DTD: complete a shadow task whose remote original finished; `payload`
  * holds the serialized written-tile contents (comm.cpp framing:
@@ -504,11 +543,13 @@ void ptc_dtd_apply_complete(ptc_context *ctx, ptc_task *t,
 /* ------------------------------------------------------------------ */
 
 /* outgoing PTG activation: deliver (class_id, params, flow, copy bytes) to
- * `rank`'s matching taskpool */
+ * `rank`'s matching taskpool.  send_dtype >= 0 packs the producer copy's
+ * strided layout (ctx->dtypes[send_dtype]) to contiguous wire bytes. */
 void ptc_comm_send_activate(ptc_context *ctx, uint32_t rank, ptc_taskpool *tp,
                             int32_t class_id,
                             const std::vector<int64_t> &params,
-                            int32_t flow_idx, ptc_copy *copy);
+                            int32_t flow_idx, ptc_copy *copy,
+                            int32_t send_dtype = -1);
 
 /* batched form: several successor instances sharing one payload copy
  * (reference: per-rank output bitmaps, parsec/remote_dep.h:143-177) */
@@ -522,12 +563,14 @@ struct PtcBcastRankGroup {
 void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
                                   int32_t flow_idx, ptc_copy *copy,
                                   int32_t topo,
-                                  std::vector<PtcBcastRankGroup> &&groups);
+                                  std::vector<PtcBcastRankGroup> &&groups,
+                                  int32_t send_dtype = -1);
 
 void ptc_comm_send_activate_batch(
     ptc_context *ctx, uint32_t rank, ptc_taskpool *tp, int32_t flow_idx,
     ptc_copy *copy,
-    const std::vector<std::pair<int32_t, std::vector<int64_t>>> &targets);
+    const std::vector<std::pair<int32_t, std::vector<int64_t>>> &targets,
+    int32_t send_dtype = -1);
 
 /* replay activations that arrived before `tp` was registered locally */
 void ptc_comm_drain_early(ptc_context *ctx, ptc_taskpool *tp);

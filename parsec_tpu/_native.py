@@ -115,6 +115,8 @@ _sigs = {
     "ptc_context_test": (C.c_int32, [C.c_void_p]),
     "ptc_context_set_scheduler": (C.c_int32, [C.c_void_p, C.c_char_p]),
     "ptc_context_set_rank": (None, [C.c_void_p, C.c_uint32, C.c_uint32]),
+    "ptc_context_set_binding": (None, [C.c_void_p, C.c_int32]),
+    "ptc_worker_binding": (C.c_int32, [C.c_void_p, C.c_int32]),
     "ptc_register_expr_cb": (C.c_int32, [C.c_void_p, EXPR_CB_T, C.c_void_p]),
     "ptc_register_body": (C.c_int32, [C.c_void_p, BODY_CB_T, C.c_void_p]),
     "ptc_register_collection": (C.c_int32, [C.c_void_p, C.c_uint32, C.c_uint32,
@@ -123,6 +125,8 @@ _sigs = {
                                                    C.c_uint32, C.c_void_p,
                                                    C.c_int64, C.c_int64]),
     "ptc_register_arena": (C.c_int32, [C.c_void_p, C.c_int64]),
+    "ptc_register_datatype": (C.c_int32, [C.c_void_p, C.c_int64, C.c_int64,
+                                          C.c_int64]),
     "ptc_tp_new": (C.c_void_p, [C.c_void_p, C.c_int32, C.POINTER(C.c_int64)]),
     "ptc_tp_destroy": (None, [C.c_void_p]),
     "ptc_tp_add_class": (C.c_int32, [C.c_void_p, C.c_char_p,

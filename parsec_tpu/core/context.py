@@ -61,6 +61,8 @@ class Context:
         if _mca.get("runtime.pins"):
             from ..profiling.pins import enable_from_param
             enable_from_param(self, _mca.get("runtime.pins"))
+        if _mca.get("runtime.bind") == "core":
+            N.lib.ptc_context_set_binding(self._ptr, 1)
         # keep-alives: ctypes callbacks must outlive the native context
         self._expr_cbs: List = []
         self._body_cbs: List = []
@@ -69,6 +71,7 @@ class Context:
         self._buffers: List[np.ndarray] = []
         self.collections: Dict[str, int] = {}
         self.arenas: Dict[str, int] = {}
+        self.datatypes: Dict[str, int] = {}
         self._devices: List = []  # TpuDevice instances (stopped on destroy)
         self._colocated: set = set()  # ranks sharing this accel client
         self._destroyed = False
@@ -293,6 +296,32 @@ class Context:
         aid = N.lib.ptc_register_arena(self._ptr, elem_size)
         self.arenas[name] = aid
         return aid
+
+    def worker_binding(self, worker: int) -> int:
+        """CPU the worker thread is pinned to (runtime.bind=core), or -1
+        when unbound / not yet started (reference: parsec_hwloc.c)."""
+        return N.lib.ptc_worker_binding(self._ptr, worker)
+
+    def register_datatype(self, name: str, elem_bytes: int, count: int,
+                          stride_bytes: Optional[int] = None) -> int:
+        """Wire datatype: `count` blocks of `elem_bytes` spaced
+        `stride_bytes` apart (default contiguous).  Attach per dep
+        (In/Out dtype= or JDF `[type = name]`): OUT deps pack to
+        contiguous wire bytes, IN deps scatter into the consumer layout
+        — the MPI-datatype layer analog (reference:
+        parsec/datatype/datatype_mpi.c; SURVEY §2.5 datatype row).
+        Register in the same order on every rank (SPMD ids)."""
+        if stride_bytes is None:
+            stride_bytes = elem_bytes
+        did = N.lib.ptc_register_datatype(self._ptr, elem_bytes, count,
+                                          stride_bytes)
+        if did < 0:
+            raise ValueError(
+                f"bad datatype {name!r}: elem={elem_bytes} count={count} "
+                f"stride={stride_bytes} (need elem>0, count>0, "
+                "stride>=elem)")
+        self.datatypes[name] = did
+        return did
 
     # ------------------------------------------------------------ devices
     def device_queue_set_weight(self, qid: int, weight: float):

@@ -48,18 +48,22 @@ class Mem:
 
 
 class _Dep:
-    def __init__(self, direction: int, target, guard: Optional[ExprLike]):
+    def __init__(self, direction: int, target, guard: Optional[ExprLike],
+                 dtype: Optional[str] = None):
         self.direction = direction
         self.target = target  # Ref | Mem | None
         self.guard = guard
+        self.dtype = dtype  # wire datatype name (Context.register_datatype)
 
 
-def In(target=None, guard: Optional[ExprLike] = None) -> _Dep:
-    return _Dep(0, target, guard)
+def In(target=None, guard: Optional[ExprLike] = None,
+       dtype: Optional[str] = None) -> _Dep:
+    return _Dep(0, target, guard, dtype)
 
 
-def Out(target=None, guard: Optional[ExprLike] = None) -> _Dep:
-    return _Dep(1, target, guard)
+def Out(target=None, guard: Optional[ExprLike] = None,
+        dtype: Optional[str] = None) -> _Dep:
+    return _Dep(1, target, guard, dtype)
 
 
 class _Flow:
@@ -150,7 +154,7 @@ class TaskClass:
         locals_map = {n: i for i, (n, _, _) in enumerate(self.locals)}
         cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call,
                           scope=getattr(tp, "jdf_scope", None))
-        spec: List[int] = [1, len(self.locals)]
+        spec: List[int] = [2, len(self.locals)]  # v2: per-dep datatype
         for (_, is_range, payload) in self.locals:
             spec.append(1 if is_range else 0)
             if is_range:
@@ -207,6 +211,13 @@ class TaskClass:
                 else:
                     raise TypeError(f"bad dep target {t!r}")
                 spec.append(-1)  # per-dep arena (reserved)
+                if d.dtype is not None and d.dtype not in tp.ctx.datatypes:
+                    raise ValueError(
+                        f"{self.name}: dep dtype {d.dtype!r} names no "
+                        "registered datatype — call "
+                        "Context.register_datatype first")
+                spec.append(tp.ctx.datatypes[d.dtype]
+                            if d.dtype is not None else -1)
         # chores
         spec.append(len(self.chores))
         for ch in self.chores:

@@ -615,13 +615,20 @@ class JdfTaskpoolBuilder:
             deps = []
             for d in fl.deps:
                 mk = In if d.direction == 0 else Out
+                dt = d.props.get("type")
+                if dt is not None and dt not in self.ctx.datatypes:
+                    raise ValueError(
+                        f"jdf: {jt.name}.{fl.name}: dep [type = {dt}] names "
+                        "no registered datatype "
+                        "(Context.register_datatype)")
                 tgt = _target_to_builder(d.target, fl.name)
                 if d.alt is not None:
                     alt = _target_to_builder(d.alt, fl.name)
-                    deps.append(mk(tgt, guard=d.guard))
-                    deps.append(mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard)))
+                    deps.append(mk(tgt, guard=d.guard, dtype=dt))
+                    deps.append(mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard),
+                                   dtype=dt))
                 else:
-                    deps.append(mk(tgt, guard=d.guard))
+                    deps.append(mk(tgt, guard=d.guard, dtype=dt))
             tc.flow(fl.name, fl.access, *deps,
                     arena=self.arenas.get(fl.name))
         self._attach_bodies(jt, tc)

@@ -151,6 +151,10 @@ dump_help = params.dump_help
 # away (PTC_MCA_runtime_sched=lfq).
 register("runtime.sched", "lws", str,
          "scheduler module (reference: --mca sched <m>)")
+register("runtime.bind", "none", str,
+         "worker thread binding: none|core — core pins workers "
+         "round-robin over the allowed cpuset (reference: the hwloc "
+         "binding layer, parsec_hwloc.c/bindthread.c)")
 register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")
 register("runtime.profile", False, bool, "enable event tracing at init")

@@ -538,6 +538,69 @@ def dtd_chain_counting_termdet(rank: int, nodes: int, port: int,
         ctx.comm_fini()
 
 
+def ptg_datatype_column(rank: int, nodes: int, port: int,
+                        eager_limit: int | None = None):
+    """Wire-datatype layer (reference: parsec/datatype/datatype_mpi.c —
+    per-dep MPI types for non-contiguous cross-rank movement): rank 0
+    owns a row-major 8x8 int64 tile and sends its COLUMN 0 (elem 8 B,
+    count 8, stride 64 B) to rank 1, whose IN dep scatters the 8 packed
+    values into a strided receive layout (stride 16 B: every other
+    int64).  eager_limit=0 forces the GET rendezvous path so both wire
+    forms are covered."""
+    import os
+
+    if eager_limit is not None:
+        os.environ["PTC_MCA_comm_eager_limit"] = str(eager_limit)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        n = 8
+        tile_bytes = n * n * 8
+        buf = np.zeros(n * n, dtype=np.int64)
+        if rank == 0:
+            buf[:] = np.arange(n * n)  # value at (i, j) = i*n + j
+        ctx.register_linear_collection("A", buf, elem_size=tile_bytes,
+                                       nodes=nodes, myrank=rank)
+        # SPMD-ordered datatype registration (ids must match across ranks)
+        ctx.register_datatype("colT", 8, n, n * 8)   # column of the tile
+        ctx.register_datatype("recvT", 8, n, 16)     # every other slot
+        tp = pt.Taskpool(ctx, globals={})
+        prod = tp.task_class("Prod")
+        prod.param("z", 0, 0)
+        prod.affinity("A", 0)
+        prod.flow("T", "RW",
+                  pt.In(pt.Mem("A", 0)),
+                  pt.Out(pt.Ref("Cons", 1, flow="X"), dtype="colT"))
+        prod.body(lambda view: None)
+        cons = tp.task_class("Cons")
+        cons.param("z", 1, 1)
+        cons.affinity("A", 1)
+        cons.flow("X", "READ",
+                  pt.In(pt.Ref("Prod", 0, flow="T"), dtype="recvT"))
+        got = []
+
+        def cons_body(view):
+            got.append(view.data("X", dtype=np.int64).copy())
+
+        cons.body(cons_body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        if rank == 1 % nodes:
+            assert len(got) == 1, got
+            x = got[0]
+            # extent = (8-1)*16 + 8 = 120 B -> 15 int64 slots
+            assert x.size == 15, x.size
+            col = np.arange(n) * n  # column 0 of the row-major tile
+            np.testing.assert_array_equal(x[0::2], col)
+            np.testing.assert_array_equal(x[1::2], 0)
+        if eager_limit == 0:
+            # the payload must have ridden the rendezvous, not the frame
+            st = ctx.comm_rdv_stats()
+            key = "gets_sent" if rank == 1 % nodes else "gets_served"
+            assert st.get(key, 0) >= 1 or nodes == 1, st
+        ctx.comm_fini()
+
+
 def fence_lost_peer(rank: int, nodes: int, port: int):
     """Rank 1 tears down without fencing (crash stand-in: its connection
     just closes); rank 0's fence must ERROR (peer-lost detection) instead

@@ -159,6 +159,17 @@ def test_dtd_counting_termdet_device_async():
               device=True)
 
 
+def test_datatype_column_eager_2ranks():
+    """Non-contiguous cross-rank movement: OUT dep packs a tile column,
+    IN dep scatters into a different strided layout (eager wire form)."""
+    _run_spmd(_workers.ptg_datatype_column, 2)
+
+
+def test_datatype_column_rendezvous_2ranks():
+    """Same layout change with the payload on the GET rendezvous path."""
+    _run_spmd(_workers.ptg_datatype_column, 2, eager_limit=0)
+
+
 def test_fence_errors_on_lost_peer():
     """A crashed rank fails the survivors' fence instead of hanging it."""
     _run_spmd(_workers.fence_lost_peer, 2, timeout=120.0)

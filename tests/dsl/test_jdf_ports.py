@@ -386,6 +386,46 @@ END
             compile_jdf(src, ctx, globals={"NX": 2})
 
 
+def test_jdf_dep_type_property_resolves_datatype():
+    """JDF `[type = name]` on a dep binds the registered wire datatype
+    (reference: per-dep MPI datatype selection); an unregistered name
+    fails at build."""
+    src = """
+NX [ type="int" ]
+P(k)
+k = 0 .. NX
+: D(k)
+RW A <- D(k)
+     -> A Q(k)        [type = colT]
+BODY
+{
+pass
+}
+END
+
+Q(k)
+k = 0 .. NX
+: D(k)
+READ A <- A P(k)      [type = colT]
+BODY
+{
+pass
+}
+END
+"""
+    buf = np.zeros(4, dtype=np.int64)
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_linear_collection("D", buf, elem_size=8)
+        with pytest.raises(ValueError, match="no registered datatype"):
+            compile_jdf(src, ctx, globals={"NX": 2}, dtype=np.int64)
+        ctx.register_datatype("colT", 8, 1)
+        b = compile_jdf(src, ctx, globals={"NX": 2}, dtype=np.int64)
+        b.run().wait()
+        # the dtype id landed on the task-class deps
+        tc = b.tp.class_by_name("P")
+        assert any(d.dtype == "colT" for f in tc.flows for d in f.deps)
+
+
 def test_jdf_unbound_pointer_global_rejected():
     """A pointer-typed global with no collection/value/prologue binding and
     no late_bound promise must fail at build, not evaluate to 0 at run."""

@@ -1,0 +1,39 @@
+"""Worker thread binding (reference: the hwloc binding layer,
+parsec/parsec_hwloc.c + bindthread.c — workers pinned round-robin over
+the allowed cpuset, selected by an MCA parameter)."""
+import os
+
+import parsec_tpu as pt
+from parsec_tpu.utils import params as mca
+
+
+def _run_small_pool(ctx):
+    tp = pt.Taskpool(ctx, globals={"NB": 7})
+    tc = tp.task_class("T")
+    tc.param("k", 0, pt.G("NB"))
+    tc.body_noop()
+    tp.run()
+    tp.wait()
+
+
+def test_bind_core_pins_workers(monkeypatch):
+    monkeypatch.setenv("PTC_MCA_runtime_bind", "core")
+    mca.reload_files()
+    try:
+        allowed = sorted(os.sched_getaffinity(0))
+        with pt.Context(nb_workers=2) as ctx:
+            _run_small_pool(ctx)
+            cpus = [ctx.worker_binding(w) for w in range(2)]
+        # every worker bound to a cpu from the allowed set, round-robin
+        for w, c in enumerate(cpus):
+            assert c == allowed[w % len(allowed)], (cpus, allowed)
+    finally:
+        monkeypatch.delenv("PTC_MCA_runtime_bind")
+        mca.reload_files()
+
+
+def test_unbound_by_default():
+    with pt.Context(nb_workers=1) as ctx:
+        _run_small_pool(ctx)
+        assert ctx.worker_binding(0) == -1
+        assert ctx.worker_binding(99) == -1  # out of range is safe
