@@ -211,17 +211,23 @@ struct SpecReader {
 static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
   SpecReader r{spec, spec + len};
   int64_t version = r.next();
-  /* v2 adds a wire-datatype id per dep after the arena slot */
-  if (version != 1 && version != 2) return false;
+  /* v2 adds a wire-datatype id per dep after the arena slot;
+   * v3 adds comprehension locals (kind 2) + per-dep iterator lists */
+  if (version < 1 || version > 3) return false;
   int64_t nb_locals = r.next();
   if (nb_locals < 0 || nb_locals > PTC_MAX_LOCALS) return false;
   for (int64_t i = 0; i < nb_locals; i++) {
     Local l;
-    l.is_range = r.next() != 0;
-    if (l.is_range) {
+    int64_t kind = r.next();
+    if (kind == 1 || kind == 2) {
+      l.is_range = true;
       l.lo = r.expr();
       l.hi = r.expr();
       l.st = r.expr();
+      if (kind == 2) {
+        l.is_compr = true;
+        l.value = r.expr();
+      }
       tc.range_locals.push_back((int32_t)i);
     } else {
       l.value = r.expr();
@@ -267,6 +273,17 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
       }
       dep.arena_id = (int32_t)r.next();
       if (version >= 2) dep.dtype_id = (int32_t)r.next();
+      if (version >= 3) {
+        int64_t ni = r.next();
+        if (ni < 0 || nb_locals + ni > PTC_MAX_LOCALS) return false;
+        for (int64_t k = 0; k < ni && r.ok; k++) {
+          DepIter di;
+          di.lo = r.expr();
+          di.hi = r.expr();
+          di.st = r.expr();
+          dep.iters.push_back(std::move(di));
+        }
+      }
       if (dep.direction == 0)
         fl.in_deps.push_back(std::move(dep));
       else
@@ -409,6 +426,30 @@ static inline bool in_range(int64_t v, int64_t lo, int64_t hi, int64_t st) {
   return v <= lo && v >= hi && (lo - v) % (-st) == 0;
 }
 
+/* True when the expression depends on nothing but pool globals and ONE
+ * local slot (a comprehension value reading its iterator) — no escapes,
+ * no other locals. */
+static bool expr_const_except_slot(const Expr &e, int64_t slot) {
+  const std::vector<int64_t> &c = e.code;
+  for (size_t i = 0; i < c.size(); i++) {
+    switch (c[i]) {
+    case PTC_OP_LOCAL:
+      if (i + 1 >= c.size() || c[i + 1] != slot) return false;
+      i++;
+      break;
+    case PTC_OP_CALL:
+      return false;
+    case PTC_OP_IMM:
+    case PTC_OP_GLOBAL:
+      i++;
+      break;
+    default:
+      break;
+    }
+  }
+  return true;
+}
+
 /* Is `params` inside the class's enumerated parameter domain?  The
  * reference's generated iterate_successors/predecessors bound-check every
  * peer (jdf2c emits per-param min/max guards around each release), so an
@@ -433,6 +474,10 @@ static bool task_params_in_domain(ptc_context *ctx, ptc_taskpool *tp,
         const Local &l = tc.locals[(size_t)tc.range_locals[(size_t)i]];
         constb = expr_pool_const(l.lo) && expr_pool_const(l.hi) &&
                  expr_pool_const(l.st);
+        if (constb && l.is_compr)
+          /* cacheable when the value maps nothing but its own iterator
+           * slot (+ globals): the whole value set is fixed per pool */
+          constb = expr_const_except_slot(l.value, tc.range_locals[i]);
       }
       /* derived locals feeding nothing here: const bounds read none */
       if (constb) {
@@ -440,13 +485,36 @@ static bool task_params_in_domain(ptc_context *ctx, ptc_taskpool *tp,
         tc.domain_lo.resize(nb_range);
         tc.domain_hi.resize(nb_range);
         tc.domain_st.resize(nb_range);
-        for (size_t i = 0; i < nb_range; i++) {
+        tc.domain_vals.assign(nb_range, {});
+        for (size_t i = 0; constb && i < nb_range; i++) {
           const Local &l = tc.locals[(size_t)tc.range_locals[(size_t)i]];
-          tc.domain_lo[i] = eval_expr(l.lo, ctx, zero, nb_locals, g);
-          tc.domain_hi[i] = eval_expr(l.hi, ctx, zero, nb_locals, g);
+          int64_t lo = eval_expr(l.lo, ctx, zero, nb_locals, g);
+          int64_t hi = eval_expr(l.hi, ctx, zero, nb_locals, g);
           int64_t st = eval_expr(l.st, ctx, zero, nb_locals, g, 1);
-          tc.domain_st[i] = st ? st : 1;
+          if (st == 0) st = 1;
+          tc.domain_lo[i] = lo;
+          tc.domain_hi[i] = hi;
+          tc.domain_st[i] = st;
+          if (l.is_compr) {
+            int64_t n = (st > 0) ? (hi - lo) / st + 1 : (lo - hi) / (-st) + 1;
+            if (n > 65536) { /* unreasonable value-set: stay dynamic */
+              constb = false;
+              break;
+            }
+            int32_t idx = tc.range_locals[(size_t)i];
+            std::vector<int64_t> &vals = tc.domain_vals[i];
+            for (int64_t it = lo; (st > 0) ? it <= hi : it >= hi; it += st) {
+              zero[idx] = it;
+              vals.push_back(eval_expr(l.value, ctx, zero, nb_locals, g));
+            }
+            zero[idx] = 0;
+            std::sort(vals.begin(), vals.end());
+            /* empty comprehension: in_range on lo>hi rejects everything,
+             * matching the no-instances domain */
+          }
         }
+      }
+      if (constb) {
         tc.domain_cache_state.store(1, std::memory_order_release);
         cs = 1;
       } else {
@@ -458,10 +526,16 @@ static bool task_params_in_domain(ptc_context *ctx, ptc_taskpool *tp,
     }
   }
   if (cs == 1) {
-    for (size_t i = 0; i < nb_range; i++)
-      if (!in_range(params[i], tc.domain_lo[i], tc.domain_hi[i],
-                    tc.domain_st[i]))
+    for (size_t i = 0; i < nb_range; i++) {
+      if (i < tc.domain_vals.size() && !tc.domain_vals[i].empty()) {
+        const std::vector<int64_t> &vals = tc.domain_vals[i];
+        if (!std::binary_search(vals.begin(), vals.end(), params[i]))
+          return false;
+      } else if (!in_range(params[i], tc.domain_lo[i], tc.domain_hi[i],
+                           tc.domain_st[i])) {
         return false;
+      }
+    }
     return true;
   }
   /* dynamic bounds (triangular ranges etc.): evaluate in declaration
@@ -476,6 +550,22 @@ static bool task_params_in_domain(ptc_context *ctx, ptc_taskpool *tp,
     int64_t hi = eval_expr(l.hi, ctx, locals, nb_locals, g);
     int64_t st = eval_expr(l.st, ctx, locals, nb_locals, g, 1);
     if (st == 0) st = 1;
+    if (l.is_compr) {
+      /* membership = some iterator value maps to params[i] (no inverse
+       * in general: walk the iterator range) */
+      int32_t idx = tc.range_locals[(size_t)i];
+      bool found = false;
+      for (int64_t it = lo; (st > 0) ? it <= hi : it >= hi; it += st) {
+        locals[idx] = it;
+        if (eval_expr(l.value, ctx, locals, nb_locals, g) == params[i]) {
+          found = true;
+          break;
+        }
+      }
+      locals[idx] = params[i]; /* restore for later range bounds */
+      if (!found) return false;
+      continue;
+    }
     if (!in_range(params[i], lo, hi, st)) return false;
   }
   return true;
@@ -526,6 +616,31 @@ static const Dep *select_input_dep(ptc_context *ctx, ptc_taskpool *tp,
   return nullptr;
 }
 
+/* Nested-loop walk over a dep's bracketed iterators (JDF local indices):
+ * binds scratch slots nb_locals + k in declaration order — inner bounds
+ * may read outer iterators and are re-evaluated per outer step — and
+ * invokes fn() per combination.  Callers evaluate dep expressions with
+ * count nb_locals + iters so Python escapes see the iterator slots. */
+template <typename F>
+static void walk_dep_iters(ptc_context *ctx, const Dep &d, int64_t *scratch,
+                           int nb_locals, const int64_t *g, F &&fn,
+                           size_t k = 0) {
+  if (k == d.iters.size()) {
+    fn();
+    return;
+  }
+  int nb_eval = nb_locals + (int)k;
+  const DepIter &di = d.iters[k];
+  int64_t lo = eval_expr(di.lo, ctx, scratch, nb_eval, g);
+  int64_t hi = eval_expr(di.hi, ctx, scratch, nb_eval, g);
+  int64_t st = eval_expr(di.st, ctx, scratch, nb_eval, g, 1);
+  if (st == 0) st = 1;
+  for (int64_t v = lo; (st > 0) ? v <= hi : v >= hi; v += st) {
+    scratch[nb_locals + (int)k] = v;
+    walk_dep_iters(ctx, d, scratch, nb_locals, g, fn, k + 1);
+  }
+}
+
 /* Count the task-input dependencies of one task instance: for every non-CTL
  * IN flow the *first* guard-true dep with an existing producer selects the
  * source (JDF alternative semantics); for CTL flows every guard-true input
@@ -545,52 +660,68 @@ static int32_t count_task_inputs(ptc_context *ctx, ptc_taskpool *tp,
     if (fl.flags & PTC_FLOW_CTL) {
       for (const Dep &d : fl.in_deps) {
         if (d.kind != DEP_TASK) continue;
-        if (!eval_guard(d.guard, ctx, locals, nb_locals, g)) continue;
         const TaskClass &peer = tp->classes[(size_t)d.peer_class];
-        size_t np = d.params.size();
-        std::vector<int64_t> vals(np, 0);
-        std::vector<size_t> range_idx;
-        for (size_t i = 0; i < np; i++) {
-          if (d.params[i].is_range)
-            range_idx.push_back(i);
-          else
-            vals[i] = eval_expr(d.params[i].value, ctx, locals, nb_locals, g);
-        }
-        if (range_idx.empty()) {
-          if (task_params_in_domain(ctx, tp, peer, vals)) flow_count += 1;
+        /* producers counted for one guard-true (dep-level) combination */
+        auto count_for = [&](const int64_t *locs, int nb) {
+          size_t np = d.params.size();
+          std::vector<int64_t> vals(np, 0);
+          std::vector<size_t> range_idx;
+          for (size_t i = 0; i < np; i++) {
+            if (d.params[i].is_range)
+              range_idx.push_back(i);
+            else
+              vals[i] = eval_expr(d.params[i].value, ctx, locs, nb, g);
+          }
+          if (range_idx.empty()) {
+            if (task_params_in_domain(ctx, tp, peer, vals)) flow_count += 1;
+            return;
+          }
+          /* odometer over range params, domain-checking each producer */
+          struct R { int64_t lo, hi, st, cur; };
+          std::vector<R> rs;
+          bool live = true;
+          for (size_t ri : range_idx) {
+            const DepParam &pm = d.params[ri];
+            R r;
+            r.lo = eval_expr(pm.lo, ctx, locs, nb, g);
+            r.hi = eval_expr(pm.hi, ctx, locs, nb, g);
+            r.st = eval_expr(pm.st, ctx, locs, nb, g, 1);
+            if (r.st == 0) r.st = 1;
+            r.cur = r.lo;
+            if ((r.st > 0 && r.cur > r.hi) || (r.st < 0 && r.cur < r.hi))
+              live = false;
+            rs.push_back(r);
+          }
+          while (live) {
+            for (size_t i = 0; i < rs.size(); i++)
+              vals[range_idx[i]] = rs[i].cur;
+            if (task_params_in_domain(ctx, tp, peer, vals)) flow_count += 1;
+            size_t lvl = rs.size();
+            while (lvl > 0) {
+              R &r = rs[lvl - 1];
+              r.cur += r.st;
+              bool ok = (r.st > 0) ? r.cur <= r.hi : r.cur >= r.hi;
+              if (ok) break;
+              r.cur = r.lo;
+              lvl--;
+            }
+            if (lvl == 0) live = false;
+          }
+        };
+        if (d.iters.empty()) {
+          if (!eval_guard(d.guard, ctx, locals, nb_locals, g)) continue;
+          count_for(locals, nb_locals);
           continue;
         }
-        /* odometer over range params, domain-checking each producer */
-        struct R { int64_t lo, hi, st, cur; };
-        std::vector<R> rs;
-        bool live = true;
-        for (size_t ri : range_idx) {
-          const DepParam &pm = d.params[ri];
-          R r;
-          r.lo = eval_expr(pm.lo, ctx, locals, nb_locals, g);
-          r.hi = eval_expr(pm.hi, ctx, locals, nb_locals, g);
-          r.st = eval_expr(pm.st, ctx, locals, nb_locals, g, 1);
-          if (r.st == 0) r.st = 1;
-          r.cur = r.lo;
-          if ((r.st > 0 && r.cur > r.hi) || (r.st < 0 && r.cur < r.hi))
-            live = false;
-          rs.push_back(r);
-        }
-        while (live) {
-          for (size_t i = 0; i < rs.size(); i++)
-            vals[range_idx[i]] = rs[i].cur;
-          if (task_params_in_domain(ctx, tp, peer, vals)) flow_count += 1;
-          size_t lvl = rs.size();
-          while (lvl > 0) {
-            R &r = rs[lvl - 1];
-            r.cur += r.st;
-            bool ok = (r.st > 0) ? r.cur <= r.hi : r.cur >= r.hi;
-            if (ok) break;
-            r.cur = r.lo;
-            lvl--;
-          }
-          if (lvl == 0) live = false;
-        }
+        /* bracketed iterators: guard per combination (it may read them) */
+        int nb_ext = nb_locals + (int)d.iters.size();
+        int64_t scratch[PTC_MAX_LOCALS] = {0};
+        std::memcpy(scratch, locals,
+                    sizeof(int64_t) * (size_t)nb_locals);
+        walk_dep_iters(ctx, d, scratch, nb_locals, g, [&]() {
+          if (eval_guard(d.guard, ctx, scratch, nb_ext, g))
+            count_for(scratch, nb_ext);
+        });
       }
     } else {
       const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals, g);
@@ -942,8 +1073,10 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
     if (copy && (fl.flags & PTC_FLOW_WRITE))
       copy->version.fetch_add(1, std::memory_order_relaxed);
     for (const Dep &d : fl.out_deps) {
-      if (!eval_guard(d.guard, ctx, t->locals, nb_locals, g)) continue;
-      if (d.kind == DEP_TASK) {
+      /* one guard-true (dep-level) emission given the locals view `locs`
+       * (the task's own locals, or a scratch copy extended with bracketed
+       * iterator values in slots nb_locals..) */
+      auto emit_task_dep = [&](const int64_t *locs, int nb) {
         /* expand range params (broadcast outputs) */
         size_t np = d.params.size();
         std::vector<int64_t> vals(np, 0);
@@ -953,83 +1086,108 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
         /* evaluate scalar params once */
         for (size_t i = 0; i < np; i++)
           if (!d.params[i].is_range)
-            vals[i] = eval_expr(d.params[i].value, ctx, t->locals, nb_locals, g);
+            vals[i] = eval_expr(d.params[i].value, ctx, locs, nb, g);
         /* out-of-domain successors are dropped HERE, before the edge is
          * traced or the successor's rank is computed: a negative param
          * through a modulo rank_of would index garbage, and a remote
          * send would serialize a frame the receiver immediately drops.
-         * (ptc_deliver_dep_local re-checks as wire defense.) */
+         * (Remote arrivals re-check in ptc_deliver_dep_local as wire
+         * defense; local deliveries skip the re-check.) */
         const TaskClass &peer_tc = tp->classes[(size_t)d.peer_class];
         if (range_idx.empty()) {
           std::vector<int64_t> pv(vals);
-          if (!task_params_in_domain(ctx, tp, peer_tc, pv)) continue;
+          if (!task_params_in_domain(ctx, tp, peer_tc, pv)) return;
           prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
           deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
                       d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
                       &batch, d.dtype_id);
-        } else {
-          /* nested iteration over up to a few range params */
-          struct R { int64_t lo, hi, st, cur; };
-          std::vector<R> rs;
-          for (size_t ri : range_idx) {
-            const DepParam &pm = d.params[ri];
-            R r;
-            r.lo = eval_expr(pm.lo, ctx, t->locals, nb_locals, g);
-            r.hi = eval_expr(pm.hi, ctx, t->locals, nb_locals, g);
-            r.st = eval_expr(pm.st, ctx, t->locals, nb_locals, g, 1);
-            if (r.st == 0) r.st = 1;
-            r.cur = r.lo;
-            rs.push_back(r);
-          }
-          bool live = true;
-          for (const R &r : rs)
-            if ((r.st > 0 && r.cur > r.hi) || (r.st < 0 && r.cur < r.hi))
-              live = false;
-          while (live) {
-            for (size_t i = 0; i < rs.size(); i++)
-              vals[range_idx[i]] = rs[i].cur;
-            std::vector<int64_t> pv(vals);
-            if (task_params_in_domain(ctx, tp, peer_tc, pv)) {
-              prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
-              deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                          d.peer_flow,
-                          (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
-                          &batch, d.dtype_id);
-            }
-            /* advance odometer */
-            size_t i = 0;
-            for (; i < rs.size(); i++) {
-              rs[i].cur += rs[i].st;
-              if ((rs[i].st > 0 && rs[i].cur <= rs[i].hi) ||
-                  (rs[i].st < 0 && rs[i].cur >= rs[i].hi))
-                break;
-              rs[i].cur = rs[i].lo;
-            }
-            if (i == rs.size()) live = false;
-          }
+          return;
         }
-      } else if (d.kind == DEP_MEM && copy && (fl.flags & PTC_FLOW_WRITE)) {
+        /* nested iteration over up to a few range params */
+        struct R { int64_t lo, hi, st, cur; };
+        std::vector<R> rs;
+        for (size_t ri : range_idx) {
+          const DepParam &pm = d.params[ri];
+          R r;
+          r.lo = eval_expr(pm.lo, ctx, locs, nb, g);
+          r.hi = eval_expr(pm.hi, ctx, locs, nb, g);
+          r.st = eval_expr(pm.st, ctx, locs, nb, g, 1);
+          if (r.st == 0) r.st = 1;
+          r.cur = r.lo;
+          rs.push_back(r);
+        }
+        bool live = true;
+        for (const R &r : rs)
+          if ((r.st > 0 && r.cur > r.hi) || (r.st < 0 && r.cur < r.hi))
+            live = false;
+        while (live) {
+          for (size_t i = 0; i < rs.size(); i++)
+            vals[range_idx[i]] = rs[i].cur;
+          std::vector<int64_t> pv(vals);
+          if (task_params_in_domain(ctx, tp, peer_tc, pv)) {
+            prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
+            deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
+                        d.peer_flow,
+                        (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
+                        &batch, d.dtype_id);
+          }
+          /* advance odometer */
+          size_t i = 0;
+          for (; i < rs.size(); i++) {
+            rs[i].cur += rs[i].st;
+            if ((rs[i].st > 0 && rs[i].cur <= rs[i].hi) ||
+                (rs[i].st < 0 && rs[i].cur >= rs[i].hi))
+              break;
+            rs[i].cur = rs[i].lo;
+          }
+          if (i == rs.size()) live = false;
+        }
+      };
+      auto emit_mem_dep = [&](const int64_t *locs, int nb) {
+        if (!copy || !(fl.flags & PTC_FLOW_WRITE)) return;
         int64_t idx[PTC_MAX_LOCALS];
         int ni = (int)d.idx.size();
         for (int i = 0; i < ni; i++)
-          idx[i] = eval_expr(d.idx[(size_t)i], ctx, t->locals, nb_locals, g);
+          idx[i] = eval_expr(d.idx[(size_t)i], ctx, locs, nb, g);
         if (ctx->nodes > 1) {
           uint32_t r = ptc_collection_rank_of(ctx, d.dc_id, idx, ni);
           if (r != ctx->myrank) {
-            ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
+            ptc_copy_sync_for_host(ctx, copy); /* coherence: pull mirror */
             ptc_comm_send_put_mem(ctx, r, d.dc_id, idx, ni, copy);
-            continue;
+            return;
           }
         }
         ptc_data *dst = ptc_collection_data_of(ctx, d.dc_id, idx, ni);
         if (dst && dst->host_copy && dst->host_copy->ptr != copy->ptr) {
-          ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
+          ptc_copy_sync_for_host(ctx, copy); /* coherence: pull mirror */
           std::memcpy(dst->host_copy->ptr, copy->ptr,
                       (size_t)std::min(dst->host_copy->size, copy->size));
         }
         if (dst && dst->host_copy)
           dst->host_copy->version.store(copy->version.load());
+      };
+      auto emit = [&](const int64_t *locs, int nb) {
+        if (d.kind == DEP_TASK)
+          emit_task_dep(locs, nb);
+        else if (d.kind == DEP_MEM)
+          emit_mem_dep(locs, nb);
+      };
+      if (d.iters.empty()) {
+        if (!eval_guard(d.guard, ctx, t->locals, nb_locals, g)) continue;
+        emit(t->locals, nb_locals);
+        continue;
       }
+      /* bracketed iterators (JDF local indices): nested loops binding
+       * scratch slots nb_locals..; the guard is re-evaluated per
+       * combination (it may read the iterators), and inner bounds may
+       * read outer iterators (re-evaluated per outer step) */
+      int nb_ext = nb_locals + (int)d.iters.size();
+      int64_t scratch[PTC_MAX_LOCALS];
+      std::memcpy(scratch, t->locals, sizeof(scratch));
+      walk_dep_iters(ctx, d, scratch, nb_locals, g, [&]() {
+        if (eval_guard(d.guard, ctx, scratch, nb_ext, g))
+          emit(scratch, nb_ext);
+      });
     }
   }
   int32_t topo = ctx->comm_topo.load(std::memory_order_relaxed);
@@ -1576,9 +1734,19 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
   int64_t visited = 0;
 
   /* odometer over range locals, honoring declaration order so later ranges
-   * may reference earlier locals (incl. derived ones in between) */
+   * may reference earlier locals (incl. derived ones in between).  For
+   * comprehension locals `cur` walks the ITERATOR; the slot holds the
+   * mapped value (the value expr reads the slot as the iterator). */
   struct R { int64_t lo, hi, st, cur; };
   std::vector<R> rs(nb_range);
+
+  auto set_slot = [&](size_t i) {
+    const Local &l = tc.locals[(size_t)tc.range_locals[i]];
+    int32_t idx = tc.range_locals[i];
+    locals[idx] = rs[i].cur;
+    if (l.is_compr)
+      locals[idx] = eval_expr(l.value, ctx, locals, nb_locals, g);
+  };
 
   /* recompute range i bounds from current locals */
   auto init_range = [&](size_t i) -> bool {
@@ -1590,8 +1758,10 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
     rs[i].st = eval_expr(l.st, ctx, locals, nb_locals, g, 1);
     if (rs[i].st == 0) rs[i].st = 1;
     rs[i].cur = rs[i].lo;
-    locals[tc.range_locals[i]] = rs[i].cur;
-    return (rs[i].st > 0) ? rs[i].cur <= rs[i].hi : rs[i].cur >= rs[i].hi;
+    bool live =
+        (rs[i].st > 0) ? rs[i].cur <= rs[i].hi : rs[i].cur >= rs[i].hi;
+    if (live) set_slot(i);
+    return live;
   };
 
   auto visit = [&]() {
@@ -1642,9 +1812,11 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
       while (true) {
         R &r = rs[level];
         r.cur += r.st;
-        locals[tc.range_locals[level]] = r.cur;
         bool live = (r.st > 0) ? r.cur <= r.hi : r.cur >= r.hi;
-        if (live) break;
+        if (live) {
+          set_slot(level); /* only live iterators reach the value expr */
+          break;
+        }
         if (level == 0) return;
         level--;
       }

@@ -73,6 +73,13 @@ struct DepParam {
   Expr lo, hi, st; /* when is_range */
 };
 
+/* bound iterator of a bracketed dep (`-> [i = 0..n] A T(f(i))`): its
+ * expressions may read earlier iterators; the iterator value lives in
+ * scratch slot nb_locals + position during dep evaluation */
+struct DepIter {
+  Expr lo, hi, st;
+};
+
 struct Dep {
   int32_t direction = 0; /* 0 in, 1 out */
   Expr guard;            /* empty == always true */
@@ -85,6 +92,9 @@ struct Dep {
   int32_t dc_id = -1;
   std::vector<Expr> idx;
   int32_t arena_id = -1;
+  /* bracketed iterators (JDF local indices); guard and params may read
+   * them via scratch slots */
+  std::vector<DepIter> iters;
   /* wire datatype (JDF `[type = ...]`): OUT deps pack the producer's
    * strided layout to contiguous wire bytes, IN deps scatter wire bytes
    * into the consumer's layout (reference: the MPI datatype construction
@@ -110,8 +120,14 @@ struct Flow {
 
 struct Local {
   bool is_range = false;
-  Expr lo, hi, st; /* range */
-  Expr value;      /* derived */
+  /* comprehension parameter (JDF local indices: `odd = [i = 0..4] 2*i+1`,
+   * tests/dsl/ptg/local-indices): lo/hi/st bound the ITERATOR, and
+   * `value` maps it to the parameter value — compiled to read the
+   * local's own slot, which holds the iterator during evaluation and
+   * the mapped value afterwards. */
+  bool is_compr = false;
+  Expr lo, hi, st; /* range bounds, or comprehension iterator bounds */
+  Expr value;      /* derived value, or comprehension map expr */
 };
 
 struct Chore {
@@ -141,6 +157,10 @@ struct TaskClass {
    * fixed).  state: 0 unknown, 1 cached, 2 dynamic bounds. */
   mutable std::atomic<int> domain_cache_state{0};
   mutable std::vector<int64_t> domain_lo, domain_hi, domain_st;
+  /* per range-local sorted value set for POOL-CONST comprehension
+   * parameters (membership by binary search instead of an O(range)
+   * re-evaluation walk); empty vector = plain range, use lo/hi/st */
+  mutable std::vector<std::vector<int64_t>> domain_vals;
   TaskClass() = default;
   TaskClass(const TaskClass &o)
       : name(o.name), id(o.id), locals(o.locals),

@@ -33,6 +33,10 @@ FLOW_WRITE = 2
 FLOW_RW = 3
 FLOW_CTL = 4
 
+# must mirror PTC_MAX_LOCALS / PTC_MAX_FLOWS (native/parsec_core.h:30-31)
+MAX_LOCALS = 20
+MAX_FLOWS = 20
+
 BODY_NOOP = 0
 BODY_CB = 1
 BODY_DEVICE = 2

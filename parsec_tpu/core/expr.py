@@ -175,6 +175,21 @@ class Range:
         self.lo, self.hi, self.step = _wrap(lo), _wrap(hi), _wrap(step)
 
 
+class Compr:
+    """Comprehension parameter space (JDF local indices,
+    `odd = [i = 0..4] 2*i+1`): the parameter takes value(iterator) for
+    each iterator in lo..hi..step.  The value expression reads the
+    parameter's OWN slot as the iterator (it holds the iterator during
+    evaluation); `iter_name` additionally aliases that slot so JDF
+    sources can reference the iterator by its declared name."""
+
+    def __init__(self, lo: ExprLike, hi: ExprLike, value: ExprLike,
+                 step: ExprLike = 1, iter_name: Optional[str] = None):
+        self.lo, self.hi, self.step = _wrap(lo), _wrap(hi), _wrap(step)
+        self.value = _wrap(value)
+        self.iter_name = iter_name
+
+
 class CompileCtx:
     """Name→index resolution + Python-callback registration for one class."""
 

@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .. import _native as N
-from .expr import CompileCtx, Expr, ExprLike, Range, compile_expr
+from .expr import Compr, CompileCtx, Expr, ExprLike, Range, compile_expr
 
 ACCESS = {"READ": N.FLOW_READ, "WRITE": N.FLOW_WRITE, "RW": N.FLOW_RW,
           "CTL": N.FLOW_CTL, "R": N.FLOW_READ, "W": N.FLOW_WRITE}
@@ -49,21 +49,25 @@ class Mem:
 
 class _Dep:
     def __init__(self, direction: int, target, guard: Optional[ExprLike],
-                 dtype: Optional[str] = None):
+                 dtype: Optional[str] = None, iters=None):
         self.direction = direction
         self.target = target  # Ref | Mem | None
         self.guard = guard
         self.dtype = dtype  # wire datatype name (Context.register_datatype)
+        # bracketed iterators (JDF local indices): [(name, lo, hi, step)];
+        # guard and target expressions may reference the names, bounds may
+        # reference earlier iterators
+        self.iters = list(iters or [])
 
 
 def In(target=None, guard: Optional[ExprLike] = None,
-       dtype: Optional[str] = None) -> _Dep:
-    return _Dep(0, target, guard, dtype)
+       dtype: Optional[str] = None, iters=None) -> _Dep:
+    return _Dep(0, target, guard, dtype, iters)
 
 
 def Out(target=None, guard: Optional[ExprLike] = None,
-        dtype: Optional[str] = None) -> _Dep:
-    return _Dep(1, target, guard, dtype)
+        dtype: Optional[str] = None, iters=None) -> _Dep:
+    return _Dep(1, target, guard, dtype, iters)
 
 
 class _Flow:
@@ -98,6 +102,18 @@ class TaskClass:
               step: ExprLike = 1) -> "TaskClass":
         """Declare a range parameter (JDF `k = lo .. hi .. step`)."""
         self.locals.append((name, True, Range(lo, hi, step)))
+        return self
+
+    def param_compr(self, name: str, lo: ExprLike, hi: ExprLike,
+                    value: ExprLike, step: ExprLike = 1,
+                    iter_name: Optional[str] = None) -> "TaskClass":
+        """Comprehension parameter (JDF local indices,
+        `name = [i = lo..hi..step] value(i)`).  `value` reads the
+        iterator through L(name) — the parameter's slot holds the
+        iterator while the value expression runs — or through
+        `iter_name` when given (JDF sources name the iterator)."""
+        self.locals.append(
+            (name, True, Compr(lo, hi, value, step, iter_name)))
         return self
 
     def local(self, name: str, value: ExprLike) -> "TaskClass":
@@ -154,14 +170,30 @@ class TaskClass:
         locals_map = {n: i for i, (n, _, _) in enumerate(self.locals)}
         cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call,
                           scope=getattr(tp, "jdf_scope", None))
-        spec: List[int] = [2, len(self.locals)]  # v2: per-dep datatype
-        for (_, is_range, payload) in self.locals:
-            spec.append(1 if is_range else 0)
-            if is_range:
+        # v3: comprehension locals (kind 2) + per-dep iterators + dtype
+        spec: List[int] = [3, len(self.locals)]
+        for (name, is_range, payload) in self.locals:
+            if isinstance(payload, Compr):
+                spec.append(2)
+                spec += compile_expr(payload.lo, cctx)
+                spec += compile_expr(payload.hi, cctx)
+                spec += compile_expr(payload.step, cctx)
+                # the value expr reads this local's slot as the iterator;
+                # alias the declared iterator name onto the same slot
+                vctx = cctx
+                if payload.iter_name:
+                    vmap = dict(locals_map)
+                    vmap[payload.iter_name] = locals_map[name]
+                    vctx = CompileCtx(vmap, tp.globals_map,
+                                      tp._register_call, scope=cctx.scope)
+                spec += compile_expr(payload.value, vctx)
+            elif is_range:
+                spec.append(1)
                 spec += compile_expr(payload.lo, cctx)
                 spec += compile_expr(payload.hi, cctx)
                 spec += compile_expr(payload.step, cctx)
             else:
+                spec.append(0)
                 spec += compile_expr(payload, cctx)
         # affinity
         if self._affinity is not None:
@@ -179,7 +211,32 @@ class TaskClass:
             spec += [fl.access, arena_id, len(fl.deps)]
             for d in fl.deps:
                 spec.append(d.direction)
-                spec += compile_expr(d.guard, cctx)
+                # bracketed iterators bind scratch slots nb_locals..; the
+                # guard and target expressions compile against the
+                # extended name map, and iterator k's own bounds see only
+                # earlier iterators
+                dctx = cctx
+                iter_bound_ctxs = []
+                if d.iters:
+                    if d.direction == 0 and fl.access != N.FLOW_CTL:
+                        raise ValueError(
+                            f"{self.name}.{fl.name}: bracketed iterators "
+                            "on a data IN dep are not supported (a data "
+                            "flow has one source); CTL gathers and OUT "
+                            "deps only")
+                    if len(self.locals) + len(d.iters) > N.MAX_LOCALS:
+                        raise ValueError(
+                            f"{self.name}: locals + dep iterators exceed "
+                            f"the {N.MAX_LOCALS}-slot limit")
+                    emap = dict(locals_map)
+                    for k, (iname, _, _, _) in enumerate(d.iters):
+                        iter_bound_ctxs.append(
+                            CompileCtx(dict(emap), tp.globals_map,
+                                       tp._register_call, scope=cctx.scope))
+                        emap[iname] = len(self.locals) + k
+                    dctx = CompileCtx(emap, tp.globals_map,
+                                      tp._register_call, scope=cctx.scope)
+                spec += compile_expr(d.guard, dctx)
                 t = d.target
                 if t is None:
                     spec.append(0)  # DEP_NONE
@@ -198,16 +255,16 @@ class TaskClass:
                     for p in t.params:
                         if isinstance(p, Range):
                             spec.append(1)
-                            spec += compile_expr(p.lo, cctx)
-                            spec += compile_expr(p.hi, cctx)
-                            spec += compile_expr(p.step, cctx)
+                            spec += compile_expr(p.lo, dctx)
+                            spec += compile_expr(p.hi, dctx)
+                            spec += compile_expr(p.step, dctx)
                         else:
                             spec.append(0)
-                            spec += compile_expr(p, cctx)
+                            spec += compile_expr(p, dctx)
                 elif isinstance(t, Mem):
                     spec += [2, tp.ctx.collections[t.collection], len(t.idx)]
                     for e in t.idx:
-                        spec += compile_expr(e, cctx)
+                        spec += compile_expr(e, dctx)
                 else:
                     raise TypeError(f"bad dep target {t!r}")
                 spec.append(-1)  # per-dep arena (reserved)
@@ -218,6 +275,11 @@ class TaskClass:
                         "Context.register_datatype first")
                 spec.append(tp.ctx.datatypes[d.dtype]
                             if d.dtype is not None else -1)
+                spec.append(len(d.iters))
+                for k, (_, lo, hi, step) in enumerate(d.iters):
+                    spec += compile_expr(lo, iter_bound_ctxs[k])
+                    spec += compile_expr(hi, iter_bound_ctxs[k])
+                    spec += compile_expr(step, iter_bound_ctxs[k])
         # chores
         spec.append(len(self.chores))
         for ch in self.chores:

@@ -101,20 +101,32 @@ class JdfGlobal:
 
 
 class JdfDepTarget:
-    def __init__(self, kind, name=None, flow=None, args=None):
+    def __init__(self, kind, name=None, flow=None, args=None, iters=None):
         self.kind = kind  # "task" | "mem" | "new" | "null"
         self.name = name  # task or collection name
         self.flow = flow  # flow name on the peer (task kind)
         self.args = args or []
+        self.iters = iters or []  # target-level bracketed iterators
 
 
 class JdfDep:
-    def __init__(self, direction, guard, target, alt=None, props=None):
+    def __init__(self, direction, guard, target, alt=None, props=None,
+                 iters=None):
         self.direction = direction  # 0 in, 1 out
         self.guard = guard          # Expr | None
         self.target = target        # JdfDepTarget
         self.alt = alt              # else-branch target
         self.props = props or {}    # [type=.. layout=.. count=.. displ=..]
+        self.iters = iters or []    # dep-level bracketed iterators
+
+
+class JdfCompr:
+    """Comprehension local: name = [ it = lo .. hi .. st ] value."""
+
+    def __init__(self, iter_name, lo, hi, st, value):
+        self.iter_name = iter_name
+        self.lo, self.hi, self.st = lo, hi, st
+        self.value = value
 
 
 class JdfFlow:
@@ -284,6 +296,19 @@ class _Parser:
             if t.kind == "id" and self.peek(1).val == "=":
                 nm = self.next().val
                 self.expect("=")
+                if self._at_iter_bracket():
+                    # comprehension local (local indices):
+                    #   nm = [ it = lo .. hi [.. st] ] value
+                    its = self._parse_iters()
+                    if len(its) != 1:
+                        raise SyntaxError(
+                            "jdf: comprehension locals take exactly one "
+                            "iterator")
+                    it_name, lo, hi, st = its[0]
+                    val = self._parse_expr()
+                    task.locals.append(
+                        (nm, JdfCompr(it_name, lo, hi, st, val)))
+                    continue
                 first = self._parse_expr()
                 if self.accept(".."):
                     hi = self._parse_expr()
@@ -330,12 +355,35 @@ class _Parser:
             fl.deps.append(self._parse_dep(direction))
         return fl
 
+    def _at_iter_bracket(self) -> bool:
+        """A '[' opening an iterator list: `[ id = ... ]` (dep properties
+        also look like `[ id = ... ]` but only appear AFTER a target)."""
+        return (self.peek().val == "[" and self.peek(1).kind == "id"
+                and self.peek(2).val == "=")
+
+    def _parse_iters(self):
+        """[ i = lo .. hi [.. st] (, j = ...)* ]"""
+        its = []
+        self.expect("[")
+        while True:
+            name = self.next().val
+            self.expect("=")
+            lo = self._parse_expr()
+            self.expect("..")
+            hi = self._parse_expr()
+            st = self._parse_expr() if self.accept("..") else 1
+            its.append((name, lo, hi, st))
+            if not self.accept(","):
+                break
+        self.expect("]")
+        return its
+
     def _parse_dep(self, direction: int) -> JdfDep:
         guard = None
         alt = None
-        # `(guard) ? target [: target]`  — need lookahead: a '(' could also
-        # open a parenthesized expression... in JDF a dep starts either with
-        # '(' guard or an identifier (flow/coll/NEW/NULL).
+        # dep-level bracketed iterators (local indices):
+        #   [ i = 0 .. odd ] guard ? target : target
+        iters = self._parse_iters() if self._at_iter_bracket() else []
         if self.peek().val == "(" or self.peek().kind == "escape":
             # or-level, not ternary: the dep's own `?` must stay unconsumed.
             # A %{ ... %} escape can itself be the whole guard (reference:
@@ -346,12 +394,37 @@ class _Parser:
             if self.accept(":"):
                 alt = self._parse_target()
         else:
-            target = self._parse_target()
+            # unparenthesized guards (`odd < 4 ? A t(..) : ...`,
+            # tests/dsl/ptg/local-indices) are indistinguishable from a
+            # target without lookahead: try guard-form, backtrack to
+            # target-form (a bare flow name never survives expect('?')).
+            # When BOTH forms fail, report whichever parse got further —
+            # the shorter one's error points at the wrong token.
+            save = self.i
+            try:
+                guard = self._or()
+                self.expect("?")
+                target = self._parse_target()
+                if self.accept(":"):
+                    alt = self._parse_target()
+            except SyntaxError as guard_err:
+                guard_pos = self.i
+                self.i = save
+                guard = None
+                try:
+                    target = self._parse_target()
+                except SyntaxError:
+                    if guard_pos > self.i:
+                        self.i = guard_pos
+                        raise guard_err from None
+                    raise
         # trailing dep properties: [type = X displ_remote = e ...]
         props = self._parse_props() if self.peek().val == "[" else {}
-        return JdfDep(direction, guard, target, alt, props)
+        return JdfDep(direction, guard, target, alt, props, iters)
 
     def _parse_target(self) -> JdfDepTarget:
+        # target-level iterators: `? [ j = 0 .. e .. 2 ] A tA(...)`
+        iters = self._parse_iters() if self._at_iter_bracket() else []
         t = self.next()
         if t.kind != "id":
             raise SyntaxError(f"jdf: bad dep target {t.val!r}")
@@ -366,7 +439,7 @@ class _Parser:
             while not self.accept(")"):
                 args.append(self._parse_range_or_expr())
                 self.accept(",")
-            return JdfDepTarget("mem", name=t.val, args=args)
+            return JdfDepTarget("mem", name=t.val, args=args, iters=iters)
         # flow Task(args)
         flow = t.val
         tname = self.next().val
@@ -375,7 +448,8 @@ class _Parser:
         while not self.accept(")"):
             args.append(self._parse_range_or_expr())
             self.accept(",")
-        return JdfDepTarget("task", name=tname, flow=flow, args=args)
+        return JdfDepTarget("task", name=tname, flow=flow, args=args,
+                            iters=iters)
 
     def _parse_body(self) -> JdfBody:
         """Bodies are pre-extracted (their code is Python, not lexable as
@@ -501,7 +575,11 @@ class _PyEscape(E.Expr):
         self._names: List[str] = []
 
     def _emit(self, out, ctx):
-        names = {v: k for k, v in ctx.locals.items()}
+        # one slot may carry several names (a comprehension parameter and
+        # its iterator alias both bind to the parameter's slot)
+        names: Dict[int, List[str]] = {}
+        for name, idx in ctx.locals.items():
+            names.setdefault(idx, []).append(name)
         code = compile(self.code, "<jdf-escape>", "eval")
         scope = ctx.scope  # live dict: later caller bindings stay visible
 
@@ -510,8 +588,9 @@ class _PyEscape(E.Expr):
             # scope (it can be large), and later caller bindings stay
             # visible; int globals and task locals shadow it via env
             env = dict(globs)
-            env.update({names[i]: v for i, v in enumerate(locs)
-                        if i in names})
+            for i, v in enumerate(locs):
+                for n in names.get(i, ()):
+                    env[n] = v
             return int(eval(code, scope if scope is not None else {}, env))
 
         cb_id = ctx.register_call(fn)
@@ -603,7 +682,10 @@ class JdfTaskpoolBuilder:
         if "startup_fn" in jt.props:
             self._startup_hooks.append((jt.name, jt.props["startup_fn"]))
         for (nm, payload) in jt.locals:
-            if isinstance(payload, E.Range):
+            if isinstance(payload, JdfCompr):
+                tc.param_compr(nm, payload.lo, payload.hi, payload.value,
+                               payload.st, iter_name=payload.iter_name)
+            elif isinstance(payload, E.Range):
                 tc.locals.append((nm, True, payload))
             else:
                 tc.locals.append((nm, False, payload))
@@ -622,13 +704,14 @@ class JdfTaskpoolBuilder:
                         "no registered datatype "
                         "(Context.register_datatype)")
                 tgt = _target_to_builder(d.target, fl.name)
+                its = d.iters + d.target.iters  # dep-level outer
                 if d.alt is not None:
                     alt = _target_to_builder(d.alt, fl.name)
-                    deps.append(mk(tgt, guard=d.guard, dtype=dt))
+                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its))
                     deps.append(mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard),
-                                   dtype=dt))
+                                   dtype=dt, iters=d.iters + d.alt.iters))
                 else:
-                    deps.append(mk(tgt, guard=d.guard, dtype=dt))
+                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its))
             tc.flow(fl.name, fl.access, *deps,
                     arena=self.arenas.get(fl.name))
         self._attach_bodies(jt, tc)

@@ -386,6 +386,126 @@ END
             compile_jdf(src, ctx, globals={"NX": 2})
 
 
+LOCAL_INDICES = """
+extern "C" %{
+# sparse execution domains via local indices
+%}
+
+descA            [type = "parsec_matrix_block_cyclic_t*"]
+MT               [type = "int"]
+NT               [type = "int"]
+
+STARTUP(odd, even)
+
+odd = [ i = 0 .. %{ return 4 %} ] %{ return 2*i+1 %}
+even = [ i = 0 .. 4 ] 2*i
+
+: descA( ((odd/2) % MT) * NT + ((even/2) % NT) )
+
+READ A <- descA( ((odd/2) % MT) * NT + ((even/2) % NT) )
+       -> [ i = 0 .. odd ] odd < 4 ? [ j = 0 .. %{ return even %} .. 2 ] A tA(odd, even, %{ return i %}, j/2) : [ j = 0 .. even .. 2 ] A tB(odd, even, i, j/2)
+
+CTL  X <- [ i = 0 .. odd ] i == -1 ? X STARTUP(0, 0)
+       -> [ i = 0 .. odd ] i == -1 ? X STARTUP(0, 0)
+       -> Y tG(0)
+
+BODY
+{
+counts["STARTUP"] += 1
+}
+END
+
+tG(zero)
+
+zero = 0 .. 0
+
+: descA(0)
+
+CTL Y <- [ i = 0 .. 4, j = 0 .. 4 ] i >= 0 ? X STARTUP(2*i+1, 2*j)
+
+BODY
+{
+counts["tG"] += 1
+}
+END
+
+tA(o, e, i, j)
+
+o = [ k = 0 .. 4 ] 2*k+1
+e = [ k = 0 .. 4 ] 2*k
+i = 0 .. o < 4 ? o : -1
+j = 0 .. e / 2
+
+: descA( (i % MT) * NT + (j % NT) )
+
+READ A <- A STARTUP(o, e)
+
+BODY
+{
+counts["tA"] += 1
+}
+END
+
+tB(o, e, i, j)
+
+o = [ k = 0 .. 4 ] 2*k+1
+e = [ k = 0 .. 4 ] 2*k
+i = 6 .. o
+j = 0 .. e / 2
+
+: descA( (i % MT) * NT + (j % NT) )
+
+READ A <- A STARTUP(o, e)
+        -> o == 7 && e == 0 && i == 7 && j == 0 ? [ l = 1 .. 2 ] A tC(l, 2*l .. 3*l)
+
+BODY
+{
+counts["tB"] += 1
+}
+END
+
+tC(l1, l2)
+
+l1 = 1 .. 2
+l2 = 2*l1 .. 3*l1
+
+: descA( (l1 % MT) * NT + (l2 % NT) )
+
+READ A <- A tB(7, 0, 7, 0)
+
+BODY
+{
+counts["tC"] += 1
+}
+END
+"""
+
+
+def test_jdf_local_indices_port():
+    """Port of tests/dsl/ptg/local-indices/local_indices.jdf: sparse
+    execution domains via comprehension parameters (`odd = [i=0..4]
+    2*i+1`), bracketed dep/target iterators with per-iteration guards,
+    escape expressions reading iterators, out-of-domain sends dropped by
+    range semantics (tB receives only i >= 6), unparenthesized multi-term
+    dep guards, and iterator+range-param targets (tC)."""
+    MT, NT = 3, 2
+    buf = np.zeros(MT * NT, dtype=np.int64)
+    counts = {"STARTUP": 0, "tG": 0, "tA": 0, "tB": 0, "tC": 0}
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_linear_collection("descA", buf, elem_size=8)
+        b = compile_jdf(LOCAL_INDICES, ctx,
+                        globals={"MT": MT, "NT": NT}, dtype=np.int64)
+        b.scope["counts"] = counts
+        tp = b.run()
+        tp.wait()
+    # 25 STARTUP (5 odd x 5 even); tA for odd in {1,3}: (o+1)*(e/2+1)
+    # summed = (2+4)*15 = 90; tB domain i = 6..o -> o in {7,9}: (2+4)*15
+    # = 90; tC: l2 = 2..3 and 4..6 -> 5; tG gathers all 25 STARTUPs.
+    assert counts == {"STARTUP": 25, "tG": 1, "tA": 90, "tB": 90,
+                      "tC": 5}, counts
+    assert tp.nb_total_tasks == 25 + 1 + 90 + 90 + 5
+
+
 def test_jdf_dep_type_property_resolves_datatype():
     """JDF `[type = name]` on a dep binds the registered wire datatype
     (reference: per-dep MPI datatype selection); an unregistered name

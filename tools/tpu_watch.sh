@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the TPU tunnel; when it comes back, run the spotrf bench ladder
+# and leave results in /tmp/spotrf_r3.jsonl.  One rung per probe cycle so
+# a mid-ladder wedge still records earlier rungs.
+cd /root/repo
+OUT=/tmp/spotrf_r3.jsonl
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel alive" >> $OUT
+    for cfg in "16384 1024" "32768 512" "65536 512"; do
+      set -- $cfg
+      echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 start" >> $OUT
+      PTC_BENCH_PROFILE=1 timeout 2400 python bench.py --spotrf-child \
+        --n $1 --nb $2 >> $OUT 2>&1
+      echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 rc=$?" >> $OUT
+    done
+    exit 0
+  fi
+  sleep 300
+done
+echo "watcher gave up" >> $OUT
