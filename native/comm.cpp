@@ -176,6 +176,10 @@ struct MemReg {
    * mapping on last pull) */
   bool in_by_copy = false;
   int32_t packed_dtype = -1; /* >= 0: mem_by_packed[{src, dtype}] == h */
+  /* one entry per expected pull: the rank expected to issue it.  Lets
+   * mark_peer_lost reap registrations whose puller died (a crashed
+   * consumer would otherwise pin the snapshot/device tile forever). */
+  std::vector<uint32_t> targets;
 };
 
 /* receiver side: a dep delivery whose payload is still being pulled */
@@ -189,6 +193,7 @@ struct BcastWireGroup {
 struct PendingGet {
   int32_t tp_id;
   int32_t flow_idx;
+  uint32_t src_rank = UINT32_MAX; /* the rank we are pulling from */
   std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
   uint8_t pk;
   /* broadcast-relay rendezvous: once the pull resolves, deliver locally
@@ -297,6 +302,14 @@ namespace {
 static void comm_wake(CommEngine *ce) { ce->ops->wake(ce); }
 
 /* enqueue a finished frame for `rank` (worker threads call this) */
+/* true when `rank` has been marked lost; ce->lock must be held — this
+ * linearizes against mark_peer_lost's reap: a registration made under
+ * the same lock either sees the flag (and skips) or is visible to the
+ * subsequent reap.  No TOCTOU window. */
+static bool peer_lost_locked(CommEngine *ce, uint32_t rank) {
+  return rank < ce->peer_lost.size() && ce->peer_lost[rank];
+}
+
 static void comm_post(CommEngine *ce, uint32_t rank,
                       std::vector<uint8_t> &&frame) {
   bool is_ctl = frame.size() > 4 &&
@@ -357,8 +370,14 @@ static std::vector<WireTarget> parse_targets(Reader &r, uint32_t nb_targets) {
 static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
                                  uint64_t src_handle, PendingGet &&pg) {
   uint64_t cookie;
+  pg.src_rank = from;
   {
     std::lock_guard<std::mutex> g(ce->lock);
+    if (peer_lost_locked(ce, from)) {
+      std::fprintf(stderr, "ptc-comm: not pulling from lost rank %u; "
+                           "delivery dropped\n", from);
+      return;
+    }
     cookie = ce->next_cookie++;
     ce->pending_gets.emplace(cookie, std::move(pg));
   }
@@ -728,15 +747,20 @@ static void handle_dtd_done_body(ptc_context *ctx, const uint8_t *body,
  *     what it pulled, and forwards its own handle: re-rooted data
  *     movement, reference remote_dep.c:39-47). */
 
-/* number of direct child frames the fanout will emit */
-static size_t bcast_frame_count(size_t ngroups, uint8_t topo) {
-  size_t frames = 0, n = ngroups;
-  while (n > 0) {
+
+/* the ranks that receive the direct child frames (one per chunk start —
+ * mirrors bcast_fanout's chunking); these are the expected pullers of a
+ * rendezvous broadcast's registration */
+static void bcast_direct_children(const std::vector<BcastWireGroup> &groups,
+                                  uint8_t topo,
+                                  std::vector<uint32_t> &out) {
+  size_t i = 0;
+  while (i < groups.size()) {
+    size_t n = groups.size() - i;
     size_t take = (topo == 2) ? (n + 1) / 2 : n;
-    frames++;
-    n -= take;
+    out.push_back(groups[i].rank);
+    i += take;
   }
-  return frames;
 }
 
 static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
@@ -903,6 +927,12 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
       w.raw(m.bytes.data(), m.bytes.size());
     }
     m.served++;
+    /* retire this puller's expectation record (see MemReg.targets) */
+    for (auto t = m.targets.begin(); t != m.targets.end(); ++t)
+      if (*t == from) {
+        m.targets.erase(t);
+        break;
+      }
     ptc_copy *rel = nullptr;
     if (m.served >= m.expected) { /* last pull: drop the registration */
       ce->mem_reg_bytes.fetch_sub(m.bytes.size(), std::memory_order_relaxed);
@@ -978,7 +1008,9 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
     /* re-root: register what we pulled and forward our own handle to the
      * children (reference: each forwarding rank re-roots data movement,
      * remote_dep.c:39-47) */
-    size_t nframes = bcast_frame_count(pg.groups.size(), pg.topo);
+    std::vector<uint32_t> rchildren;
+    bcast_direct_children(pg.groups, pg.topo, rchildren);
+    size_t nframes = rchildren.size();
     uint8_t fpk = 0;
     uint64_t fh = 0;
     int64_t tag = 0;
@@ -994,6 +1026,8 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
       MemReg &m = ce->mem_reg[fh];
       m.pk = PK_DEVICE;
       m.expected += (int32_t)nframes;
+      m.targets.insert(m.targets.end(), rchildren.begin(),
+                       rchildren.end());
       fpk = PK_DEVICE;
     } else if (plen == real_len) {
       std::lock_guard<std::mutex> g(ce->lock);
@@ -1001,6 +1035,7 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
       MemReg m;
       m.pk = PK_GET;
       m.expected = (int32_t)nframes;
+      m.targets = rchildren;
       m.bytes.assign(r.p, r.p + plen);
       ce->mem_reg_bytes.fetch_add(m.bytes.size(),
                                   std::memory_order_relaxed);
@@ -1089,11 +1124,73 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
   p.fd = -1;
   p.inbuf.clear();
   p.in_off = 0;
-  if (!ce->stop.load(std::memory_order_acquire)) {
+  if (ce->stop.load(std::memory_order_acquire)) {
+    ce->fence_cv.notify_all();
+    return;
+  }
+  std::vector<ptc_copy *> rels;
+  std::vector<int64_t> dp_done;
+  size_t dropped_pulls = 0;
+  {
     std::lock_guard<std::mutex> g(ce->lock);
     ce->peer_lost[rank] = 1;
     std::fprintf(stderr, "ptc-comm: rank %u connection lost\n", rank);
+    /* Reap rendezvous registrations whose puller died: the dead rank's
+     * GETs will never arrive, so drop its expectation records and free
+     * registrations with no live pullers left (a crashed consumer must
+     * not pin snapshots/device tiles forever). */
+    for (auto it = ce->mem_reg.begin(); it != ce->mem_reg.end();) {
+      MemReg &m = it->second;
+      int32_t removed = 0;
+      for (auto t = m.targets.begin(); t != m.targets.end();) {
+        if (*t == rank) {
+          t = m.targets.erase(t);
+          removed++;
+        } else {
+          ++t;
+        }
+      }
+      if (removed == 0) {
+        ++it;
+        continue;
+      }
+      m.expected -= removed;
+      if (m.pk == PK_DEVICE)
+        for (int32_t k = 0; k < removed; k++)
+          dp_done.push_back(
+              (int64_t)(it->first & ~DP_HANDLE_FLAG));
+      if (m.served >= m.expected) {
+        ce->mem_reg_bytes.fetch_sub(m.bytes.size(),
+                                    std::memory_order_relaxed);
+        if (m.src && m.in_by_copy) ce->mem_by_copy.erase(m.src);
+        if (m.src && m.packed_dtype >= 0)
+          ce->mem_by_packed.erase({m.src, m.packed_dtype});
+        if (m.src) rels.push_back(m.src);
+        it = ce->mem_reg.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    /* pulls waiting on the dead rank will never resolve; their parked
+     * deliveries are gone — survivors observe the loss via the fence */
+    for (auto it = ce->pending_gets.begin();
+         it != ce->pending_gets.end();) {
+      if (it->second.src_rank == rank) {
+        dropped_pulls++;
+        it = ce->pending_gets.erase(it);
+      } else {
+        ++it;
+      }
+    }
   }
+  ptc_context *ctx = ce->ctx;
+  for (ptc_copy *c : rels) ptc_copy_release_internal(ctx, c);
+  for (int64_t tag : dp_done)
+    if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
+  if (dropped_pulls)
+    std::fprintf(stderr,
+                 "ptc-comm: dropped %zu pending pull(s) from lost rank "
+                 "%u\n", dropped_pulls, rank);
   ce->fence_cv.notify_all();
 }
 
@@ -1408,6 +1505,13 @@ void ptc_comm_send_activate_batch(
                            "activations dropped\n");
     return;
   }
+  {
+    /* dead target: drop the activation (the fence reports the loss);
+     * checked under ce->lock so a registration below can never slip in
+     * after mark_peer_lost's reap */
+    std::lock_guard<std::mutex> g(ce->lock);
+    if (peer_lost_locked(ce, rank)) return;
+  }
   std::vector<uint8_t> f = frame_begin(MSG_ACTIVATE);
   Writer w{f};
   w.i32(tp->id);
@@ -1445,11 +1549,20 @@ void ptc_comm_send_activate_batch(
     w.u8(PK_NONE);
   } else if (dp_tag > 0) {
     uint64_t dp_h = (uint64_t)dp_tag | DP_HANDLE_FLAG;
+    bool lost;
     {
       std::lock_guard<std::mutex> g(ce->lock);
-      MemReg &m = ce->mem_reg[dp_h];
-      m.pk = PK_DEVICE;
-      m.expected++;
+      lost = peer_lost_locked(ce, rank);
+      if (!lost) {
+        MemReg &m = ce->mem_reg[dp_h];
+        m.pk = PK_DEVICE;
+        m.expected++;
+        m.targets.push_back(rank);
+      }
+    }
+    if (lost) { /* raced with the loss: drop the fresh device pin */
+      if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, dp_tag);
+      return;
     }
     w.u8(PK_DEVICE);
     w.u64(dp_h);
@@ -1464,12 +1577,14 @@ void ptc_comm_send_activate_batch(
     uint64_t h;
     {
       std::lock_guard<std::mutex> g(ce->lock);
+      if (peer_lost_locked(ce, rank)) return; /* raced with the loss */
       bool found = false;
       if (is_packed) {
         auto itp = ce->mem_by_packed.find({copy, send_dtype});
         if (itp != ce->mem_by_packed.end()) {
           h = itp->second;
           ce->mem_reg[h].expected++;
+          ce->mem_reg[h].targets.push_back(rank);
           found = true;
         }
       } else {
@@ -1477,6 +1592,7 @@ void ptc_comm_send_activate_batch(
         if (itc != ce->mem_by_copy.end()) {
           h = itc->second;
           ce->mem_reg[h].expected++;
+          ce->mem_reg[h].targets.push_back(rank);
           found = true;
         }
       }
@@ -1485,6 +1601,7 @@ void ptc_comm_send_activate_batch(
         MemReg m;
         m.pk = PK_GET;
         m.expected = 1;
+        m.targets.push_back(rank);
         m.src = copy;
         ptc_copy_retain(copy); /* pointer identity pin until last pull */
         if (is_packed)
@@ -1586,7 +1703,12 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
                             : (payload ? (uint64_t)copy->size : 0);
   bool big = payload && ce->eager_limit >= 0 &&
              (int64_t)plen > (int64_t)ce->eager_limit;
-  size_t nframes = bcast_frame_count(wire.size(), (uint8_t)topo);
+  /* the direct children are the expected pullers of a rendezvous
+   * broadcast (one frame each) — computed ONCE so the frame count and
+   * the target list cannot diverge */
+  std::vector<uint32_t> children;
+  bcast_direct_children(wire, (uint8_t)topo, children);
+  size_t nframes = children.size();
   if (big && nframes) {
     /* rendezvous broadcast: advertise a handle, let the direct children
      * pull (and re-root for theirs) — a big tile never rides the
@@ -1600,12 +1722,26 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
                                copy->version.load(), copy->size);
     if (tag > 0) {
       uint64_t dp_h = (uint64_t)tag | DP_HANDLE_FLAG;
+      size_t excess = 0;
       {
         std::lock_guard<std::mutex> g(ce->lock);
         MemReg &m = ce->mem_reg[dp_h];
         m.pk = PK_DEVICE;
-        m.expected += (int32_t)nframes;
+        for (uint32_t c : children) {
+          /* already-lost children will never pull: don't count them */
+          if (peer_lost_locked(ce, c)) {
+            excess++;
+            continue;
+          }
+          m.expected += 1;
+          m.targets.push_back(c);
+        }
+        if (m.expected == 0 && m.served == 0) ce->mem_reg.erase(dp_h);
       }
+      /* drop the device pins registered for children that are gone */
+      for (size_t q = 0; q < excess; q++)
+        if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
+      if (excess == children.size()) return;
       bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
                    PK_DEVICE, dp_h, nullptr, plen);
       return;
@@ -1620,26 +1756,36 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
        * layout-specific snapshot (no cross-dep sharing). */
       std::lock_guard<std::mutex> g(ce->lock);
       bool found = false;
+      std::vector<uint32_t> live;
+      for (uint32_t c : children)
+        if (!peer_lost_locked(ce, c)) live.push_back(c);
       if (is_packed) {
         auto itp = ce->mem_by_packed.find({copy, send_dtype});
         if (itp != ce->mem_by_packed.end()) {
           h = itp->second;
-          ce->mem_reg[h].expected += (int32_t)nframes;
+          ce->mem_reg[h].expected += (int32_t)live.size();
+          for (uint32_t c : live) ce->mem_reg[h].targets.push_back(c);
           found = true;
         }
       } else {
         auto itc = ce->mem_by_copy.find(copy);
         if (itc != ce->mem_by_copy.end()) {
           h = itc->second;
-          ce->mem_reg[h].expected += (int32_t)nframes;
+          ce->mem_reg[h].expected += (int32_t)live.size();
+          for (uint32_t c : live) ce->mem_reg[h].targets.push_back(c);
           found = true;
         }
+      }
+      if (!found && live.empty()) {
+        /* every direct child already died: nothing will ever pull */
+        return;
       }
       if (!found) {
         h = ce->next_handle++;
         MemReg m;
         m.pk = PK_GET;
-        m.expected = (int32_t)nframes;
+        m.expected = (int32_t)live.size();
+        m.targets = live;
         m.src = copy;
         ptc_copy_retain(copy);
         if (is_packed)

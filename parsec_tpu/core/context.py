@@ -210,10 +210,29 @@ class Context:
         n = N.lib.ptc_worker_stats(self._ptr, buf, cap)
         return [buf[i] for i in range(n)]
 
+    def rusage(self) -> dict:
+        """Process resource usage (the reference's per-EU rusage dumps,
+        parsec/scheduling.c:45-86 — user/sys time, maxrss, context
+        switches; process-wide here, workers being threads)."""
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "utime_s": round(ru.ru_utime, 3),
+            "stime_s": round(ru.ru_stime, 3),
+            "maxrss_kb": ru.ru_maxrss,
+            "vol_ctx_switches": ru.ru_nvcsw,
+            "invol_ctx_switches": ru.ru_nivcsw,
+            "minor_faults": ru.ru_minflt,
+            "major_faults": ru.ru_majflt,
+        }
+
     def stats_dump(self) -> str:
         """Human-readable counter dump (the --mca device_show_statistics /
         dump_and_reset analog, parsec/mca/device/device.h:224)."""
         lines = [f"workers (selected tasks): {self.worker_stats()}"]
+        bindings = [self.worker_binding(w) for w in range(self.nb_workers)]
+        if any(b >= 0 for b in bindings):
+            lines.append(f"worker cpu bindings: {bindings}")
         for i, dev in enumerate(self._devices):
             qid = getattr(dev, "qid", None)
             if qid is not None:
@@ -221,6 +240,7 @@ class Context:
                              f"depth={self.device_queue_depth(qid)}")
         if self.comm_enabled:
             lines.append(f"comm: {self.comm_stats()}")
+        lines.append(f"rusage: {self.rusage()}")
         return "\n".join(lines)
 
     def comm_stats(self) -> dict:

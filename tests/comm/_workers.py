@@ -601,6 +601,55 @@ def ptg_datatype_column(rank: int, nodes: int, port: int,
         ctx.comm_fini()
 
 
+def rendezvous_reaped_on_peer_loss(rank: int, nodes: int, port: int):
+    """Rank 0 advertises a big tile to rank 1 via the GET rendezvous;
+    rank 1 dies without ever pulling.  The registration must be REAPED
+    when the loss is detected (a crashed consumer must not pin the
+    snapshot forever), leaving registered_bytes == 0."""
+    import os
+    import time
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "1024"  # force rendezvous
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    arr = np.zeros(nodes * 64 * 1024, dtype=np.uint8)
+    ctx.register_linear_collection("A", arr, elem_size=64 * 1024,
+                                   nodes=nodes, myrank=rank)
+    if rank == 1:
+        time.sleep(2.0)  # stay connected long enough to receive ACTIVATE
+        ctx.destroy()    # die without pulling: no fence, no goodbye
+        return
+    tp = pt.Taskpool(ctx, globals={})
+    prod = tp.task_class("Prod")
+    prod.param("z", 0, 0)
+    prod.affinity("A", 0)
+    prod.flow("T", "RW", pt.In(pt.Mem("A", 0)),
+              pt.Out(pt.Ref("Cons", 1, flow="X")))
+    prod.body(lambda v: None)
+    cons = tp.task_class("Cons")
+    cons.param("z", 1, 1)
+    cons.affinity("A", 1)
+    cons.flow("X", "READ", pt.In(pt.Ref("Prod", 0, flow="T")))
+    cons.body(lambda v: None)
+    tp.run()
+    tp.wait()  # local Prod completes; the 64K payload is now registered
+    deadline = time.monotonic() + 2
+    st = ctx.comm_rdv_stats()
+    while st["registered_bytes"] < 64 * 1024 and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+        st = ctx.comm_rdv_stats()
+    assert st["registered_bytes"] >= 64 * 1024, st
+    # wait for the loss to be detected and the registration reaped
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = ctx.comm_rdv_stats()
+        if st["registered_bytes"] == 0:
+            break
+        time.sleep(0.2)
+    assert st["registered_bytes"] == 0, st
+    ctx.destroy()
+
+
 def fence_lost_peer(rank: int, nodes: int, port: int):
     """Rank 1 tears down without fencing (crash stand-in: its connection
     just closes); rank 0's fence must ERROR (peer-lost detection) instead

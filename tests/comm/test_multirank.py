@@ -170,6 +170,12 @@ def test_datatype_column_rendezvous_2ranks():
     _run_spmd(_workers.ptg_datatype_column, 2, eager_limit=0)
 
 
+def test_rendezvous_reaped_on_peer_loss():
+    """A dead consumer's un-pulled GET registration is reaped (no pinned
+    snapshot memory after peer loss)."""
+    _run_spmd(_workers.rendezvous_reaped_on_peer_loss, 2)
+
+
 def test_fence_errors_on_lost_peer():
     """A crashed rank fails the survivors' fence instead of hanging it."""
     _run_spmd(_workers.fence_lost_peer, 2, timeout=120.0)

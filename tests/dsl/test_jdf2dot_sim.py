@@ -1,0 +1,98 @@
+"""Weighted DAG simulation in the jdf2dot enumerator (reference: JDF
+body `weight` properties feeding the simulation/dagenum cost model)."""
+import json
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+
+import jdf2dot  # noqa: E402
+
+FORK_JOIN = """
+N [ type="int" ]
+
+Root(z)
+z = 0 .. 0
+: mydata(0)
+RW A <- mydata(0)
+     -> A Work(0 .. N)
+BODY [weight = 2]
+{
+pass
+}
+END
+
+Work(i)
+i = 0 .. N
+: mydata(i)
+RW A <- A Root(0)
+     -> A Join(0)
+BODY [weight = 3]
+{
+pass
+}
+END
+
+Join(z)
+z = 0 .. 0
+: mydata(0)
+READ A <- A Work(0)
+CTL X <- X Work(0 .. N)
+BODY
+{
+pass
+}
+END
+"""
+
+
+def _wait_ctl_flow_on_work():
+    # Work needs a CTL out flow for Join's gather
+    return FORK_JOIN.replace(
+        "     -> A Join(0)\nBODY",
+        "     -> A Join(0)\nCTL X -> X Join(0)\nBODY")
+
+
+def test_simulate_fork_join(tmp_path):
+    src = _wait_ctl_flow_on_work()
+    jdf = tmp_path / "fj.jdf"
+    jdf.write_text(src)
+    out = tmp_path / "fj.dot"
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = jdf2dot.main([str(jdf), str(out), "--global", "N=3",
+                           "--simulate", "2"])
+    assert rc == 0
+    sim_line = [ln for ln in buf.getvalue().splitlines()
+                if ln.startswith("simulate: ")][0]
+    sim = json.loads(sim_line[len("simulate: "):])
+    # Root(2) -> 4x Work(3) -> Join(1): total 2 + 12 + 1 = 15
+    assert sim["tasks"] == 6
+    assert sim["total_work"] == 15
+    assert sim["critical_path"] == 6   # 2 + 3 + 1
+    # P=2 greedy: root 0-2, works pairwise 2-5 and 5-8, join 8-9
+    assert sim["makespan"] == 9
+    assert sim["speedup"] == round(15 / 9, 3)
+    assert out.read_text().count("->") >= 8  # DOT captured the edges
+
+
+def test_simulate_scales_with_workers(tmp_path):
+    src = _wait_ctl_flow_on_work()
+    jdf = tmp_path / "fj.jdf"
+    jdf.write_text(src)
+    out = tmp_path / "fj.dot"
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        jdf2dot.main([str(jdf), str(out), "--global", "N=3",
+                      "--simulate", "4"])
+    sim = json.loads([ln for ln in buf.getvalue().splitlines()
+                      if ln.startswith("simulate: ")][0][10:])
+    # all four Works run in parallel: 2 + 3 + 1
+    assert sim["makespan"] == 6

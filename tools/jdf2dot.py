@@ -1,11 +1,18 @@
 #!/usr/bin/env python
 """Enumerate a JDF program's task DAG and emit DOT + per-class counts
-(reference: tools/dagenum.c + the --parsec dot grapher).
+(reference: tools/dagenum.c + the --parsec dot grapher), optionally with
+a weighted list-scheduling simulation (reference: the JDF body `weight`
+property feeding the simulation/dagenum cost model, parsec.y body
+properties).
 
 Usage: python tools/jdf2dot.py prog.jdf out.dot [--global N=10 ...]
+                [--simulate P]
 Bodies are replaced with no-ops; the program runs once on a throwaway
 context with full tracing and the executed DAG is captured from EDGE
-events.
+events.  --simulate P list-schedules the captured DAG on P virtual
+workers using per-task costs from `BODY [weight = <expr>]` (a Python
+expression over the task's first two parameters; default cost 1) and
+reports total work, critical path, makespan, speedup, and efficiency.
 """
 import argparse
 import os
@@ -22,9 +29,116 @@ from parsec_tpu.profiling import take_trace, to_dot  # noqa: E402
 
 
 def _noopify(src: str) -> str:
-    """Replace every BODY{...}END block's code with 'pass'."""
-    return re.sub(r"BODY\s*\{.*?\}\s*END", "BODY\n{\npass\n}\nEND", src,
-                  flags=re.S)
+    """Replace every BODY block's code with 'pass', preserving the body
+    properties ([type=..] selection and [weight=..] simulation costs)."""
+    return re.sub(
+        r"BODY(\s*\[[^\]]*\])?\s*(?:\{.*?\}\s*)?END",
+        lambda m: f"BODY{m.group(1) or ''}\n{{\npass\n}}\nEND",
+        src, flags=re.S)
+
+
+def simulate(trace, prog, gvals, nb_workers):
+    """List-schedule the captured DAG on `nb_workers` virtual workers.
+
+    Costs come from each class's first BODY carrying a `weight` property
+    (a Python expression over the task's first two declared parameters
+    and the program globals; default 1).  Returns a dict with total
+    work, weighted critical path, greedy makespan, speedup, and
+    efficiency — the JDF-simulation cost model (reference: body weight
+    properties + the simulation dag enumerators)."""
+    import heapq
+
+    weight_src = {}
+    pnames = {}
+    for i, jt in enumerate(prog.tasks):
+        pnames[i] = jt.params[:2]
+        for body in jt.bodies:
+            w = body.props.get("weight")
+            if w is not None:
+                weight_src[i] = compile(w, f"<weight-{jt.name}>", "eval")
+                break
+
+    def cost(cid, l0, l1):
+        code = weight_src.get(cid)
+        if code is None:
+            return 1
+        env = dict(gvals)
+        names = pnames.get(cid, [])
+        if len(names) > 0:
+            env[names[0]] = l0
+        if len(names) > 1:
+            env[names[1]] = l1
+        return max(1, int(eval(code, {}, env)))
+
+    # nodes from EXEC begins; edges from EDGE pairs
+    ev = trace.events
+    nodes = {}
+    for row in ev:
+        key, phase, cid, l0, l1 = (int(x) for x in row[:5])
+        if key == 0 and phase == 0:  # KEY_EXEC begin
+            nodes[(cid, l0, l1)] = cost(cid, l0, l1)
+    succs = {n: [] for n in nodes}
+    npred = {n: 0 for n in nodes}
+    for src, dst in trace.edges():
+        if src in nodes and dst in nodes:
+            succs[src].append(dst)
+            npred[dst] += 1
+    # weighted critical path (DAG longest path, reverse topological)
+    order = []
+    stack = [n for n in nodes if npred[n] == 0]
+    indeg = dict(npred)
+    while stack:
+        n = stack.pop()
+        order.append(n)
+        for s in succs[n]:
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                stack.append(s)
+    dist = {n: nodes[n] for n in nodes}
+    for n in order:
+        for s in succs[n]:
+            if dist[n] + nodes[s] > dist[s]:
+                dist[s] = dist[n] + nodes[s]
+    critical = max(dist.values(), default=0)
+    total = sum(nodes.values())
+    # greedy list scheduling on P workers
+    ready = [(0, n) for n in nodes if npred[n] == 0]
+    heapq.heapify(ready)
+    workers = [0] * max(1, nb_workers)
+    heapq.heapify(workers)
+    indeg = dict(npred)
+    avail = {}
+    makespan = 0
+    scheduled = 0
+    while ready:
+        t_ready, n = heapq.heappop(ready)
+        scheduled += 1
+        t_start = max(t_ready, heapq.heappop(workers))
+        t_end = t_start + nodes[n]
+        heapq.heappush(workers, t_end)
+        makespan = max(makespan, t_end)
+        for s in succs[n]:
+            avail[s] = max(avail.get(s, 0), t_end)
+            indeg[s] -= 1
+            if indeg[s] == 0:
+                heapq.heappush(ready, (avail[s], s))
+    if scheduled != len(nodes):
+        # classes with >2 parameters alias to one (cid, l0, l1) node and
+        # can fabricate cycles — silent makespan underestimates lie
+        print(f"simulate: WARNING {len(nodes) - scheduled} of "
+              f"{len(nodes)} tasks never became ready (node aliasing "
+              "on classes with >2 parameters?); makespan/critical-path "
+              "are lower bounds", file=sys.stderr)
+    return {
+        "tasks": len(nodes),
+        "total_work": total,
+        "critical_path": critical,
+        "workers": nb_workers,
+        "makespan": makespan,
+        "speedup": round(total / makespan, 3) if makespan else 0.0,
+        "efficiency": round(total / (makespan * nb_workers), 3)
+                      if makespan else 0.0,
+    }
 
 
 def main(argv=None):
@@ -37,6 +151,9 @@ def main(argv=None):
                     help="name bound to memory references (default mydata)")
     ap.add_argument("--size", type=int, default=256,
                     help="elements in the throwaway collection")
+    ap.add_argument("--simulate", type=int, default=0, metavar="P",
+                    help="list-schedule the DAG on P virtual workers "
+                         "using BODY [weight=..] costs")
     args = ap.parse_args(argv)
 
     src = _noopify(open(args.jdf).read())
@@ -65,6 +182,10 @@ def main(argv=None):
     counts = tr.counts()
     print(f"{tp.nb_total_tasks} tasks, {dot.count('->')} edges -> "
           f"{args.out}; events: {counts}")
+    if args.simulate > 0:
+        import json
+        sim = simulate(tr, b.prog, b.gvals, args.simulate)
+        print("simulate: " + json.dumps(sim))
     return 0
 
 
