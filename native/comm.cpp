@@ -310,6 +310,33 @@ static bool peer_lost_locked(CommEngine *ce, uint32_t rank) {
   return rank < ce->peer_lost.size() && ce->peer_lost[rank];
 }
 
+/* Register the LIVE members of `children` as expected pullers of `m`
+ * (ce->lock held): dead children never pull, so counting them would pin
+ * the registration forever.  Returns how many were skipped — PK_DEVICE
+ * callers must release one device pin per skip. */
+static size_t reg_live_children(CommEngine *ce, MemReg &m,
+                                const std::vector<uint32_t> &children) {
+  size_t excess = 0;
+  for (uint32_t c : children) {
+    if (peer_lost_locked(ce, c)) {
+      excess++;
+      continue;
+    }
+    m.expected += 1;
+    m.targets.push_back(c);
+  }
+  return excess;
+}
+
+/* connect-time handshake constants.  Wire format is native-endian BY
+ * DESIGN (single-host loopback / homogeneous pod slices — every TPU
+ * host is little-endian x86/ARM); the magic doubles as an endianness
+ * canary, since a byte-swapped peer presents it reversed. */
+enum : uint32_t {
+  PTC_WIRE_MAGIC = 0x50544331u, /* "PTC1" */
+  PTC_WIRE_VERSION = 1,
+};
+
 static void comm_post(CommEngine *ce, uint32_t rank,
                       std::vector<uint8_t> &&frame) {
   bool is_ctl = frame.size() > 4 &&
@@ -1021,26 +1048,32 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
                                (int64_t)real_len);
     }
     if (tag > 0) {
-      std::lock_guard<std::mutex> g(ce->lock);
-      fh = (uint64_t)tag | DP_HANDLE_FLAG;
-      MemReg &m = ce->mem_reg[fh];
-      m.pk = PK_DEVICE;
-      m.expected += (int32_t)nframes;
-      m.targets.insert(m.targets.end(), rchildren.begin(),
-                       rchildren.end());
-      fpk = PK_DEVICE;
+      size_t excess = 0;
+      {
+        std::lock_guard<std::mutex> g(ce->lock);
+        fh = (uint64_t)tag | DP_HANDLE_FLAG;
+        MemReg &m = ce->mem_reg[fh];
+        m.pk = PK_DEVICE;
+        /* children that died while our pull was in flight never pull */
+        excess = reg_live_children(ce, m, rchildren);
+        if (m.expected == 0 && m.served == 0) ce->mem_reg.erase(fh);
+      }
+      for (size_t q = 0; q < excess; q++)
+        if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
+      fpk = (excess == rchildren.size()) ? 0 : PK_DEVICE;
     } else if (plen == real_len) {
       std::lock_guard<std::mutex> g(ce->lock);
-      fh = ce->next_handle++;
       MemReg m;
       m.pk = PK_GET;
-      m.expected = (int32_t)nframes;
-      m.targets = rchildren;
-      m.bytes.assign(r.p, r.p + plen);
-      ce->mem_reg_bytes.fetch_add(m.bytes.size(),
-                                  std::memory_order_relaxed);
-      ce->mem_reg.emplace(fh, std::move(m));
-      fpk = PK_GET;
+      reg_live_children(ce, m, rchildren);
+      if (m.expected > 0) {
+        fh = ce->next_handle++;
+        m.bytes.assign(r.p, r.p + plen);
+        ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+                                    std::memory_order_relaxed);
+        ce->mem_reg.emplace(fh, std::move(m));
+        fpk = PK_GET;
+      }
     } else {
       std::fprintf(stderr, "ptc-comm: bcast relay cannot re-serve a "
                            "by-ref payload with no device; children "
@@ -1411,8 +1444,11 @@ static int32_t tcp_start(CommEngine *ce, int base_port) {
       std::fprintf(stderr, "ptc-comm: cannot connect to rank %u\n", r);
       return -1;
     }
-    uint32_t me = ce->myrank;
-    if (send(fd, &me, 4, 0) != 4) {
+    /* magic + protocol version + rank: a mismatched build (or a stray
+     * client) is rejected at connect instead of desynchronizing the
+     * frame stream later (reference: the OOB version handshake role) */
+    uint32_t hello[3] = {PTC_WIRE_MAGIC, PTC_WIRE_VERSION, ce->myrank};
+    if (send(fd, hello, sizeof(hello), 0) != (ssize_t)sizeof(hello)) {
       close(fd);
       return -1;
     }
@@ -1430,11 +1466,24 @@ static int32_t tcp_start(CommEngine *ce, int base_port) {
       std::fprintf(stderr, "ptc-comm: accept failed: %s\n", strerror(errno));
       return -1;
     }
-    uint32_t who = 0;
-    ssize_t got = recv(fd, &who, 4, MSG_WAITALL);
-    if (got != 4 || who <= ce->myrank || who >= ce->nodes ||
-        tt.peers[who].fd >= 0) {
-      std::fprintf(stderr, "ptc-comm: rejecting bad peer handshake\n");
+    /* a stray/old client that sends a short banner and keeps the
+     * socket open must not wedge the single-threaded accept loop */
+    struct timeval hs_to = {5, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hs_to, sizeof(hs_to));
+    uint32_t hello[3] = {0, 0, 0};
+    ssize_t got = recv(fd, hello, sizeof(hello), MSG_WAITALL);
+    uint32_t who = hello[2];
+    if (got != (ssize_t)sizeof(hello) || hello[0] != PTC_WIRE_MAGIC ||
+        hello[1] != PTC_WIRE_VERSION || who <= ce->myrank ||
+        who >= ce->nodes || tt.peers[who].fd >= 0) {
+      if (got == (ssize_t)sizeof(hello) && hello[0] == PTC_WIRE_MAGIC &&
+          hello[1] != PTC_WIRE_VERSION)
+        std::fprintf(stderr,
+                     "ptc-comm: peer speaks wire version %u, this build "
+                     "speaks %u — mixed builds in one job?\n", hello[1],
+                     PTC_WIRE_VERSION);
+      else
+        std::fprintf(stderr, "ptc-comm: rejecting bad peer handshake\n");
       close(fd);
       if (++strays > 256) return -1; /* give up rather than loop forever */
       continue;
@@ -1727,15 +1776,7 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
         std::lock_guard<std::mutex> g(ce->lock);
         MemReg &m = ce->mem_reg[dp_h];
         m.pk = PK_DEVICE;
-        for (uint32_t c : children) {
-          /* already-lost children will never pull: don't count them */
-          if (peer_lost_locked(ce, c)) {
-            excess++;
-            continue;
-          }
-          m.expected += 1;
-          m.targets.push_back(c);
-        }
+        excess = reg_live_children(ce, m, children);
         if (m.expected == 0 && m.served == 0) ce->mem_reg.erase(dp_h);
       }
       /* drop the device pins registered for children that are gone */
@@ -1756,36 +1797,30 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
        * layout-specific snapshot (no cross-dep sharing). */
       std::lock_guard<std::mutex> g(ce->lock);
       bool found = false;
-      std::vector<uint32_t> live;
-      for (uint32_t c : children)
-        if (!peer_lost_locked(ce, c)) live.push_back(c);
       if (is_packed) {
         auto itp = ce->mem_by_packed.find({copy, send_dtype});
         if (itp != ce->mem_by_packed.end()) {
           h = itp->second;
-          ce->mem_reg[h].expected += (int32_t)live.size();
-          for (uint32_t c : live) ce->mem_reg[h].targets.push_back(c);
+          reg_live_children(ce, ce->mem_reg[h], children);
           found = true;
         }
       } else {
         auto itc = ce->mem_by_copy.find(copy);
         if (itc != ce->mem_by_copy.end()) {
           h = itc->second;
-          ce->mem_reg[h].expected += (int32_t)live.size();
-          for (uint32_t c : live) ce->mem_reg[h].targets.push_back(c);
+          reg_live_children(ce, ce->mem_reg[h], children);
           found = true;
         }
-      }
-      if (!found && live.empty()) {
-        /* every direct child already died: nothing will ever pull */
-        return;
       }
       if (!found) {
         h = ce->next_handle++;
         MemReg m;
         m.pk = PK_GET;
-        m.expected = (int32_t)live.size();
-        m.targets = live;
+        reg_live_children(ce, m, children);
+        if (m.expected == 0) {
+          /* every direct child already died: nothing will ever pull */
+          return;
+        }
         m.src = copy;
         ptc_copy_retain(copy);
         if (is_packed)

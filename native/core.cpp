@@ -599,14 +599,52 @@ static bool dep_producer_in_domain(ptc_context *ctx, ptc_taskpool *tp,
   return task_params_in_domain(ctx, tp, peer, pv, np);
 }
 
+/* does the expression call into Python (an escape that may read state
+ * written by task bodies — e.g. choice.jdf's `decision` array)? */
+static bool expr_has_call(const Expr &e) {
+  const std::vector<int64_t> &c = e.code;
+  for (size_t i = 0; i < c.size(); i++) {
+    switch (c[i]) {
+    case PTC_OP_CALL:
+      return true;
+    case PTC_OP_IMM:
+    case PTC_OP_LOCAL:
+    case PTC_OP_GLOBAL:
+      i++;
+      break;
+    default:
+      break;
+    }
+  }
+  return false;
+}
+
 /* The input dep selected for a non-CTL flow: the first dep that is
  * guard-true AND (for task sources) whose producer instance exists —
  * the reference's implicit range guard on every dep composes with the
- * explicit guard, so selection falls through to the next alternative. */
+ * explicit guard, so selection falls through to the next alternative.
+ *
+ * `conservative` (the COUNTING mode): a dynamic guard — one containing
+ * a Python escape — may read state that task bodies write later
+ * (choice.jdf's decision array), so its value at enumeration time is
+ * meaningless.  A dynamic-guard TASK dep is then treated as a
+ * potential source (the instance waits for a delivery instead of
+ * startup-firing; if no producer ever chooses it, the count-correction
+ * path retires it — the reference's choice contract).  Execution-time
+ * resolution (prepare_input) evaluates guards for real: by then the
+ * producers have run. */
 static const Dep *select_input_dep(ptc_context *ctx, ptc_taskpool *tp,
                                    const Flow &fl, const int64_t *locals,
-                                   int nb_locals, const int64_t *g) {
+                                   int nb_locals, const int64_t *g,
+                                   bool conservative = false) {
   for (const Dep &d : fl.in_deps) {
+    if (conservative && expr_has_call(d.guard)) {
+      if (d.kind != DEP_TASK)
+        continue; /* dynamic memory source: cannot deliver; keep looking */
+      if (!dep_producer_in_domain(ctx, tp, d, locals, nb_locals, g))
+        continue;
+      return &d;
+    }
     if (!eval_guard(d.guard, ctx, locals, nb_locals, g)) continue;
     if (d.kind == DEP_TASK &&
         !dep_producer_in_domain(ctx, tp, d, locals, nb_locals, g))
@@ -724,7 +762,8 @@ static int32_t count_task_inputs(ptc_context *ctx, ptc_taskpool *tp,
         });
       }
     } else {
-      const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals, g);
+      const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals, g,
+                                        /*conservative=*/true);
       if (sel && sel->kind == DEP_TASK) flow_count = 1;
     }
     if (per_flow && fi < PTC_MAX_FLOWS) per_flow[fi] = flow_count;
@@ -914,8 +953,17 @@ int32_t ptc_consumer_recv_dtype(ptc_context *ctx, ptc_taskpool *tp,
   fill_derived_locals(ctx, tp, tc, locals);
   const Flow &fl = tc.flows[(size_t)flow_idx];
   if (fl.flags & PTC_FLOW_CTL) return -1;
+  /* real evaluation first: at delivery time the producers have run, so
+   * a dynamic guard usually resolves (and alternatives may declare
+   * DIFFERENT wire datatypes — picking conservatively would scatter
+   * with the wrong layout).  Fall back to the conservative pick only
+   * when nothing selects (e.g. producer-side state not visible on this
+   * rank). */
   const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
                                     tp->globals.data());
+  if (!sel)
+    sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
+                           tp->globals.data(), /*conservative=*/true);
   return sel ? sel->dtype_id : -1;
 }
 

@@ -1,0 +1,218 @@
+"""Mixture-of-Experts dispatch/combine THROUGH the task runtime.
+
+The GSPMD library implementation lives in parallel/expert.py (GShard
+one-hot dispatch/combine over an `ep` mesh axis); this is the same
+computation expressed as a dataflow taskpool, so the two all-to-all legs
+are ordinary runtime dependencies: dispatch tiles move shard-rank →
+expert-rank and result tiles move back, riding the comm engine
+(eager/GET rendezvous/device plane) like any other tile.  Reference
+pattern: algorithms packaged as dataflow taskpools
+(parsec/data_dist/matrix/redistribute/redistribute.jdf); validation
+oracle: parallel/expert.py moe_ffn_reference.
+
+DAG (S token shards of T tokens, E experts, capacity C):
+
+  GATE(s):    X(s), WG          -> R(s)  (T, 2k) top-k ids + renorm probs
+  DISP(s, e): R(s), X(s)        -> D     (C, d+2) = [x | token idx | prob]
+  EXP(e, s):  D, WU(e), WD(e)   -> D     (result written over the x cols;
+              affinity = expert e's rank: D moving here IS the dispatch
+              all-to-all leg, the result moving out IS the combine leg)
+  ACC(s, e):  chain over e scatter-adding prob-weighted rows into Y(s)
+
+Tokens beyond an expert's capacity are dropped in token order — the
+same rule as parallel/expert.py's cumsum positioning."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import parsec_tpu as pt
+from ..data.collections import TwoDimBlockCyclic
+
+
+def _relu(x):
+    return np.maximum(x, 0.0)
+
+
+def _softmax(x):
+    m = x.max(axis=-1, keepdims=True)
+    p = np.exp(x - m)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def _topk_gate(x, w_gate, k):
+    """Shared routing rule for the runtime gate and the oracle: softmax
+    over experts, stable top-k, renormalized top-k probabilities."""
+    probs = _softmax(x @ w_gate)
+    idx = np.argsort(-probs, axis=-1, kind="stable")[:, :k]
+    vals = np.take_along_axis(probs, idx, axis=-1)
+    return idx, vals / vals.sum(axis=-1, keepdims=True)
+
+
+def make_moe_collections(S, T, d, f, E, nodes=1, myrank=0, x=None,
+                         w_gate=None, w_up=None, w_down=None):
+    """Token shards X/Y (shard s on rank s%nodes), per-expert weights
+    WU/WD (expert e on rank e%nodes), gate weights WG replicated via
+    rank-0 ownership... gate runs on every shard rank, so WG is stored
+    per shard-rank (broadcast-free: it is small and passed at init)."""
+    def init_from(arr, rows):
+        if arr is None:
+            return None
+        return lambda c, m, n: np.ascontiguousarray(
+            arr[m * rows:(m + 1) * rows], dtype=np.float32)
+
+    Xc = TwoDimBlockCyclic(S * T, d, T, d, P=nodes, Q=1, nodes=nodes,
+                          myrank=myrank, dtype=np.float32,
+                          init=init_from(x, T))
+    Yc = TwoDimBlockCyclic(S * T, d, T, d, P=nodes, Q=1, nodes=nodes,
+                          myrank=myrank, dtype=np.float32,
+                          init=lambda c, m, n: np.zeros((T, d),
+                                                        np.float32))
+    # every shard rank gates locally: replicate WG as a per-rank tile
+    WGc = TwoDimBlockCyclic(nodes * d, E, d, E, P=nodes, Q=1, nodes=nodes,
+                            myrank=myrank, dtype=np.float32,
+                            init=(lambda c, m, n: np.ascontiguousarray(
+                                w_gate, dtype=np.float32))
+                            if w_gate is not None else None)
+    WUc = TwoDimBlockCyclic(E * d, f, d, f, P=nodes, Q=1, nodes=nodes,
+                            myrank=myrank, dtype=np.float32,
+                            init=init_from(
+                                w_up.reshape(E * d, f) if w_up is not None
+                                else None, d))
+    WDc = TwoDimBlockCyclic(E * f, d, f, d, P=nodes, Q=1, nodes=nodes,
+                            myrank=myrank, dtype=np.float32,
+                            init=init_from(
+                                w_down.reshape(E * f, d)
+                                if w_down is not None else None, f))
+    return Xc, Yc, WGc, WUc, WDc
+
+
+def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
+              capacity: Optional[int] = None,
+              activation: Callable = _relu, dev=None) -> pt.Taskpool:
+    S, T, d = Xc.mt, Xc.mb, Xc.nb
+    f = WUc.nb
+    C = capacity if capacity is not None else T
+    Xc.register(ctx, "X")
+    Yc.register(ctx, "Y")
+    WGc.register(ctx, "WG")
+    WUc.register(ctx, "WU")
+    WDc.register(ctx, "WD")
+    ctx.register_arena("moe_r", T * 2 * k * 4)
+    ctx.register_arena("moe_d", C * (d + 2) * 4)
+    ctx.register_arena("moe_y", T * d * 4)
+    nodes = max(1, Xc.nodes)
+    tp = pt.Taskpool(ctx, globals={"S": S - 1, "E": E - 1, "P": nodes})
+    s, e = pt.L("s"), pt.L("e")
+    Sg, Eg, Pg = pt.G("S"), pt.G("E"), pt.G("P")
+
+    gate = tp.task_class("GATE")
+    gate.param("s", 0, Sg)
+    gate.affinity("X", s, 0)
+    gate.flow("X", "READ", pt.In(pt.Mem("X", s, 0)))
+    # WG is replicated one tile per rank; the gate reads its own rank's
+    gate.flow("WG", "READ", pt.In(pt.Mem("WG", s % Pg, 0)))
+    gate.flow("R", "W",
+              pt.Out(pt.Ref("DISP", s, pt.Range(0, Eg), flow="R")),
+              arena="moe_r")
+
+    disp = tp.task_class("DISP")
+    disp.param("s", 0, Sg)
+    disp.param("e", 0, Eg)
+    disp.affinity("X", s, 0)
+    disp.flow("R", "READ", pt.In(pt.Ref("GATE", s, flow="R")))
+    disp.flow("X", "READ", pt.In(pt.Mem("X", s, 0)))
+    disp.flow("D", "W", pt.Out(pt.Ref("EXP", e, s, flow="D")),
+              arena="moe_d")
+
+    exp = tp.task_class("EXP")
+    exp.param("e", 0, Eg)
+    exp.param("s", 0, Sg)
+    exp.affinity("WU", e, 0)  # expert-owner computes: the all-to-all
+    exp.flow("D", "RW", pt.In(pt.Ref("DISP", s, e, flow="D")),
+             pt.Out(pt.Ref("ACC", s, e, flow="C")), arena="moe_d")
+    exp.flow("WU", "READ", pt.In(pt.Mem("WU", e, 0)))
+    exp.flow("WD", "READ", pt.In(pt.Mem("WD", e, 0)))
+
+    acc = tp.task_class("ACC")
+    acc.param("s", 0, Sg)
+    acc.param("e", 0, Eg)
+    acc.affinity("X", s, 0)
+    acc.flow("A", "RW",
+             pt.In(pt.Mem("Y", s, 0), guard=(e == 0)),
+             pt.In(pt.Ref("ACC", s, e - 1, flow="A")),
+             pt.Out(pt.Ref("ACC", s, e + 1, flow="A"), guard=(e < Eg)),
+             pt.Out(pt.Mem("Y", s, 0), guard=(e == Eg)), arena="moe_y")
+    acc.flow("C", "READ", pt.In(pt.Ref("EXP", e, s, flow="D")),
+             arena="moe_d")
+
+    def b_gate(view):
+        x = view.data("X", np.float32, (T, d))
+        wg = view.data("WG", np.float32, (d, E))
+        r = view.data("R", np.float32, (T, 2 * k))
+        idx, vals = _topk_gate(x, wg, k)
+        r[:, :k] = idx
+        r[:, k:] = vals
+
+    def b_disp(view):
+        my_e = view.local("e")
+        r = view.data("R", np.float32, (T, 2 * k))
+        x = view.data("X", np.float32, (T, d))
+        dtile = view.data("D", np.float32, (C, d + 2))
+        dtile[...] = 0.0
+        cnt = 0
+        for t in range(T):
+            for j in range(k):
+                if int(r[t, j]) == my_e and cnt < C:
+                    dtile[cnt, :d] = x[t]
+                    dtile[cnt, d] = t
+                    dtile[cnt, d + 1] = r[t, k + j]
+                    cnt += 1
+        # rows past cnt stay zero: prob 0 contributes nothing at combine
+
+    def b_exp(view):
+        dtile = view.data("D", np.float32, (C, d + 2))
+        wu = view.data("WU", np.float32, (d, f))
+        wd = view.data("WD", np.float32, (f, d))
+        dtile[:, :d] = activation(dtile[:, :d] @ wu) @ wd
+
+    def b_acc(view):
+        a = view.data("A", np.float32, (T, d))
+        c = view.data("C", np.float32, (C, d + 2))
+        for row in range(C):
+            p = c[row, d + 1]
+            if p != 0.0:
+                a[int(c[row, d])] += p * c[row, :d]
+
+    gate.body(b_gate)
+    disp.body(b_disp)
+    exp.body(b_exp)
+    acc.body(b_acc)
+
+    if dev is not None:
+        # the FLOPs live in EXP: offload its fused FFN to the device
+        def k_exp(dtile, wu, wd):
+            import jax.numpy as jnp
+            y = jnp.maximum(dtile[:, :d] @ wu, 0.0) @ wd
+            return jnp.concatenate([y, dtile[:, d:]], axis=1)
+
+        dev.attach(exp, tp, kernel=k_exp, reads=["D", "WU", "WD"],
+                   writes=["D"],
+                   shapes={"D": (C, d + 2), "WU": (d, f), "WD": (f, d)},
+                   dtype=np.float32)
+    return tp
+
+
+def moe_oracle(x, w_gate, w_up, w_down, k=2, activation=_relu):
+    """Dense numpy oracle, same math as parallel/expert.py
+    moe_ffn_reference (no capacity limit)."""
+    T, d = x.shape
+    idx, vals = _topk_gate(x, w_gate, k)
+    y = np.zeros_like(x)
+    for t in range(T):
+        for j in range(k):
+            e = idx[t, j]
+            h = activation(x[t] @ w_up[e])
+            y[t] += vals[t, j] * (h @ w_down[e])
+    return y

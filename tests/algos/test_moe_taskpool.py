@@ -1,0 +1,72 @@
+"""MoE dispatch/combine through the task runtime (algos/moe.py),
+validated against the dense numpy oracle and cross-checked against the
+GSPMD library implementation (parallel/expert.py moe_ffn_reference) —
+the two stacks must agree on the same inputs."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.algos.moe import (build_moe, make_moe_collections,
+                                  moe_oracle)
+
+S, T, d, f, E, K = 2, 8, 4, 6, 3, 2
+
+
+def _inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(S * T, d)).astype(np.float32)
+    wg = rng.normal(size=(d, E)).astype(np.float32)
+    wu = rng.normal(size=(E, d, f)).astype(np.float32) / np.sqrt(d)
+    wd = rng.normal(size=(E, f, d)).astype(np.float32) / np.sqrt(f)
+    return x, wg, wu, wd
+
+
+def _run_runtime_moe(x, wg, wu, wd, nb_workers=2):
+    with pt.Context(nb_workers=nb_workers) as ctx:
+        Xc, Yc, WGc, WUc, WDc = make_moe_collections(
+            S, T, d, f, E, x=x, w_gate=wg, w_up=wu, w_down=wd)
+        tp = build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=K)
+        tp.run()
+        tp.wait()
+        return np.concatenate([Yc.tile(s_, 0) for s_ in range(S)])
+
+
+def test_moe_taskpool_matches_numpy_oracle():
+    x, wg, wu, wd = _inputs()
+    y = _run_runtime_moe(x, wg, wu, wd)
+    ref = moe_oracle(x, wg, wu, wd, k=K)
+    np.testing.assert_allclose(y, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_taskpool_matches_gspmd_library():
+    """The runtime taskpool and the jax/GSPMD reference produce the same
+    tokens-out for the same weights (relu activation on both)."""
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.parallel.expert import moe_ffn_reference
+
+    x, wg, wu, wd = _inputs(seed=3)
+    y_rt = _run_runtime_moe(x, wg, wu, wd)
+    y_jax = moe_ffn_reference(
+        jnp.asarray(x[None]), jnp.asarray(wg), jnp.asarray(wu),
+        jnp.asarray(wd), k=K, activation=jax.nn.relu)
+    np.testing.assert_allclose(y_rt, np.asarray(y_jax)[0], rtol=3e-4,
+                               atol=3e-4)
+
+
+def test_moe_capacity_drops_tokens():
+    """capacity=1: each expert keeps one token per shard, the rest are
+    dropped (zero contribution) — the GShard capacity semantics."""
+    x, wg, wu, wd = _inputs(seed=5)
+    with pt.Context(nb_workers=1) as ctx:
+        Xc, Yc, WGc, WUc, WDc = make_moe_collections(
+            S, T, d, f, E, x=x, w_gate=wg, w_up=wu, w_down=wd)
+        tp = build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=K, capacity=1)
+        tp.run()
+        tp.wait()
+        y = np.concatenate([Yc.tile(s_, 0) for s_ in range(S)])
+    ref = moe_oracle(x, wg, wu, wd, k=K)
+    # dropped tokens make y deviate from the no-capacity oracle, but no
+    # token can GAIN weight: every row is a partial sum of the oracle's
+    assert not np.allclose(y, ref)
+    assert np.isfinite(y).all()

@@ -601,6 +601,61 @@ def ptg_datatype_column(rank: int, nodes: int, port: int,
         ctx.comm_fini()
 
 
+def moe_taskpool_spmd(rank: int, nodes: int, port: int, S: int = 4,
+                      T: int = 8, d: int = 4, f: int = 6, E: int = 4,
+                      k: int = 2):
+    """MoE through the runtime across ranks: token shards live on rank
+    s%nodes, experts on rank e%nodes — the dispatch tiles moving to the
+    expert ranks and the results moving back are the two all-to-all legs,
+    expressed as ordinary runtime dependencies over the comm engine.
+    Validated against the dense numpy oracle on each owned shard."""
+    from parsec_tpu.algos.moe import (build_moe, make_moe_collections,
+                                      moe_oracle)
+
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(S * T, d)).astype(np.float32)
+        wg = rng.normal(size=(d, E)).astype(np.float32)
+        wu = (rng.normal(size=(E, d, f)) / np.sqrt(d)).astype(np.float32)
+        wd = (rng.normal(size=(E, f, d)) / np.sqrt(f)).astype(np.float32)
+        Xc, Yc, WGc, WUc, WDc = make_moe_collections(
+            S, T, d, f, E, nodes=nodes, myrank=rank, x=x, w_gate=wg,
+            w_up=wu, w_down=wd)
+        tp = build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=k)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        ref = moe_oracle(x, wg, wu, wd, k=k)
+        for s_ in range(S):
+            if s_ % nodes != rank:
+                continue  # not my shard
+            np.testing.assert_allclose(Yc.tile(s_, 0),
+                                       ref[s_ * T:(s_ + 1) * T],
+                                       rtol=3e-5, atol=3e-5)
+        ctx.comm_fini()
+
+
+def ptg_chain_with_stray_client(rank: int, nodes: int, port: int):
+    """A stray client with a bad handshake (wrong magic — e.g. a port
+    scanner or a mismatched build) must be rejected without consuming a
+    peer slot; the real mesh then forms and runs normally."""
+    import socket
+    import time
+
+    if rank == 1:
+        s = socket.socket()
+        for _ in range(100):
+            try:
+                s.connect(("127.0.0.1", port))  # rank 0's listen port
+                break
+            except OSError:
+                time.sleep(0.05)
+        s.send(b"NOTPTC_HANDSHK")  # 12+ bytes, wrong magic
+        s.close()
+    ptg_chain(rank, nodes, port, nb=8)
+
+
 def rendezvous_reaped_on_peer_loss(rank: int, nodes: int, port: int):
     """Rank 0 advertises a big tile to rank 1 via the GET rendezvous;
     rank 1 dies without ever pulling.  The registration must be REAPED

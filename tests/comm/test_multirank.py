@@ -170,6 +170,22 @@ def test_datatype_column_rendezvous_2ranks():
     _run_spmd(_workers.ptg_datatype_column, 2, eager_limit=0)
 
 
+def test_moe_taskpool_2ranks():
+    """MoE dispatch/combine all-to-all legs across 2 ranks (shards on
+    s%2, experts on e%2), validated against the dense oracle."""
+    _run_spmd(_workers.moe_taskpool_spmd, 2)
+
+
+def test_moe_taskpool_4ranks():
+    _run_spmd(_workers.moe_taskpool_spmd, 4)
+
+
+def test_stray_client_rejected_at_handshake():
+    """Wrong-magic connections are rejected at connect (version/magic
+    handshake); the real mesh still forms."""
+    _run_spmd(_workers.ptg_chain_with_stray_client, 2)
+
+
 def test_rendezvous_reaped_on_peer_loss():
     """A dead consumer's un-pulled GET registration is reaped (no pinned
     snapshot memory after peer loss)."""

@@ -569,6 +569,47 @@ END
         b.run().wait()
 
 
+def test_jdf_dynamic_guard_chain_is_deterministic():
+    """A dep guard reading body-written state (the choice pattern) must
+    NOT be evaluated at enumeration time for startup-readiness: C(k>0)
+    has a potential producer, so it waits for the delivery — with 2
+    workers and slow bodies the chain order is still strict.  (This was
+    a real race: enumeration-time evaluation saw state[]==0, counted 0
+    expected inputs, and startup-fired every instance.)"""
+    src = """
+NT [ type="int" ]
+state [ type = "int *" ]
+
+C(k)
+k = 0 .. NT
+: A(k)
+RW D <- (k == 0) ? A(k)
+     <- %{ return (k > 0) and (state[k-1] == 1) %} ? D C(k-1)
+     -> %{ return state[k] == 1 %} ? D C(k+1)
+BODY
+{
+import time
+state[k] = 1
+ran.append(k)
+time.sleep(0.005)
+}
+END
+"""
+    for _ in range(5):
+        buf = np.zeros(8, dtype=np.int64)
+        state = [0] * 6
+        ran = []
+        with pt.Context(nb_workers=2) as ctx:
+            ctx.register_linear_collection("A", buf, elem_size=8)
+            b = compile_jdf(src, ctx, globals={"NT": 4}, dtype=np.int64,
+                            late_bound=["state"])
+            b.scope["state"] = state
+            b.scope["ran"] = ran
+            tp = b.run()
+            tp.wait()
+        assert ran == [0, 1, 2, 3, 4], ran
+
+
 def test_jdf_addto_nb_tasks_api():
     """Native count adjustment completes a pool holding a never-ready
     task (the primitive under the choice port)."""
