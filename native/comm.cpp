@@ -1959,6 +1959,11 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
     delete ce;
     return -1;
   }
+  if (ptc_context_verbose(ctx, PTC_DBG_COMM) >= 1)
+    std::fprintf(stderr,
+                 "ptc [comm]: rank %u/%u mesh connected (transport %s, "
+                 "eager_limit %lld)\n", ce->myrank, ce->nodes,
+                 ce->ops->name, (long long)ce->eager_limit);
   ce->running.store(true);
   ctx->comm = ce;
   return 0;
@@ -2041,7 +2046,13 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
      * dirty flag, so an all-clean round proves global quiescence.  (The
      * round count is uniform: every rank computes any_dirty over the
      * same flag set.) */
-    if (!any_dirty) return 0;
+    if (!any_dirty) {
+      if (ptc_context_verbose(ctx, PTC_DBG_COMM) >= 1)
+        std::fprintf(stderr,
+                     "ptc [comm]: fence quiesced at round %llu\n",
+                     (unsigned long long)gen);
+      return 0;
+    }
   }
 }
 

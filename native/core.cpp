@@ -1312,6 +1312,10 @@ static void notify_drain_waiters(ptc_taskpool *tp) {
 static void tp_mark_complete(ptc_context *ctx, ptc_taskpool *tp) {
   bool expected = false;
   if (!tp->completed.compare_exchange_strong(expected, true)) return;
+  if (ptc_context_verbose(ctx, PTC_DBG_RUNTIME) >= 1)
+    std::fprintf(stderr, "ptc [runtime]: taskpool %d complete (%lld "
+                         "errors)\n", tp->id,
+                 (long long)tp->nb_errors.load());
   /* composition callback first: if it adds a follow-up taskpool, active_tps
    * never hits 0 between the pools and ptc_context_wait stays blocked */
   if (tp->complete_cb) tp->complete_cb(tp->complete_user, tp);
@@ -2034,6 +2038,17 @@ void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode) {
   ctx->bind_mode = mode;
 }
 
+void ptc_context_set_verbose(ptc_context_t *ctx, int32_t subsys,
+                             int32_t level) {
+  if (subsys >= 0 && subsys < PTC_DBG_NSUBSYS)
+    ctx->verbose[subsys].store(level, std::memory_order_relaxed);
+}
+
+int32_t ptc_context_verbose(ptc_context_t *ctx, int32_t subsys) {
+  if (subsys < 0 || subsys >= PTC_DBG_NSUBSYS) return 0;
+  return ctx->verbose[subsys].load(std::memory_order_relaxed);
+}
+
 int32_t ptc_worker_binding(ptc_context_t *ctx, int32_t worker) {
   if (worker < 0 || (size_t)worker >= ctx->worker_cpu.size()) return -1;
   return ctx->worker_cpu[(size_t)worker]->load(std::memory_order_relaxed);
@@ -2165,6 +2180,12 @@ int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp) {
     tp->id = ctx->next_tp_id++;
     ctx->tp_registry[tp->id] = tp;
   }
+  if (ptc_context_verbose(ctx, PTC_DBG_RUNTIME) >= 1)
+    std::fprintf(stderr,
+                 "ptc [runtime]: taskpool %d: %lld local tasks across %zu "
+                 "classes (%d on the dense engine), %zu startup-ready\n",
+                 tp->id, (long long)st.nb_local, tp->classes.size(),
+                 ptc_tp_dense_classes(tp), st.ready.size());
   if (st.nb_local == 0 && !tp->open.load()) {
     tp_mark_complete(ctx, tp);
     ptc_comm_drain_early(ctx, tp);

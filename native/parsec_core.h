@@ -160,6 +160,20 @@ void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes);
  * mode 0 = unbound (default), 1 = round-robin core pinning over the
  * process's allowed cpuset.  Call before the first taskpool runs. */
 void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode);
+
+/* per-subsystem debug verbosity (reference: the parsec output/debug
+ * streams, parsec/utils/debug.c — one stream per subsystem with its own
+ * verbosity).  Level 0 = warnings only (default); >=1 enables `ptc
+ * [subsys]` informational diagnostics on stderr. */
+enum {
+  PTC_DBG_RUNTIME = 0,
+  PTC_DBG_COMM = 1,
+  PTC_DBG_DEVICE = 2,
+  PTC_DBG_NSUBSYS = 3
+};
+void ptc_context_set_verbose(ptc_context_t *ctx, int32_t subsys,
+                             int32_t level);
+int32_t ptc_context_verbose(ptc_context_t *ctx, int32_t subsys);
 /* the cpu worker w was bound to, or -1 (unbound / binding failed /
  * worker not started yet) */
 int32_t ptc_worker_binding(ptc_context_t *ctx, int32_t worker);

@@ -488,6 +488,8 @@ struct ptc_context {
    * pinning; worker_cpu[w] = bound cpu id or -1 */
   int32_t bind_mode = 0;
   std::vector<std::atomic<int32_t> *> worker_cpu;
+  /* per-subsystem debug verbosity (PTC_DBG_*; debug.c streams analog) */
+  std::atomic<int32_t> verbose[PTC_DBG_NSUBSYS] = {};
 
   /* communication engine (nullptr when single-process) */
   CommEngine *comm = nullptr;

@@ -37,6 +37,12 @@ FLOW_CTL = 4
 MAX_LOCALS = 20
 MAX_FLOWS = 20
 
+# debug-stream subsystem ids (must mirror PTC_DBG_* in parsec_core.h)
+DBG_RUNTIME = 0
+DBG_COMM = 1
+DBG_DEVICE = 2
+DBG_SUBSYSTEMS = ("runtime", "comm", "device")  # index == id
+
 BODY_NOOP = 0
 BODY_CB = 1
 BODY_DEVICE = 2
@@ -121,6 +127,8 @@ _sigs = {
     "ptc_context_set_rank": (None, [C.c_void_p, C.c_uint32, C.c_uint32]),
     "ptc_context_set_binding": (None, [C.c_void_p, C.c_int32]),
     "ptc_worker_binding": (C.c_int32, [C.c_void_p, C.c_int32]),
+    "ptc_context_set_verbose": (None, [C.c_void_p, C.c_int32, C.c_int32]),
+    "ptc_context_verbose": (C.c_int32, [C.c_void_p, C.c_int32]),
     "ptc_register_expr_cb": (C.c_int32, [C.c_void_p, EXPR_CB_T, C.c_void_p]),
     "ptc_register_body": (C.c_int32, [C.c_void_p, BODY_CB_T, C.c_void_p]),
     "ptc_register_collection": (C.c_int32, [C.c_void_p, C.c_uint32, C.c_uint32,

@@ -90,7 +90,12 @@ def make_moe_collections(S, T, d, f, E, nodes=1, myrank=0, x=None,
 
 def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
               capacity: Optional[int] = None,
-              activation: Callable = _relu, dev=None) -> pt.Taskpool:
+              activation: Callable = _relu,
+              activation_jax: Optional[Callable] = None,
+              dev=None) -> pt.Taskpool:
+    """`activation` runs in the CPU bodies (numpy); when `dev` is given
+    the EXP FFN offloads to the device and needs a jax-traceable
+    `activation_jax` (defaulted for the stock relu)."""
     S, T, d = Xc.mt, Xc.mb, Xc.nb
     f = WUc.nb
     C = capacity if capacity is not None else T
@@ -191,10 +196,20 @@ def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
     acc.body(b_acc)
 
     if dev is not None:
+        act_jax = activation_jax
+        if act_jax is None:
+            if activation is not _relu:
+                raise ValueError(
+                    "build_moe: a custom activation needs a jax-traceable "
+                    "activation_jax= for the device kernel (the numpy "
+                    "activation cannot trace)")
+            import jax.numpy as jnp
+            act_jax = lambda v: jnp.maximum(v, 0.0)  # noqa: E731
+
         # the FLOPs live in EXP: offload its fused FFN to the device
         def k_exp(dtile, wu, wd):
             import jax.numpy as jnp
-            y = jnp.maximum(dtile[:, :d] @ wu, 0.0) @ wd
+            y = act_jax(dtile[:, :d] @ wu) @ wd
             return jnp.concatenate([y, dtile[:, d:]], axis=1)
 
         dev.attach(exp, tp, kernel=k_exp, reads=["D", "WU", "WD"],

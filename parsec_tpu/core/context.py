@@ -63,6 +63,11 @@ class Context:
             enable_from_param(self, _mca.get("runtime.pins"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
+        # per-subsystem debug streams (parsec/utils/debug.c analog)
+        for i, name in enumerate(N.DBG_SUBSYSTEMS):
+            lvl = _mca.get(f"debug.{name}")
+            if lvl:
+                N.lib.ptc_context_set_verbose(self._ptr, i, lvl)
         # keep-alives: ctypes callbacks must outlive the native context
         self._expr_cbs: List = []
         self._body_cbs: List = []

@@ -428,6 +428,8 @@ class TpuDevice:
         # id(stack) -> [refcount, stack]; the strong ref keeps id() stable
         self._stacks: Dict[int, list] = {}
         self._lock = threading.Lock()
+        self._dbg(f"device up: {self.device} queue={self.qid} "
+                  f"cache={cache_bytes >> 20}MiB batch<= {self.batch_max}")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stats = {"tasks": 0, "h2d_bytes": 0, "d2h_bytes": 0,
@@ -617,6 +619,13 @@ class TpuDevice:
         with self._lock:
             ent.dirty = False
 
+    def _dbg(self, msg: str):
+        """Device-subsystem debug stream (PTC_MCA_debug_device >= 1;
+        reference: the per-subsystem output streams, parsec/utils/debug.c)."""
+        if N.lib.ptc_context_verbose(self.ctx._ptr, N.DBG_DEVICE) >= 1:
+            import sys
+            print(f"ptc [device]: {msg}", file=sys.stderr)
+
     def flush(self):
         """Write every dirty device mirror back to its host copy.  Call
         before bulk host reads (to_dense etc.); per-copy coherence for CPU
@@ -628,6 +637,8 @@ class TpuDevice:
             # buffers can be freed concurrently by the last consumer
             dirty = [(k, e) for k, e in self._cache.items()
                      if e.dirty and e.persistent]
+        if dirty:
+            self._dbg(f"flush: {len(dirty)} dirty mirrors")
         by_shape: Dict[tuple, list] = {}
         for uid, ent in dirty:
             by_shape.setdefault(tuple(ent.host.shape), []).append(ent)

@@ -30,6 +30,15 @@ Expressions: C-style with ? :, && || !, comparisons, + - * / %, and
 `%{ <python expr> %}` escapes evaluated over locals, int globals, and the
 program scope (prologue definitions + objects bound via builder.scope).
 
+Dynamic-guard semantics (matches the reference): a data-input dep whose
+guard contains a `%{ %}` escape cannot be pruned statically — the escape
+may read state task bodies write later (the choice pattern) — so the
+instance is counted as WAITING for that delivery rather than evaluated
+now.  If no producer ever chooses it, retire it via
+`taskpool.addto_nb_tasks(-1)` (what choice-style DAGs do); a pure
+always-false escape guard on a data input with a memory fallback would
+therefore wait forever — write such guards as plain expressions instead.
+
 User-defined functions (reference: tests/dsl/ptg/user-defined-functions):
   %option nb_local_tasks_fn = fn   — fn(taskpool) -> int overrides the
       enumerated local-task count used for termination detection.

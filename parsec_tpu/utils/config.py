@@ -151,6 +151,15 @@ dump_help = params.dump_help
 # away (PTC_MCA_runtime_sched=lfq).
 register("runtime.sched", "lws", str,
          "scheduler module (reference: --mca sched <m>)")
+register("debug.runtime", 0, int,
+         "runtime-subsystem verbosity: >=1 prints taskpool lifecycle "
+         "diagnostics (reference: the per-subsystem debug output "
+         "streams, parsec/utils/debug.c)")
+register("debug.comm", 0, int,
+         "comm-subsystem verbosity: >=1 prints mesh/fence diagnostics")
+register("debug.device", 0, int,
+         "device-subsystem verbosity: >=1 prints stage/flush "
+         "diagnostics")
 register("runtime.bind", "none", str,
          "worker thread binding: none|core — core pins workers "
          "round-robin over the allowed cpuset (reference: the hwloc "

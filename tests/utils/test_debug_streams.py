@@ -1,0 +1,47 @@
+"""Per-subsystem debug streams (reference: parsec/utils/debug.c — one
+output stream per subsystem with its own verbosity, MCA-selected)."""
+import subprocess
+import sys
+
+SCRIPT = """
+import parsec_tpu as pt
+with pt.Context(nb_workers=1) as ctx:
+    tp = pt.Taskpool(ctx, globals={"NB": 3})
+    tc = tp.task_class("T"); tc.param("k", 0, pt.G("NB")); tc.body_noop()
+    tp.run(); tp.wait()
+print("done")
+"""
+
+
+def _run(env_extra):
+    import os
+    env = dict(os.environ)
+    env.update(env_extra)
+    r = subprocess.run([sys.executable, "-c", SCRIPT], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    return r.stderr
+
+
+def test_runtime_stream_off_by_default():
+    err = _run({})
+    assert "ptc [runtime]:" not in err
+
+
+def test_runtime_stream_verbose():
+    err = _run({"PTC_MCA_debug_runtime": "1"})
+    assert "ptc [runtime]: taskpool 0: 4 local tasks" in err, err
+    assert "taskpool 0 complete (0 errors)" in err, err
+    # other subsystems stay quiet
+    assert "ptc [comm]:" not in err and "ptc [device]:" not in err
+
+
+def test_verbose_api_roundtrip():
+    import parsec_tpu as pt
+    from parsec_tpu import _native as N
+    with pt.Context(nb_workers=1) as ctx:
+        assert N.lib.ptc_context_verbose(ctx._ptr, 1) == 0
+        N.lib.ptc_context_set_verbose(ctx._ptr, 1, 2)
+        assert N.lib.ptc_context_verbose(ctx._ptr, 1) == 2
+        N.lib.ptc_context_set_verbose(ctx._ptr, 99, 1)  # out of range: safe
+        assert N.lib.ptc_context_verbose(ctx._ptr, 99) == 0
