@@ -243,6 +243,8 @@ class Context:
             if qid is not None:
                 lines.append(f"device[{i}] queue={qid} "
                              f"depth={self.device_queue_depth(qid)}")
+            if hasattr(dev, "info"):
+                lines.append(f"device[{i}] info: {dev.info()}")
         if self.comm_enabled:
             lines.append(f"comm: {self.comm_stats()}")
         lines.append(f"rusage: {self.rusage()}")

@@ -174,8 +174,7 @@ def _make_dp_callbacks(ctx):
                                 _DP_REG[tag] = [_conc(ent), 1, key,
                                                 ent.raw]
                                 _DP_BY_KEY[key] = tag
-                        dev.stats["dp_sends"] = \
-                            dev.stats.get("dp_sends", 0) + 1
+                        dev.stats["dp_sends"] += 1
                         return tag
             return 0
         except Exception:
@@ -253,8 +252,7 @@ def _make_dp_callbacks(ctx):
                 # rawness travels with the array: a relay's raw-bytes
                 # mirror stays raw (consumers reinterpret at stage-in)
                 dev._cache_put(uid, 0, darr, arr.nbytes, raw=was_raw)
-                dev.stats["dp_d2d_bytes"] = \
-                    dev.stats.get("dp_d2d_bytes", 0) + arr.nbytes
+                dev.stats["dp_d2d_bytes"] += arr.nbytes
                 return uid
             host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
             darr = dev._jax.device_put(host, dev.device)
@@ -262,8 +260,7 @@ def _make_dp_callbacks(ctx):
             # version 0 matches the fresh wire-materialized ptc_copy;
             # raw=True: stage-in reinterprets to the consumer's dtype/shape
             dev._cache_put(uid, 0, darr, size, raw=True)
-            dev.stats["dp_recv_bytes"] = \
-                dev.stats.get("dp_recv_bytes", 0) + size
+            dev.stats["dp_recv_bytes"] += size
             return uid
         except Exception:
             import traceback
@@ -432,8 +429,14 @@ class TpuDevice:
                   f"cache={cache_bytes >> 20}MiB batch<= {self.batch_max}")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # every key pre-populated: the dict never resizes after init, so
+        # a concurrent info()/stats_dump() copy cannot hit a
+        # changed-size-during-iteration error
         self.stats = {"tasks": 0, "h2d_bytes": 0, "d2h_bytes": 0,
-                      "h2d_hits": 0, "evictions": 0, "dead_drops": 0}
+                      "h2d_hits": 0, "evictions": 0, "dead_drops": 0,
+                      "batches": 0, "batched_tasks": 0, "d2d_bytes": 0,
+                      "dp_sends": 0, "dp_d2d_bytes": 0,
+                      "dp_recv_bytes": 0, "invalidations": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -552,8 +555,7 @@ class TpuDevice:
                 ent = sib._cache.pop(uid, None)
                 if ent is not None:
                     sib._uncharge(ent)
-                    sib.stats["invalidations"] = \
-                        sib.stats.get("invalidations", 0) + 1
+                    sib.stats["invalidations"] += 1
 
     def _cache_get(self, uid, version) -> Optional[object]:
         with self._lock:
@@ -618,6 +620,32 @@ class TpuDevice:
         self.stats["d2h_bytes"] += res.nbytes
         with self._lock:
             ent.dirty = False
+
+    def info(self) -> dict:
+        """Device info object (reference: the per-device info dictionaries,
+        parsec/mca/device/device.h device_info) — identity, capacity, and
+        live cache/kernel state for tooling and stats dumps."""
+        with self._lock:
+            cache_n = len(self._cache)
+            cache_b = self._cache_used
+            # copied under the lock: the manager thread inserts stats
+            # keys lazily, and dict iteration during an insert raises
+            stats = dict(self.stats)
+            attached = len(self.bodies)
+        return {
+            "device": str(self.device),
+            "kind": getattr(self.device, "device_kind", "?"),
+            "platform": getattr(self.device, "platform", "?"),
+            "queue": self.qid,
+            "cache_tiles": cache_n,
+            "cache_bytes": cache_b,
+            "cache_capacity": self._cache_bytes,
+            "attached_classes": attached,
+            # the executable cache is process-wide (shared across device
+            # instances of one client), hence the name
+            "process_jit_kernels": len(_JIT_CACHE),
+            "stats": stats,
+        }
 
     def _dbg(self, msg: str):
         """Device-subsystem debug stream (PTC_MCA_debug_device >= 1;
@@ -816,8 +844,7 @@ class TpuDevice:
             if sarr is not None:
                 darr = self._jax.device_put(sarr, self.device)
                 self._cache_put(uid, ver, darr, sarr.nbytes)
-                self.stats["d2d_bytes"] = \
-                    self.stats.get("d2d_bytes", 0) + sarr.nbytes
+                self.stats["d2d_bytes"] += sarr.nbytes
                 return darr
         host = view.data(flow, dtype=body.dtypes[flow],
                          shape=body.shapes.get(flow), sync=False)
@@ -907,9 +934,8 @@ class TpuDevice:
                     self._write_out(view, body, f, _StackRef(ostack, i),
                                     res[i] if sync_host else None)
             self.stats["tasks"] += len(tasks)
-            self.stats["batches"] = self.stats.get("batches", 0) + 1
-            self.stats["batched_tasks"] = \
-                self.stats.get("batched_tasks", 0) + len(tasks)
+            self.stats["batches"] += 1
+            self.stats["batched_tasks"] += len(tasks)
         except Exception:
             # a vmap-incompatible kernel (no batching rule, shape-dependent
             # callback, ...) must not abort the pool: fall back to strict

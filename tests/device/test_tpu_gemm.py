@@ -48,6 +48,13 @@ def test_gemm_tpu_device():
         assert dev.stats["tasks"] == 4 * 4 * 4
         # A tiles are reused across the n-dimension: cache must hit
         assert dev.stats["h2d_hits"] > 0
+        # device info object (per-device identity/capacity dictionary)
+        info = dev.info()
+        assert info["queue"] == dev.qid
+        assert info["attached_classes"] >= 1
+        assert info["cache_bytes"] <= info["cache_capacity"]
+        assert info["stats"]["tasks"] == 64
+        assert f"queue={dev.qid}" in ctx.stats_dump()
 
 
 def test_device_stage_in_version_invalidation():
