@@ -228,7 +228,10 @@ def main():
                          "(axon tunnel down?); falling back to dispatch\n")
         print(_dispatch_json())
         return 0
-    ladder = [(16384, 1024), (32768, 512), (65536, 512)]
+    # NB=512 first: it is the config the dispatch path must prove itself
+    # at (4x the task count of NB=1024); if the budget only admits one
+    # rung, that one carries the most evidence.  Larger N supersedes.
+    ladder = [(16384, 512), (32768, 512), (65536, 512)]
     if os.environ.get("PTC_BENCH_N"):
         ladder = [(int(os.environ["PTC_BENCH_N"]),
                    int(os.environ.get("PTC_BENCH_NB", "512")))]

@@ -1,14 +1,20 @@
 #!/bin/bash
 # Probe the TPU tunnel; when it comes back, run the spotrf bench ladder
-# and leave results in /tmp/spotrf_r3.jsonl.  One rung per probe cycle so
-# a mid-ladder wedge still records earlier rungs.
+# and leave results in /tmp/spotrf_r3.jsonl.  Re-probe before each rung
+# so a mid-ladder wedge stops the ladder (keeping the rungs already
+# recorded) instead of burning the per-rung timeout on a dead tunnel.
 cd /root/repo
 OUT=/tmp/spotrf_r3.jsonl
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel alive" >> $OUT
-    for cfg in "16384 1024" "32768 512" "65536 512"; do
+    for cfg in "16384 512" "32768 512" "65536 512"; do
       set -- $cfg
+      if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
+      then
+        echo "$(date -u +%H:%M:%S) tunnel dropped before N=$1" >> $OUT
+        break
+      fi
       echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 start" >> $OUT
       PTC_BENCH_PROFILE=1 timeout 2400 python bench.py --spotrf-child \
         --n $1 --nb $2 >> $OUT 2>&1
