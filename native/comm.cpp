@@ -136,6 +136,12 @@ struct Reader {
  * by the `comm.engine` MCA param (env PTC_MCA_comm_engine). */
 struct CeOps {
   const char *name;
+  /* component priority + availability probe (reference: the MCA
+   * open/query protocol — components report a priority and whether
+   * they can run here; the framework picks the best available when no
+   * name is forced).  available == nullptr means always available. */
+  int32_t priority;
+  bool (*available)(void);
   /* bring up links to all peers; spawn the progress thread */
   int32_t (*start)(CommEngine *ce, int base_port);
   /* queue one framed message for `rank` (any thread) */
@@ -1501,17 +1507,38 @@ static void tcp_stop(CommEngine *ce) {
   if (ce->tcp.thread.joinable()) ce->tcp.thread.join();
 }
 
-static const CeOps TCP_OPS = {"tcp", tcp_start, tcp_post, tcp_wake, tcp_stop};
+static const CeOps TCP_OPS = {"tcp", /*priority=*/10, /*available=*/nullptr,
+                              tcp_start, tcp_post, tcp_wake, tcp_stop};
 
-/* transport registry (MCA-style selection by name) */
+/* transport registry (MCA-style selection: explicit name wins; otherwise
+ * the highest-priority AVAILABLE component — the open/query protocol of
+ * the reference's MCA framework, mca_base_components_open.c) */
 static const CeOps *CE_REGISTRY[] = {&TCP_OPS};
 
 static const CeOps *ce_select(const char *name) {
-  for (const CeOps *ops : CE_REGISTRY)
-    if (!name || !*name || std::strcmp(ops->name, name) == 0) return ops;
-  std::fprintf(stderr, "ptc-comm: unknown comm engine '%s'; using %s\n",
-               name, CE_REGISTRY[0]->name);
-  return CE_REGISTRY[0];
+  if (name && *name) {
+    for (const CeOps *ops : CE_REGISTRY)
+      if (std::strcmp(ops->name, name) == 0) {
+        if (ops->available && !ops->available()) {
+          std::fprintf(stderr, "ptc-comm: comm engine '%s' is not "
+                               "available here\n", name);
+          break;
+        }
+        return ops;
+      }
+    std::fprintf(stderr, "ptc-comm: unknown/unavailable comm engine "
+                         "'%s'; falling back to priority selection\n",
+                 name);
+  }
+  const CeOps *best = nullptr;
+  for (const CeOps *ops : CE_REGISTRY) {
+    if (ops->available && !ops->available()) continue;
+    if (!best || ops->priority > best->priority) best = ops;
+  }
+  if (!best)
+    std::fprintf(stderr, "ptc-comm: no comm-engine component is "
+                         "available on this host\n");
+  return best; /* caller aborts init on nullptr */
 }
 
 } // namespace
@@ -1951,6 +1978,10 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
   ce->td_info.resize(ctx->nodes);
   ce->peer_lost.assign(ctx->nodes, 0);
   ce->ops = ce_select(std::getenv("PTC_MCA_comm_engine"));
+  if (!ce->ops) {
+    delete ce;
+    return -1;
+  }
   if (const char *e = std::getenv("PTC_MCA_comm_eager_limit"))
     ce->eager_limit = std::atoll(e);
   if (const char *e = std::getenv("PTC_MCA_comm_fence_timeout_s"))

@@ -636,6 +636,16 @@ def moe_taskpool_spmd(rank: int, nodes: int, port: int, S: int = 4,
         ctx.comm_fini()
 
 
+def ptg_chain_bogus_engine(rank: int, nodes: int, port: int):
+    """An unknown comm.engine name falls back to MCA priority selection
+    (highest-priority available component = tcp) and the job still runs —
+    the open/query protocol of the reference's component framework."""
+    import os
+
+    os.environ["PTC_MCA_comm_engine"] = "no_such_transport"
+    ptg_chain(rank, nodes, port, nb=8)
+
+
 def ptg_chain_with_stray_client(rank: int, nodes: int, port: int):
     """A stray client with a bad handshake (wrong magic — e.g. a port
     scanner or a mismatched build) must be rejected without consuming a

@@ -180,6 +180,10 @@ def test_moe_taskpool_4ranks():
     _run_spmd(_workers.moe_taskpool_spmd, 4)
 
 
+def test_unknown_comm_engine_falls_back_by_priority():
+    _run_spmd(_workers.ptg_chain_bogus_engine, 2)
+
+
 def test_stray_client_rejected_at_handshake():
     """Wrong-magic connections are rejected at connect (version/magic
     handshake); the real mesh still forms."""

@@ -1,27 +1,40 @@
 #!/bin/bash
-# Probe the TPU tunnel; when it comes back, run the spotrf bench ladder
-# and leave results in /tmp/spotrf_r3.jsonl.  Re-probe before each rung
-# so a mid-ladder wedge stops the ladder (keeping the rungs already
-# recorded) instead of burning the per-rung timeout on a dead tunnel.
+# Probe the TPU tunnel; whenever it is up, run the next unfinished rung
+# of the spotrf ladder, recording results in /tmp/spotrf_r3.jsonl.  A
+# mid-ladder wedge keeps completed rungs and re-arms on the next probe
+# cycle; the script exits when every rung has completed (or probes are
+# exhausted).  The outer probe doubles as the pre-rung liveness check —
+# exactly one JAX init per attempt.
 cd /root/repo
 OUT=/tmp/spotrf_r3.jsonl
+STATE=/tmp/spotrf_r3.done
+touch $STATE
 for i in $(seq 1 200); do
+  remaining=0
+  for cfg in "16384 512" "32768 512" "65536 512"; do
+    grep -q "^$cfg$" $STATE || remaining=$((remaining + 1))
+  done
+  if [ $remaining -eq 0 ]; then
+    echo "$(date -u +%H:%M:%S) ladder complete" >> $OUT
+    exit 0
+  fi
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u +%H:%M:%S) tunnel alive" >> $OUT
     for cfg in "16384 512" "32768 512" "65536 512"; do
+      grep -q "^$cfg$" $STATE && continue
       set -- $cfg
-      if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
-      then
-        echo "$(date -u +%H:%M:%S) tunnel dropped before N=$1" >> $OUT
-        break
-      fi
       echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 start" >> $OUT
       PTC_BENCH_PROFILE=1 timeout 2400 python bench.py --spotrf-child \
         --n $1 --nb $2 >> $OUT 2>&1
-      echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 rc=$?" >> $OUT
+      rc=$?
+      echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 rc=$rc" >> $OUT
+      if [ $rc -eq 0 ]; then
+        echo "$cfg" >> $STATE
+      else
+        break  # wedge/failure: back to probing, completed rungs kept
+      fi
     done
-    exit 0
+  else
+    sleep 300
   fi
-  sleep 300
 done
 echo "watcher gave up" >> $OUT
