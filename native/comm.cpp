@@ -527,7 +527,11 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
       std::memset(copy->ptr, 0, (size_t)alloc_len);
     }
     /* data plane delivered this payload into the device cache too: stamp
-     * its uid so a device-chore consumer hits the cache (no re-stage) */
+     * its uid so a device-chore consumer hits the cache (no re-stage).
+     * CONTRACT with the device layer: the cache entry was inserted at
+     * version 0 (tpu.py dp_deliver), matching this freshly-constructed
+     * copy's version 0 — bump neither side alone or cache hits silently
+     * become misses (or stale hits after copy reuse). */
     copy->handle = device_uid;
     /* let the device layer bind the host buffer of its mirror: a by-ref
      * delivery (host bytes never written) materializes on host lazily
