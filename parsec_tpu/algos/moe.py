@@ -50,6 +50,16 @@ def _topk_gate(x, w_gate, k):
     return idx, vals / vals.sum(axis=-1, keepdims=True)
 
 
+def _k_exp_relu(dtile, wu, wd):
+    """Stock device kernel for EXP (relu FFN over the packed dispatch
+    tile); module-level so the jit cache holds exactly one entry per
+    (shape, dtype) across every build_moe call in the process."""
+    import jax.numpy as jnp
+    d = wu.shape[0]
+    y = jnp.maximum(dtile[:, :d] @ wu, 0.0) @ wd
+    return jnp.concatenate([y, dtile[:, d:]], axis=1)
+
+
 def make_moe_collections(S, T, d, f, E, nodes=1, myrank=0, x=None,
                          w_gate=None, w_up=None, w_down=None):
     """Token shards X/Y (shard s on rank s%nodes), per-expert weights
@@ -190,32 +200,32 @@ def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
             if p != 0.0:
                 a[int(c[row, d])] += p * c[row, :d]
 
-    gate.body(b_gate)
-    disp.body(b_disp)
-    exp.body(b_exp)
-    acc.body(b_acc)
-
     if dev is not None:
-        act_jax = activation_jax
-        if act_jax is None:
-            if activation is not _relu:
-                raise ValueError(
-                    "build_moe: a custom activation needs a jax-traceable "
-                    "activation_jax= for the device kernel (the numpy "
-                    "activation cannot trace)")
-            import jax.numpy as jnp
-            act_jax = lambda v: jnp.maximum(v, 0.0)  # noqa: E731
-
-        # the FLOPs live in EXP: offload its fused FFN to the device
-        def k_exp(dtile, wu, wd):
-            import jax.numpy as jnp
-            y = act_jax(dtile[:, :d] @ wu) @ wd
-            return jnp.concatenate([y, dtile[:, d:]], axis=1)
-
+        # device chore attached BEFORE the CPU bodies: chores are tried
+        # in declaration order, so the device runs and CPU is the
+        # fallback.  The FLOPs live in EXP — offload its fused FFN.
+        if activation_jax is not None:
+            def k_exp(dtile, wu, wd, _act=activation_jax):
+                import jax.numpy as jnp
+                dd = wu.shape[0]
+                y = _act(dtile[:, :dd] @ wu) @ wd
+                return jnp.concatenate([y, dtile[:, dd:]], axis=1)
+        elif activation is _relu:
+            k_exp = _k_exp_relu  # module-level: one jitted entry/process
+        else:
+            raise ValueError(
+                "build_moe: a custom activation needs a jax-traceable "
+                "activation_jax= for the device kernel (the numpy "
+                "activation cannot trace)")
         dev.attach(exp, tp, kernel=k_exp, reads=["D", "WU", "WD"],
                    writes=["D"],
                    shapes={"D": (C, d + 2), "WU": (d, f), "WD": (f, d)},
                    dtype=np.float32)
+
+    gate.body(b_gate)
+    disp.body(b_disp)
+    exp.body(b_exp)
+    acc.body(b_acc)
     return tp
 
 

@@ -54,6 +54,38 @@ def test_moe_taskpool_matches_gspmd_library():
                                atol=3e-4)
 
 
+def test_moe_taskpool_device_offload():
+    """EXP's fused FFN offloaded to the device module produces the same
+    result as the CPU bodies; a custom activation without a jax form is
+    rejected up front."""
+    from parsec_tpu.device import TpuDevice
+
+    x, wg, wu, wd = _inputs(seed=9)
+    with pt.Context(nb_workers=1) as ctx:
+        Xc, Yc, WGc, WUc, WDc = make_moe_collections(
+            S, T, d, f, E, x=x, w_gate=wg, w_up=wu, w_down=wd)
+        dev = TpuDevice(ctx)
+        tp = build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=K, dev=dev)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        # the device chore actually ran the EXP tasks (no CPU fallback)
+        assert dev.stats["tasks"] == S * E, dev.stats
+        dev.stop()
+        y = np.concatenate([Yc.tile(s_, 0) for s_ in range(S)])
+    ref = moe_oracle(x, wg, wu, wd, k=K)
+    np.testing.assert_allclose(y, ref, rtol=3e-4, atol=3e-4)
+
+    with pt.Context(nb_workers=1) as ctx:
+        Xc, Yc, WGc, WUc, WDc = make_moe_collections(
+            S, T, d, f, E, x=x, w_gate=wg, w_up=wu, w_down=wd)
+        dev = TpuDevice(ctx)
+        with pytest.raises(ValueError, match="activation_jax"):
+            build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=K,
+                      activation=lambda v: np.tanh(v), dev=dev)
+        dev.stop()
+
+
 def test_moe_capacity_drops_tokens():
     """capacity=1: each expert keeps one token per shard, the rest are
     dropped (zero contribution) — the GShard capacity semantics."""
