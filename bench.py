@@ -96,6 +96,32 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
         return dt, resid
 
 
+def _chip_info():
+    """(device_kind, measured fp32 matmul GFLOP/s) of the chip the bench
+    runs on.  The matmul peak is measured, not tabulated: chip class can
+    change between rounds (v5p vs v5e) and published fp32 rates don't
+    exist for TPUs, so spotrf numbers are only interpretable relative to
+    what *this* chip's MXU does on plain fp32 GEMM.  A scalar readback
+    forces completion (block_until_ready can return early through the
+    tunnel)."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu")
+    n = 4096
+    a = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda x: x @ x)
+    float(f(a)[0, 0])  # compile + settle
+    reps = 8
+    t0 = time.perf_counter()
+    x = a
+    for _ in range(reps):
+        x = f(x)
+    float(x[0, 0])
+    dt = time.perf_counter() - t0
+    return kind, reps * 2 * n ** 3 / dt / 1e9
+
+
 def bench_spotrf(N=16384, nb=1024, reps=2):
     import os
     from parsec_tpu.algos import potrf_flops
@@ -203,6 +229,7 @@ def main():
     if "--spotrf-child" in sys.argv:
         n = _arg_after("--n", 16384)
         nb = _arg_after("--nb", 1024)
+        chip, peak = _chip_info()
         gflops = bench_spotrf(n, nb)
         print(json.dumps({
             "metric": "spotrf_gflops_per_chip",
@@ -210,6 +237,9 @@ def main():
             "unit": "GFLOP/s",
             "vs_baseline": round(gflops / 7000.0, 4),
             "config": {"N": n, "NB": nb},
+            "chip_kind": chip,
+            "chip_fp32_matmul_gflops": round(peak, 1),
+            "frac_of_chip_matmul": round(gflops / peak, 3) if peak else None,
         }))
         return 0
     # Headline spotrf runs on the real chip through the axon tunnel, which
@@ -231,15 +261,22 @@ def main():
     # NB=512 first: it is the config the dispatch path must prove itself
     # at (4x the task count of NB=1024); if the budget only admits one
     # rung, that one carries the most evidence.  Larger N supersedes.
-    ladder = [(16384, 512), (32768, 512), (65536, 512)]
+    # The smallest rung leads with a TIGHT cap so a slow tunnel still
+    # leaves budget to land it (two rounds running, rung-budget greed is
+    # why no NB=512 number got captured).
+    ladder = [(8192, 512), (16384, 512), (32768, 512), (65536, 512)]
+    caps = [240, 360, 600, None]
     if os.environ.get("PTC_BENCH_N"):
         ladder = [(int(os.environ["PTC_BENCH_N"]),
                    int(os.environ.get("PTC_BENCH_NB", "512")))]
+        caps = [None]
     best_line = None
-    for n, nb in ladder:
+    for (n, nb), cap in zip(ladder, caps):
         remaining = deadline - time.monotonic()
         if remaining < 60:
             break
+        if cap is not None:
+            remaining = min(remaining, cap)
         try:
             r = subprocess.run(
                 [sys.executable, __file__, "--spotrf-child",

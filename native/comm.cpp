@@ -69,6 +69,10 @@ enum {
   MSG_TD = 8,       /* counting-termdet wave: [u64 gen][u64 sent]
                        [u64 recv][u8 idle] (reference: fourcounter
                        UP/DOWN messages over the CE) */
+  MSG_DTD_FETCH = 9, /* pull a marked DTD completion payload:
+                        [i32 tp][u64 seq][u32 flow] */
+  MSG_DTD_DATA = 10, /* fetch response:
+                        [i32 tp][u64 seq][u32 flow][u64 len][bytes] */
 };
 
 /* ACTIVATE payload kinds (reference: short/eager piggy-back vs GET
@@ -1102,6 +1106,74 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
                     /*allow_park=*/true, real_len);
 }
 
+static void handle_dtd_fetch_body(ptc_context *ctx, uint32_t from,
+                                  const uint8_t *body, size_t len) {
+  Reader r{body, body + len};
+  int32_t tp_id = r.i32();
+  uint64_t seq = r.u64();
+  int32_t flow = (int32_t)r.u32();
+  if (!r.ok) {
+    /* cannot even identify the pull — the requester's waiters will hang;
+     * make the cause loud (same-build peers should never produce this) */
+    std::fprintf(stderr, "ptc-comm: malformed DTD_FETCH from rank %u "
+                         "dropped; a pull on that rank may hang\n", from);
+    return;
+  }
+  ptc_taskpool *tp = find_tp(ctx, tp_id);
+  ptc_copy *src = nullptr;
+  if (tp) {
+    std::lock_guard<std::mutex> g(tp->dtd_lock);
+    auto it = tp->dtd_served.find(seq);
+    if (it != tp->dtd_served.end())
+      for (auto &rec : it->second)
+        if (rec.flow == flow) {
+          src = rec.copy;
+          ptc_copy_retain(src); /* pin across the serve (retire can race) */
+          break;
+        }
+  }
+  if (!src) {
+    /* protocol invariant violated (fetch after retire) — loud, and the
+     * requester's waiters would hang: answer with an empty frame so the
+     * failure is a visible wrong-result, not a deadlock */
+    std::fprintf(stderr,
+                 "ptc-comm: DTD fetch for unknown (tp=%d seq=%llu flow=%d) "
+                 "from rank %u\n", tp_id, (unsigned long long)seq, flow,
+                 from);
+  }
+  if (src) ptc_copy_sync_for_host(ctx, src); /* lazy d2h at serve time */
+  std::vector<uint8_t> f = frame_begin(MSG_DTD_DATA);
+  Writer w{f};
+  w.i32(tp_id);
+  w.u64(seq);
+  w.u32((uint32_t)flow);
+  w.u64(src ? (uint64_t)src->size : 0);
+  if (src) w.raw(src->ptr, (size_t)src->size);
+  frame_finish(f);
+  comm_post(ctx->comm, from, std::move(f));
+  if (src) ptc_copy_release_internal(ctx, src);
+}
+
+static void handle_dtd_data_body(ptc_context *ctx, const uint8_t *body,
+                                 size_t len) {
+  Reader r{body, body + len};
+  int32_t tp_id = r.i32();
+  uint64_t seq = r.u64();
+  int32_t flow = (int32_t)r.u32();
+  uint64_t plen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed DTD_DATA frame dropped\n");
+    return;
+  }
+  ptc_taskpool *tp = find_tp(ctx, tp_id);
+  if (!tp) {
+    std::fprintf(stderr, "ptc-comm: DTD_DATA for unknown taskpool %d\n",
+                 tp_id);
+    return;
+  }
+  ptc_dtd_fetch_data(ctx, tp, seq, flow, r.p, (size_t)plen);
+}
+
 static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
                          const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
@@ -1126,6 +1198,12 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     break;
   case MSG_DTD_DONE:
     handle_dtd_done_body(ctx, body, len);
+    break;
+  case MSG_DTD_FETCH:
+    handle_dtd_fetch_body(ctx, from, body, len);
+    break;
+  case MSG_DTD_DATA:
+    handle_dtd_data_body(ctx, body, len);
     break;
   case MSG_FENCE: {
     Reader r{body, body + len};
@@ -1900,13 +1978,36 @@ void ptc_comm_send_dtd_complete(ptc_context *ctx, ptc_taskpool *tp,
   CommEngine *ce = ctx->comm;
   if (!ce) return;
   DynExt *dx = t->dyn;
-  /* payload: written-tile contents, one record per OUTPUT flow */
+  /* payload: written-tile contents, one record per OUTPUT flow.  Small
+   * tiles ride inline (every rank gets the bytes with the completion);
+   * large ones ship a marker and interested ranks pull on demand — the
+   * reference's data-follows-dependency-edges shape instead of
+   * O(nodes x tile bytes) broadcast (insert_function_internal.h:110). */
   std::vector<uint8_t> payload;
   Writer pw{payload};
   for (int fi = 0; fi < dx->nb_flows; fi++) {
     if (!(dx->modes[fi] & PTC_DTD_OUTPUT)) continue;
     ptc_copy *c = t->data[fi];
     if (!c || !c->ptr) continue;
+    if (ce->eager_limit >= 0 && c->size > ce->eager_limit &&
+        dx->tiles[fi] != nullptr) {
+      ptc_dtile *tile = dx->tiles[fi];
+      {
+        std::lock_guard<std::mutex> g(tp->dtd_lock);
+        /* the previous writer's entry for this tile is retired — every
+         * fetch of it has been served (WAR: this writer ran after all
+         * readers of the old version completed, and a reader completes
+         * only after its pull round-trip) */
+        ptc_dtd_retire_served_locked(ctx, tp, tile);
+        ptc_copy_retain(c);
+        tp->dtd_served[dx->seq].push_back(
+            ptc_taskpool::DtdServed{fi, c, tile});
+        tile->served_seq = dx->seq;
+      }
+      pw.u32((uint32_t)fi | PTC_DTD_REC_MARKER);
+      pw.u64((uint64_t)c->size);
+      continue;
+    }
     ptc_copy_sync_for_host(ctx, c); /* coherence: pull device mirror */
     pw.u32((uint32_t)fi);
     pw.u64((uint64_t)c->size);
@@ -1923,6 +2024,19 @@ void ptc_comm_send_dtd_complete(ptc_context *ctx, ptc_taskpool *tp,
     frame_finish(f);
     comm_post(ce, r, std::move(f));
   }
+}
+
+void ptc_comm_send_dtd_fetch(ptc_context *ctx, uint32_t rank, int32_t tp_id,
+                             uint64_t seq, int32_t flow) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return;
+  std::vector<uint8_t> f = frame_begin(MSG_DTD_FETCH);
+  Writer w{f};
+  w.i32(tp_id);
+  w.u64(seq);
+  w.u32((uint32_t)flow);
+  frame_finish(f);
+  comm_post(ce, rank, std::move(f));
 }
 
 void ptc_comm_drain_early(ptc_context *ctx, ptc_taskpool *tp) {

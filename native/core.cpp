@@ -1903,27 +1903,173 @@ static void enumerate_class(ptc_context *ctx, ptc_taskpool *tp,
 /* DTD distributed: shadow release (called from comm.cpp)              */
 /* ------------------------------------------------------------------ */
 
+/* Retire the pull-server entry for `tile` (caller holds tp->dtd_lock).
+ * Safe once the tile's next writer completed locally or as a shadow:
+ * WAR ordering means every pull of the old version was served first. */
+void ptc_dtd_retire_served_locked(ptc_context *ctx, ptc_taskpool *tp,
+                                  ptc_dtile *tile) {
+  if (tile->served_seq == UINT64_MAX) return;
+  auto it = tp->dtd_served.find(tile->served_seq);
+  if (it != tp->dtd_served.end()) {
+    auto &vec = it->second;
+    for (size_t i = 0; i < vec.size(); i++)
+      if (vec[i].tile == tile) {
+        ptc_copy_release_internal(ctx, vec[i].copy);
+        vec.erase(vec.begin() + (ptrdiff_t)i);
+        break;
+      }
+    if (vec.empty()) tp->dtd_served.erase(it);
+  }
+  tile->served_seq = UINT64_MAX;
+}
+
+/* Register `task` as waiting for `tile`'s pulled bytes (+1 message-style
+ * hold, dedup by pointer).  Returns true if this call must ALSO issue the
+ * fetch (first waiter while no pull is in flight).  tile->lock held. */
+static bool dtd_add_fetch_waiter_locked(ptc_dtile *tile, ptc_task *task) {
+  for (ptc_task *w : tile->fetch_waiters)
+    if (w == task) return false;
+  task->dyn->remaining.fetch_add(1, std::memory_order_relaxed);
+  dyn_retain(task);
+  tile->fetch_waiters.push_back(task);
+  if (!tile->fetch_inflight) {
+    tile->fetch_inflight = true;
+    return true;
+  }
+  return false;
+}
+
 /* Payload framing (see comm.cpp dtd_complete): sequence of
- * [u32 flow][u64 len][bytes] records for every OUTPUT-mode flow. */
+ * [u32 flow][u64 len][bytes] records for every OUTPUT-mode flow; a flow
+ * word with PTC_DTD_REC_MARKER set carries no bytes — the writer's rank
+ * serves them on demand (MSG_DTD_FETCH). */
 void ptc_dtd_apply_complete(ptc_context *ctx, ptc_task *t,
                             const uint8_t *payload, size_t len) {
+  ptc_taskpool *tp = t->tp;
+  DynExt *dx = t->dyn;
+  /* this (remote) writer supersedes any pull entry we served for its
+   * tiles — those versions can no longer be fetched */
+  for (int fi = 0; fi < dx->nb_flows; fi++) {
+    ptc_dtile *tile = dx->tiles[fi];
+    if ((dx->modes[fi] & PTC_DTD_OUTPUT) && tile &&
+        tile->served_seq != UINT64_MAX) {
+      std::lock_guard<std::mutex> g(tp->dtd_lock);
+      ptc_dtd_retire_served_locked(ctx, tp, tile);
+    }
+  }
   /* apply written-tile payloads into the local copies */
+  struct Fetch {
+    ptc_dtile *tile;
+    uint64_t seq;
+    int32_t flow;
+    uint32_t src;
+  };
+  std::vector<Fetch> fetches;
   size_t off = 0;
   while (off + 12 <= len) {
-    uint32_t flow;
+    uint32_t flow_word;
     uint64_t plen;
-    std::memcpy(&flow, payload + off, 4);
+    std::memcpy(&flow_word, payload + off, 4);
     std::memcpy(&plen, payload + off + 4, 8);
     off += 12;
+    uint32_t flow = flow_word & ~PTC_DTD_REC_MARKER;
+    if (flow_word & PTC_DTD_REC_MARKER) {
+      /* size-only marker: the local mirror is stale until pulled.  Local
+       * successors already ordered after this shadow (its succs) must not
+       * run on stale bytes — give each a pull hold now, BEFORE the
+       * message hold below releases them.  Successors inserted later are
+       * handled by the submit-time stale check. */
+      ptc_dtile *tile = flow < PTC_MAX_FLOWS ? dx->tiles[flow] : nullptr;
+      if (tile) {
+        /* ORDER MATTERS: mark stale BEFORE snapshotting succs.  A reader
+         * whose dep edge lands after the snapshot then observes stale at
+         * its submit-time check; one that landed before is in the
+         * snapshot; one in between is caught by both (waiter dedup). */
+        {
+          std::lock_guard<std::mutex> tg0(tile->lock);
+          tile->stale = true;
+          tile->stale_seq = dx->seq;
+          tile->stale_flow = (int32_t)flow;
+          tile->stale_src = dx->rank;
+        }
+        std::vector<ptc_task *> succs_snap;
+        {
+          std::lock_guard<std::mutex> g(dx->lock);
+          succs_snap = dx->succs;
+        }
+        std::lock_guard<std::mutex> tg(tile->lock);
+        bool need_fetch = false;
+        for (ptc_task *s : succs_snap) {
+          DynExt *sd = s->dyn;
+          if (!sd || sd->shadow) continue;
+          bool reads_tile = false;
+          {
+            std::lock_guard<std::mutex> sg(sd->lock);
+            if (sd->completed) continue;
+            for (int sf = 0; sf < sd->nb_flows; sf++)
+              if (sd->tiles[sf] == tile && (sd->modes[sf] & PTC_DTD_INPUT)) {
+                reads_tile = true;
+                break;
+              }
+          }
+          if (reads_tile)
+            need_fetch |= dtd_add_fetch_waiter_locked(tile, s);
+        }
+        if (need_fetch)
+          fetches.push_back(Fetch{tile, dx->seq, (int32_t)flow, dx->rank});
+      }
+      continue;
+    }
     if (off + plen > len) break;
     if (flow < PTC_MAX_FLOWS && t->data[flow] && t->data[flow]->ptr)
       std::memcpy(t->data[flow]->ptr, payload + off,
                   (size_t)std::min<uint64_t>(plen, (uint64_t)t->data[flow]->size));
     off += plen;
   }
+  for (const Fetch &f : fetches) {
+    {
+      std::lock_guard<std::mutex> g(tp->dtd_lock);
+      tp->dtd_fetch_pending[{f.seq, f.flow}] = f.tile;
+    }
+    ptc_comm_send_dtd_fetch(ctx, f.src, tp->id, f.seq, f.flow);
+  }
   /* drop the message hold; schedule if local predecessors are also done */
   if (t->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
     ptc_schedule_task(ctx, -1, t);
+}
+
+/* requester side: pulled bytes landed — fill the mirror, release holds */
+void ptc_dtd_fetch_data(ptc_context *ctx, ptc_taskpool *tp, uint64_t seq,
+                        int32_t flow, const uint8_t *payload, size_t len) {
+  ptc_dtile *tile = nullptr;
+  {
+    std::lock_guard<std::mutex> g(tp->dtd_lock);
+    auto it = tp->dtd_fetch_pending.find({seq, flow});
+    if (it == tp->dtd_fetch_pending.end()) {
+      std::fprintf(stderr, "ptc: unexpected DTD_DATA (seq=%llu flow=%d)\n",
+                   (unsigned long long)seq, flow);
+      return;
+    }
+    tile = it->second;
+    tp->dtd_fetch_pending.erase(it);
+  }
+  std::vector<ptc_task *> waiters;
+  {
+    std::lock_guard<std::mutex> g(tile->lock);
+    if (len > 0 && tile->copy && tile->copy->ptr)
+      std::memcpy(tile->copy->ptr, payload,
+                  std::min(len, (size_t)tile->copy->size));
+    /* only clear if no NEWER writer re-marked meanwhile (cannot happen
+     * per WAR ordering, but the guard is cheap) */
+    if (tile->stale && tile->stale_seq == seq) tile->stale = false;
+    tile->fetch_inflight = false;
+    waiters.swap(tile->fetch_waiters);
+  }
+  for (ptc_task *w : waiters) {
+    if (w->dyn->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
+      ptc_schedule_task(ctx, -1, w);
+    dyn_release(w);
+  }
 }
 
 void ptc_dtd_shadow_ready(ptc_context *ctx, ptc_taskpool *tp, uint64_t seq,
@@ -2141,6 +2287,31 @@ void ptc_tp_destroy(ptc_taskpool_t *tp) {
         if (e->staged[f]) copy_release(tp->ctx, e->staged[f]);
       delete e;
     }
+  }
+  {
+    /* never-refetched pull-server entries (chain-final tiles).  The tile
+     * pointers are NOT touched: user tiles may already be destroyed, and
+     * a stale served_seq on a surviving tile is harmless (the next
+     * writer's retire just misses in the new pool's map). */
+    std::lock_guard<std::mutex> g(tp->dtd_lock);
+    for (auto &kv : tp->dtd_served)
+      for (auto &rec : kv.second)
+        ptc_copy_release_internal(tp->ctx, rec.copy);
+    tp->dtd_served.clear();
+    /* unanswered pulls (aborted pool / lost peer): drop the waiters'
+     * retains so their task memory is reclaimed.  No scheduling — the
+     * pool is dying; the +1 holds simply never release. */
+    for (auto &kv : tp->dtd_fetch_pending) {
+      ptc_dtile *tile = kv.second;
+      std::vector<ptc_task *> waiters;
+      {
+        std::lock_guard<std::mutex> tg(tile->lock);
+        waiters.swap(tile->fetch_waiters);
+        tile->fetch_inflight = false;
+      }
+      for (ptc_task *w : waiters) dyn_release(w);
+    }
+    tp->dtd_fetch_pending.clear();
   }
   delete tp;
 }
@@ -2575,6 +2746,40 @@ int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
   tp->nb_tasks.fetch_add(1, std::memory_order_acq_rel);
   tp->nb_total.fetch_add(1, std::memory_order_relaxed);
   ptc_context_start(ctx);
+  /* stale-mirror pulls: a LOCAL task reading a tile whose bytes live on
+   * the remote writer's rank (marker completion) must not run until the
+   * pull lands; a local OUTPUT-only writer clears the mark instead (it
+   * overwrites — nobody here ever needed the old bytes) */
+  if (ctx->nodes > 1 && !dx->shadow) {
+    struct PendingFetch {
+      ptc_dtile *tile;
+      uint64_t seq;
+      int32_t flow;
+      uint32_t src;
+    };
+    std::vector<PendingFetch> fetches;
+    for (int f = 0; f < dx->nb_flows; f++) {
+      ptc_dtile *tile = dx->tiles[f];
+      if (!tile) continue;
+      std::lock_guard<std::mutex> g(tile->lock);
+      if (!tile->stale) continue;
+      if (dx->modes[f] & PTC_DTD_INPUT) {
+        if (dtd_add_fetch_waiter_locked(tile, t))
+          fetches.push_back(PendingFetch{tile, tile->stale_seq,
+                                         tile->stale_flow, tile->stale_src});
+      } else if ((dx->modes[f] & PTC_DTD_OUTPUT) && !tile->fetch_inflight &&
+                 tile->fetch_waiters.empty()) {
+        tile->stale = false;
+      }
+    }
+    for (const PendingFetch &pf : fetches) {
+      {
+        std::lock_guard<std::mutex> g(tp->dtd_lock);
+        tp->dtd_fetch_pending[{pf.seq, pf.flow}] = pf.tile;
+      }
+      ptc_comm_send_dtd_fetch(ctx, pf.src, tp->id, pf.seq, pf.flow);
+    }
+  }
   /* drop the submission hold; schedule if all preds already done */
   if (dx->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1)
     schedule_task(ctx, 0, t);

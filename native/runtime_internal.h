@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -242,6 +243,19 @@ struct ptc_dtile {
   ptc_task *last_writer = nullptr;
   std::vector<ptc_task *> readers;
   uint32_t owner = 0; /* owning rank (distributed DTD placement) */
+  /* Distributed payload pull (the reference routes DTD data along actual
+   * dependency edges instead of broadcasting written tiles to every rank,
+   * insert_function_internal.h:110-139).  A remote writer's completion
+   * above the eager limit carries a size-only marker; the local mirror is
+   * then `stale` until a local consumer pulls the bytes on demand. */
+  bool stale = false;
+  bool fetch_inflight = false;
+  uint64_t stale_seq = 0;   /* writer's insertion seq (the pull key) */
+  int32_t stale_flow = 0;   /* writer's flow index holding the bytes */
+  uint32_t stale_src = 0;   /* rank that executed the writer */
+  std::vector<ptc_task *> fetch_waiters; /* +1 remaining each, retained */
+  /* owner side: seq of this tile's live entry in tp->dtd_served */
+  uint64_t served_seq = UINT64_MAX;
 };
 
 /* ------------------------------------------------------------------ */
@@ -400,6 +414,18 @@ struct ptc_taskpool {
   std::mutex dtd_lock;
   std::unordered_map<uint64_t, ptc_task *> dtd_shadows; /* seq → waiting */
   std::unordered_map<uint64_t, std::vector<uint8_t>> dtd_early;
+  /* payload pull server (writer side): seq → records a remote rank may
+   * still fetch.  An entry is retired when the tile's NEXT writer
+   * completes here (by then every fetch of the old seq has been served —
+   * WAR ordering) or at pool teardown.  Copies are retained. */
+  struct DtdServed {
+    int32_t flow;
+    ptc_copy *copy;
+    ptc_dtile *tile;
+  };
+  std::unordered_map<uint64_t, std::vector<DtdServed>> dtd_served;
+  /* requester side: outstanding pulls, (seq, flow) → destination tile */
+  std::map<std::pair<uint64_t, int32_t>, ptc_dtile *> dtd_fetch_pending;
 };
 
 struct ptc_context {
@@ -610,8 +636,27 @@ void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
                            const int64_t *idx, int32_t nidx, ptc_copy *copy);
 
 /* outgoing DTD completion broadcast (real task finished; shadows on every
- * other rank release their successors + apply written-tile payloads) */
+ * other rank release their successors + apply written-tile payloads).
+ * Written flows at or under the eager limit ride inline
+ * ([u32 flow][u64 len][bytes]); larger ones ship a size-only marker
+ * ([u32 flow|MARKER][u64 len]) and consumers pull on demand. */
 void ptc_comm_send_dtd_complete(ptc_context *ctx, ptc_taskpool *tp,
                                 ptc_task *t);
+
+/* marker bit in a DTD completion record's flow word */
+constexpr uint32_t PTC_DTD_REC_MARKER = 0x80000000u;
+
+/* pull one marked flow's bytes from the rank that ran writer `seq` */
+void ptc_comm_send_dtd_fetch(ptc_context *ctx, uint32_t rank, int32_t tp_id,
+                             uint64_t seq, int32_t flow);
+
+/* requester side: fetched bytes landed (comm.cpp → core.cpp) */
+void ptc_dtd_fetch_data(ptc_context *ctx, ptc_taskpool *tp, uint64_t seq,
+                        int32_t flow, const uint8_t *payload, size_t len);
+
+/* retire the pull-server entry a tile holds (next-writer completion or
+ * teardown); caller must hold tp->dtd_lock */
+void ptc_dtd_retire_served_locked(ptc_context *ctx, ptc_taskpool *tp,
+                                  ptc_dtile *tile);
 
 #endif /* PTC_RUNTIME_INTERNAL_H */

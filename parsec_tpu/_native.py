@@ -11,7 +11,10 @@ import os
 import subprocess
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_LIB_PATH = os.path.join(_REPO, "build", "libparsec_core.so")
+# PTC_NATIVE_LIB points at an alternate build of the core (ASan/TSan
+# instrumented, debug, ...) without touching the default build tree.
+_LIB_PATH = os.environ.get("PTC_NATIVE_LIB") or \
+    os.path.join(_REPO, "build", "libparsec_core.so")
 _SOURCES = [
     os.path.join(_REPO, "native", "core.cpp"),
     os.path.join(_REPO, "native", "sched.cpp"),
@@ -79,6 +82,8 @@ OP_SHR = 24
 
 
 def _needs_build() -> bool:
+    if os.environ.get("PTC_NATIVE_LIB"):
+        return False  # instrumented override: its builder owns freshness
     if not os.path.exists(_LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)

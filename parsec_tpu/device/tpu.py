@@ -848,7 +848,13 @@ class TpuDevice:
                 return darr
         host = view.data(flow, dtype=body.dtypes[flow],
                          shape=body.shapes.get(flow), sync=False)
-        darr = self._jax.device_put(host, self.device)
+        # OWNED snapshot, not the raw view: jax may read the h2d source
+        # AFTER device_put returns (async dispatch), and `host` is a view
+        # over native-owned memory — a wire-arrival copy dies at its last
+        # consumer's completion, which the async kernel can overtake.
+        # Observed failure: the first 16 bytes of a consumed panel turn
+        # into freed-chunk heap metadata (tests/comm potrf device runs).
+        darr = self._jax.device_put(np.array(host, copy=True), self.device)
         self._cache_put(uid, ver, darr, host.nbytes)
         self.stats["h2d_bytes"] += host.nbytes
         return darr

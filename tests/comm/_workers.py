@@ -157,6 +157,64 @@ def dtd_chain(rank: int, nodes: int, port: int, nb_tiles: int = 4,
         ctx.comm_fini()
 
 
+def dtd_routed_payloads(rank: int, nodes: int, port: int,
+                        elems: int = 32768, rounds: int = 4):
+    """Distributed DTD with LARGE tiles: written-tile bytes must ride to
+    the ranks that actually read them, not broadcast to everyone.  Each
+    rank owns one big tile (elems*4 bytes > the 64KiB eager limit); only
+    rank (r+1)%nodes reads rank r's tile.  Completions carry size-only
+    markers; the single reader pulls.  Asserts result values AND that
+    per-rank received bytes are far below the broadcast-all volume
+    (reference: shadow pruning, insert_function_internal.h:110-139)."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.dsl.dtd import DtdTaskpool
+
+    with ctx:
+        big_datas = [ctx.data(i, np.zeros(elems, dtype=np.float32))
+                     for i in range(nodes)]
+        small_datas = [ctx.data(100 + i, np.zeros(4, dtype=np.float32))
+                       for i in range(nodes)]
+        dtp = DtdTaskpool(ctx, window=64)
+        big = [dtp.tile_of(d, owner=i) for i, d in enumerate(big_datas)]
+        small = [dtp.tile_of(d, owner=i)
+                 for i, d in enumerate(small_datas)]
+
+        def mk_writer(val):
+            def w(view):
+                view.data(0, dtype=np.float32)[:] = val
+            return w
+
+        def reader(view):
+            src = view.data(0, dtype=np.float32)
+            dst = view.data(1, dtype=np.float32)
+            dst[0] = src[0]
+            dst[1] = src[-1]
+
+        for j in range(rounds):
+            for r in range(nodes):
+                dtp.insert_task(mk_writer(float(j * nodes + r)),
+                                (big[r], "INOUT"))
+            for r in range(nodes):
+                dtp.insert_task(reader, (big[r], "INPUT"),
+                                (small[(r + 1) % nodes], "INOUT"))
+        dtp.wait()
+        ctx.comm_fence()
+        src_rank = (rank - 1 + nodes) % nodes
+        expect = float((rounds - 1) * nodes + src_rank)
+        mine = np.frombuffer(small_datas[rank].array, dtype=np.float32)
+        assert mine[0] == expect and mine[1] == expect, (rank, mine, expect)
+        st = ctx.comm_stats()
+        tile_bytes = elems * 4
+        # routed: this rank pulls its one source tile `rounds` times (plus
+        # small eager payloads + frame overhead).  Broadcast-all would be
+        # nodes*rounds*tile_bytes received per rank.
+        budget = int(1.5 * rounds * tile_bytes)
+        bcast_all = nodes * rounds * tile_bytes
+        assert st["bytes_recv"] < budget, (rank, st, budget, bcast_all)
+        dtp.destroy()
+        ctx.comm_fini()
+
+
 def ptg_chain_rendezvous(rank: int, nodes: int, port: int, nb: int = 12,
                          elems: int = 4096):
     """RW chain with payloads far above the eager limit: every hop rides
@@ -354,6 +412,62 @@ def ptg_block_cyclic_scale(rank: int, nodes: int, port: int, mt: int = 4,
                 if A.rank_of(mm, nn) == rank:
                     np.testing.assert_allclose(A.tile(mm, nn),
                                                2.0 * (mm + nn + 1))
+        ctx.comm_fini()
+
+
+def potrf_dist(rank: int, nodes: int, port: int, N: int = 64, nb: int = 8,
+               use_device: bool = False):
+    """Distributed tiled Cholesky over a P×Q 2D block-cyclic grid — the
+    DPLASMA shape the whole stack exists for (reference:
+    two_dim_rectangle_cyclic.c:24 + remote_dep.c:454).  Cross-rank
+    TRSM→SYRK/GEMM panel flows ride the remote-dep protocol (eager or
+    rendezvous depending on tile size); the result is validated per-rank
+    against a single-process numpy Cholesky of the same matrix."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.algos import build_potrf
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    with ctx:
+        P = 2 if nodes % 2 == 0 else 1
+        Q = nodes // P
+        # same SPD matrix on every rank, deterministically
+        rng = np.random.default_rng(7)
+        B = rng.normal(size=(N, N)).astype(np.float64)
+        full = (B @ B.T + N * np.eye(N)).astype(np.float32)
+        A = TwoDimBlockCyclic(N, N, nb, nb, P=P, Q=Q, nodes=nodes,
+                              myrank=rank, dtype=np.float32)
+        A.register(ctx, "A")
+        A.from_dense(full)
+        dev = None
+        if use_device:
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # loopback: no tunnel
+            from parsec_tpu.device.tpu import TpuDevice
+            dev = TpuDevice(ctx)
+        tp = build_potrf(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        if dev is not None:
+            dev.flush()
+            dev.stop()
+        L = np.linalg.cholesky(full.astype(np.float64))
+        nt = A.mt
+        for m in range(nt):
+            for n in range(m + 1):  # lower triangle only: potrf_L touches it
+                if A.rank_of(m, n) != rank:
+                    continue
+                ref = L[m * nb:(m + 1) * nb, n * nb:(n + 1) * nb]
+                got = A.tile(m, n)
+                if m == n:  # diagonal tiles: upper part is untouched input
+                    got = np.tril(got)
+                    ref = np.tril(ref)
+                np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+        st = ctx.comm_stats()
+        assert st["msgs_sent"] > 0, st  # panels really crossed ranks
+        rdv = ctx.comm_rdv_stats()
+        assert rdv["registered_bytes"] == 0, rdv
+        assert rdv["pending_pulls"] == 0, rdv
         ctx.comm_fini()
 
 

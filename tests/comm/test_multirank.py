@@ -89,6 +89,11 @@ def test_dtd_chain_2ranks():
     _run_spmd(_workers.dtd_chain, 2, nb_tiles=4, rounds=6)
 
 
+def test_dtd_routed_payloads_4ranks():
+    """Big written tiles travel only to the rank that reads them."""
+    _run_spmd(_workers.dtd_routed_payloads, 4, timeout=180)
+
+
 def test_ptg_chain_rendezvous_2ranks():
     """Payloads above the eager limit ride the GET/PUT_DATA rendezvous;
     comm memory must be fully drained after the fence."""
@@ -178,6 +183,30 @@ def test_moe_taskpool_2ranks():
 
 def test_moe_taskpool_4ranks():
     _run_spmd(_workers.moe_taskpool_spmd, 4)
+
+
+def test_potrf_2ranks():
+    # N=64/nb=8 -> 8x8 tiles on a 2x1 grid: every TRSM->GEMM panel flow
+    # crosses ranks (eager-sized tiles)
+    _run_spmd(_workers.potrf_dist, 2, timeout=180, N=64, nb=8)
+
+
+def test_potrf_4ranks():
+    # 2x2 grid; nb=16 tiles (1KiB) still eager; more rows per panel
+    _run_spmd(_workers.potrf_dist, 4, timeout=240, N=128, nb=16)
+
+
+def test_potrf_2ranks_device():
+    """Panels produced device-resident: cross-rank TRSM->GEMM flows ride
+    the PK_DEVICE protocol (d2h at the producing rank boundary)."""
+    _run_spmd(_workers.potrf_dist, 2, timeout=240, N=64, nb=8,
+              use_device=True)
+
+
+def test_potrf_2ranks_rendezvous():
+    # tiles of 64KiB exceed the eager threshold: panel flows ride the
+    # rendezvous GET protocol
+    _run_spmd(_workers.potrf_dist, 2, timeout=240, N=512, nb=128)
 
 
 def test_unknown_comm_engine_falls_back_by_priority():

@@ -1,17 +1,20 @@
 #!/bin/bash
 # Probe the TPU tunnel; whenever it is up, run the next unfinished rung
-# of the spotrf ladder, recording results in /tmp/spotrf_r3.jsonl.  A
+# of the spotrf ladder, recording results in /tmp/spotrf_r4.jsonl.  A
 # mid-ladder wedge keeps completed rungs and re-arms on the next probe
 # cycle; the script exits when every rung has completed (or probes are
 # exhausted).  The outer probe doubles as the pre-rung liveness check —
 # exactly one JAX init per attempt.
+#
+# The smallest rung (N=8192) leads: it completes even on a slow tunnel,
+# so a brief tunnel window still yields a driver-grade NB=512 number.
 cd /root/repo
-OUT=/tmp/spotrf_r3.jsonl
-STATE=/tmp/spotrf_r3.done
+OUT=/tmp/spotrf_r4.jsonl
+STATE=/tmp/spotrf_r4.done
 touch $STATE
 for i in $(seq 1 200); do
   remaining=0
-  for cfg in "16384 512" "32768 512" "65536 512"; do
+  for cfg in "8192 512" "16384 512" "32768 512" "65536 512"; do
     grep -q "^$cfg$" $STATE || remaining=$((remaining + 1))
   done
   if [ $remaining -eq 0 ]; then
@@ -19,7 +22,7 @@ for i in $(seq 1 200); do
     exit 0
   fi
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    for cfg in "16384 512" "32768 512" "65536 512"; do
+    for cfg in "8192 512" "16384 512" "32768 512" "65536 512"; do
       grep -q "^$cfg$" $STATE && continue
       set -- $cfg
       echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 start" >> $OUT
