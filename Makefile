@@ -6,7 +6,7 @@ LIB := $(BUILD)/libparsec_core.so
 all: $(LIB)
 
 SRCS := native/core.cpp native/sched.cpp native/comm.cpp
-HDRS := native/parsec_core.h native/runtime_internal.h
+HDRS := native/parsec_core.h native/runtime_internal.h native/lockfree.h
 
 $(LIB): $(SRCS) $(HDRS)
 	@mkdir -p $(BUILD)
@@ -15,4 +15,16 @@ $(LIB): $(SRCS) $(HDRS)
 clean:
 	rm -rf $(BUILD)
 
-.PHONY: all clean
+# ThreadSanitizer build of the core (the lock-free scheduler path's
+# correctness harness; see tools/stress_tsan.py).  Loaded via
+# PTC_NATIVE_LIB with the tsan runtime LD_PRELOADed.
+TSAN_LIB := $(BUILD)/libparsec_core_tsan.so
+
+tsan: $(TSAN_LIB)
+
+$(TSAN_LIB): $(SRCS) $(HDRS)
+	@mkdir -p $(BUILD)
+	$(CXX) -O1 -g -std=c++17 -fPIC -Wall -pthread -fsanitize=thread \
+		-shared -o $@ $(SRCS)
+
+.PHONY: all clean tsan

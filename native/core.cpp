@@ -1284,6 +1284,9 @@ static void wake_workers(ptc_context *ctx) {
 } // namespace
 
 void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
+  /* comm-thread deliveries can precede/overlap the lazy start */
+  if (!ctx->started.load(std::memory_order_acquire))
+    ptc_context_start(ctx);
   ctx->sched->schedule(worker < 0 ? 0 : worker, t);
   wake_workers(ctx);
 }
@@ -1303,9 +1306,11 @@ static void notify_drain_waiters(ptc_taskpool *tp) {
    * drain_waiters; drainer stores drain_waiters then loads nb_tasks — the
    * seq_cst total order forbids both sides missing the other's store */
   if (tp->drain_waiters.load(std::memory_order_seq_cst) == 0) return;
-  {
-    std::lock_guard<std::mutex> g(tp->window_lock);
-  }
+  /* notify UNDER the lock: a waiter may return the instant the predicate
+   * flips and destroy the pool — an after-unlock notify would then
+   * broadcast on a dead condvar (ptc_tp_destroy serializes on this lock
+   * before deleting; TSan-caught) */
+  std::lock_guard<std::mutex> g(tp->window_lock);
   tp->window_cv.notify_all();
 }
 
@@ -1320,9 +1325,10 @@ static void tp_mark_complete(ptc_context *ctx, ptc_taskpool *tp) {
    * never hits 0 between the pools and ptc_context_wait stays blocked */
   if (tp->complete_cb) tp->complete_cb(tp->complete_user, tp);
   {
+    /* under the lock: see notify_drain_waiters */
     std::lock_guard<std::mutex> g(tp->done_lock);
+    tp->done_cv.notify_all();
   }
-  tp->done_cv.notify_all();
   notify_drain_waiters(tp);
   if (ctx->active_tps.fetch_sub(1, std::memory_order_acq_rel) == 1) {
     std::lock_guard<std::mutex> g(ctx->wait_lock);
@@ -1493,11 +1499,14 @@ static void dyn_complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   for (int f = 0; f < dx->nb_flows; f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   dyn_release(t);
+  tp->busy.fetch_add(1, std::memory_order_acquire);
   tp_task_done(ctx, tp); /* decrement before waking window waiters */
   {
+    /* under the lock: see notify_drain_waiters */
     std::lock_guard<std::mutex> g(tp->window_lock);
+    tp->window_cv.notify_all();
   }
-  tp->window_cv.notify_all();
+  tp->busy.fetch_sub(1, std::memory_order_release); /* LAST tp access */
 }
 
 static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
@@ -1513,7 +1522,9 @@ static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   for (size_t f = 0; f < tc.flows.size(); f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   task_free(ctx, t);
+  tp->busy.fetch_add(1, std::memory_order_acquire);
   tp_task_done(ctx, tp);
+  tp->busy.fetch_sub(1, std::memory_order_release); /* LAST tp access */
 }
 
 /* A task failed (body error / no runnable chore): do NOT release successors
@@ -1524,7 +1535,9 @@ static void fail_task(ptc_context *ctx, ptc_task *t) {
   for (size_t f = 0; f < tc.flows.size(); f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   task_free(ctx, t);
+  tp->busy.fetch_add(1, std::memory_order_acquire);
   tp_abort(ctx, tp);
+  tp->busy.fetch_sub(1, std::memory_order_release); /* LAST tp access */
 }
 
 /* (prof_event / ptc_prof_push defined above dyn_complete_task) */
@@ -1540,11 +1553,14 @@ static void dyn_fail_task(ptc_context *ctx, ptc_task *t) {
   for (int f = 0; f < dx->nb_flows; f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   dyn_release(t);
+  tp->busy.fetch_add(1, std::memory_order_acquire);
   tp_abort(ctx, tp);
   {
+    /* under the lock: see notify_drain_waiters */
     std::lock_guard<std::mutex> g(tp->window_lock);
+    tp->window_cv.notify_all();
   }
-  tp->window_cv.notify_all();
+  tp->busy.fetch_sub(1, std::memory_order_release); /* LAST tp access */
 }
 
 /* single-chore execution for dynamic tasks */
@@ -2142,12 +2158,19 @@ const char *ptc_context_get_scheduler(ptc_context_t *ctx) {
 }
 
 int32_t ptc_context_start(ptc_context_t *ctx) {
-  bool expected = false;
-  if (!ctx->started.compare_exchange_strong(expected, true)) return 0;
+  /* fully-initialized-before-visible: the comm thread can race a lazy
+   * start (early remote delivery while the user thread is inside
+   * add_taskpool).  The mutex makes late starters BLOCK until install
+   * finished; `started` is released only after the scheduler is usable,
+   * so the fast path's acquire load sees a complete scheduler. */
+  if (ctx->started.load(std::memory_order_acquire)) return 0;
+  std::lock_guard<std::mutex> g(ctx->start_lock);
+  if (ctx->started.load(std::memory_order_relaxed)) return 0;
   ctx->sched = ptc_sched_create(ctx->sched_name);
   ctx->sched->install(ctx->nb_workers);
   for (int i = 0; i < ctx->nb_workers; i++)
     ctx->workers.emplace_back(worker_main, ctx, i);
+  ctx->started.store(true, std::memory_order_release);
   return 0;
 }
 
@@ -2271,6 +2294,14 @@ void ptc_tp_destroy(ptc_taskpool_t *tp) {
     std::lock_guard<std::mutex> g(tp->ctx->tp_reg_lock);
     tp->ctx->tp_registry.erase(tp->id);
   }
+  /* completion drain: a waiter can return the instant completed /
+   * nb_tasks==0 flips, but the completer may still be on its way to the
+   * notify locks (or inside them).  Every such path holds tp->busy for
+   * its full tp lifetime-critical span, so spin it out before freeing
+   * the condvars/mutexes.  (Acquire pairs with the completer's release
+   * decrement: all its tp writes are visible before the delete.) */
+  while (tp->busy.load(std::memory_order_acquire) != 0)
+    std::this_thread::yield();
   for (auto &shard : tp->shards) {
     std::lock_guard<std::mutex> g(shard.lock);
     for (auto &kv : shard.map)
@@ -2358,8 +2389,10 @@ int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp) {
                  tp->id, (long long)st.nb_local, tp->classes.size(),
                  ptc_tp_dense_classes(tp), st.ready.size());
   if (st.nb_local == 0 && !tp->open.load()) {
+    tp->busy.fetch_add(1, std::memory_order_acquire);
     tp_mark_complete(ctx, tp);
     ptc_comm_drain_early(ctx, tp);
+    tp->busy.fetch_sub(1, std::memory_order_release);
     return 0;
   }
   ptc_context_start(ctx);
@@ -2383,11 +2416,13 @@ int64_t ptc_tp_nb_tasks(ptc_taskpool_t *tp) { return tp->nb_tasks.load(); }
  * tests/dsl/ptg/choice/choice.jdf — and by %option nb_local_tasks_fn
  * overrides, tests/dsl/ptg/user-defined-functions/udf.jdf). */
 int64_t ptc_tp_addto_nb_tasks(ptc_taskpool_t *tp, int64_t delta) {
+  tp->busy.fetch_add(1, std::memory_order_acquire);
   int64_t now =
       tp->nb_tasks.fetch_add(delta, std::memory_order_seq_cst) + delta;
   if (now == 0 && !tp->open.load(std::memory_order_seq_cst))
     tp_mark_complete(tp->ctx, tp);
   notify_drain_waiters(tp);
+  tp->busy.fetch_sub(1, std::memory_order_release);
   return now;
 }
 
@@ -2416,8 +2451,11 @@ void ptc_tp_set_open(ptc_taskpool_t *tp, int32_t open) {
   /* closing after the count already drained must still complete the pool;
    * seq_cst pairs with tp_task_done (see comment there) */
   if (!open && tp->added.load(std::memory_order_acquire) &&
-      tp->nb_tasks.load(std::memory_order_seq_cst) == 0)
+      tp->nb_tasks.load(std::memory_order_seq_cst) == 0) {
+    tp->busy.fetch_add(1, std::memory_order_acquire);
     tp_mark_complete(tp->ctx, tp);
+    tp->busy.fetch_sub(1, std::memory_order_release);
+  }
 }
 
 void ptc_tp_set_on_complete(ptc_taskpool_t *tp, ptc_tp_complete_cb cb,

@@ -408,6 +408,12 @@ struct ptc_taskpool {
   std::mutex window_lock;
   std::condition_variable window_cv;
   std::atomic<int32_t> drain_waiters{0};
+  /* completion-path guard: >0 while a completer may still touch this
+   * pool AFTER a waiter-visible predicate (completed / nb_tasks==0)
+   * flipped.  A waiter can return the instant the predicate is true
+   * (spurious wakeup), so ptc_tp_destroy must wait for busy==0 before
+   * freeing the condvars/mutexes the completer is about to notify. */
+  std::atomic<int32_t> busy{0};
   /* DTD distributed: insertion sequence counter + remote completions that
    * arrived before their shadow task was inserted (seq → payload frame) */
   std::atomic<uint64_t> dtd_seq{0};
@@ -432,6 +438,7 @@ struct ptc_context {
   int nb_workers = 1;
   std::vector<std::thread> workers;
   std::atomic<bool> started{false};
+  std::mutex start_lock; /* serializes lazy startup vs concurrent schedulers */
   std::atomic<bool> shutdown{false};
   Scheduler *sched = nullptr;
   std::string sched_name = "lfq";

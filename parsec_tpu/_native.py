@@ -21,6 +21,7 @@ _SOURCES = [
     os.path.join(_REPO, "native", "comm.cpp"),
     os.path.join(_REPO, "native", "parsec_core.h"),
     os.path.join(_REPO, "native", "runtime_internal.h"),
+    os.path.join(_REPO, "native", "lockfree.h"),
 ]
 
 # hook protocol (parsec_core.h)

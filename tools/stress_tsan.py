@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Concurrency stress for the native core under ThreadSanitizer.
+
+Targets the paths that only ever ran effectively single-threaded on this
+1-core box: the lock-free Chase-Lev work-stealing deque (lws), the dense
+and hashed dependency engines under concurrent release, DTD accessor
+chains, and the comm thread's delivery path against worker releases
+(colocated 2-rank job in one process).  TSan's happens-before analysis
+finds missing synchronization even when the kernel timeslices, so this
+is meaningful on one core.
+
+Run:
+    make tsan
+    PTC_NATIVE_LIB=build/libparsec_core_tsan.so \
+    LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \
+    TSAN_OPTIONS="suppressions=tools/tsan.supp exitcode=66 \
+                  report_thread_leaks=0" \
+    timeout 900 python tools/stress_tsan.py
+
+Exit 0 + "stress ok" and no "WARNING: ThreadSanitizer" lines = clean.
+(reference practice: the PARANOID/NOISIER debug CI matrix,
+.github/workflows/build_cmake.yml:33-34)
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import parsec_tpu as pt  # noqa: E402
+
+
+def ep_burst(sched: str, workers: int, n: int) -> None:
+    """Independent tasks: pure produce/steal churn on the deques."""
+    with pt.Context(nb_workers=workers, scheduler=sched) as ctx:
+        tp = pt.Taskpool(ctx, globals={"NB": n - 1})
+        tc = tp.task_class("EP")
+        tc.param("k", 0, pt.G("NB"))
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        assert tp.nb_total_tasks == n
+
+
+def chain_mesh(sched: str, workers: int, nb: int, lanes: int) -> None:
+    """`lanes` independent RW chains: concurrent release_deps traffic
+    through the dense dependency engine while workers steal."""
+    with pt.Context(nb_workers=workers, scheduler=sched) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb - 1, "L": lanes - 1})
+        k, l = pt.L("k"), pt.L("l")
+        tc = tp.task_class("Chain")
+        tc.param("l", 0, pt.G("L"))
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("Chain", l, k - 1, flow="A")),
+                pt.Out(pt.Ref("Chain", l, k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                arena="t")
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        assert tp.nb_total_tasks == nb * lanes
+
+
+def dtd_churn(workers: int, tiles: int, rounds: int) -> None:
+    """Dynamic insertion racing execution: accessor-chain updates, window
+    throttling, freelist reuse."""
+    with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+        from parsec_tpu.dsl.dtd import DtdTaskpool
+        datas = [ctx.data(i, np.zeros(8, dtype=np.int64))
+                 for i in range(tiles)]
+        dtp = DtdTaskpool(ctx, window=32)
+        tls = [dtp.tile_of(d, owner=0) for d in datas]
+
+        def bump(view):
+            view.data(0, dtype=np.int64)[0] += 1
+
+        for _ in range(rounds):
+            for t in range(tiles):
+                dtp.insert_task(bump, (tls[t], "INOUT"))
+        dtp.wait()
+        for i, d in enumerate(datas):
+            v = np.frombuffer(d.array, dtype=np.int64)[0]
+            assert v == rounds, (i, v)
+        dtp.destroy()
+
+
+def colocated_comm(workers: int, nb: int = 64, port: int = 29900) -> None:
+    """Two ranks in ONE process (a thread per rank, loopback TCP): the
+    comm threads' delivery paths run against both ranks' workers on a
+    cross-rank RW chain, all inside one TSan-observed address space."""
+    import threading
+
+    errs = []
+
+    def rank_prog(rank):
+        try:
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            with ctx:
+                arr = np.zeros(2, dtype=np.int64)
+                ctx.register_linear_collection("A", arr, elem_size=8,
+                                               nodes=2, myrank=rank)
+                ctx.register_arena("t", 8)
+                tp = pt.Taskpool(ctx, globals={"NB": nb})
+                k = pt.L("k")
+                tc = tp.task_class("Task")
+                tc.param("k", 0, pt.G("NB"))
+                tc.affinity("A", k % 2)
+                tc.flow("A", "RW",
+                        pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                        pt.In(pt.Ref("Task", k - 1, flow="A")),
+                        pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                               guard=(k < pt.G("NB"))),
+                        arena="t")
+
+                def body(view):
+                    view.data("A", dtype=np.int64)[0] += 1
+
+                tc.body(body)
+                tp.run()
+                tp.wait()
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    ts = [threading.Thread(target=rank_prog, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    hung = [t.name for t in ts if t.is_alive()]
+    assert not hung, f"deadlocked rank threads: {hung}"
+    assert not errs, errs
+
+
+def main():
+    reps = int(os.environ.get("STRESS_REPS", "3"))
+    for rep in range(reps):
+        for sched in ("lws", "lfq", "ll"):
+            ep_burst(sched, workers=8, n=20000)
+            chain_mesh(sched, workers=8, nb=200, lanes=16)
+        dtd_churn(workers=8, tiles=8, rounds=100)
+        colocated_comm(workers=4, port=29900 + rep)
+        sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
+    print("stress ok")
+
+
+if __name__ == "__main__":
+    main()
