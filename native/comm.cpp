@@ -206,6 +206,10 @@ struct PendingGet {
   uint32_t src_rank = UINT32_MAX; /* the rank we are pulling from */
   std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
   uint8_t pk;
+  /* datatype the payload bytes are ALREADY in (from the ACTIVATE frame's
+   * shaped field): a consumer whose recv type matches must not re-apply
+   * a cast (round-4 review: cast double-apply across the wire) */
+  int32_t shaped = -1;
   /* broadcast-relay rendezvous: once the pull resolves, deliver locally
    * AND re-root — re-register the payload and forward to these children
    * along `topo` (reference: re-rooted bcast data movement,
@@ -344,7 +348,7 @@ static size_t reg_live_children(CommEngine *ce, MemReg &m,
  * canary, since a byte-swapped peer presents it reversed. */
 enum : uint32_t {
   PTC_WIRE_MAGIC = 0x50544331u, /* "PTC1" */
-  PTC_WIRE_VERSION = 1,
+  PTC_WIRE_VERSION = 2, /* v2: PUT frame gained the ltype field */
 };
 
 static void comm_post(CommEngine *ce, uint32_t rank,
@@ -441,7 +445,7 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             std::vector<WireTarget> &&targets,
                             const uint8_t *payload, uint64_t plen,
                             int64_t device_uid = 0,
-                            uint64_t alloc_len = 0) {
+                            uint64_t alloc_len = 0, int32_t shaped = -1) {
   if (alloc_len == 0) alloc_len = plen;
   ptc_copy *copy = nullptr;
   /* ptc_has_dtypes: zero-registered-datatype workloads skip the
@@ -465,6 +469,22 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                    "payload rode the device path; delivering raw (declare "
                    "no IN type or keep the producer on the host path)\n");
     } else if (any_dt) {
+      /* consumer-side lower bound for typed allocations: an indexed type
+       * whose segments stop short of the tile end must still yield a
+       * tile-sized copy (parity with the local reshape path, which
+       * allocates src->size) — the consumer flow's arena knows the size */
+      int64_t min_alloc = 0;
+      if (!targets.empty()) {
+        int32_t cid0 = targets[0].class_id;
+        if (cid0 >= 0 && (size_t)cid0 < tp->classes.size() &&
+            flow_idx >= 0 &&
+            (size_t)flow_idx < tp->classes[(size_t)cid0].flows.size()) {
+          int32_t aid = tp->classes[(size_t)cid0].flows[(size_t)flow_idx]
+                            .arena_id;
+          if (aid >= 0 && (size_t)aid < ctx->arenas.size())
+            min_alloc = ctx->arenas[(size_t)aid]->elem_size;
+        }
+      }
       /* one materialized copy per distinct receive layout */
       std::vector<int32_t> done;
       for (size_t i = 0; i < targets.size(); i++) {
@@ -475,7 +495,7 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
         done.push_back(dt);
         DtypeDef dtv;
         const DtypeDef *rdt = ptc_dtype_get(ctx, dt, &dtv) ? &dtv : nullptr;
-        if (rdt && (int64_t)plen != rdt->packed()) {
+        if (rdt && !rdt->is_cast() && (int64_t)plen != rdt->packed()) {
           std::fprintf(stderr,
                        "ptc-comm: payload (%llu B) does not match the "
                        "consumer datatype's packed size (%lld B); "
@@ -484,8 +504,42 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
           rdt = nullptr;
         }
         ptc_copy *c = new ptc_copy();
-        if (rdt) {
-          c->size = rdt->extent();
+        if (rdt && rdt->is_cast() && shaped == dt) {
+          /* the producer already converted pre-send (its [type] reshape
+           * or packed cast): the wire bytes ARE the consumer form —
+           * re-applying the cast would re-interpret converted bytes */
+          c->size = (int64_t)plen;
+          c->ptr = std::malloc((size_t)(plen > 0 ? plen : 1));
+          c->owns_ptr = true;
+          std::memcpy(c->ptr, payload, (size_t)plen);
+          c->shaped_as = dt;
+        } else if (rdt && rdt->is_cast()) {
+          /* receive-side element conversion: wire bytes hold src_kind,
+           * the consumer's layout holds dst_kind */
+          int64_t ssz = ptc_elem_size_of(rdt->src_kind);
+          int64_t dsz = ptc_elem_size_of(rdt->dst_kind);
+          int64_t n = ssz ? (int64_t)plen / ssz : 0;
+          if (rdt->count > 0 && n > rdt->count) n = rdt->count;
+          c->size = n * dsz;
+          c->ptr = std::malloc((size_t)(c->size > 0 ? c->size : 1));
+          c->owns_ptr = true;
+          ptc_convert_elems(rdt->src_kind, rdt->dst_kind, payload, c->ptr,
+                            n);
+          c->shaped_as = dt;
+        } else if (rdt && !rdt->segs.empty()) {
+          c->size = std::max(rdt->extent(), min_alloc);
+          c->ptr = std::malloc((size_t)c->size);
+          c->owns_ptr = true;
+          std::memset(c->ptr, 0, (size_t)c->size); /* gaps defined */
+          uint8_t *dst = (uint8_t *)c->ptr;
+          size_t o = 0;
+          for (const auto &p : rdt->segs) {
+            std::memcpy(dst + p.first, payload + o, (size_t)p.second);
+            o += (size_t)p.second;
+          }
+          c->shaped_as = dt; /* consumer's ltype pass must not re-select */
+        } else if (rdt) {
+          c->size = std::max(rdt->extent(), min_alloc);
           c->ptr = std::malloc((size_t)c->size);
           c->owns_ptr = true;
           std::memset(c->ptr, 0, (size_t)c->size); /* gaps defined */
@@ -493,11 +547,13 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
           for (int64_t k = 0; k < rdt->count; k++)
             std::memcpy(dst + k * rdt->stride, payload + k * rdt->elem,
                         (size_t)rdt->elem);
+          c->shaped_as = dt;
         } else {
           c->size = (int64_t)plen;
           c->ptr = std::malloc((size_t)plen);
           c->owns_ptr = true;
           std::memcpy(c->ptr, payload, (size_t)plen);
+          c->shaped_as = shaped; /* whatever form the wire carried */
         }
         for (size_t j = i; j < targets.size(); j++) {
           if (dts[j] != dt) continue;
@@ -530,6 +586,7 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                    (unsigned long long)alloc_len);
       std::memset(copy->ptr, 0, (size_t)alloc_len);
     }
+    copy->shaped_as = shaped; /* wire form (pre-send reshape/pack), or -1 */
     /* data plane delivered this payload into the device cache too: stamp
      * its uid so a device-chore consumer hits the cache (no re-stage).
      * CONTRACT with the device layer: the cache entry was inserted at
@@ -566,7 +623,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
                             const uint8_t *targets_bytes, size_t targets_len,
                             const uint8_t *payload, uint64_t plen,
                             int64_t device_uid, bool allow_park,
-                            uint64_t alloc_len = 0) {
+                            uint64_t alloc_len = 0, int32_t shaped = -1) {
   ptc_taskpool *tp = find_tp(ctx, tp_id);
   if (!tp) {
     /* Re-check the registry under the lock: add_taskpool may have
@@ -589,6 +646,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
       w.u32(UINT32_MAX); /* parked `from`: replay never pulls */
       w.i32(tp_id);
       w.i32(flow_idx);
+      w.i32(shaped);
       w.raw(targets_bytes, targets_len);
       if (alloc_len && alloc_len != plen) {
         if (device_uid == 0) {
@@ -623,7 +681,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
     return;
   }
   deliver_targets(ctx, tp, flow_idx, std::move(targets), payload, plen,
-                  device_uid, alloc_len);
+                  device_uid, alloc_len, shaped);
 }
 
 /* body excludes the type byte.  `from` is the sending rank (rendezvous
@@ -635,6 +693,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   Reader r{body, body + len};
   int32_t tp_id = r.i32();
   int32_t flow_idx = r.i32();
+  int32_t shaped = r.i32(); /* datatype the payload bytes are already in */
   const uint8_t *targets_start = r.p;
   uint32_t nb_targets = r.u32();
   (void)parse_targets(r, nb_targets); /* skip to measure the slice */
@@ -648,7 +707,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   case PK_NONE:
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0, 0,
-                    allow_park);
+                    allow_park, 0, shaped);
     return;
   case PK_EAGER: {
     uint64_t plen = r.u64();
@@ -658,7 +717,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     }
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), r.p, plen, 0,
-                    allow_park);
+                    allow_park, 0, shaped);
     return;
   }
   case PK_PARKED_DEVICE: {
@@ -677,7 +736,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     if (!r.ok) return;
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0,
-                    (int64_t)uid, allow_park, alloc_len);
+                    (int64_t)uid, allow_park, alloc_len, shaped);
     return;
   }
   case PK_GET:
@@ -711,6 +770,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     pg.flow_idx = flow_idx;
     pg.targets_bytes.assign(targets_start, targets_end);
     pg.pk = pk;
+    pg.shaped = shaped;
     send_rendezvous_pull(ce, from, src_handle, std::move(pg));
     return;
   }
@@ -727,6 +787,7 @@ static void handle_put_body(ptc_context *ctx, const uint8_t *body, size_t len) {
   if (nidx < 0 || nidx > PTC_MAX_LOCALS) return;
   int64_t idx[PTC_MAX_LOCALS] = {0};
   for (int32_t i = 0; i < nidx; i++) idx[i] = r.i64();
+  int32_t ltype = r.i32();
   uint64_t plen = r.u64();
   if (!r.ok || (size_t)(r.end - r.p) < plen) {
     std::fprintf(stderr, "ptc-comm: malformed PUT frame dropped\n");
@@ -734,8 +795,19 @@ static void handle_put_body(ptc_context *ctx, const uint8_t *body, size_t len) {
   }
   ptc_data *d = ptc_collection_data_of(ctx, dc_id, idx, nidx);
   if (d && d->host_copy && d->host_copy->ptr) {
-    std::memcpy(d->host_copy->ptr, r.p,
-                (size_t)std::min<uint64_t>(plen, (uint64_t)d->host_copy->size));
+    if (ltype >= 0) {
+      /* selective write-back ([type_data]): wrap the wire bytes in a
+       * stack copy so the shared typed-writeback routine applies */
+      ptc_copy tmp;
+      tmp.ptr = (void *)r.p;
+      tmp.size = (int64_t)plen;
+      ptc_typed_writeback(ctx, ltype, &tmp, d->host_copy->ptr,
+                          d->host_copy->size);
+      tmp.ptr = nullptr; /* stack copy: nothing to free */
+    } else
+      std::memcpy(d->host_copy->ptr, r.p,
+                  (size_t)std::min<uint64_t>(plen,
+                                             (uint64_t)d->host_copy->size));
     d->host_copy->version.fetch_add(1, std::memory_order_release);
   }
 }
@@ -808,7 +880,8 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
                          uint8_t topo,
                          const std::vector<BcastWireGroup> &groups,
                          size_t i0, uint8_t pk, uint64_t handle,
-                         const uint8_t *payload, uint64_t plen) {
+                         const uint8_t *payload, uint64_t plen,
+                         int32_t shaped = -1) {
   size_t i = i0;
   while (i < groups.size()) {
     size_t n = groups.size() - i;
@@ -817,6 +890,7 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
     Writer w{f};
     w.i32(tp_id);
     w.i32(flow_idx);
+    w.i32(shaped);
     w.u8(topo);
     w.u32((uint32_t)take);
     for (size_t k = i; k < i + take; k++) {
@@ -846,6 +920,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
   Reader r{body, body + len};
   int32_t tp_id = r.i32();
   int32_t flow_idx = r.i32();
+  int32_t shaped = r.i32();
   uint8_t topo = r.u8();
   uint32_t nb_groups = r.u32();
   std::vector<BcastWireGroup> groups;
@@ -904,6 +979,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     pg.flow_idx = flow_idx;
     pg.targets_bytes = std::move(my_targets);
     pg.pk = pk;
+    pg.shaped = shaped;
     pg.bcast = true;
     pg.topo = topo;
     pg.groups = std::move(groups);
@@ -913,7 +989,8 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
   /* inline payload: forward FIRST (latency: children deliver while we
    * do; forwarding needs no taskpool knowledge, so SPMD skew cannot
    * stall the tree) */
-  bcast_fanout(ce, tp_id, flow_idx, topo, groups, 0, pk, 0, r.p, plen);
+  bcast_fanout(ce, tp_id, flow_idx, topo, groups, 0, pk, 0, r.p, plen,
+               shaped);
   if (my_targets.empty()) {
     std::fprintf(stderr, "ptc-comm: ACTIVATE_BCAST without my group; "
                          "forwarded only\n");
@@ -926,13 +1003,13 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     Reader tr{my_targets.data(), my_targets.data() + my_targets.size()};
     uint32_t nb_targets = tr.u32();
     deliver_targets(ctx, tp, flow_idx, parse_targets(tr, nb_targets),
-                    r.p, plen);
+                    r.p, plen, 0, 0, shaped);
     return;
   }
   /* unknown taskpool (SPMD skew): park via the shared eager-form path (a
    * parked frame must NOT re-forward on replay — this form cannot) */
   deliver_or_park(ctx, tp_id, flow_idx, my_targets.data(), my_targets.size(),
-                  r.p, plen, 0, /*allow_park=*/true);
+                  r.p, plen, 0, /*allow_park=*/true, 0, shaped);
 }
 
 /* serve a rendezvous pull: respond with the registered payload bytes */
@@ -1095,7 +1172,7 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
     }
     if (fpk)
       bcast_fanout(ce, pg.tp_id, pg.flow_idx, pg.topo, pg.groups, 0,
-                   fpk, fh, nullptr, real_len);
+                   fpk, fh, nullptr, real_len, pg.shaped);
   }
   /* by-reference delivery (real_len != plen): the payload rode the device
    * fabric; the host copy is allocated at real_len and materialized
@@ -1103,7 +1180,7 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
   if (!pg.targets_bytes.empty())
     deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
                     pg.targets_bytes.size(), r.p, plen, device_uid,
-                    /*allow_park=*/true, real_len);
+                    /*allow_park=*/true, real_len, pg.shaped);
 }
 
 static void handle_dtd_fetch_body(ptc_context *ctx, uint32_t from,
@@ -1629,11 +1706,22 @@ static const CeOps *ce_select(const char *name) {
 /* outgoing hooks (called from core.cpp; no-ops when comm is off)      */
 /* ------------------------------------------------------------------ */
 
-/* gather a strided producer layout into contiguous wire bytes */
+/* gather a producer layout into contiguous wire bytes: strided vector,
+ * indexed segments, or element cast (pre-send conversion) */
 static bool dtype_pack(ptc_context *ctx, int32_t dtype_id,
                        const ptc_copy *copy, std::vector<uint8_t> &out) {
   DtypeDef dt;
   if (!ptc_dtype_get(ctx, dtype_id, &dt)) return false;
+  const uint8_t *src = (const uint8_t *)copy->ptr;
+  if (dt.is_cast()) {
+    int64_t ssz = ptc_elem_size_of(dt.src_kind);
+    int64_t dsz = ptc_elem_size_of(dt.dst_kind);
+    if (!ssz || !dsz) return false;
+    int64_t n = (dt.count > 0) ? dt.count : copy->size / ssz;
+    if (n * ssz > copy->size) n = copy->size / ssz;
+    out.resize((size_t)(n * dsz));
+    return ptc_convert_elems(dt.src_kind, dt.dst_kind, src, out.data(), n);
+  }
   if (dt.extent() > copy->size) {
     std::fprintf(stderr,
                  "ptc-comm: datatype extent %lld exceeds copy size %lld; "
@@ -1642,11 +1730,49 @@ static bool dtype_pack(ptc_context *ctx, int32_t dtype_id,
     return false;
   }
   out.resize((size_t)dt.packed());
-  const uint8_t *src = (const uint8_t *)copy->ptr;
+  if (!dt.segs.empty()) {
+    size_t o = 0;
+    for (const auto &p : dt.segs) {
+      std::memcpy(out.data() + o, src + p.first, (size_t)p.second);
+      o += (size_t)p.second;
+    }
+    return true;
+  }
   for (int64_t i = 0; i < dt.count; i++)
     std::memcpy(out.data() + i * dt.elem, src + i * dt.stride,
                 (size_t)dt.elem);
   return true;
+}
+
+/* Decide the pre-send form of a typed payload: returns true when it
+ * should ship packed (filling `packed`); sets `shaped` to the datatype
+ * the shipped bytes are already in (-1 = raw producer layout).  A copy
+ * that IS the product of a cast reshape through the same type ships its
+ * bytes as-is — they are already converted, and packing would
+ * re-interpret converted bytes as the source kind (round-4 review:
+ * cast double-apply).  The receiver consults `shaped` symmetrically. */
+static bool presend_form(ptc_context *ctx, int32_t send_dtype,
+                         ptc_copy *copy, std::vector<uint8_t> &packed,
+                         int32_t &shaped) {
+  shaped = -1;
+  if (!copy || !copy->ptr || copy->size <= 0) return false;
+  if (send_dtype < 0) {
+    /* no wire type, but the payload may already BE the product of a
+     * producer-side [type] reshape (ltype with no dtype): advertise its
+     * form so the consumer's matching ltype does not re-apply a cast */
+    shaped = copy->shaped_as;
+    return false;
+  }
+  DtypeDef dt;
+  if (ptc_dtype_get(ctx, send_dtype, &dt) && dt.is_cast() &&
+      copy->shaped_as == send_dtype) {
+    shaped = send_dtype;
+    return false;
+  }
+  ptc_copy_sync_for_host(ctx, copy);
+  bool p = dtype_pack(ctx, send_dtype, copy, packed);
+  if (p) shaped = send_dtype;
+  return p;
 }
 
 void ptc_comm_send_activate_batch(
@@ -1670,25 +1796,24 @@ void ptc_comm_send_activate_batch(
     std::lock_guard<std::mutex> g(ce->lock);
     if (peer_lost_locked(ce, rank)) return;
   }
+  bool has_payload = copy && copy->ptr && copy->size > 0;
+  /* OUT-dep wire datatype: pack the strided layout to contiguous bytes
+   * (host path — a packed send needs host access, so the device by-ref
+   * shortcut is skipped below); `shaped` records the form on the wire */
+  std::vector<uint8_t> packed;
+  int32_t shaped = -1;
+  bool is_packed =
+      has_payload && presend_form(ctx, send_dtype, copy, packed, shaped);
   std::vector<uint8_t> f = frame_begin(MSG_ACTIVATE);
   Writer w{f};
   w.i32(tp->id);
   w.i32(flow_idx);
+  w.i32(shaped);
   w.u32((uint32_t)targets.size());
   for (const auto &t : targets) {
     w.i32(t.first);
     w.u8((uint8_t)t.second.size());
     for (int64_t v : t.second) w.i64(v);
-  }
-  bool has_payload = copy && copy->ptr && copy->size > 0;
-  /* OUT-dep wire datatype: pack the strided layout to contiguous bytes
-   * (host path — a packed send needs host access, so the device by-ref
-   * shortcut is skipped below) */
-  std::vector<uint8_t> packed;
-  bool is_packed = false;
-  if (has_payload && send_dtype >= 0) {
-    ptc_copy_sync_for_host(ctx, copy);
-    is_packed = dtype_pack(ctx, send_dtype, copy, packed);
   }
   int64_t payload_size = is_packed ? (int64_t)packed.size() :
                          (has_payload ? copy->size : 0);
@@ -1845,13 +1970,12 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
     wire.push_back(std::move(wg));
   }
   /* OUT-dep wire datatype: pack once; all hops forward the packed wire
-   * form, each consumer unpacks at final delivery (deliver_targets) */
+   * form, each consumer unpacks at final delivery (deliver_targets).
+   * `shaped` = the form already on the wire (cast-reshaped copies ship
+   * as-is — see presend_form). */
   std::vector<uint8_t> packed;
-  bool is_packed = false;
-  if (copy && copy->ptr && copy->size > 0 && send_dtype >= 0) {
-    ptc_copy_sync_for_host(ctx, copy);
-    is_packed = dtype_pack(ctx, send_dtype, copy, packed);
-  }
+  int32_t shaped = -1;
+  bool is_packed = presend_form(ctx, send_dtype, copy, packed, shaped);
   const uint8_t *payload =
       is_packed ? packed.data()
                 : ((copy && copy->ptr && copy->size > 0)
@@ -1893,7 +2017,7 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
         if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
       if (excess == children.size()) return;
       bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
-                   PK_DEVICE, dp_h, nullptr, plen);
+                   PK_DEVICE, dp_h, nullptr, plen, shaped);
       return;
     }
     if (!is_packed)
@@ -1949,17 +2073,18 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
       }
     }
     bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, PK_GET, h,
-                 nullptr, plen);
+                 nullptr, plen, shaped);
     return;
   }
   if (payload && !is_packed)
     ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
   bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
-               payload ? PK_EAGER : PK_NONE, 0, payload, plen);
+               payload ? PK_EAGER : PK_NONE, 0, payload, plen, shaped);
 }
 
 void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
-                           const int64_t *idx, int32_t nidx, ptc_copy *copy) {
+                           const int64_t *idx, int32_t nidx, ptc_copy *copy,
+                           int32_t ltype) {
   CommEngine *ce = ctx->comm;
   if (!ce || !copy || !copy->ptr) return;
   std::vector<uint8_t> f = frame_begin(MSG_PUT);
@@ -1967,6 +2092,7 @@ void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
   w.i32(dc_id);
   w.i32(nidx);
   for (int32_t i = 0; i < nidx; i++) w.i64(idx[i]);
+  w.i32(ltype); /* selective write-back datatype, -1 = full tile */
   w.u64((uint64_t)copy->size);
   w.raw(copy->ptr, (size_t)copy->size);
   frame_finish(f);

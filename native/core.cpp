@@ -213,7 +213,7 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
   int64_t version = r.next();
   /* v2 adds a wire-datatype id per dep after the arena slot;
    * v3 adds comprehension locals (kind 2) + per-dep iterator lists */
-  if (version < 1 || version > 3) return false;
+  if (version < 1 || version > 4) return false;
   int64_t nb_locals = r.next();
   if (nb_locals < 0 || nb_locals > PTC_MAX_LOCALS) return false;
   for (int64_t i = 0; i < nb_locals; i++) {
@@ -284,9 +284,11 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
           dep.iters.push_back(std::move(di));
         }
       }
-      if (dep.direction == 0)
+      if (version >= 4) dep.ltype_id = (int32_t)r.next();
+      if (dep.direction == 0) {
+        if (dep.ltype_id >= 0) tc.has_in_ltype = true;
         fl.in_deps.push_back(std::move(dep));
-      else
+      } else
         fl.out_deps.push_back(std::move(dep));
     }
     tc.flows.push_back(std::move(fl));
@@ -317,6 +319,14 @@ void ptc_copy_release_internal(ptc_context *ctx, ptc_copy *c) {
   if (c->refcount.fetch_sub(1, std::memory_order_acq_rel) == 1) {
     if (c->handle != 0 && ctx->copy_release_cb)
       ctx->copy_release_cb(ctx->copy_release_user, c->handle);
+    /* drop the memoized reshape children (each holds one cache ref);
+     * consumers still running hold their own refs */
+    ReshapeCache *rc = c->reshape.load(std::memory_order_acquire);
+    if (rc) {
+      for (ReshapeCache::Entry &e : rc->entries)
+        ptc_copy_release_internal(ctx, e.shaped);
+      delete rc;
+    }
     if (c->arena_id >= 0 && c->ptr)
       ctx->arenas[(size_t)c->arena_id]->dealloc(c->ptr);
     else if (c->owns_ptr && c->ptr)
@@ -330,7 +340,185 @@ static inline void copy_retain(ptc_copy *c) { ptc_copy_retain(c); }
 static inline void copy_release(ptc_context *ctx, ptc_copy *c) {
   ptc_copy_release_internal(ctx, c);
 }
+
+/* ---- local reshape (datacopy-future role; parsec_reshape.c) -------- */
+
+template <typename S, typename D>
+static void convert_loop(const void *src, void *dst, int64_t n) {
+  const S *s = (const S *)src;
+  D *d = (D *)dst;
+  for (int64_t i = 0; i < n; i++) d[i] = (D)s[i];
+}
+
+template <typename S>
+static bool convert_from(int32_t dk, const void *src, void *dst, int64_t n) {
+  switch (dk) {
+  case PTC_ELEM_F32: convert_loop<S, float>(src, dst, n); return true;
+  case PTC_ELEM_F64: convert_loop<S, double>(src, dst, n); return true;
+  case PTC_ELEM_I32: convert_loop<S, int32_t>(src, dst, n); return true;
+  case PTC_ELEM_I64: convert_loop<S, int64_t>(src, dst, n); return true;
+  case PTC_ELEM_U8: convert_loop<S, uint8_t>(src, dst, n); return true;
+  default: return false;
+  }
+}
+
 } // namespace
+
+int64_t ptc_elem_size_of(int32_t kind) {
+  switch (kind) {
+  case PTC_ELEM_F32:
+  case PTC_ELEM_I32:
+    return 4;
+  case PTC_ELEM_F64:
+  case PTC_ELEM_I64:
+    return 8;
+  case PTC_ELEM_U8:
+    return 1;
+  default:
+    return 0;
+  }
+}
+
+bool ptc_convert_elems(int32_t sk, int32_t dk, const void *src, void *dst,
+                       int64_t n) {
+  switch (sk) {
+  case PTC_ELEM_F32: return convert_from<float>(dk, src, dst, n);
+  case PTC_ELEM_F64: return convert_from<double>(dk, src, dst, n);
+  case PTC_ELEM_I32: return convert_from<int32_t>(dk, src, dst, n);
+  case PTC_ELEM_I64: return convert_from<int64_t>(dk, src, dst, n);
+  case PTC_ELEM_U8: return convert_from<uint8_t>(dk, src, dst, n);
+  default: return false;
+  }
+}
+
+ptc_copy *ptc_reshape_get(ptc_context *ctx, ptc_copy *src, int32_t ltype_id) {
+  if (!src || !src->ptr || ltype_id < 0) {
+    ptc_copy_retain(src);
+    return src;
+  }
+  if (src->shaped_as == ltype_id) {
+    /* already the product of this exact type (a reshaped copy forwarded
+     * through a same-typed dep): no re-reshape (remote_no_re_reshape) */
+    ctx->reshape_hits.fetch_add(1, std::memory_order_relaxed);
+    ptc_copy_retain(src);
+    return src;
+  }
+  DtypeDef dt;
+  if (!ptc_dtype_get(ctx, ltype_id, &dt)) {
+    ptc_copy_retain(src);
+    return src;
+  }
+  if (!dt.is_cast()) {
+    /* identity for this copy (full-extent contiguous selection): the
+     * avoidable-reshape case — pass the original pointer through */
+    bool identity;
+    if (!dt.segs.empty())
+      identity = dt.segs.size() == 1 && dt.segs[0].first == 0 &&
+                 dt.segs[0].second >= src->size;
+    else
+      identity = dt.stride == dt.elem && dt.packed() >= src->size;
+    if (identity) {
+      ctx->reshape_hits.fetch_add(1, std::memory_order_relaxed);
+      ptc_copy_retain(src);
+      return src;
+    }
+  }
+  ReshapeCache *rc = src->reshape.load(std::memory_order_acquire);
+  if (!rc) {
+    ReshapeCache *fresh = new ReshapeCache();
+    ReshapeCache *expect = nullptr;
+    if (src->reshape.compare_exchange_strong(expect, fresh,
+                                             std::memory_order_acq_rel))
+      rc = fresh;
+    else {
+      delete fresh;
+      rc = expect; /* the racer's cache */
+    }
+  }
+  int32_t ver = src->version.load(std::memory_order_acquire);
+  std::lock_guard<std::mutex> g(rc->lock);
+  for (auto it = rc->entries.begin(); it != rc->entries.end();) {
+    if (it->ltype_id == ltype_id) {
+      if (it->src_version == ver) {
+        ctx->reshape_hits.fetch_add(1, std::memory_order_relaxed);
+        /* retained under the cache lock: a concurrent stale-version
+         * eviction cannot free it before the caller owns a ref */
+        ptc_copy_retain(it->shaped);
+        return it->shaped; /* the future already resolved: shared copy */
+      }
+      /* stale version: evict so an iteratively rewritten source does
+       * not accumulate one retained child per version (running
+       * consumers hold their own refs) */
+      ptc_copy_release_internal(ctx, it->shaped);
+      it = rc->entries.erase(it);
+      continue;
+    }
+    ++it;
+  }
+  /* trigger the future: materialize the converted child exactly once */
+  ptc_copy_sync_for_host(ctx, src);
+  ptc_copy *out = new ptc_copy();
+  if (dt.is_cast()) {
+    int64_t ssz = ptc_elem_size_of(dt.src_kind);
+    int64_t dsz = ptc_elem_size_of(dt.dst_kind);
+    int64_t n = (dt.count > 0) ? dt.count : (ssz ? src->size / ssz : 0);
+    if (ssz && n * ssz > src->size) n = src->size / ssz;
+    out->size = n * dsz;
+    out->ptr = std::calloc(1, (size_t)(out->size > 0 ? out->size : 1));
+    ptc_convert_elems(dt.src_kind, dt.dst_kind, src->ptr, out->ptr, n);
+  } else {
+    out->size = src->size;
+    out->ptr = std::calloc(1, (size_t)(out->size > 0 ? out->size : 1));
+    auto copy_seg = [&](int64_t off, int64_t len) {
+      if (off < 0 || off >= src->size || len <= 0) return;
+      if (off + len > src->size) len = src->size - off;
+      std::memcpy((char *)out->ptr + off, (const char *)src->ptr + off,
+                  (size_t)len);
+    };
+    if (!dt.segs.empty())
+      for (const auto &p : dt.segs) copy_seg(p.first, p.second);
+    else
+      for (int64_t i = 0; i < dt.count; i++) copy_seg(i * dt.stride, dt.elem);
+  }
+  out->owns_ptr = true;
+  out->shaped_as = ltype_id;
+  rc->entries.push_back(ReshapeCache::Entry{ltype_id, ver, out});
+  ctx->reshape_conversions.fetch_add(1, std::memory_order_relaxed);
+  ptc_copy_retain(out); /* one ref for the cache, one for the caller */
+  return out;
+}
+
+void ptc_typed_writeback(ptc_context *ctx, int32_t ltype_id, ptc_copy *src,
+                         void *dst, int64_t dst_size) {
+  DtypeDef dt;
+  if (ltype_id < 0 || !ptc_dtype_get(ctx, ltype_id, &dt)) {
+    std::memcpy(dst, src->ptr,
+                (size_t)std::min<int64_t>(dst_size, src->size));
+    return;
+  }
+  if (dt.is_cast()) {
+    /* the copy holds dst_kind elements; the collection holds src_kind */
+    int64_t ssz = ptc_elem_size_of(dt.src_kind);
+    int64_t dsz = ptc_elem_size_of(dt.dst_kind);
+    if (!ssz || !dsz) return;
+    int64_t n = src->size / dsz;
+    if (n * ssz > dst_size) n = dst_size / ssz;
+    ptc_convert_elems(dt.dst_kind, dt.src_kind, src->ptr, dst, n);
+    return;
+  }
+  auto put_seg = [&](int64_t off, int64_t len) {
+    if (off < 0 || off >= dst_size || len <= 0) return;
+    if (off + len > dst_size) len = dst_size - off;
+    if (off + len > src->size) len = src->size - off;
+    if (len > 0)
+      std::memcpy((char *)dst + off, (const char *)src->ptr + off,
+                  (size_t)len);
+  };
+  if (!dt.segs.empty())
+    for (const auto &p : dt.segs) put_seg(p.first, p.second);
+  else
+    for (int64_t i = 0; i < dt.count; i++) put_seg(i * dt.stride, dt.elem);
+}
 
 ptc_data *ptc_collection_data_of(ptc_context *ctx, int32_t dc_id,
                                  const int64_t *idx, int32_t n) {
@@ -939,6 +1127,30 @@ bool ptc_has_dtypes(ptc_context *ctx) {
  * count_task_inputs), or -1.  Used by the comm layer to scatter wire
  * bytes into the consumer's layout (reference: per-dep MPI datatype
  * selection on the receive side, remote_dep_mpi.c). */
+/* The IN dep that selects deliveries for one consumer instance's flow
+ * (guard- and domain-aware).  Real evaluation first: at delivery time
+ * the producers have run, so a dynamic guard usually resolves (and
+ * alternatives may declare DIFFERENT datatypes — picking conservatively
+ * would pick the wrong layout); conservative fallback only when nothing
+ * selects.  ONE rule shared by the wire-dtype scatter and the
+ * local-reshape staging so the two cannot drift. */
+static const Dep *ptc_select_consumer_in_dep(
+    ptc_context *ctx, ptc_taskpool *tp, const TaskClass &tc,
+    const std::vector<int64_t> &params, int32_t flow_idx) {
+  int nb_locals = (int)tc.locals.size();
+  int64_t locals[PTC_MAX_LOCALS] = {0};
+  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+    locals[tc.range_locals[(size_t)i]] = params[i];
+  fill_derived_locals(ctx, tp, tc, locals);
+  const Flow &fl = tc.flows[(size_t)flow_idx];
+  const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
+                                    tp->globals.data());
+  if (!sel)
+    sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
+                           tp->globals.data(), /*conservative=*/true);
+  return sel;
+}
+
 int32_t ptc_consumer_recv_dtype(ptc_context *ctx, ptc_taskpool *tp,
                                 int32_t class_id,
                                 const std::vector<int64_t> &params,
@@ -946,24 +1158,8 @@ int32_t ptc_consumer_recv_dtype(ptc_context *ctx, ptc_taskpool *tp,
   if (class_id < 0 || (size_t)class_id >= tp->classes.size()) return -1;
   const TaskClass &tc = tp->classes[(size_t)class_id];
   if (flow_idx < 0 || (size_t)flow_idx >= tc.flows.size()) return -1;
-  int nb_locals = (int)tc.locals.size();
-  int64_t locals[PTC_MAX_LOCALS] = {0};
-  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
-    locals[tc.range_locals[(size_t)i]] = params[i];
-  fill_derived_locals(ctx, tp, tc, locals);
-  const Flow &fl = tc.flows[(size_t)flow_idx];
-  if (fl.flags & PTC_FLOW_CTL) return -1;
-  /* real evaluation first: at delivery time the producers have run, so
-   * a dynamic guard usually resolves (and alternatives may declare
-   * DIFFERENT wire datatypes — picking conservatively would scatter
-   * with the wrong layout).  Fall back to the conservative pick only
-   * when nothing selects (e.g. producer-side state not visible on this
-   * rank). */
-  const Dep *sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
-                                    tp->globals.data());
-  if (!sel)
-    sel = select_input_dep(ctx, tp, fl, locals, nb_locals,
-                           tp->globals.data(), /*conservative=*/true);
+  if (tc.flows[(size_t)flow_idx].flags & PTC_FLOW_CTL) return -1;
+  const Dep *sel = ptc_select_consumer_in_dep(ctx, tp, tc, params, flow_idx);
   return sel ? sel->dtype_id : -1;
 }
 
@@ -977,6 +1173,29 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
     /* out-of-domain successor: dropped by JDF semantics (see
      * task_params_in_domain).  Not an error. */
     return;
+  }
+
+  /* consumer-side local reshape ([type = X] on the IN dep): stage the
+   * memoized reshaped child instead of the delivered copy.  Same dep
+   * selection rule as the recv-dtype path (ptc_consumer_recv_dtype);
+   * gated per class so ltype-free programs never pay for it.  The hold
+   * releases the caller-owned reshape ref once staging has retained. */
+  struct LtypeHold {
+    ptc_context *ctx;
+    ptc_copy *c = nullptr;
+    ~LtypeHold() {
+      if (c) ptc_copy_release_internal(ctx, c);
+    }
+  } ltype_hold{ctx};
+  if (copy && tc.has_in_ltype && flow_idx >= 0 &&
+      (size_t)flow_idx < tc.flows.size()) {
+    const Flow &fl = tc.flows[(size_t)flow_idx];
+    if (!(fl.flags & PTC_FLOW_CTL)) {
+      const Dep *sel = ptc_select_consumer_in_dep(ctx, tp, tc, params,
+                                                  flow_idx);
+      if (sel && sel->ltype_id >= 0)
+        copy = ltype_hold.c = ptc_reshape_get(ctx, copy, sel->ltype_id);
+    }
   }
 
   /* dense engine: O(1) slot in the class's bounding box (reference:
@@ -1086,8 +1305,16 @@ static int prepare_input(ptc_context *ctx, ptc_task *t) {
       }
       ptc_data *d = ptc_collection_data_of(ctx, sel->dc_id, idx, ni);
       if (d && d->host_copy) {
-        copy_retain(d->host_copy);
-        t->data[f] = d->host_copy;
+        /* [type_data = X] on a matrix read: stage the reshaped child
+         * (a new copy holding only the selected/converted elements) so
+         * the body never aliases the collection tile.  reshape_get
+         * returns retained; the plain path retains explicitly. */
+        ptc_copy *c = d->host_copy;
+        if (sel->ltype_id >= 0)
+          c = ptc_reshape_get(ctx, c, sel->ltype_id);
+        else
+          copy_retain(c);
+        t->data[f] = c;
       }
     } else if (!sel || sel->kind == DEP_NONE) {
       /* pure WRITE flow: allocate from its arena */
@@ -1114,6 +1341,10 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
   int nb_locals = (int)tc.locals.size();
   const int64_t *g = tp->globals.data();
   std::vector<RemoteSend> batch;
+  /* reshape refs owned by this pass (ptc_reshape_get returns retained);
+   * released only after the remote batch flush — RemoteSend holds raw
+   * copy pointers until then */
+  std::vector<ptc_copy *> reshape_holds;
 
   for (size_t f = 0; f < tc.flows.size(); f++) {
     const Flow &fl = tc.flows[f];
@@ -1125,6 +1356,25 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
        * (the task's own locals, or a scratch copy extended with bracketed
        * iterator values in slots nb_locals..) */
       auto emit_task_dep = [&](const int64_t *locs, int nb) {
+        /* local reshape ([type = X] on an OUT dep): successors of this
+         * dep receive the memoized reshaped child instead of the
+         * producer's copy — and remote sends ship it (the reference's
+         * pre-send remote reshape, parsec_reshape.c:771).  Resolved
+         * lazily on the first in-domain delivery so an all-out-of-domain
+         * boundary dep never pays the conversion. */
+        ptc_copy *ecopy_v = nullptr;
+        bool ecopy_done = false;
+        auto ecopy = [&]() -> ptc_copy * {
+          if (!ecopy_done) {
+            ecopy_done = true;
+            ecopy_v = (fl.flags & PTC_FLOW_CTL) ? nullptr : copy;
+            if (ecopy_v && d.ltype_id >= 0) {
+              ecopy_v = ptc_reshape_get(ctx, ecopy_v, d.ltype_id);
+              reshape_holds.push_back(ecopy_v); /* released post-flush */
+            }
+          }
+          return ecopy_v;
+        };
         /* expand range params (broadcast outputs) */
         size_t np = d.params.size();
         std::vector<int64_t> vals(np, 0);
@@ -1147,8 +1397,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
           if (!task_params_in_domain(ctx, tp, peer_tc, pv)) return;
           prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
           deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                      d.peer_flow, (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
-                      &batch, d.dtype_id);
+                      d.peer_flow, ecopy(), &batch, d.dtype_id);
           return;
         }
         /* nested iteration over up to a few range params */
@@ -1175,9 +1424,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
           if (task_params_in_domain(ctx, tp, peer_tc, pv)) {
             prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
             deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                        d.peer_flow,
-                        (fl.flags & PTC_FLOW_CTL) ? nullptr : copy,
-                        &batch, d.dtype_id);
+                        d.peer_flow, ecopy(), &batch, d.dtype_id);
           }
           /* advance odometer */
           size_t i = 0;
@@ -1201,15 +1448,23 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
           uint32_t r = ptc_collection_rank_of(ctx, d.dc_id, idx, ni);
           if (r != ctx->myrank) {
             ptc_copy_sync_for_host(ctx, copy); /* coherence: pull mirror */
-            ptc_comm_send_put_mem(ctx, r, d.dc_id, idx, ni, copy);
+            ptc_comm_send_put_mem(ctx, r, d.dc_id, idx, ni, copy,
+                                  d.ltype_id);
             return;
           }
         }
         ptc_data *dst = ptc_collection_data_of(ctx, d.dc_id, idx, ni);
         if (dst && dst->host_copy && dst->host_copy->ptr != copy->ptr) {
           ptc_copy_sync_for_host(ctx, copy); /* coherence: pull mirror */
-          std::memcpy(dst->host_copy->ptr, copy->ptr,
-                      (size_t)std::min(dst->host_copy->size, copy->size));
+          /* [type_data = X] on the write-back: update only the region
+           * the type selects (cast types reverse-convert) instead of
+           * overwriting the whole tile */
+          if (d.ltype_id >= 0)
+            ptc_typed_writeback(ctx, d.ltype_id, copy, dst->host_copy->ptr,
+                                dst->host_copy->size);
+          else
+            std::memcpy(dst->host_copy->ptr, copy->ptr,
+                        (size_t)std::min(dst->host_copy->size, copy->size));
         }
         if (dst && dst->host_copy)
           dst->host_copy->version.store(copy->version.load());
@@ -1274,6 +1529,7 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
       batch[i].rank = UINT32_MAX;
     }
   }
+  for (ptc_copy *h : reshape_holds) ptc_copy_release_internal(ctx, h);
 }
 
 static void wake_workers(ptc_context *ctx) {
@@ -2279,6 +2535,44 @@ int32_t ptc_register_datatype(ptc_context_t *ctx, int64_t elem_bytes,
   ctx->dtypes.push_back(DtypeDef{elem_bytes, count, stride_bytes});
   ctx->has_dtypes.store(true, std::memory_order_release);
   return (int32_t)ctx->dtypes.size() - 1;
+}
+
+int32_t ptc_register_datatype_indexed(ptc_context_t *ctx,
+                                      const int64_t *offsets,
+                                      const int64_t *lens, int32_t nseg) {
+  if (nseg <= 0) return -1;
+  DtypeDef dt;
+  for (int32_t i = 0; i < nseg; i++) {
+    if (offsets[i] < 0 || lens[i] <= 0) return -1;
+    dt.segs.emplace_back(offsets[i], lens[i]);
+  }
+  std::lock_guard<std::mutex> g(ctx->reg_lock);
+  ctx->dtypes.push_back(std::move(dt));
+  ctx->has_dtypes.store(true, std::memory_order_release);
+  return (int32_t)ctx->dtypes.size() - 1;
+}
+
+int32_t ptc_register_datatype_cast(ptc_context_t *ctx, int32_t src_kind,
+                                   int32_t dst_kind, int64_t count) {
+  auto valid = [](int32_t k) {
+    return k >= PTC_ELEM_F32 && k <= PTC_ELEM_U8;
+  };
+  if (!valid(src_kind) || !valid(dst_kind) || count == 0) return -1;
+  DtypeDef dt;
+  dt.src_kind = src_kind;
+  dt.dst_kind = dst_kind;
+  dt.count = count; /* < 0: whole copy, element count derived per copy */
+  std::lock_guard<std::mutex> g(ctx->reg_lock);
+  ctx->dtypes.push_back(std::move(dt));
+  ctx->has_dtypes.store(true, std::memory_order_release);
+  return (int32_t)ctx->dtypes.size() - 1;
+}
+
+void ptc_ctx_reshape_stats(ptc_context_t *ctx, int64_t *conversions,
+                           int64_t *hits) {
+  if (conversions)
+    *conversions = ctx->reshape_conversions.load(std::memory_order_relaxed);
+  if (hits) *hits = ctx->reshape_hits.load(std::memory_order_relaxed);
 }
 
 ptc_taskpool_t *ptc_tp_new(ptc_context_t *ctx, int32_t nb_globals,

@@ -153,6 +153,38 @@ int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size);
 int32_t ptc_register_datatype(ptc_context_t *ctx, int64_t elem_bytes,
                               int64_t count, int64_t stride_bytes);
 
+/* indexed datatype: explicit (offset, len) byte segments — the
+ * MPI_Type_indexed analog; expresses lower/upper triangles etc.  Used
+ * as a wire type (pack/scatter the segments) or as a dep's LOCAL
+ * reshape type (JDF `[type = name]`): the dep's data is routed through
+ * a new datacopy holding only the selected bytes (other bytes zero),
+ * memoized per (source copy, type) — the reference's datacopy-future
+ * reshape chain (parsec/parsec_reshape.c, parsec_datacopy_future.c). */
+int32_t ptc_register_datatype_indexed(ptc_context_t *ctx,
+                                      const int64_t *offsets,
+                                      const int64_t *lens, int32_t nseg);
+
+/* element-cast datatype: contiguous `count` elements (count < 0 = the
+ * whole copy) converted src_kind -> dst_kind element-wise.  As a local
+ * reshape type this is the arbitrary type->type promise of the
+ * reference's reshape machinery; on a Mem write-back dep the conversion
+ * reverses (the copy holds dst_kind, the collection holds src_kind). */
+enum {
+  PTC_ELEM_F32 = 0,
+  PTC_ELEM_F64 = 1,
+  PTC_ELEM_I32 = 2,
+  PTC_ELEM_I64 = 3,
+  PTC_ELEM_U8 = 4
+};
+int32_t ptc_register_datatype_cast(ptc_context_t *ctx, int32_t src_kind,
+                                   int32_t dst_kind, int64_t count);
+
+/* local-reshape accounting: conversions = reshape futures triggered
+ * (distinct (copy, type) pairs materialized), hits = memoized or
+ * identity reuses.  The avoidable-reshape test matrix asserts these. */
+void ptc_ctx_reshape_stats(ptc_context_t *ctx, int64_t *conversions,
+                           int64_t *hits);
+
 /* set my rank / world for affinity filtering (default 0/1) */
 void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes);
 

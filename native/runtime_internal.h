@@ -45,6 +45,8 @@ struct ExprCb {
 /* data                                                                */
 /* ------------------------------------------------------------------ */
 
+struct ReshapeCache; /* below (needs DtypeDef) */
+
 struct ptc_copy {
   ptc_data *data = nullptr;
   void *ptr = nullptr;
@@ -54,6 +56,16 @@ struct ptc_copy {
   std::atomic<int32_t> version{0};
   int32_t arena_id = -1; /* >=0: return to arena freelist on release */
   bool owns_ptr = false;
+  /* local-reshape support (reference: parsec_reshape.c /
+   * parsec_datacopy_future.c — the datacopy-future chain).  `shaped_as`
+   * marks a copy that IS the product of a reshape through that datatype,
+   * so forwarding it through a same-typed dep does not re-reshape
+   * (reference: remote_no_re_reshape.jdf).  `reshape` memoizes this
+   * copy's reshaped children per (datatype, version): every consumer of
+   * the same (copy, type) shares one converted copy — the future's
+   * trigger runs once. */
+  int32_t shaped_as = -1;
+  std::atomic<ReshapeCache *> reshape{nullptr};
 };
 
 struct ptc_data {
@@ -96,21 +108,62 @@ struct Dep {
   /* bracketed iterators (JDF local indices); guard and params may read
    * them via scratch slots */
   std::vector<DepIter> iters;
-  /* wire datatype (JDF `[type = ...]`): OUT deps pack the producer's
-   * strided layout to contiguous wire bytes, IN deps scatter wire bytes
-   * into the consumer's layout (reference: the MPI datatype construction
-   * per dep, parsec/datatype/datatype_mpi.c) */
+  /* wire datatype (JDF `[type_remote = ...]`): OUT deps pack the
+   * producer's strided layout to contiguous wire bytes, IN deps scatter
+   * wire bytes into the consumer's layout (reference: the MPI datatype
+   * construction per dep, parsec/datatype/datatype_mpi.c) */
   int32_t dtype_id = -1;
+  /* local reshape datatype (JDF `[type = ...]` / `[type_data = ...]`):
+   * the dep's data is routed through a NEW datacopy holding only the
+   * elements the type selects (and/or element-cast), memoized per
+   * (source copy, type) — the reference's datacopy-future reshape,
+   * parsec/parsec_reshape.c:771.  On a Mem OUT dep this selects which
+   * region of the collection tile the write-back updates. */
+  int32_t ltype_id = -1;
 };
 
-/* strided-vector wire datatype: `count` blocks of `elem` bytes spaced
- * `stride` bytes apart in memory; contiguous when stride == elem */
+/* wire/reshape datatype.  Three forms:
+ *  - strided vector: `count` blocks of `elem` bytes spaced `stride`
+ *    bytes apart (contiguous when stride == elem);
+ *  - indexed: explicit (offset, len) byte segments (`segs` non-empty;
+ *    the MPI_Type_indexed analog — expresses triangles etc.);
+ *  - element cast: src_kind/dst_kind >= 0, contiguous; `count` elements
+ *    (count < 0 = the whole copy) converted element-wise.  Cast and
+ *    segment selection do not combine (rejected at registration). */
 struct DtypeDef {
   int64_t elem = 0, count = 0, stride = 0;
-  int64_t packed() const { return elem * count; }
+  std::vector<std::pair<int64_t, int64_t>> segs; /* (offset, len) bytes */
+  int32_t src_kind = -1, dst_kind = -1;          /* PTC_ELEM_* */
+  bool is_cast() const { return src_kind >= 0; }
+  int64_t packed() const {
+    if (!segs.empty()) {
+      int64_t s = 0;
+      for (const auto &p : segs) s += p.second;
+      return s;
+    }
+    return elem * count;
+  }
   int64_t extent() const {
+    if (!segs.empty()) {
+      int64_t e = 0;
+      for (const auto &p : segs)
+        if (p.first + p.second > e) e = p.first + p.second;
+      return e;
+    }
     return count > 0 ? (count - 1) * stride + elem : 0;
   }
+};
+
+/* memoized reshaped children of one source copy (datacopy-future role:
+ * one conversion, shared by every consumer of the same (copy, type)) */
+struct ReshapeCache {
+  std::mutex lock;
+  struct Entry {
+    int32_t ltype_id;
+    int32_t src_version;
+    ptc_copy *shaped; /* one ref held by the cache */
+  };
+  std::vector<Entry> entries;
 };
 
 struct Flow {
@@ -162,11 +215,15 @@ struct TaskClass {
    * parameters (membership by binary search instead of an O(range)
    * re-evaluation walk); empty vector = plain range, use lo/hi/st */
   mutable std::vector<std::vector<int64_t>> domain_vals;
+  /* any IN dep declares a local reshape type (checked per delivery only
+   * when true — keeps ltype-free classes off the select_input_dep path) */
+  bool has_in_ltype = false;
   TaskClass() = default;
   TaskClass(const TaskClass &o)
       : name(o.name), id(o.id), locals(o.locals),
         range_locals(o.range_locals), aff_dc(o.aff_dc), aff_idx(o.aff_idx),
-        priority(o.priority), flows(o.flows), chores(o.chores) {}
+        priority(o.priority), flows(o.flows), chores(o.chores),
+        has_in_ltype(o.has_in_ltype) {}
 };
 
 /* ------------------------------------------------------------------ */
@@ -527,6 +584,11 @@ struct ptc_context {
   /* communication engine (nullptr when single-process) */
   CommEngine *comm = nullptr;
 
+  /* local-reshape accounting (avoidable-reshape tests assert on these:
+   * conversions = futures triggered, hits = memoized/identity reuses) */
+  std::atomic<int64_t> reshape_conversions{0};
+  std::atomic<int64_t> reshape_hits{0};
+
   ~ptc_context();
 };
 
@@ -542,6 +604,29 @@ int64_t ptc_eval_expr(const Expr &e, ptc_context *ctx, const int64_t *locals,
 
 void ptc_copy_retain(ptc_copy *c);
 void ptc_copy_release_internal(ptc_context *ctx, ptc_copy *c);
+
+/* The reshaped view of `src` through local datatype `ltype_id`
+ * (reference: parsec_reshape.c reshape promises).  Returns `src` itself
+ * when the type is the identity for this copy or the copy is already
+ * shaped as the type; otherwise the memoized per-(copy, type, version)
+ * converted child — created (and counted as a conversion) at most once.
+ * The returned pointer is RETAINED (under the cache lock, so a racing
+ * stale-version eviction cannot free it first): the caller owns one ref
+ * and must release it after staging. */
+ptc_copy *ptc_reshape_get(ptc_context *ctx, ptc_copy *src, int32_t ltype_id);
+
+/* selective write-back of `src` into `dst` through a datatype: segments
+ * copy only their byte ranges; cast types reverse-convert (the copy
+ * holds dst_kind elements, the collection tile holds src_kind).  A
+ * ltype < 0 (or unknown) falls back to a full memcpy. */
+void ptc_typed_writeback(ptc_context *ctx, int32_t ltype_id, ptc_copy *src,
+                         void *dst, int64_t dst_size);
+
+/* element-cast primitives (PTC_ELEM_*; shared by the reshape engine and
+ * the comm layer's pack/scatter) */
+int64_t ptc_elem_size_of(int32_t kind);
+bool ptc_convert_elems(int32_t src_kind, int32_t dst_kind, const void *src,
+                       void *dst, int64_t n);
 
 ptc_data *ptc_collection_data_of(ptc_context *ctx, int32_t dc_id,
                                  const int64_t *idx, int32_t n);
@@ -638,9 +723,13 @@ void ptc_comm_shutdown(ptc_context *ctx);
  * (extern "C": defined inside core.cpp's public-API linkage block) */
 extern "C" void ptc_copy_sync_for_host(ptc_context *ctx, ptc_copy *c);
 
-/* outgoing memory write-back to a collection datum owned by `rank` */
+/* outgoing memory write-back to a collection datum owned by `rank`.
+ * ltype >= 0: selective write-back — the receiver applies only the
+ * byte ranges (or reverse element cast) the datatype selects (SPMD
+ * registration order makes the id meaningful on both sides). */
 void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,
-                           const int64_t *idx, int32_t nidx, ptc_copy *copy);
+                           const int64_t *idx, int32_t nidx, ptc_copy *copy,
+                           int32_t ltype = -1);
 
 /* outgoing DTD completion broadcast (real task finished; shadows on every
  * other rank release their successors + apply written-tile payloads).

@@ -8,9 +8,10 @@ the comm engine moves activations and tile payloads between ranks.
 """
 from ._native import (DEV_CPU, DEV_RECURSIVE, DEV_TPU, HOOK_AGAIN, HOOK_ASYNC,
                       HOOK_DISABLE, HOOK_DONE, HOOK_ERROR, HOOK_NEXT)
-from .core import (Compound, Context, Data, G, In, L, Mem, Out, Range, Ref,
-                   TaskClass, Taskpool, TaskView, call, compose, maximum,
-                   minimum, recursive_call, select, shl, shr)
+from .core import (Compound, Context, CountableFuture, Data, Future, G, In,
+                   L, Mem, Out, Range, Ref, TaskClass, Taskpool, TaskView,
+                   TriggeredFuture, call, compose, maximum, minimum,
+                   recursive_call, select, shl, shr)
 
 __version__ = "0.1.0"
 
@@ -19,6 +20,7 @@ __all__ = [
     "In", "Out", "Mem", "Ref",
     "L", "G", "Range", "select", "call", "minimum", "maximum", "shl", "shr",
     "Compound", "compose", "recursive_call",
+    "Future", "CountableFuture", "TriggeredFuture",
     "HOOK_DONE", "HOOK_AGAIN", "HOOK_ASYNC", "HOOK_NEXT", "HOOK_DISABLE",
     "HOOK_ERROR", "DEV_CPU", "DEV_TPU", "DEV_RECURSIVE",
     "__version__",

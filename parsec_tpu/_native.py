@@ -51,6 +51,9 @@ BODY_NOOP = 0
 BODY_CB = 1
 BODY_DEVICE = 2
 
+# element kinds for cast datatypes (must mirror PTC_ELEM_* in parsec_core.h)
+ELEM_KINDS = {"float32": 0, "float64": 1, "int32": 2, "int64": 3, "uint8": 4}
+
 DEV_CPU = 0
 DEV_TPU = 1
 DEV_RECURSIVE = 2
@@ -145,6 +148,14 @@ _sigs = {
     "ptc_register_arena": (C.c_int32, [C.c_void_p, C.c_int64]),
     "ptc_register_datatype": (C.c_int32, [C.c_void_p, C.c_int64, C.c_int64,
                                           C.c_int64]),
+    "ptc_register_datatype_indexed": (C.c_int32, [C.c_void_p,
+                                                  C.POINTER(C.c_int64),
+                                                  C.POINTER(C.c_int64),
+                                                  C.c_int32]),
+    "ptc_register_datatype_cast": (C.c_int32, [C.c_void_p, C.c_int32,
+                                               C.c_int32, C.c_int64]),
+    "ptc_ctx_reshape_stats": (None, [C.c_void_p, C.POINTER(C.c_int64),
+                                     C.POINTER(C.c_int64)]),
     "ptc_tp_new": (C.c_void_p, [C.c_void_p, C.c_int32, C.POINTER(C.c_int64)]),
     "ptc_tp_destroy": (None, [C.c_void_p]),
     "ptc_tp_add_class": (C.c_int32, [C.c_void_p, C.c_char_p,

@@ -1,5 +1,6 @@
 from .compose import Compound, compose, recursive_call
 from .context import Context, Data
+from .future import CountableFuture, Future, TriggeredFuture
 from .expr import (G, L, Range, call, compile_expr, maximum, minimum, select,
                    shl, shr)
 from .taskclass import In, Mem, Out, Ref, TaskClass, TaskView
@@ -10,4 +11,5 @@ __all__ = [
     "In", "Out", "Mem", "Ref",
     "L", "G", "Range", "select", "call", "minimum", "maximum", "shl", "shr",
     "compile_expr", "Compound", "compose", "recursive_call",
+    "Future", "CountableFuture", "TriggeredFuture",
 ]

@@ -49,11 +49,17 @@ class Mem:
 
 class _Dep:
     def __init__(self, direction: int, target, guard: Optional[ExprLike],
-                 dtype: Optional[str] = None, iters=None):
+                 dtype: Optional[str] = None, iters=None,
+                 ltype: Optional[str] = None):
         self.direction = direction
         self.target = target  # Ref | Mem | None
         self.guard = guard
         self.dtype = dtype  # wire datatype name (Context.register_datatype)
+        # local reshape datatype (JDF `[type = ...]`/`[type_data = ...]`,
+        # reference parsec_reshape.c): the dep's data is routed through a
+        # new memoized datacopy holding the selected/converted elements;
+        # on a Mem OUT dep it selects the write-back region
+        self.ltype = ltype
         # bracketed iterators (JDF local indices): [(name, lo, hi, step)];
         # guard and target expressions may reference the names, bounds may
         # reference earlier iterators
@@ -61,13 +67,15 @@ class _Dep:
 
 
 def In(target=None, guard: Optional[ExprLike] = None,
-       dtype: Optional[str] = None, iters=None) -> _Dep:
-    return _Dep(0, target, guard, dtype, iters)
+       dtype: Optional[str] = None, iters=None,
+       ltype: Optional[str] = None) -> _Dep:
+    return _Dep(0, target, guard, dtype, iters, ltype)
 
 
 def Out(target=None, guard: Optional[ExprLike] = None,
-        dtype: Optional[str] = None, iters=None) -> _Dep:
-    return _Dep(1, target, guard, dtype, iters)
+        dtype: Optional[str] = None, iters=None,
+        ltype: Optional[str] = None) -> _Dep:
+    return _Dep(1, target, guard, dtype, iters, ltype)
 
 
 class _Flow:
@@ -170,8 +178,9 @@ class TaskClass:
         locals_map = {n: i for i, (n, _, _) in enumerate(self.locals)}
         cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call,
                           scope=getattr(tp, "jdf_scope", None))
-        # v3: comprehension locals (kind 2) + per-dep iterators + dtype
-        spec: List[int] = [3, len(self.locals)]
+        # v4: v3 (comprehension locals, per-dep iterators, dtype) + per-dep
+        # local reshape type (ltype)
+        spec: List[int] = [4, len(self.locals)]
         for (name, is_range, payload) in self.locals:
             if isinstance(payload, Compr):
                 spec.append(2)
@@ -280,6 +289,13 @@ class TaskClass:
                     spec += compile_expr(lo, iter_bound_ctxs[k])
                     spec += compile_expr(hi, iter_bound_ctxs[k])
                     spec += compile_expr(step, iter_bound_ctxs[k])
+                if d.ltype is not None and d.ltype not in tp.ctx.datatypes:
+                    raise ValueError(
+                        f"{self.name}: dep ltype {d.ltype!r} names no "
+                        "registered datatype — call "
+                        "Context.register_datatype* first")
+                spec.append(tp.ctx.datatypes[d.ltype]
+                            if d.ltype is not None else -1)
         # chores
         spec.append(len(self.chores))
         for ch in self.chores:

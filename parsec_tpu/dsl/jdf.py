@@ -706,21 +706,36 @@ class JdfTaskpoolBuilder:
             deps = []
             for d in fl.deps:
                 mk = In if d.direction == 0 else Out
-                dt = d.props.get("type")
-                if dt is not None and dt not in self.ctx.datatypes:
-                    raise ValueError(
-                        f"jdf: {jt.name}.{fl.name}: dep [type = {dt}] names "
-                        "no registered datatype "
-                        "(Context.register_datatype)")
+                # reference dep-type semantics (parsec_reshape.c,
+                # tests/collections/reshape/): [type = X] reshapes
+                # locally through a datacopy future AND types the wire;
+                # [type_remote = X] types the wire only; [type_data = X]
+                # types the collection read / selective write-back.
+                t_full = d.props.get("type")
+                t_rem = d.props.get("type_remote")
+                t_data = d.props.get("type_data")
+                dt = t_rem if t_rem is not None else t_full
+                lt = t_full if t_full is not None else t_data
+                for nm_, role in ((t_full, "type"),
+                                  (t_rem, "type_remote"),
+                                  (t_data, "type_data")):
+                    if nm_ is not None and nm_ not in self.ctx.datatypes:
+                        raise ValueError(
+                            f"jdf: {jt.name}.{fl.name}: dep [{role} = "
+                            f"{nm_}] names no registered datatype "
+                            "(Context.register_datatype*)")
                 tgt = _target_to_builder(d.target, fl.name)
                 its = d.iters + d.target.iters  # dep-level outer
                 if d.alt is not None:
                     alt = _target_to_builder(d.alt, fl.name)
-                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its))
+                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its,
+                                   ltype=lt))
                     deps.append(mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard),
-                                   dtype=dt, iters=d.iters + d.alt.iters))
+                                   dtype=dt, iters=d.iters + d.alt.iters,
+                                   ltype=lt))
                 else:
-                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its))
+                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its,
+                                   ltype=lt))
             tc.flow(fl.name, fl.access, *deps,
                     arena=self.arenas.get(fl.name))
         self._attach_bodies(jt, tc)

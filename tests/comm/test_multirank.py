@@ -175,6 +175,18 @@ def test_datatype_column_rendezvous_2ranks():
     _run_spmd(_workers.ptg_datatype_column, 2, eager_limit=0)
 
 
+def test_remote_read_reshape_2ranks():
+    """Ported remote_read_reshape.jdf: consumer-rank reshape future +
+    typed remote PUT write-back (reference tests/collections/reshape/)."""
+    _run_spmd(_workers.ptg_remote_read_reshape, 2)
+
+
+def test_remote_cast_2ranks():
+    """Cross-rank f64->f32 conversion declared on the consumer's IN dep
+    (no manual apply-taskpool detour)."""
+    _run_spmd(_workers.ptg_remote_cast, 2)
+
+
 def test_moe_taskpool_2ranks():
     """MoE dispatch/combine all-to-all legs across 2 ranks (shards on
     s%2, experts on e%2), validated against the dense oracle."""
@@ -228,3 +240,9 @@ def test_rendezvous_reaped_on_peer_loss():
 def test_fence_errors_on_lost_peer():
     """A crashed rank fails the survivors' fence instead of hanging it."""
     _run_spmd(_workers.fence_lost_peer, 2, timeout=120.0)
+
+
+def test_jdf_remote_type_cast_2ranks():
+    """JDF [type = X] (cast) across ranks: converted once on the
+    producer, shipped shaped-as-X, not re-applied by the consumer."""
+    _run_spmd(_workers.jdf_remote_type_cast, 2)

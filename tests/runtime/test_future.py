@@ -1,0 +1,121 @@
+"""Future/promise primitives (reference: parsec/class/parsec_future.h
+base + countable futures, parsec/utils/parsec_datacopy_future.c
+trigger-once semantics)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+
+
+def test_future_set_get():
+    f = pt.Future()
+    assert not f.is_ready()
+    f.set(42)
+    assert f.is_ready()
+    assert f.get() == 42
+    with pytest.raises(RuntimeError):
+        f.set(43)
+
+
+def test_future_blocking_get_across_threads():
+    f = pt.Future()
+    got = []
+    t = threading.Thread(target=lambda: got.append(f.get(timeout=5)))
+    t.start()
+    time.sleep(0.05)
+    f.set("x")
+    t.join()
+    assert got == ["x"]
+
+
+def test_future_timeout():
+    with pytest.raises(TimeoutError):
+        pt.Future().get(timeout=0.05)
+
+
+def test_future_exception_propagates():
+    f = pt.Future()
+    f.set_exception(ValueError("boom"))
+    with pytest.raises(ValueError, match="boom"):
+        f.get()
+
+
+def test_on_ready_callback_before_and_after():
+    f = pt.Future()
+    order = []
+    f.on_ready(lambda fu: order.append(("early", fu.get())))
+    f.set(1)
+    f.on_ready(lambda fu: order.append(("late", fu.get())))
+    assert order == [("early", 1), ("late", 1)]
+
+
+def test_countable_future():
+    f = pt.CountableFuture(3)
+    f.advance("a")
+    f.advance("b")
+    assert not f.is_ready()
+    f.advance("c")
+    assert f.get() == ["a", "b", "c"]
+
+
+def test_triggered_future_fires_once_concurrently():
+    """Datacopy-future contract: many consumers, one conversion."""
+    fired = []
+    lock = threading.Lock()
+
+    def trigger():
+        with lock:
+            fired.append(1)
+        time.sleep(0.02)
+        return np.arange(4)
+
+    f = pt.TriggeredFuture(trigger)
+    results = []
+    rl = threading.Lock()
+
+    def getter():
+        v = f.get(timeout=5)
+        with rl:
+            results.append(v)
+
+    ts = [threading.Thread(target=getter) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(fired) == 1
+    assert len(results) == 8
+    for r in results:
+        assert r is results[0]  # the SAME materialized value, shared
+
+
+def test_triggered_future_failure_shared():
+    def trigger():
+        raise RuntimeError("conversion failed")
+
+    f = pt.TriggeredFuture(trigger)
+    with pytest.raises(RuntimeError, match="conversion failed"):
+        f.get(timeout=1)
+    with pytest.raises(RuntimeError, match="conversion failed"):
+        f.get(timeout=1)  # memoized failure, not re-fired
+
+
+def test_body_coordination_through_future():
+    """A future bridging two task bodies out-of-band (the user-facing
+    role the reference exposes futures for)."""
+    f = pt.Future()
+    got = []
+    with pt.Context(nb_workers=2) as ctx:
+        tp = pt.Taskpool(ctx)
+        a = tp.task_class("A")
+        a.flow("X", "CTL", pt.Out(pt.Ref("B", flow="X")))
+        a.body(lambda t: f.set(7))
+        b = tp.task_class("B")
+        b.flow("X", "CTL", pt.In(pt.Ref("A", flow="X")))
+        b.body(lambda t: got.append(f.get(timeout=5)))
+        tp.run()
+        tp.wait()
+    assert got == [7]

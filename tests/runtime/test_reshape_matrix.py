@@ -1,0 +1,302 @@
+"""Ported reference reshape test matrix (local half).
+
+Reference: /root/reference/tests/collections/reshape/*.jdf +
+testing_reshape.c — the datacopy-future reshape machinery
+(parsec/parsec_reshape.c:771, parsec/utils/parsec_datacopy_future.c).
+Dep semantics under test (reference jdf comments):
+
+- ``[type = X]`` (In/Out ``ltype=``): a NEW datacopy holding only the
+  elements X selects (or element-cast) is created and passed on —
+  memoized per (source copy, type): every consumer shares one
+  conversion (the datacopy-future resolves once).
+- ``[type_remote = X]`` (In/Out ``dtype=``): wire-only; locally the
+  original pointer is passed (local_no_reshape.jdf).
+- ``[type_data = X]`` on a Mem dep (``ltype=`` on Mem In/Out): types the
+  collection read / selective write-back.
+
+Each test is named for the reference .jdf it ports; the cross-rank half
+lives in tests/comm/test_multirank.py (remote_read_reshape, cast).
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+
+N = 8  # tile side (ints)
+
+
+def lower_segments(n, itemsize=4):
+    """Row-major lower triangle incl. diagonal as (offset, len) bytes."""
+    return [(i * n * itemsize, (i + 1) * itemsize) for i in range(n)]
+
+
+def upper_segments(n, itemsize=4):
+    return [((i * n + i) * itemsize, (n - i) * itemsize) for i in range(n)]
+
+
+def lower_mask(n):
+    return np.tril(np.ones((n, n), dtype=bool))
+
+
+def _run_chain(ctx, tile, read_out_ltype=None, zero_in_ltype=None,
+               write_back_ltype=None, zero_out_ltype=None,
+               capture=None):
+    """READ_A -> SET_ZEROS -> WRITE_A over one tile (the reference
+    matrix's 3-task shape).  SET_ZEROS memsets its whole staged copy;
+    WRITE_A writes back to the collection."""
+    ctx.register_linear_collection("descA", tile, elem_size=tile.nbytes)
+    tp = pt.Taskpool(ctx)
+    read = tp.task_class("READ_A")
+    read.flow("A", "RW",
+              pt.In(pt.Mem("descA", 0)),
+              pt.Out(pt.Ref("SET_ZEROS", flow="A"), ltype=read_out_ltype))
+    read.body(lambda t: None)
+
+    zeros = tp.task_class("SET_ZEROS")
+    zeros.flow("A", "RW",
+               pt.In(pt.Ref("READ_A", flow="A"), ltype=zero_in_ltype),
+               pt.Out(pt.Ref("WRITE_A", flow="A"), ltype=zero_out_ltype))
+
+    def zbody(t):
+        if capture is not None:
+            capture.append(t.data_ptr("A"))
+        t.data("A", np.int32)[:] = 0
+
+    zeros.body(zbody)
+
+    write = tp.task_class("WRITE_A")
+    write.flow("A", "RW",
+               pt.In(pt.Ref("SET_ZEROS", flow="A")),
+               pt.Out(pt.Mem("descA", 0), ltype=write_back_ltype))
+    write.body(lambda t: None)
+    tp.run()
+    tp.wait()
+    return tp
+
+
+def test_local_no_reshape():
+    """local_no_reshape.jdf: type_remote only — the original pointer is
+    passed to successors, so the FULL tile is zeroed."""
+    tile = np.ones((N, N), dtype=np.int32)
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype("colT", 4, N, N * 4)  # wire-only: no effect
+        _run_chain(ctx, tile)
+        conv, _ = ctx.reshape_stats()
+    assert (tile == 0).all()
+    assert conv == 0
+
+
+def test_local_input_reshape():
+    """local_input_reshape.jdf: [type = LOWER] on the READ_A->SET_ZEROS
+    edge + [type_data = LOWER] on the write-back: only the lower part of
+    the original tile ends up zeroed."""
+    tile = np.ones((N, N), dtype=np.int32)
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("LOWER", lower_segments(N))
+        _run_chain(ctx, tile, zero_in_ltype="LOWER",
+                   write_back_ltype="LOWER")
+        conv, _ = ctx.reshape_stats()
+    m = lower_mask(N)
+    assert (tile[m] == 0).all()
+    assert (tile[~m] == 1).all()  # upper untouched: body wrote a NEW copy
+    assert conv == 1
+
+
+def test_local_output_reshape():
+    """local_output_reshape.jdf: the reshape declared on the producer's
+    OUT dep instead — same observable behavior."""
+    tile = np.ones((N, N), dtype=np.int32)
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("LOWER", lower_segments(N))
+        _run_chain(ctx, tile, read_out_ltype="LOWER",
+                   write_back_ltype="LOWER")
+        conv, _ = ctx.reshape_stats()
+    m = lower_mask(N)
+    assert (tile[m] == 0).all()
+    assert (tile[~m] == 1).all()
+    assert conv == 1
+
+
+def test_local_read_reshape_shared():
+    """local_read_reshape.jdf: two readers of the same source through the
+    same [type] share ONE reshaped copy (the datacopy future resolves
+    once; the second consumer is a memoization hit)."""
+    tile = np.arange(N * N, dtype=np.int32).reshape(N, N)
+    ptrs = []
+    seen = []
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("LOWER", lower_segments(N))
+        ctx.register_linear_collection("descA", tile, elem_size=tile.nbytes)
+        tp = pt.Taskpool(ctx, globals={"NR": 1})
+        r = pt.L("r")
+        src = tp.task_class("SRC")
+        src.flow("A", "RW",
+                 pt.In(pt.Mem("descA", 0)),
+                 pt.Out(pt.Ref("RD", pt.Range(0, pt.G("NR")), flow="A")))
+        src.body(lambda t: None)
+        rd = tp.task_class("RD")
+        rd.param("r", 0, pt.G("NR"))
+        rd.flow("A", "READ",
+                pt.In(pt.Ref("SRC", flow="A"), ltype="LOWER"))
+
+        def rbody(t):
+            ptrs.append(t.data_ptr("A"))
+            seen.append(t.data("A", np.int32, shape=(N, N)).copy())
+
+        rd.body(rbody)
+        tp.run()
+        tp.wait()
+        conv, hits = ctx.reshape_stats()
+    assert len(ptrs) == 2 and ptrs[0] == ptrs[1]  # shared converted copy
+    assert conv == 1 and hits >= 1
+    m = lower_mask(N)
+    for s in seen:
+        assert (s[m] == tile[m]).all()
+        assert (s[~m] == 0).all()  # non-selected bytes defined-zero
+
+
+def test_local_input_LU_LL():
+    """local_input_LU_LL.jdf: two consumers pull DIFFERENT types (upper
+    vs lower) from the same predecessor flow — two distinct futures."""
+    tile = np.arange(1, N * N + 1, dtype=np.int32).reshape(N, N)
+    got = {}
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("LOWER", lower_segments(N))
+        ctx.register_datatype_indexed("UPPER", upper_segments(N))
+        ctx.register_linear_collection("descA", tile, elem_size=tile.nbytes)
+        tp = pt.Taskpool(ctx)
+        src = tp.task_class("SRC")
+        src.flow("A", "RW",
+                 pt.In(pt.Mem("descA", 0)),
+                 pt.Out(pt.Ref("LO", flow="A")),
+                 pt.Out(pt.Ref("UP", flow="A")))
+        src.body(lambda t: None)
+        for name, lt in (("LO", "LOWER"), ("UP", "UPPER")):
+            c = tp.task_class(name)
+            c.flow("A", "READ", pt.In(pt.Ref("SRC", flow="A"), ltype=lt))
+            c.body(lambda t, name=name: got.__setitem__(
+                name, t.data("A", np.int32, shape=(N, N)).copy()))
+        tp.run()
+        tp.wait()
+        conv, _ = ctx.reshape_stats()
+    assert conv == 2
+    m = lower_mask(N)
+    assert (got["LO"][m] == tile[m]).all() and (got["LO"][~m] == 0).all()
+    mu = np.triu(np.ones((N, N), dtype=bool))
+    assert (got["UP"][mu] == tile[mu]).all() and (got["UP"][~mu] == 0).all()
+
+
+def test_avoidable_reshape():
+    """avoidable_reshape.jdf: a [type] matching the data's own shape
+    (full-extent contiguous) creates NO new copy — the consumer sees the
+    original pointer and zero conversions are recorded."""
+    tile = np.ones((N, N), dtype=np.int32)
+    ptrs = []
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("FULL", [(0, tile.nbytes)])
+        _run_chain(ctx, tile, zero_in_ltype="FULL", capture=ptrs)
+        conv, hits = ctx.reshape_stats()
+    assert (tile == 0).all()  # identity: body wrote the original tile
+    assert ptrs[0] == tile.ctypes.data  # the ORIGINAL pointer passed through
+    assert conv == 0 and hits >= 1
+
+
+def test_no_re_reshape_on_forward():
+    """remote_no_re_reshape.jdf (local leg): a copy that already IS the
+    product of [type = X] forwarded through another X-typed dep is not
+    reshaped again."""
+    tile = np.ones((N, N), dtype=np.int32)
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("LOWER", lower_segments(N))
+        _run_chain(ctx, tile, zero_in_ltype="LOWER",
+                   zero_out_ltype="LOWER", write_back_ltype="LOWER")
+        conv, hits = ctx.reshape_stats()
+    m = lower_mask(N)
+    assert (tile[m] == 0).all() and (tile[~m] == 1).all()
+    assert conv == 1  # one future total; the forward was a hit
+    assert hits >= 1
+
+
+def test_input_dep_single_copy_reshape():
+    """input_dep_single_copy_reshape.jdf: a [type_data] on the matrix
+    READ itself — the task body sees a reshaped copy, never aliasing the
+    collection tile."""
+    tile = np.arange(N * N, dtype=np.int32).reshape(N, N)
+    orig = tile.copy()
+    got = []
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.register_datatype_indexed("LOWER", lower_segments(N))
+        ctx.register_linear_collection("descA", tile, elem_size=tile.nbytes)
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("RD")
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("descA", 0), ltype="LOWER"))
+
+        def body(t):
+            got.append(t.data("A", np.int32, shape=(N, N)).copy())
+            t.data("A", np.int32)[:] = -1  # must not touch the collection
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        conv, _ = ctx.reshape_stats()
+    assert conv == 1
+    m = lower_mask(N)
+    assert (got[0][m] == orig[m]).all() and (got[0][~m] == 0).all()
+    assert (tile == orig).all()  # collection tile untouched
+
+
+def test_cast_reshape_local():
+    """The arbitrary type->type promise: an f64 tile read through a
+    [type = f64->f32] dep arrives in the body as converted f32."""
+    tile = np.linspace(0.0, 1.0, N * N, dtype=np.float64)
+    got = []
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_datatype_cast("D2S", np.float64, np.float32)
+        ctx.register_linear_collection("descA", tile, elem_size=tile.nbytes)
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("RD")
+        tc.flow("A", "READ", pt.In(pt.Mem("descA", 0), ltype="D2S"))
+        tc.body(lambda t: got.append(t.data("A", np.float32).copy()))
+        tp.run()
+        tp.wait()
+        conv, _ = ctx.reshape_stats()
+    assert conv == 1
+    assert got[0].dtype == np.float32 and got[0].size == N * N
+    np.testing.assert_allclose(got[0], tile.astype(np.float32), rtol=0)
+
+
+def test_cast_writeback_reverses():
+    """[type_data = cast] on a Mem write-back: the copy holds converted
+    (f32) elements; the collection keeps its own type (f64)."""
+    tile = np.full(N, 3.0, dtype=np.float64)
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_datatype_cast("D2S", np.float64, np.float32)
+        ctx.register_linear_collection("descA", tile, elem_size=tile.nbytes)
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("T")
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("descA", 0), ltype="D2S"),
+                pt.Out(pt.Mem("descA", 0), ltype="D2S"))
+
+        def body(t):
+            a = t.data("A", np.float32)
+            a *= 2.0
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        conv, _ = ctx.reshape_stats()
+    assert conv == 1
+    np.testing.assert_allclose(tile, np.full(N, 6.0))
+    assert tile.dtype == np.float64
+
+
+def test_unknown_ltype_name_rejected():
+    with pt.Context(nb_workers=1) as ctx:
+        tp = pt.Taskpool(ctx)
+        tc = tp.task_class("T")
+        tc.flow("A", "READ", pt.In(None, ltype="nope"))
+        tc.body(lambda t: None)
+        with pytest.raises(ValueError, match="ltype 'nope'"):
+            tp.run()
