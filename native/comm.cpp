@@ -474,14 +474,18 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
        * tile-sized copy (parity with the local reshape path, which
        * allocates src->size) — the consumer flow's arena knows the size */
       int64_t min_alloc = 0;
-      if (!targets.empty()) {
-        int32_t cid0 = targets[0].class_id;
-        if (cid0 >= 0 && (size_t)cid0 < tp->classes.size() &&
+      for (const WireTarget &t : targets) {
+        /* one frame can merge targets of DIFFERENT consumer classes
+         * (RemoteSend keys on rank/flow/copy, not class): the shared
+         * copy must satisfy the largest arena among them */
+        int32_t cid = t.class_id;
+        if (cid >= 0 && (size_t)cid < tp->classes.size() &&
             flow_idx >= 0 &&
-            (size_t)flow_idx < tp->classes[(size_t)cid0].flows.size()) {
-          int32_t aid = tp->classes[(size_t)cid0].flows[(size_t)flow_idx]
-                            .arena_id;
-          if (aid >= 0 && (size_t)aid < ctx->arenas.size())
+            (size_t)flow_idx < tp->classes[(size_t)cid].flows.size()) {
+          int32_t aid =
+              tp->classes[(size_t)cid].flows[(size_t)flow_idx].arena_id;
+          if (aid >= 0 && (size_t)aid < ctx->arenas.size() &&
+              ctx->arenas[(size_t)aid]->elem_size > min_alloc)
             min_alloc = ctx->arenas[(size_t)aid]->elem_size;
         }
       }
@@ -1757,21 +1761,27 @@ static bool presend_form(ptc_context *ctx, int32_t send_dtype,
   shaped = -1;
   if (!copy || !copy->ptr || copy->size <= 0) return false;
   if (send_dtype < 0) {
-    /* no wire type, but the payload may already BE the product of a
-     * producer-side [type] reshape (ltype with no dtype): advertise its
-     * form so the consumer's matching ltype does not re-apply a cast */
+    /* no wire type: the copy ships whole (full extent), so if it IS the
+     * product of a producer-side [type] reshape (ltype with no dtype)
+     * its form survives the wire verbatim — advertise it so the
+     * consumer's matching ltype does not re-apply */
     shaped = copy->shaped_as;
     return false;
   }
   DtypeDef dt;
-  if (ptc_dtype_get(ctx, send_dtype, &dt) && dt.is_cast() &&
-      copy->shaped_as == send_dtype) {
+  bool have = ptc_dtype_get(ctx, send_dtype, &dt);
+  if (have && dt.is_cast() && copy->shaped_as == send_dtype) {
     shaped = send_dtype;
     return false;
   }
   ptc_copy_sync_for_host(ctx, copy);
   bool p = dtype_pack(ctx, send_dtype, copy, packed);
-  if (p) shaped = send_dtype;
+  /* only CAST types may advertise shaped on a packed send: their packed
+   * and extent forms coincide (contiguous converted bytes).  A packed
+   * indexed/strided payload is NOT the reshape product (concatenated
+   * segments vs zero-gapped extent) — claiming shaped would make the
+   * consumer's ltype fast path stage a short packed buffer as a tile. */
+  if (p && have && dt.is_cast()) shaped = send_dtype;
   return p;
 }
 

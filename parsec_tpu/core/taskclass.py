@@ -175,6 +175,20 @@ class TaskClass:
 
     def compile(self, tp) -> List[int]:
         """Serialize to the native spec blob (version-1 layout)."""
+        # ptgpp-style limit diagnostics (reference: the MAX_LOCAL_COUNT /
+        # MAX_PARAM_COUNT compiler checks behind
+        # tests/dsl/ptg/ptgpp/too_many_*.jdf) — a clear error here beats
+        # the native decoder's generic bad-spec failure.  Dep counts per
+        # flow are NOT limited in this runtime (no dependency bitmask, so
+        # the reference's MAX_DEP_IN/OUT_COUNT has no analog).
+        if len(self.locals) > N.MAX_LOCALS:
+            raise ValueError(
+                f"{self.name}: too many local variables "
+                f"({len(self.locals)} > PTC_MAX_LOCALS={N.MAX_LOCALS})")
+        if len(self.flows) > N.MAX_FLOWS:
+            raise ValueError(
+                f"{self.name}: too many flows "
+                f"({len(self.flows)} > PTC_MAX_FLOWS={N.MAX_FLOWS})")
         locals_map = {n: i for i, (n, _, _) in enumerate(self.locals)}
         cctx = CompileCtx(locals_map, tp.globals_map, tp._register_call,
                           scope=getattr(tp, "jdf_scope", None))

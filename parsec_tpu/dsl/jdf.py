@@ -348,6 +348,10 @@ class _Parser:
         # flows
         while self.peek().kind == "id" and self.peek().val in _ACCESS:
             task.flows.append(self._parse_flow())
+        # reference priority clause between dataflow and BODY: `; expr`
+        # (tests/dsl/ptg/startup.jdf `; prio`)
+        if self.accept(";"):
+            task.priority = self._parse_expr()
         # bodies
         while self.peek().kind == "id" and self.peek().val == "BODY":
             task.bodies.append(self._parse_body())
@@ -706,6 +710,20 @@ class JdfTaskpoolBuilder:
             deps = []
             for d in fl.deps:
                 mk = In if d.direction == 0 else Out
+                # ptgpp compiler checks (reference messages verbatim:
+                # tests/dsl/ptg/ptgpp/output_{NEW,NULL}*.jdf expect them)
+                if d.direction == 1:
+                    tkinds = [d.target.kind] + (
+                        [d.alt.kind] if d.alt is not None else [])
+                    if "new" in tkinds:
+                        raise ValueError(
+                            f"jdf: {jt.name}.{fl.name}: Automatic data "
+                            "allocation with NEW only supported in IN "
+                            "dependencies.")
+                    if "null" in tkinds:
+                        raise ValueError(
+                            f"jdf: {jt.name}.{fl.name}: NULL data only "
+                            "supported in IN dependencies.")
                 # reference dep-type semantics (parsec_reshape.c,
                 # tests/collections/reshape/): [type = X] reshapes
                 # locally through a datacopy future AND types the wire;
