@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Cross-PROCESS device data-plane measurement (VERDICT r3 #5).
+
+Two separate OS processes (the real multi-host shape — no shared jax
+client, so the colocated by-reference shortcut cannot apply), one
+device-resident tile per size rung crossing rank 0 -> rank 1 through the
+PK_DEVICE rendezvous: producing-side lazy d2h at serve time, TCP, h2d on
+the consumer.  This is the fallback path whose cost decides whether a
+platform-level cross-host device transfer is worth building (reference
+seam: transport-native payload movement end to end,
+parsec/parsec_comm_engine.h:139-160; SURVEY §7 hard-part 2).
+
+Emits one JSON line per tile size:
+  {"tile_mb": M, "xfer_ms": t, "gbps": g, "d2h_bytes": ..., "h2d_bytes": ...}
+
+Run (needs the real chip; each rank owns the whole chip in turn — the
+axon tunnel serializes, which is itself part of the measured reality):
+  python tools/bench_dataplane.py            # all rungs
+  python tools/bench_dataplane.py --mb 16    # one rung
+"""
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _worker(rank, nodes, port, mb, reps, q):
+    try:
+        import jax
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            # smoke runs: the axon plugin overrides the env var — force
+            # the platform BEFORE backend init or a dead tunnel hangs us
+            jax.config.update("jax_platforms", "cpu")
+        import parsec_tpu as pt
+        from parsec_tpu.device import TpuDevice
+
+        os.environ["PTC_MCA_comm_eager_limit"] = "65536"
+        ctx = pt.Context(nb_workers=1)
+        ctx.set_rank(rank, nodes)
+        ctx.comm_init(port)
+        elems = mb * (1 << 20) // 4
+        esize = elems * 4
+        arr = np.zeros((nodes, elems), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=esize,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", esize)
+        dev = TpuDevice(ctx)
+        k = pt.L("k")
+        times = []
+        for rep in range(reps + 1):  # rep 0 = compile warmup
+            tp = pt.Taskpool(ctx, globals={"R": rep})
+            prod = tp.task_class("Prod")
+            prod.param("k", 0, 0)
+            prod.affinity("A", 0)
+            cons = tp.task_class("Cons")
+            cons.param("k", 0, 0)
+            cons.affinity("A", 1)
+            prod.flow("X", "RW", pt.In(pt.Mem("A", 0)),
+                      pt.Out(pt.Ref("Cons", k, flow="X")))
+            cons.flow("X", "R", pt.In(pt.Ref("Prod", k, flow="X")),
+                      arena="t")
+            cons.flow("Y", "W", pt.Out(pt.Mem("A", 1)), arena="t")
+            dev.attach(prod, tp, kernel=lambda x: x + 1.0, reads=["X"],
+                       writes=["X"], shapes={"X": (elems,)},
+                       dtype=np.float32)
+            dev.attach(cons, tp, kernel=lambda x: x * 1.0, reads=["X"],
+                       writes=["Y"], shapes={"X": (elems,), "Y": (elems,)},
+                       dtype=np.float32)
+            ctx.comm_fence()  # both ranks ready: isolate the transfer
+            t0 = time.perf_counter()
+            tp.run()
+            tp.wait()
+            ctx.comm_fence()
+            dt = time.perf_counter() - t0
+            if rep == 0:
+                base = dict(dev.stats)  # exclude compile-warmup traffic
+            else:
+                times.append(dt)
+        end = dict(dev.stats)
+        st = {k: (end.get(k, 0) - base.get(k, 0)) / reps
+              for k in ("d2h_bytes", "h2d_bytes")}
+        dev.stop()
+        ctx.comm_fini()
+        ctx.destroy()
+        q.put(("ok", rank, min(times), st["d2h_bytes"], st["h2d_bytes"]))
+    except Exception:
+        import traceback
+        q.put(("err", rank, traceback.format_exc(), 0, 0))
+
+
+def run_rung(mb, port, reps=3):
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    procs = [mpctx.Process(target=_worker, args=(r, 2, port, mb, reps, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        res = [q.get(timeout=1200) for _ in range(2)]
+    finally:
+        # a wedged tunnel must not orphan children holding the TPU
+        # client and the rung's ports (they would block every later step
+        # of the watch plan)
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in res if r[0] != "ok"]
+    if errs:
+        raise RuntimeError(str(errs))
+    wall = max(r[2] for r in res)  # transfer completes on the slower side
+    d2h = sum(r[3] for r in res)
+    h2d = sum(r[4] for r in res)
+    return {
+        "tile_mb": mb,
+        "xfer_ms": round(wall * 1e3, 2),
+        "gbps": round(mb / 1024 / wall * 8, 3),
+        "d2h_bytes": d2h,
+        "h2d_bytes": h2d,
+    }
+
+
+def main():
+    mbs = [1, 4, 16, 64]
+    if "--mb" in sys.argv:
+        mbs = [int(sys.argv[sys.argv.index("--mb") + 1])]
+    base = int(os.environ.get("PTC_PORT", "31100"))
+    for i, mb in enumerate(mbs):
+        try:
+            print(json.dumps(run_rung(mb, base + 2 * i)), flush=True)
+        except Exception as e:
+            print(json.dumps({"tile_mb": mb, "error": str(e)[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

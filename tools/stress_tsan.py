@@ -139,6 +139,49 @@ def colocated_comm(workers: int, nb: int = 64, port: int = 29900) -> None:
     assert not errs, errs
 
 
+def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
+    """Concurrent consumers of the same (copy, [type]) — the memoized
+    reshape cache's create/hit race — plus write-back version bumps that
+    trigger the stale-entry eviction path against racing readers
+    (round-4 machinery: ptc_reshape_get / ReshapeCache)."""
+    n = 16
+    tile = np.arange(n * n, dtype=np.int32).reshape(n, n)
+    with pt.Context(nb_workers=workers) as ctx:
+        segs = [(i * n * 4, (i + 1) * 4) for i in range(n)]
+        ctx.register_datatype_indexed("LOW", segs)
+        ctx.register_datatype_cast("I2L", np.int32, np.int64)
+        ctx.register_linear_collection("A", tile, elem_size=tile.nbytes)
+        tp = pt.Taskpool(ctx, globals={"NR": rounds - 1, "NF": fanout - 1})
+        r = pt.L("r")
+        w = tp.task_class("W")
+        w.param("r", 0, pt.G("NR"))
+        w.flow("A", "RW",
+               pt.In(pt.Mem("A", 0), guard=(r == 0)),
+               pt.In(pt.Ref("W", r - 1, flow="A")),
+               pt.Out(pt.Ref("R", r, pt.Range(0, pt.G("NF")), flow="X")),
+               pt.Out(pt.Ref("C", r, pt.Range(0, pt.G("NF")), flow="X")),
+               pt.Out(pt.Ref("W", r + 1, flow="A"),
+                      guard=(r < pt.G("NR"))),
+               pt.Out(pt.Mem("A", 0), ltype="LOW", guard=(r == pt.G("NR"))))
+
+        def wbody(t):
+            t.data("A", np.int32)[0] += 1  # version churn per round
+
+        w.body(wbody)
+        rd = tp.task_class("R")
+        rd.param("r", 0, pt.G("NR"))
+        rd.param("f", 0, pt.G("NF"))
+        rd.flow("X", "READ", pt.In(pt.Ref("W", r, flow="A"), ltype="LOW"))
+        rd.body_noop()
+        cc = tp.task_class("C")
+        cc.param("r", 0, pt.G("NR"))
+        cc.param("f", 0, pt.G("NF"))
+        cc.flow("X", "READ", pt.In(pt.Ref("W", r, flow="A"), ltype="I2L"))
+        cc.body_noop()
+        tp.run()
+        tp.wait()
+
+
 def main():
     reps = int(os.environ.get("STRESS_REPS", "3"))
     for rep in range(reps):
@@ -146,6 +189,7 @@ def main():
             ep_burst(sched, workers=8, n=20000)
             chain_mesh(sched, workers=8, nb=200, lanes=16)
         dtd_churn(workers=8, tiles=8, rounds=100)
+        reshape_churn(workers=8, fanout=8, rounds=60)
         colocated_comm(workers=4, port=29900 + rep)
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")

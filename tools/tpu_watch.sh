@@ -1,41 +1,56 @@
 #!/bin/bash
-# Probe the TPU tunnel; whenever it is up, run the next unfinished rung
-# of the spotrf ladder, recording results in /tmp/spotrf_r4.jsonl.  A
-# mid-ladder wedge keeps completed rungs and re-arms on the next probe
-# cycle; the script exits when every rung has completed (or probes are
-# exhausted).  The outer probe doubles as the pre-rung liveness check —
-# exactly one JAX init per attempt.
+# Probe the TPU tunnel; whenever it is up, run the next unfinished step
+# of the round-4 measurement plan, recording results in
+# /tmp/spotrf_r4.jsonl.  A mid-step wedge keeps completed steps and
+# re-arms on the next probe cycle; the script exits when every step has
+# completed (or probes are exhausted).  The outer probe doubles as the
+# pre-step liveness check — exactly one JAX init per attempt.
 #
-# The smallest rung (N=8192) leads: it completes even on a slow tunnel,
-# so a brief tunnel window still yields a driver-grade NB=512 number.
+# Step order (value-per-tunnel-minute): the smallest NB=512 spotrf rung
+# first (driver-grade headline number), then the ring-attention
+# runtime-vs-GSPMD point (VERDICT #9), then the cross-process device
+# data-plane table (VERDICT #5), then the larger spotrf rungs.
 cd /root/repo
 OUT=/tmp/spotrf_r4.jsonl
 STATE=/tmp/spotrf_r4.done
 touch $STATE
+
+run_step() {  # name, command...
+  local name="$1"; shift
+  grep -q "^$name$" $STATE && return 0
+  echo "$(date -u +%H:%M:%S) step $name start" >> $OUT
+  timeout 2400 "$@" >> $OUT 2>&1
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) step $name rc=$rc" >> $OUT
+  if [ $rc -eq 0 ]; then
+    echo "$name" >> $STATE
+    return 0
+  fi
+  return 1
+}
+
+STEPS="spotrf_8192 ring dataplane spotrf_16384 spotrf_32768 spotrf_65536"
+
 for i in $(seq 1 200); do
   remaining=0
-  for cfg in "8192 512" "16384 512" "32768 512" "65536 512"; do
-    grep -q "^$cfg$" $STATE || remaining=$((remaining + 1))
+  for s in $STEPS; do
+    grep -q "^$s$" $STATE || remaining=$((remaining + 1))
   done
   if [ $remaining -eq 0 ]; then
-    echo "$(date -u +%H:%M:%S) ladder complete" >> $OUT
+    echo "$(date -u +%H:%M:%S) plan complete" >> $OUT
     exit 0
   fi
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    for cfg in "8192 512" "16384 512" "32768 512" "65536 512"; do
-      grep -q "^$cfg$" $STATE && continue
-      set -- $cfg
-      echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 start" >> $OUT
-      PTC_BENCH_PROFILE=1 timeout 2400 python bench.py --spotrf-child \
-        --n $1 --nb $2 >> $OUT 2>&1
-      rc=$?
-      echo "$(date -u +%H:%M:%S) rung N=$1 NB=$2 rc=$rc" >> $OUT
-      if [ $rc -eq 0 ]; then
-        echo "$cfg" >> $STATE
-      else
-        break  # wedge/failure: back to probing, completed rungs kept
-      fi
-    done
+    PTC_BENCH_PROFILE=1 run_step spotrf_8192 \
+      python bench.py --spotrf-child --n 8192 --nb 512 || { sleep 300; continue; }
+    run_step ring python bench.py --ring || { sleep 300; continue; }
+    run_step dataplane python tools/bench_dataplane.py || { sleep 300; continue; }
+    PTC_BENCH_PROFILE=1 run_step spotrf_16384 \
+      python bench.py --spotrf-child --n 16384 --nb 512 || { sleep 300; continue; }
+    PTC_BENCH_PROFILE=1 run_step spotrf_32768 \
+      python bench.py --spotrf-child --n 32768 --nb 512 || { sleep 300; continue; }
+    PTC_BENCH_PROFILE=1 run_step spotrf_65536 \
+      python bench.py --spotrf-child --n 65536 --nb 512 || { sleep 300; continue; }
   else
     sleep 300
   fi
