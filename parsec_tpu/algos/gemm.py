@@ -24,17 +24,11 @@ def k_gemm_nn(a, b, c):
                                    preferred_element_type=c.dtype)
 
 
-def build_gemm(ctx: pt.Context, A: TwoDimBlockCyclic, B: TwoDimBlockCyclic,
-               C: TwoDimBlockCyclic, dev: Optional[TpuDevice] = None,
-               names=("A", "B", "C")) -> pt.Taskpool:
-    """Build (but don't run) the GEMM taskpool.  Collections must already be
-    registered with ctx under `names`."""
-    mt, nt, kt = C.mt, C.nt, A.nt
-    assert A.mt == mt and B.nt == nt and B.mt == kt
-    tp = pt.Taskpool(ctx, globals={"MT": mt - 1, "NT": nt - 1, "KT": kt - 1})
+def _gemm_class(tp, A, B, C, dev, cn, a_in, b_in):
+    """The shared Gemm(m,n,k) class: owner-computes C k-chain; only the
+    A/B input deps differ between the single-rank (collection reads) and
+    distributed (reader-broadcast Refs) builders."""
     m, n, k = pt.L("m"), pt.L("n"), pt.L("k")
-    an, bn, cn = names
-
     g = tp.task_class("Gemm")
     g.param("m", 0, pt.G("MT"))
     g.param("n", 0, pt.G("NT"))
@@ -42,8 +36,8 @@ def build_gemm(ctx: pt.Context, A: TwoDimBlockCyclic, B: TwoDimBlockCyclic,
     g.affinity(cn, m, n)
     # deeper k first so the chain head is prioritized
     g.priority(pt.G("KT") - k)
-    g.flow("A", "READ", pt.In(pt.Mem(an, m, k)))
-    g.flow("B", "READ", pt.In(pt.Mem(bn, k, n)))
+    g.flow("A", "READ", a_in)
+    g.flow("B", "READ", b_in)
     g.flow("C", "RW",
            pt.In(pt.Mem(cn, m, n), guard=(k == 0)),
            pt.In(pt.Ref("Gemm", m, n, k - 1, flow="C")),
@@ -63,6 +57,70 @@ def build_gemm(ctx: pt.Context, A: TwoDimBlockCyclic, B: TwoDimBlockCyclic,
         c += a @ b
 
     g.body(cpu_body)
+    return g
+
+
+def build_gemm(ctx: pt.Context, A: TwoDimBlockCyclic, B: TwoDimBlockCyclic,
+               C: TwoDimBlockCyclic, dev: Optional[TpuDevice] = None,
+               names=("A", "B", "C")) -> pt.Taskpool:
+    """Build (but don't run) the GEMM taskpool.  Collections must already be
+    registered with ctx under `names`."""
+    mt, nt, kt = C.mt, C.nt, A.nt
+    assert A.mt == mt and B.nt == nt and B.mt == kt
+    tp = pt.Taskpool(ctx, globals={"MT": mt - 1, "NT": nt - 1, "KT": kt - 1})
+    m, n, k = pt.L("m"), pt.L("n"), pt.L("k")
+    an, bn, cn = names
+
+    _gemm_class(tp, A, B, C, dev, cn,
+                pt.In(pt.Mem(an, m, k)), pt.In(pt.Mem(bn, k, n)))
+    return tp
+
+
+def build_gemm_dist(ctx: pt.Context, A: TwoDimBlockCyclic,
+                    B: TwoDimBlockCyclic, C: TwoDimBlockCyclic,
+                    dev: Optional[TpuDevice] = None,
+                    names=("A", "B", "C")) -> pt.Taskpool:
+    """Distributed GEMM: owner-computes on C with A/B tiles moved by
+    reader-task broadcasts placed AT their data.
+
+    The single-rank builder reads A(m,k)/B(k,n) straight from the
+    collections, which this runtime (deliberately) rejects cross-rank —
+    memory reads must be affine with placement.  DPLASMA's answer is the
+    one used here: ReadA(m,k) runs on A(m,k)'s owner and BROADCASTS the
+    tile to the whole Gemm row m (all n at step k), ReadB(k,n) to the
+    whole column — the reference's collective-propagation machinery
+    carries the panels (remote_dep.c:39-47 bcast trees; dplasma gemm's
+    read_A/read_B task classes).  Chain/binomial topologies apply via
+    ctx.comm_set_topology."""
+    mt, nt, kt = C.mt, C.nt, A.nt
+    assert A.mt == mt and B.nt == nt and B.mt == kt
+    tp = pt.Taskpool(ctx, globals={"MT": mt - 1, "NT": nt - 1, "KT": kt - 1})
+    m, n, k = pt.L("m"), pt.L("n"), pt.L("k")
+    an, bn, cn = names
+
+    ra = tp.task_class("ReadA")
+    ra.param("m", 0, pt.G("MT"))
+    ra.param("k", 0, pt.G("KT"))
+    ra.affinity(an, m, k)
+    ra.flow("A", "READ",
+            pt.In(pt.Mem(an, m, k)),
+            pt.Out(pt.Ref("Gemm", m, pt.Range(0, pt.G("NT")), k,
+                          flow="A")))
+    ra.body_noop()
+
+    rb = tp.task_class("ReadB")
+    rb.param("k", 0, pt.G("KT"))
+    rb.param("n", 0, pt.G("NT"))
+    rb.affinity(bn, k, n)
+    rb.flow("B", "READ",
+            pt.In(pt.Mem(bn, k, n)),
+            pt.Out(pt.Ref("Gemm", pt.Range(0, pt.G("MT")), n, k,
+                          flow="B")))
+    rb.body_noop()
+
+    _gemm_class(tp, A, B, C, dev, cn,
+                pt.In(pt.Ref("ReadA", m, k, flow="A")),
+                pt.In(pt.Ref("ReadB", k, n, flow="B")))
     return tp
 
 

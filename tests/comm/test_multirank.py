@@ -246,3 +246,32 @@ def test_jdf_remote_type_cast_2ranks():
     """JDF [type = X] (cast) across ranks: converted once on the
     producer, shipped shaped-as-X, not re-applied by the consumer."""
     _run_spmd(_workers.jdf_remote_type_cast, 2)
+
+
+def test_gemm_dist_2ranks():
+    """Distributed GEMM: reader-task broadcasts (DPLASMA read_A/read_B
+    shape) carrying A rows / B columns cross-rank, C owner-computes."""
+    _run_spmd(_workers.gemm_dist, 2, timeout=180, N=64, nb=8)
+
+
+@pytest.mark.parametrize("topo", ["chain", "binomial"])
+def test_gemm_dist_4ranks_topologies(topo):
+    """Same DAG on a 2x2 grid with the broadcast riding chain/binomial
+    propagation trees."""
+    _run_spmd(_workers.gemm_dist, 4, timeout=240, N=64, nb=8, topo=topo)
+
+
+def test_gemm_dist_4ranks_rendezvous():
+    """A/B panel broadcasts above the eager limit ride the re-rooted GET
+    rendezvous.  4 ranks (2x2 grid) so BOTH A row-broadcasts and B
+    column-broadcasts cross ranks (at P=2,Q=1 the A row lives on one
+    rank and only B would move)."""
+    _run_spmd(_workers.gemm_dist, 4, timeout=300, N=64, nb=16,
+              eager_limit=0)
+
+
+def test_gemm_dist_2ranks_device():
+    """Distributed GEMM with the Gemm tiles computed by device chores:
+    ReadA/ReadB Ref flows feed device stage-in instead of Mem reads."""
+    _run_spmd(_workers.gemm_dist, 2, timeout=240, N=64, nb=8,
+              use_device=True)
