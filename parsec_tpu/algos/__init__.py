@@ -1,11 +1,14 @@
 from .gemm import build_gemm, build_gemm_dist, run_gemm
+from .lu import build_getrf_nopiv, getrf_flops, getrf_nopiv_reference
 from .matrix_ops import (build_apply, build_map_operator, build_reduce_col,
                          build_reduce_row)
 from .potrf import build_potrf, potrf_flops, run_potrf
 from .redistribute import redistribute
 from .reshape import build_reshape_dtype, reshape_geometry
 
-__all__ = ["build_gemm", "build_gemm_dist", "run_gemm", "build_potrf", "run_potrf",
+__all__ = ["build_gemm", "build_gemm_dist", "run_gemm",
+           "build_getrf_nopiv", "getrf_flops", "getrf_nopiv_reference",
+           "build_potrf", "run_potrf",
            "potrf_flops", "build_apply", "build_map_operator",
            "build_reduce_col", "build_reduce_row", "redistribute",
            "build_reshape_dtype", "reshape_geometry"]

@@ -1,0 +1,199 @@
+"""Tiled LU factorization without pivoting (DPLASMA dgetrf_nopiv) as a
+PTG taskpool over a 2D block-cyclic matrix:
+
+  GETRF(k)     : diagonal tile LU          A[k,k] = L[k,k] U[k,k]
+  TRSM_L(k, n) : row-panel solve           A[k,n] = L[k,k]^-1 A[k,n]
+  TRSM_U(m, k) : column-panel solve        A[m,k] = A[m,k] U[k,k]^-1
+  GEMM(k,m,n)  : trailing update           A[m,n] -= A[m,k] A[k,n]
+
+Doolittle convention: L is unit-lower (diagonal implied), U upper — both
+packed into the tile in place, exactly the reference's storage
+(dplasma dgetrf_nopiv.jdf dataflow shape).  All initial collection reads
+are affine with task placement, so the same taskpool runs distributed:
+cross-rank panel flows ride the remote-dep protocol like potrf's.
+
+No pivoting means the input must be (block) diagonally dominant or
+otherwise LU-stable — same contract as the reference algorithm.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import parsec_tpu as pt
+from ..data.collections import TwoDimBlockCyclic
+from ..device.tpu import TpuDevice
+
+
+# ---------------------------------------------------------------- kernels
+def k_getrf_nopiv(a):
+    """In-place Doolittle elimination: O(nb) sequential rank-1 updates —
+    the diagonal tile is the serial pivot of the DAG, like potrf's
+    cholesky call (only 1/nt of the tiles run this)."""
+    import jax
+    import jax.numpy as jnp
+    n = a.shape[0]
+    idx = jnp.arange(n)
+
+    def step(i, a):
+        below = idx > i
+        col = jnp.where(below, a[:, i] / a[i, i], 0.0).astype(a.dtype)
+        row = jnp.where(idx > i, a[i, :], 0.0).astype(a.dtype)
+        a = a - jnp.outer(col, row)
+        return a.at[:, i].set(jnp.where(below, col, a[:, i]))
+
+    return jax.lax.fori_loop(0, n - 1, step, a)
+
+
+def k_trsm_l(t, c):
+    """Row panel: L[k,k]^-1 C with unit-diagonal L."""
+    import jax
+    return jax.scipy.linalg.solve_triangular(t, c, lower=True,
+                                             unit_diagonal=True)
+
+
+def k_trsm_u(t, c):
+    """Column panel: C U[k,k]^-1 (non-unit upper)."""
+    import jax
+    return jax.scipy.linalg.solve_triangular(t.T, c.T, lower=True).T
+
+
+def k_gemm_lu(a, b, c):
+    import jax
+    return c - jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=c.dtype)
+
+
+def _getrf_np(a):
+    """Numpy Doolittle, in place (float32/float64 tile)."""
+    n = a.shape[0]
+    for i in range(n - 1):
+        a[i + 1:, i] /= a[i, i]
+        a[i + 1:, i + 1:] -= np.outer(a[i + 1:, i], a[i, i + 1:])
+    return a
+
+
+def build_getrf_nopiv(ctx: pt.Context, A: TwoDimBlockCyclic,
+                      dev: Optional[TpuDevice] = None,
+                      name: str = "A") -> pt.Taskpool:
+    """Build the LU-nopiv taskpool for square tiled `A` (registered with
+    ctx under `name`)."""
+    nt = A.mt
+    assert A.mt == A.nt and A.mb == A.nb
+    nb = A.mb
+    tp = pt.Taskpool(ctx, globals={"NT": nt - 1})
+    k, m, n = pt.L("k"), pt.L("m"), pt.L("n")
+    NT = pt.G("NT")
+    shp = (nb, nb)
+    dt = A.dtype
+
+    # ------------------------------------------------------------ GETRF(k)
+    gf = tp.task_class("GETRF")
+    gf.param("k", 0, NT)
+    gf.affinity(name, k, k)
+    gf.priority((NT - k) * 1000)
+    gf.flow("T", "RW",
+            pt.In(pt.Mem(name, k, k), guard=(k == 0)),
+            pt.In(pt.Ref("GEMM", k - 1, k, k, flow="C")),
+            pt.Out(pt.Ref("TRSM_L", k, pt.Range(k + 1, NT), flow="T"),
+                   guard=(k < NT)),
+            # NB: TRSM_U's declared param order is (k, m)
+            pt.Out(pt.Ref("TRSM_U", k, pt.Range(k + 1, NT), flow="T"),
+                   guard=(k < NT)),
+            pt.Out(pt.Mem(name, k, k)))
+
+    # --------------------------------------------------------- TRSM_L(k, n)
+    tl = tp.task_class("TRSM_L")
+    tl.param("k", 0, NT)
+    tl.param("n", k + 1, NT)
+    tl.affinity(name, k, n)
+    tl.priority((NT - k) * 1000 - n)
+    tl.flow("T", "READ", pt.In(pt.Ref("GETRF", k, flow="T")))
+    tl.flow("C", "RW",
+            pt.In(pt.Mem(name, k, n), guard=(k == 0)),
+            pt.In(pt.Ref("GEMM", k - 1, k, n, flow="C")),
+            pt.Out(pt.Ref("GEMM", k, pt.Range(k + 1, NT), n, flow="B")),
+            pt.Out(pt.Mem(name, k, n)))
+
+    # --------------------------------------------------------- TRSM_U(m, k)
+    tu = tp.task_class("TRSM_U")
+    tu.param("k", 0, NT)
+    tu.param("m", k + 1, NT)
+    tu.affinity(name, m, k)
+    tu.priority((NT - k) * 1000 - m)
+    tu.flow("T", "READ", pt.In(pt.Ref("GETRF", k, flow="T")))
+    tu.flow("C", "RW",
+            pt.In(pt.Mem(name, m, k), guard=(k == 0)),
+            pt.In(pt.Ref("GEMM", k - 1, m, k, flow="C")),
+            pt.Out(pt.Ref("GEMM", k, m, pt.Range(k + 1, NT), flow="A")),
+            pt.Out(pt.Mem(name, m, k)))
+
+    # -------------------------------------------------------- GEMM(k, m, n)
+    ge = tp.task_class("GEMM")
+    ge.param("k", 0, NT)
+    ge.param("m", k + 1, NT)
+    ge.param("n", k + 1, NT)
+    ge.affinity(name, m, n)
+    ge.priority((NT - k) * 1000 - m - n)
+    ge.flow("A", "READ", pt.In(pt.Ref("TRSM_U", k, m, flow="C")))
+    ge.flow("B", "READ", pt.In(pt.Ref("TRSM_L", k, n, flow="C")))
+    ge.flow("C", "RW",
+            pt.In(pt.Mem(name, m, n), guard=(k == 0)),
+            pt.In(pt.Ref("GEMM", k - 1, m, n, flow="C")),
+            pt.Out(pt.Ref("GETRF", k + 1, flow="T"),
+                   guard=(m == k + 1) & (n == k + 1)),
+            pt.Out(pt.Ref("TRSM_L", k + 1, n, flow="C"),
+                   guard=(m == k + 1) & (n > k + 1)),
+            pt.Out(pt.Ref("TRSM_U", k + 1, m, flow="C"),
+                   guard=(m > k + 1) & (n == k + 1)),
+            pt.Out(pt.Ref("GEMM", k + 1, m, n, flow="C"),
+                   guard=(m > k + 1) & (n > k + 1)))
+
+    # --------------------------------------------------------------- chores
+    for d in ([dev] if dev is not None and not isinstance(dev, (list, tuple))
+              else (dev or [])):
+        d.attach(gf, tp, kernel=k_getrf_nopiv, reads=["T"], writes=["T"],
+                 shapes={"T": shp}, dtype=dt)
+        d.attach(tl, tp, kernel=k_trsm_l, reads=["T", "C"], writes=["C"],
+                 shapes={"T": shp, "C": shp}, dtype=dt)
+        d.attach(tu, tp, kernel=k_trsm_u, reads=["T", "C"], writes=["C"],
+                 shapes={"T": shp, "C": shp}, dtype=dt)
+        d.attach(ge, tp, kernel=k_gemm_lu, reads=["A", "B", "C"],
+                 writes=["C"], shapes={"A": shp, "B": shp, "C": shp},
+                 dtype=dt)
+
+    def b_getrf(t):
+        _getrf_np(t.data("T", dt, shp))
+
+    def b_trsm_l(t):
+        l = np.tril(t.data("T", dt, shp), -1) + np.eye(nb, dtype=dt)
+        c = t.data("C", dt, shp)
+        c[...] = np.linalg.solve(l, c)
+
+    def b_trsm_u(t):
+        u = np.triu(t.data("T", dt, shp))
+        c = t.data("C", dt, shp)
+        c[...] = np.linalg.solve(u.T, c.T).T
+
+    def b_gemm(t):
+        a = t.data("A", dt, shp)
+        b = t.data("B", dt, shp)
+        c = t.data("C", dt, shp)
+        c -= a @ b
+
+    gf.body(b_getrf)
+    tl.body(b_trsm_l)
+    tu.body(b_trsm_u)
+    ge.body(b_gemm)
+    return tp
+
+
+def getrf_nopiv_reference(full: np.ndarray) -> np.ndarray:
+    """Float64 no-pivot LU of the dense matrix, packed L\\U (oracle)."""
+    a = full.astype(np.float64).copy()
+    return _getrf_np(a)
+
+
+def getrf_flops(N: int) -> float:
+    return 2.0 * N ** 3 / 3.0

@@ -1070,3 +1070,40 @@ def gemm_dist(rank: int, nodes: int, port: int, N: int = 64, nb: int = 8,
                 rdv
             assert rdv.get("registered_bytes", 0) == 0, rdv
         ctx.comm_fini()
+
+
+def getrf_dist(rank: int, nodes: int, port: int, N: int = 64, nb: int = 8):
+    """Distributed LU-nopiv over a PxQ block-cyclic grid: like potrf, all
+    collection reads are affine with placement, so the single-rank
+    taskpool runs distributed as-is — row/column panel flows cross ranks
+    on the remote-dep protocol (reference: dplasma dgetrf_nopiv over
+    two_dim_rectangle_cyclic)."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.algos.lu import build_getrf_nopiv, getrf_nopiv_reference
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    with ctx:
+        P = 2 if nodes % 2 == 0 else 1
+        Q = nodes // P
+        rng = np.random.default_rng(13)
+        full = (rng.normal(size=(N, N)) + N * np.eye(N)).astype(np.float32)
+        A = TwoDimBlockCyclic(N, N, nb, nb, P=P, Q=Q, nodes=nodes,
+                              myrank=rank, dtype=np.float32)
+        A.register(ctx, "A")
+        A.from_dense(full)
+        tp = build_getrf_nopiv(ctx, A)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        ref = getrf_nopiv_reference(full)
+        for m in range(A.mt):
+            for n in range(A.nt):
+                if A.rank_of(m, n) != rank:
+                    continue
+                np.testing.assert_allclose(
+                    A.tile(m, n),
+                    ref[m * nb:(m + 1) * nb, n * nb:(n + 1) * nb],
+                    rtol=3e-3, atol=3e-3)
+        st = ctx.comm_stats()
+        assert st["msgs_sent"] > 0, st
+        ctx.comm_fini()

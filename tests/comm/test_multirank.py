@@ -275,3 +275,13 @@ def test_gemm_dist_2ranks_device():
     ReadA/ReadB Ref flows feed device stage-in instead of Mem reads."""
     _run_spmd(_workers.gemm_dist, 2, timeout=240, N=64, nb=8,
               use_device=True)
+
+
+def test_getrf_dist_2ranks():
+    """Distributed LU-nopiv: row/column panel flows cross ranks (the
+    second dense-LA factorization through the runtime, after potrf)."""
+    _run_spmd(_workers.getrf_dist, 2, timeout=180, N=64, nb=8)
+
+
+def test_getrf_dist_4ranks():
+    _run_spmd(_workers.getrf_dist, 4, timeout=240, N=64, nb=8)
