@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Comm-engine bandwidth/latency microbench (reference roles:
+tests/apps/pingpong/bandwidth.jdf for the transport and
+tools/gpu/testbandwidth for the device staging path).
+
+Two SPMD processes over loopback TCP run a rank-hopping RW chain whose
+datum is a tile of the given size: each hop is one full payload transfer
+(eager inline, or GET rendezvous above the eager limit).  Reported per
+size: hop latency (wall / hops) and payload bandwidth.  With --device,
+the same chain runs with device chores so every hop additionally pays
+device stage-out/stage-in (the h2d/d2h testbandwidth role; uses the real
+chip when the tunnel is up, else the CPU jax backend).
+
+  python tools/testbandwidth.py                 # host path, 4K..16M
+  python tools/testbandwidth.py --sizes 1048576 --hops 64
+  python tools/testbandwidth.py --device
+"""
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _worker(rank, port, size, hops, device, q):
+    try:
+        import jax
+        if os.environ.get("JAX_PLATFORMS") == "cpu" or not device:
+            jax.config.update("jax_platforms", "cpu")
+        import parsec_tpu as pt
+
+        ctx = pt.Context(nb_workers=1)
+        ctx.set_rank(rank, 2)
+        ctx.comm_init(port)
+        elems = size // 4
+        arr = np.zeros((2, elems), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=size,
+                                       nodes=2, myrank=rank)
+        ctx.register_arena("t", size)
+        dev = None
+        if device:
+            from parsec_tpu.device import TpuDevice
+            dev = TpuDevice(ctx)
+        k = pt.L("k")
+
+        def build():
+            tp = pt.Taskpool(ctx, globals={"NB": hops})
+            tc = tp.task_class("Hop")
+            tc.param("k", 0, pt.G("NB"))
+            tc.affinity("A", k % 2)
+            tc.flow("A", "RW",
+                    pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                    pt.In(pt.Ref("Hop", k - 1, flow="A")),
+                    pt.Out(pt.Ref("Hop", k + 1, flow="A"),
+                           guard=(k < pt.G("NB"))),
+                    arena="t")
+            if dev is not None:
+                dev.attach(tc, tp, kernel=lambda x: x + 1.0, reads=["A"],
+                           writes=["A"], shapes={"A": (elems,)},
+                           dtype=np.float32)
+            tc.body_noop()
+            return tp
+
+        tp = build()  # warmup: connections + (device) compile
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        tp = build()
+        t0 = time.perf_counter()
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        dt = time.perf_counter() - t0
+        if dev is not None:
+            dev.stop()
+        ctx.comm_fini()
+        ctx.destroy()
+        q.put(("ok", rank, dt))
+    except Exception:
+        import traceback
+        q.put(("err", rank, traceback.format_exc()))
+
+
+def run_size(size, hops, port, device=False):
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    procs = [mpctx.Process(target=_worker,
+                           args=(r, port, size, hops, device, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        res = [q.get(timeout=900) for _ in range(2)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in res if r[0] != "ok"]
+    if errs:
+        raise RuntimeError(str(errs))
+    wall = max(r[2] for r in res)
+    return {
+        "size_bytes": size,
+        "hops": hops,
+        "hop_latency_us": round(wall / hops * 1e6, 2),
+        "bandwidth_gbps": round(size * hops / wall * 8 / 1e9, 3),
+        "path": "device" if device else "host",
+    }
+
+
+def main():
+    sizes = [4096, 65536, 1048576, 16777216]
+    hops = 32
+    device = "--device" in sys.argv
+    if "--sizes" in sys.argv:
+        sizes = [int(x) for x in
+                 sys.argv[sys.argv.index("--sizes") + 1].split(",")]
+    if "--hops" in sys.argv:
+        hops = int(sys.argv[sys.argv.index("--hops") + 1])
+    base = int(os.environ.get("PTC_PORT", "31300"))
+    for i, size in enumerate(sizes):
+        try:
+            print(json.dumps(run_size(size, hops, base + 2 * i,
+                                      device=device)), flush=True)
+        except Exception as e:
+            print(json.dumps({"size_bytes": size, "error": str(e)[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+au BufRead,BufNewFile *.jdf set filetype=jdf

@@ -1,0 +1,54 @@
+" Vim syntax for JDF (parameterized task graph) files as accepted by
+" parsec_tpu.dsl.jdf (reference role: tools/vim_syntax — written against
+" THIS front-end's grammar: parsec_tpu/dsl/jdf.py lexer + parser).
+"
+" Install:  cp -r tools/vim ~/.vim  (or add tools/vim to runtimepath)
+
+if exists("b:current_syntax")
+  finish
+endif
+
+" task structure
+syn keyword jdfKeyword BODY END NEW NULL
+syn keyword jdfAccess READ WRITE RW CTL R W
+syn match   jdfOption "^%option\>"
+
+" dependency arrows and the priority clause
+syn match jdfArrow "<-\|->"
+syn match jdfPriorityClause "^\s*;"
+
+" affinity line   : coll(expr, ...)
+syn match jdfAffinity "^\s*:\s*\w\+\s*("he=e-1
+
+" dep/task/global properties  [type = X hidden = on ...]
+syn region jdfProps start="\[" end="\]" contains=jdfPropKey,jdfString
+syn keyword jdfPropKey contained type type_remote type_data hidden default
+syn keyword jdfPropKey contained profile priority batch startup_fn
+syn keyword jdfPropKey contained make_key_fn hash_struct
+
+" inline escapes  %{ ... %}  (Python here, C in the reference)
+syn region jdfEscape start="%{" end="%}" keepend
+
+" ranges and numbers
+syn match jdfRange "\.\."
+syn match jdfNumber "\<\d\+\>"
+syn region jdfString start=+"+ end=+"+
+
+" comments (C and C++ style pass the lexer as whitespace)
+syn region jdfComment start="/\*" end="\*/"
+syn match  jdfComment "//.*$"
+
+hi def link jdfKeyword        Keyword
+hi def link jdfAccess         Type
+hi def link jdfOption         PreProc
+hi def link jdfArrow          Operator
+hi def link jdfPriorityClause Operator
+hi def link jdfAffinity       Identifier
+hi def link jdfPropKey        Special
+hi def link jdfEscape         Macro
+hi def link jdfRange          Operator
+hi def link jdfNumber         Number
+hi def link jdfString         String
+hi def link jdfComment        Comment
+
+let b:current_syntax = "jdf"
