@@ -1,0 +1,10 @@
+"""Shared helpers for the algorithm taskpools."""
+
+
+def as_device_list(dev):
+    """Normalize the dev argument (None | device | list/tuple) to a list."""
+    if dev is None:
+        return []
+    if isinstance(dev, (list, tuple)):
+        return list(dev)
+    return [dev]

@@ -25,6 +25,8 @@ import parsec_tpu as pt
 from ..data.collections import TwoDimBlockCyclic
 from ..device.tpu import TpuDevice
 
+from ._util import as_device_list
+
 
 # ---------------------------------------------------------------- kernels
 def k_getrf_nopiv(a):
@@ -151,8 +153,7 @@ def build_getrf_nopiv(ctx: pt.Context, A: TwoDimBlockCyclic,
                    guard=(m > k + 1) & (n > k + 1)))
 
     # --------------------------------------------------------------- chores
-    for d in ([dev] if dev is not None and not isinstance(dev, (list, tuple))
-              else (dev or [])):
+    for d in as_device_list(dev):
         d.attach(gf, tp, kernel=k_getrf_nopiv, reads=["T"], writes=["T"],
                  shapes={"T": shp}, dtype=dt)
         d.attach(tl, tp, kernel=k_trsm_l, reads=["T", "C"], writes=["C"],

@@ -21,6 +21,8 @@ import parsec_tpu as pt
 from ..data.collections import TwoDimBlockCyclic
 from ..device.tpu import TpuDevice
 
+from ._util import as_device_list
+
 
 # ---------------------------------------------------------------- kernels
 # module-level so their identity is stable: jax.jit keeps ONE compiled
@@ -127,8 +129,7 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     # best-device routing load-balances task instances across the queues
     # (reference: parsec_get_best_device, device.c:79-160), and sibling
     # mirrors stage D2D over the fabric
-    for d in ([dev] if dev is not None and not isinstance(dev, (list, tuple))
-              else (dev or [])):
+    for d in as_device_list(dev):
         d.attach(po, tp, kernel=k_potrf, reads=["T"], writes=["T"],
                  shapes={"T": shp}, dtype=dt)
         d.attach(tr, tp, kernel=k_trsm, reads=["L", "C"], writes=["C"],
@@ -170,8 +171,7 @@ def run_potrf(ctx, A, dev=None):
     tp = build_potrf(ctx, A, dev)
     tp.run()
     tp.wait()
-    devs = ([dev] if dev is not None and not isinstance(dev, (list, tuple))
-            else (dev or []))
+    devs = as_device_list(dev)
     for d in devs:
         d.flush()
 

@@ -1107,3 +1107,47 @@ def getrf_dist(rank: int, nodes: int, port: int, N: int = 64, nb: int = 8):
         st = ctx.comm_stats()
         assert st["msgs_sent"] > 0, st
         ctx.comm_fini()
+
+
+def trsm_dist(rank: int, nodes: int, port: int, N: int = 48, nb: int = 8,
+              nrhs: int = 16):
+    """Distributed triangular solve with L and B on DIFFERENT grids
+    (L on PxQ, B on 1xnodes): every ReadDiag/ReadL broadcast crosses
+    ranks to reach the solve/update rows — the reader-task pattern is
+    what makes mixed distributions legal at all."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.algos.trsm import build_trsm
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    with ctx:
+        P = 2 if nodes % 2 == 0 else 1
+        Q = nodes // P
+        rng = np.random.default_rng(17)
+        l = np.tril(rng.normal(size=(N, N))).astype(np.float32)
+        l += 2 * N * np.eye(N, dtype=np.float32)
+        b = rng.normal(size=(N, nrhs)).astype(np.float32)
+        L = TwoDimBlockCyclic(N, N, nb, nb, P=P, Q=Q, nodes=nodes,
+                              myrank=rank, dtype=np.float32)
+        B = TwoDimBlockCyclic(N, nrhs, nb, nb, P=1, Q=nodes, nodes=nodes,
+                              myrank=rank, dtype=np.float32)
+        L.register(ctx, "L")
+        B.register(ctx, "B")
+        L.from_dense(l)
+        B.from_dense(b)
+        tp = build_trsm(ctx, L, B)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        ref = np.linalg.solve(np.tril(l).astype(np.float64),
+                              b.astype(np.float64))
+        for m in range(B.mt):
+            for n in range(B.nt):
+                if B.rank_of(m, n) != rank:
+                    continue
+                np.testing.assert_allclose(
+                    B.tile(m, n),
+                    ref[m * nb:(m + 1) * nb, n * nb:(n + 1) * nb],
+                    rtol=2e-3, atol=2e-3)
+        st = ctx.comm_stats()
+        assert st["msgs_sent"] > 0, st
+        ctx.comm_fini()

@@ -285,3 +285,14 @@ def test_getrf_dist_2ranks():
 
 def test_getrf_dist_4ranks():
     _run_spmd(_workers.getrf_dist, 4, timeout=240, N=64, nb=8)
+
+
+def test_trsm_dist_2ranks():
+    """Distributed triangular solve with L and B on DIFFERENT grids:
+    reader broadcasts bridge the distributions (dtrsm over mixed
+    datadists, the reference's data_of/rank_of vtable point)."""
+    _run_spmd(_workers.trsm_dist, 2, timeout=180)
+
+
+def test_trsm_dist_4ranks():
+    _run_spmd(_workers.trsm_dist, 4, timeout=240)

@@ -10,7 +10,7 @@ endif
 
 " task structure
 syn keyword jdfKeyword BODY END NEW NULL
-syn keyword jdfAccess READ WRITE RW CTL R W
+syn keyword jdfAccess READ WRITE RW CTL
 syn match   jdfOption "^%option\>"
 
 " dependency arrows and the priority clause
