@@ -169,8 +169,10 @@ def bench_spotrf(N=16384, nb=1024, reps=2):
     from parsec_tpu.algos import potrf_flops
     profile = bool(os.environ.get("PTC_BENCH_PROFILE"))
     # warmup: compiles the 4 kernels at (nb, nb) + generator + small graph;
-    # 16*nb gives nt=16 so the batched buckets up to 16 pre-compile too
-    _potrf_once(16 * nb, nb, seed=1)
+    # 16*nb gives nt=16 so the batched buckets up to 16 pre-compile too.
+    # Never warm up BIGGER than the measured run (the N=4096 rung would
+    # otherwise pay an N=8192 warmup - slower than the rung itself).
+    _potrf_once(min(16 * nb, N), nb, seed=1)
     best = None
     resid = None
     for rep in range(reps):
@@ -403,8 +405,9 @@ def main():
     # The smallest rung leads with a TIGHT cap so a slow tunnel still
     # leaves budget to land it (two rounds running, rung-budget greed is
     # why no NB=512 number got captured).
-    ladder = [(8192, 512), (16384, 512), (32768, 512), (65536, 512)]
-    caps = [240, 360, 600, None]
+    ladder = [(4096, 512), (8192, 512), (16384, 512), (32768, 512),
+              (65536, 512)]
+    caps = [180, 240, 360, 600, None]
     if os.environ.get("PTC_BENCH_N"):
         ladder = [(int(os.environ["PTC_BENCH_N"]),
                    int(os.environ.get("PTC_BENCH_NB", "512")))]
