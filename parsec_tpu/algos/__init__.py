@@ -4,6 +4,7 @@ from .matrix_ops import (build_apply, build_map_operator, build_reduce_col,
                          build_reduce_row)
 from .potrf import build_potrf, potrf_flops, run_potrf
 from .redistribute import redistribute
+from .qr import build_geqrf, geqrf_flops
 from .trsm import build_trsm
 from .reshape import build_reshape_dtype, reshape_geometry
 
@@ -12,4 +13,5 @@ __all__ = ["build_gemm", "build_gemm_dist", "run_gemm",
            "build_potrf", "run_potrf",
            "potrf_flops", "build_apply", "build_map_operator",
            "build_reduce_col", "build_reduce_row", "redistribute",
-           "build_reshape_dtype", "reshape_geometry", "build_trsm"]
+           "build_reshape_dtype", "reshape_geometry", "build_trsm",
+           "build_geqrf", "geqrf_flops"]

@@ -296,3 +296,14 @@ def test_trsm_dist_2ranks():
 
 def test_trsm_dist_4ranks():
     _run_spmd(_workers.trsm_dist, 4, timeout=240)
+
+
+def test_geqrf_dist_2ranks():
+    """Distributed tiled QR (explicit-Q dgeqrf dataflow): panel/reflector
+    flows cross ranks; owned R tiles match the lapack oracle up to row
+    signs."""
+    _run_spmd(_workers.geqrf_dist, 2, timeout=240)
+
+
+def test_geqrf_dist_4ranks():
+    _run_spmd(_workers.geqrf_dist, 4, timeout=300)
