@@ -15,16 +15,36 @@ def main():
     if not os.path.exists(path):
         path = os.path.join(os.path.dirname(__file__), path)
     src = open(path).read()
+    reshape_demo = "Reshape" in os.path.basename(path)
     with pt.Context() as ctx:
-        buf = np.zeros(64, dtype=np.int64)
-        buf[0] = 300
-        ctx.register_linear_collection("mydata", buf, elem_size=8)
+        if reshape_demo:
+            # Ex08: NB+1 tiles of n x n int64; LOWER selects the lower
+            # triangle (incl. diagonal) of a row-major tile
+            n, nb_tiles = 4, 11
+            tile_bytes = n * n * 8
+            buf = np.ones(nb_tiles * n * n, dtype=np.int64)
+            ctx.register_linear_collection("descA", buf,
+                                           elem_size=tile_bytes)
+            ctx.register_datatype_indexed(
+                "LOWER", [(i * n * 8, (i + 1) * 8) for i in range(n)])
+        else:
+            buf = np.zeros(64, dtype=np.int64)
+            buf[0] = 300
+            ctx.register_linear_collection("mydata", buf, elem_size=8)
         ctx.register_arena("default", 64)
         b = compile_jdf(src, ctx, globals={"NB": 10, "N": 10},
                         dtype=np.int64,
                         arenas={"A": "default"})
         tp = b.run()
         tp.wait()
+        if reshape_demo:
+            tiles = buf.reshape(nb_tiles, n, n)
+            low = np.tril(np.ones((n, n), dtype=bool))
+            assert (tiles[:, low] == 0).all(), "lower zeroed"
+            assert (tiles[:, ~low] == 1).all(), "upper untouched"
+            conv, hits = ctx.reshape_stats()
+            print(f"reshape futures: {conv} conversions, {hits} hits; "
+                  "lower triangles zeroed, upper halves untouched")
     print("done;", tp.nb_total_tasks, "tasks")
 
 
