@@ -391,6 +391,10 @@ def main():
     # always gets its JSON line.
     import os
     import subprocess
+    # the opportunistic capture watcher (tools/tpu_watch.sh) may still be
+    # probing; the driver's run owns the chip — stop it first so two
+    # processes never contend for the tunnel
+    subprocess.run(["pkill", "-f", "tpu_watch"], capture_output=True)
     budget = int(os.environ.get("PTC_BENCH_TIMEOUT_S", "480"))
     probe_s = int(os.environ.get("PTC_BENCH_PROBE_S", "90"))
     deadline = time.monotonic() + budget
