@@ -392,9 +392,17 @@ def main():
     import os
     import subprocess
     # the opportunistic capture watcher (tools/tpu_watch.sh) may still be
-    # probing; the driver's run owns the chip — stop it first so two
-    # processes never contend for the tunnel
-    subprocess.run(["pkill", "-f", "tpu_watch"], capture_output=True)
+    # probing; the driver's run owns the chip — stop the watcher shell
+    # AND any in-flight bench child it spawned (their cmdlines don't
+    # contain 'tpu_watch'), best-effort: a host without procps must not
+    # lose the guaranteed fallback JSON line over this
+    try:
+        open("/tmp/tpu_watch.stop", "w").close()  # watcher exits next cycle
+        for pat in ("tools/tpu_watch.sh", "bench.py --spotrf-child",
+                    "bench.py --ring", "tools/bench_dataplane.py"):
+            subprocess.run(["pkill", "-f", pat], capture_output=True)
+    except Exception:
+        pass
     budget = int(os.environ.get("PTC_BENCH_TIMEOUT_S", "480"))
     probe_s = int(os.environ.get("PTC_BENCH_PROBE_S", "90"))
     deadline = time.monotonic() + budget

@@ -1202,3 +1202,74 @@ def geqrf_dist(rank: int, nodes: int, port: int, N: int = 48, nb: int = 8):
         st = ctx.comm_stats()
         assert st["msgs_sent"] > 0, st
         ctx.comm_fini()
+
+
+def jdf_ctlgat(rank: int, nodes: int, port: int, nt: int = 8):
+    """Ported ctlgat.jdf (reference tests/dsl/ptg/controlgather): TA(k)
+    and TB(k) run on rank k%nodes and their CTL flows gather into TC(0)
+    on rank 0 — pure cross-rank control dependencies (no payloads),
+    including the reference's `; 0` priority clause."""
+    from parsec_tpu.dsl.jdf import compile_jdf
+
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        buf = np.zeros(max(nodes, nt), dtype=np.int64)
+        ctx.register_linear_collection("A", buf, elem_size=8,
+                                       nodes=nodes, myrank=rank)
+        src = """
+NT [ type = int ]
+
+TA(k)
+k = 0 .. NT - 1
+: A(k)
+CTL X -> X TC(0)
+; 0
+BODY
+{
+ran.append(("TA", k))
+}
+END
+
+TB(k)
+k = 0 .. NT - 1
+: A(k)
+CTL X -> Y TC(0)
+; 0
+BODY
+{
+ran.append(("TB", k))
+}
+END
+
+TC(k)
+k = 0 .. 0
+: A(0)
+CTL X <- X TA(0 .. NT - 1)
+CTL Y <- X TB(0 .. NT - 1)
+; 0
+BODY
+{
+ran.append(("TC", k))
+}
+END
+"""
+        ran = []
+        b = compile_jdf(src, ctx, globals={"NT": nt}, dtype=np.int64,
+                        late_bound=["ran"])
+        b.scope["ran"] = ran
+        b.run().wait()
+        ctx.comm_fence()
+        mine_a = [("TA", k) for k in range(nt) if k % nodes == rank]
+        mine_b = [("TB", k) for k in range(nt) if k % nodes == rank]
+        got_ab = [x for x in ran if x[0] != "TC"]
+        assert sorted(got_ab) == sorted(mine_a + mine_b), (rank, ran)
+        if rank == 0:
+            assert ran.count(("TC", 0)) == 1, ran
+            # the gather fired LAST on this rank's local order for the
+            # producers rank 0 owns
+            idx = ran.index(("TC", 0))
+            assert all(i < idx for i, x in enumerate(ran)
+                       if x[0] != "TC"), ran
+        else:
+            assert ("TC", 0) not in ran, ran
+        ctx.comm_fini()

@@ -307,3 +307,13 @@ def test_geqrf_dist_2ranks():
 
 def test_geqrf_dist_4ranks():
     _run_spmd(_workers.geqrf_dist, 4, timeout=300)
+
+
+def test_jdf_ctlgat_2ranks():
+    """Ported ctlgat.jdf: cross-rank CTL gather (control-only
+    activations) through the JDF front-end."""
+    _run_spmd(_workers.jdf_ctlgat, 2)
+
+
+def test_jdf_ctlgat_4ranks():
+    _run_spmd(_workers.jdf_ctlgat, 4)
