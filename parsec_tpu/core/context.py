@@ -58,9 +58,16 @@ class Context:
             # same meaning as profile_enable(True): full tracing incl. EDGE
             N.lib.ptc_profile_enable(self._ptr, 2)
         self._pins_chain = None
+        # monitors/devices lists exist before any hook can install into
+        # them (the live monitor registers for teardown at construction)
+        self._devices: List = []  # TpuDevice instances (stopped on destroy)
+        self._monitors: List = []  # LiveMonitor instances
         if _mca.get("runtime.pins"):
             from ..profiling.pins import enable_from_param
             enable_from_param(self, _mca.get("runtime.pins"))
+        if _mca.get("runtime.live"):
+            from ..profiling.live import enable_from_param as _live
+            _live(self, _mca.get("runtime.live"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
         # per-subsystem debug streams (parsec/utils/debug.c analog)
@@ -77,7 +84,6 @@ class Context:
         self.collections: Dict[str, int] = {}
         self.arenas: Dict[str, int] = {}
         self.datatypes: Dict[str, int] = {}
-        self._devices: List = []  # TpuDevice instances (stopped on destroy)
         self._colocated: set = set()  # ranks sharing this accel client
         self._destroyed = False
 
@@ -94,6 +100,11 @@ class Context:
     def destroy(self):
         if not self._destroyed:
             self._destroyed = True
+            for mon in list(getattr(self, "_monitors", [])):
+                try:
+                    mon.stop()
+                except Exception:
+                    pass
             # stop device manager threads first: they block in
             # ptc_device_pop on queues owned by the native context
             for dev in list(getattr(self, "_devices", [])):

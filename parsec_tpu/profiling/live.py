@@ -1,0 +1,126 @@
+"""Live metrics monitor — the minimal aggregator_visu analog.
+
+Reference role: tools/aggregator_visu streams per-rank runtime counters
+out of a running job for live display.  TPU-native translation: a
+sampler thread snapshots the context's counters (worker selected-task
+counts, device queue depth / cache occupancy, comm volumes, rusage) at a
+fixed interval and appends one JSON line per sample to a sink — a file
+any dashboard, `tail -f`, or pandas can consume live.  Enable per
+process with `PTC_MCA_runtime_live=<interval_s>` or programmatically:
+
+    mon = LiveMonitor(ctx, path="/tmp/ptc_live_{rank}.jsonl", interval=1.0)
+    ... run taskpools ...
+    mon.stop()   # or it stops with the context
+
+The sink path is formatted with the context's rank at FIRST SAMPLE (not
+construction), so the env-installed monitor picks up set_rank() done by
+comm bring-up.  On shared hosts point `path` somewhere private — the
+default lives in /tmp for tail-ability, like the repo's other scratch
+sinks.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class LiveMonitor:
+    def __init__(self, ctx, path: str = "/tmp/ptc_live_{rank}.jsonl",
+                 interval: float = 1.0):
+        self.ctx = ctx
+        self._path_tmpl = path
+        self.path: Optional[str] = None  # resolved at first sample
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._t0 = time.time()
+        self._fh = None
+        self._write_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ptc-live-monitor")
+        self._thread.start()
+        # registered for teardown in its OWN list — _devices is the
+        # device-protocol fan-out (stage-in, coherence callbacks) and a
+        # monitor must never be visible there
+        ctx._monitors.append(self)
+
+    def stop(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # a wedged sample owns the file handle: do not race it
+            sys.stderr.write("ptc-live: sampler did not stop in 5s; "
+                             "leaving its file handle open\n")
+            return
+        try:
+            self._sample()  # final snapshot so short runs record something
+        except Exception:
+            pass
+        finally:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def _ensure_sink(self):
+        if self._fh is None:
+            self.path = self._path_tmpl.format(rank=self.ctx.myrank)
+            self._fh = open(self.path, "a", buffering=1)
+
+    def _sample(self):
+        ctx = self.ctx
+        rec = {
+            "t": round(time.time() - self._t0, 3),
+            "rank": ctx.myrank,
+            "workers": ctx.worker_stats(),
+        }
+        for i, dev in enumerate(ctx._devices):
+            if not hasattr(dev, "stats"):
+                continue
+            s = dev.stats
+            rec[f"dev{i}_tasks"] = s.get("tasks", 0)
+            rec[f"dev{i}_cache_bytes"] = s.get("cache_bytes", 0)
+            qid = getattr(dev, "qid", None)
+            if qid is not None:
+                rec[f"dev{i}_qdepth"] = ctx.device_queue_depth(qid)
+        if ctx.comm_enabled:
+            rec["comm"] = ctx.comm_stats()
+        ru = ctx.rusage()
+        rec["maxrss_kb"] = ru["maxrss_kb"]
+        rec["utime_s"] = ru["utime_s"]
+        with self._write_lock:
+            self._ensure_sink()
+            self._fh.write(json.dumps(rec) + "\n")
+
+    def _loop(self):
+        warned = False
+        while not self._stop.wait(self.interval):
+            if getattr(self.ctx, "_destroyed", False):
+                return
+            try:
+                self._sample()
+            except Exception as e:
+                # transient errors (device mid-teardown, full disk) must
+                # not silently end a multi-hour monitoring run
+                if not warned:
+                    warned = True
+                    sys.stderr.write(f"ptc-live: sample failed ({e!r}); "
+                                     "will keep trying\n")
+
+
+def enable_from_param(ctx, value) -> Optional[LiveMonitor]:
+    """`PTC_MCA_runtime_live=<seconds>` hook (Context.__init__)."""
+    try:
+        iv = float(value)
+    except (TypeError, ValueError):
+        sys.stderr.write(f"ptc-live: runtime.live={value!r} is not a "
+                         "number of seconds; monitoring disabled\n")
+        return None
+    if iv <= 0:
+        sys.stderr.write(f"ptc-live: runtime.live={value!r} must be a "
+                         "positive interval; monitoring disabled\n")
+        return None
+    return LiveMonitor(ctx, interval=iv)

@@ -167,6 +167,11 @@ register("runtime.bind", "none", str,
 register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")
 register("runtime.profile", False, bool, "enable event tracing at init")
+register("runtime.live", "", str,
+         "live metrics sampling interval in seconds (empty = off): a "
+         "sampler thread appends JSON counter snapshots to "
+         "/tmp/ptc_live_{rank}.jsonl (reference: the aggregator_visu "
+         "live-metrics role, minimal file-sink form)")
 register("runtime.pins", "", str,
          "comma-separated PINS instrumentation modules to install at init "
          "(reference: --mca pins <list>, parsec/mca/pins/pins.h); "
