@@ -109,6 +109,8 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
                                                wait_device_tiles)
     workers = int(os.environ.get("PTC_BENCH_WORKERS", "4"))
     cache_gb = int(os.environ.get("PTC_BENCH_CACHE_GB", "64"))
+    # batch-accumulate: one tunnel round trip per WAVE beats per-drain
+    os.environ.setdefault("PTC_DEVICE_BATCH_WAIT_MS", "5")
     with pt.Context(nb_workers=workers) as ctx:
         A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
         A.register(ctx, "A")

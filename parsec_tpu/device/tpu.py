@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -414,6 +415,14 @@ class TpuDevice:
         self.pipeline_depth = pipeline_depth
         # max tasks fused into one vmapped dispatch (power-of-two padded)
         self.batch_max = int(os.environ.get("PTC_DEVICE_BATCH", "128"))
+        # opt-in accumulate window: after a MULTI-task drain, keep
+        # sweeping for up to this long so a wave being released
+        # concurrently by workers lands in ONE dispatch — worth paying
+        # when per-dispatch cost is a tunnel round trip (bench sets it
+        # for spotrf; 0 = off, and single-task pops never wait, so
+        # latency-bound chains are unaffected)
+        self.batch_wait_ms = float(
+            os.environ.get("PTC_DEVICE_BATCH_WAIT_MS", "0"))
         self.bodies: Dict[Tuple[int, int], _DeviceBody] = {}
         self._dtd_bodies: Dict[int, _DeviceBody] = {}
         self._tp_by_ptr: Dict[int, Taskpool] = {}
@@ -771,6 +780,14 @@ class TpuDevice:
                 if not t2:
                     break
                 batch.append(t2)
+            if (len(batch) > 1 and self.batch_wait_ms > 0
+                    and len(batch) < self.batch_max):
+                deadline = time.monotonic() + self.batch_wait_ms / 1e3
+                while (len(batch) < self.batch_max
+                       and time.monotonic() < deadline):
+                    t2 = self.ctx.device_pop(self.qid, timeout_ms=1)
+                    if t2:
+                        batch.append(t2)
             if len(batch) == 1:
                 self._dispatch(task)
                 continue
