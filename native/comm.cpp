@@ -582,13 +582,19 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
     if (plen == alloc_len) {
       std::memcpy(copy->ptr, payload, (size_t)plen);
     } else if (device_uid == 0) {
-      /* by-reference payload that the device layer could not place: the
-       * copy would be garbage — flag loudly (contract: colocated peers
-       * run a device) */
-      std::fprintf(stderr, "ptc-comm: by-ref payload (%llu bytes) had no "
-                           "device to land on; data undefined\n",
-                   (unsigned long long)alloc_len);
-      std::memset(copy->ptr, 0, (size_t)alloc_len);
+      /* by-reference payload the device layer could not place (no
+       * device, or a transfer-plane pull failed): the REAL bytes were
+       * never sent, so there is nothing to fall back to — abort the
+       * pool instead of running consumers on garbage (round-4 review:
+       * a failed cross-process pull must be a hard failure) */
+      std::fprintf(stderr, "ptc-comm: by-ref payload (%llu bytes) could "
+                           "not land on a device; aborting taskpool %d — "
+                           "its consumers would compute on garbage\n",
+                   (unsigned long long)alloc_len, tp->id);
+      std::free(copy->ptr);
+      delete copy;
+      ptc_tp_abort_internal(ctx, tp);
+      return;
     }
     copy->shaped_as = shaped; /* wire form (pre-send reshape/pack), or -1 */
     /* data plane delivered this payload into the device cache too: stamp

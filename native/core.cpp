@@ -1614,6 +1614,13 @@ static void tp_abort(ptc_context *ctx, ptc_taskpool *tp) {
 /* -------- DTD task lifetime + completion -------- */
 } // namespace
 
+/* comm-layer entry to the abort path: an undeliverable by-ref payload
+ * (failed device placement / transfer pull) poisons the pool the same
+ * way a body error does — waiters observe the error instead of garbage */
+void ptc_tp_abort_internal(ptc_context *ctx, ptc_taskpool *tp) {
+  tp_abort(ctx, tp);
+}
+
 /* ---- paired-event trace (reference: parsec/profiling.c + the PINS hook
  * points of parsec/mca/pins/pins.h:26-54; format doc at PROF_WORDS).    */
 /* PINS: synchronous instrumentation callback chain at the event points

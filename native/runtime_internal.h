@@ -636,6 +636,11 @@ uint32_t ptc_collection_rank_of(ptc_context *ctx, int32_t dc_id,
 /* schedule a ready task (wakes idle workers) */
 void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t);
 
+/* abort a taskpool (body-error semantics: successors withheld, waiters
+ * observe the error) — used by the comm layer for undeliverable by-ref
+ * payloads */
+void ptc_tp_abort_internal(ptc_context *ctx, ptc_taskpool *tp);
+
 /* trace push (core.cpp): event = (key, phase, class, l0, l1, worker,
  * aux, t_ns); no-op unless profiling enabled */
 void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,

@@ -149,6 +149,110 @@ _DP_SERVING: Dict[int, object] = {}  # tag -> host bytes pinned during serve
 _DP_XFER: Dict[int, object] = {}
 _DP_REF_MAGIC = b"PTCDPRF1"
 
+# cross-PROCESS device transfer plane (jax.experimental.transfer): the
+# producer serves a token naming a pull uuid + its transfer server's
+# address; the consumer pulls the array device-to-device through the
+# transfer service (TCP bulk transport between hosts, DCN/pinned paths
+# on pods) — the payload bytes never exist on either HOST in this
+# runtime's buffers.  Opt-in (PTC_MCA_device_dp_transfer=1, set
+# uniformly across the SPMD job: the producer serves tokens assuming
+# every peer can pull).  Reference seam: transport-native payload
+# movement end to end, parsec_comm_engine.h:139-160 (SURVEY §7 #2).
+_DP_XFER_MAGIC = b"PTCDPXF1"
+_XFER_LOCK = threading.Lock()
+_XFER_STATE: Dict[str, object] = {"server": None, "failed": False,
+                                  "conns": {}, "next_uuid": 1}
+
+
+def _xfer_enabled() -> bool:
+    from ..utils import params as _mca
+    try:
+        return bool(_mca.get("device.dp_transfer"))
+    except KeyError:
+        return False
+
+
+def _xfer_server(client):
+    """Process-wide transfer server, lazily started for `client`; None
+    when the backend does not support it (byte path takes over)."""
+    with _XFER_LOCK:
+        if _XFER_STATE["failed"]:
+            return None
+        if _XFER_STATE["server"] is None:
+            try:
+                import jax.experimental.transfer as jxt
+                host = os.environ.get("PTC_DP_TRANSFER_HOST", "127.0.0.1")
+                _XFER_STATE["server"] = jxt.start_transfer_server(
+                    client, f"{host}:0", [f"{host}:0"])
+            except Exception as e:
+                import sys
+                sys.stderr.write(f"ptc-dp: transfer server unavailable "
+                                 f"({e!r}); device payloads fall back to "
+                                 "host bytes\n")
+                _XFER_STATE["failed"] = True
+                return None
+        return _XFER_STATE["server"]
+
+
+def _xfer_token(arr, raw: bool):
+    """Register `arr` for one pull and build the wire token, or None (the
+    d2h byte path takes over on ANY transfer-plane problem here — once
+    the token is on the wire there is no fallback, so failures must
+    happen on this side).  Known limitation: a registered pull the
+    consumer never completes (peer death between serve and pull) stays
+    pinned in the transfer server for the process lifetime — the server
+    API has no cancel; peer-loss reaping covers the comm-layer state
+    only."""
+    try:
+        client = next(iter(arr.sharding.device_set)).client
+        srv = _xfer_server(client)
+        if srv is None:
+            return None
+        with _XFER_LOCK:
+            uuid = _XFER_STATE["next_uuid"]
+            _XFER_STATE["next_uuid"] += 1
+        srv.await_pull(uuid, [arr])
+        addr = srv.address().encode()
+    except Exception as e:
+        import sys
+        sys.stderr.write(f"ptc-dp: transfer registration failed ({e!r}); "
+                         "serving host bytes\n")
+        return None
+    dt = np.dtype(arr.dtype).str.encode()
+    tok = (_DP_XFER_MAGIC + int(uuid).to_bytes(8, "little")
+           + bytes([1 if raw else 0, len(dt), len(arr.shape)])
+           + dt + b"".join(int(d).to_bytes(8, "little") for d in arr.shape)
+           + len(addr).to_bytes(2, "little") + addr)
+    return np.frombuffer(tok, dtype=np.uint8).copy()
+
+
+def _xfer_pull(raw_tok: bytes, device):
+    """Resolve a transfer token: pull the array onto `device`.  Returns
+    (array, raw_flag) or raises."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+    o = 8
+    uuid = int.from_bytes(raw_tok[o:o + 8], "little"); o += 8
+    rawf, dtlen, ndim = raw_tok[o], raw_tok[o + 1], raw_tok[o + 2]; o += 3
+    dt = np.dtype(raw_tok[o:o + dtlen].decode()); o += dtlen
+    shape = tuple(int.from_bytes(raw_tok[o + 8 * i:o + 8 * (i + 1)],
+                                 "little") for i in range(ndim))
+    o += 8 * ndim
+    alen = int.from_bytes(raw_tok[o:o + 2], "little"); o += 2
+    addr = raw_tok[o:o + alen].decode()
+    with _XFER_LOCK:
+        conn = _XFER_STATE["conns"].get(addr)
+    if conn is None:
+        srv = _xfer_server(device.client)
+        if srv is None:
+            raise RuntimeError("transfer plane unavailable on consumer")
+        conn = srv.connect(addr)
+        with _XFER_LOCK:
+            _XFER_STATE["conns"][addr] = conn
+    sds = jax.ShapeDtypeStruct(shape, dt,
+                               sharding=SingleDeviceSharding(device))
+    return conn.pull(uuid, [sds])[0], bool(rawf)
+
 
 def _make_dp_callbacks(ctx):
     """Per-context data-plane callbacks (closing over ctx._devices and
@@ -205,7 +309,13 @@ def _make_dp_callbacks(ctx):
                     _DP_REF_MAGIC + int(pull_id).to_bytes(8, "little"),
                     dtype=np.uint8).copy()
             else:
-                buf = np.ascontiguousarray(np.asarray(arr))
+                buf = None
+                if _xfer_enabled():
+                    # cross-process transfer plane: serve a token, the
+                    # consumer pulls device-to-device — no d2h here
+                    buf = _xfer_token(arr, bool(rec[3]))
+                if buf is None:
+                    buf = np.ascontiguousarray(np.asarray(arr))
             with _DP_LOCK:
                 _DP_SERVING[tag] = buf  # pin until serve_done
             ptr_out[0] = buf.ctypes.data
@@ -254,6 +364,15 @@ def _make_dp_callbacks(ctx):
                 # mirror stays raw (consumers reinterpret at stage-in)
                 dev._cache_put(uid, 0, darr, arr.nbytes, raw=was_raw)
                 dev.stats["dp_d2d_bytes"] += arr.nbytes
+                return uid
+            if size > 21 and raw[:8] == _DP_XFER_MAGIC:
+                # cross-process transfer token: pull device-to-device
+                # through the transfer service; the payload never touches
+                # this host's buffers
+                darr, was_raw = _xfer_pull(raw, dev.device)
+                uid = _next_uid()
+                dev._cache_put(uid, 0, darr, darr.nbytes, raw=was_raw)
+                dev.stats["dp_xfer_bytes"] += darr.nbytes
                 return uid
             host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
             darr = dev._jax.device_put(host, dev.device)
@@ -444,7 +563,7 @@ class TpuDevice:
         self.stats = {"tasks": 0, "h2d_bytes": 0, "d2h_bytes": 0,
                       "h2d_hits": 0, "evictions": 0, "dead_drops": 0,
                       "batches": 0, "batched_tasks": 0, "d2d_bytes": 0,
-                      "dp_sends": 0, "dp_d2d_bytes": 0,
+                      "dp_sends": 0, "dp_d2d_bytes": 0, "dp_xfer_bytes": 0,
                       "dp_recv_bytes": 0, "invalidations": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).

@@ -189,5 +189,15 @@ register("comm.eager_limit", 64 * 1024, int,
          "remote_dep_mpi.c:241-253); negative disables rendezvous")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
+register("device.dp_transfer", False, bool,
+         "cross-process device data plane via jax.experimental.transfer: "
+         "PK_DEVICE payloads between NON-colocated ranks are pulled "
+         "device-to-device through a transfer server instead of "
+         "d2h+TCP+h2d (set uniformly across the job - producers serve "
+         "pull tokens assuming every peer can pull, and a failed pull "
+         "ABORTS the consuming pool: the real bytes were never sent); "
+         "PTC_DP_TRANSFER_HOST picks the address tokens advertise - the "
+         "127.0.0.1 default only reaches same-host ranks, multi-host "
+         "jobs MUST set a routable NIC address")
 register("device.tpu_enabled", True, bool,
          "allow TPU device module (reference: --mca device_cuda_enabled)")

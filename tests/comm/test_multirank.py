@@ -116,6 +116,14 @@ def test_device_dataplane_2ranks():
     _run_spmd(_workers.device_dataplane, 2, timeout=180.0)
 
 
+def test_device_dataplane_transfer_2processes():
+    """Separate-PROCESS zero-host-copy device payload (VERDICT r3 #5):
+    the producer serves a jax.experimental.transfer pull token; the
+    consumer pulls the tile device-to-device through the transfer
+    service.  Neither process's host buffers ever hold the payload."""
+    _run_spmd(_workers.device_dataplane, 2, timeout=180.0, transfer=True)
+
+
 @pytest.mark.parametrize("nodes", [2, 4])
 def test_ptg_block_cyclic_scale(nodes):
     _run_spmd(_workers.ptg_block_cyclic_scale, nodes)
