@@ -29,7 +29,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def _worker(rank, nodes, port, mb, reps, q):
+def _worker(rank, nodes, port, mb, reps, q, transfer=False):
     try:
         import jax
         if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -40,6 +40,8 @@ def _worker(rank, nodes, port, mb, reps, q):
         from parsec_tpu.device import TpuDevice
 
         os.environ["PTC_MCA_comm_eager_limit"] = "65536"
+        if transfer:
+            os.environ["PTC_MCA_device_dp_transfer"] = "1"
         ctx = pt.Context(nb_workers=1)
         ctx.set_rank(rank, nodes)
         ctx.comm_init(port)
@@ -87,16 +89,20 @@ def _worker(rank, nodes, port, mb, reps, q):
         dev.stop()
         ctx.comm_fini()
         ctx.destroy()
-        q.put(("ok", rank, min(times), st["d2h_bytes"], st["h2d_bytes"]))
+        st["dp_xfer_bytes"] = (end.get("dp_xfer_bytes", 0)
+                               - base.get("dp_xfer_bytes", 0)) / reps
+        q.put(("ok", rank, min(times), st["d2h_bytes"], st["h2d_bytes"],
+               st["dp_xfer_bytes"]))
     except Exception:
         import traceback
-        q.put(("err", rank, traceback.format_exc(), 0, 0))
+        q.put(("err", rank, traceback.format_exc(), 0, 0, 0))
 
 
-def run_rung(mb, port, reps=3):
+def run_rung(mb, port, reps=3, transfer=False):
     mpctx = mp.get_context("spawn")
     q = mpctx.Queue()
-    procs = [mpctx.Process(target=_worker, args=(r, 2, port, mb, reps, q))
+    procs = [mpctx.Process(target=_worker,
+                           args=(r, 2, port, mb, reps, q, transfer))
              for r in range(2)]
     for p in procs:
         p.start()
@@ -114,14 +120,14 @@ def run_rung(mb, port, reps=3):
     if errs:
         raise RuntimeError(str(errs))
     wall = max(r[2] for r in res)  # transfer completes on the slower side
-    d2h = sum(r[3] for r in res)
-    h2d = sum(r[4] for r in res)
     return {
         "tile_mb": mb,
+        "path": "transfer" if transfer else "bytes",
         "xfer_ms": round(wall * 1e3, 2),
         "gbps": round(mb / 1024 / wall * 8, 3),
-        "d2h_bytes": d2h,
-        "h2d_bytes": h2d,
+        "d2h_bytes": sum(r[3] for r in res),
+        "h2d_bytes": sum(r[4] for r in res),
+        "dp_xfer_bytes": sum(r[5] for r in res),
     }
 
 
@@ -130,12 +136,18 @@ def main():
     if "--mb" in sys.argv:
         mbs = [int(sys.argv[sys.argv.index("--mb") + 1])]
     base = int(os.environ.get("PTC_PORT", "31100"))
-    for i, mb in enumerate(mbs):
-        try:
-            print(json.dumps(run_rung(mb, base + 2 * i)), flush=True)
-        except Exception as e:
-            print(json.dumps({"tile_mb": mb, "error": str(e)[:300]}),
-                  flush=True)
+    i = 0
+    for mb in mbs:
+        for transfer in (False, True):
+            try:
+                print(json.dumps(run_rung(mb, base + 2 * i,
+                                          transfer=transfer)), flush=True)
+            except Exception as e:
+                print(json.dumps({"tile_mb": mb,
+                                  "path": "transfer" if transfer
+                                  else "bytes",
+                                  "error": str(e)[:300]}), flush=True)
+            i += 1
 
 
 if __name__ == "__main__":
