@@ -426,6 +426,10 @@ static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
   Writer w{f};
   w.u64(src_handle);
   w.u64(cookie);
+  /* puller capability: may the producer serve a transfer-plane token
+   * instead of bytes?  (set by the device layer after its pull probe) */
+  w.u8((uint8_t)(ce->ctx->dp_can_pull.load(std::memory_order_relaxed)
+                     ? 1 : 0));
   frame_finish(f);
   ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
   comm_post(ce, from, std::move(f));
@@ -1030,6 +1034,9 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
   uint64_t src_handle = r.u64();
   uint64_t cookie = r.u64();
   if (!r.ok) return;
+  /* puller's transfer-plane capability (absent on pre-v2 frames → 0:
+   * bytes, the always-safe serve) */
+  uint8_t xfer_ok = (r.p < r.end) ? r.u8() : 0;
   std::vector<uint8_t> f = frame_begin(MSG_PUT_DATA);
   Writer w{f};
   w.u64(cookie);
@@ -1081,7 +1088,9 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     int64_t real = 0;
     int64_t tag = (int64_t)(src_handle & ~DP_HANDLE_FLAG);
     int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user, tag,
-                                              (int32_t)from, &ptr, &real)
+                                              (int32_t)from,
+                                              (int32_t)xfer_ok, &ptr,
+                                              &real)
                               : -1;
     if (n < 0 || !ptr) {
       std::fprintf(stderr, "ptc-comm: data plane could not serve tag "

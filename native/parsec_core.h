@@ -358,13 +358,19 @@ void ptc_set_copy_sync_cb(ptc_context_t *ctx, ptc_copy_sync_cb cb,
  * pair rides ICI instead of host TCP).
  *   dp_register(copy_handle, size) -> tag>0 if a device mirror exists
  *                                     (the payload source), else 0
- *   dp_serve(tag, from, &ptr, &real) -> wire byte size; ptr valid until
- *       dp_serve_done(tag).  `from` is the pulling rank: a colocated
- *       consumer (same process / same accelerator client) may be served
- *       a small by-reference token instead of the bytes — then `real`
- *       is set to the true payload size (the consumer-side copy is
- *       allocated at `real` and materialized lazily from the device
- *       mirror).  For byte serves, real == returned size.
+ *   dp_serve(tag, from, xfer_ok, &ptr, &real) -> wire byte size; ptr
+ *       valid until dp_serve_done(tag).  `from` is the pulling rank: a
+ *       colocated consumer (same process / same accelerator client) may
+ *       be served a small by-reference token instead of the bytes — then
+ *       `real` is set to the true payload size (the consumer-side copy
+ *       is allocated at `real` and materialized lazily from the device
+ *       mirror).  For byte serves, real == returned size.  `xfer_ok` is
+ *       the PULLER's advertised transfer-plane capability (carried on
+ *       the GET frame, set per-context via ptc_set_dp_can_pull after a
+ *       successful consumer-side probe): serve a cross-process transfer
+ *       token ONLY when it is nonzero — a token sent to a rank whose
+ *       accelerator runtime cannot pull is unrecoverable (the real
+ *       bytes were never sent).
  *   dp_deliver(ptr, size, tag) -> device-cache uid for the delivered
  *                                 payload (stamped on the new host copy)
  *   dp_bound(uid, ptr, size, host_valid) -> called after the consumer-
@@ -376,7 +382,8 @@ void ptc_set_copy_sync_cb(ptc_context_t *ctx, ptc_copy_sync_cb cb,
 typedef int64_t (*ptc_dp_register_cb)(void *user, int64_t copy_handle,
                                       int64_t version, int64_t size);
 typedef int64_t (*ptc_dp_serve_cb)(void *user, int64_t tag, int32_t from,
-                                   void **ptr_out, int64_t *real_out);
+                                   int32_t xfer_ok, void **ptr_out,
+                                   int64_t *real_out);
 typedef void (*ptc_dp_serve_done_cb)(void *user, int64_t tag);
 typedef int64_t (*ptc_dp_deliver_cb)(void *user, const void *ptr,
                                      int64_t size, int64_t tag);
@@ -386,6 +393,11 @@ void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,
                        ptc_dp_serve_cb serve, ptc_dp_serve_done_cb done,
                        ptc_dp_deliver_cb deliver, ptc_dp_bound_cb bound,
                        void *user);
+/* Advertise this rank's transfer-plane PULL capability on outgoing GET
+ * frames (0 until the device layer's probe succeeds).  Producers serve
+ * cross-process device tokens only to capable pullers; everyone else
+ * gets real bytes over the host path. */
+void ptc_set_dp_can_pull(ptc_context_t *ctx, int32_t ok);
 /* nonzero if the copy is backed by persistent user data (ptc_data_new),
  * zero for transient arena-backed copies */
 int32_t ptc_copy_is_persistent(ptc_copy_t *c);

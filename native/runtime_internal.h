@@ -558,6 +558,8 @@ struct ptc_context {
   ptc_dp_deliver_cb dp_deliver = nullptr;
   ptc_dp_bound_cb dp_bound = nullptr;
   void *dp_user = nullptr;
+  /* this rank's transfer-plane pull capability, stamped on GET frames */
+  std::atomic<int32_t> dp_can_pull{0};
 
   /* profiling */
   std::atomic<int32_t> prof_level{0}; /* 0 off, 1 spans, 2 +edges */

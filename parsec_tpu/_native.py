@@ -115,7 +115,8 @@ COPY_SYNC_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 DP_REGISTER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64, C.c_int64,
                                C.c_int64)
 DP_SERVE_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64, C.c_int32,
-                            C.POINTER(C.c_void_p), C.POINTER(C.c_int64))
+                            C.c_int32, C.POINTER(C.c_void_p),
+                            C.POINTER(C.c_int64))
 DP_SERVE_DONE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 DP_DELIVER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_void_p, C.c_int64,
                               C.c_int64)
@@ -191,6 +192,7 @@ _sigs = {
     "ptc_set_dataplane": (None, [C.c_void_p, DP_REGISTER_CB_T, DP_SERVE_CB_T,
                                  DP_SERVE_DONE_CB_T, DP_DELIVER_CB_T,
                                  DP_BOUND_CB_T, C.c_void_p]),
+    "ptc_set_dp_can_pull": (None, [C.c_void_p, C.c_int32]),
     "ptc_task_local": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_task_class": (C.c_int32, [C.c_void_p]),
     "ptc_task_priority": (C.c_int32, [C.c_void_p]),

@@ -154,10 +154,12 @@ _DP_REF_MAGIC = b"PTCDPRF1"
 # address; the consumer pulls the array device-to-device through the
 # transfer service (TCP bulk transport between hosts, DCN/pinned paths
 # on pods) — the payload bytes never exist on either HOST in this
-# runtime's buffers.  Opt-in (PTC_MCA_device_dp_transfer=1, set
-# uniformly across the SPMD job: the producer serves tokens assuming
-# every peer can pull).  Reference seam: transport-native payload
-# movement end to end, parsec_comm_engine.h:139-160 (SURVEY §7 #2).
+# runtime's buffers.  Opt-in (PTC_MCA_device_dp_transfer=1); each rank
+# probes its own pull path at device init (_xfer_can_pull) and
+# advertises the verdict on GET frames, so producers serve tokens only
+# to capable pullers — incapable ranks (PJRT plugins without async-h2d,
+# or device.dp_pull=0) get real bytes.  Reference seam: transport-native
+# payload movement end to end, parsec_comm_engine.h:139-160 (SURVEY §7 #2).
 _DP_XFER_MAGIC = b"PTCDPXF1"
 _XFER_LOCK = threading.Lock()
 _XFER_STATE: Dict[str, object] = {"server": None, "failed": False,
@@ -192,6 +194,59 @@ def _xfer_server(client):
                 _XFER_STATE["failed"] = True
                 return None
         return _XFER_STATE["server"]
+
+
+def _xfer_can_pull(client, device) -> bool:
+    """One-time consumer-side probe: can this process PULL through the
+    transfer plane?  Serves a tiny array to itself and pulls it back —
+    exercising the exact runtime path a remote token will need
+    (start_transfer_server + connect + CreateBuffersForAsyncHostToDevice,
+    which some PJRT plugins do not implement).  The verdict is advertised
+    to producers on GET frames via ptc_set_dp_can_pull; a False keeps
+    every payload on the always-safe byte path instead of aborting pools
+    at delivery time."""
+    from ..utils import params as _mca
+    try:
+        if not _mca.get("device.dp_pull"):
+            return False  # ops override: this rank refuses pulls
+    except KeyError:
+        pass
+    with _XFER_LOCK:
+        cached = _XFER_STATE.get("can_pull")
+    if cached is not None:
+        return bool(cached)
+    ok = False
+    try:
+        import jax
+        from jax.sharding import SingleDeviceSharding
+        srv = _xfer_server(client)
+        if srv is not None:
+            probe = jax.device_put(np.arange(4, dtype=np.float32), device)
+            with _XFER_LOCK:
+                uuid = _XFER_STATE["next_uuid"]
+                _XFER_STATE["next_uuid"] += 1
+            srv.await_pull(uuid, [probe])
+            addr = srv.address()
+            with _XFER_LOCK:
+                conn = _XFER_STATE["conns"].get(addr)
+            if conn is None:
+                conn = srv.connect(addr)
+                with _XFER_LOCK:
+                    # cache for _xfer_pull: tokens advertising this rank's
+                    # own server (loopback jobs) reuse the probe's conn
+                    _XFER_STATE["conns"][addr] = conn
+            sds = jax.ShapeDtypeStruct((4,), np.float32,
+                                       sharding=SingleDeviceSharding(device))
+            out = conn.pull(uuid, [sds])[0]
+            ok = bool(np.array_equal(np.asarray(out), np.arange(4)))
+    except Exception as e:
+        import sys
+        sys.stderr.write(f"ptc-dp: transfer-plane pull probe failed "
+                         f"({e!r}); this rank will request host bytes\n")
+        ok = False
+    with _XFER_LOCK:
+        _XFER_STATE["can_pull"] = ok
+    return ok
 
 
 def _xfer_token(arr, raw: bool):
@@ -287,7 +342,7 @@ def _make_dp_callbacks(ctx):
             traceback.print_exc()
             return 0  # host path takes over
 
-    def dp_serve(user, tag, from_rank, ptr_out, real_out) -> int:
+    def dp_serve(user, tag, from_rank, xfer_ok, ptr_out, real_out) -> int:
         """Produce one pull's wire bytes: the payload itself, or — for a
         colocated consumer — a by-reference token (the array is handed
         off in-process and the transfer rides the device fabric)."""
@@ -310,9 +365,11 @@ def _make_dp_callbacks(ctx):
                     dtype=np.uint8).copy()
             else:
                 buf = None
-                if _xfer_enabled():
+                if _xfer_enabled() and xfer_ok:
                     # cross-process transfer plane: serve a token, the
-                    # consumer pulls device-to-device — no d2h here
+                    # consumer pulls device-to-device — no d2h here.
+                    # Gated on the PULLER's probed capability (GET frame
+                    # bit): a token is unrecoverable if the pull fails
                     buf = _xfer_token(arr, bool(rec[3]))
                 if buf is None:
                     buf = np.ascontiguousarray(np.asarray(arr))
@@ -598,6 +655,11 @@ class TpuDevice:
                            N.DP_DELIVER_CB_T(dlv),
                            N.DP_BOUND_CB_T(bnd))
             N.lib.ptc_set_dataplane(ctx._ptr, *ctx._dp_cbs, None)
+            if _xfer_enabled():
+                # advertise pull capability to producers (GET-frame bit);
+                # probe once per process, stamp per context
+                ok = _xfer_can_pull(self.device.client, self.device)
+                N.lib.ptc_set_dp_can_pull(ctx._ptr, 1 if ok else 0)
         ctx._devices.append(self)  # stopped before the native ctx dies
         _ALL_DEVICES.append(self)
         self.start()

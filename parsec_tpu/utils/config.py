@@ -193,11 +193,20 @@ register("device.dp_transfer", False, bool,
          "cross-process device data plane via jax.experimental.transfer: "
          "PK_DEVICE payloads between NON-colocated ranks are pulled "
          "device-to-device through a transfer server instead of "
-         "d2h+TCP+h2d (set uniformly across the job - producers serve "
-         "pull tokens assuming every peer can pull, and a failed pull "
-         "ABORTS the consuming pool: the real bytes were never sent); "
-         "PTC_DP_TRANSFER_HOST picks the address tokens advertise - the "
-         "127.0.0.1 default only reaches same-host ranks, multi-host "
+         "d2h+TCP+h2d.  Platforms whose PJRT plugin cannot pull are "
+         "handled: each rank PROBES its own pull path at device init "
+         "and advertises the verdict on GET frames, so producers serve "
+         "tokens only to capable pullers and real bytes to everyone "
+         "else.  The probe does NOT cover address reachability: "
+         "PTC_DP_TRANSFER_HOST picks the address tokens advertise, the "
+         "127.0.0.1 default only reaches same-host ranks, and a pull "
+         "to an unroutable advertised address still ABORTS the "
+         "consuming pool (the real bytes were never sent) - multi-host "
          "jobs MUST set a routable NIC address")
+register("device.dp_pull", True, bool,
+         "this rank's willingness to PULL through the transfer plane; "
+         "set 0 to force producers to serve this rank host bytes even "
+         "when the probe would succeed (ops escape hatch per rank - "
+         "e.g. a rank behind a NAT the token addresses cannot cross)")
 register("device.tpu_enabled", True, bool,
          "allow TPU device module (reference: --mca device_cuda_enabled)")

@@ -317,7 +317,7 @@ def ptg_bcast_rendezvous_dedup(rank: int, nodes: int, port: int,
 
 
 def device_dataplane(rank: int, nodes: int, port: int, elems: int = 1024,
-                     transfer: bool = False):
+                     transfer: bool = False, no_pull: bool = False):
     """TPU-produced tile consumed by a device chore on another rank via the
     PK_DEVICE data plane: the producing host copy is never written (no
     d2h on rank 0) and the consumer stages nothing (no h2d on rank 1) —
@@ -337,6 +337,11 @@ def device_dataplane(rank: int, nodes: int, port: int, elems: int = 1024,
     os.environ["PTC_MCA_comm_eager_limit"] = "1024"
     if transfer:
         os.environ["PTC_MCA_device_dp_transfer"] = "1"
+    if no_pull and rank == 1:
+        # capability negotiation: this consumer declares itself unable to
+        # pull (the probed-incapable-PJRT shape); the producer must serve
+        # real bytes instead of a token
+        os.environ["PTC_MCA_device_dp_pull"] = "0"
     pt, ctx = _mk_ctx(rank, nodes, port, nb_workers=1)
     from parsec_tpu.device import TpuDevice
 
@@ -376,7 +381,13 @@ def device_dataplane(rank: int, nodes: int, port: int, elems: int = 1024,
             assert dev.stats["d2h_bytes"] == 0, dev.stats
             assert arr[0, 0] == 2.0, arr[0, 0]  # host tile untouched
         if rank == 1:
-            if transfer:
+            if transfer and no_pull:
+                # this consumer advertised itself pull-incapable on its
+                # GET frame: the producer fell back to real bytes — the
+                # pool completed instead of aborting on a doomed token
+                assert dev.stats.get("dp_recv_bytes", 0) == esize, dev.stats
+                assert dev.stats.get("dp_xfer_bytes", 0) == 0, dev.stats
+            elif transfer:
                 # the payload arrived ONLY through the transfer plane:
                 # device-to-device pull, zero host-byte delivery
                 assert dev.stats.get("dp_xfer_bytes", 0) == esize, dev.stats

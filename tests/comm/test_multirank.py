@@ -124,6 +124,16 @@ def test_device_dataplane_transfer_2processes():
     _run_spmd(_workers.device_dataplane, 2, timeout=180.0, transfer=True)
 
 
+def test_device_dataplane_transfer_pull_incapable_2processes():
+    """Capability negotiation on the transfer plane: the consumer's PJRT
+    runtime cannot pull (probe fails / device.dp_pull=0), so its GET
+    frames advertise xfer_ok=0 and the producer serves real bytes — the
+    job completes on the host path instead of aborting on a token the
+    consumer could never resolve (the r4 axon-tunnel failure shape)."""
+    _run_spmd(_workers.device_dataplane, 2, timeout=180.0, transfer=True,
+              no_pull=True)
+
+
 @pytest.mark.parametrize("nodes", [2, 4])
 def test_ptg_block_cyclic_scale(nodes):
     _run_spmd(_workers.ptg_block_cyclic_scale, nodes)

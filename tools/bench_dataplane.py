@@ -120,14 +120,18 @@ def run_rung(mb, port, reps=3, transfer=False):
     if errs:
         raise RuntimeError(str(errs))
     wall = max(r[2] for r in res)  # transfer completes on the slower side
+    xfer_b = sum(r[5] for r in res)
     return {
         "tile_mb": mb,
         "path": "transfer" if transfer else "bytes",
+        # what actually moved the payload: a pull-incapable PJRT (probe
+        # failed) degrades a requested transfer run to bytes — report it
+        "path_taken": "transfer" if xfer_b > 0 else "bytes",
         "xfer_ms": round(wall * 1e3, 2),
         "gbps": round(mb / 1024 / wall * 8, 3),
         "d2h_bytes": sum(r[3] for r in res),
         "h2d_bytes": sum(r[4] for r in res),
-        "dp_xfer_bytes": sum(r[5] for r in res),
+        "dp_xfer_bytes": xfer_b,
     }
 
 
