@@ -332,18 +332,38 @@ def _arg_after(flag, default):
     return default
 
 
-def _probe_tpu(timeout_s: int) -> bool:
+def _spotrf_fits(n: int, hbm_bytes: int):
+    """(fits, need_gib) for an fp32 spotrf rung: the matrix plus the
+    device tile cache is ~2x the matrix, plus slack."""
+    need = 2.2 * n * n * 4
+    return need <= hbm_bytes, need / 2 ** 30
+
+
+def _probe_tpu(timeout_s: int) -> int:
     """Cheap liveness check: the axon tunnel has multi-hour outages during
     which even jax.devices() hangs at backend init.  Probe in a subprocess
-    so a wedged backend cannot take the bench down with it."""
+    so a wedged backend cannot take the bench down with it.  Returns the
+    chip's HBM bytes_limit (so the ladder can skip rungs that cannot
+    fit — N=65536 fp32 is 17 GB of matrix alone, beyond a v5e's 16 GB),
+    a generic large number when the backend lacks memory_stats, or 0 when
+    the probe fails."""
     import subprocess
     try:
         r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]\n"
+             "try: s = d.memory_stats() or {}\n"
+             "except Exception: s = {}\n"
+             "print(s.get('bytes_limit', 1 << 62))"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if r.returncode != 0:
+            return 0
+        try:
+            return int((r.stdout or "").strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return 1 << 62
     except subprocess.TimeoutExpired:
-        return False
+        return 0
 
 
 def main():
@@ -370,8 +390,27 @@ def main():
                          d=_arg_after("--d", 128)))
         return 0
     if "--spotrf-child" in sys.argv:
+        import jax
         n = _arg_after("--n", 16384)
         nb = _arg_after("--nb", 1024)
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+        except Exception:
+            stats = {}  # plugin without memory stats: assume it fits
+        hbm = stats.get("bytes_limit", 1 << 62)
+        ok, need_gib = _spotrf_fits(n, hbm)
+        if not ok:
+            # a rung that cannot fit must not OOM-crash (a watcher would
+            # retry it forever): report the skip as a completed step
+            print(json.dumps({
+                "metric": "spotrf_gflops_per_chip", "value": None,
+                "unit": "GFLOP/s",
+                "skipped": f"N={n} fp32 needs ~{need_gib:.0f}"
+                           f" GiB, chip HBM is {hbm / 2**30:.0f} GiB",
+                "config": {"N": n, "NB": nb},
+                "chip_kind": getattr(jax.devices()[0], "device_kind", "?"),
+            }))
+            return 0
         chip, peak = _chip_info()
         gflops = bench_spotrf(n, nb)
         print(json.dumps({
@@ -408,7 +447,8 @@ def main():
     budget = int(os.environ.get("PTC_BENCH_TIMEOUT_S", "480"))
     probe_s = int(os.environ.get("PTC_BENCH_PROBE_S", "90"))
     deadline = time.monotonic() + budget
-    if not _probe_tpu(min(probe_s, budget)):
+    hbm = _probe_tpu(min(probe_s, budget))
+    if not hbm:
         sys.stderr.write(f"TPU probe failed within {probe_s}s "
                          "(axon tunnel down?); falling back to dispatch\n")
         print(_dispatch_json())
@@ -431,6 +471,14 @@ def main():
         remaining = deadline - time.monotonic()
         if remaining < 60:
             break
+        # rungs that cannot fit this chip's HBM are skipped, not
+        # crashed into
+        ok, need_gib = _spotrf_fits(n, hbm)
+        if not ok:
+            sys.stderr.write(f"spotrf rung N={n} skipped: needs "
+                             f"~{need_gib:.0f} GiB, chip "
+                             f"HBM is {hbm / 2**30:.0f} GiB\n")
+            continue
         if cap is not None:
             remaining = min(remaining, cap)
         try:
@@ -448,6 +496,9 @@ def main():
                                  f"(rc={r.returncode}): "
                                  f"{(r.stderr or '')[-400:]}\n")
                 break
+            if "\"skipped\"" in got:
+                sys.stderr.write(f"spotrf child N={n}: {got}\n")
+                continue
             best_line = got  # larger N supersedes: closer to BASELINE config
         except subprocess.TimeoutExpired:
             sys.stderr.write(f"spotrf child N={n} exceeded budget; "

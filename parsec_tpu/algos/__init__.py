@@ -1,4 +1,6 @@
 from .gemm import build_gemm, build_gemm_dist, run_gemm
+from .inverse import (build_lauum, build_trtri, lauum_flops, run_potri,
+                      trtri_flops)
 from .lu import build_getrf_nopiv, getrf_flops, getrf_nopiv_reference
 from .matrix_ops import (build_apply, build_map_operator, build_reduce_col,
                          build_reduce_row)
@@ -14,4 +16,6 @@ __all__ = ["build_gemm", "build_gemm_dist", "run_gemm",
            "potrf_flops", "build_apply", "build_map_operator",
            "build_reduce_col", "build_reduce_row", "redistribute",
            "build_reshape_dtype", "reshape_geometry", "build_trsm",
-           "build_geqrf", "geqrf_flops"]
+           "build_geqrf", "geqrf_flops",
+           "build_trtri", "build_lauum", "run_potri", "trtri_flops",
+           "lauum_flops"]

@@ -37,6 +37,28 @@ def k_trsm(l, c):
     return jax.scipy.linalg.solve_triangular(l, c.T, lower=True).T
 
 
+def k_potrf_inv(t):
+    """POTRF that also emits inv(L): ONE small triangular solve per panel
+    turns every TRSM in the panel's wave into a plain batched GEMM — the
+    MXU runs matmuls an order of magnitude faster than XLA's blocked
+    triangular solve runs on a whole wave of tiles (tools/
+    probe_la_kernels.py quantifies the gap per chip).  Standard
+    inversion-based TRSM practice from GPU dense LA, TPU-shaped."""
+    import jax
+    import jax.numpy as jnp
+    l = jnp.linalg.cholesky(t)
+    linv = jax.scipy.linalg.solve_triangular(
+        l, jnp.eye(t.shape[0], dtype=t.dtype), lower=True)
+    return l, linv
+
+
+def k_trsm_mm(linv, c):
+    """TRSM as GEMM: X L^T = C  ->  X = C inv(L)^T."""
+    import jax
+    return jax.lax.dot_general(c, linv, (((1,), (1,)), ((), ())),
+                               preferred_element_type=c.dtype)
+
+
 def k_syrk(a, t):
     import jax
     return t - jax.lax.dot_general(a, a, (((1,), (1,)), ((), ())),
@@ -51,9 +73,16 @@ def k_gemm(a, b, c):
 
 def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
                 dev: Optional[TpuDevice] = None,
-                name: str = "A") -> pt.Taskpool:
+                name: str = "A",
+                trsm_via_inverse: bool = True) -> pt.Taskpool:
     """Build the Cholesky taskpool for the square tiled SPD matrix `A`
-    (registered with ctx under `name`).  A.mt == A.nt required."""
+    (registered with ctx under `name`).  A.mt == A.nt required.
+
+    trsm_via_inverse (default): POTRF(k) additionally emits inv(L[k,k])
+    through a W temp flow and TRSM becomes a batched GEMM against it —
+    one extra NB-size triangular solve per PANEL instead of one per
+    TILE, and the whole TRSM wave rides the MXU.  Set False for the
+    textbook solve_triangular dataflow."""
     nt = A.mt
     assert A.mt == A.nt and A.mb == A.nb
     nb = A.mb
@@ -62,18 +91,32 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     NT = pt.G("NT")
     shp = (nb, nb)
     dt = A.dtype
+    if trsm_via_inverse:
+        li_arena = f"potrf_li_{nb}_{np.dtype(dt).str}"
+        ctx.register_arena(li_arena, nb * nb * np.dtype(dt).itemsize)
 
     # ------------------------------------------------------------- POTRF(k)
     po = tp.task_class("POTRF")
     po.param("k", 0, NT)
     po.affinity(name, k, k)
     po.priority((NT - k) * 1000)
-    po.flow("T", "RW",
-            pt.In(pt.Mem(name, k, k), guard=(k == 0)),
-            pt.In(pt.Ref("SYRK", k - 1, k, flow="T")),
-            pt.Out(pt.Ref("TRSM", k, pt.Range(k + 1, NT), flow="L"),
-                   guard=(k < NT)),
-            pt.Out(pt.Mem(name, k, k)))
+    if trsm_via_inverse:
+        po.flow("T", "RW",
+                pt.In(pt.Mem(name, k, k), guard=(k == 0)),
+                pt.In(pt.Ref("SYRK", k - 1, k, flow="T")),
+                pt.Out(pt.Mem(name, k, k)))
+        # the panel inverse: consumed by every TRSM in this panel's wave
+        po.flow("I", "W",
+                pt.Out(pt.Ref("TRSM", k, pt.Range(k + 1, NT), flow="L"),
+                       guard=(k < NT)),
+                arena=li_arena)
+    else:
+        po.flow("T", "RW",
+                pt.In(pt.Mem(name, k, k), guard=(k == 0)),
+                pt.In(pt.Ref("SYRK", k - 1, k, flow="T")),
+                pt.Out(pt.Ref("TRSM", k, pt.Range(k + 1, NT), flow="L"),
+                       guard=(k < NT)),
+                pt.Out(pt.Mem(name, k, k)))
 
     # ----------------------------------------------------------- TRSM(m, k)
     tr = tp.task_class("TRSM")
@@ -81,7 +124,9 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     tr.param("m", k + 1, NT)
     tr.affinity(name, m, k)
     tr.priority((NT - k) * 1000 - m)
-    tr.flow("L", "READ", pt.In(pt.Ref("POTRF", k, flow="T")))
+    tr.flow("L", "READ",
+            pt.In(pt.Ref("POTRF", k, flow="I" if trsm_via_inverse
+                         else "T")))
     # NB: GEMM's declared param order is (k, m, n) — Refs must match it
     tr.flow("C", "RW",
             pt.In(pt.Mem(name, m, k), guard=(k == 0)),
@@ -130,10 +175,17 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     # (reference: parsec_get_best_device, device.c:79-160), and sibling
     # mirrors stage D2D over the fabric
     for d in as_device_list(dev):
-        d.attach(po, tp, kernel=k_potrf, reads=["T"], writes=["T"],
-                 shapes={"T": shp}, dtype=dt)
-        d.attach(tr, tp, kernel=k_trsm, reads=["L", "C"], writes=["C"],
-                 shapes={"L": shp, "C": shp}, dtype=dt)
+        if trsm_via_inverse:
+            d.attach(po, tp, kernel=k_potrf_inv, reads=["T"],
+                     writes=["T", "I"], shapes={"T": shp, "I": shp},
+                     dtype=dt)
+            d.attach(tr, tp, kernel=k_trsm_mm, reads=["L", "C"],
+                     writes=["C"], shapes={"L": shp, "C": shp}, dtype=dt)
+        else:
+            d.attach(po, tp, kernel=k_potrf, reads=["T"], writes=["T"],
+                     shapes={"T": shp}, dtype=dt)
+            d.attach(tr, tp, kernel=k_trsm, reads=["L", "C"],
+                     writes=["C"], shapes={"L": shp, "C": shp}, dtype=dt)
         d.attach(sy, tp, kernel=k_syrk, reads=["A", "T"], writes=["T"],
                  shapes={"A": shp, "T": shp}, dtype=dt)
         d.attach(ge, tp, kernel=k_gemm, reads=["A", "B", "C"], writes=["C"],
@@ -142,12 +194,18 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     def b_potrf(t):
         a = t.data("T", dt, shp)
         a[...] = np.linalg.cholesky(a)
+        if trsm_via_inverse:
+            li = t.data("I", dt, shp)
+            li[...] = np.linalg.solve(a, np.eye(nb, dtype=dt))
 
     def b_trsm(t):
-        l = t.data("L", dt, shp)
+        l = t.data("L", dt, shp)  # inv(L) when trsm_via_inverse
         c = t.data("C", dt, shp)
-        # X L^T = C -> X = (L^-1 C^T)^T ; use lapack-free solve
-        c[...] = np.linalg.solve(l, c.T).T
+        if trsm_via_inverse:
+            c[...] = c @ l.T
+        else:
+            # X L^T = C -> X = (L^-1 C^T)^T ; use lapack-free solve
+            c[...] = np.linalg.solve(l, c.T).T
 
     def b_syrk(t):
         a = t.data("A", dt, shp)

@@ -1092,6 +1092,36 @@ class TpuDevice:
             idxs += [idxs[0]] * (bucket - len(idxs))
             return jnp.take(stack, jnp.asarray(idxs, dtype=jnp.int32),
                             axis=0)
+        if stacks and len(ents) > len(stacks) + 2:
+            # mixed sources (a wave split across batch windows feeds this
+            # group from several producer stacks): ONE take per source
+            # stack + one stack of the loose tiles + a permutation take,
+            # O(sources) device ops instead of O(tiles) slice ops — per-op
+            # dispatch is an RPC when a tunnel fronts the chip
+            by_stack = {}   # id -> (stack, [(orig_pos, row_idx)])
+            loose = []      # [(orig_pos, array)]
+            for pos, e in enumerate(ents):
+                if isinstance(e, _StackRef):
+                    by_stack.setdefault(id(e.stack), (e.stack, []))[1] \
+                        .append((pos, e.idx))
+                else:
+                    loose.append((pos, e))
+            parts, order = [], []
+            for stack, rows in by_stack.values():
+                parts.append(jnp.take(
+                    stack, jnp.asarray([r for _, r in rows],
+                                       dtype=jnp.int32), axis=0))
+                order.extend(p for p, _ in rows)
+            if loose:
+                parts.append(jnp.stack([a for _, a in loose]))
+                order.extend(p for p, _ in loose)
+            cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            perm = [0] * len(ents)
+            for cat_row, orig_pos in enumerate(order):
+                perm[orig_pos] = cat_row
+            perm += [perm[0]] * (bucket - len(perm))
+            return jnp.take(cat, jnp.asarray(perm, dtype=jnp.int32),
+                            axis=0)
         mats = [e.materialize() if isinstance(e, _StackRef) else e
                 for e in ents]
         mats += [mats[0]] * (bucket - len(mats))

@@ -13,9 +13,13 @@ def _spd(n, rng):
     return (x @ x.T + n * np.eye(n)).astype(np.float32)
 
 
+@pytest.mark.parametrize("via_inv", [True, False])
 @pytest.mark.parametrize("use_dev", [False, True])
 @pytest.mark.parametrize("N,nb", [(64, 16), (96, 32)])
-def test_potrf_matches_numpy(N, nb, use_dev):
+def test_potrf_matches_numpy(N, nb, use_dev, via_inv):
+    """Both TRSM dataflows: inversion-based (panel inverse riding a W
+    temp flow into batched GEMMs — the MXU-shaped default) and the
+    textbook per-tile triangular solve."""
     rng = np.random.default_rng(42)
     M = _spd(N, rng)
     with pt.Context(nb_workers=2) as ctx:
@@ -23,7 +27,7 @@ def test_potrf_matches_numpy(N, nb, use_dev):
         A.from_dense(M)
         A.register(ctx, "A")
         dev = TpuDevice(ctx) if use_dev else None
-        tp = build_potrf(ctx, A, dev=dev)
+        tp = build_potrf(ctx, A, dev=dev, trsm_via_inverse=via_inv)
         tp.run()
         tp.wait()
         if dev:

@@ -239,6 +239,12 @@ def test_potrf_2ranks_rendezvous():
     _run_spmd(_workers.potrf_dist, 2, timeout=240, N=512, nb=128)
 
 
+def test_trtri_2ranks():
+    """Distributed triangular inversion (dtrtri role): diagonal-inverse
+    broadcasts + column-chain GEMM flows cross the 2x1 grid."""
+    _run_spmd(_workers.trtri_dist, 2, timeout=180, N=64, nb=8)
+
+
 def test_unknown_comm_engine_falls_back_by_priority():
     _run_spmd(_workers.ptg_chain_bogus_engine, 2)
 
