@@ -111,6 +111,11 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
     cache_gb = int(os.environ.get("PTC_BENCH_CACHE_GB", "64"))
     # batch-accumulate: one tunnel round trip per WAVE beats per-drain
     os.environ.setdefault("PTC_DEVICE_BATCH_WAIT_MS", "5")
+    # wide batches keep whole waves in ONE stack: consumers then hit the
+    # single-take gather path and launches stay O(waves), not O(tasks).
+    # 512 tiles x 4 flows x 1 MiB = 2 GiB transient - fits every chip
+    # the ladder admits
+    os.environ.setdefault("PTC_DEVICE_BATCH", "512")
     with pt.Context(nb_workers=workers) as ctx:
         A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
         A.register(ctx, "A")

@@ -525,6 +525,51 @@ def local_tile_index(coll):
     return out
 
 
+def grouped_stack(jnp, ents, bucket=None):
+    """One stacked (bucket, *tile) device array from per-tile entries
+    (concrete arrays or _StackRefs), in O(source stacks) device ops
+    instead of O(tiles) slice ops — per-op dispatch is an RPC when a
+    tunnel fronts the chip.  Rows past len(ents) are padding (row 0
+    repeated).  Shared by the batched dispatch gather and the bench
+    tile gather."""
+    bucket = bucket or len(ents)
+    stacks = {id(e.stack) for e in ents if isinstance(e, _StackRef)}
+    if len(stacks) == 1 and all(isinstance(e, _StackRef) for e in ents):
+        stack = ents[0].stack
+        idxs = [e.idx for e in ents]
+        idxs += [idxs[0]] * (bucket - len(idxs))
+        return jnp.take(stack, jnp.asarray(idxs, dtype=jnp.int32),
+                        axis=0)
+    if stacks and len(ents) > len(stacks) + 2:
+        by_stack = {}   # id -> (stack, [(orig_pos, row_idx)])
+        loose = []      # [(orig_pos, array)]
+        for pos, e in enumerate(ents):
+            if isinstance(e, _StackRef):
+                by_stack.setdefault(id(e.stack), (e.stack, []))[1] \
+                    .append((pos, e.idx))
+            else:
+                loose.append((pos, e))
+        parts, order = [], []
+        for stack, rows in by_stack.values():
+            parts.append(jnp.take(
+                stack, jnp.asarray([r for _, r in rows],
+                                   dtype=jnp.int32), axis=0))
+            order.extend(p for p, _ in rows)
+        if loose:
+            parts.append(jnp.stack([a for _, a in loose]))
+            order.extend(p for p, _ in loose)
+        cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        perm = [0] * len(ents)
+        for cat_row, orig_pos in enumerate(order):
+            perm[orig_pos] = cat_row
+        perm += [perm[0]] * (bucket - len(perm))
+        return jnp.take(cat, jnp.asarray(perm, dtype=jnp.int32), axis=0)
+    mats = [e.materialize() if isinstance(e, _StackRef) else e
+            for e in ents]
+    mats += [mats[0]] * (bucket - len(mats))
+    return jnp.stack(mats)
+
+
 def _conc(ent: "_CacheEnt"):
     """Concrete device array for a cache entry, slicing a _StackRef out of
     its batch stack on first use (memoized; benign if raced)."""
@@ -746,14 +791,6 @@ class TpuDevice:
                 if ent is not None:
                     sib._uncharge(ent)
                     sib.stats["invalidations"] += 1
-
-    def _cache_get(self, uid, version) -> Optional[object]:
-        with self._lock:
-            ent = self._cache.get(uid)
-            if ent is not None and ent.version == version:
-                self._cache.move_to_end(uid)
-                return _conc(ent)
-        return None
 
     def _cache_ent(self, uid, version) -> Optional["_CacheEnt"]:
         """Entry lookup without materializing _StackRefs (batched stage-in
@@ -1085,47 +1122,7 @@ class TpuDevice:
             else:
                 self.stats["h2d_hits"] += 1
                 ents.append(ent.arr)  # may be a _StackRef: resolved below
-        stacks = {id(e.stack) for e in ents if isinstance(e, _StackRef)}
-        if len(stacks) == 1 and all(isinstance(e, _StackRef) for e in ents):
-            stack = ents[0].stack
-            idxs = [e.idx for e in ents]
-            idxs += [idxs[0]] * (bucket - len(idxs))
-            return jnp.take(stack, jnp.asarray(idxs, dtype=jnp.int32),
-                            axis=0)
-        if stacks and len(ents) > len(stacks) + 2:
-            # mixed sources (a wave split across batch windows feeds this
-            # group from several producer stacks): ONE take per source
-            # stack + one stack of the loose tiles + a permutation take,
-            # O(sources) device ops instead of O(tiles) slice ops — per-op
-            # dispatch is an RPC when a tunnel fronts the chip
-            by_stack = {}   # id -> (stack, [(orig_pos, row_idx)])
-            loose = []      # [(orig_pos, array)]
-            for pos, e in enumerate(ents):
-                if isinstance(e, _StackRef):
-                    by_stack.setdefault(id(e.stack), (e.stack, []))[1] \
-                        .append((pos, e.idx))
-                else:
-                    loose.append((pos, e))
-            parts, order = [], []
-            for stack, rows in by_stack.values():
-                parts.append(jnp.take(
-                    stack, jnp.asarray([r for _, r in rows],
-                                       dtype=jnp.int32), axis=0))
-                order.extend(p for p, _ in rows)
-            if loose:
-                parts.append(jnp.stack([a for _, a in loose]))
-                order.extend(p for p, _ in loose)
-            cat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-            perm = [0] * len(ents)
-            for cat_row, orig_pos in enumerate(order):
-                perm[orig_pos] = cat_row
-            perm += [perm[0]] * (bucket - len(perm))
-            return jnp.take(cat, jnp.asarray(perm, dtype=jnp.int32),
-                            axis=0)
-        mats = [e.materialize() if isinstance(e, _StackRef) else e
-                for e in ents]
-        mats += [mats[0]] * (bucket - len(mats))
-        return jnp.stack(mats)
+        return grouped_stack(jnp, ents, bucket)
 
     def _write_out(self, view, body: _DeviceBody, flow, arr, res) -> None:
         """Install one task's output in the cache (and, for mem-out flows
