@@ -148,11 +148,7 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
             # skip honestly rather than OOM-crash the tunnel client; the
             # smaller rungs and the test suite carry the correctness
             # evidence
-            try:
-                stats = dev.device.memory_stats() or {}
-            except Exception:
-                stats = {}
-            hbm = stats.get("bytes_limit", 1 << 62)
+            hbm = _device_hbm(dev.device)
             if 7.0 * N * N * 4 <= hbm:
                 resid = potrf_residual(dev, A, a_stacked)
             else:
@@ -358,6 +354,30 @@ def _arg_after(flag, default):
     return default
 
 
+# per-chip-kind HBM GiB, matched as substrings of device_kind (ordered:
+# first hit wins) — the fallback when the PJRT plugin's memory_stats
+# returns nothing (the axon plugin returns None)
+_HBM_GIB_BY_KIND = (("v5 lite", 16), ("v5e", 16), ("v5p", 95),
+                    ("v6 lite", 32), ("v6e", 32), ("v4", 32), ("v3", 16))
+
+
+def _device_hbm(d) -> int:
+    """Usable accelerator memory in bytes: PJRT memory_stats when the
+    plugin implements it, else the per-chip-kind table, else a huge
+    fail-open sentinel."""
+    try:
+        stats = d.memory_stats() or {}
+    except Exception:
+        stats = {}
+    if stats.get("bytes_limit"):
+        return int(stats["bytes_limit"])
+    kind = getattr(d, "device_kind", "").lower()
+    for tag, gib in _HBM_GIB_BY_KIND:
+        if tag in kind:
+            return gib << 30
+    return 1 << 62
+
+
 def _spotrf_fits(n: int, hbm_bytes: int):
     """(fits, need_gib) for an fp32 spotrf rung: the matrix plus the
     device tile cache is ~2x the matrix, plus slack."""
@@ -374,14 +394,25 @@ def _probe_tpu(timeout_s: int) -> int:
     a generic large number when the backend lacks memory_stats, or 0 when
     the probe fails."""
     import subprocess
+    # self-contained child snippet (imports jax ONLY — a heavier import
+    # failing or slowing in the child must not report a live TPU dead);
+    # the kind table is interpolated from the single module constant
+    snippet = (
+        "import jax\n"
+        "d = jax.devices()[0]\n"
+        "try: s = d.memory_stats() or {}\n"
+        "except Exception: s = {}\n"
+        "v = int(s.get('bytes_limit') or 0)\n"
+        "if not v:\n"
+        "    k = getattr(d, 'device_kind', '').lower()\n"
+        f"    for t, g in {_HBM_GIB_BY_KIND!r}:\n"
+        "        if t in k:\n"
+        "            v = g << 30; break\n"
+        "print(v or 1 << 62)\n")
     try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices()[0]\n"
-             "try: s = d.memory_stats() or {}\n"
-             "except Exception: s = {}\n"
-             "print(s.get('bytes_limit', 1 << 62))"],
-            timeout=timeout_s, capture_output=True, text=True)
+        r = subprocess.run([sys.executable, "-c", snippet],
+                           timeout=timeout_s, capture_output=True,
+                           text=True)
         if r.returncode != 0:
             return 0
         try:
@@ -419,11 +450,7 @@ def main():
         import jax
         n = _arg_after("--n", 16384)
         nb = _arg_after("--nb", 1024)
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-        except Exception:
-            stats = {}  # plugin without memory stats: assume it fits
-        hbm = stats.get("bytes_limit", 1 << 62)
+        hbm = _device_hbm(jax.devices()[0])
         ok, need_gib = _spotrf_fits(n, hbm)
         if not ok:
             # a rung that cannot fit must not OOM-crash (a watcher would
