@@ -108,7 +108,7 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
                                                potrf_residual,
                                                wait_device_tiles)
     workers = int(os.environ.get("PTC_BENCH_WORKERS", "4"))
-    cache_gb = int(os.environ.get("PTC_BENCH_CACHE_GB", "64"))
+    cache_gb = os.environ.get("PTC_BENCH_CACHE_GB")
     # batch-accumulate: one tunnel round trip per WAVE beats per-drain
     os.environ.setdefault("PTC_DEVICE_BATCH_WAIT_MS", "5")
     # wide batches keep whole waves in ONE stack: consumers then hit the
@@ -119,7 +119,18 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
     with pt.Context(nb_workers=workers) as ctx:
         A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
         A.register(ctx, "A")
-        dev = TpuDevice(ctx, cache_bytes=cache_gb << 30)
+        if cache_gb is not None:
+            cache_bytes = int(cache_gb) << 30
+        else:
+            # budget the tile cache from PHYSICAL HBM: the generator's
+            # stacked A plus batch transients and XLA workspace need
+            # their share, and a budget above HBM means dead tile
+            # versions never evict (the r4 N=32768 rep-2 OOM).  The LRU
+            # then retires superseded stacks as the factorization walks
+            import jax
+            hbm = _device_hbm(jax.devices()[0])
+            cache_bytes = max(2 << 30, int(hbm - N * N * 4 - (3 << 30)))
+        dev = TpuDevice(ctx, cache_bytes=cache_bytes)
         t_g0 = time.perf_counter()
         a_stacked = generate_spd_on_device(dev, A, seed=seed)
         a_stacked.block_until_ready()
@@ -159,7 +170,12 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
                     f"{hbm / 2**30:.0f} GiB - skipped (verified at "
                     "smaller rungs)\n")
         dev.stop()
-        return dt, resid
+    # the context/device just left scope: collect NOW so the next rep's
+    # allocations don't race the old rep's uncollected device arrays
+    # (ctypes-callback cycles keep them alive past the with-block)
+    import gc
+    gc.collect()
+    return dt, resid
 
 
 def _chip_info():

@@ -898,7 +898,11 @@ class TpuDevice:
         for uid, ent in dirty:
             by_shape.setdefault(tuple(ent.host.shape), []).append(ent)
         for shape, ents in by_shape.items():
-            stacked = np.asarray(jnp.stack([_conc(e) for e in ents]))
+            # grouped takes, not per-tile slices: flushing N tiles must
+            # cost O(source stacks) device ops + one d2h, not N eager
+            # slice RPCs (a 4096-tile flush segfaulted the tunnel client)
+            stacked = np.asarray(
+                grouped_stack(jnp, [e.arr for e in ents]))
             for e, res in zip(ents, stacked):
                 _host_write(e, res)
                 self.stats["d2h_bytes"] += res.nbytes
@@ -976,8 +980,23 @@ class TpuDevice:
         if self._thread:
             self._thread.join(timeout=30)
             self._thread = None
+        # second flush AFTER the join: a task completing between the
+        # first flush's dirty snapshot and manager exit would otherwise
+        # be discarded by the clear below (cheap when nothing new)
+        self.flush()
         if self in _ALL_DEVICES:
             _ALL_DEVICES.remove(self)
+        # release the HBM now: the device object itself often survives in
+        # ctx/callback reference cycles until a GC pass, and a stopped
+        # device's mirrors are dead weight (the flushes made the host
+        # authoritative).  _stacks holds the strong refs to the batch
+        # stacks — the multi-GiB allocations — so it must clear too.
+        # Back-to-back runs on one chip otherwise OOM on the previous
+        # run's stacks (r4 N=32768 rep-2).
+        with self._lock:
+            self._cache.clear()
+            self._stacks.clear()
+            self._cache_used = 0
 
     def _manager(self):
         """Dispatch loop.  XLA queues kernels asynchronously, so completing
