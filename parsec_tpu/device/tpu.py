@@ -87,6 +87,7 @@ _JIT_CACHE: Dict[object, Callable] = {}
 # (kernel, bucket size, tile shape/dtype); bucket padding (powers of two)
 # keeps the number of compiles logarithmic in the max batch.
 _VMAP_CACHE: Dict[object, Callable] = {}
+_FUSED_CACHE: Dict[object, Callable] = {}
 
 # live devices, for copy-handle coherence sync (handles are stamped only by
 # devices, so a zero handle short-circuits before ever reaching this).
@@ -486,6 +487,53 @@ def _get_vmapped(jax_mod, kernel: Callable) -> Callable:
     return j
 
 
+def _get_fused(jax_mod, kernel: Callable, sig: tuple, single: bool):
+    """One jitted program fusing the per-flow gathers INTO the kernel
+    call.  `sig[i]` says whether read flow i arrives as (stack, idx) —
+    gathered inside the program — or as an already-shaped array.  Per-op
+    dispatch is a network round trip when a tunnel fronts the chip, so a
+    wave that used to cost one `take` per flow plus the exec collapses
+    to ONE dispatch.  `single=True` wraps the unbatched kernel (scalar
+    idx selects one row); False wraps vmap(kernel) over stacked rows.
+
+    A sig with nothing to fuse reuses the plain jitted/vmapped program
+    (same cache `warm()` pre-compiles into)."""
+    if not any(sig):
+        return (_get_jitted if single else _get_vmapped)(jax_mod, kernel)
+    key = (kernel, sig, single)
+    f = _FUSED_CACHE.get(key)
+    if f is None:
+        jnp = jax_mod.numpy
+        core = kernel if single else jax_mod.vmap(kernel)
+
+        def fused(*args):
+            ins, ai = [], 0
+            for indexed in sig:
+                if indexed:
+                    ins.append(jnp.take(args[ai], args[ai + 1], axis=0))
+                    ai += 2
+                else:
+                    ins.append(args[ai])
+                    ai += 1
+            return core(*ins)
+
+        f = jax_mod.jit(fused)
+        _FUSED_CACHE[key] = f
+    return f
+
+
+def _single_stack(ents):
+    """(stack, row_idxs) when every entry is a lazy slice of ONE source
+    stack — the gather can then ride inside the fused program — else
+    None.  Shared by grouped_stack's eager fast path and the fused
+    dispatcher so padding/identity semantics cannot diverge."""
+    if not ents or not all(isinstance(e, _StackRef) for e in ents):
+        return None
+    if len({id(e.stack) for e in ents}) != 1:
+        return None
+    return ents[0].stack, [e.idx for e in ents]
+
+
 def _bucket(n: int) -> int:
     """Round a batch size up to a power of two: stacked shapes then come
     from a log-bounded set, so XLA compiles each batched kernel O(log B)
@@ -533,13 +581,13 @@ def grouped_stack(jnp, ents, bucket=None):
     repeated).  Shared by the batched dispatch gather and the bench
     tile gather."""
     bucket = bucket or len(ents)
-    stacks = {id(e.stack) for e in ents if isinstance(e, _StackRef)}
-    if len(stacks) == 1 and all(isinstance(e, _StackRef) for e in ents):
-        stack = ents[0].stack
-        idxs = [e.idx for e in ents]
+    one = _single_stack(ents)
+    if one is not None:
+        stack, idxs = one
         idxs += [idxs[0]] * (bucket - len(idxs))
         return jnp.take(stack, jnp.asarray(idxs, dtype=jnp.int32),
                         axis=0)
+    stacks = {id(e.stack) for e in ents if isinstance(e, _StackRef)}
     if stacks and len(ents) > len(stacks) + 2:
         by_stack = {}   # id -> (stack, [(orig_pos, row_idx)])
         loose = []      # [(orig_pos, array)]
@@ -666,7 +714,8 @@ class TpuDevice:
                       "h2d_hits": 0, "evictions": 0, "dead_drops": 0,
                       "batches": 0, "batched_tasks": 0, "d2d_bytes": 0,
                       "dp_sends": 0, "dp_d2d_bytes": 0, "dp_xfer_bytes": 0,
-                      "dp_recv_bytes": 0, "invalidations": 0}
+                      "dp_recv_bytes": 0, "invalidations": 0,
+                      "eager_gathers": 0, "fused_flows": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -1125,12 +1174,10 @@ class TpuDevice:
         cptr = N.lib.ptc_task_copy(view._ptr, fi)
         return cptr, self._copy_uid(cptr), N.lib.ptc_copy_version(cptr)
 
-    def _gather_flow(self, views, body, flow, bucket):
-        """Stage one read flow for a whole group as a stacked device array
-        (padded to `bucket` rows).  If every per-task entry is a lazy slice
-        of one producer stack, gather straight from it with a single take;
-        otherwise stack the per-task arrays."""
-        jnp = self._jax.numpy
+    def _flow_entries(self, views, body, flow):
+        """Per-task device entries for one read flow: concrete arrays or
+        lazy _StackRefs (left unresolved so the dispatcher can fuse the
+        gather into the kernel program)."""
         ents = []
         for view in views:
             cptr, uid, ver = self._flow_uid_ver(view, body, flow)
@@ -1140,8 +1187,8 @@ class TpuDevice:
                 ents.append(self._stage_in(view, body, flow))
             else:
                 self.stats["h2d_hits"] += 1
-                ents.append(ent.arr)  # may be a _StackRef: resolved below
-        return grouped_stack(jnp, ents, bucket)
+                ents.append(ent.arr)  # may be a _StackRef
+        return ents
 
     def _write_out(self, view, body: _DeviceBody, flow, arr, res) -> None:
         """Install one task's output in the cache (and, for mem-out flows
@@ -1171,9 +1218,28 @@ class TpuDevice:
         views = [body.make_view(t) for t in tasks]
         bucket = _bucket(len(tasks))
         try:
-            ins = [self._gather_flow(views, body, f, bucket)
-                   for f in body.reads]
-            out = _get_vmapped(self._jax, body.kernel)(*ins)
+            # Per flow: if every entry is a slice of ONE source stack,
+            # ship (stack, idx) and gather inside the fused program;
+            # otherwise pre-gather eagerly (mixed sources).  The whole
+            # wave is then a single device dispatch.
+            sig, call_args = [], []
+            for f in body.reads:
+                ents = self._flow_entries(views, body, f)
+                one = _single_stack(ents)
+                if one is not None:
+                    stack, idxs = one
+                    idxs += [idxs[0]] * (bucket - len(idxs))
+                    sig.append(True)
+                    self.stats["fused_flows"] += 1
+                    call_args += [stack,
+                                  np.asarray(idxs, dtype=np.int32)]
+                else:
+                    sig.append(False)
+                    self.stats["eager_gathers"] += 1
+                    call_args.append(grouped_stack(
+                        self._jax.numpy, ents, bucket))
+            out = _get_fused(self._jax, body.kernel, tuple(sig),
+                             single=False)(*call_args)
             outs = out if isinstance(out, tuple) else (out,)
             for f, ostack in zip(body.writes, outs):
                 sync_host = f in body.mem_out_flows
@@ -1207,9 +1273,22 @@ class TpuDevice:
     def _dispatch_one(self, body, task):
         view = body.make_view(task)
         try:
-            jitted = _get_jitted(self._jax, body.kernel)
-            ins = [self._stage_in(view, body, f) for f in body.reads]
-            out = jitted(*ins)  # async: returns immediately
+            # Inputs still living as stack slices are selected INSIDE the
+            # jitted program (scalar-index take) — a single-task dispatch
+            # whose inputs are batch-stack rows costs one device call,
+            # not one slice op per flow plus the exec.
+            sig, call_args = [], []
+            for f in body.reads:
+                ent = self._flow_entries([view], body, f)[0]
+                if isinstance(ent, _StackRef):
+                    sig.append(True)
+                    call_args += [ent.stack,
+                                  np.int32(ent.idx)]
+                else:
+                    sig.append(False)
+                    call_args.append(ent)
+            out = _get_fused(self._jax, body.kernel, tuple(sig),
+                             single=True)(*call_args)  # async dispatch
             outs = out if isinstance(out, tuple) else (out,)
             for f, arr in zip(body.writes, outs):
                 sync_host = f in body.mem_out_flows
