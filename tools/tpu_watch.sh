@@ -29,7 +29,7 @@ run_step() {  # name, command...
   return 1
 }
 
-STEPS="spotrf_4096 spotrf_8192 ring dataplane spotrf_16384 spotrf_32768 spotrf_65536"
+STEPS="launch spotrf_4096 spotrf_8192 ring dataplane spotrf_16384 spotrf_32768 spotrf_65536"
 
 for i in $(seq 1 200); do
   # the driver's end-of-round bench claims the chip via this stop file
@@ -43,6 +43,7 @@ for i in $(seq 1 200); do
     exit 0
   fi
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    run_step launch python tools/probe_launch_overhead.py || { sleep 300; continue; }
     PTC_BENCH_PROFILE=1 run_step spotrf_4096 \
       python bench.py --spotrf-child --n 4096 --nb 512 || { sleep 300; continue; }
     PTC_BENCH_PROFILE=1 run_step spotrf_8192 \
