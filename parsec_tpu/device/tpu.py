@@ -496,23 +496,36 @@ def _get_fused(jax_mod, kernel: Callable, sig: tuple, single: bool):
     to ONE dispatch.  `single=True` wraps the unbatched kernel (scalar
     idx selects one row); False wraps vmap(kernel) over stacked rows.
 
-    A sig with nothing to fuse reuses the plain jitted/vmapped program
-    (same cache `warm()` pre-compiles into)."""
+    Per-flow sig entries (None = pre-stacked passthrough, vmap axis 0):
+      "idx"   (stack, lane_idxs) — gathered inside, vmap axis 0
+      "bcast" one shared array every lane consumes — vmap axis None,
+              shipped ONCE instead of duplicated per lane by a gather
+              (e.g. the panel inverse every TRSM lane reads)
+      "bidx"  (stack, scalar_idx) — one shared row taken inside,
+              vmap axis None
+
+    A sig with nothing to fuse or broadcast reuses the plain
+    jitted/vmapped program (same cache `warm()` pre-compiles into)."""
     if not any(sig):
         return (_get_jitted if single else _get_vmapped)(jax_mod, kernel)
     key = (kernel, sig, single)
     f = _FUSED_CACHE.get(key)
     if f is None:
         jnp = jax_mod.numpy
-        core = kernel if single else jax_mod.vmap(kernel)
+        if single:
+            core = kernel
+        else:
+            axes = tuple(None if s in ("bcast", "bidx") else 0
+                         for s in sig)
+            core = jax_mod.vmap(kernel, in_axes=axes)
 
         def fused(*args):
             ins, ai = [], 0
-            for indexed in sig:
-                if indexed:
+            for s in sig:
+                if s in ("idx", "bidx"):
                     ins.append(jnp.take(args[ai], args[ai + 1], axis=0))
                     ai += 2
-                else:
+                else:  # "bcast" / pre-stacked passthrough
                     ins.append(args[ai])
                     ai += 1
             return core(*ins)
@@ -1225,19 +1238,47 @@ class TpuDevice:
             sig, call_args = [], []
             for f in body.reads:
                 ents = self._flow_entries(views, body, f)
+                first = ents[0]
+                if all(e is first for e in ents):
+                    # wave-wide shared operand: ship once, vmap axis None
+                    self.stats["fused_flows"] += 1
+                    if isinstance(first, _StackRef):
+                        sig.append("bidx")
+                        call_args += [first.stack, np.int32(first.idx)]
+                    else:
+                        sig.append("bcast")
+                        call_args.append(first)
+                    continue
                 one = _single_stack(ents)
                 if one is not None:
                     stack, idxs = one
+                    if len(set(idxs)) == 1:
+                        # shared row of one stack: same broadcast case
+                        self.stats["fused_flows"] += 1
+                        sig.append("bidx")
+                        call_args += [stack, np.int32(idxs[0])]
+                        continue
                     idxs += [idxs[0]] * (bucket - len(idxs))
-                    sig.append(True)
+                    sig.append("idx")
                     self.stats["fused_flows"] += 1
                     call_args += [stack,
                                   np.asarray(idxs, dtype=np.int32)]
                 else:
-                    sig.append(False)
+                    sig.append(None)
                     self.stats["eager_gathers"] += 1
                     call_args.append(grouped_stack(
                         self._jax.numpy, ents, bucket))
+            if sig and all(s in ("bcast", "bidx") for s in sig):
+                # degenerate wave (every flow shared): vmap needs one
+                # mapped axis — demote flow 0 to a per-lane form
+                if sig[0] == "bidx":
+                    sig[0] = "idx"
+                    call_args[1] = np.full((bucket,),
+                                           int(call_args[1]), np.int32)
+                else:
+                    sig[0] = None
+                    call_args[0] = self._jax.numpy.stack(
+                        [call_args[0]] * bucket)
             out = _get_fused(self._jax, body.kernel, tuple(sig),
                              single=False)(*call_args)
             outs = out if isinstance(out, tuple) else (out,)
@@ -1281,11 +1322,11 @@ class TpuDevice:
             for f in body.reads:
                 ent = self._flow_entries([view], body, f)[0]
                 if isinstance(ent, _StackRef):
-                    sig.append(True)
+                    sig.append("idx")
                     call_args += [ent.stack,
                                   np.int32(ent.idx)]
                 else:
-                    sig.append(False)
+                    sig.append(None)
                     call_args.append(ent)
             out = _get_fused(self._jax, body.kernel, tuple(sig),
                              single=True)(*call_args)  # async dispatch
