@@ -98,13 +98,20 @@ def bench_dispatch_mt(nb_tasks: int = 4000, lanes: int = 8, workers: int = 4,
     return min(p50s)
 
 
-def _potrf_once(N, nb, seed=0, check=False, profile=False):
-    """One spotrf run with device-resident data; returns (seconds, resid)."""
+def _potrf_once(N, nb, seed=0, check=False, profile=False,
+                variant="panel"):
+    """One spotrf run with device-resident data; returns (seconds, resid).
+
+    variant="panel" (default): build_potrf_panels — full-height N x nb
+    panel tasks, each trailing update ONE MXU matmul, a wave one vmapped
+    call.  variant="tile": the tiled dpotrf_L DAG (the distributed
+    form), nb x nb tasks."""
     import os
-    from parsec_tpu.algos import build_potrf
+    from parsec_tpu.algos import build_potrf, build_potrf_panels
     from parsec_tpu.data import TwoDimBlockCyclic
     from parsec_tpu.device import TpuDevice
     from parsec_tpu.device.bench_utils import (generate_spd_on_device,
+                                               generate_spd_panels_on_device,
                                                potrf_residual,
                                                wait_device_tiles)
     workers = int(os.environ.get("PTC_BENCH_WORKERS", "4"))
@@ -117,7 +124,10 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
     # the ladder admits
     os.environ.setdefault("PTC_DEVICE_BATCH", "512")
     with pt.Context(nb_workers=workers) as ctx:
-        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        if variant == "panel":
+            A = TwoDimBlockCyclic(N, N, N, nb, dtype=np.float32)
+        else:
+            A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
         A.register(ctx, "A")
         if cache_gb is not None:
             cache_bytes = int(cache_gb) << 30
@@ -132,10 +142,16 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False):
             cache_bytes = max(2 << 30, int(hbm - N * N * 4 - (3 << 30)))
         dev = TpuDevice(ctx, cache_bytes=cache_bytes)
         t_g0 = time.perf_counter()
-        a_stacked = generate_spd_on_device(dev, A, seed=seed)
+        if variant == "panel":
+            a_stacked = generate_spd_panels_on_device(dev, A, seed=seed)
+        else:
+            a_stacked = generate_spd_on_device(dev, A, seed=seed)
         a_stacked.block_until_ready()
         t_g1 = time.perf_counter()
-        tp = build_potrf(ctx, A, dev=dev)
+        if variant == "panel":
+            tp = build_potrf_panels(ctx, A, dev=dev)
+        else:
+            tp = build_potrf(ctx, A, dev=dev)
         t0 = time.perf_counter()
         tp.run()
         tp.wait()
@@ -204,20 +220,22 @@ def _chip_info():
     return kind, reps * 2 * n ** 3 / dt / 1e9
 
 
-def bench_spotrf(N=16384, nb=1024, reps=2):
+def bench_spotrf(N=16384, nb=1024, reps=2, variant="panel"):
     import os
     from parsec_tpu.algos import potrf_flops
     profile = bool(os.environ.get("PTC_BENCH_PROFILE"))
-    # warmup: compiles the 4 kernels at (nb, nb) + generator + small graph;
+    # warmup: compiles the kernels + generator + small graph;
     # 16*nb gives nt=16 so the batched buckets up to 16 pre-compile too.
     # Never warm up BIGGER than the measured run (the N=4096 rung would
     # otherwise pay an N=8192 warmup - slower than the rung itself).
-    _potrf_once(min(16 * nb, N), nb, seed=1)
+    # (Panel kernels recompile at the full N anyway — panels are
+    # full-height — so the warmup only covers the small-graph paths.)
+    _potrf_once(min(16 * nb, N), nb, seed=1, variant=variant)
     best = None
     resid = None
     for rep in range(reps):
         dt, r = _potrf_once(N, nb, seed=0, check=(rep == 0),
-                            profile=profile)
+                            profile=profile, variant=variant)
         if rep == 0:
             resid = r
         if best is None or dt < best:
@@ -481,13 +499,14 @@ def main():
             }))
             return 0
         chip, peak = _chip_info()
-        gflops = bench_spotrf(n, nb)
+        variant = "tile" if "--tiled" in sys.argv else "panel"
+        gflops = bench_spotrf(n, nb, variant=variant)
         print(json.dumps({
             "metric": "spotrf_gflops_per_chip",
             "value": round(gflops, 1),
             "unit": "GFLOP/s",
             "vs_baseline": round(gflops / 7000.0, 4),
-            "config": {"N": n, "NB": nb},
+            "config": {"N": n, "NB": nb, "variant": variant},
             "chip_kind": chip,
             "chip_fp32_matmul_gflops": round(peak, 1),
             "frac_of_chip_matmul": round(gflops / peak, 3) if peak else None,
@@ -551,9 +570,12 @@ def main():
         if cap is not None:
             remaining = min(remaining, cap)
         try:
+            child_argv = [sys.executable, __file__, "--spotrf-child",
+                          "--n", str(n), "--nb", str(nb)]
+            if "--tiled" in sys.argv:
+                child_argv.append("--tiled")
             r = subprocess.run(
-                [sys.executable, __file__, "--spotrf-child",
-                 "--n", str(n), "--nb", str(nb)],
+                child_argv,
                 timeout=remaining, capture_output=True, text=True)
             got = None
             for line in reversed((r.stdout or "").strip().splitlines()):

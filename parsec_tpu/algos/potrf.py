@@ -225,6 +225,135 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     return tp
 
 
+# ------------------------------------------------------ panel variant
+# Right-looking blocked Cholesky at PANEL granularity: tasks operate on
+# full-height N x nb column panels instead of nb x nb tiles.  Same math
+# as the tiled dataflow (DPLASMA dpotrf_L), coarser tasks: each trailing
+# update U(k, j) is ONE (N x nb) @ (nb x nb) MXU matmul, and a wave of
+# them is one vmapped call — the TPU-shaped answer to the tile DAG's
+# launch-overhead wall on a single fat chip.  The tiled build_potrf
+# remains the distributed (PxQ block-cyclic) form.
+#
+#   F(k)   : factor panel k   diag = chol(P[kb:kb+nb]); P = P inv(L)^T
+#            (rows above kb zeroed, diag block set to L exactly)
+#   U(k,j) : panel j trailing update   P_j -= P_k P_k[jb:jb+nb]^T
+#
+# Panel row offsets ride a tiny int32 index collection (kernels receive
+# only flow arrays; the offset is data, not a compile-time constant, so
+# ONE executable serves every k).
+
+
+def k_panel_factor(p, ks):
+    import jax
+    import jax.numpy as jnp
+    nb = p.shape[1]
+    off = ks[0] * nb
+    diag = jax.lax.dynamic_slice(p, (off, 0), (nb, nb))
+    l = jnp.linalg.cholesky(diag)
+    linv = jax.scipy.linalg.solve_triangular(
+        l, jnp.eye(nb, dtype=p.dtype), lower=True)
+    x = jax.lax.dot_general(p, linv, (((1,), (1,)), ((), ())),
+                            preferred_element_type=p.dtype)
+    rows = jnp.arange(p.shape[0], dtype=ks.dtype)[:, None]
+    x = jnp.where(rows >= off, x, jnp.zeros((), p.dtype))
+    return jax.lax.dynamic_update_slice(x, l, (off, 0))
+
+
+def k_panel_update(pk, js, pj):
+    import jax
+    nb = pk.shape[1]
+    off = js[0] * nb
+    bj = jax.lax.dynamic_slice(pk, (off, 0), (nb, nb))
+    return pj - jax.lax.dot_general(pk, bj, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=pj.dtype)
+
+
+def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
+                       dev: Optional[TpuDevice] = None,
+                       name: str = "A") -> pt.Taskpool:
+    """Panel-granular Cholesky taskpool.  `A` must be a single block row
+    of N x nb panels: TwoDimBlockCyclic(N, N, N, nb) registered under
+    `name`.  Also registers an int32 index collection under
+    `name + "_pidx"`."""
+    from ..data.collections import VectorCyclic
+    assert A.mt == 1 and A.M == A.N and A.M == A.mb, \
+        "panel collection: mb == M (one block row of panels)"
+    nt = A.nt
+    nb = A.nb
+    NN = A.M
+    dt = A.dtype
+    pidx_name = name + "_pidx"
+    pidx = VectorCyclic(nt, 1, dtype=np.int32)
+    for j in range(nt):
+        pidx.seg(j)[0] = j
+    pidx.register(ctx, pidx_name)
+    tp = pt.Taskpool(ctx, globals={"NT": nt - 1})
+    k, j = pt.L("k"), pt.L("j")
+    NT = pt.G("NT")
+
+    # ------------------------------------------------------------- F(k)
+    fa = tp.task_class("PF")
+    fa.param("k", 0, NT)
+    fa.affinity(name, 0, k)
+    fa.priority((NT - k) * 1000 + 500)
+    fa.flow("P", "RW",
+            pt.In(pt.Mem(name, 0, k), guard=(k == 0)),
+            pt.In(pt.Ref("PU", k - 1, k, flow="PJ")),
+            pt.Out(pt.Ref("PU", k, pt.Range(k + 1, NT), flow="PK"),
+                   guard=(k < NT)),
+            pt.Out(pt.Mem(name, 0, k)))
+    fa.flow("KS", "READ", pt.In(pt.Mem(pidx_name, k)))
+
+    # ----------------------------------------------------------- U(k, j)
+    up = tp.task_class("PU")
+    up.param("k", 0, NT)
+    up.param("j", k + 1, NT)
+    up.affinity(name, 0, j)
+    up.priority((NT - k) * 1000 - j)
+    up.flow("PK", "READ", pt.In(pt.Ref("PF", k, flow="P")))
+    up.flow("JS", "READ", pt.In(pt.Mem(pidx_name, j)))
+    up.flow("PJ", "RW",
+            pt.In(pt.Mem(name, 0, j), guard=(k == 0)),
+            pt.In(pt.Ref("PU", k - 1, j, flow="PJ")),
+            pt.Out(pt.Ref("PF", j, flow="P"), guard=(j == k + 1)),
+            pt.Out(pt.Ref("PU", k + 1, j, flow="PJ"), guard=(j > k + 1)))
+
+    # --------------------------------------------------------------- chores
+    pshp = (NN, nb)
+    for d in as_device_list(dev):
+        d.attach(fa, tp, kernel=k_panel_factor, reads=["P", "KS"],
+                 writes=["P"], shapes={"P": pshp, "KS": (1,)},
+                 dtypes={"P": np.dtype(dt), "KS": np.dtype(np.int32)})
+        d.attach(up, tp, kernel=k_panel_update, reads=["PK", "JS", "PJ"],
+                 writes=["PJ"],
+                 shapes={"PK": pshp, "JS": (1,), "PJ": pshp},
+                 dtypes={"PK": np.dtype(dt), "JS": np.dtype(np.int32),
+                         "PJ": np.dtype(dt)})
+
+    def b_factor(t):
+        p = t.data("P", dt, pshp)
+        kk = int(t.data("KS", np.int32, (1,))[0])
+        off = kk * nb
+        diag = p[off:off + nb]
+        l = np.linalg.cholesky(diag)
+        linv = np.linalg.solve(l, np.eye(nb, dtype=dt))
+        x = p @ linv.T
+        x[:off] = 0
+        x[off:off + nb] = l
+        p[...] = x
+
+    def b_update(t):
+        pk_ = t.data("PK", dt, pshp)
+        jj = int(t.data("JS", np.int32, (1,))[0])
+        pj_ = t.data("PJ", dt, pshp)
+        off = jj * nb
+        pj_ -= pk_ @ pk_[off:off + nb].T
+
+    fa.body(b_factor)
+    up.body(b_update)
+    return tp
+
+
 def run_potrf(ctx, A, dev=None):
     tp = build_potrf(ctx, A, dev)
     tp.run()

@@ -705,6 +705,10 @@ class TpuDevice:
         # latency-bound chains are unaffected)
         self.batch_wait_ms = float(
             os.environ.get("PTC_DEVICE_BATCH_WAIT_MS", "0"))
+        # byte cap on one vmapped call's stacked operands (see
+        # _dispatch_group); count cap alone is blind to tile size
+        self.batch_max_bytes = int(
+            os.environ.get("PTC_DEVICE_BATCH_BYTES", str(2 << 30)))
         self.bodies: Dict[Tuple[int, int], _DeviceBody] = {}
         self._dtd_bodies: Dict[int, _DeviceBody] = {}
         self._tp_by_ptr: Dict[int, Taskpool] = {}
@@ -1227,7 +1231,32 @@ class TpuDevice:
         same class.  Inputs are gathered per flow into (bucket, *tile)
         stacks; outputs stay stacked, with per-task cache entries holding
         lazy slices — the next batched consumer gathers from them without
-        any intermediate slicing."""
+        any intermediate slicing.
+
+        Groups are split so one call's stacked operands stay under
+        PTC_DEVICE_BATCH_BYTES (default 2 GiB): a wave of wide tiles
+        (panel-granular dense LA) must not stack itself out of HBM."""
+        per_task = 0
+        # reads + writes separately: an RW flow's gathered input stack
+        # and produced output stack coexist during the call, so it costs
+        # two stacks' worth.  (Wave-shared broadcast flows are counted
+        # per lane though shipped once — conservative over-splitting.)
+        for f in list(body.reads) + list(body.writes):
+            shp = body.shapes.get(f)
+            if shp:
+                per_task += int(np.prod(shp)) * np.dtype(
+                    body.dtypes.get(f, np.float32)).itemsize
+        if per_task > 0 and len(tasks) * per_task > self.batch_max_bytes:
+            chunk = max(1, self.batch_max_bytes // per_task)
+            # floor to a power of two: _bucket rounds the lane count UP,
+            # so a non-power chunk would pad its stacks past the cap
+            chunk = 1 << (chunk.bit_length() - 1)
+            for i in range(0, len(tasks), chunk):
+                self._dispatch_group_chunk(body, tasks[i:i + chunk])
+            return
+        self._dispatch_group_chunk(body, tasks)
+
+    def _dispatch_group_chunk(self, body: _DeviceBody, tasks: List):
         views = [body.make_view(t) for t in tasks]
         bucket = _bucket(len(tasks))
         try:
