@@ -278,12 +278,17 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
     from ..data.collections import VectorCyclic
     assert A.mt == 1 and A.M == A.N and A.M == A.mb, \
         "panel collection: mb == M (one block row of panels)"
+    assert A.P == 1, "panels distribute 1-D: P must be 1 (Q = nodes)"
     nt = A.nt
     nb = A.nb
     NN = A.M
     dt = A.dtype
     pidx_name = name + "_pidx"
-    pidx = VectorCyclic(nt, 1, dtype=np.int32)
+    # same cyclic map as the panels (Q == nodes, rank_of(j) == j % nodes):
+    # every Mem(pidx, j) read is co-located with the task that issues it,
+    # so the index tiles never cross ranks
+    pidx = VectorCyclic(nt, 1, nodes=A.nodes, myrank=A.myrank,
+                        dtype=np.int32)
     for j in range(nt):
         pidx.seg(j)[0] = j
     pidx.register(ctx, pidx_name)

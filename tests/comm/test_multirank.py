@@ -341,3 +341,19 @@ def test_jdf_ctlgat_2ranks():
 
 def test_jdf_ctlgat_4ranks():
     _run_spmd(_workers.jdf_ctlgat, 4)
+
+
+def test_potrf_panels_2ranks():
+    """1-D panel-cyclic distributed Cholesky (build_potrf_panels):
+    factored panels broadcast across ranks as whole N x nb payloads."""
+    _run_spmd(_workers.potrf_panels_dist, 2, timeout=180, N=128, nb=16)
+
+
+def test_potrf_panels_4ranks():
+    _run_spmd(_workers.potrf_panels_dist, 4, timeout=240, N=192, nb=16)
+
+
+def test_potrf_panels_2ranks_rendezvous():
+    # N x nb = 512x64 fp32 panels = 128 KiB: above the eager threshold,
+    # every cross-rank panel flow rides the rendezvous GET protocol
+    _run_spmd(_workers.potrf_panels_dist, 2, timeout=240, N=512, nb=64)
