@@ -96,3 +96,34 @@ def test_two_devices_chain_alternating():
             [dict(d0.stats), dict(d1.stats)]
         d0.stop()
         d1.stop()
+
+
+def test_potrf_panels_two_devices():
+    """Panel-granular potrf across two devices: panel tasks load-balance
+    over the queues and cross-device panel flows stage D2D."""
+    N, nb = 192, 32
+    spd = _spd(N)
+    with pt.Context(nb_workers=2) as ctx:
+        from parsec_tpu.algos import build_potrf_panels
+        A = TwoDimBlockCyclic(N, N, N, nb, dtype=np.float32)
+        for j in range(A.nt):
+            A.tile(0, j)[...] = spd[:, j * nb:(j + 1) * nb]
+        A.register(ctx, "A")
+        devs = [TpuDevice(ctx, jax_device=jax.devices()[i])
+                for i in range(2)]
+        tp = build_potrf_panels(ctx, A, dev=devs)
+        tp.run()
+        tp.wait()
+        for d in devs:
+            d.flush()
+        out = np.zeros((N, N), np.float32)
+        for j in range(A.nt):
+            out[:, j * nb:(j + 1) * nb] = A.tile(0, j)
+        np.testing.assert_allclose(np.tril(out), np.linalg.cholesky(spd),
+                                   rtol=2e-3, atol=2e-3)
+        assert all(d.stats["tasks"] > 0 for d in devs), \
+            [d.stats["tasks"] for d in devs]
+        assert any(d.stats.get("d2d_bytes", 0) > 0 for d in devs), \
+            [dict(d.stats) for d in devs]
+        for d in devs:
+            d.stop()
