@@ -2408,6 +2408,18 @@ int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   return n;
 }
 
+/* per-worker steal counters (selects served from a victim's queue);
+ * 0 for global-queue schedulers.  (Reference observability role:
+ * mca/pins/print_steals.) */
+int64_t ptc_worker_steals(ptc_context_t *ctx, int64_t *out, int64_t cap) {
+  if (!ctx->sched) return 0;
+  auto &st = ctx->sched->steals;
+  int64_t n = 0;
+  for (; n < (int64_t)st.size() && n < cap; n++)
+    out[n] = st[(size_t)n]->load(std::memory_order_relaxed);
+  return n;
+}
+
 int32_t ptc_context_nb_workers(ptc_context_t *ctx) { return ctx->nb_workers; }
 
 int32_t ptc_context_set_scheduler(ptc_context_t *ctx, const char *name) {
@@ -2431,6 +2443,7 @@ int32_t ptc_context_start(ptc_context_t *ctx) {
   if (ctx->started.load(std::memory_order_relaxed)) return 0;
   ctx->sched = ptc_sched_create(ctx->sched_name);
   ctx->sched->install(ctx->nb_workers);
+  ctx->sched->steals_init(ctx->nb_workers);
   for (int i = 0; i < ctx->nb_workers; i++)
     ctx->workers.emplace_back(worker_main, ctx, i);
   ctx->started.store(true, std::memory_order_release);

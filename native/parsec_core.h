@@ -294,6 +294,7 @@ void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
 /* per-worker SELECTED-task counters (scheduler pops; the PAPI-SDE
  * TASKS_SCHEDULED analog) -> out[0..cap); returns count */
 int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap);
+int64_t ptc_worker_steals(ptc_context_t *ctx, int64_t *out, int64_t cap);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
 

@@ -392,6 +392,20 @@ constexpr size_t PROMOTED_RECENT_CAP = 1024; /* per shard */
 /* ------------------------------------------------------------------ */
 
 struct Scheduler {
+  /* per-worker steal counters — a select() that served worker w from a
+   * VICTIM's queue ticks steals[w].  Data source for the print_steals
+   * observability role (reference: mca/pins/print_steals); global-queue
+   * schedulers never tick.  Sized by the install caller (core.cpp). */
+  std::vector<std::unique_ptr<std::atomic<int64_t>>> steals;
+  void steals_init(int n) {
+    steals.clear();
+    for (int i = 0; i < (n < 1 ? 1 : n); i++)
+      steals.emplace_back(new std::atomic<int64_t>(0));
+  }
+  void steal_tick(int w) {
+    if (w >= 0 && w < (int)steals.size())
+      steals[(size_t)w]->fetch_add(1, std::memory_order_relaxed);
+  }
   virtual ~Scheduler() {}
   virtual void install(int nb_workers) = 0;
   virtual void schedule(int worker, ptc_task *t) = 0;

@@ -80,7 +80,10 @@ struct SchedLWS : Scheduler {
     }
     for (int i = 1; i < n; i++) {
       t = dq[(size_t)((w + i) % n)]->steal();
-      if (t) return t;
+      if (t) {
+        steal_tick(w % n);
+        return t;
+      }
     }
     return nullptr;
   }
@@ -119,6 +122,7 @@ struct SchedLFQ : Scheduler {
       if (!q.dq.empty()) {
         ptc_task *t = q.dq.front();
         q.dq.pop_front();
+        steal_tick(w % n);
         return t;
       }
     }
@@ -137,6 +141,7 @@ struct SchedLL : SchedLFQ {
       if (!q.dq.empty()) {
         ptc_task *t = q.dq.back();
         q.dq.pop_back();
+        if (i) steal_tick(w % n);
         return t;
       }
     }
@@ -173,6 +178,7 @@ struct SchedLTQ : Scheduler {
         std::pop_heap(q.heap.begin(), q.heap.end(), Cmp{});
         ptc_task *t = q.heap.back();
         q.heap.pop_back();
+        if (i) steal_tick(w % n);
         return t;
       }
     }
@@ -191,6 +197,7 @@ struct SchedPBQ : SchedLFQ {
       if (!q.dq.empty()) {
         ptc_task *t = q.dq.front();
         q.dq.pop_front();
+        if (i) steal_tick(w % n);
         return t;
       }
     }

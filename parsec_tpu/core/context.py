@@ -100,6 +100,14 @@ class Context:
     def destroy(self):
         if not self._destroyed:
             self._destroyed = True
+            # uninstall the PINS chain while the native context is still
+            # alive: teardown reports (print_steals) read native counters
+            chain = getattr(self, "_pins_chain", None)
+            if chain is not None:
+                try:
+                    chain.uninstall()
+                except Exception:
+                    pass
             for mon in list(getattr(self, "_monitors", [])):
                 try:
                     mon.stop()
@@ -226,6 +234,15 @@ class Context:
         n = N.lib.ptc_worker_stats(self._ptr, buf, cap)
         return [buf[i] for i in range(n)]
 
+    def worker_steals(self) -> list:
+        """Per-worker steal counts: selects served from a VICTIM's queue
+        (the mca/pins/print_steals data; zero under global-queue
+        schedulers, which have nothing to steal)."""
+        cap = max(1, self.nb_workers)
+        buf = (C.c_int64 * cap)()
+        n = N.lib.ptc_worker_steals(self._ptr, buf, cap)
+        return [buf[i] for i in range(n)]
+
     def rusage(self) -> dict:
         """Process resource usage (the reference's per-EU rusage dumps,
         parsec/scheduling.c:45-86 — user/sys time, maxrss, context
@@ -246,6 +263,9 @@ class Context:
         """Human-readable counter dump (the --mca device_show_statistics /
         dump_and_reset analog, parsec/mca/device/device.h:224)."""
         lines = [f"workers (selected tasks): {self.worker_stats()}"]
+        steals = self.worker_steals()
+        if any(steals):
+            lines.append(f"worker steals: {steals}")
         bindings = [self.worker_binding(w) for w in range(self.nb_workers)]
         if any(b >= 0 for b in bindings):
             lines.append(f"worker cpu bindings: {bindings}")

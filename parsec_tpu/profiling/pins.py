@@ -119,10 +119,31 @@ class CommVolume(PinsModule):
                 self.recv_bytes += aux
 
 
+class PrintSteals(PinsModule):
+    """Reports per-worker steal counts when the chain uninstalls
+    (reference: mca/pins/print_steals).  The counts themselves are native
+    (Scheduler.steals, ticked inside select) — this module is the
+    report-at-teardown role, so it subscribes to no events."""
+
+    name = "print_steals"
+    mask = 0
+
+    def on_event(self, *a):  # pragma: no cover - mask=0, never called
+        pass
+
+    def on_uninstall(self, ctx) -> None:
+        steals = ctx.worker_steals()
+        import sys
+        sys.stderr.write(
+            f"ptc [pins] print_steals: per-worker steals {steals} "
+            f"(total {sum(steals)})\n")
+
+
 REGISTRY: Dict[str, Type[PinsModule]] = {
     TaskCounter.name: TaskCounter,
     TaskProfiler.name: TaskProfiler,
     CommVolume.name: CommVolume,
+    PrintSteals.name: PrintSteals,
 }
 
 
@@ -161,8 +182,21 @@ class PinsChain:
         N.lib.ptc_set_pins_cb(ctx._ptr, self._cb, None, mask)
 
     def uninstall(self):
+        # idempotent: a second call (explicit uninstall then Context
+        # destroy) must not re-report or touch a freed native context
+        if getattr(self, "_uninstalled", False):
+            return
+        self._uninstalled = True
         N.lib.ptc_set_pins_cb(self._ctx._ptr, C.cast(None, PINS_CB_T),
                               None, 0)
+        for m in self.modules:
+            hook = getattr(m, "on_uninstall", None)
+            if hook is not None:
+                try:
+                    hook(self._ctx)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
         self._ctx._pins_chain = None
 
     def __getitem__(self, name: str) -> PinsModule:

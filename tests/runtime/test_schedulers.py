@@ -58,3 +58,77 @@ def test_chain_all_schedulers(sched):
         tp.run()
         tp.wait()
     assert order == list(range(n + 1))
+
+
+def test_worker_steals_counted():
+    """Per-worker-queue schedulers tick native steal counters when a
+    select is served from a victim's queue (the print_steals data,
+    reference mca/pins/print_steals); global-queue schedulers stay 0."""
+    import parsec_tpu as pt
+
+    import time
+
+    def run(sched):
+        # one root fans out to 64 successors: the releasing WORKER pushes
+        # them all to its own local queue (startup tasks would go through
+        # the inject/global path instead), so the other three workers can
+        # only run them by stealing.  Sleeping bodies release the GIL and
+        # keep the releaser busy long enough for thieves to arrive.
+        with pt.Context(nb_workers=4, scheduler=sched) as ctx:
+            ctx.register_arena("t", 8)
+            tp = pt.Taskpool(ctx, globals={"NW": 63})
+            k = pt.L("k")
+            r = tp.task_class("R")
+            r.param("k", 0, 0)
+            r.flow("C", "W",
+                   pt.Out(pt.Ref("W", pt.Range(0, pt.G("NW")), flow="C"),
+                          guard=None), arena="t")
+            w = tp.task_class("W")
+            w.param("k", 0, pt.G("NW"))
+            w.flow("C", "READ", pt.In(pt.Ref("R", 0, flow="C")))
+            r.body(lambda v: None)
+            w.body(lambda v: time.sleep(0.002))
+            tp.run()
+            tp.wait()
+            return ctx.worker_steals()
+
+    st = run("lws")
+    assert len(st) == 4 and sum(st) > 0, st
+    assert sum(run("gd")) == 0  # global dequeue: nothing to steal
+
+
+def test_print_steals_module(capsys):
+    import parsec_tpu as pt
+    from parsec_tpu.profiling.pins import enable_pins
+
+    with pt.Context(nb_workers=4, scheduler="lfq") as ctx:
+        chain = enable_pins(ctx, "print_steals")
+        tp = pt.Taskpool(ctx, globals={"N": 400})
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("N"))
+        tc.body(lambda v: None)
+        tp.run()
+        tp.wait()
+        chain.uninstall()
+    err = capsys.readouterr().err
+    assert "print_steals: per-worker steals" in err
+
+
+def test_print_steals_fires_on_context_destroy(capsys):
+    """The MCA-param path installs the chain at init and never calls
+    uninstall explicitly — Context.destroy() must fire the teardown
+    reports while the native context is still alive, exactly once."""
+    import parsec_tpu as pt
+    from parsec_tpu.profiling.pins import enable_pins
+
+    with pt.Context(nb_workers=2, scheduler="lfq") as ctx:
+        chain = enable_pins(ctx, "print_steals")
+        tp = pt.Taskpool(ctx, globals={"N": 10})
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("N"))
+        tc.body(lambda v: None)
+        tp.run()
+        tp.wait()
+    chain.uninstall()  # after destroy: must be a no-op, not a UAF
+    err = capsys.readouterr().err
+    assert err.count("print_steals: per-worker steals") == 1
