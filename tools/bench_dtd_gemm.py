@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""BASELINE ladder rung 2: DTD tiled GEMM on one TPU chip.
+
+Runtime-discovered DAG (every (m,n,k) product inserted through the DTD
+accessor-chain machinery), device chores dispatching cached XLA
+executables, host tiles staged h2d on first touch — the honest DTD
+bring-up number, reference shape: tests/dsl/dtd task-insertion GEMMs.
+
+Emits one JSON line:
+  {"metric": "dtd_gemm", "gflops": .., "tasks_per_s": .., "config": ..}
+
+Run on the chip:  python tools/bench_dtd_gemm.py [--n 4096] [--nb 512]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _arg(flag, default):
+    if flag in sys.argv:
+        return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+def main():
+    N = _arg("--n", 4096)
+    nb = _arg("--nb", 512)
+    nt = N // nb
+    import parsec_tpu as pt
+    from parsec_tpu.data import TwoDimBlockCyclic
+    from parsec_tpu.device import TpuDevice
+    from parsec_tpu.dsl.dtd import DtdTaskpool
+
+    rng = np.random.default_rng(7)
+
+    def k_gemm(a, b, c):
+        return c + a @ b
+
+    def run():
+        with pt.Context(nb_workers=4) as ctx:
+            A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+            B = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+            C = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+            A.from_dense(rng.standard_normal((N, N), dtype=np.float32))
+            B.from_dense(rng.standard_normal((N, N), dtype=np.float32))
+            C.from_dense(np.zeros((N, N), dtype=np.float32))
+            A.register(ctx, "A")
+            B.register(ctx, "B")
+            C.register(ctx, "C")
+            dev = TpuDevice(ctx)
+            dtd = DtdTaskpool(ctx)
+            t0 = time.perf_counter()
+            for m in range(nt):
+                for n in range(nt):
+                    for k in range(nt):
+                        dtd.insert_tpu_task(
+                            dev, k_gemm,
+                            (dtd.tile_of(A, m, k), "INPUT"),
+                            (dtd.tile_of(B, k, n), "INPUT"),
+                            (dtd.tile_of(C, m, n), "INOUT"),
+                            shapes={i: (nb, nb) for i in range(3)})
+            dtd.wait()
+            from parsec_tpu.device.bench_utils import wait_device_tiles
+            wait_device_tiles(dev, C)
+            dt = time.perf_counter() - t0
+            dev.stop()
+            dtd.destroy()
+            return dt
+
+    run()  # warm: compiles the executable + the insert path
+    dt = min(run() for _ in range(2))
+    tasks = nt ** 3
+    flops = 2.0 * N * N * N
+    import jax
+    print(json.dumps({
+        "metric": "dtd_gemm",
+        "gflops": round(flops / dt / 1e9, 1),
+        "tasks_per_s": round(tasks / dt, 1),
+        "config": {"N": N, "nb": nb, "tasks": tasks},
+        "chip_kind": getattr(jax.devices()[0], "device_kind", "?"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

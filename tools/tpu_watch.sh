@@ -29,7 +29,7 @@ run_step() {  # name, command...
   return 1
 }
 
-STEPS="launch spotrf_4096 spotrf_8192 spotrf_8192_tiled ring dataplane spotrf_16384 spotrf_32768 spotrf_65536"
+STEPS="launch spotrf_4096 spotrf_8192 spotrf_8192_tiled ring dataplane dtdgemm spotrf_16384 spotrf_32768 spotrf_65536"
 
 for i in $(seq 1 200); do
   # the driver's end-of-round bench claims the chip via this stop file
@@ -53,6 +53,7 @@ for i in $(seq 1 200); do
       python bench.py --spotrf-child --n 8192 --nb 512 --tiled || { sleep 300; continue; }
     run_step ring python bench.py --ring || { sleep 300; continue; }
     run_step dataplane python tools/bench_dataplane.py || { sleep 300; continue; }
+    run_step dtdgemm python tools/bench_dtd_gemm.py || { sleep 300; continue; }
     PTC_BENCH_PROFILE=1 run_step spotrf_16384 \
       python bench.py --spotrf-child --n 16384 --nb 512 || { sleep 300; continue; }
     PTC_BENCH_PROFILE=1 run_step spotrf_32768 \
