@@ -166,6 +166,8 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False,
                 f"enqueue={t_w - t0:.2f}s total={dt:.2f}s "
                 f"tasks={s['tasks']} batches={s.get('batches', 0)} "
                 f"batched={s.get('batched_tasks', 0)} "
+                f"fused={s.get('fused_flows', 0)} "
+                f"eager={s.get('eager_gathers', 0)} "
                 f"h2d={s['h2d_bytes']} d2h={s['d2h_bytes']}\n")
         resid = 0.0
         if check:
