@@ -425,13 +425,24 @@ def _spotrf_fits(n: int, hbm_bytes: int):
 
 
 def _best_cached_spotrf():
-    """Best spotrf JSON line captured earlier this round (watcher log
-    /tmp/spotrf_r4.jsonl): largest completed N wins.  Returns the line
-    with a `captured` provenance field added, or None."""
+    """Best spotrf JSON line captured earlier this round (the watcher log,
+    path shared with tools/tpu_watch.sh via PTC_WATCH_LOG): largest
+    completed N *of the run's requested configuration* wins — a --tiled
+    run never reuses a panel capture and vice versa, and an explicit
+    PTC_BENCH_N only accepts its own size.  Returns the line with a
+    `captured` provenance field added, or None."""
     import json as _json
-    best = None
+    import os as _os
+    want_variant = "tile" if "--tiled" in sys.argv else "panel"
+    want_n = int(_os.environ["PTC_BENCH_N"]) \
+        if _os.environ.get("PTC_BENCH_N") else None
+    best = None       # requested variant
+    best_any = None   # any variant: the emitted config is self-
+    #                   describing, so a real off-variant measurement
+    #                   still beats the dispatch fallback
     try:
-        with open("/tmp/spotrf_r4.jsonl") as f:
+        with open(_os.environ.get("PTC_WATCH_LOG",
+                                  "/tmp/spotrf_r4.jsonl")) as f:
             for line in f:
                 i = line.find("{")
                 if i < 0:
@@ -440,13 +451,25 @@ def _best_cached_spotrf():
                     d = _json.loads(line[i:])
                 except ValueError:
                     continue
-                if (d.get("metric") == "spotrf_gflops_per_chip"
-                        and d.get("value")):
-                    if (best is None or d["config"]["N"] >
-                            best["config"]["N"]):
-                        best = d
+                if (d.get("metric") != "spotrf_gflops_per_chip"
+                        or not d.get("value")):
+                    continue
+                cfg = d.get("config", {})
+                if want_n is not None and cfg.get("N") != want_n:
+                    continue
+                if best_any is None or cfg.get("N", 0) > \
+                        best_any["config"].get("N", 0):
+                    best_any = d
+                # pre-variant captures carry no variant field; they were
+                # tile-DAG runs
+                if cfg.get("variant", "tile") != want_variant:
+                    continue
+                if best is None or cfg.get("N", 0) > \
+                        best["config"].get("N", 0):
+                    best = d
     except OSError:
         return None
+    best = best or best_any
     if best is None:
         return None
     best["captured"] = "earlier this round (tunnel down at bench time)"

@@ -325,7 +325,18 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
 
     # --------------------------------------------------------------- chores
     pshp = (NN, nb)
-    for d in as_device_list(dev):
+    devs = as_device_list(dev)
+    # pre-stage this rank's index segments as ONE stacked device array
+    # per device: every wave's KS/JS gather then rides the fused
+    # (stack, idx) path instead of an eager per-wave stack of h2d'd
+    # scalars
+    local = [k2 for k2 in range(nt) if pidx.rank_of(k2) == pidx.myrank]
+    seg_host = np.asarray(local, dtype=np.int32).reshape(-1, 1)
+    for d in devs:
+        if local:
+            from ..device.bench_utils import install_device_segments
+            install_device_segments(
+                d, pidx, d._jax.device_put(seg_host, d.device))
         d.attach(fa, tp, kernel=k_panel_factor, reads=["P", "KS"],
                  writes=["P"], shapes={"P": pshp, "KS": (1,)},
                  dtypes={"P": np.dtype(dt), "KS": np.dtype(np.int32)})

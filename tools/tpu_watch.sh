@@ -11,8 +11,9 @@
 # runtime-vs-GSPMD point (VERDICT #9), then the cross-process device
 # data-plane table (VERDICT #5), then the larger spotrf rungs.
 cd /root/repo
-OUT=/tmp/spotrf_r4.jsonl
-STATE=/tmp/spotrf_r4.done
+# log path shared with bench.py's cached-capture fallback
+OUT=${PTC_WATCH_LOG:-/tmp/spotrf_r4.jsonl}
+STATE=${PTC_WATCH_STATE:-/tmp/spotrf_r4.done}
 touch $STATE
 
 run_step() {  # name, command...
