@@ -144,3 +144,28 @@ def test_device_resident_waves_fuse_gathers():
         assert s["fused_flows"] > 0, s
         assert s["eager_gathers"] <= s["batches"] * 2, s
         dev.stop()
+
+
+def test_byte_capped_chunking(monkeypatch):
+    """A wave whose stacked operands exceed PTC_DEVICE_BATCH_BYTES splits
+    into power-of-two chunks (buckets never pad past the cap) and still
+    computes the right answer."""
+    monkeypatch.setenv("PTC_DEVICE_BATCH_BYTES", "40000")  # ~3 tiles of 32x32
+    N, nb = 256, 32
+    spd = _spd(N)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(spd)
+        A.register(ctx, "A")
+        dev = TpuDevice(ctx)
+        assert dev.batch_max_bytes == 40000
+        tp = build_potrf(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        np.testing.assert_allclose(np.tril(A.to_dense()),
+                                   np.linalg.cholesky(spd),
+                                   rtol=1e-4, atol=1e-4)
+        # 8x8 tiles -> wide GEMM waves exist; the cap forces them apart
+        assert dev.stats["batches"] > 8, dev.stats
+        dev.stop()
