@@ -140,6 +140,13 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False,
             import jax
             hbm = _device_hbm(jax.devices()[0])
             cache_bytes = max(2 << 30, int(hbm - N * N * 4 - (3 << 30)))
+            # size the per-call byte cap from the same headroom: bigger
+            # chunks = fewer device calls per U wave (a wave split 8 ways
+            # costs 8 round trips through the tunnel), bounded so the
+            # in+out stacks of one call fit beside the matrix
+            os.environ.setdefault(
+                "PTC_DEVICE_BATCH_BYTES",
+                str(max(1 << 30, int(hbm - N * N * 4 - (3 << 30)) // 3)))
         dev = TpuDevice(ctx, cache_bytes=cache_bytes)
         t_g0 = time.perf_counter()
         if variant == "panel":
