@@ -209,3 +209,54 @@ def test_all2all_ctl():
         tp.run()
         tp.wait()
     assert sorted(consumed) == list(range(n))
+
+
+def test_merge_sort_dtd():
+    """Bottom-up merge sort with DTD (tests/apps/merge_sort behavior):
+    leaves sort locally, each tree level merges two sorted runs into a
+    parent buffer — log2(nt) levels of dynamically discovered tasks."""
+    nt, seg = 8, 16
+    rng = np.random.default_rng(3)
+    flat = rng.integers(0, 1000, nt * seg).astype(np.int64)
+    with pt.Context(nb_workers=2) as ctx:
+        datas = {}
+        for j in range(nt):
+            datas[(0, j)] = ctx.data(
+                j, flat[j * seg:(j + 1) * seg].copy())
+        dtp = DtdTaskpool(ctx, window=64)
+        tiles = {k: dtp.tile_of(d) for k, d in datas.items()}
+
+        def sort_leaf(view):
+            a = view.data(0, dtype=np.int64)
+            a[...] = np.sort(a)
+
+        for j in range(nt):
+            dtp.insert_task(sort_leaf, (tiles[(0, j)], "INOUT"))
+
+        level, width, key = 0, nt, nt
+        while width > 1:
+            sz = seg * (nt // width) * 2
+            for j in range(width // 2):
+                dst = ctx.data(key, np.zeros(sz, dtype=np.int64))
+                key += 1
+                datas[(level + 1, j)] = dst
+                tiles[(level + 1, j)] = dtp.tile_of(dst)
+
+                def merge(view, half=sz // 2):
+                    a = view.data(0, dtype=np.int64)[:half]
+                    b = view.data(1, dtype=np.int64)[:half]
+                    o = view.data(2, dtype=np.int64)
+                    # two sorted runs -> one sorted run
+                    o[...] = np.concatenate([a, b])
+                    o.sort(kind="mergesort")
+
+                dtp.insert_task(merge,
+                                (tiles[(level, 2 * j)], "INPUT"),
+                                (tiles[(level, 2 * j + 1)], "INPUT"),
+                                (tiles[(level + 1, j)], "OUTPUT"))
+            level += 1
+            width //= 2
+        dtp.wait()
+        out = datas[(level, 0)].array
+        dtp.destroy()
+    np.testing.assert_array_equal(out, np.sort(flat))
