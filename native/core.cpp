@@ -2408,6 +2408,13 @@ int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   return n;
 }
 
+/* externally-sourced trace event (device manager dispatch spans):
+ * same buffer, dictionary, and PINS fan-out as native events */
+void ptc_prof_event(ptc_context_t *ctx, int64_t key, int64_t phase,
+                    int64_t class_id, int64_t l0, int64_t l1, int64_t aux) {
+  ptc_prof_push(ctx, -1, key, phase, class_id, l0, l1, aux);
+}
+
 /* per-worker steal counters (selects served from a victim's queue);
  * 0 for global-queue schedulers.  (Reference observability role:
  * mca/pins/print_steals.) */

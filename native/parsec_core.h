@@ -295,6 +295,11 @@ void ptc_profile_enable(ptc_context_t *ctx, int32_t enable);
  * TASKS_SCHEDULED analog) -> out[0..cap); returns count */
 int64_t ptc_worker_stats(ptc_context_t *ctx, int64_t *out, int64_t cap);
 int64_t ptc_worker_steals(ptc_context_t *ctx, int64_t *out, int64_t cap);
+/* externally-sourced trace event (device manager spans): same buffer,
+ * dictionary, and PINS fan-out as native events; no-op when both
+ * profiling and PINS are off */
+void ptc_prof_event(ptc_context_t *ctx, int64_t key, int64_t phase,
+                    int64_t class_id, int64_t l0, int64_t l1, int64_t aux);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
 

@@ -445,6 +445,9 @@ enum {
   PROF_KEY_EDGE = 2,      /* dep edge src->dst (pair of events)       */
   PROF_KEY_COMM_SEND = 3, /* per-target activation send: instant span
                            * (begin+end, same t), aux = payload bytes */
+  PROF_KEY_DEVICE = 5,    /* device dispatch call begin/end (emitted by
+                             the device manager through ptc_prof_event;
+                             l0 = lanes in the batched call)            */
   PROF_KEY_COMM_RECV = 4, /* per-target activation delivery: instant
                            * span, aux = payload bytes                */
 };

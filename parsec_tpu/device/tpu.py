@@ -1256,7 +1256,25 @@ class TpuDevice:
             return
         self._dispatch_group_chunk(body, tasks)
 
+    def _prof(self, phase: int, body: "_DeviceBody", lanes: int) -> None:
+        """DEVICE_DISPATCH trace span: begin at gather/dispatch start,
+        end after the async enqueue.  Same native buffer, dictionary,
+        and PINS fan-out as worker events; no-op when both are off.
+        l1 carries the device's queue id so concurrent same-class spans
+        from sibling devices pair and render distinctly."""
+        from ..profiling.trace import KEY_DEVICE
+        cid = body.tc.id if body.tc is not None else -1
+        N.lib.ptc_prof_event(self.ctx._ptr, KEY_DEVICE, phase, cid,
+                             lanes, self.qid, 0)
+
     def _dispatch_group_chunk(self, body: _DeviceBody, tasks: List):
+        self._prof(0, body, len(tasks))
+        try:
+            self._dispatch_group_run(body, tasks)
+        finally:
+            self._prof(1, body, len(tasks))
+
+    def _dispatch_group_run(self, body: _DeviceBody, tasks: List):
         views = [body.make_view(t) for t in tasks]
         bucket = _bucket(len(tasks))
         try:
@@ -1341,6 +1359,13 @@ class TpuDevice:
             self.ctx.task_complete(t)
 
     def _dispatch_one(self, body, task):
+        self._prof(0, body, 1)
+        try:
+            self._dispatch_one_run(body, task)
+        finally:
+            self._prof(1, body, 1)
+
+    def _dispatch_one_run(self, body, task):
         view = body.make_view(task)
         try:
             # Inputs still living as stack slices are selected INSIDE the

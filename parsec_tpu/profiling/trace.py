@@ -22,6 +22,7 @@ KEY_RELEASE = 1    # release_deps begin/end
 KEY_EDGE = 2       # dep edge, consecutive src(phase0)/dst(phase1) pair
 KEY_COMM_SEND = 3  # per-target activation send (instant span), aux = bytes
 KEY_COMM_RECV = 4  # per-target activation delivery (instant span)
+KEY_DEVICE = 5     # device dispatch call begin/end, l0 = lanes
 
 _MAGIC = b"#PTCPROF"
 _VERSION = 1
@@ -32,6 +33,7 @@ _DEFAULT_KEYS = {
     KEY_EDGE: ("EDGE", "#888888"),
     KEY_COMM_SEND: ("COMM_SEND", "#ff0000"),
     KEY_COMM_RECV: ("COMM_RECV", "#ff8800"),
+    KEY_DEVICE: ("DEVICE_DISPATCH", "#aa00ff"),
 }
 
 

@@ -75,3 +75,39 @@ def test_trace_save_load_merge(tmp_path):
     # per-rank exec counts survive the merge
     assert len(df[(df["rank"] == 0) & (df["key"] == KEY_EXEC)]) == 6
     assert len(df[(df["rank"] == 1) & (df["key"] == KEY_EXEC)]) == 4
+
+
+def test_device_dispatch_spans(monkeypatch):
+    """Device-executed DAGs are visible in traces: the manager emits
+    DEVICE_DISPATCH spans (key 5, l0 = lanes) through the same native
+    buffer/PINS sink as worker events — a device-heavy potrf must not
+    produce an execution-empty trace."""
+    import jax
+    from parsec_tpu.algos import build_potrf
+    from parsec_tpu.data import TwoDimBlockCyclic
+    from parsec_tpu.device import TpuDevice
+    from parsec_tpu.profiling import KEY_DEVICE
+
+    rng = np.random.default_rng(0)
+    N, nb2 = 96, 32
+    M = rng.standard_normal((N, N), dtype=np.float32)
+    spd = M @ M.T + N * np.eye(N, dtype=np.float32)
+    monkeypatch.setenv("PTC_DEVICE_BATCH_WAIT_MS", "5")  # deterministic waves
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.profile_enable(True)
+        A = TwoDimBlockCyclic(N, N, nb2, nb2, dtype=np.float32)
+        A.from_dense(spd)
+        A.register(ctx, "A")
+        dev = TpuDevice(ctx)
+        tp = build_potrf(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        tr = take_trace(ctx, class_names=["POTRF", "TRSM", "SYRK", "GEMM"])
+        dev.stop()
+    df = tr.to_pandas()  # paired spans: one row per begin/end pair
+    dd = df[df["key"] == KEY_DEVICE]
+    assert len(dd) > 0, df
+    assert dd["name"].eq("DEVICE_DISPATCH").all()
+    assert (dd["dur_ns"] >= 0).all()
+    assert dd["l0"].max() > 1  # a batched wave existed
