@@ -76,6 +76,7 @@ class LiveMonitor:
             "t": round(time.time() - self._t0, 3),
             "rank": ctx.myrank,
             "workers": ctx.worker_stats(),
+            "steals": ctx.worker_steals(),
         }
         for i, dev in enumerate(ctx._devices):
             if not hasattr(dev, "stats"):
