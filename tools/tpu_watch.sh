@@ -19,6 +19,16 @@ touch $STATE
 run_step() {  # name, command...
   local name="$1"; shift
   grep -q "^$name$" $STATE && return 0
+  # 2-strike rule: a step that failed twice (bad rung for this chip,
+  # persistent crash) is retired so it cannot eat every future tunnel
+  # window retrying; later steps still get their chance
+  local fails
+  fails=$(grep -c "^$name$" $STATE.fail 2>/dev/null || echo 0)
+  if [ "$fails" -ge 2 ]; then
+    echo "$(date -u +%H:%M:%S) step $name retired after $fails failures" >> $OUT
+    echo "$name" >> $STATE
+    return 0
+  fi
   echo "$(date -u +%H:%M:%S) step $name start" >> $OUT
   timeout 2400 "$@" >> $OUT 2>&1
   local rc=$?
@@ -27,6 +37,7 @@ run_step() {  # name, command...
     echo "$name" >> $STATE
     return 0
   fi
+  echo "$name" >> $STATE.fail
   return 1
 }
 
