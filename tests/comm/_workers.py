@@ -1344,7 +1344,7 @@ END
 
 
 def potrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
-                      nb: int = 16):
+                      nb: int = 16, use_device: bool = False):
     """Distributed PANEL-granular Cholesky: full-height N x nb panels
     cyclic over ranks (the ScaLAPACK-style 1-D panel distribution).
     Every factored panel F(k) broadcasts to the ranks owning later
@@ -1362,10 +1362,19 @@ def potrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
                               myrank=rank, dtype=np.float32)
         A.register(ctx, "A")
         A.from_dense(full)
-        tp = build_potrf_panels(ctx, A)
+        dev = None
+        if use_device:
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # loopback: no tunnel
+            from parsec_tpu.device.tpu import TpuDevice
+            dev = TpuDevice(ctx)
+        tp = build_potrf_panels(ctx, A, dev=dev)
         tp.run()
         tp.wait()
         ctx.comm_fence()
+        if dev is not None:
+            dev.flush()
+            dev.stop()
         L = np.tril(np.linalg.cholesky(full.astype(np.float64)))
         for j in range(A.nt):
             if A.rank_of(0, j) != rank:

@@ -357,3 +357,11 @@ def test_potrf_panels_2ranks_rendezvous():
     # N x nb = 512x64 fp32 panels = 128 KiB: above the eager threshold,
     # every cross-rank panel flow rides the rendezvous GET protocol
     _run_spmd(_workers.potrf_panels_dist, 2, timeout=240, N=512, nb=64)
+
+
+def test_potrf_panels_2ranks_device():
+    """Panel dataflow with device chores across ranks: factored panels
+    are device-resident, so cross-rank F->U flows advertise PK_DEVICE
+    and the whole N x nb payload moves through the device data plane."""
+    _run_spmd(_workers.potrf_panels_dist, 2, timeout=240, N=128, nb=16,
+              use_device=True)
