@@ -5,7 +5,7 @@ from .lu import build_getrf_nopiv, getrf_flops, getrf_nopiv_reference
 from .matrix_ops import (build_apply, build_map_operator, build_reduce_col,
                          build_reduce_row)
 from .potrf import (build_potrf, build_potrf_panels,
-                    potrf_flops, run_potrf)
+                    build_potrs_panels, potrf_flops, run_potrf)
 from .redistribute import redistribute
 from .qr import build_geqrf, geqrf_flops
 from .trsm import build_trsm
@@ -13,7 +13,8 @@ from .reshape import build_reshape_dtype, reshape_geometry
 
 __all__ = ["build_gemm", "build_gemm_dist", "run_gemm",
            "build_getrf_nopiv", "getrf_flops", "getrf_nopiv_reference",
-           "build_potrf", "build_potrf_panels", "run_potrf",
+           "build_potrf", "build_potrf_panels", "build_potrs_panels",
+           "run_potrf",
            "potrf_flops", "build_apply", "build_map_operator",
            "build_reduce_col", "build_reduce_row", "redistribute",
            "build_reshape_dtype", "reshape_geometry", "build_trsm",

@@ -268,6 +268,25 @@ def k_panel_update(pk, js, pj):
                                     preferred_element_type=pj.dtype)
 
 
+def _register_pidx(ctx: pt.Context, A: TwoDimBlockCyclic, name: str):
+    """Register (once) the int32 panel-index collection `name + "_pidx"`
+    following A's panel-cyclic map, so every Mem(pidx, j) read is
+    co-located with the task that issues it."""
+    from ..data.collections import VectorCyclic
+    pidx_name = name + "_pidx"
+    if pidx_name in ctx.collections:
+        return pidx_name, ctx._pidx_colls[pidx_name]
+    pidx = VectorCyclic(A.nt, 1, nodes=A.nodes, myrank=A.myrank,
+                        dtype=np.int32)
+    for j in range(A.nt):
+        pidx.seg(j)[0] = j
+    pidx.register(ctx, pidx_name)
+    if not hasattr(ctx, "_pidx_colls"):
+        ctx._pidx_colls = {}
+    ctx._pidx_colls[pidx_name] = pidx
+    return pidx_name, pidx
+
+
 def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
                        dev: Optional[TpuDevice] = None,
                        name: str = "A") -> pt.Taskpool:
@@ -275,7 +294,6 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
     of N x nb panels: TwoDimBlockCyclic(N, N, N, nb) registered under
     `name`.  Also registers an int32 index collection under
     `name + "_pidx"`."""
-    from ..data.collections import VectorCyclic
     assert A.mt == 1 and A.M == A.N and A.M == A.mb, \
         "panel collection: mb == M (one block row of panels)"
     assert A.P == 1, "panels distribute 1-D: P must be 1 (Q = nodes)"
@@ -283,15 +301,7 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
     nb = A.nb
     NN = A.M
     dt = A.dtype
-    pidx_name = name + "_pidx"
-    # same cyclic map as the panels (Q == nodes, rank_of(j) == j % nodes):
-    # every Mem(pidx, j) read is co-located with the task that issues it,
-    # so the index tiles never cross ranks
-    pidx = VectorCyclic(nt, 1, nodes=A.nodes, myrank=A.myrank,
-                        dtype=np.int32)
-    for j in range(nt):
-        pidx.seg(j)[0] = j
-    pidx.register(ctx, pidx_name)
+    pidx_name, pidx = _register_pidx(ctx, A, name)
     tp = pt.Taskpool(ctx, globals={"NT": nt - 1})
     k, j = pt.L("k"), pt.L("j")
     NT = pt.G("NT")
@@ -367,6 +377,127 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
 
     fa.body(b_factor)
     up.body(b_update)
+    return tp
+
+
+def k_panel_fwd(p, ks, b):
+    """Forward-substitution step on the whole RHS block: solve the
+    diagonal rows against L_kk, then eliminate below."""
+    import jax
+    import jax.numpy as jnp
+    nb = p.shape[1]
+    off = ks[0] * nb
+    lkk = jax.lax.dynamic_slice(p, (off, 0), (nb, nb))
+    bk = jax.lax.dynamic_slice(b, (off, 0), (nb, b.shape[1]))
+    yk = jax.scipy.linalg.solve_triangular(lkk, bk, lower=True)
+    upd = b - jax.lax.dot_general(p, yk, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=b.dtype)
+    rows = jnp.arange(b.shape[0], dtype=ks.dtype)[:, None]
+    # rows above the block keep their solved values; the block row takes
+    # y_k; rows below take the eliminated update
+    out = jnp.where(rows >= off + nb, upd, b)
+    return jax.lax.dynamic_update_slice(out, yk, (off, 0))
+
+
+def k_panel_bwd(p, ks, b):
+    """Backward-substitution step: x_k = L_kk^-T (y_k - L_below^T x_below)."""
+    import jax
+    import jax.numpy as jnp
+    nb = p.shape[1]
+    off = ks[0] * nb
+    lkk = jax.lax.dynamic_slice(p, (off, 0), (nb, nb))
+    # contribution of already-solved rows BELOW the block: P rows below
+    # hold L[below, k-block]; mask rows <= off+nb so only solved x rows
+    # contribute
+    rows = jnp.arange(b.shape[0], dtype=ks.dtype)[:, None]
+    xmask = jnp.where(rows >= off + nb, b, jnp.zeros((), b.dtype))
+    contrib = jax.lax.dot_general(p, xmask, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=b.dtype)
+    yk = jax.lax.dynamic_slice(b, (off, 0), (nb, b.shape[1]))
+    xk = jax.scipy.linalg.solve_triangular(lkk, yk - contrib, lower=True,
+                                           trans="T")
+    return jax.lax.dynamic_update_slice(b, xk, (off, 0))
+
+
+def build_potrs_panels(ctx: pt.Context, A: TwoDimBlockCyclic, B,
+                       dev: Optional[TpuDevice] = None,
+                       name: str = "A", bname: str = "B") -> pt.Taskpool:
+    """Panel-granular triangular solve after build_potrf_panels (the
+    dpotrs role; potrf_panels + potrs_panels = posv).  `A` holds the
+    factored panels (same collection the factorization ran on); `B` is a
+    single-tile (N, nrhs) collection registered under `bname`.  Forward
+    substitution walks panels 0..NT-1, backward NT-1..0 — 2*NT tasks,
+    each one tall MXU contraction over the whole RHS block.
+    Single-rank form (the distributed solve rides the tiled
+    algos/trsm.py)."""
+    assert A.mt == 1 and A.M == A.mb
+    assert A.nodes == 1, \
+        "potrs_panels is the single-rank solve (distributed: algos/trsm.py)"
+    nt = A.nt
+    nb = A.nb
+    NN = A.M
+    dt = A.dtype
+    nrhs = B.nb
+    assert B.mt == 1 and B.nt == 1 and B.mb == NN
+    assert B.dtype == A.dtype, "A and B dtypes must match"
+    pidx_name, _ = _register_pidx(ctx, A, name)
+    tp = pt.Taskpool(ctx, globals={"NT": nt - 1})
+    k = pt.L("k")
+    NT = pt.G("NT")
+
+    fw = tp.task_class("FWD")
+    fw.param("k", 0, NT)
+    fw.affinity(bname, 0, 0)
+    fw.flow("P", "READ", pt.In(pt.Mem(name, 0, k)))
+    fw.flow("KS", "READ", pt.In(pt.Mem(pidx_name, k)))
+    fw.flow("B", "RW",
+            pt.In(pt.Mem(bname, 0, 0), guard=(k == 0)),
+            pt.In(pt.Ref("FWD", k - 1, flow="B")),
+            pt.Out(pt.Ref("FWD", k + 1, flow="B"), guard=(k < NT)),
+            pt.Out(pt.Ref("BWD", NT, flow="B"), guard=(k == NT)))
+
+    bw = tp.task_class("BWD")
+    bw.param("k", 0, NT)
+    bw.affinity(bname, 0, 0)
+    bw.flow("P", "READ", pt.In(pt.Mem(name, 0, k)))
+    bw.flow("KS", "READ", pt.In(pt.Mem(pidx_name, k)))
+    bw.flow("B", "RW",
+            pt.In(pt.Ref("FWD", NT, flow="B"), guard=(k == NT)),
+            pt.In(pt.Ref("BWD", k + 1, flow="B"), guard=(k < NT)),
+            pt.Out(pt.Ref("BWD", k - 1, flow="B"), guard=(k > 0)),
+            pt.Out(pt.Mem(bname, 0, 0), guard=(k == 0)))
+
+    pshp, bshp = (NN, nb), (NN, nrhs)
+    for d in as_device_list(dev):
+        d.attach(fw, tp, kernel=k_panel_fwd, reads=["P", "KS", "B"],
+                 writes=["B"], shapes={"P": pshp, "KS": (1,), "B": bshp},
+                 dtypes={"P": np.dtype(dt), "KS": np.dtype(np.int32),
+                         "B": np.dtype(dt)}, sync_mem_out=True)
+        d.attach(bw, tp, kernel=k_panel_bwd, reads=["P", "KS", "B"],
+                 writes=["B"], shapes={"P": pshp, "KS": (1,), "B": bshp},
+                 dtypes={"P": np.dtype(dt), "KS": np.dtype(np.int32),
+                         "B": np.dtype(dt)}, sync_mem_out=True)
+
+    def b_fwd(t):
+        p = t.data("P", dt, pshp)
+        kk = int(t.data("KS", np.int32, (1,))[0])
+        b = t.data("B", dt, bshp)
+        off = kk * nb
+        yk = np.linalg.solve(p[off:off + nb], b[off:off + nb])
+        b[off:off + nb] = yk
+        b[off + nb:] -= p[off + nb:] @ yk
+
+    def b_bwd(t):
+        p = t.data("P", dt, pshp)
+        kk = int(t.data("KS", np.int32, (1,))[0])
+        b = t.data("B", dt, bshp)
+        off = kk * nb
+        lkk = p[off:off + nb]
+        contrib = p[off + nb:].T @ b[off + nb:]
+        b[off:off + nb] = np.linalg.solve(lkk.T, b[off:off + nb] - contrib)
+
+    fw.body(b_fwd)
+    bw.body(b_bwd)
     return tp
 
 

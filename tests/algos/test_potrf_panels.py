@@ -57,3 +57,42 @@ def test_panels_device_waves_batch():
     L, ref, stats = _run(256, 32, dev_on=True)
     np.testing.assert_allclose(L, ref, rtol=2e-3, atol=2e-3)
     assert stats["batches"] > 0, stats
+
+
+def _posv_panels(N, nb, nrhs, dev_on):
+    """factor with build_potrf_panels then solve with build_potrs_panels
+    (the dposv composition at panel granularity)."""
+    spd = _spd(N, seed=5)
+    rng = np.random.default_rng(6)
+    rhs = rng.standard_normal((N, nrhs)).astype(np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        from parsec_tpu.algos import build_potrs_panels
+        A = TwoDimBlockCyclic(N, N, N, nb, dtype=np.float32)
+        for j in range(A.nt):
+            A.tile(0, j)[...] = spd[:, j * nb:(j + 1) * nb]
+        A.register(ctx, "A")
+        B = TwoDimBlockCyclic(N, nrhs, N, nrhs, dtype=np.float32)
+        B.tile(0, 0)[...] = rhs
+        B.register(ctx, "B")
+        dev = TpuDevice(ctx) if dev_on else None
+        tp = build_potrf_panels(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        tp2 = build_potrs_panels(ctx, A, B, dev=dev)
+        tp2.run()
+        tp2.wait()
+        if dev is not None:
+            dev.flush()
+            dev.stop()
+        x = B.tile(0, 0).copy()
+    ref = np.linalg.solve(spd.astype(np.float64), rhs.astype(np.float64))
+    err = np.abs(x - ref).max() / max(1.0, np.abs(ref).max())
+    assert err < 5e-3, err
+
+
+def test_posv_panels_host():
+    _posv_panels(128, 32, 8, dev_on=False)
+
+
+def test_posv_panels_device():
+    _posv_panels(192, 32, 4, dev_on=True)
