@@ -1,7 +1,8 @@
 from .gemm import build_gemm, build_gemm_dist, run_gemm
 from .inverse import (build_lauum, build_trtri, lauum_flops, run_potri,
                       trtri_flops)
-from .lu import build_getrf_nopiv, getrf_flops, getrf_nopiv_reference
+from .lu import (build_getrf_nopiv, build_getrf_panels,
+                 getrf_flops, getrf_nopiv_reference)
 from .matrix_ops import (build_apply, build_map_operator, build_reduce_col,
                          build_reduce_row)
 from .potrf import (build_potrf, build_potrf_panels,
@@ -12,7 +13,8 @@ from .trsm import build_trsm
 from .reshape import build_reshape_dtype, reshape_geometry
 
 __all__ = ["build_gemm", "build_gemm_dist", "run_gemm",
-           "build_getrf_nopiv", "getrf_flops", "getrf_nopiv_reference",
+           "build_getrf_nopiv", "build_getrf_panels", "getrf_flops",
+           "getrf_nopiv_reference",
            "build_potrf", "build_potrf_panels", "build_potrs_panels",
            "run_potrf",
            "potrf_flops", "build_apply", "build_map_operator",

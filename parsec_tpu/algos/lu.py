@@ -198,3 +198,87 @@ def getrf_nopiv_reference(full: np.ndarray) -> np.ndarray:
 
 def getrf_flops(N: int) -> float:
     return 2.0 * N ** 3 / 3.0
+
+
+# ------------------------------------------------------ panel variant
+# Same coarse right-looking shape as build_potrf_panels (one tall MXU
+# contraction per trailing-panel update, shared DAG in
+# potrf._build_panel_factorization), LU math:
+#   F(k)   : diag block -> packed L\U (Doolittle); rows below become
+#            L_below = P_below U_kk^-1; rows ABOVE stay (they hold the
+#            finalized U rows of earlier panels)
+#   U(k,j) : u_kj = unit_lower_solve(L_kk, P_j[kblock]);
+#            P_j[kblock] = u_kj; P_j[below] -= L_below @ u_kj
+
+
+def k_panel_getrf(p, ks):
+    """Returns (factored panel, ki): ki forwards the panel index to the
+    U wave as data (U solves at row block k, and pidx[k] is not
+    co-located with U(k, j) on rank j)."""
+    import jax
+    import jax.numpy as jnp
+    nb = p.shape[1]
+    off = ks[0] * nb
+    d = jax.lax.dynamic_slice(p, (off, 0), (nb, nb))
+    packed = k_getrf_nopiv(d)
+    ukk = jnp.triu(packed)
+    rows = jnp.arange(p.shape[0], dtype=ks.dtype)[:, None]
+    below = jnp.where(rows >= off + nb, p, jnp.zeros((), p.dtype))
+    # X U_kk = below  ->  X = (U_kk^T \ below^T)^T
+    lb = jax.scipy.linalg.solve_triangular(ukk.T, below.T, lower=True).T
+    out = jnp.where(rows >= off + nb, lb, p)
+    return jax.lax.dynamic_update_slice(out, packed, (off, 0)), ks
+
+
+def k_panel_getrf_update(pk, ki, pj):
+    import jax
+    import jax.numpy as jnp
+    nb = pk.shape[1]
+    off = ki[0] * nb
+    lkk = jax.lax.dynamic_slice(pk, (off, 0), (nb, nb))
+    bk = jax.lax.dynamic_slice(pj, (off, 0), (nb, nb))
+    ukj = jax.scipy.linalg.solve_triangular(lkk, bk, lower=True,
+                                            unit_diagonal=True)
+    rows = jnp.arange(pk.shape[0], dtype=ki.dtype)[:, None]
+    lmask = jnp.where(rows >= off + nb, pk, jnp.zeros((), pk.dtype))
+    upd = pj - jax.lax.dot_general(lmask, ukj, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=pj.dtype)
+    return jax.lax.dynamic_update_slice(upd, ukj, (off, 0))
+
+
+def _getrf_b_factor(nt, nb, pshp, dt):
+    def b_factor(t):
+        p = t.data("P", dt, pshp)
+        kk = int(t.data("KS", np.int32, (1,))[0])
+        t.data("KI", np.int32, (1,))[0] = kk
+        off = kk * nb
+        packed = _getrf_np(p[off:off + nb].copy())
+        ukk = np.triu(packed)
+        p[off + nb:] = np.linalg.solve(ukk.T, p[off + nb:].T).T
+        p[off:off + nb] = packed
+    return b_factor
+
+
+def _getrf_b_update(nt, nb, pshp, dt):
+    def b_update(t):
+        pk_ = t.data("PK", dt, pshp)
+        kk = int(t.data("KI", np.int32, (1,))[0])
+        pj_ = t.data("PJ", dt, pshp)
+        off = kk * nb
+        lkk = np.tril(pk_[off:off + nb], -1) + np.eye(nb, dtype=dt)
+        ukj = np.linalg.solve(lkk, pj_[off:off + nb])
+        pj_[off + nb:] -= pk_[off + nb:] @ ukj
+        pj_[off:off + nb] = ukj
+    return b_update
+
+
+def build_getrf_panels(ctx, A, dev=None, name: str = "A"):
+    """Panel-granular no-pivot LU: the getrf analog of
+    build_potrf_panels (same shared DAG; LU kernels/bodies).  Result
+    layout per panel j: rows above j*nb = finalized U rows, the block =
+    packed L\\U, rows below = L columns — assembling tril(,-1)+I and
+    triu reproduces getrf_nopiv_reference's packed dense."""
+    from .potrf import _build_panel_factorization
+    return _build_panel_factorization(
+        ctx, A, dev, name, k_panel_getrf, k_panel_getrf_update,
+        _getrf_b_factor, _getrf_b_update, update_uses="k")

@@ -287,13 +287,30 @@ def _register_pidx(ctx: pt.Context, A: TwoDimBlockCyclic, name: str):
     return pidx_name, pidx
 
 
-def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
-                       dev: Optional[TpuDevice] = None,
-                       name: str = "A") -> pt.Taskpool:
-    """Panel-granular Cholesky taskpool.  `A` must be a single block row
-    of N x nb panels: TwoDimBlockCyclic(N, N, N, nb) registered under
-    `name`.  Also registers an int32 index collection under
-    `name + "_pidx"`."""
+def _build_panel_factorization(ctx: pt.Context, A: TwoDimBlockCyclic,
+                               dev, name: str,
+                               k_factor, k_update,
+                               b_factor, b_update,
+                               update_uses: str = "j") -> pt.Taskpool:
+    """Shared panel-factorization DAG (right-looking, full-height
+    panels): F(k) factors panel k, U(k, j) applies its rank-nb update to
+    panel j, a U wave batches into one vmapped MXU call.  The algorithm
+    lives in the kernel/body pair: Cholesky (build_potrf_panels) and
+    no-pivot LU (build_getrf_panels) share this graph.
+
+      F(k)   : P RW (chain from U(k-1,k)), KS index READ
+      U(k,j) : PK READ (broadcast from F(k)), an index flow, PJ RW chain
+
+    update_uses selects which panel index U's kernel needs:
+      "j" — the TARGET panel's index, read co-located from the pidx
+            collection (Cholesky slices the source panel at row block j)
+      "k" — the SOURCE panel's index; pidx[k] is NOT co-located with
+            U(k, j) on rank j, so F(k) emits it as a tiny KI arena flow
+            that broadcasts WITH the panel (distributed-correct; LU
+            solves at row block k).  k_factor then returns (panel, ki).
+
+    Host bodies are built by b_factor/b_update factories given
+    (nt, nb, pshp, dt)."""
     assert A.mt == 1 and A.M == A.N and A.M == A.mb, \
         "panel collection: mb == M (one block row of panels)"
     assert A.P == 1, "panels distribute 1-D: P must be 1 (Q = nodes)"
@@ -318,6 +335,13 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
                    guard=(k < NT)),
             pt.Out(pt.Mem(name, 0, k)))
     fa.flow("KS", "READ", pt.In(pt.Mem(pidx_name, k)))
+    if update_uses == "k":
+        ki_arena = f"panel_ki_{name}"
+        ctx.register_arena(ki_arena, 4)
+        fa.flow("KI", "W",
+                pt.Out(pt.Ref("PU", k, pt.Range(k + 1, NT), flow="KI"),
+                       guard=(k < NT)),
+                arena=ki_arena)
 
     # ----------------------------------------------------------- U(k, j)
     up = tp.task_class("PU")
@@ -326,7 +350,10 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
     up.affinity(name, 0, j)
     up.priority((NT - k) * 1000 - j)
     up.flow("PK", "READ", pt.In(pt.Ref("PF", k, flow="P")))
-    up.flow("JS", "READ", pt.In(pt.Mem(pidx_name, j)))
+    if update_uses == "k":
+        up.flow("KI", "READ", pt.In(pt.Ref("PF", k, flow="KI")))
+    else:
+        up.flow("JS", "READ", pt.In(pt.Mem(pidx_name, j)))
     up.flow("PJ", "RW",
             pt.In(pt.Mem(name, 0, j), guard=(k == 0)),
             pt.In(pt.Ref("PU", k - 1, j, flow="PJ")),
@@ -347,15 +374,24 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
             from ..device.bench_utils import install_device_segments
             install_device_segments(
                 d, pidx, d._jax.device_put(seg_host, d.device))
-        d.attach(fa, tp, kernel=k_panel_factor, reads=["P", "KS"],
-                 writes=["P"], shapes={"P": pshp, "KS": (1,)},
-                 dtypes={"P": np.dtype(dt), "KS": np.dtype(np.int32)})
-        d.attach(up, tp, kernel=k_panel_update, reads=["PK", "JS", "PJ"],
+        idxf = "KI" if update_uses == "k" else "JS"
+        d.attach(fa, tp, kernel=k_factor, reads=["P", "KS"],
+                 writes=["P", "KI"] if update_uses == "k" else ["P"],
+                 shapes={"P": pshp, "KS": (1,), "KI": (1,)},
+                 dtypes={"P": np.dtype(dt), "KS": np.dtype(np.int32),
+                         "KI": np.dtype(np.int32)})
+        d.attach(up, tp, kernel=k_update, reads=["PK", idxf, "PJ"],
                  writes=["PJ"],
-                 shapes={"PK": pshp, "JS": (1,), "PJ": pshp},
-                 dtypes={"PK": np.dtype(dt), "JS": np.dtype(np.int32),
+                 shapes={"PK": pshp, idxf: (1,), "PJ": pshp},
+                 dtypes={"PK": np.dtype(dt), idxf: np.dtype(np.int32),
                          "PJ": np.dtype(dt)})
 
+    fa.body(b_factor(nt, nb, pshp, dt))
+    up.body(b_update(nt, nb, pshp, dt))
+    return tp
+
+
+def _potrf_b_factor(nt, nb, pshp, dt):
     def b_factor(t):
         p = t.data("P", dt, pshp)
         kk = int(t.data("KS", np.int32, (1,))[0])
@@ -367,17 +403,29 @@ def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
         x[:off] = 0
         x[off:off + nb] = l
         p[...] = x
+    return b_factor
 
+
+def _potrf_b_update(nt, nb, pshp, dt):
     def b_update(t):
         pk_ = t.data("PK", dt, pshp)
         jj = int(t.data("JS", np.int32, (1,))[0])
         pj_ = t.data("PJ", dt, pshp)
         off = jj * nb
         pj_ -= pk_ @ pk_[off:off + nb].T
+    return b_update
 
-    fa.body(b_factor)
-    up.body(b_update)
-    return tp
+
+def build_potrf_panels(ctx: pt.Context, A: TwoDimBlockCyclic,
+                       dev: Optional[TpuDevice] = None,
+                       name: str = "A") -> pt.Taskpool:
+    """Panel-granular Cholesky taskpool.  `A` must be a single block row
+    of N x nb panels: TwoDimBlockCyclic(N, N, N, nb) registered under
+    `name`.  Also registers an int32 index collection under
+    `name + "_pidx"`."""
+    return _build_panel_factorization(
+        ctx, A, dev, name, k_panel_factor, k_panel_update,
+        _potrf_b_factor, _potrf_b_update)
 
 
 def k_panel_fwd(p, ks, b):

@@ -1388,3 +1388,35 @@ def potrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
         assert rdv["registered_bytes"] == 0, rdv
         assert rdv["pending_pulls"] == 0, rdv
         ctx.comm_fini()
+
+
+def getrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
+                      nb: int = 16):
+    """Distributed panel-granular no-pivot LU: the factored panel AND its
+    index ride the broadcast to later-panel owners (the KI arena flow —
+    U solves at row block k, which is not derivable on rank j)."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.algos import build_getrf_panels, getrf_nopiv_reference
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    with ctx:
+        rng = np.random.default_rng(13)
+        full = (rng.normal(size=(N, N)) + N * np.eye(N)).astype(np.float32)
+        ref = getrf_nopiv_reference(full.astype(np.float64))
+        A = TwoDimBlockCyclic(N, N, N, nb, P=1, Q=nodes, nodes=nodes,
+                              myrank=rank, dtype=np.float32)
+        A.register(ctx, "A")
+        A.from_dense(full)
+        tp = build_getrf_panels(ctx, A)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        for j in range(A.nt):
+            if A.rank_of(0, j) != rank:
+                continue
+            np.testing.assert_allclose(
+                A.tile(0, j), ref[:, j * nb:(j + 1) * nb],
+                rtol=5e-3, atol=5e-3)
+        st = ctx.comm_stats()
+        assert st["msgs_sent"] > 0, st
+        ctx.comm_fini()

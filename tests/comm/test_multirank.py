@@ -365,3 +365,8 @@ def test_potrf_panels_2ranks_device():
     and the whole N x nb payload moves through the device data plane."""
     _run_spmd(_workers.potrf_panels_dist, 2, timeout=240, N=128, nb=16,
               use_device=True)
+
+
+def test_getrf_panels_2ranks():
+    """Distributed panel LU: the KI index flow broadcasts with the panel."""
+    _run_spmd(_workers.getrf_panels_dist, 2, timeout=180, N=128, nb=16)
