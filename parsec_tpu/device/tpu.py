@@ -997,6 +997,18 @@ class TpuDevice:
         False for kernels with cross-tile semantics."""
         if dtypes is None:
             dtypes = {f: np.dtype(dtype) for f in set(reads) | set(writes)}
+        # float64 without jax x64: device_put silently downcasts to
+        # float32 and the writeback would reinterpret mismatched bytes
+        # (observed: corrupted f64 host tiles).  TPUs have no f64 compute
+        # anyway — leave the class on its host chore, loudly.
+        if any(np.dtype(d) == np.float64 for d in dtypes.values()) \
+                and not self._jax.config.jax_enable_x64:
+            import sys as _sys
+            _sys.stderr.write(
+                f"ptc [device]: not attaching {getattr(tc, 'name', '?')}: "
+                "float64 flows need JAX_ENABLE_X64=1 (device would "
+                "silently downcast); host chore carries it\n")
+            return
         tc.body_device(self.qid, device="tpu")
         body = _DeviceBody(kernel, reads, writes, shapes, dtypes, tc, tp,
                            batch=batch)

@@ -123,3 +123,33 @@ def test_getrf_panels_matches_reference():
             for j in range(A.nt):
                 out[:, j * nb:(j + 1) * nb] = A.tile(0, j)
         np.testing.assert_allclose(out, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_panels_odd_sizes_and_float64():
+    """Non-power-of-two panel counts (odd wave widths exercise bucket
+    padding) and the float64 path."""
+    for N, nb, dt in ((160, 32, np.float32), (224, 32, np.float64)):
+        spd = _spd(N).astype(dt)
+        with pt.Context(nb_workers=2) as ctx:
+            A = TwoDimBlockCyclic(N, N, N, nb, dtype=dt)
+            for j in range(A.nt):
+                A.tile(0, j)[...] = spd[:, j * nb:(j + 1) * nb]
+            A.register(ctx, "A")
+            dev = TpuDevice(ctx)
+            tp = build_potrf_panels(ctx, A, dev=dev)
+            tp.run()
+            tp.wait()
+            dev.flush()
+            out = np.zeros((N, N), dt)
+            for j in range(A.nt):
+                out[:, j * nb:(j + 1) * nb] = A.tile(0, j)
+            import jax
+            if dt == np.float64 and not jax.config.jax_enable_x64:
+                # without jax x64, f64 classes must stay on host chores
+                # (device_put would silently downcast) — loud refusal
+                assert dev.stats["tasks"] == 0, dev.stats
+            dev.stop()
+        tol = 2e-3 if dt == np.float32 else 1e-8
+        np.testing.assert_allclose(np.tril(out),
+                                   np.linalg.cholesky(spd.astype(dt)),
+                                   rtol=tol, atol=tol)

@@ -1,9 +1,14 @@
 """The driver-artifact safety net: when the tunnel is down at bench
 time, bench.py reuses the round's best watcher-captured spotrf line
 (variant-aware, PTC_BENCH_N-aware, provenance-marked)."""
-import importlib
 import json
+import os
 import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 def _bench(monkeypatch, argv, log_path, env=None):
@@ -11,9 +16,7 @@ def _bench(monkeypatch, argv, log_path, env=None):
     for k, v in (env or {}).items():
         monkeypatch.setenv(k, v)
     monkeypatch.setattr(sys, "argv", argv)
-    sys.path.insert(0, "/root/repo")
-    import bench
-    importlib.reload(bench)
+    import bench  # reads argv/env at call time, not import time
     return bench
 
 
