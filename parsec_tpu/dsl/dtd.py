@@ -154,6 +154,15 @@ class DtdTaskpool:
         args, in order)."""
         if self._closed:
             raise RuntimeError("taskpool already closed")
+        # same hazard attach() guards: float64 without jax x64 silently
+        # downcasts on device and corrupts the writeback.  DTD device
+        # tasks have no host fallback chore, so fail loudly at insert.
+        if np.dtype(dtype) == np.float64 \
+                and not dev._jax.config.jax_enable_x64:
+            raise ValueError(
+                "insert_tpu_task: float64 needs JAX_ENABLE_X64=1 "
+                "(the device would silently downcast to float32); "
+                "use insert_task with a host body instead")
         t = N.lib.ptc_dtask_begin(self.tp._ptr, N.BODY_DEVICE, dev.qid,
                                   priority)
         reads, writes = [], []

@@ -135,3 +135,23 @@ def test_dtd_tiled_gemm_on_device():
         ref = A.to_dense() @ B.to_dense()
         np.testing.assert_allclose(C.to_dense(), ref, rtol=1e-3, atol=1e-3)
         dtd.destroy()
+
+
+def test_dtd_tpu_task_f64_refused():
+    """float64 device tasks without jax x64 would silently downcast —
+    insert_tpu_task must fail loudly (attach()'s guard, DTD edition)."""
+    import jax
+    import pytest
+    from parsec_tpu.device import TpuDevice
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 on: f64 device tasks are legitimate")
+    with pt.Context(nb_workers=1) as ctx:
+        d = ctx.data(0, np.zeros(4, dtype=np.float64))
+        dev = TpuDevice(ctx)
+        dtd = DtdTaskpool(ctx)
+        with pytest.raises(ValueError, match="JAX_ENABLE_X64"):
+            dtd.insert_tpu_task(dev, lambda a: a, (dtd.tile_of(d), "INOUT"),
+                                shapes={0: (4,)}, dtype=np.float64)
+        dtd.wait()
+        dev.stop()
+        dtd.destroy()
