@@ -42,6 +42,7 @@ class TransformerConfig:
     moe_k: int = 2
     dtype: object = jnp.float32
     use_flash: bool = False     # Pallas flash kernel for local attention
+    use_pallas_norm: bool = False  # Pallas fused RMSNorm (ops/rms_norm)
     remat: bool = False         # jax.checkpoint each block: recompute
     #                             activations in backward — HBM for FLOPs
     #                             (the standard long-context/deep-stack
@@ -53,7 +54,10 @@ class TransformerConfig:
     ep_axis: Optional[str] = "ep"   # commonly == dp_axis
 
 
-def _rms_norm(x, scale):
+def _rms_norm(x, scale, use_pallas: bool = False):
+    if use_pallas:
+        from ..ops import rms_norm
+        return rms_norm(x, scale)
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
@@ -144,7 +148,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh: Optional[Mesh]):
 
 
 def _block(x, bp, cfg: TransformerConfig, mesh: Optional[Mesh]):
-    h = _rms_norm(x, bp["ln1"])
+    h = _rms_norm(x, bp["ln1"], cfg.use_pallas_norm)
     qkv = jnp.einsum("bsd,dchn->bschn", h, bp["wqkv"],
                      preferred_element_type=jnp.float32).astype(x.dtype)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -152,7 +156,7 @@ def _block(x, bp, cfg: TransformerConfig, mesh: Optional[Mesh]):
     o = _attention(q, k, v, cfg, mesh)
     x = x + jnp.einsum("bshn,hnd->bsd", o, bp["wo"],
                        preferred_element_type=jnp.float32).astype(x.dtype)
-    h = _rms_norm(x, bp["ln2"])
+    h = _rms_norm(x, bp["ln2"], cfg.use_pallas_norm)
     if cfg.n_experts:
         if mesh is not None and cfg.ep_axis and \
                 mesh.shape.get(cfg.ep_axis, 1) > 1:
@@ -194,7 +198,7 @@ def forward(params, tokens, cfg: TransformerConfig,
 
     # scan over the stacked layer dim; shard_map regions nest fine inside
     x, _ = lax.scan(body, x, params["blocks"])
-    x = _rms_norm(x, params["ln_f"])
+    x = _rms_norm(x, params["ln_f"], cfg.use_pallas_norm)
     return jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
                       params["embed"].astype(jnp.float32))
 
@@ -224,7 +228,7 @@ def pipelined_forward(params, tokens, cfg: TransformerConfig, mesh: Mesh,
 
     y = gpipe(stage_fn, stages, x_mb, mesh, pp_axis)
     y = y.reshape(b, *y.shape[2:])
-    y = _rms_norm(y, params["ln_f"])
+    y = _rms_norm(y, params["ln_f"], cfg.use_pallas_norm)
     return jnp.einsum("bsd,vd->bsv", y.astype(jnp.float32),
                       params["embed"].astype(jnp.float32))
 

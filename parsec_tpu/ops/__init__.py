@@ -6,5 +6,6 @@ the higher layers (parallel/, models/, device/) pick up when running on
 TPU, with jnp reference fallbacks everywhere else.
 """
 from .flash_attention import flash_attention
+from .rms_norm import rms_norm
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "rms_norm"]

@@ -1,0 +1,92 @@
+"""Pallas fused RMSNorm: one VMEM pass per row block computes the
+mean-square, normalizes, and applies the scale — the elementwise+
+reduction chain XLA would otherwise split across HBM round trips on the
+boundary of fusion clusters.  Second hand-written device kernel next to
+ops/flash_attention.py (reference contrast: hand-written cuBLAS/cuDNN
+kernels dyld'd per chore, device_cuda_module.c:175).
+
+Forward is the fused Pallas kernel; backward is plain jnp through a
+custom VJP (the backward chain is matmul-shaped and XLA already fuses it
+well — fusing the forward is where the win is)."""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[...]
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                  keepdims=True)
+    r = jax.lax.rsqrt(ms + eps)
+    o_ref[...] = (x.astype(jnp.float32) * r).astype(x.dtype) * w_ref[...]
+
+
+def _rms_fwd_pallas(x2d, w, eps, block_rows, interpret):
+    n, d = x2d.shape
+    grid = (n // block_rows,)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2d.dtype),
+        interpret=interpret,
+    )(x2d, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _rms(x2d, w, eps, block_rows, interpret):
+    return _rms_fwd_pallas(x2d, w, eps, block_rows, interpret)
+
+
+def _rms_vjp_fwd(x2d, w, eps, block_rows, interpret):
+    return _rms_fwd_pallas(x2d, w, eps, block_rows, interpret), (x2d, w)
+
+
+def _rms_vjp_bwd(eps, block_rows, interpret, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(ms + eps)
+    xhat = xf * r
+    gw = gf * wf
+    d = x.shape[-1]
+    # dx = r*gw - x * (sum(gw*x)/d) * r^3   (d/dx of x*rsqrt(mean x^2))
+    dx = r * gw - xf * (jnp.sum(gw * xf, axis=-1, keepdims=True) / d) \
+        * (r ** 3)
+    dw = jnp.sum(gf * xhat, axis=0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_rms.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)
+
+
+def rms_norm(x, w, eps: float = 1e-6, block_rows: int = 128,
+             interpret: Optional[bool] = None):
+    """y = x / sqrt(mean(x^2, -1) + eps) * w over the last dim.
+
+    Any leading shape; `interpret=None` auto-selects (Mosaic on TPU,
+    interpreter elsewhere).  Falls back to plain jnp when the row count
+    doesn't fill one block."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    n = 1
+    for s in lead:
+        n *= s
+    if n % block_rows:
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        return (x.astype(jnp.float32)
+                * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+    out = _rms(x.reshape(n, d), w, eps, block_rows, interpret)
+    return out.reshape(*lead, d)

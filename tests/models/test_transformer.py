@@ -101,3 +101,19 @@ def test_remat_matches_plain_gradients():
         assert p0 == p1
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6, err_msg=str(p0))
+
+
+def test_pallas_norm_matches_plain():
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.models import TransformerConfig, init_params, forward
+
+    base = dict(vocab=64, d_model=64, n_heads=2, head_dim=32, n_layers=2,
+                d_ff=128, dtype=jnp.float32)
+    params = init_params(TransformerConfig(**base), jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 64)
+    lg0 = forward(params, toks, TransformerConfig(**base))
+    lg1 = forward(params, toks,
+                  TransformerConfig(**base, use_pallas_norm=True))
+    np.testing.assert_allclose(np.asarray(lg0), np.asarray(lg1),
+                               rtol=2e-4, atol=2e-4)
