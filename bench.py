@@ -56,6 +56,48 @@ def bench_dispatch_chain(nb_tasks: int = 20000, reps: int = 5):
     return min(p50s)
 
 
+def bench_profiling_overhead(nb_tasks: int = 20000, reps: int = 5):
+    """Tracing cost per task (the reference's sp-perf standalone profiler
+    benchmark role, tests/profiling-standalone/sp-perf.c): wall time of
+    the 20k noop chain at trace level 0 (off), 1 (spans), 2 (+edges)."""
+    walls = {}
+    for level in (0, 1, 2):
+        best = None
+        for _ in range(reps):
+            with pt.Context(nb_workers=1) as ctx:
+                if level:
+                    ctx.profile_enable(level)
+                ctx.register_arena("t", 8)
+                tp = pt.Taskpool(ctx, globals={"NB": nb_tasks - 1})
+                k = pt.L("k")
+                tc = tp.task_class("Task")
+                tc.param("k", 0, pt.G("NB"))
+                tc.flow("A", "RW",
+                        pt.In(None, guard=(k == 0)),
+                        pt.In(pt.Ref("Task", k - 1, flow="A")),
+                        pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                               guard=(k < pt.G("NB"))),
+                        arena="t")
+                tc.body_noop()
+                t0 = time.perf_counter()
+                tp.run()
+                tp.wait()
+                dt = time.perf_counter() - t0
+            if best is None or dt < best:
+                best = dt
+        walls[level] = best
+    per = {lv: walls[lv] / nb_tasks * 1e9 for lv in walls}
+    return json.dumps({
+        "metric": "profiling_overhead_ns_per_task",
+        "value": round(per[1] - per[0], 1),
+        "unit": "ns (level 1 spans vs off)",
+        "vs_baseline": None,
+        "config": {"tasks": nb_tasks,
+                   "ns_per_task": {str(lv): round(per[lv], 1)
+                                   for lv in per}},
+    })
+
+
 def bench_dispatch_mt(nb_tasks: int = 4000, lanes: int = 8, workers: int = 4,
                       reps: int = 5):
     """Multi-worker dispatch latency (VERDICT r3 weak #4: the single-
@@ -545,6 +587,9 @@ def main():
             "config": {"workers": _arg_after("--workers", 4),
                        "lanes": _arg_after("--lanes", 8)},
         }))
+        return 0
+    if "--profov" in sys.argv:
+        print(bench_profiling_overhead())
         return 0
     if "--ring" in sys.argv:
         print(bench_ring(S=_arg_after("--s", 8), T=_arg_after("--t", 2048),
