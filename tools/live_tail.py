@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Render a LiveMonitor counter stream (profiling/live.py JSONL) as a
+compact terminal table — the CLI face of the aggregator_visu role (the
+reference's GUI itself stays out of scope; any dashboard can consume
+the same file).
+
+  python tools/live_tail.py /tmp/ptc_live_rank0.jsonl          # snapshot
+  python tools/live_tail.py /tmp/ptc_live_rank0.jsonl --follow # tail -f
+"""
+import json
+import sys
+import time
+
+
+def _fmt(snap):
+    t = snap.get("t", 0.0)
+    workers = snap.get("workers", [])
+    steals = snap.get("steals", [])
+    line = (f"t={t:8.2f}s r{snap.get('rank', 0)} "
+            f"tasks={sum(workers):8d} workers={workers} "
+            f"steals={sum(steals) if steals else 0} "
+            f"rss={snap.get('maxrss_kb', 0) >> 10}MiB")
+    i = 0
+    while f"dev{i}_tasks" in snap:
+        line += (f" | dev{i} tasks={snap[f'dev{i}_tasks']}"
+                 f" q={snap.get(f'dev{i}_qdepth', '?')}"
+                 f" cache={snap.get(f'dev{i}_cache_bytes', 0) >> 20}MiB")
+        i += 1
+    c = snap.get("comm")
+    if c:
+        line += (f" | comm tx={c.get('bytes_sent', 0) >> 10}KiB "
+                 f"rx={c.get('bytes_recv', 0) >> 10}KiB")
+    return line
+
+
+def main():
+    if len(sys.argv) < 2:
+        sys.stderr.write(__doc__)
+        return 2
+    path = sys.argv[1]
+    follow = "--follow" in sys.argv
+    with open(path) as f:
+        while True:
+            line = f.readline()
+            if line:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    print(_fmt(json.loads(line)))
+                except ValueError:
+                    continue
+            elif follow:
+                time.sleep(0.5)
+            else:
+                return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
