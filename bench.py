@@ -309,6 +309,7 @@ def bench_ep(nb_tasks=100000, workers=(1, 2, 4, 8), scheds=None):
         scheds = ["lfq", "lws", "ll", "ltq", "pbq", "gd", "ap", "spq", "ip",
                   "rnd"]
     results = {}
+    steals = {}
     for w in workers:
         for s in scheds:
             with pt.Context(nb_workers=w, scheduler=s) as ctx:
@@ -320,15 +321,19 @@ def bench_ep(nb_tasks=100000, workers=(1, 2, 4, 8), scheds=None):
                 tp.run()
                 tp.wait()
                 dt = time.perf_counter() - t0
+                stl = sum(ctx.worker_steals())
             results[(s, w)] = nb_tasks / dt
-    sys.stderr.write("ep tasks/s (%d tasks)\n%-6s" % (nb_tasks, "sched"))
+            steals[(s, w)] = stl
+    sys.stderr.write("ep tasks/s (%d tasks; (steals) per cell)\n%-6s"
+                     % (nb_tasks, "sched"))
     for w in workers:
         sys.stderr.write(f"{w:>12d}w")
     sys.stderr.write("\n")
     for s in scheds:
         sys.stderr.write("%-6s" % s)
         for w in workers:
-            sys.stderr.write(f"{results[(s, w)]:>13,.0f}")
+            sys.stderr.write(
+                f"{results[(s, w)]:>13,.0f}({steals[(s, w)]})")
         sys.stderr.write("\n")
     return results
 
