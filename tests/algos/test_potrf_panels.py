@@ -153,3 +153,33 @@ def test_panels_odd_sizes_and_float64():
         np.testing.assert_allclose(np.tril(out),
                                    np.linalg.cholesky(spd.astype(dt)),
                                    rtol=tol, atol=tol)
+
+
+def test_posv_panels_composed():
+    """posv as ONE composed pipeline: compose(factorize, solve) —
+    the reference's parsec_compose idiom over the panel taskpools."""
+    from parsec_tpu.algos import build_potrs_panels
+    from parsec_tpu.core.compose import compose
+    N, nb, nrhs = 128, 32, 4
+    spd = _spd(N, seed=9)
+    rng = np.random.default_rng(10)
+    rhs = rng.standard_normal((N, nrhs)).astype(np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, N, nb, dtype=np.float32)
+        for j in range(A.nt):
+            A.tile(0, j)[...] = spd[:, j * nb:(j + 1) * nb]
+        A.register(ctx, "A")
+        B = TwoDimBlockCyclic(N, nrhs, N, nrhs, dtype=np.float32)
+        B.tile(0, 0)[...] = rhs
+        B.register(ctx, "B")
+        dev = TpuDevice(ctx)
+        posv = compose(build_potrf_panels(ctx, A, dev=dev),
+                       build_potrs_panels(ctx, A, B, dev=dev))
+        posv.run()
+        posv.wait()
+        dev.flush()
+        x = B.tile(0, 0).copy()
+        dev.stop()
+    ref = np.linalg.solve(spd.astype(np.float64), rhs.astype(np.float64))
+    err = np.abs(x - ref).max() / max(1.0, np.abs(ref).max())
+    assert err < 5e-3, err
