@@ -101,3 +101,34 @@ def test_perfetto_sink(tmp_path):
     # spans are well-formed perfetto: ts strictly increasing per chain dep
     ts = sorted(e["ts"] for e in execs)
     assert ts == [e["ts"] for e in sorted(execs, key=lambda x: x["ts"])]
+
+
+def test_perfetto_includes_device_dispatch(tmp_path, monkeypatch):
+    """DEVICE_DISPATCH spans flow through to the Perfetto export with
+    their category and lane count intact."""
+    import numpy as np
+    from parsec_tpu.algos import build_potrf
+    from parsec_tpu.data import TwoDimBlockCyclic
+    from parsec_tpu.device import TpuDevice
+
+    monkeypatch.setenv("PTC_DEVICE_BATCH_WAIT_MS", "5")
+    rng = np.random.default_rng(0)
+    N, nb = 96, 32
+    M = rng.standard_normal((N, N), dtype=np.float32)
+    spd = M @ M.T + N * np.eye(N, dtype=np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.profile_enable(True)
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(spd)
+        A.register(ctx, "A")
+        dev = TpuDevice(ctx)
+        tp = build_potrf(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        tr = take_trace(ctx, class_names=["POTRF", "TRSM", "SYRK", "GEMM"])
+        dev.stop()
+    doc = tr.to_perfetto(str(tmp_path / "t.json"))
+    dd = [e for e in doc["traceEvents"] if e["cat"] == "DEVICE_DISPATCH"]
+    assert dd, [e["cat"] for e in doc["traceEvents"][:10]]
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in dd)
