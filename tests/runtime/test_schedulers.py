@@ -132,3 +132,12 @@ def test_print_steals_fires_on_context_destroy(capsys):
     chain.uninstall()  # after destroy: must be a no-op, not a UAF
     err = capsys.readouterr().err
     assert err.count("print_steals: per-worker steals") == 1
+
+
+def test_steals_zero_before_start():
+    """worker_steals on a fresh context (scheduler installed lazily at
+    start) must return cleanly, not crash on a missing scheduler."""
+    import parsec_tpu as pt
+    with pt.Context(nb_workers=2) as ctx:
+        st = ctx.worker_steals()
+        assert st == [] or sum(st) == 0, st
