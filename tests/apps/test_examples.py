@@ -1,0 +1,16 @@
+"""The tutorial examples stay runnable (Ex09 asserts its own results)."""
+import os
+import runpy
+import sys
+
+
+def test_ex09_panel_cholesky_runs():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "examples", "Ex09_PanelCholesky.py")
+    old = sys.argv
+    sys.argv = [path, "192", "32"]
+    try:
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = old
