@@ -100,6 +100,15 @@ class Context:
     def destroy(self):
         if not self._destroyed:
             self._destroyed = True
+            # teardown counter dump (reference: device_show_statistics)
+            try:
+                from ..utils.config import params as _mca
+                if _mca.get("runtime.stats"):
+                    import sys as _sys
+                    _sys.stderr.write("ptc stats:\n" + self.stats_dump()
+                                      + "\n")
+            except Exception:
+                pass
             # uninstall the PINS chain while the native context is still
             # alive: teardown reports (print_steals) read native counters
             chain = getattr(self, "_pins_chain", None)

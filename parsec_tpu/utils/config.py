@@ -167,6 +167,10 @@ register("runtime.bind", "none", str,
 register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")
 register("runtime.profile", False, bool, "enable event tracing at init")
+register("runtime.stats", False, bool,
+         "print the counter dump (stats_dump) to stderr at context "
+         "teardown (reference: --mca device_show_statistics / "
+         "dump_and_reset, parsec/mca/device/device.h:224)")
 register("runtime.live", "", str,
          "live metrics sampling interval in seconds (empty = off): a "
          "sampler thread appends JSON counter snapshots to "

@@ -68,3 +68,23 @@ def test_context_reads_registry(monkeypatch):
         tp.run()
         tp.wait()
     assert ran == [1]
+
+
+def test_runtime_stats_dump_at_teardown(monkeypatch, capsys):
+    """PTC_MCA_runtime_stats=1 prints the counter dump at context
+    teardown (reference: --mca device_show_statistics)."""
+    import parsec_tpu as pt
+    from parsec_tpu.utils.config import params
+    params.set("runtime.stats", True)
+    try:
+        with pt.Context(nb_workers=2) as ctx:
+            tp = pt.Taskpool(ctx, globals={"N": 20})
+            tc = tp.task_class("T")
+            tc.param("k", 0, pt.G("N"))
+            tc.body(lambda v: None)
+            tp.run()
+            tp.wait()
+        err = capsys.readouterr().err
+        assert "ptc stats:" in err and "workers (selected tasks)" in err
+    finally:
+        params.set("runtime.stats", False)
