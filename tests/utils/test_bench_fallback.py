@@ -70,3 +70,16 @@ def test_none_when_log_empty(tmp_path, monkeypatch):
     log.write_text("no json here\n")
     b = _bench(monkeypatch, ["bench.py"], log)
     assert b._best_cached_spotrf() is None
+
+
+def test_watcher_log_env_shared_with_shell_script():
+    """bench.py and tools/tpu_watch.sh resolve the same log path (the
+    PTC_WATCH_LOG contract) so the cached-capture fallback reads what
+    the watcher writes."""
+    import re
+    sh = open(os.path.join(_ROOT, "tools", "tpu_watch.sh")).read()
+    m = re.search(r"OUT=\$\{PTC_WATCH_LOG:-(\S+)\}", sh)
+    assert m, "watcher no longer parameterizes its log path"
+    py = open(os.path.join(_ROOT, "bench.py")).read()
+    assert f'"PTC_WATCH_LOG",\n                                  "{m.group(1)}"' \
+        in py or m.group(1) in py, (m.group(1), "bench default diverged")
