@@ -1901,6 +1901,12 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
     int32_t best = -1, n_dev = 0;
     double best_load = 0.0;
     bool first_enabled_is_device = false;
+    /* candidate table for the affinity pass (chores are few; >16 device
+     * chores would only lose affinity for the overflow, never routing) */
+    enum { MAX_CAND = 16 };
+    int32_t cand_idx[MAX_CAND];
+    int64_t cand_qid[MAX_CAND];
+    double cand_load[MAX_CAND];
     for (int32_t i = 0; i < (int32_t)tc.chores.size(); i++) {
       Chore &ch = tc.chores[(size_t)i];
       if (ch.disabled.load(std::memory_order_relaxed)) continue;
@@ -1915,10 +1921,55 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
        * own weight in the same way, device.c:129-141) */
       double load = (1.0 + (double)q->depth.load(std::memory_order_relaxed))
                     / (w > 0.0 ? w : 1e-9);
+      if (n_dev < MAX_CAND) {
+        cand_idx[n_dev] = i;
+        cand_qid[n_dev] = ch.body_arg;
+        cand_load[n_dev] = load;
+      }
       if (best == -1 || load < best_load) { best = i; best_load = load; }
       n_dev++;
     }
-    if (first_enabled_is_device && n_dev >= 2) t->chore_idx = best;
+    if (first_enabled_is_device && n_dev >= 2) {
+      t->chore_idx = best;
+      /* data-affinity pass (reference: device.c:100-117): a queue that
+       * already holds a current mirror of one of this task's flows —
+       * write flows first, read flows as fallback — wins over pure
+       * load, unless its load is skewed past the best candidate's. */
+      double skew = ctx->affinity_skew.load(std::memory_order_relaxed);
+      if (skew > 0.0) {
+        int32_t aff = -1;
+        double aff_load = 0.0;
+        int cap = n_dev < (int)MAX_CAND ? n_dev : (int)MAX_CAND;
+        for (int pass = 0; pass < 2 && aff == -1; pass++) {
+          for (int32_t f = 0;
+               f < (int32_t)tc.flows.size() && aff == -1; f++) {
+            Flow &fl = tc.flows[(size_t)f];
+            if (fl.flags & PTC_FLOW_CTL) continue;
+            bool wr = (fl.flags & PTC_FLOW_WRITE) != 0;
+            if (pass == 0 ? !wr : wr) continue;
+            ptc_copy *c = t->data[f];
+            if (!c || c->handle == 0) continue;
+            uint64_t pack;
+            {
+              std::lock_guard<std::mutex> g(ctx->owner_lock);
+              auto it = ctx->data_owner.find(c->handle);
+              if (it == ctx->data_owner.end()) continue;
+              pack = it->second;
+            }
+            if ((int32_t)(uint32_t)pack !=
+                c->version.load(std::memory_order_relaxed))
+              continue; /* stale mirror */
+            for (int j = 0; j < cap; j++)
+              if (cand_qid[j] == (int64_t)(int32_t)(pack >> 32)) {
+                aff = cand_idx[j];
+                aff_load = cand_load[j];
+                break;
+              }
+          }
+        }
+        if (aff >= 0 && aff_load <= skew * best_load) t->chore_idx = aff;
+      }
+    }
   }
   while (t->chore_idx < (int32_t)tc.chores.size()) {
     Chore &ch = tc.chores[(size_t)t->chore_idx];
@@ -2900,6 +2951,43 @@ void ptc_device_queue_set_weight(ptc_context_t *ctx, int32_t qid, double w) {
 int64_t ptc_device_queue_depth(ptc_context_t *ctx, int32_t qid) {
   if (qid < 0 || (size_t)qid >= ctx->dev_queues.size()) return -1;
   return ctx->dev_queues[(size_t)qid]->depth.load(std::memory_order_relaxed);
+}
+
+/* data-affinity map (see parsec_core.h; reference device.c:100-117) */
+void ptc_device_set_data_owner(ptc_context_t *ctx, int64_t handle,
+                               int32_t qid, int32_t version) {
+  if (!ctx || handle == 0) return;
+  std::lock_guard<std::mutex> g(ctx->owner_lock);
+  if (qid < 0)
+    ctx->data_owner.erase(handle);
+  else
+    ctx->data_owner[handle] =
+        ((uint64_t)(uint32_t)qid << 32) | (uint32_t)version;
+}
+
+void ptc_device_clear_data_owner(ptc_context_t *ctx, int64_t handle,
+                                 int32_t qid) {
+  if (!ctx || handle == 0) return;
+  std::lock_guard<std::mutex> g(ctx->owner_lock);
+  auto it = ctx->data_owner.find(handle);
+  if (it == ctx->data_owner.end()) return;
+  if (qid < 0 || (int32_t)(it->second >> 32) == qid)
+    ctx->data_owner.erase(it);
+}
+
+int32_t ptc_device_get_data_owner(ptc_context_t *ctx, int64_t handle,
+                                  int32_t *version_out) {
+  if (!ctx) return -1;
+  std::lock_guard<std::mutex> g(ctx->owner_lock);
+  auto it = ctx->data_owner.find(handle);
+  if (it == ctx->data_owner.end()) return -1;
+  if (version_out) *version_out = (int32_t)(uint32_t)it->second;
+  return (int32_t)(it->second >> 32);
+}
+
+void ptc_device_set_affinity_skew(ptc_context_t *ctx, double skew) {
+  if (!ctx) return;
+  ctx->affinity_skew.store(skew, std::memory_order_relaxed);
 }
 
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) {

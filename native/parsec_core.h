@@ -281,6 +281,23 @@ void ptc_device_queue_set_weight(ptc_context_t *ctx, int32_t qid, double w);
 int64_t ptc_device_queue_depth(ptc_context_t *ctx, int32_t qid);
 /* blocking pop with timeout (ms); NULL on timeout or shutdown */
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms);
+/* data-affinity routing (reference: parsec_get_best_device's
+ * owner_device/preferred_device pass, device.c:100-117, before the load
+ * pass at :129-160).  The device layer stamps which queue holds a
+ * CURRENT mirror (version-checked) of the copy with this handle;
+ * best-device selection then prefers a queue owning one of the task's
+ * flows — write flows first, read flows as fallback — unless the
+ * owner's projected load exceeds skew * the least-loaded candidate
+ * (affinity must not defeat load balance; skew<=0 disables the pass). */
+void ptc_device_set_data_owner(ptc_context_t *ctx, int64_t handle,
+                               int32_t qid, int32_t version);
+/* erase only if currently owned by qid (qid<0: erase unconditionally) */
+void ptc_device_clear_data_owner(ptc_context_t *ctx, int64_t handle,
+                                 int32_t qid);
+/* returns owner qid or -1; *version_out = stamped mirror version */
+int32_t ptc_device_get_data_owner(ptc_context_t *ctx, int64_t handle,
+                                  int32_t *version_out);
+void ptc_device_set_affinity_skew(ptc_context_t *ctx, double skew);
 /* completion entry point for ASYNC owners (any thread) */
 void ptc_task_complete(ptc_context_t *ctx, ptc_task_t *task);
 /* failure entry point for ASYNC owners: aborts the task's taskpool

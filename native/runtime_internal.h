@@ -534,6 +534,20 @@ struct ptc_context {
                                  * ptc_dtype_get (reg_lock-guarded) */
   std::atomic<bool> has_dtypes{false};
   std::vector<DeviceQueue *> dev_queues;
+  /* data-affinity routing (reference: the owner_device/preferred_device
+   * pass of parsec_get_best_device, device.c:100-117, which runs BEFORE
+   * the load pass at :129-160): copy handle(uid) → packed
+   * (qid<<32 | mirror version) of the device queue holding a current
+   * mirror.  Maintained by the device layer (cache put / evict /
+   * invalidate / copy death); read in execute_task's best-device pass.
+   * A stale entry (version mismatch, or mirror evicted without the copy
+   * dying) only costs a misroute — the consumer re-stages, exactly what
+   * load-only routing would have done. */
+  std::mutex owner_lock;
+  std::unordered_map<int64_t, uint64_t> data_owner;
+  /* spill guard: affinity yields when owner load > skew * best load
+   * (<=0 disables the affinity pass).  MCA: device.affinity_skew. */
+  std::atomic<double> affinity_skew{4.0};
   std::mutex reg_lock;
 
   uint32_t myrank = 0, nodes = 1;

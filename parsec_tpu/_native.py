@@ -203,6 +203,13 @@ _sigs = {
     "ptc_device_queue_set_weight": (None, [C.c_void_p, C.c_int32, C.c_double]),
     "ptc_device_queue_depth": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_device_pop": (C.c_void_p, [C.c_void_p, C.c_int32, C.c_int32]),
+    "ptc_device_set_data_owner": (None, [C.c_void_p, C.c_int64, C.c_int32,
+                                         C.c_int32]),
+    "ptc_device_clear_data_owner": (None, [C.c_void_p, C.c_int64,
+                                           C.c_int32]),
+    "ptc_device_get_data_owner": (C.c_int32, [C.c_void_p, C.c_int64,
+                                              C.POINTER(C.c_int32)]),
+    "ptc_device_set_affinity_skew": (None, [C.c_void_p, C.c_double]),
     "ptc_task_complete": (None, [C.c_void_p, C.c_void_p]),
     "ptc_dtile_new": (C.c_void_p, [C.c_void_p, C.c_void_p]),
     "ptc_dtile_destroy": (None, [C.c_void_p, C.c_void_p]),

@@ -70,6 +70,8 @@ class Context:
             _live(self, _mca.get("runtime.live"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
+        N.lib.ptc_device_set_affinity_skew(
+            self._ptr, _mca.get("device.affinity_skew"))
         # per-subsystem debug streams (parsec/utils/debug.c analog)
         for i, name in enumerate(N.DBG_SUBSYSTEMS):
             lvl = _mca.get(f"debug.{name}")
@@ -446,6 +448,27 @@ class Context:
 
     def device_queue_depth(self, qid: int) -> int:
         return N.lib.ptc_device_queue_depth(self._ptr, qid)
+
+    def device_set_data_owner(self, handle: int, qid: int, version: int):
+        """Stamp which device queue holds a current mirror of the copy
+        with this handle (data-affinity routing; reference:
+        parsec_get_best_device's owner pass, device.c:100-117)."""
+        N.lib.ptc_device_set_data_owner(self._ptr, handle, qid, version)
+
+    def device_clear_data_owner(self, handle: int, qid: int = -1):
+        N.lib.ptc_device_clear_data_owner(self._ptr, handle, qid)
+
+    def device_get_data_owner(self, handle: int):
+        """(qid, version) of the stamped mirror owner, or (-1, 0)."""
+        ver = C.c_int32(0)
+        q = N.lib.ptc_device_get_data_owner(self._ptr, handle, C.byref(ver))
+        return q, ver.value
+
+    def device_set_affinity_skew(self, skew: float):
+        """Spill guard for affinity routing: the owning queue loses to
+        the least-loaded one when its load exceeds skew * best (<=0
+        disables the affinity pass)."""
+        N.lib.ptc_device_set_affinity_skew(self._ptr, float(skew))
 
     def device_queue_new(self) -> int:
         return N.lib.ptc_device_queue_new(self._ptr)

@@ -817,6 +817,8 @@ class TpuDevice:
             if ent is not None:
                 self._uncharge(ent)
                 self.stats["dead_drops"] += 1
+        # the copy is dying: its affinity stamp must not route anyone
+        N.lib.ptc_device_clear_data_owner(self.ctx._ptr, handle, -1)
 
     def _cache_put(self, uid, version, arr, nbytes, dirty=False, host=None,
                    persistent=True, raw=False):
@@ -828,6 +830,11 @@ class TpuDevice:
                             persistent, raw)
             self._cache[uid] = ent
             self._charge(ent)
+            # affinity stamp (reference: the owner_device routing pass,
+            # device.c:100-117): consumers of this copy at this version
+            # route here instead of staging on a cold sibling
+            N.lib.ptc_device_set_data_owner(self.ctx._ptr, uid,
+                                            self.qid, version)
             evict = []
             if self._cache_used > self._cache_bytes:
                 for k, e in self._cache.items():
@@ -840,6 +847,8 @@ class TpuDevice:
                 for k, e in evict:
                     del self._cache[k]
                     self.stats["evictions"] += 1
+                    N.lib.ptc_device_clear_data_owner(self.ctx._ptr, k,
+                                                      self.qid)
 
     def _invalidate_siblings(self, uid: int) -> None:
         """Writer-side invalidation (MOESI 'owned' takeover): after this
@@ -857,6 +866,8 @@ class TpuDevice:
                 if ent is not None:
                     sib._uncharge(ent)
                     sib.stats["invalidations"] += 1
+                    N.lib.ptc_device_clear_data_owner(self.ctx._ptr, uid,
+                                                      sib.qid)
 
     def _cache_ent(self, uid, version) -> Optional["_CacheEnt"]:
         """Entry lookup without materializing _StackRefs (batched stage-in
@@ -1072,6 +1083,9 @@ class TpuDevice:
         # Back-to-back runs on one chip otherwise OOM on the previous
         # run's stacks (r4 N=32768 rep-2).
         with self._lock:
+            for k in self._cache:
+                N.lib.ptc_device_clear_data_owner(self.ctx._ptr, k,
+                                                  self.qid)
             self._cache.clear()
             self._stacks.clear()
             self._cache_used = 0

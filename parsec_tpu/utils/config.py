@@ -214,3 +214,10 @@ register("device.dp_pull", True, bool,
          "e.g. a rank behind a NAT the token addresses cannot cross)")
 register("device.tpu_enabled", True, bool,
          "allow TPU device module (reference: --mca device_cuda_enabled)")
+register("device.affinity_skew", 4.0, float,
+         "data-affinity spill guard for best-device routing: a queue "
+         "holding a current mirror of a task's flow wins over pure "
+         "load unless its projected load exceeds skew * the "
+         "least-loaded candidate; <=0 disables the affinity pass "
+         "(reference: parsec_get_best_device's owner/preferred pass, "
+         "device.c:100-117)")

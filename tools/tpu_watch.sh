@@ -23,7 +23,8 @@ run_step() {  # name, command...
   # persistent crash) is retired so it cannot eat every future tunnel
   # window retrying; later steps still get their chance
   local fails
-  fails=$(grep -c "^$name$" $STATE.fail 2>/dev/null || echo 0)
+  fails=$(grep -c "^$name$" $STATE.fail 2>/dev/null)
+  fails=${fails:-0}
   if [ "$fails" -ge 2 ]; then
     echo "$(date -u +%H:%M:%S) step $name retired after $fails failures" >> $OUT
     echo "$name" >> $STATE
