@@ -1539,10 +1539,31 @@ static void wake_workers(ptc_context *ctx) {
 
 } // namespace
 
+/* Hot-path scheduler bypass (reference: __parsec_schedule's
+ * keep_highest_priority_task + es->next_task, parsec/scheduling.c:373-396):
+ * a worker thread completing a task keeps the highest-priority ready
+ * successor in a thread-local slot and executes it directly, skipping one
+ * schedule/select round-trip per task.  Only worker threads opt in
+ * (tl_bypass), so comm-thread, device-manager, and main-thread schedules
+ * take the normal scheduler path. */
+static thread_local ptc_task *tl_next_task = nullptr;
+static thread_local bool tl_bypass = false;
+
 void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
   /* comm-thread deliveries can precede/overlap the lazy start */
   if (!ctx->started.load(std::memory_order_acquire))
     ptc_context_start(ctx);
+  if (tl_bypass) {
+    if (!tl_next_task) {
+      tl_next_task = t;
+      return;
+    }
+    if (t->priority > tl_next_task->priority) {
+      ptc_task *lower = tl_next_task;
+      tl_next_task = t;
+      t = lower;
+    }
+  }
   ctx->sched->schedule(worker < 0 ? 0 : worker, t);
   wake_workers(ctx);
 }
@@ -1866,9 +1887,15 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
   case PTC_HOOK_DONE:
     complete_task(ctx, worker, t);
     return;
-  case PTC_HOOK_AGAIN:
+  case PTC_HOOK_AGAIN: {
+    /* same spin guard as the PTG AGAIN path: the bypass slot would
+     * re-execute the task immediately, starving whatever it waits on */
+    bool save = tl_bypass;
+    tl_bypass = false;
     schedule_task(ctx, worker, t);
+    tl_bypass = save;
     return;
+  }
   case PTC_HOOK_ASYNC:
     return;
   default:
@@ -2010,9 +2037,15 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
       return;
     case PTC_HOOK_ASYNC:
       return; /* ownership transferred */
-    case PTC_HOOK_AGAIN:
+    case PTC_HOOK_AGAIN: {
+      /* AGAIN means "requeue, try later" — the bypass slot would
+       * re-execute it immediately and spin; force the scheduler path */
+      bool save = tl_bypass;
+      tl_bypass = false;
       schedule_task(ctx, worker, t);
+      tl_bypass = save;
       return;
+    }
     case PTC_HOOK_NEXT:
       t->chore_idx++;
       continue;
@@ -2070,8 +2103,13 @@ static void worker_main(ptc_context *ctx, int worker) {
     ctx->worker_cpu[(size_t)worker]->store(cpu, std::memory_order_relaxed);
   }
   int misses = 0;
+  tl_bypass = true;
   while (!ctx->shutdown.load(std::memory_order_acquire)) {
-    ptc_task *t = ctx->sched->select(worker);
+    ptc_task *t = tl_next_task;
+    if (t)
+      tl_next_task = nullptr; /* bypass hit: no scheduler round-trip */
+    else
+      t = ctx->sched->select(worker);
     if (t) {
       misses = 0;
       ctx->worker_executed[(size_t)worker]->fetch_add(
@@ -2091,6 +2129,13 @@ static void worker_main(ptc_context *ctx, int worker) {
     });
     misses = 0;
   }
+  /* a successor kept across the shutdown check must not leak: hand it
+   * back so destroy-time accounting sees it */
+  if (tl_next_task) {
+    ctx->sched->schedule(worker, tl_next_task);
+    tl_next_task = nullptr;
+  }
+  tl_bypass = false;
 }
 
 /* ------------------------------------------------------------------ */

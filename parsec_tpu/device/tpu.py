@@ -679,7 +679,7 @@ class TpuDevice:
     """One TPU device (one jax device) with a manager thread."""
 
     def __init__(self, ctx: Context, jax_device=None, pipeline_depth: int = 16,
-                 cache_bytes: int = 4 << 30):
+                 cache_bytes: int = 4 << 30, autostart: bool = True):
         import jax  # deferred: tests may pin the platform first
         from collections import OrderedDict
         self._jax = jax
@@ -732,7 +732,8 @@ class TpuDevice:
                       "batches": 0, "batched_tasks": 0, "d2d_bytes": 0,
                       "dp_sends": 0, "dp_d2d_bytes": 0, "dp_xfer_bytes": 0,
                       "dp_recv_bytes": 0, "invalidations": 0,
-                      "eager_gathers": 0, "fused_flows": 0}
+                      "eager_gathers": 0, "fused_flows": 0,
+                      "wb_tasks": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -773,7 +774,17 @@ class TpuDevice:
                 N.lib.ptc_set_dp_can_pull(ctx._ptr, 1 if ok else 0)
         ctx._devices.append(self)  # stopped before the native ctx dies
         _ALL_DEVICES.append(self)
-        self.start()
+        # mem-out writeback lane (reference: the CUDA stage-out/pop
+        # stream, device_cuda_module.c:2197): d2h materialization of
+        # sync-mem-out flows runs here, NOT in the dispatch loop, so one
+        # slow d2h cannot serialize the waves behind it.  The task
+        # completes from this lane AFTER its host bytes are coherent
+        # (release_deps may memcpy them).
+        import queue as _queue
+        self._wb_q: "_queue.Queue" = _queue.Queue()
+        self._wb_thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
 
     # ------------------------------------------------------------ cache
     def _copy_uid(self, cptr) -> int:
@@ -964,6 +975,8 @@ class TpuDevice:
         chores and comm sends is automatic via sync_handle().
         Same-shape mirrors are batched into one stacked d2h transfer."""
         import jax.numpy as jnp
+        # coherence point: deferred mem-out writebacks must retire first
+        self._wb_barrier()
         with self._lock:
             # only persistent (user-Data-backed) hosts are written: arena
             # buffers can be freed concurrently by the last consumer
@@ -1059,6 +1072,67 @@ class TpuDevice:
         self._thread = threading.Thread(target=self._manager, daemon=True,
                                         name="ptc-tpu-manager")
         self._thread.start()
+        self._wb_thread = threading.Thread(target=self._wb_loop,
+                                           daemon=True,
+                                           name="ptc-tpu-writeback")
+        self._wb_thread.start()
+
+    def _wb_loop(self):
+        """Writeback lane: materialize deferred mem-out d2h, then
+        complete the tasks (coherence before release_deps).  A batched
+        wave's whole output stack transfers as ONE stacked d2h ("stack"
+        items); single-task dispatches sync per copy ("sync")."""
+        while True:
+            item = self._wb_q.get()
+            if item is None:
+                return
+            if item[0] == "barrier":
+                item[1].set()
+                continue
+            kind, tasks, payload = item
+            try:
+                if kind == "stack":
+                    for ostack, uids in payload:
+                        res = np.asarray(ostack[:len(uids)])  # one d2h
+                        for i, uid in enumerate(uids):
+                            self._wb_write(uid, ostack, i, res[i])
+                else:
+                    for uid in payload:
+                        self.sync_handle(uid)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                for t in tasks:
+                    self.ctx.task_fail(t)
+                continue
+            self.stats["wb_tasks"] += len(tasks)
+            for t in tasks:
+                self.ctx.task_complete(t)
+
+    def _wb_write(self, uid, ostack, i, res) -> None:
+        """Host-write one stack row's result if the cache entry is still
+        the dispatch-time slice; anything re-put/evicted since falls back
+        to the generic per-copy sync."""
+        with self._lock:
+            ent = self._cache.get(uid)
+            hit = (ent is not None and ent.dirty
+                   and isinstance(ent.arr, _StackRef)
+                   and ent.arr.stack is ostack and ent.arr.idx == i)
+        if not hit:
+            self.sync_handle(uid)
+            return
+        _host_write(ent, res)
+        self.stats["d2h_bytes"] += res.nbytes
+        with self._lock:
+            ent.dirty = False
+
+    def _wb_barrier(self, timeout: float = 300.0):
+        """Coherence point: block until every queued writeback retired."""
+        if self._wb_thread is None or not self._wb_thread.is_alive():
+            return
+        ev = threading.Event()
+        self._wb_q.put(("barrier", ev))
+        ev.wait(timeout=timeout)
 
     def stop(self):
         """Flush dirty mirrors and stop the manager (idempotent)."""
@@ -1073,6 +1147,10 @@ class TpuDevice:
         # first flush's dirty snapshot and manager exit would otherwise
         # be discarded by the clear below (cheap when nothing new)
         self.flush()
+        if self._wb_thread is not None:
+            self._wb_q.put(None)
+            self._wb_thread.join(timeout=30)
+            self._wb_thread = None
         if self in _ALL_DEVICES:
             _ALL_DEVICES.remove(self)
         # release the HBM now: the device object itself often survives in
@@ -1233,24 +1311,21 @@ class TpuDevice:
                 ents.append(ent.arr)  # may be a _StackRef
         return ents
 
-    def _write_out(self, view, body: _DeviceBody, flow, arr, res) -> None:
-        """Install one task's output in the cache (and, for mem-out flows
-        where `res` is the materialized host result, write the host copy
-        synchronously — release_deps may memcpy it into another
-        collection tile).  Shared by batched and per-task dispatch."""
+    def _write_out(self, view, body: _DeviceBody, flow, arr):
+        """Install one task's output in the cache as a dirty mirror and
+        return its uid.  Host coherence is lazy: flush()/sync_handle()
+        pull it, and sync-mem-out flows ride the writeback lane, which
+        syncs the host copy BEFORE completing the task (release_deps may
+        memcpy it into another collection tile).  Shared by batched and
+        per-task dispatch."""
         cptr, uid, ver = self._flow_uid_ver(view, body, flow)
         host = view.data(flow, dtype=body.dtypes[flow],
                          shape=body.shapes.get(flow), sync=False)
         persistent = bool(N.lib.ptc_copy_is_persistent(cptr))
-        if res is not None:
-            host[...] = res.reshape(host.shape)
-            self.stats["d2h_bytes"] += res.nbytes
-            self._cache_put(uid, ver + 1, arr, host.nbytes,
-                            persistent=persistent)
-        else:
-            self._cache_put(uid, ver + 1, arr, host.nbytes,
-                            dirty=True, host=host, persistent=persistent)
+        self._cache_put(uid, ver + 1, arr, host.nbytes,
+                        dirty=True, host=host, persistent=persistent)
         self._invalidate_siblings(uid)
+        return uid
 
     def _dispatch_group(self, body: _DeviceBody, tasks: List):
         """One vmapped executable call for a group of ready tasks of the
@@ -1355,14 +1430,17 @@ class TpuDevice:
             out = _get_fused(self._jax, body.kernel, tuple(sig),
                              single=False)(*call_args)
             outs = out if isinstance(out, tuple) else (out,)
+            wb_stacks = []
             for f, ostack in zip(body.writes, outs):
                 sync_host = f in body.mem_out_flows
-                # slice off the bucket padding before the blocking d2h
-                res = (np.asarray(ostack[:len(views)]) if sync_host
-                       else None)
+                uids = []
                 for i, view in enumerate(views):
-                    self._write_out(view, body, f, _StackRef(ostack, i),
-                                    res[i] if sync_host else None)
+                    uid = self._write_out(view, body, f,
+                                          _StackRef(ostack, i))
+                    if sync_host:
+                        uids.append(uid)
+                if sync_host:
+                    wb_stacks.append((ostack, uids))
             self.stats["tasks"] += len(tasks)
             self.stats["batches"] += 1
             self.stats["batched_tasks"] += len(tasks)
@@ -1380,6 +1458,13 @@ class TpuDevice:
             body.batch = False
             for t in tasks:
                 self._dispatch_one(body, t)
+            return
+        if wb_stacks and self._wb_thread is not None:
+            # mem-out flows: host coherence (the blocking d2h) and the
+            # completions ride the writeback lane; the dispatch loop
+            # moves straight on to the next wave.  The whole output
+            # stack ships as ONE stacked d2h there, not per-tile pulls.
+            self._wb_q.put(("stack", list(tasks), wb_stacks))
             return
         for t in tasks:
             self.ctx.task_complete(t)
@@ -1411,10 +1496,11 @@ class TpuDevice:
             out = _get_fused(self._jax, body.kernel, tuple(sig),
                              single=True)(*call_args)  # async dispatch
             outs = out if isinstance(out, tuple) else (out,)
+            wb_uids = []
             for f, arr in zip(body.writes, outs):
-                sync_host = f in body.mem_out_flows
-                self._write_out(view, body, f, arr,
-                                np.asarray(arr) if sync_host else None)
+                uid = self._write_out(view, body, f, arr)
+                if f in body.mem_out_flows:
+                    wb_uids.append(uid)
             self.stats["tasks"] += 1
         except Exception:
             # A failed kernel must NOT complete the task — successors
@@ -1424,5 +1510,8 @@ class TpuDevice:
             import traceback
             traceback.print_exc()
             self.ctx.task_fail(task)
+            return
+        if wb_uids and self._wb_thread is not None:
+            self._wb_q.put(("sync", [task], wb_uids))
             return
         self.ctx.task_complete(task)

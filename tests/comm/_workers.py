@@ -33,6 +33,21 @@ def run(worker_fn, rank, nodes, port, q, **kw):
         q.put(("err", rank, traceback.format_exc()))
 
 
+def run_capture_stderr(worker_fn, rank, nodes, port, q, stderr_dir, **kw):
+    """run() with the child's fd 2 redirected to a per-rank file, so a
+    test can assert a clean SPMD job logs NOTHING (the native runtime
+    writes its warnings to C stderr, invisible to capsys)."""
+    import os
+    import sys
+
+    path = os.path.join(stderr_dir, f"rank{rank}.stderr")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    os.dup2(fd, 2)
+    os.close(fd)
+    sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+    run(worker_fn, rank, nodes, port, q, **kw)
+
+
 def ptg_chain(rank: int, nodes: int, port: int, nb: int = 32,
               topo: str = "star"):
     """Ex04-style RW chain where consecutive tasks live on different ranks:
