@@ -93,7 +93,8 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
     dt = A.dtype
     if trsm_via_inverse:
         li_arena = f"potrf_li_{nb}_{np.dtype(dt).str}"
-        ctx.register_arena(li_arena, nb * nb * np.dtype(dt).itemsize)
+        if li_arena not in ctx.arenas:  # re-builds must not leak an id
+            ctx.register_arena(li_arena, nb * nb * np.dtype(dt).itemsize)
 
     # ------------------------------------------------------------- POTRF(k)
     po = tp.task_class("POTRF")
@@ -274,8 +275,15 @@ def _register_pidx(ctx: pt.Context, A: TwoDimBlockCyclic, name: str):
     co-located with the task that issues it."""
     from ..data.collections import VectorCyclic
     pidx_name = name + "_pidx"
-    if pidx_name in ctx.collections:
+    # guard on OUR registry, not ctx.collections: a user collection that
+    # happens to be named <name>_pidx must not satisfy the early return
+    # (it has no _pidx_colls record and the wrong contents)
+    if pidx_name in getattr(ctx, "_pidx_colls", {}):
         return pidx_name, ctx._pidx_colls[pidx_name]
+    if pidx_name in ctx.collections:
+        raise ValueError(
+            f"collection name {pidx_name!r} is reserved for the panel "
+            f"index of {name!r} but is already registered")
     pidx = VectorCyclic(A.nt, 1, nodes=A.nodes, myrank=A.myrank,
                         dtype=np.int32)
     for j in range(A.nt):
@@ -337,7 +345,8 @@ def _build_panel_factorization(ctx: pt.Context, A: TwoDimBlockCyclic,
     fa.flow("KS", "READ", pt.In(pt.Mem(pidx_name, k)))
     if update_uses == "k":
         ki_arena = f"panel_ki_{name}"
-        ctx.register_arena(ki_arena, 4)
+        if ki_arena not in ctx.arenas:  # re-builds must not leak an id
+            ctx.register_arena(ki_arena, 4)
         fa.flow("KI", "W",
                 pt.Out(pt.Ref("PU", k, pt.Range(k + 1, NT), flow="KI"),
                        guard=(k < NT)),

@@ -733,7 +733,7 @@ class TpuDevice:
                       "dp_sends": 0, "dp_d2d_bytes": 0, "dp_xfer_bytes": 0,
                       "dp_recv_bytes": 0, "invalidations": 0,
                       "eager_gathers": 0, "fused_flows": 0,
-                      "wb_tasks": 0}
+                      "wb_tasks": 0, "f64_refused": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -1032,6 +1032,10 @@ class TpuDevice:
                 f"ptc [device]: not attaching {getattr(tc, 'name', '?')}: "
                 "float64 flows need JAX_ENABLE_X64=1 (device would "
                 "silently downcast); host chore carries it\n")
+            # programmatic signal alongside the stderr line (DTD's
+            # insert_tpu_task raises for the same hazard): tests/benches
+            # assert the refusal without parsing stderr
+            self.stats["f64_refused"] += 1
             return
         tc.body_device(self.qid, device="tpu")
         body = _DeviceBody(kernel, reads, writes, shapes, dtypes, tc, tp,

@@ -75,7 +75,9 @@ def rms_norm(x, w, eps: float = 1e-6, block_rows: int = 128,
 
     Any leading shape; `interpret=None` auto-selects (Mosaic on TPU,
     interpreter elsewhere).  Falls back to plain jnp when the row count
-    doesn't fill one block."""
+    doesn't fill one block, or when the last dim violates the TPU lane
+    tiling (d % 128) — Mosaic would reject the kernel on hardware even
+    though interpret mode happily runs it."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     d = x.shape[-1]
@@ -83,7 +85,7 @@ def rms_norm(x, w, eps: float = 1e-6, block_rows: int = 128,
     n = 1
     for s in lead:
         n *= s
-    if n % block_rows:
+    if n % block_rows or d % 128:
         ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
                       keepdims=True)
         return (x.astype(jnp.float32)

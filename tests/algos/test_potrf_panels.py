@@ -146,8 +146,10 @@ def test_panels_odd_sizes_and_float64():
             import jax
             if dt == np.float64 and not jax.config.jax_enable_x64:
                 # without jax x64, f64 classes must stay on host chores
-                # (device_put would silently downcast) — loud refusal
+                # (device_put would silently downcast) — loud refusal,
+                # now also counted (no stderr parsing needed)
                 assert dev.stats["tasks"] == 0, dev.stats
+                assert dev.stats["f64_refused"] > 0, dev.stats
             dev.stop()
         tol = 2e-3 if dt == np.float32 else 1e-8
         np.testing.assert_allclose(np.tril(out),

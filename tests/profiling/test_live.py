@@ -51,3 +51,75 @@ def test_live_monitor_via_mca_param(tmp_path, monkeypatch):
         os.unlink(fname)
     finally:
         monkeypatch.delenv("PTC_MCA_runtime_live")
+
+
+def _load_live_tail():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "live_tail", os.path.join(os.path.dirname(__file__),
+                                  "..", "..", "tools", "live_tail.py"))
+    lt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lt)
+    return lt
+
+
+def test_live_tail_merges_ranks(tmp_path):
+    """Cross-rank aggregation (reference:
+    tools/aggregator_visu/aggregator.py): N per-rank streams merge into
+    one view keyed by rank, and a late-joining rank appears on the next
+    refresh."""
+    lt = _load_live_tail()
+
+    def write(rank, t, tasks, tx=0):
+        p = tmp_path / f"live_rank{rank}.jsonl"
+        with open(p, "a") as f:
+            f.write(json.dumps({"rank": rank, "t": t,
+                                "workers": [tasks, tasks + 1],
+                                "steals": [0, 0], "maxrss_kb": 2048,
+                                "comm": {"bytes_sent": tx,
+                                         "bytes_recv": tx}}) + "\n")
+        return str(p)
+
+    paths = [write(r, 1.0, 10 * r, tx=1 << 20) for r in range(3)]
+    merged = lt.merge_latest(paths)
+    assert sorted(merged) == [0, 1, 2]
+    # latest sample per rank wins
+    write(1, 2.0, 99)
+    merged = lt.merge_latest(paths)
+    assert merged[1]["t"] == 2.0 and merged[1]["workers"][0] == 99
+    # late-joining rank appears on the next poll (the rank-join case)
+    p3 = write(3, 0.5, 7)
+    merged = lt.merge_latest(paths + [p3])
+    assert sorted(merged) == [0, 1, 2, 3]
+    view = lt.render_merged(merged)
+    lines = view.splitlines()
+    assert len(lines) == 5  # 4 rank lines + totals
+    assert lines[-1].startswith("== 4 rank(s)")
+    for r in range(4):
+        assert f"r{r} " in lines[r]
+
+
+def test_live_tail_merge_real_streams(tmp_path):
+    """Integration: two real LiveMonitor streams (two contexts standing
+    in for two ranks) merge into one aggregated view."""
+    lt = _load_live_tail()
+
+    paths = []
+    for fake_rank in range(2):
+        path = str(tmp_path / f"live_r{fake_rank}.jsonl")
+        with pt.Context(nb_workers=1) as ctx:
+            ctx.set_rank(fake_rank, 2)
+            mon = LiveMonitor(ctx, path=path, interval=0.05)
+            tp = pt.Taskpool(ctx, globals={"NB": 100})
+            tc = tp.task_class("T")
+            tc.param("k", 0, pt.G("NB"))
+            tc.body_noop()
+            tp.run()
+            tp.wait()
+            mon.stop()
+        paths.append(path)
+    merged = lt.merge_latest(paths)
+    assert sorted(merged) == [0, 1]
+    assert all(sum(merged[r]["workers"]) == 101 for r in (0, 1))
+    view = lt.render_merged(merged)
+    assert view.splitlines()[-1].startswith("== 2 rank(s) tasks=202")

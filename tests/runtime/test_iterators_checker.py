@@ -1,0 +1,211 @@
+"""Randomized dependency-iterator cross-check (reference:
+parsec/mca/pins/iterators_checker — a PINS module that walks every
+task's successor iterators and validates them against the runtime's
+actual delivery).  Here the oracle is a brute-force Python enumeration:
+for randomly generated task classes (random ranges, affine dep offsets,
+guard predicates, cross-class edges) it computes the exact expected
+(producer -> consumer) edge multiset and the expected executed-task set,
+then compares both against the EDGE/EXEC trace of the real run through
+release_deps, the dense/hash dependency engines, and the domain filters.
+"""
+import random
+from collections import Counter
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import KEY_EXEC, take_trace
+
+# predicate library: (name, expr builder over param exprs, python eval)
+_PREDS = [
+    ("always", lambda ps: None, lambda p: True),
+    ("even", lambda ps: ps[0] % 2 == 0, lambda p: p[0] % 2 == 0),
+    ("low", lambda ps: ps[0] <= 3, lambda p: p[0] <= 3),
+    ("odd-sum", lambda ps: (sum(ps, 0) % 2) == 1,
+     lambda p: sum(p) % 2 == 1),
+]
+
+
+def _gen_case(rng: random.Random):
+    """A random consistent taskpool spec: classes with 1-2 range params,
+    plus edge families (src flow with Out -> dst flow with In) whose two
+    declarations are derived from the same (offsets, predicate) ground
+    truth — the JDF bidirectional-declaration discipline."""
+    n_classes = rng.randint(1, 2)
+    classes = []
+    for ci in range(n_classes):
+        nparams = rng.randint(1, 2)
+        bounds = [(0, rng.randint(2, 7)) for _ in range(nparams)]
+        classes.append({"name": f"K{ci}", "nparams": nparams,
+                        "bounds": bounds})
+    families = []
+    for fi in range(rng.randint(1, 3)):
+        dst = rng.randrange(n_classes)
+        nd = classes[dst]["nparams"]
+        # src <= dst keeps the DAG acyclic; src params must INJECT into
+        # dst params (ns <= nd), else one consumer flow would receive
+        # from several producers — ill-formed dataflow
+        cands = [c for c in range(dst + 1)
+                 if classes[c]["nparams"] <= nd]
+        src = rng.choice(cands)
+        ns = classes[src]["nparams"]
+        # offsets map src params onto the dst's FIRST ns params; missing
+        # dst params (ns < nd) pin to a constant
+        offs = [rng.randint(0, 2) for _ in range(ns)]
+        if src == dst and all(o == 0 for o in offs):
+            offs[0] = 1  # forbid self-loops
+        pin = [rng.randint(0, classes[dst]["bounds"][i][1])
+               for i in range(ns, nd)]
+        pred = rng.choice(_PREDS)
+        families.append({"id": fi, "src": src, "dst": dst, "offs": offs,
+                         "pin": pin, "pred": pred})
+    return {"classes": classes, "families": families,
+            "sched": rng.choice(["lfq", "lws", "ll"])}
+
+
+def _domain(cls):
+    def rec(i):
+        if i == cls["nparams"]:
+            yield ()
+            return
+        lo, hi = cls["bounds"][i]
+        for v in range(lo, hi + 1):
+            for rest in rec(i + 1):
+                yield (v,) + rest
+    return list(rec(0))
+
+
+def _expected(case):
+    """Oracle: executed-task set (every in-domain instance; each flow has
+    an In(None) fallback) and the exact edge multiset."""
+    execd = set()
+    for ci, cls in enumerate(case["classes"]):
+        for p in _domain(cls):
+            execd.add((ci, p[0], p[1] if len(p) > 1 else 0))
+    edges = Counter()
+    for fam in case["families"]:
+        scls = case["classes"][fam["src"]]
+        dcls = case["classes"][fam["dst"]]
+        dset = set(_domain(dcls))
+        for p in _domain(scls):
+            if not fam["pred"][2](p):
+                continue
+            q = tuple(p[i] + fam["offs"][i] for i in range(len(p))) \
+                + tuple(fam["pin"])
+            if q not in dset:
+                continue
+            edges[((fam["src"], p[0], p[1] if len(p) > 1 else 0),
+                   (fam["dst"], q[0], q[1] if len(q) > 1 else 0))] += 1
+    return execd, edges
+
+
+def _build_and_run(case):
+    with pt.Context(nb_workers=2, scheduler=case["sched"]) as ctx:
+        ctx.profile_enable(2)  # spans + EDGE pairs
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={})
+        tcs = []
+        params = []
+        for cls in case["classes"]:
+            tc = tp.task_class(cls["name"])
+            ps = []
+            for i in range(cls["nparams"]):
+                nm = "kj"[i]
+                lo, hi = cls["bounds"][i]
+                tc.param(nm, lo, hi)
+                ps.append(pt.L(nm))
+            tcs.append(tc)
+            params.append(ps)
+        for fam in case["families"]:
+            stc, dtc = tcs[fam["src"]], tcs[fam["dst"]]
+            sps, dps = params[fam["src"]], params[fam["dst"]]
+            sname = case["classes"][fam["src"]]["name"]
+            dname = case["classes"][fam["dst"]]["name"]
+            dcls = case["classes"][fam["dst"]]
+            fx, fy = f"X{fam['id']}", f"Y{fam['id']}"
+            # ---- OUT side (declared on src): pred(own) & target-in-domain
+            tgt = [sps[i] + fam["offs"][i] for i in range(len(sps))] \
+                + list(fam["pin"])
+            g = fam["pred"][1](sps)
+            for i in range(len(sps)):
+                lo, hi = dcls["bounds"][i]
+                b = (tgt[i] >= lo) & (tgt[i] <= hi)
+                g = b if g is None else (g & b)
+            out = pt.Out(pt.Ref(dname, *tgt, flow=fy), guard=g) \
+                if g is not None else pt.Out(pt.Ref(dname, *tgt, flow=fy))
+            stc.flow(fx, "RW", pt.In(None), out, arena="t")
+            # ---- IN side (declared on dst): src exists & pred(src)
+            srcp = [dps[i] - fam["offs"][i] for i in range(len(sps))]
+            scls = case["classes"][fam["src"]]
+            gi = fam["pred"][1](srcp)
+            for i in range(len(srcp)):
+                lo, hi = scls["bounds"][i]
+                b = (srcp[i] >= lo) & (srcp[i] <= hi)
+                gi = b if gi is None else (gi & b)
+            # pinned dst params: this family only feeds instances at the
+            # pinned values
+            for i, v in enumerate(fam["pin"]):
+                gi = gi & (dps[len(srcp) + i] == v)
+            dtc.flow(fy, "RW",
+                     pt.In(pt.Ref(sname, *srcp, flow=fx), guard=gi),
+                     pt.In(None), arena="t")
+        # classes untouched by any family still need one flow
+        flowed = {f["src"] for f in case["families"]} \
+            | {f["dst"] for f in case["families"]}
+        for ci, tc in enumerate(tcs):
+            if ci not in flowed:
+                tc.flow("Z", "RW", pt.In(None), arena="t")
+            tc.body(lambda t: None)
+        tp.run()
+        tp.wait()
+        tr = take_trace(ctx,
+                        class_names=[c["name"] for c in case["classes"]])
+    ev = tr.events
+    execd = {(int(e[2]), int(e[3]), int(e[4]))
+             for e in ev if e[0] == KEY_EXEC and e[1] == 0}
+    edges = Counter(tr.edges())
+    return execd, edges
+
+
+def test_iterators_checker_randomized(monkeypatch):
+    """>=100 generated classes cross-checked against the brute-force
+    oracle (the reference iterators_checker role, in CI).  Odd-numbered
+    cases disable the dense dependency engine so the hash-sharded path
+    is cross-checked by the same oracle."""
+    rng = random.Random(20260731)
+    n_cases = 80  # 80 cases x 1-2 classes >= 100 classes
+    n_classes = 0
+    total_edges = 0
+    for case_no in range(n_cases):
+        if case_no % 2:
+            monkeypatch.setenv("PTC_MCA_deptable_dense_max", "0")
+        else:
+            monkeypatch.delenv("PTC_MCA_deptable_dense_max",
+                               raising=False)
+        case = _gen_case(rng)
+        n_classes += len(case["classes"])
+        want_exec, want_edges = _expected(case)
+        got_exec, got_edges = _build_and_run(case)
+        assert got_exec == want_exec, (case_no, case,
+                                       got_exec ^ want_exec)
+        assert got_edges == want_edges, (
+            case_no, case,
+            {"missing": want_edges - got_edges,
+             "extra": got_edges - want_edges})
+        total_edges += sum(want_edges.values())
+    assert n_classes >= 100
+    assert total_edges > 200  # the generation was not degenerate
+
+
+def test_iterators_checker_known_case():
+    """One pinned case kept readable as documentation of the contract."""
+    case = {
+        "classes": [{"name": "K0", "nparams": 1, "bounds": [(0, 5)]}],
+        "families": [{"id": 0, "src": 0, "dst": 0, "offs": [2],
+                      "pin": [], "pred": _PREDS[1]}],  # even producers
+        "sched": "lfq",
+    }
+    want_exec, want_edges = _expected(case)
+    got_exec, got_edges = _build_and_run(case)
+    assert got_exec == want_exec
+    # even k in 0..3 -> k+2: edges 0->2, 2->4  (4 is even but 6 > hi)
+    assert got_edges == want_edges == Counter(
+        {((0, 0, 0), (0, 2, 0)): 1, ((0, 2, 0), (0, 4, 0)): 1})

@@ -1,12 +1,20 @@
 #!/usr/bin/env python
-"""Render a LiveMonitor counter stream (profiling/live.py JSONL) as a
-compact terminal table — the CLI face of the aggregator_visu role (the
-reference's GUI itself stays out of scope; any dashboard can consume
-the same file).
+"""Render LiveMonitor counter streams (profiling/live.py JSONL) as a
+compact terminal table — the CLI face of the aggregator_visu role
+(reference: tools/aggregator_visu/aggregator.py, which socket-aggregates
+per-rank counters for a GUI; here the per-rank JSONL files ARE the
+transport and any dashboard can consume them).
 
-  python tools/live_tail.py /tmp/ptc_live_rank0.jsonl          # snapshot
-  python tools/live_tail.py /tmp/ptc_live_rank0.jsonl --follow # tail -f
+  python tools/live_tail.py /tmp/ptc_live_rank0.jsonl           # one rank
+  python tools/live_tail.py /tmp/ptc_live_rank0.jsonl --follow  # tail -f
+  python tools/live_tail.py '/tmp/ptc_live_rank*.jsonl' --merge # all ranks
+  python tools/live_tail.py '/tmp/ptc_live_rank*.jsonl' --merge --follow
+
+--merge shows ONE view with a line per rank (latest sample each) plus a
+cluster totals line; ranks whose stream appears later JOIN the view on
+the next refresh.
 """
+import glob
 import json
 import sys
 import time
@@ -33,12 +41,95 @@ def _fmt(snap):
     return line
 
 
+def read_latest(path, tail_bytes=65536):
+    """Last valid snapshot in one rank's stream, or None.  Reads only a
+    bounded tail window: the follow loop polls every second and streams
+    grow without bound, so a full re-parse per poll would be quadratic
+    cumulative work."""
+    last = None
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - tail_bytes))
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    lines = chunk.splitlines()
+    if size > tail_bytes and lines:
+        lines = lines[1:]  # first line of a mid-file window is partial
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            last = json.loads(line)
+        except ValueError:
+            continue
+    return last
+
+
+def merge_latest(paths):
+    """Rank-keyed latest snapshots across N per-rank streams (the
+    aggregator_visu join): {rank: snapshot}.  Ranks appear as their
+    stream files appear — a late-joining rank shows up on the next
+    call."""
+    merged = {}
+    for p in paths:
+        snap = read_latest(p)
+        if snap is None:
+            continue
+        merged[int(snap.get("rank", 0))] = snap
+    return merged
+
+
+def render_merged(merged):
+    """One view: a line per rank + cluster totals."""
+    lines = []
+    tot_tasks = 0
+    tot_tx = tot_rx = 0
+    for rank in sorted(merged):
+        snap = merged[rank]
+        lines.append(_fmt(snap))
+        tot_tasks += sum(snap.get("workers", []))
+        c = snap.get("comm") or {}
+        tot_tx += c.get("bytes_sent", 0)
+        tot_rx += c.get("bytes_recv", 0)
+    lines.append(f"== {len(merged)} rank(s) tasks={tot_tasks} "
+                 f"tx={tot_tx >> 10}KiB rx={tot_rx >> 10}KiB")
+    return "\n".join(lines)
+
+
+def _expand(args):
+    paths = []
+    for a in args:
+        if any(ch in a for ch in "*?["):
+            paths.extend(sorted(glob.glob(a)))
+        else:
+            paths.append(a)
+    return paths
+
+
 def main():
-    if len(sys.argv) < 2:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    follow = "--follow" in sys.argv
+    # a glob pattern implies the multi-rank view even without --merge
+    # (the literal pattern is not an openable path)
+    merge = ("--merge" in sys.argv or len(args) > 1
+             or any(ch in a for a in args for ch in "*?["))
+    if not args:
         sys.stderr.write(__doc__)
         return 2
-    path = sys.argv[1]
-    follow = "--follow" in sys.argv
+    if merge:
+        patterns = args
+        while True:
+            merged = merge_latest(_expand(patterns))
+            print(render_merged(merged))
+            if not follow:
+                return 0
+            time.sleep(1.0)
+            print()
+    path = args[0]
     with open(path) as f:
         while True:
             line = f.readline()
