@@ -2643,6 +2643,21 @@ int32_t ptc_register_linear_collection(ptc_context_t *ctx, uint32_t nodes,
   return (int32_t)ctx->collections.size() - 1;
 }
 
+/* tool access to a registered collection's vtable (ptg_to_dtd, dumps) */
+ptc_data_t *ptc_dc_data_of(ptc_context_t *ctx, int32_t dc_id,
+                           const int64_t *idx, int32_t n) {
+  if (!ctx || dc_id < 0 || (size_t)dc_id >= ctx->collections.size())
+    return nullptr;
+  return ptc_collection_data_of(ctx, dc_id, idx, n);
+}
+
+int32_t ptc_dc_rank_of(ptc_context_t *ctx, int32_t dc_id,
+                       const int64_t *idx, int32_t n) {
+  if (!ctx || dc_id < 0 || (size_t)dc_id >= ctx->collections.size())
+    return 0;
+  return (int32_t)ptc_collection_rank_of(ctx, dc_id, idx, n);
+}
+
 int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size) {
   std::lock_guard<std::mutex> g(ctx->reg_lock);
   Arena *a = new Arena();

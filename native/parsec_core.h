@@ -143,6 +143,13 @@ int32_t ptc_register_linear_collection(ptc_context_t *ctx, uint32_t nodes,
                                        int64_t nb_elems, int64_t elem_size);
 /* arena: size-class allocator for WRITE-only flow outputs */
 int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size);
+/* tool access to a registered collection's vtable (ptg_to_dtd, dumps):
+ * the datum at idx[0..n-1] (lazily created for linear collections) and
+ * its owning rank */
+ptc_data_t *ptc_dc_data_of(ptc_context_t *ctx, int32_t dc_id,
+                           const int64_t *idx, int32_t n);
+int32_t ptc_dc_rank_of(ptc_context_t *ctx, int32_t dc_id,
+                       const int64_t *idx, int32_t n);
 
 /* wire datatype: `count` blocks of `elem_bytes` spaced `stride_bytes`
  * apart (contiguous when stride == elem).  Attached per dep (JDF

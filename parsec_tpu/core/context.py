@@ -85,6 +85,7 @@ class Context:
         self._buffers: List[np.ndarray] = []
         self.collections: Dict[str, int] = {}
         self.arenas: Dict[str, int] = {}
+        self.arena_sizes: Dict[str, int] = {}  # name -> elem bytes
         self.datatypes: Dict[str, int] = {}
         self._colocated: set = set()  # ranks sharing this accel client
         self._destroyed = False
@@ -364,6 +365,7 @@ class Context:
     def register_arena(self, name: str, elem_size: int) -> int:
         aid = N.lib.ptc_register_arena(self._ptr, elem_size)
         self.arenas[name] = aid
+        self.arena_sizes[name] = elem_size
         return aid
 
     def worker_binding(self, worker: int) -> int:

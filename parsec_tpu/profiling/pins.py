@@ -17,6 +17,7 @@ at Context init, or modules are attached explicitly with enable_pins().
 from __future__ import annotations
 
 import ctypes as C
+import resource
 import threading
 from typing import Dict, List, Optional, Type
 
@@ -139,11 +140,72 @@ class PrintSteals(PinsModule):
             f"(total {sum(steals)})\n")
 
 
+class HwCounters(PinsModule):
+    """Per-class OS hardware/software counters over task execution spans
+    (reference: mca/pins/papi, which reads PAPI event sets at the same
+    hook points).  TPU VMs expose no PAPI; the portable equivalents are
+    the per-THREAD rusage counters — user/system cpu-time, minor faults,
+    voluntary + involuntary context switches — sampled at EXEC begin/end
+    on the worker thread itself (RUSAGE_THREAD), so deltas attribute to
+    exactly the sampled task.  Like the reference's papi module this is
+    opt-in instrumentation: two getrusage syscalls per task (~1µs) — not
+    for the ns/task hot-path benches."""
+
+    name = "hwcounters"
+    mask = 1 << KEY_EXEC
+
+    def __init__(self):
+        self._open: Dict[tuple, tuple] = {}
+        # class_id -> [tasks, utime_us, stime_us, minflt, nvcsw, nivcsw]
+        self.counters: Dict[int, list] = {}
+        self._lock = threading.Lock()  # see TaskCounter
+
+    @staticmethod
+    def _sample():
+        r = resource.getrusage(resource.RUSAGE_THREAD)
+        return (int(r.ru_utime * 1e6), int(r.ru_stime * 1e6),
+                r.ru_minflt, r.ru_nvcsw, r.ru_nivcsw)
+
+    def on_event(self, key, phase, class_id, l0, l1, worker, aux, t_ns):
+        sig = (worker, class_id, l0, l1)
+        if phase == 0:
+            with self._lock:
+                self._open[sig] = self._sample()
+            return
+        now = self._sample()
+        with self._lock:
+            begin = self._open.pop(sig, None)
+            if begin is None:
+                return
+            c = self.counters.setdefault(class_id, [0, 0, 0, 0, 0, 0])
+            c[0] += 1
+            for i in range(5):
+                c[1 + i] += now[i] - begin[i]
+
+    def report(self, class_names: Optional[Dict[int, str]] = None) -> str:
+        rows = []
+        with self._lock:
+            items = sorted(self.counters.items())
+        for cid, c in items:
+            name = (class_names or {}).get(cid, f"class{cid}")
+            rows.append(
+                f"{name}: tasks={c[0]} utime={c[1]}us stime={c[2]}us "
+                f"minflt={c[3]} vcsw={c[4]} ivcsw={c[5]}")
+        return "\n".join(rows)
+
+    def on_uninstall(self, ctx) -> None:
+        import sys
+        rep = self.report()
+        if rep:
+            sys.stderr.write("ptc [pins] hwcounters:\n" + rep + "\n")
+
+
 REGISTRY: Dict[str, Type[PinsModule]] = {
     TaskCounter.name: TaskCounter,
     TaskProfiler.name: TaskProfiler,
     CommVolume.name: CommVolume,
     PrintSteals.name: PrintSteals,
+    HwCounters.name: HwCounters,
 }
 
 

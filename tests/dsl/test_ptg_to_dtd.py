@@ -1,0 +1,187 @@
+"""PTG -> DTD runtime conversion (reference: parsec/mca/pins/ptg_to_dtd):
+the same PTG spec executes through the DTD engine and must produce the
+same data — the two front-ends cross-validate."""
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.dsl.ptg_to_dtd import eval_expr, run_ptg_as_dtd
+
+
+def _chain_spec(ctx, nb):
+    """Ex04-style RW chain rooted at a collection element."""
+    arr = np.zeros(1, dtype=np.int64)
+    ctx.register_linear_collection("A", arr, elem_size=8, nodes=1,
+                                   myrank=0)
+    ctx.register_arena("t", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": nb})
+    k = pt.L("k")
+    tc = tp.task_class("T")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(pt.Mem("A", 0), guard=(k == 0)),
+            pt.In(pt.Ref("T", k - 1, flow="A")),
+            pt.Out(pt.Ref("T", k + 1, flow="A"), guard=(k < pt.G("NB"))),
+            pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+            arena="t")
+
+    def body(view):
+        d = view.data("A", dtype=np.int64, shape=(1,))
+        d[0] += 1
+    tc.body(body)
+    return tp, arr
+
+
+def test_chain_ptg_vs_dtd():
+    nb = 17
+    with pt.Context(nb_workers=2) as ctx:
+        tp, arr = _chain_spec(ctx, nb)
+        tp.run()
+        tp.wait()
+        ptg_result = arr[0]
+    assert ptg_result == nb + 1
+    with pt.Context(nb_workers=2) as ctx:
+        tp, arr = _chain_spec(ctx, nb)
+        stats = run_ptg_as_dtd(ctx, tp, {"A": None})
+        assert stats["tasks"] == nb + 1
+        assert arr[0] == ptg_result, (arr[0], ptg_result)
+
+
+def _fan_spec(ctx, nb):
+    """P(k) computes into its own tile; C(k) doubles it — Mem-rooted
+    producer/consumer pairs with a guard filter on the consumer edge."""
+    arr = np.zeros(nb, dtype=np.int64)
+    ctx.register_linear_collection("A", arr, elem_size=8, nodes=1,
+                                   myrank=0)
+    ctx.register_arena("t", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": nb - 1})
+    k = pt.L("k")
+    P = tp.task_class("P")
+    P.param("k", 0, pt.G("NB"))
+    P.flow("X", "RW",
+           pt.In(pt.Mem("A", k)),
+           pt.Out(pt.Ref("C", k, flow="X")),
+           arena="t")
+
+    def pbody(view):
+        view.data("X", dtype=np.int64, shape=(1,))[0] = \
+            10 + view.local("k")
+    P.body(pbody)
+    C = tp.task_class("C")
+    C.param("k", 0, pt.G("NB"))
+    C.flow("X", "RW",
+           pt.In(pt.Ref("P", k, flow="X")),
+           pt.Out(pt.Mem("A", k)),
+           arena="t")
+
+    def cbody(view):
+        view.data("X", dtype=np.int64, shape=(1,))[0] *= 2
+    C.body(cbody)
+    return tp, arr
+
+
+def test_fan_ptg_vs_dtd():
+    nb = 9
+    with pt.Context(nb_workers=2) as ctx:
+        tp, arr = _fan_spec(ctx, nb)
+        tp.run()
+        tp.wait()
+        ptg = arr.copy()
+    np.testing.assert_array_equal(ptg, 2 * (10 + np.arange(nb)))
+    with pt.Context(nb_workers=2) as ctx:
+        tp, arr = _fan_spec(ctx, nb)
+        run_ptg_as_dtd(ctx, tp, {"A": None})
+        np.testing.assert_array_equal(arr, ptg)
+
+
+def test_potrf_ptg_vs_dtd():
+    """The reference tool's flagship: a dense Cholesky PTG pool
+    re-executed through DTD matches numpy."""
+    from parsec_tpu.algos import build_potrf
+    from parsec_tpu.data import TwoDimBlockCyclic
+
+    N, nb = 96, 32
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((N, N), dtype=np.float32)
+    spd = M @ M.T + N * np.eye(N, dtype=np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(spd)
+        A.register(ctx, "A")
+        tp = build_potrf(ctx, A)
+        stats = run_ptg_as_dtd(ctx, tp, {"A": A})
+        nt = N // nb
+        assert stats["tasks"] == nt + 2 * (nt * (nt - 1)) // 2 \
+            + nt * (nt - 1) * (nt - 2) // 6
+        out = np.tril(A.to_dense())
+        np.testing.assert_allclose(out, np.linalg.cholesky(spd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_eval_expr_matches_native_vm():
+    """The Python evaluator agrees with the native expression VM on the
+    operator set (spot expressions through a guard-observable class)."""
+    k, NB = pt.L("k"), pt.G("NB")
+    cases = [
+        ((k + 3) * 2 - (k // 2), {"k": 5}, {"NB": 9}, 14),
+        (pt.select(k % 2 == 0, k, -k), {"k": 7}, {"NB": 0}, -7),
+        (pt.minimum(k, 4) + pt.maximum(k, 4), {"k": 2}, {"NB": 0}, 6),
+        ((k < NB) & (k >= 0), {"k": 3}, {"NB": 4}, 1),
+        (~(k == 3), {"k": 3}, {"NB": 0}, 0),
+    ]
+    for e, loc, glb, want in cases:
+        assert eval_expr(e, loc, glb) == want, (e, want)
+
+
+def test_ctl_flow_rejected():
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": 3})
+        k = pt.L("k")
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("Z", "CTL", pt.In(None), arena="t")
+        tc.body_noop()
+        try:
+            run_ptg_as_dtd(ctx, tp, {})
+            assert False, "CTL must be rejected loudly"
+        except NotImplementedError:
+            pass
+
+
+def _crosstile_spec(ctx, n):
+    """Chain rooted at tile 0 whose LAST task ALSO writes tile n-1 — the
+    PTG release-time cross-tile Mem memcpy, which the converter must
+    reproduce as an explicit copy task (caught by a verify probe)."""
+    arr = np.zeros(n, dtype=np.int64)
+    ctx.register_linear_collection("A", arr, elem_size=8, nodes=1,
+                                   myrank=0)
+    ctx.register_arena("t", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": n - 1})
+    k = pt.L("k")
+    tc = tp.task_class("T")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("X", "RW",
+            pt.In(pt.Mem("A", 0), guard=(k == 0)),
+            pt.In(pt.Ref("T", k - 1, flow="X")),
+            pt.Out(pt.Ref("T", k + 1, flow="X"), guard=(k < pt.G("NB"))),
+            pt.Out(pt.Mem("A", pt.G("NB")), guard=(k == pt.G("NB"))),
+            arena="t")
+
+    def body(view):
+        view.data("X", dtype=np.int64, shape=(1,))[0] += 5
+    tc.body(body)
+    return tp, arr
+
+
+def test_crosstile_memout_writeback():
+    n = 8
+    with pt.Context(nb_workers=2) as ctx:
+        tp, arr = _crosstile_spec(ctx, n)
+        tp.run()
+        tp.wait()
+        ptg = arr.copy()
+    assert ptg[n - 1] == 5 * n  # the cross-tile writeback target
+    with pt.Context(nb_workers=2) as ctx:
+        tp, arr = _crosstile_spec(ctx, n)
+        run_ptg_as_dtd(ctx, tp, {"A": None})
+        np.testing.assert_array_equal(arr, ptg)

@@ -132,3 +132,32 @@ def test_perfetto_includes_device_dispatch(tmp_path, monkeypatch):
     dd = [e for e in doc["traceEvents"] if e["cat"] == "DEVICE_DISPATCH"]
     assert dd, [e["cat"] for e in doc["traceEvents"][:10]]
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in dd)
+
+
+def test_pins_hwcounters():
+    """papi-analog module: per-class RUSAGE_THREAD deltas over EXEC
+    spans — cpu time must accumulate for a busy class."""
+    from parsec_tpu.profiling.pins import HwCounters, enable_pins
+
+    with pt.Context(nb_workers=2) as ctx:
+        hw = HwCounters()
+        enable_pins(ctx, hw)
+        tp = pt.Taskpool(ctx, globals={"NB": 199})
+        tc = tp.task_class("Busy")
+        tc.param("k", 0, pt.G("NB"))
+
+        def body(view):
+            x = 0
+            for i in range(4000):
+                x += i * i
+            return None
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx._pins_chain.uninstall()
+    assert list(hw.counters) == [0]
+    c = hw.counters[0]
+    assert c[0] == 200            # every task sampled
+    assert c[1] + c[2] > 0        # cpu time attributed
+    rep = hw.report({0: "Busy"})
+    assert rep.startswith("Busy: tasks=200")
