@@ -2545,6 +2545,8 @@ int32_t ptc_context_start(ptc_context_t *ctx) {
   std::lock_guard<std::mutex> g(ctx->start_lock);
   if (ctx->started.load(std::memory_order_relaxed)) return 0;
   ctx->sched = ptc_sched_create(ctx->sched_name);
+  if (!ctx->vp_of_worker.empty())
+    ctx->sched->set_vpmap(ctx->vp_of_worker);
   ctx->sched->install(ctx->nb_workers);
   ctx->sched->steals_init(ctx->nb_workers);
   for (int i = 0; i < ctx->nb_workers; i++)
@@ -2584,6 +2586,30 @@ void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes) {
 
 void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode) {
   ctx->bind_mode = mode;
+}
+
+/* vpmap (reference: parsec/vpmap.c): vp id per worker, before start.
+ * Returns -1 once the context started — the scheduler was installed
+ * with the old map and will not re-read it (silent acceptance would
+ * leave the caller believing the hierarchy changed). */
+int32_t ptc_context_set_vpmap(ptc_context_t *ctx, const int32_t *vp,
+                              int32_t n) {
+  if (!ctx || !vp || n <= 0) return -1;
+  std::lock_guard<std::mutex> g(ctx->start_lock);
+  if (ctx->started.load(std::memory_order_acquire)) return -1;
+  ctx->vp_of_worker.assign(vp, vp + n);
+  return 0;
+}
+
+/* test/debug probe: the victim (steal) order a hierarchical scheduler
+ * computed for `worker`.  Returns the count written (<= cap), or -1
+ * when the active scheduler has no explicit order (flat modules). */
+int32_t ptc_sched_victim_order(ptc_context_t *ctx, int32_t worker,
+                               int32_t *out, int32_t cap) {
+  if (!ctx || !ctx->sched) return -1;
+  auto *lhq = dynamic_cast<SchedVictimOrder *>(ctx->sched);
+  if (!lhq) return -1;
+  return lhq->victim_order(worker, out, cap);
 }
 
 void ptc_context_set_verbose(ptc_context_t *ctx, int32_t subsys,

@@ -200,6 +200,17 @@ void ptc_context_set_rank(ptc_context_t *ctx, uint32_t myrank, uint32_t nodes);
  * process's allowed cpuset.  Call before the first taskpool runs. */
 void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode);
 
+/* vpmap (reference: parsec/vpmap.c virtual processes): vp id per
+ * worker, set before the context starts.  Hierarchical schedulers
+ * (lhq) steal within a worker's vp before crossing vps.  Returns 0, or
+ * -1 when the context already started (the map would be ignored). */
+int32_t ptc_context_set_vpmap(ptc_context_t *ctx, const int32_t *vp,
+                              int32_t n);
+/* test/debug probe: a hierarchical scheduler's computed steal order
+ * for `worker` (count written, or -1 for flat modules) */
+int32_t ptc_sched_victim_order(ptc_context_t *ctx, int32_t worker,
+                               int32_t *out, int32_t cap);
+
 /* per-subsystem debug verbosity (reference: the parsec output/debug
  * streams, parsec/utils/debug.c — one stream per subsystem with its own
  * verbosity).  Level 0 = warnings only (default); >=1 enables `ptc

@@ -407,9 +407,22 @@ struct Scheduler {
       steals[(size_t)w]->fetch_add(1, std::memory_order_relaxed);
   }
   virtual ~Scheduler() {}
+  /* vp (virtual process / NUMA domain) per worker, given BEFORE
+   * install; hierarchical modules (lhq) shape their steal order on it,
+   * everyone else ignores it (reference: vpmap.c feeding sched init) */
+  virtual void set_vpmap(const std::vector<int32_t> &) {}
   virtual void install(int nb_workers) = 0;
   virtual void schedule(int worker, ptc_task *t) = 0;
   virtual ptc_task *select(int worker) = 0;
+};
+
+/* optional introspection mixin for hierarchical schedulers: exposes
+ * the computed steal order so tests can assert the hierarchy without
+ * racing actual steals (consumed by ptc_sched_victim_order) */
+struct SchedVictimOrder {
+  virtual ~SchedVictimOrder() {}
+  virtual int32_t victim_order(int32_t worker, int32_t *out,
+                               int32_t cap) const = 0;
 };
 
 /* registered by name; see sched table in core.cpp */
@@ -607,6 +620,10 @@ struct ptc_context {
    * scheduled/retired counters + per-thread rusage dumps,
    * parsec/scheduling.c:45-86,319-323) */
   std::vector<std::atomic<int64_t> *> worker_executed;
+  /* vpmap (reference: parsec/vpmap.c virtual processes): vp id per
+   * worker, set before start; empty = flat (single vp).  Consumed by
+   * hierarchical schedulers (lhq steal order). */
+  std::vector<int32_t> vp_of_worker;
   /* thread binding (hwloc analog): 0 = unbound, 1 = round-robin core
    * pinning; worker_cpu[w] = bound cpu id or -1 */
   int32_t bind_mode = 0;

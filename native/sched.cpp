@@ -205,6 +205,67 @@ struct SchedPBQ : SchedLFQ {
   }
 };
 
+/* lhq: LOCAL HIERARCHICAL QUEUES (reference: mca/sched/lhq + the NUMA
+ * form of pbq) — per-worker deques like lfq, but the steal order is the
+ * hierarchy: a worker missing locally first visits every queue of its
+ * OWN virtual process (NUMA domain, ptc_context_set_vpmap), then the
+ * other vps.  With a flat vpmap (everyone vp 0) this degrades to lfq's
+ * ring order — the hierarchy is exactly the vp structure. */
+struct SchedLHQ : SchedLFQ, SchedVictimOrder {
+  std::vector<std::vector<int>> order; /* per worker: victim sequence */
+  void set_vpmap(const std::vector<int32_t> &vp) override { vpmap = vp; }
+  std::vector<int32_t> vpmap;
+  int32_t victim_order(int32_t w, int32_t *out,
+                       int32_t cap) const override {
+    if (w < 0 || (size_t)w >= order.size()) return -1;
+    int32_t k = 0;
+    for (int v : order[(size_t)w]) {
+      if (k >= cap) break;
+      out[k++] = v;
+    }
+    return k;
+  }
+  void install(int n) override {
+    SchedLFQ::install(n);
+    n = std::max(1, n);
+    if ((int)vpmap.size() != n) vpmap.assign((size_t)n, 0);
+    order.assign((size_t)n, {});
+    for (int w = 0; w < n; w++) {
+      /* same-vp victims in ring order, then the rest in ring order */
+      for (int i = 1; i < n; i++)
+        if (vpmap[(size_t)((w + i) % n)] == vpmap[(size_t)w])
+          order[(size_t)w].push_back((w + i) % n);
+      for (int i = 1; i < n; i++)
+        if (vpmap[(size_t)((w + i) % n)] != vpmap[(size_t)w])
+          order[(size_t)w].push_back((w + i) % n);
+    }
+  }
+  ptc_task *select(int w) override {
+    int n = (int)qs.size();
+    int me = w % n;
+    {
+      Q &q = qs[(size_t)me];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.dq.empty()) {
+        ptc_task *t = q.dq.back(); /* LIFO local: cache warmth */
+        q.dq.pop_back();
+        return t;
+      }
+    }
+    for (int v : order[(size_t)me]) { /* FIFO steals up the hierarchy */
+      Q &q = qs[(size_t)v];
+      std::lock_guard<std::mutex> g(q.lock);
+      if (!q.dq.empty()) {
+        ptc_task *t = q.dq.front();
+        q.dq.pop_front();
+        steal_tick(me);
+        return t;
+      }
+    }
+    return nullptr;
+  }
+};
+
 /* ---------------- global family ---------------- */
 
 /* gd: one global dequeue (reference: mca/sched/gd) */
@@ -318,15 +379,15 @@ struct SchedRND : Scheduler {
 
 } // namespace
 
-/* canonical module name a request resolves to: aliases collapse
- * ("lhq" -> "pbq"), unknown names fall back to the default "lfq" —
- * exposed so callers/tests can observe which module actually runs */
+/* canonical module name a request resolves to; unknown names fall back
+ * to the default "lfq" — exposed so callers/tests can observe which
+ * module actually runs.  lhq became its own module (hierarchical
+ * vp-aware steal order) in r5; it is no longer a pbq alias. */
 const char *ptc_sched_canonical(const char *name) {
-  static const char *known[] = {"gd", "ap",  "ll",  "ltq", "pbq",
+  static const char *known[] = {"gd", "ap",  "ll",  "ltq", "pbq", "lhq",
                                 "ip", "spq", "rnd", "lfq", "lws"};
   if (name) {
     std::string n(name);
-    if (n == "lhq") return "pbq";
     for (const char *k : known)
       if (n == k) return k;
   }
@@ -339,7 +400,8 @@ Scheduler *ptc_sched_create(const std::string &name) {
   if (name == "ap") return new SchedAP();
   if (name == "ll") return new SchedLL();
   if (name == "ltq") return new SchedLTQ();
-  if (name == "pbq" || name == "lhq") return new SchedPBQ();
+  if (name == "pbq") return new SchedPBQ();
+  if (name == "lhq") return new SchedLHQ();
   if (name == "ip") return new SchedIP();
   if (name == "spq") return new SchedSPQ();
   if (name == "rnd") return new SchedRND();

@@ -143,6 +143,12 @@ _sigs = {
     "ptc_register_body": (C.c_int32, [C.c_void_p, BODY_CB_T, C.c_void_p]),
     "ptc_register_collection": (C.c_int32, [C.c_void_p, C.c_uint32, C.c_uint32,
                                             RANK_OF_CB_T, DATA_OF_CB_T, C.c_void_p]),
+    "ptc_context_set_vpmap": (C.c_int32, [C.c_void_p,
+                                          C.POINTER(C.c_int32),
+                                          C.c_int32]),
+    "ptc_sched_victim_order": (C.c_int32, [C.c_void_p, C.c_int32,
+                                           C.POINTER(C.c_int32),
+                                           C.c_int32]),
     "ptc_dc_data_of": (C.c_void_p, [C.c_void_p, C.c_int32,
                                     C.POINTER(C.c_int64), C.c_int32]),
     "ptc_dc_rank_of": (C.c_int32, [C.c_void_p, C.c_int32,

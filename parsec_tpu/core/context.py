@@ -39,6 +39,44 @@ class Data:
             self._ptr = None
 
 
+def _numa_vpmap(n: int) -> "List[int]":
+    """vp per worker from the NUMA topology: worker w round-robin-binds
+    to allowed cpu w % ncpu (bind_worker_thread's order), and its vp is
+    that cpu's NUMA node, dense-renumbered.  Flat on hosts without
+    sysfs NUMA info (reference: the hwloc-fed vpmap init)."""
+    import glob as _glob
+    import os as _os
+    try:
+        cpus = sorted(_os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return [0] * n
+    node_of = {}
+    for path in _glob.glob("/sys/devices/system/node/node[0-9]*"):
+        try:
+            node = int(_os.path.basename(path)[4:])
+            with open(_os.path.join(path, "cpulist")) as f:
+                txt = f.read().strip()
+        except (OSError, ValueError):
+            continue
+        for part in txt.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "-" in part:
+                a, b = part.split("-")
+                rng = range(int(a), int(b) + 1)
+            else:
+                rng = [int(part)]
+            for c in rng:
+                node_of[c] = node
+    if not node_of or not cpus:
+        return [0] * n
+    nodes_sorted = sorted({node_of.get(c, 0) for c in cpus})
+    dense = {nd: i for i, nd in enumerate(nodes_sorted)}
+    return [dense[node_of.get(cpus[w % len(cpus)], nodes_sorted[0])]
+            for w in range(n)]
+
+
 class Context:
     def __init__(self, nb_workers: Optional[int] = None,
                  scheduler: Optional[str] = None):
@@ -70,6 +108,8 @@ class Context:
             _live(self, _mca.get("runtime.live"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
+        if _mca.get("runtime.vpmap") not in ("", "flat"):
+            self.set_vpmap(_mca.get("runtime.vpmap"))
         N.lib.ptc_device_set_affinity_skew(
             self._ptr, _mca.get("device.affinity_skew"))
         # per-subsystem debug streams (parsec/utils/debug.c analog)
@@ -147,7 +187,7 @@ class Context:
     @property
     def scheduler_name(self) -> str:
         """Canonical name of the scheduler module that runs (unknown
-        requests fall back to "lfq"; "lhq" is the "pbq" module)."""
+        requests fall back to "lfq")."""
         return N.lib.ptc_context_get_scheduler(self._ptr).decode()
 
     def set_rank(self, myrank: int, nodes: int):
@@ -367,6 +407,39 @@ class Context:
         self.arenas[name] = aid
         self.arena_sizes[name] = elem_size
         return aid
+
+    def set_vpmap(self, spec) -> List[int]:
+        """Virtual-process map (reference: parsec/vpmap.c): a vp id per
+        worker, before the context starts.  `spec` is a list of ints,
+        'numa' (derive from the NUMA node each worker's round-robin
+        binding cpu belongs to), or a comma-separated string.  Returns
+        the applied list.  Hierarchical schedulers (lhq) steal within a
+        vp before crossing vps."""
+        n = N.lib.ptc_context_nb_workers(self._ptr)
+        if isinstance(spec, (list, tuple)):
+            vps = [int(x) for x in spec]
+        elif spec == "numa":
+            vps = _numa_vpmap(n)
+        else:
+            vps = [int(x) for x in str(spec).split(",") if x.strip()]
+        if not vps:
+            vps = [0] * n
+        if len(vps) < n:  # short specs repeat (vpmap file semantics)
+            vps = (vps * (n // len(vps) + 1))[:n]
+        vps = vps[:n]
+        arr = (C.c_int32 * n)(*vps)
+        if N.lib.ptc_context_set_vpmap(self._ptr, arr, n) != 0:
+            raise RuntimeError(
+                "set_vpmap: context already started — the scheduler was "
+                "installed with the previous map")
+        return vps
+
+    def sched_victim_order(self, worker: int, cap: int = 64):
+        """A hierarchical scheduler's computed steal order for `worker`
+        (None for flat modules) — test/debug probe."""
+        out = (C.c_int32 * cap)()
+        k = N.lib.ptc_sched_victim_order(self._ptr, worker, out, cap)
+        return None if k < 0 else list(out[:k])
 
     def worker_binding(self, worker: int) -> int:
         """CPU the worker thread is pinned to (runtime.bind=core), or -1

@@ -160,6 +160,12 @@ register("debug.comm", 0, int,
 register("debug.device", 0, int,
          "device-subsystem verbosity: >=1 prints stage/flush "
          "diagnostics")
+register("runtime.vpmap", "flat", str,
+         "virtual-process map (reference: parsec/vpmap.c): 'flat' (one "
+         "vp), 'numa' (derive each worker's vp from the NUMA node of "
+         "the cpu it would round-robin-bind to), or an explicit "
+         "comma-separated vp id per worker ('0,0,1,1').  Hierarchical "
+         "schedulers (lhq) steal within a vp before crossing vps")
 register("runtime.bind", "none", str,
          "worker thread binding: none|core — core pins workers "
          "round-robin over the allowed cpuset (reference: the hwloc "

@@ -10,7 +10,7 @@ from .chain_util import chain_task_class
 
 # requested name -> canonical module that must actually run
 SCHEDULERS = {"lfq": "lfq", "lws": "lws", "ll": "ll", "gd": "gd",
-              "ap": "ap", "ltq": "ltq", "pbq": "pbq", "lhq": "pbq",
+              "ap": "ap", "ltq": "ltq", "pbq": "pbq", "lhq": "lhq",
               "ip": "ip", "spq": "spq", "rnd": "rnd"}
 
 

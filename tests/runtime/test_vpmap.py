@@ -1,0 +1,100 @@
+"""vpmap (virtual processes / NUMA domains; reference: parsec/vpmap.c)
+and the hierarchical lhq scheduler whose steal order follows it."""
+import threading
+
+import parsec_tpu as pt
+
+
+def _start(ctx):
+    """Force context start so the scheduler exists (lazy startup)."""
+    ctx.register_arena("t", 8)
+    tp = pt.Taskpool(ctx, globals={})
+    tc = tp.task_class("Noop")
+    tc.param("k", 0, 0)
+    tc.body_noop()
+    tp.run()
+    tp.wait()
+
+
+def test_lhq_steal_order_follows_vpmap():
+    """4 workers in 2 vps [0,0,1,1]: each worker's victim order lists
+    its OWN vp's workers before the other vp's (the hierarchy)."""
+    with pt.Context(nb_workers=4, scheduler="lhq") as ctx:
+        assert ctx.set_vpmap([0, 0, 1, 1]) == [0, 0, 1, 1]
+        _start(ctx)
+        assert ctx.scheduler_name == "lhq"
+        assert ctx.sched_victim_order(0) == [1, 2, 3]
+        assert ctx.sched_victim_order(1) == [0, 2, 3]
+        assert ctx.sched_victim_order(2) == [3, 0, 1]
+        assert ctx.sched_victim_order(3) == [2, 0, 1]
+
+
+def test_lhq_flat_vpmap_is_ring_order():
+    with pt.Context(nb_workers=3, scheduler="lhq") as ctx:
+        _start(ctx)  # no vpmap: flat
+        assert ctx.sched_victim_order(0) == [1, 2]
+        assert ctx.sched_victim_order(1) == [2, 0]
+
+
+def test_victim_order_none_for_flat_modules():
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        _start(ctx)
+        assert ctx.sched_victim_order(0) is None
+
+
+def test_vpmap_string_and_repeat():
+    """Comma specs parse; short specs repeat over the workers (the
+    vpmap-file semantics)."""
+    with pt.Context(nb_workers=4, scheduler="lhq") as ctx:
+        assert ctx.set_vpmap("0,1") == [0, 1, 0, 1]
+        _start(ctx)
+        assert ctx.sched_victim_order(0) == [2, 1, 3]
+
+
+def test_vpmap_numa_resolves():
+    """'numa' derives a valid map on any Linux host (flat where the
+    sysfs topology shows one node — this 1-core box)."""
+    with pt.Context(nb_workers=2, scheduler="lhq") as ctx:
+        vps = ctx.set_vpmap("numa")
+        assert len(vps) == 2 and all(v >= 0 for v in vps)
+
+
+def test_lhq_runs_dags_correctly():
+    """Correctness under the hierarchy: ep fan + strict chain."""
+    n = 120
+    done = []
+    lock = threading.Lock()
+    with pt.Context(nb_workers=4, scheduler="lhq") as ctx:
+        ctx.set_vpmap([0, 0, 1, 1])
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"N": n - 1})
+        tc = tp.task_class("Ep")
+        tc.param("k", 0, pt.G("N"))
+        tc.flow("A", "RW", pt.In(None), arena="t")
+
+        def body(v):
+            with lock:
+                done.append(v["k"])
+        tc.body(body)
+        tp.run()
+        tp.wait()
+    assert sorted(done) == list(range(n))
+
+
+def test_vpmap_mca_param(monkeypatch):
+    monkeypatch.setenv("PTC_MCA_runtime_vpmap", "0,1")
+    monkeypatch.setenv("PTC_MCA_runtime_sched", "lhq")
+    with pt.Context(nb_workers=2) as ctx:
+        _start(ctx)
+        assert ctx.scheduler_name == "lhq"
+        assert ctx.sched_victim_order(0) == [1]
+
+
+def test_set_vpmap_after_start_raises():
+    """A post-start map would be silently ignored by the installed
+    scheduler — refuse loudly instead."""
+    import pytest
+    with pt.Context(nb_workers=2, scheduler="lhq") as ctx:
+        _start(ctx)
+        with pytest.raises(RuntimeError, match="already started"):
+            ctx.set_vpmap([0, 1])
