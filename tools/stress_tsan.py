@@ -185,7 +185,7 @@ def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
 def main():
     reps = int(os.environ.get("STRESS_REPS", "3"))
     for rep in range(reps):
-        for sched in ("lws", "lfq", "ll"):
+        for sched in ("lws", "lfq", "ll", "lhq"):
             ep_burst(sched, workers=8, n=20000)
             chain_mesh(sched, workers=8, nb=200, lanes=16)
         dtd_churn(workers=8, tiles=8, rounds=100)
