@@ -1359,13 +1359,15 @@ END
 
 
 def potrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
-                      nb: int = 16, use_device: bool = False):
+                      nb: int = 16, use_device: bool = False,
+                      scheduler: str = "lfq"):
     """Distributed PANEL-granular Cholesky: full-height N x nb panels
     cyclic over ranks (the ScaLAPACK-style 1-D panel distribution).
     Every factored panel F(k) broadcasts to the ranks owning later
     panels (big payloads: the whole panel rides the remote-dep protocol,
     eager or rendezvous by size); validated per-rank against numpy."""
-    pt, ctx = _mk_ctx(rank, nodes, port)
+    pt, ctx = _mk_ctx(rank, nodes, port, scheduler=scheduler)
+    assert ctx.scheduler_name == scheduler  # no silent fallback
     from parsec_tpu.algos import build_potrf_panels
     from parsec_tpu.data.collections import TwoDimBlockCyclic
 
