@@ -16,11 +16,13 @@ Cholesky factorization — in its two dataflow shapes:
 Run:  python examples/Ex09_PanelCholesky.py [N] [nb]
 Add a TPU/virtual device automatically when jax is importable.
 """
+import os
 import sys
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import parsec_tpu as pt  # noqa: E402
 from parsec_tpu.algos import build_potrf, build_potrf_panels  # noqa: E402
@@ -34,6 +36,23 @@ def main():
     M = rng.standard_normal((N, N), dtype=np.float32)
     spd = M @ M.T + N * np.eye(N, dtype=np.float32)
     ref = np.linalg.cholesky(spd)
+
+    # Probe the accelerator in a SUBPROCESS before touching jax here:
+    # tunnel-fronted TPU plugins can hang backend init for hours when
+    # the link is down (and they override JAX_PLATFORMS=cpu from the
+    # environment), so a dead probe pins this process to CPU devices.
+    import importlib.util
+    import subprocess
+    if importlib.util.find_spec("jax") is not None:
+        try:
+            alive = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=20, capture_output=True).returncode == 0
+        except subprocess.TimeoutExpired:
+            alive = False
+        if not alive:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
 
     dev = None
     with pt.Context(nb_workers=4) as ctx:

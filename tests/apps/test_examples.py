@@ -14,3 +14,18 @@ def test_ex09_panel_cholesky_runs():
         runpy.run_path(path, run_name="__main__")
     finally:
         sys.argv = old
+
+
+def test_ex10_crosscheck_runs():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "examples", "Ex10_CrossCheck.py")
+    old = sys.argv
+    sys.argv = [path]
+    try:
+        try:
+            runpy.run_path(path, run_name="__main__")
+        except SystemExit as e:  # the example exits 0 on success
+            assert not e.code, e.code
+    finally:
+        sys.argv = old
