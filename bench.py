@@ -307,8 +307,8 @@ def bench_ep(nb_tasks=100000, workers=(1, 2, 4, 8), scheds=None):
     a (scheduler x workers) tasks/s table to stderr and returns the
     matrix."""
     if scheds is None:
-        scheds = ["lfq", "lws", "ll", "ltq", "pbq", "gd", "ap", "spq", "ip",
-                  "rnd"]
+        scheds = ["lfq", "lws", "ll", "ltq", "pbq", "lhq", "gd", "ap",
+                  "spq", "ip", "rnd"]
     results = {}
     steals = {}
     for w in workers:
