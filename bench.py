@@ -218,7 +218,9 @@ def _potrf_once(N, nb, seed=0, check=False, profile=False,
                 f"fused={s.get('fused_flows', 0)} "
                 f"eager={s.get('eager_gathers', 0)} "
                 f"h2d={s['h2d_bytes']} d2h={s['d2h_bytes']} "
-                f"wb={s.get('wb_tasks', 0)}\n")
+                f"wb={s.get('wb_tasks', 0)} "
+                f"spec={s.get('spec_hits', 0)}/"
+                f"{s.get('spec_store', 0)}\n")
         resid = 0.0
         if check:
             # the exact residual assembles dense L, A, and L L^T — ~7x

@@ -56,6 +56,8 @@ class _DeviceBody:
         self.tc = tc
         self.tp = tp
         self.nb_flows = nb_flows
+        self.epilogue = None  # _Epilogue on the SOURCE class
+        self.spec_src = None  # _Epilogue on the DESTINATION class
         self.batch = batch  # kernel is elementwise over tiles: vmap-able
         # flows whose output deps include a memory writeback: their host
         # copy must be coherent at completion (release_deps may memcpy it)
@@ -487,6 +489,31 @@ def _get_vmapped(jax_mod, kernel: Callable) -> Callable:
     return j
 
 
+def _sig_core(jax_mod, kernel: Callable, sig: tuple, single: bool):
+    """The (possibly vmapped) kernel for a sig — one source of truth for
+    the vmap axes shared by _get_fused and _get_fused_epi."""
+    if single:
+        return kernel
+    axes = tuple(None if s in ("bcast", "bidx") else 0 for s in sig)
+    return jax_mod.vmap(kernel, in_axes=axes)
+
+
+def _sig_assemble(jnp, sig, args):
+    """Marshal flat call args into kernel inputs per the sig — the
+    idx/bidx gathers happen here, INSIDE the traced program.  Returns
+    (inputs, args_consumed); shared by _get_fused and _get_fused_epi so
+    the two can never marshal differently."""
+    ins, ai = [], 0
+    for s in sig:
+        if s in ("idx", "bidx"):
+            ins.append(jnp.take(args[ai], args[ai + 1], axis=0))
+            ai += 2
+        else:  # "bcast" / pre-stacked passthrough
+            ins.append(args[ai])
+            ai += 1
+    return ins, ai
+
+
 def _get_fused(jax_mod, kernel: Callable, sig: tuple, single: bool):
     """One jitted program fusing the per-flow gathers INTO the kernel
     call.  `sig[i]` says whether read flow i arrives as (stack, idx) —
@@ -512,27 +539,74 @@ def _get_fused(jax_mod, kernel: Callable, sig: tuple, single: bool):
     f = _FUSED_CACHE.get(key)
     if f is None:
         jnp = jax_mod.numpy
-        if single:
-            core = kernel
-        else:
-            axes = tuple(None if s in ("bcast", "bidx") else 0
-                         for s in sig)
-            core = jax_mod.vmap(kernel, in_axes=axes)
+        core = _sig_core(jax_mod, kernel, sig, single)
 
         def fused(*args):
-            ins, ai = [], 0
-            for s in sig:
-                if s in ("idx", "bidx"):
-                    ins.append(jnp.take(args[ai], args[ai + 1], axis=0))
-                    ai += 2
-                else:  # "bcast" / pre-stacked passthrough
-                    ins.append(args[ai])
-                    ai += 1
+            ins, _ = _sig_assemble(jnp, sig, args)
             return core(*ins)
 
         f = jax_mod.jit(fused)
         _FUSED_CACHE[key] = f
     return f
+
+
+def _get_fused_epi(jax_mod, kernel: Callable, sig: tuple, single: bool,
+                   epi_kernel: Callable, w_idx: int, n_epi_ops: int):
+    """_get_fused plus a SPECULATIVE EPILOGUE: after the (vmapped)
+    kernel, one lane's output feeds a second kernel inside the SAME
+    jitted program — the device-call answer to a critical-path
+    consumer that the runtime has not released yet (it will, the moment
+    this wave completes).  Panel factorizations are the shape this
+    serves: the U(k, k+1) update's output is factored into F(k+1)'s
+    result in the same call, halving calls on the factor chain.
+
+    Batched form appends (lane:int32, *epi_ops) to the argument list
+    and returns (*outs, *epi_outs); single form appends just the ops
+    (the one lane IS the output)."""
+    key = (kernel, sig, single, epi_kernel, w_idx, n_epi_ops)
+    f = _FUSED_CACHE.get(key)
+    if f is None:
+        jnp = jax_mod.numpy
+        core = _sig_core(jax_mod, kernel, sig, single)
+        n_extra = n_epi_ops + (0 if single else 1)
+
+        def fused(*args):
+            base, extra = args[:len(args) - n_extra], \
+                args[len(args) - n_extra:]
+            ins, _ = _sig_assemble(jnp, sig, base)
+            out = core(*ins)
+            outs = out if isinstance(out, tuple) else (out,)
+            if single:
+                src = outs[w_idx]
+                ops = extra
+            else:
+                src = jnp.take(outs[w_idx], extra[0], axis=0)
+                ops = extra[1:]
+            e = epi_kernel(src, *ops)
+            eouts = e if isinstance(e, tuple) else (e,)
+            return outs + eouts
+
+        f = jax_mod.jit(fused)
+        _FUSED_CACHE[key] = f
+    return f
+
+
+class _Epilogue:
+    """Speculative cross-class fusion config, attached to the SOURCE
+    body (see TpuDevice.attach_epilogue)."""
+    __slots__ = ("dst_bkey", "kernel", "pick", "dst_params", "ops",
+                 "src_flow", "dst_in_flow", "n_dst_writes")
+
+    def __init__(self, dst_bkey, kernel, pick, dst_params, ops,
+                 src_flow, dst_in_flow, n_dst_writes):
+        self.dst_bkey = dst_bkey
+        self.kernel = kernel
+        self.pick = pick
+        self.dst_params = dst_params
+        self.ops = ops
+        self.src_flow = src_flow
+        self.dst_in_flow = dst_in_flow
+        self.n_dst_writes = n_dst_writes
 
 
 def _single_stack(ents):
@@ -719,6 +793,10 @@ class TpuDevice:
         self._cache_used = 0
         # id(stack) -> [refcount, stack]; the strong ref keeps id() stable
         self._stacks: Dict[int, list] = {}
+        # speculative epilogue results: (dst body key, dst params) ->
+        # (arrays, src_uid, src_version); consumed by the dst task's
+        # dispatch, version-checked (see attach_epilogue)
+        self._spec: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
         self._dbg(f"device up: {self.device} queue={self.qid} "
                   f"cache={cache_bytes >> 20}MiB batch<= {self.batch_max}")
@@ -733,7 +811,8 @@ class TpuDevice:
                       "dp_sends": 0, "dp_d2d_bytes": 0, "dp_xfer_bytes": 0,
                       "dp_recv_bytes": 0, "invalidations": 0,
                       "eager_gathers": 0, "fused_flows": 0,
-                      "wb_tasks": 0, "f64_refused": 0}
+                      "wb_tasks": 0, "f64_refused": 0,
+                      "spec_store": 0, "spec_hits": 0, "spec_misses": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -1045,6 +1124,36 @@ class TpuDevice:
         self.bodies[(id(tp), tc.id)] = body
         self._tp_by_ptr[tp._ptr] = tp
 
+    def attach_epilogue(self, src_tc: TaskClass, dst_tc: TaskClass, tp,
+                        src_flow: str, dst_in_flow: str, pick, dst_params,
+                        kernel: Callable, ops) -> None:
+        """Speculative cross-class fusion (the dispatch-economics lever
+        for factor chains): when a wave of `src_tc` contains the lane
+        whose output is `dst_tc`'s next input, compute `kernel` (the
+        dst-class device kernel) on that lane INSIDE the wave's program
+        and park the result; when the dst task arrives, it completes
+        from the parked result with ZERO device calls (version-checked
+        against its actual input copy — any mismatch falls back to a
+        normal dispatch).
+
+          pick(src_view)  -> dst key tuple if this lane feeds the next
+                             dst task, else None
+          dst_params(view)-> the same key computed on the dst side
+          ops(key)        -> extra host operands for `kernel` (tiny)
+
+        Both classes must already be attach()ed to this device.
+        Disable via PTC_DEVICE_EPILOGUE=0 (bench comparison)."""
+        if os.environ.get("PTC_DEVICE_EPILOGUE", "1") == "0":
+            return
+        src = self.bodies.get((id(tp), src_tc.id))
+        dst = self.bodies.get((id(tp), dst_tc.id))
+        if src is None or dst is None:
+            return  # not device-attached (e.g. f64 refusal): no fusion
+        epi = _Epilogue((id(tp), dst_tc.id), kernel, pick, dst_params,
+                        ops, src_flow, dst_in_flow, len(dst.writes))
+        src.epilogue = epi
+        dst.spec_src = epi
+
     def stage_collection(self, coll):
         """Bulk-prestage every local tile of a TwoDimBlockCyclic-like
         collection: ONE h2d transfer of a stacked array, then per-tile
@@ -1170,6 +1279,7 @@ class TpuDevice:
                                                   self.qid)
             self._cache.clear()
             self._stacks.clear()
+            self._spec.clear()
             self._cache_used = 0
 
     def _manager(self):
@@ -1329,7 +1439,7 @@ class TpuDevice:
         self._cache_put(uid, ver + 1, arr, host.nbytes,
                         dirty=True, host=host, persistent=persistent)
         self._invalidate_siblings(uid)
-        return uid
+        return uid, ver + 1
 
     def _dispatch_group(self, body: _DeviceBody, tasks: List):
         """One vmapped executable call for a group of ready tasks of the
@@ -1380,6 +1490,17 @@ class TpuDevice:
             self._prof(1, body, len(tasks))
 
     def _dispatch_group_run(self, body: _DeviceBody, tasks: List):
+        if body.spec_src is not None:
+            # batched destination class: consume parked results here too
+            # (potrf's factor chain never batches, but the mechanism must
+            # not silently waste stores for classes that do)
+            rest = []
+            for t in tasks:
+                if not self._try_spec(body, t, body.make_view(t)):
+                    rest.append(t)
+            if not rest:
+                return
+            tasks = rest
         views = [body.make_view(t) for t in tasks]
         bucket = _bucket(len(tasks))
         try:
@@ -1431,20 +1552,47 @@ class TpuDevice:
                     sig[0] = None
                     call_args[0] = self._jax.numpy.stack(
                         [call_args[0]] * bucket)
-            out = _get_fused(self._jax, body.kernel, tuple(sig),
-                             single=False)(*call_args)
-            outs = out if isinstance(out, tuple) else (out,)
+            # speculative epilogue: if one lane feeds the next dst-class
+            # task, compute the dst kernel on it inside the same program
+            epi = body.epilogue
+            epi_lane = epi_key = None
+            if epi is not None:
+                for i, view in enumerate(views):
+                    kk = epi.pick(view)
+                    if kk is not None:
+                        epi_lane, epi_key = i, kk
+                        break
+            if epi_lane is not None:
+                epi_ops = epi.ops(epi_key)
+                w_idx = body.writes.index(epi.src_flow)
+                out_all = _get_fused_epi(
+                    self._jax, body.kernel, tuple(sig), False,
+                    epi.kernel, w_idx, len(epi_ops))(
+                        *call_args, np.int32(epi_lane), *epi_ops)
+                outs = tuple(out_all[:len(body.writes)])
+                eouts = tuple(out_all[len(body.writes):])
+            else:
+                out = _get_fused(self._jax, body.kernel, tuple(sig),
+                                 single=False)(*call_args)
+                outs = out if isinstance(out, tuple) else (out,)
+                eouts = ()
             wb_stacks = []
+            epi_src = None
             for f, ostack in zip(body.writes, outs):
                 sync_host = f in body.mem_out_flows
                 uids = []
                 for i, view in enumerate(views):
-                    uid = self._write_out(view, body, f,
-                                          _StackRef(ostack, i))
+                    uid, nv = self._write_out(view, body, f,
+                                              _StackRef(ostack, i))
                     if sync_host:
                         uids.append(uid)
+                    if epi_lane is not None and i == epi_lane \
+                            and f == epi.src_flow:
+                        epi_src = (uid, nv)
                 if sync_host:
                     wb_stacks.append((ostack, uids))
+            if eouts and epi_src is not None:
+                self._spec_put((epi.dst_bkey, epi_key), eouts, epi_src)
             self.stats["tasks"] += len(tasks)
             self.stats["batches"] += 1
             self.stats["batched_tasks"] += len(tasks)
@@ -1480,8 +1628,69 @@ class TpuDevice:
         finally:
             self._prof(1, body, 1)
 
+    def _spec_put(self, key, eouts, src) -> None:
+        """Park a speculative result.  Bounded: an unconsumed entry
+        (the dst task routed to a sibling device) pins a whole panel of
+        HBM, so only a handful may linger."""
+        self._spec[key] = (eouts, src[0], src[1])
+        self.stats["spec_store"] += 1
+        while len(self._spec) > 4:
+            self._spec.pop(next(iter(self._spec)))
+
+    def _try_spec(self, body, task, view) -> bool:
+        """Destination-side epilogue fast path: complete the task from a
+        parked speculative result (ZERO device calls) when its input
+        copy matches the version the source wave produced.  Returns True
+        when the task was DISPOSED (completed or failed) — a raising
+        user callback must not kill the manager thread, it fails the
+        task like every other body-error path."""
+        spec = body.spec_src
+        if spec is None:
+            return False
+        try:
+            rec = self._spec.pop((spec.dst_bkey, spec.dst_params(view)),
+                                 None)
+            if rec is None:
+                return False
+            arrs, suid, sver = rec
+            if len(arrs) != len(body.writes):
+                # misconfigured epilogue kernel (wrong output arity): a
+                # silent partial write would corrupt downstream flows
+                self.stats["spec_misses"] += 1
+                import sys as _sys
+                _sys.stderr.write(
+                    "ptc [device]: epilogue kernel returned "
+                    f"{len(arrs)} output(s), dst class writes "
+                    f"{len(body.writes)}; ignoring parked result\n")
+                return False
+            cptr = N.lib.ptc_task_copy(
+                view._ptr, body.flow_index(spec.dst_in_flow))
+            if N.lib.ptc_copy_handle(cptr) != suid \
+                    or N.lib.ptc_copy_version(cptr) != sver:
+                self.stats["spec_misses"] += 1
+                return False
+            wb_uids = []
+            for f, arr in zip(body.writes, arrs):
+                uid, _ = self._write_out(view, body, f, arr)
+                if f in body.mem_out_flows:
+                    wb_uids.append(uid)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            self.ctx.task_fail(task)
+            return True
+        self.stats["spec_hits"] += 1
+        self.stats["tasks"] += 1
+        if wb_uids and self._wb_thread is not None:
+            self._wb_q.put(("sync", [task], wb_uids))
+            return True
+        self.ctx.task_complete(task)
+        return True
+
     def _dispatch_one_run(self, body, task):
         view = body.make_view(task)
+        if self._try_spec(body, task, view):
+            return
         try:
             # Inputs still living as stack slices are selected INSIDE the
             # jitted program (scalar-index take) — a single-task dispatch
@@ -1497,14 +1706,32 @@ class TpuDevice:
                 else:
                     sig.append(None)
                     call_args.append(ent)
-            out = _get_fused(self._jax, body.kernel, tuple(sig),
-                             single=True)(*call_args)  # async dispatch
-            outs = out if isinstance(out, tuple) else (out,)
+            epi = body.epilogue
+            epi_key = epi.pick(view) if epi is not None else None
+            if epi_key is not None:
+                epi_ops = epi.ops(epi_key)
+                w_idx = body.writes.index(epi.src_flow)
+                out_all = _get_fused_epi(
+                    self._jax, body.kernel, tuple(sig), True,
+                    epi.kernel, w_idx, len(epi_ops))(*call_args,
+                                                     *epi_ops)
+                outs = tuple(out_all[:len(body.writes)])
+                eouts = tuple(out_all[len(body.writes):])
+            else:
+                out = _get_fused(self._jax, body.kernel, tuple(sig),
+                                 single=True)(*call_args)  # async
+                outs = out if isinstance(out, tuple) else (out,)
+                eouts = ()
             wb_uids = []
+            epi_src = None
             for f, arr in zip(body.writes, outs):
-                uid = self._write_out(view, body, f, arr)
+                uid, nv = self._write_out(view, body, f, arr)
                 if f in body.mem_out_flows:
                     wb_uids.append(uid)
+                if epi_key is not None and f == epi.src_flow:
+                    epi_src = (uid, nv)
+            if eouts and epi_src is not None:
+                self._spec_put((epi.dst_bkey, epi_key), eouts, epi_src)
             self.stats["tasks"] += 1
         except Exception:
             # A failed kernel must NOT complete the task — successors
