@@ -394,22 +394,21 @@ def _build_panel_factorization(ctx: pt.Context, A: TwoDimBlockCyclic,
                  shapes={"PK": pshp, idxf: (1,), "PJ": pshp},
                  dtypes={"PK": np.dtype(dt), idxf: np.dtype(np.int32),
                          "PJ": np.dtype(dt)})
-        if update_uses == "j":
-            # speculative epilogue (dispatch-economics lever): the
-            # U(k, k+1) lane's output IS F(k+1)'s input — factor it
-            # inside the same wave program, so the factor chain costs
-            # ONE device call per k step instead of two.  F(k+1) then
-            # completes from the parked result, version-checked.
-            # (getrf's factor also emits the KI index flow from a
-            # different operand layout — not fused yet.)
-            d.attach_epilogue(
-                up, fa, tp, src_flow="PJ", dst_in_flow="P",
-                pick=lambda v: ((v.local("j"),)
-                                if v.local("j") == v.local("k") + 1
-                                else None),
-                dst_params=lambda v: (v.local("k"),),
-                kernel=k_factor,
-                ops=lambda key: [np.asarray([key[0]], dtype=np.int32)])
+        # speculative epilogue (dispatch-economics lever): the U(k, k+1)
+        # lane's output IS F(k+1)'s input — factor it inside the same
+        # wave program, so the factor chain costs ONE device call per k
+        # step instead of two.  F(k+1) then completes from the parked
+        # result, version-checked.  Works for both variants: potrf's
+        # factor returns the panel; getrf's returns (panel, KI), which
+        # matches its two write flows (arity is validated at the hit).
+        d.attach_epilogue(
+            up, fa, tp, src_flow="PJ", dst_in_flow="P",
+            pick=lambda v: ((v.local("j"),)
+                            if v.local("j") == v.local("k") + 1
+                            else None),
+            dst_params=lambda v: (v.local("k"),),
+            kernel=k_factor,
+            ops=lambda key: [np.asarray([key[0]], dtype=np.int32)])
 
     fa.body(b_factor(nt, nb, pshp, dt))
     up.body(b_update(nt, nb, pshp, dt))

@@ -76,3 +76,34 @@ def test_epilogue_two_devices_with_affinity():
     total_misses = sum(s["spec_misses"] for s in stats)
     assert total_hits + total_misses <= 7
     assert total_hits >= 1, stats  # affinity makes hits the common case
+
+
+def test_epilogue_getrf_two_outputs():
+    """getrf's factor returns (panel, KI) — the multi-output epilogue
+    shape: both dst write flows come from the parked result."""
+    from parsec_tpu.algos import build_getrf_panels
+    from parsec_tpu.algos.lu import getrf_nopiv_reference
+
+    N, nb = 192, 32
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((N, N)).astype(np.float32) \
+        + N * np.eye(N, dtype=np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, N, nb, dtype=np.float32)
+        for j in range(A.nt):
+            A.tile(0, j)[...] = M[:, j * nb:(j + 1) * nb]
+        A.register(ctx, "G")
+        dev = TpuDevice(ctx)
+        tp = build_getrf_panels(ctx, A, dev=dev, name="G")
+        tp.run()
+        tp.wait()
+        dev.flush()
+        out = np.zeros((N, N), np.float32)
+        for j in range(A.nt):
+            out[:, j * nb:(j + 1) * nb] = A.tile(0, j)
+        assert dev.stats["spec_hits"] == N // nb - 1, dev.stats
+        assert dev.stats["spec_misses"] == 0
+        dev.stop()
+    ref = getrf_nopiv_reference(M.astype(np.float64))
+    np.testing.assert_allclose(out, ref.astype(np.float32),
+                               rtol=2e-2, atol=2e-2)
