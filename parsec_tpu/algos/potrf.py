@@ -232,8 +232,11 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
 # as the tiled dataflow (DPLASMA dpotrf_L), coarser tasks: each trailing
 # update U(k, j) is ONE (N x nb) @ (nb x nb) MXU matmul, and a wave of
 # them is one vmapped call — the TPU-shaped answer to the tile DAG's
-# launch-overhead wall on a single fat chip.  The tiled build_potrf
-# remains the distributed (PxQ block-cyclic) form.
+# launch-overhead wall on a single fat chip.  (The panel-granular,
+# few-big-matmuls shape follows the published TPU dense-LA recipe —
+# "Large Scale Distributed Linear Algebra With Tensor Processing
+# Units", arXiv:2112.09017 — recast as runtime task dataflow.)  The
+# tiled build_potrf remains the distributed (PxQ block-cyclic) form.
 #
 #   F(k)   : factor panel k   diag = chol(P[kb:kb+nb]); P = P inv(L)^T
 #            (rows above kb zeroed, diag block set to L exactly)

@@ -559,6 +559,9 @@ def _get_fused_epi(jax_mod, kernel: Callable, sig: tuple, single: bool,
     this wave completes).  Panel factorizations are the shape this
     serves: the U(k, k+1) update's output is factored into F(k+1)'s
     result in the same call, halving calls on the factor chain.
+    (Related art: cross-task kernel fusion in mega-kernel compilers,
+    e.g. MPK, arXiv:2512.22219 — here done dynamically by the device
+    module, scoped to a declared producer→consumer pair.)
 
     Batched form appends (lane:int32, *epi_ops) to the argument list
     and returns (*outs, *epi_outs); single form appends just the ops
