@@ -27,4 +27,10 @@ $(TSAN_LIB): $(SRCS) $(HDRS)
 	$(CXX) -O1 -g -std=c++17 -fPIC -Wall -pthread -fsanitize=thread \
 		-shared -o $@ $(SRCS)
 
-.PHONY: all clean tsan
+# Transfer-economics sweep (tools/testbandwidth.py): eager / rendezvous
+# / PK_DEVICE paths on loopback, fitted fixed-overhead + per-byte cost,
+# BENCH-style JSON.  Runs entirely without a TPU tunnel.
+bench-comm: $(LIB)
+	python tools/testbandwidth.py --json BENCH_comm.json
+
+.PHONY: all clean tsan bench-comm

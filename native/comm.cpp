@@ -40,6 +40,16 @@
  *     re-registers what it pulled (rendezvous broadcast, reference
  *     remote_dep_mpi.c:241-253), so big tiles never ride the ACTIVATE
  *     frames and device-resident tiles never touch the producing host.
+ *
+ * Wire v3 — chunked pipelined rendezvous: a GET may carry a byte range
+ * ([u64 offset][u64 len]; len 0 = whole payload, the v2 shape).  Pulls
+ * of payloads above PTC_MCA_comm_chunk_size stream as a window of up to
+ * PTC_MCA_comm_inflight ranged GETs answered by PUT_CHUNK frames and
+ * reassembled receiver-side, so the wire, the producer's serve (one d2h
+ * snapshot per pull, then memcpys) and the consumer's reassembly
+ * overlap, and no single giant frame can monopolize a link that fences
+ * and activations share.  PING/PONG (control frames) measure per-peer
+ * RTT for the adaptive eager threshold (PTC_MCA_comm_eager_limit=auto).
  */
 
 #include "runtime_internal.h"
@@ -47,6 +57,7 @@
 #include <algorithm>
 #include <arpa/inet.h>
 #include <map>
+#include <set>
 #include <cerrno>
 #include <cstdio>
 #include <fcntl.h>
@@ -75,6 +86,11 @@ enum {
                         [i32 tp][u64 seq][u32 flow][u64 len][bytes] */
   MSG_FINI = 11,      /* termination consensus (fini): no further frame
                         will come from the sender; its EOF is expected */
+  MSG_PING = 12,      /* RTT probe: [u64 t0_ns] (control frame; echoed) */
+  MSG_PONG = 13,      /* RTT probe echo: same body, verbatim */
+  MSG_PUT_CHUNK = 14, /* chunked rendezvous payload range:
+                        [u64 cookie][u64 offset][u64 total][u64 clen]
+                        [bytes] — the pipelined answer to a ranged GET */
 };
 
 /* ACTIVATE payload kinds (reference: short/eager piggy-back vs GET
@@ -181,6 +197,10 @@ struct MemReg {
   ptc_copy *src = nullptr; /* retained: keeps pointer identity stable */
   int32_t expected = 0;
   int32_t served = 0;
+  /* live chunk sessions reading `bytes` (host-rendezvous chunked pulls
+   * retire their served++ at the FIRST chunk; this ref keeps the
+   * snapshot alive until the last chunk left the wire) */
+  int32_t chunk_refs = 0;
   uint8_t pk = PK_GET;
   /* true when mem_by_copy[src] maps to THIS handle (raw snapshots only;
    * packed layout-specific snapshots have their own dedup map keyed by
@@ -206,8 +226,16 @@ struct PendingGet {
   int32_t tp_id;
   int32_t flow_idx;
   uint32_t src_rank = UINT32_MAX; /* the rank we are pulling from */
+  uint64_t src_handle = 0;        /* producer-side handle (chunk re-GETs) */
   std::vector<uint8_t> targets_bytes; /* [u32 nb_targets] targets* */
   uint8_t pk;
+  /* chunked pipelined pull (payloads above comm.chunk_size): ranges are
+   * requested with up to comm.inflight outstanding and reassembled
+   * here; empty chunk_buf = whole-payload pull (the v2 shape) */
+  std::vector<uint8_t> chunk_buf;
+  uint64_t total = 0;    /* advertised payload size */
+  uint64_t received = 0; /* bytes landed in chunk_buf */
+  uint64_t next_req = 0; /* next offset not yet requested */
   /* datatype the payload bytes are ALREADY in (from the ACTIVATE frame's
    * shaped field): a consumer whose recv type matches must not re-apply
    * a cast (round-4 review: cast double-apply across the wire) */
@@ -219,6 +247,20 @@ struct PendingGet {
   bool bcast = false;
   uint8_t topo = 0;
   std::vector<BcastWireGroup> groups;
+};
+
+/* producer side of one chunked pull: a persistent per-pull session that
+ * serves ranges of one payload across several GET round trips.  Device
+ * payloads are snapshotted ONCE into `buf` (the d2h happens at session
+ * start, then every chunk is a memcpy); host-rendezvous sessions read
+ * the shared MemReg snapshot in place (no per-puller copy — the fan-out
+ * dedup survives chunking) and hold a chunk_ref on it instead. */
+struct ChunkServe {
+  uint64_t handle = 0;
+  uint32_t from = 0;  /* the pulling rank (peer-loss reaping) */
+  uint64_t total = 0;
+  uint64_t served = 0;          /* cumulative bytes served */
+  std::vector<uint8_t> buf;     /* owned bytes (PK_DEVICE serves) */
 };
 
 } // namespace
@@ -255,11 +297,39 @@ struct CommEngine {
   std::map<std::pair<ptc_copy *, int32_t>, uint64_t> mem_by_packed;
   std::unordered_map<uint64_t, PendingGet> pending_gets;
   int64_t eager_limit = 64 * 1024; /* PTC_MCA_comm_eager_limit; <0 = off */
+  /* chunked pipelined rendezvous (PTC_MCA_comm_chunk_size /
+   * PTC_MCA_comm_inflight): payloads above chunk_size stream in ranged
+   * chunks with up to `inflight` outstanding, so the wire, the
+   * producer's serve and the consumer's reassembly overlap and one
+   * giant frame can never monopolize the link.  chunk_size <= 0
+   * disables chunking (v2 whole-payload pulls). */
+  int64_t chunk_size = 1 << 20;
+  int32_t inflight = 4;
+  /* producer chunk sessions (under `lock`), keyed by (puller rank,
+   * cookie) — cookies are allocated by each CONSUMER's own counter, so
+   * two consumers pulling one producer concurrently WILL present the
+   * same cookie value; keying by cookie alone cross-wired their
+   * sessions (double-advanced `served`, stalling both pulls) */
+  std::map<std::pair<uint32_t, uint64_t>, ChunkServe> chunk_serves;
+  /* pulls whose chunk request was answered by a by-ref/transfer token:
+   * the receiver's already-in-flight chunk GETs are absorbed silently
+   * (bounded FIFO — a cookie is hot only for one window).  Same
+   * (rank, cookie) key as chunk_serves. */
+  std::set<std::pair<uint32_t, uint64_t>> tokened;
+  std::deque<std::pair<uint32_t, uint64_t>> tokened_fifo;
+  /* adaptive eager threshold (PTC_MCA_comm_eager_limit=auto): derived
+   * at init from the measured per-peer round trip (PING/PONG) and the
+   * measured host memcpy bandwidth — see ptc_comm_init */
+  bool eager_adaptive = false;
+  std::atomic<int64_t> rtt_ns{0};       /* min RTT over peers/probes */
+  std::atomic<int64_t> memcpy_bps{0};   /* measured host copy rate */
+  std::atomic<uint32_t> pongs{0};
 
   /* stats (reference: parsec/remote_dep.c counters) */
   std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
   std::atomic<uint64_t> bytes_sent{0}, bytes_recv{0};
   std::atomic<uint64_t> gets_sent{0}, gets_served{0};
+  std::atomic<uint64_t> chunks_sent{0}, chunks_recv{0};
   std::atomic<uint64_t> mem_reg_bytes{0}; /* currently registered */
 
   /* counting termination detection (reference: the fourcounter global-TD
@@ -355,14 +425,16 @@ static size_t reg_live_children(CommEngine *ce, MemReg &m,
  * canary, since a byte-swapped peer presents it reversed. */
 enum : uint32_t {
   PTC_WIRE_MAGIC = 0x50544331u, /* "PTC1" */
-  PTC_WIRE_VERSION = 2, /* v2: PUT frame gained the ltype field */
+  PTC_WIRE_VERSION = 3, /* v3: ranged GET + PUT_CHUNK (chunked
+                           pipelined rendezvous) + PING/PONG probes */
 };
 
 static void comm_post(CommEngine *ce, uint32_t rank,
                       std::vector<uint8_t> &&frame) {
   bool is_ctl = frame.size() > 4 &&
                 (frame[4] == MSG_FENCE || frame[4] == MSG_TD ||
-                 frame[4] == MSG_FINI);
+                 frame[4] == MSG_FINI || frame[4] == MSG_PING ||
+                 frame[4] == MSG_PONG);
   if (!is_ctl) {
     /* activity ticks before the transport enqueues: a fence snapshot
      * must never see the queued frame but miss the count (the transport
@@ -415,21 +487,46 @@ static std::vector<WireTarget> parse_targets(Reader &r, uint32_t nb_targets) {
   return targets;
 }
 
-/* park a pending rendezvous delivery and pull its payload from `from` */
-static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
-                                 uint64_t src_handle, PendingGet &&pg) {
-  uint64_t cookie;
-  pg.src_rank = from;
-  {
-    std::lock_guard<std::mutex> g(ce->lock);
-    if (peer_lost_locked(ce, from)) {
-      std::fprintf(stderr, "ptc-comm: not pulling from lost rank %u; "
-                           "delivery dropped\n", from);
-      return;
+/* free a registration that has no pulls or chunk sessions left
+ * (ce->lock held).  Returns the source copy to release OUTSIDE the
+ * lock, or nullptr. */
+static ptc_copy *maybe_free_reg_locked(CommEngine *ce, uint64_t handle) {
+  auto it = ce->mem_reg.find(handle);
+  if (it == ce->mem_reg.end()) return nullptr;
+  MemReg &m = it->second;
+  if (m.served < m.expected || m.chunk_refs > 0) return nullptr;
+  ce->mem_reg_bytes.fetch_sub(m.bytes.size(), std::memory_order_relaxed);
+  ptc_copy *rel = m.src;
+  if (rel && m.in_by_copy) ce->mem_by_copy.erase(rel);
+  if (rel && m.packed_dtype >= 0)
+    ce->mem_by_packed.erase({rel, m.packed_dtype});
+  ce->mem_reg.erase(it);
+  return rel;
+}
+
+/* retire one completed pull of `handle` by rank `from` (ce->lock held):
+ * bump served, drop the puller's expectation record, free after the
+ * last pull.  Shared by the whole-payload and chunked serve paths so
+ * the registration accounting cannot diverge between them. */
+static ptc_copy *retire_pull_locked(CommEngine *ce, uint64_t handle,
+                                    uint32_t from) {
+  auto it = ce->mem_reg.find(handle);
+  if (it == ce->mem_reg.end()) return nullptr;
+  MemReg &m = it->second;
+  m.served++;
+  for (auto t = m.targets.begin(); t != m.targets.end(); ++t)
+    if (*t == from) {
+      m.targets.erase(t);
+      break;
     }
-    cookie = ce->next_cookie++;
-    ce->pending_gets.emplace(cookie, std::move(pg));
-  }
+  return maybe_free_reg_locked(ce, handle);
+}
+
+/* build one ranged GET frame (len == 0 requests the whole payload) */
+static std::vector<uint8_t> make_get_frame(CommEngine *ce,
+                                           uint64_t src_handle,
+                                           uint64_t cookie, uint64_t offset,
+                                           uint64_t len) {
   std::vector<uint8_t> f = frame_begin(MSG_GET);
   Writer w{f};
   w.u64(src_handle);
@@ -438,9 +535,54 @@ static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
    * instead of bytes?  (set by the device layer after its pull probe) */
   w.u8((uint8_t)(ce->ctx->dp_can_pull.load(std::memory_order_relaxed)
                      ? 1 : 0));
+  w.u64(offset);
+  w.u64(len);
   frame_finish(f);
+  return f;
+}
+
+/* park a pending rendezvous delivery and pull its payload from `from`.
+ * `plen` is the advertised payload size: payloads above comm.chunk_size
+ * stream as a pipelined window of ranged GETs (token-eligible PK_DEVICE
+ * pulls stay whole — the producer answers those with a token, not
+ * bytes, and a token never needs chunking). */
+static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
+                                 uint64_t src_handle, uint64_t plen,
+                                 PendingGet &&pg) {
+  uint64_t cookie;
+  pg.src_rank = from;
+  pg.src_handle = src_handle;
+  bool can_pull =
+      ce->ctx->dp_can_pull.load(std::memory_order_relaxed) != 0;
+  bool chunk = ce->chunk_size > 0 && plen > (uint64_t)ce->chunk_size &&
+               !(pg.pk == PK_DEVICE && can_pull);
+  std::vector<std::vector<uint8_t>> frames;
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    if (peer_lost_locked(ce, from)) {
+      std::fprintf(stderr, "ptc-comm: not pulling from lost rank %u; "
+                           "delivery dropped\n", from);
+      return;
+    }
+    cookie = ce->next_cookie++;
+    if (chunk) {
+      pg.total = plen;
+      pg.chunk_buf.resize((size_t)plen);
+      uint32_t win = ce->inflight > 0 ? (uint32_t)ce->inflight : 1;
+      for (uint32_t i = 0; i < win && pg.next_req < plen; i++) {
+        uint64_t off = pg.next_req;
+        uint64_t l =
+            std::min<uint64_t>((uint64_t)ce->chunk_size, plen - off);
+        frames.push_back(make_get_frame(ce, src_handle, cookie, off, l));
+        pg.next_req = off + l;
+      }
+    } else {
+      frames.push_back(make_get_frame(ce, src_handle, cookie, 0, 0));
+    }
+    ce->pending_gets.emplace(cookie, std::move(pg));
+  }
   ce->gets_sent.fetch_add(1, std::memory_order_relaxed);
-  comm_post(ce, from, std::move(f));
+  for (auto &f : frames) comm_post(ce, from, std::move(f));
 }
 
 /* Deliver parsed targets: ONE ptc_copy is materialized from the wire
@@ -765,7 +907,6 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   case PK_DEVICE: {
     uint64_t src_handle = r.u64();
     uint64_t plen = r.u64();
-    (void)plen;
     if (!r.ok || !ce || from >= ce->nodes) {
       std::fprintf(stderr, "ptc-comm: malformed rendezvous ACTIVATE "
                            "dropped\n");
@@ -793,7 +934,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     pg.targets_bytes.assign(targets_start, targets_end);
     pg.pk = pk;
     pg.shaped = shaped;
-    send_rendezvous_pull(ce, from, src_handle, std::move(pg));
+    send_rendezvous_pull(ce, from, src_handle, plen, std::move(pg));
     return;
   }
   default:
@@ -831,6 +972,10 @@ static void handle_put_body(ptc_context *ctx, const uint8_t *body, size_t len) {
                   (size_t)std::min<uint64_t>(plen,
                                              (uint64_t)d->host_copy->size));
     d->host_copy->version.fetch_add(1, std::memory_order_release);
+    /* host bytes now authoritative: drop any stale device mirror of
+     * this tile (same hazard as the local write-back in core.cpp's
+     * emit_mem_dep — a leftover dirty mirror would flush over it) */
+    ptc_copy_host_written(ctx, d->host_copy);
   }
 }
 
@@ -1005,7 +1150,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     pg.bcast = true;
     pg.topo = topo;
     pg.groups = std::move(groups);
-    send_rendezvous_pull(ce, from, src_handle, std::move(pg));
+    send_rendezvous_pull(ce, from, src_handle, plen, std::move(pg));
     return;
   }
   /* inline payload: forward FIRST (latency: children deliver while we
@@ -1034,7 +1179,38 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
                   r.p, plen, 0, /*allow_park=*/true, 0, shaped);
 }
 
-/* serve a rendezvous pull: respond with the registered payload bytes */
+/* build one PUT_CHUNK frame serving [offset, offset+clen) of a payload */
+static std::vector<uint8_t> make_chunk_frame(uint64_t cookie,
+                                             uint64_t offset, uint64_t total,
+                                             const uint8_t *base,
+                                             uint64_t clen) {
+  std::vector<uint8_t> f = frame_begin(MSG_PUT_CHUNK);
+  Writer w{f};
+  w.u64(cookie);
+  w.u64(offset);
+  w.u64(total);
+  w.u64(clen);
+  w.raw(base + offset, (size_t)clen);
+  frame_finish(f);
+  return f;
+}
+
+/* remember a cookie whose chunked pull was answered by a token, so the
+ * receiver's already-in-flight chunk GETs are absorbed silently
+ * (ce->lock held; bounded FIFO — a cookie is hot only for one window) */
+static void remember_tokened_locked(CommEngine *ce, uint32_t from,
+                                    uint64_t cookie) {
+  ce->tokened.insert({from, cookie});
+  ce->tokened_fifo.push_back({from, cookie});
+  while (ce->tokened_fifo.size() > 256) {
+    ce->tokened.erase(ce->tokened_fifo.front());
+    ce->tokened_fifo.pop_front();
+  }
+}
+
+/* serve a rendezvous pull: respond with the registered payload bytes —
+ * whole (len == 0, the v2 shape) or as ranged chunks of a persistent
+ * per-pull session (the pipelined path; see ChunkServe) */
 static void handle_get_body(CommEngine *ce, uint32_t from,
                             const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
@@ -1042,16 +1218,68 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
   uint64_t src_handle = r.u64();
   uint64_t cookie = r.u64();
   if (!r.ok) return;
-  /* puller's transfer-plane capability (absent on pre-v2 frames → 0:
+  /* puller's transfer-plane capability (absent on short frames → 0:
    * bytes, the always-safe serve) */
   uint8_t xfer_ok = (r.p < r.end) ? r.u8() : 0;
-  std::vector<uint8_t> f = frame_begin(MSG_PUT_DATA);
-  Writer w{f};
-  w.u64(cookie);
+  /* requested range (wire v3): req_len > 0 selects the chunk protocol */
+  uint64_t offset = 0, req_len = 0;
+  if ((size_t)(r.end - r.p) >= 16) {
+    offset = r.u64();
+    req_len = r.u64();
+  }
+  const bool chunked = req_len > 0;
+
+  if (chunked && offset > 0) {
+    /* continuation chunk of an existing session (offset 0 creates it;
+     * per-link FIFO guarantees the creating GET arrived first) */
+    std::vector<uint8_t> cf;
+    ptc_copy *rel = nullptr;
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      if (ce->tokened.count({from, cookie}))
+        return; /* pull completed by token */
+      auto cs = ce->chunk_serves.find({from, cookie});
+      if (cs == ce->chunk_serves.end()) return; /* reaped (peer loss) */
+      ChunkServe &s = cs->second;
+      const uint8_t *base = s.buf.empty() ? nullptr : s.buf.data();
+      if (base == nullptr) {
+        auto mr = ce->mem_reg.find(s.handle);
+        if (mr == ce->mem_reg.end()) { /* should be pinned by chunk_refs */
+          ce->chunk_serves.erase(cs);
+          return;
+        }
+        base = mr->second.bytes.data();
+      }
+      if (offset > s.total || req_len > s.total - offset) {
+        std::fprintf(stderr, "ptc-comm: chunk GET out of range; session "
+                             "dropped\n");
+        ce->chunk_serves.erase(cs);
+        return;
+      }
+      cf = make_chunk_frame(cookie, offset, s.total, base, req_len);
+      s.served += req_len;
+      if (s.served >= s.total) { /* last chunk: session retires */
+        uint64_t h = s.handle;
+        bool host_reg = s.buf.empty();
+        ce->chunk_serves.erase(cs);
+        if (host_reg) {
+          auto mr = ce->mem_reg.find(h);
+          if (mr != ce->mem_reg.end()) mr->second.chunk_refs--;
+          rel = maybe_free_reg_locked(ce, h);
+        }
+        ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
+    comm_post(ce, from, std::move(cf));
+    if (rel) ptc_copy_release_internal(ctx, rel);
+    return;
+  }
+
   uint8_t pk = PK_GET;
-  bool device_served = false;
   {
     std::unique_lock<std::mutex> g(ce->lock);
+    if (chunked && ce->tokened.count({from, cookie})) return;
     auto it = ce->mem_reg.find(src_handle);
     if (it == ce->mem_reg.end()) {
       g.unlock();
@@ -1063,91 +1291,135 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     MemReg &m = it->second;
     pk = m.pk;
     if (m.pk == PK_DEVICE) {
-      device_served = true; /* serve outside the lock (calls into Python) */
+      /* fall through: serve outside the lock (calls into Python) */
+    } else if (chunked) {
+      /* chunked host-rendezvous serve: first chunk now; the session
+       * reads the SHARED snapshot in place (chunk_refs pins it) —
+       * fan-out dedup survives chunking, no per-puller copy */
+      uint64_t total = (uint64_t)m.bytes.size();
+      uint64_t clen = std::min<uint64_t>(req_len, total);
+      std::vector<uint8_t> cf =
+          make_chunk_frame(cookie, 0, total, m.bytes.data(), clen);
+      ptc_copy *rel = nullptr;
+      if (clen < total) {
+        ChunkServe s;
+        s.handle = src_handle;
+        s.from = from;
+        s.total = total;
+        s.served = clen;
+        m.chunk_refs++;
+        ce->chunk_serves.emplace(std::make_pair(from, cookie),
+                                 std::move(s));
+        /* the pull's served++ happens NOW (one logical pull), the
+         * snapshot stays pinned via chunk_refs until the last chunk */
+        rel = retire_pull_locked(ce, src_handle, from);
+      } else {
+        rel = retire_pull_locked(ce, src_handle, from);
+        ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+      }
+      g.unlock();
+      if (rel) ptc_copy_release_internal(ctx, rel);
+      ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
+      comm_post(ce, from, std::move(cf));
+      return;
     } else {
+      /* whole-payload host serve (the v2 shape) */
+      std::vector<uint8_t> f = frame_begin(MSG_PUT_DATA);
+      Writer w{f};
+      w.u64(cookie);
       w.u8(m.pk);
       w.u64((uint64_t)m.bytes.size());
       w.raw(m.bytes.data(), m.bytes.size());
-    }
-    m.served++;
-    /* retire this puller's expectation record (see MemReg.targets) */
-    for (auto t = m.targets.begin(); t != m.targets.end(); ++t)
-      if (*t == from) {
-        m.targets.erase(t);
-        break;
-      }
-    ptc_copy *rel = nullptr;
-    if (m.served >= m.expected) { /* last pull: drop the registration */
-      ce->mem_reg_bytes.fetch_sub(m.bytes.size(), std::memory_order_relaxed);
-      rel = m.src;
-      if (rel && m.in_by_copy) ce->mem_by_copy.erase(rel);
-      if (rel && m.packed_dtype >= 0)
-        ce->mem_by_packed.erase({rel, m.packed_dtype});
-      ce->mem_reg.erase(it);
-    }
-    g.unlock();
-    if (rel) ptc_copy_release_internal(ctx, rel);
-  }
-  if (device_served) {
-    /* device-resident source: the device layer produces the bytes, or —
-     * for a colocated consumer — a small by-reference token whose payload
-     * rides the device fabric (ICI) instead of this host transport */
-    void *ptr = nullptr;
-    int64_t real = 0;
-    int64_t tag = (int64_t)(src_handle & ~DP_HANDLE_FLAG);
-    int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user, tag,
-                                              (int32_t)from,
-                                              (int32_t)xfer_ok, &ptr,
-                                              &real)
-                              : -1;
-    if (n < 0 || !ptr) {
-      std::fprintf(stderr, "ptc-comm: data plane could not serve tag "
-                           "%llu\n", (unsigned long long)src_handle);
+      frame_finish(f);
+      ptc_copy *rel = retire_pull_locked(ce, src_handle, from);
+      g.unlock();
+      if (rel) ptc_copy_release_internal(ctx, rel);
+      ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+      comm_post(ce, from, std::move(f));
       return;
     }
-    if (real <= 0) real = n;
-    w.u8(pk);
-    w.u64((uint64_t)real); /* true payload size (consumer-side alloc) */
-    w.u64((uint64_t)n);    /* bytes on this wire (== real, or a token) */
-    w.raw(ptr, (size_t)n);
-    if (ctx->dp_serve_done)
-      ctx->dp_serve_done(ctx->dp_user, tag);
   }
+  /* device-resident source: the device layer produces the bytes, or —
+   * for a colocated/transfer-capable consumer — a small by-reference
+   * token whose payload rides the device fabric instead of this host
+   * transport */
+  void *ptr = nullptr;
+  int64_t real = 0;
+  int64_t tag = (int64_t)(src_handle & ~DP_HANDLE_FLAG);
+  int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user, tag,
+                                            (int32_t)from,
+                                            (int32_t)xfer_ok, &ptr, &real)
+                            : -1;
+  if (n < 0 || !ptr) {
+    std::fprintf(stderr, "ptc-comm: data plane could not serve tag "
+                         "%llu\n", (unsigned long long)src_handle);
+    return;
+  }
+  if (real <= 0) real = n;
+  bool is_token = (n != real);
+  if (chunked && !is_token) {
+    /* chunked device serve: the d2h snapshot is taken ONCE into the
+     * session (the persistent-session amortization — every later chunk
+     * is a memcpy off it), and the device pin drops immediately */
+    uint64_t total = (uint64_t)n;
+    uint64_t clen = std::min<uint64_t>(req_len, total);
+    std::vector<uint8_t> cf =
+        make_chunk_frame(cookie, 0, total, (const uint8_t *)ptr, clen);
+    bool finish = clen >= total;
+    ptc_copy *rel = nullptr;
+    {
+      std::lock_guard<std::mutex> g(ce->lock);
+      if (!finish) {
+        ChunkServe s;
+        s.handle = src_handle;
+        s.from = from;
+        s.total = total;
+        s.served = clen;
+        s.buf.assign((const uint8_t *)ptr, (const uint8_t *)ptr + n);
+        ce->chunk_serves.emplace(std::make_pair(from, cookie),
+                                 std::move(s));
+      }
+      rel = retire_pull_locked(ce, src_handle, from);
+    }
+    if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
+    if (rel) ptc_copy_release_internal(ctx, rel);
+    if (finish) ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+    ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
+    comm_post(ce, from, std::move(cf));
+    return;
+  }
+  /* token, or whole-payload device serve */
+  std::vector<uint8_t> f = frame_begin(MSG_PUT_DATA);
+  Writer w{f};
+  w.u64(cookie);
+  w.u8(pk);
+  w.u64((uint64_t)real); /* true payload size (consumer-side alloc) */
+  w.u64((uint64_t)n);    /* bytes on this wire (== real, or a token) */
+  w.raw(ptr, (size_t)n);
   frame_finish(f);
+  ptc_copy *rel = nullptr;
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    rel = retire_pull_locked(ce, src_handle, from);
+    if (chunked) /* token answered a chunked pull: absorb its window */
+      remember_tokened_locked(ce, from, cookie);
+  }
+  if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
+  if (rel) ptc_copy_release_internal(ctx, rel);
   ce->gets_served.fetch_add(1, std::memory_order_relaxed);
   comm_post(ce, from, std::move(f));
 }
 
-/* rendezvous payload arrived: release the parked delivery */
-static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
-                                 size_t len) {
+/* a pulled payload is fully materialized: deliver it (and re-root a
+ * broadcast relay).  Shared tail of the whole-payload (PUT_DATA) and
+ * chunk-reassembly (PUT_CHUNK) paths — the two must never diverge. */
+static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
+                          const uint8_t *payload, uint64_t plen,
+                          uint64_t real_len, uint64_t cookie) {
   ptc_context *ctx = ce->ctx;
-  Reader r{body, body + len};
-  uint64_t cookie = r.u64();
-  uint8_t pk = r.u8();
-  uint64_t real_len = 0;
-  if (pk == PK_DEVICE) real_len = r.u64(); /* true payload size */
-  uint64_t plen = r.u64();
-  if (pk != PK_DEVICE) real_len = plen;
-  if (!r.ok || (size_t)(r.end - r.p) < plen) {
-    std::fprintf(stderr, "ptc-comm: malformed PUT_DATA dropped\n");
-    return;
-  }
-  PendingGet pg;
-  {
-    std::lock_guard<std::mutex> g(ce->lock);
-    auto it = ce->pending_gets.find(cookie);
-    if (it == ce->pending_gets.end()) {
-      std::fprintf(stderr, "ptc-comm: PUT_DATA for unknown cookie %llu "
-                           "dropped\n", (unsigned long long)cookie);
-      return;
-    }
-    pg = std::move(it->second);
-    ce->pending_gets.erase(it);
-  }
   int64_t device_uid = 0;
   if (pk == PK_DEVICE && ctx->dp_deliver)
-    device_uid = ctx->dp_deliver(ctx->dp_user, r.p, (int64_t)plen,
+    device_uid = ctx->dp_deliver(ctx->dp_user, payload, (int64_t)plen,
                                  (int64_t)cookie);
   if (pg.bcast && !pg.groups.empty()) {
     /* re-root: register what we pulled and forward our own handle to the
@@ -1186,7 +1458,7 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
       reg_live_children(ce, m, rchildren);
       if (m.expected > 0) {
         fh = ce->next_handle++;
-        m.bytes.assign(r.p, r.p + plen);
+        m.bytes.assign(payload, payload + plen);
         ce->mem_reg_bytes.fetch_add(m.bytes.size(),
                                     std::memory_order_relaxed);
         ce->mem_reg.emplace(fh, std::move(m));
@@ -1206,8 +1478,96 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
    * lazily from the device mirror via the coherence pull */
   if (!pg.targets_bytes.empty())
     deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
-                    pg.targets_bytes.size(), r.p, plen, device_uid,
+                    pg.targets_bytes.size(), payload, plen, device_uid,
                     /*allow_park=*/true, real_len, pg.shaped);
+}
+
+/* rendezvous payload arrived whole: release the parked delivery.  Also
+ * the token answer to a chunked pull — any partially-assembled chunk
+ * state on the cookie is simply discarded with the PendingGet. */
+static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
+                                 size_t len) {
+  Reader r{body, body + len};
+  uint64_t cookie = r.u64();
+  uint8_t pk = r.u8();
+  uint64_t real_len = 0;
+  if (pk == PK_DEVICE) real_len = r.u64(); /* true payload size */
+  uint64_t plen = r.u64();
+  if (pk != PK_DEVICE) real_len = plen;
+  if (!r.ok || (size_t)(r.end - r.p) < plen) {
+    std::fprintf(stderr, "ptc-comm: malformed PUT_DATA dropped\n");
+    return;
+  }
+  PendingGet pg;
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    auto it = ce->pending_gets.find(cookie);
+    if (it == ce->pending_gets.end()) {
+      std::fprintf(stderr, "ptc-comm: PUT_DATA for unknown cookie %llu "
+                           "dropped\n", (unsigned long long)cookie);
+      return;
+    }
+    pg = std::move(it->second);
+    ce->pending_gets.erase(it);
+  }
+  complete_pull(ce, std::move(pg), pk, r.p, plen, real_len, cookie);
+}
+
+/* one chunk of a pipelined pull landed: reassemble, keep the request
+ * window full, deliver once the last range is in */
+static void handle_put_chunk_body(CommEngine *ce, const uint8_t *body,
+                                  size_t len) {
+  Reader r{body, body + len};
+  uint64_t cookie = r.u64();
+  uint64_t offset = r.u64();
+  uint64_t total = r.u64();
+  uint64_t clen = r.u64();
+  if (!r.ok || (size_t)(r.end - r.p) < clen) {
+    std::fprintf(stderr, "ptc-comm: malformed PUT_CHUNK dropped\n");
+    return;
+  }
+  ce->chunks_recv.fetch_add(1, std::memory_order_relaxed);
+  PendingGet done_pg;
+  bool done = false;
+  uint32_t src = 0;
+  std::vector<uint8_t> next; /* the next ranged GET, if any */
+  {
+    std::lock_guard<std::mutex> g(ce->lock);
+    auto it = ce->pending_gets.find(cookie);
+    if (it == ce->pending_gets.end()) {
+      std::fprintf(stderr, "ptc-comm: PUT_CHUNK for unknown cookie %llu "
+                           "dropped\n", (unsigned long long)cookie);
+      return;
+    }
+    PendingGet &pg = it->second;
+    if (pg.chunk_buf.size() != total || offset > total ||
+        clen > total - offset) {
+      std::fprintf(stderr, "ptc-comm: PUT_CHUNK out of range dropped\n");
+      return;
+    }
+    std::memcpy(pg.chunk_buf.data() + offset, r.p, (size_t)clen);
+    pg.received += clen;
+    src = pg.src_rank;
+    if (pg.next_req < pg.total) {
+      uint64_t off = pg.next_req;
+      uint64_t l =
+          std::min<uint64_t>((uint64_t)ce->chunk_size, pg.total - off);
+      next = make_get_frame(ce, pg.src_handle, cookie, off, l);
+      pg.next_req = off + l;
+    }
+    if (pg.received >= pg.total) {
+      done = true;
+      done_pg = std::move(pg);
+      ce->pending_gets.erase(it);
+    }
+  }
+  if (!next.empty()) comm_post(ce, src, std::move(next));
+  if (done) {
+    uint8_t pk = done_pg.pk;
+    std::vector<uint8_t> buf = std::move(done_pg.chunk_buf);
+    complete_pull(ce, std::move(done_pg), pk, buf.data(),
+                  (uint64_t)buf.size(), (uint64_t)buf.size(), cookie);
+  }
 }
 
 static void handle_dtd_fetch_body(ptc_context *ctx, uint32_t from,
@@ -1282,7 +1642,8 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
                          const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
   ce->msgs_recv.fetch_add(1, std::memory_order_relaxed);
-  if (type != MSG_FENCE && type != MSG_TD && type != MSG_FINI)
+  if (type != MSG_FENCE && type != MSG_TD && type != MSG_FINI &&
+      type != MSG_PING && type != MSG_PONG)
     ce->app_recv.fetch_add(1, std::memory_order_relaxed);
   switch (type) {
   case MSG_ACTIVATE:
@@ -1293,6 +1654,9 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     break;
   case MSG_PUT_DATA:
     handle_put_data_body(ce, body, len);
+    break;
+  case MSG_PUT_CHUNK:
+    handle_put_chunk_body(ce, body, len);
     break;
   case MSG_ACTIVATE_BCAST:
     handle_activate_bcast_body(ce, from, body, len);
@@ -1343,6 +1707,30 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     ce->fence_cv.notify_all();
     break;
   }
+  case MSG_PING: { /* RTT probe: echo the body back verbatim */
+    std::vector<uint8_t> f = frame_begin(MSG_PONG);
+    Writer w{f};
+    w.raw(body, len);
+    frame_finish(f);
+    comm_post(ce, from, std::move(f));
+    break;
+  }
+  case MSG_PONG: {
+    Reader r{body, body + len};
+    uint64_t t0 = r.u64();
+    if (r.ok) {
+      int64_t rtt = ptc_now_ns() - (int64_t)t0;
+      if (rtt > 0) {
+        int64_t cur = ce->rtt_ns.load(std::memory_order_relaxed);
+        while ((cur == 0 || rtt < cur) &&
+               !ce->rtt_ns.compare_exchange_weak(cur, rtt)) {
+        }
+      }
+      ce->pongs.fetch_add(1, std::memory_order_relaxed);
+    }
+    ce->fence_cv.notify_all();
+    break;
+  }
   default:
     std::fprintf(stderr, "ptc-comm: unknown message type %d\n", (int)type);
   }
@@ -1374,6 +1762,24 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
     fin_ok = rank < ce->fin_seen.size() && ce->fin_seen[rank];
     if (!fin_ok)
       std::fprintf(stderr, "ptc-comm: rank %u connection lost\n", rank);
+    /* Reap chunk-serve sessions whose puller died: their pull was
+     * already retired at session start, so only the snapshot pin
+     * (chunk_refs) remains to drop.  Device sessions own their bytes
+     * and their dp pin was already released — erasing suffices. */
+    for (auto it = ce->chunk_serves.begin();
+         it != ce->chunk_serves.end();) {
+      if (it->second.from != rank) {
+        ++it;
+        continue;
+      }
+      if (it->second.buf.empty()) {
+        auto mr = ce->mem_reg.find(it->second.handle);
+        if (mr != ce->mem_reg.end()) mr->second.chunk_refs--;
+        ptc_copy *rel = maybe_free_reg_locked(ce, it->second.handle);
+        if (rel) rels.push_back(rel);
+      }
+      it = ce->chunk_serves.erase(it);
+    }
     /* Reap rendezvous registrations whose puller died: the dead rank's
      * GETs will never arrive, so drop its expectation records and free
      * registrations with no live pullers left (a crashed consumer must
@@ -1398,7 +1804,7 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
         for (int32_t k = 0; k < removed; k++)
           dp_done.push_back(
               (int64_t)(it->first & ~DP_HANDLE_FLAG));
-      if (m.served >= m.expected) {
+      if (m.served >= m.expected && m.chunk_refs == 0) {
         ce->mem_reg_bytes.fetch_sub(m.bytes.size(),
                                     std::memory_order_relaxed);
         if (m.src && m.in_by_copy) ce->mem_by_copy.erase(m.src);
@@ -2255,6 +2661,63 @@ void ptc_comm_shutdown(ptc_context *ctx) {
 /* public C API                                                        */
 /* ------------------------------------------------------------------ */
 
+/* measured host copy rate (bytes/s) — the per-byte cost leg of the
+ * adaptive eager threshold.  memcpy is the unit an eager send pays
+ * over rendezvous: the payload is copied into the ACTIVATE frame. */
+static int64_t measure_memcpy_bps() {
+  const size_t n = 4 << 20;
+  std::vector<uint8_t> a(n, 1), b(n);
+  int64_t best = INT64_MAX;
+  for (int i = 0; i < 3; i++) {
+    int64_t t0 = ptc_now_ns();
+    std::memcpy(b.data(), a.data(), n);
+    int64_t dt = ptc_now_ns() - t0;
+    if (dt > 0 && dt < best) best = dt;
+    a[0] = (uint8_t)(b[n - 1] + 1); /* keep the copy observable */
+  }
+  if (best <= 0 || best == INT64_MAX) best = 1000000; /* ~4 GB/s floor */
+  return (int64_t)((double)n * 1e9 / (double)best);
+}
+
+/* Adaptive eager threshold (PTC_MCA_comm_eager_limit=auto): measure the
+ * per-peer round trip with PING/PONG probes (any peer echoes them from
+ * its comm thread — no symmetric participation needed, so mixed knob
+ * settings cannot deadlock) and the host memcpy rate, then place the
+ * eager/rendezvous crossover where the payload's copy time is K× the
+ * round trip a rendezvous adds: below it the extra RTT dominates (stay
+ * eager), above it the RTT is < 1/K of the transfer itself and the
+ * rendezvous wins its dedup/bounded-memory properties nearly for free.
+ * K = 4 → the added RTT costs <= 25% at the threshold. */
+static void calibrate_eager_limit(CommEngine *ce) {
+  for (uint32_t r = 0; r < ce->nodes; r++) {
+    if (r == ce->myrank) continue;
+    for (int i = 0; i < 3; i++) {
+      std::vector<uint8_t> f = frame_begin(MSG_PING);
+      Writer w{f};
+      w.u64((uint64_t)ptc_now_ns());
+      frame_finish(f);
+      comm_post(ce, r, std::move(f));
+    }
+  }
+  {
+    std::unique_lock<std::mutex> g(ce->lock);
+    ce->fence_cv.wait_for(g, std::chrono::seconds(2), [&] {
+      return ce->pongs.load(std::memory_order_relaxed) >=
+                 ce->nodes - 1 ||
+             ce->stop.load(std::memory_order_acquire);
+    });
+  }
+  double rtt = (double)ce->rtt_ns.load(std::memory_order_relaxed);
+  if (rtt <= 0) rtt = 200000.0; /* no pong in time: assume 200 µs */
+  int64_t bps = measure_memcpy_bps();
+  ce->memcpy_bps.store(bps, std::memory_order_relaxed);
+  double bytes = 4.0 * (rtt * 1e-9) * (double)bps;
+  int64_t lim = (int64_t)bytes;
+  if (lim < (16 << 10)) lim = 16 << 10;
+  if (lim > (16 << 20)) lim = 16 << 20;
+  ce->eager_limit = lim;
+}
+
 extern "C" {
 
 int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
@@ -2274,19 +2737,36 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
     delete ce;
     return -1;
   }
-  if (const char *e = std::getenv("PTC_MCA_comm_eager_limit"))
-    ce->eager_limit = std::atoll(e);
+  if (const char *e = std::getenv("PTC_MCA_comm_eager_limit")) {
+    if (std::strcmp(e, "auto") == 0)
+      ce->eager_adaptive = true;
+    else
+      ce->eager_limit = std::atoll(e);
+  }
+  if (const char *e = std::getenv("PTC_MCA_comm_eager_adaptive"))
+    if (std::atoi(e) != 0 || std::strcmp(e, "true") == 0)
+      ce->eager_adaptive = true;
+  if (const char *e = std::getenv("PTC_MCA_comm_chunk_size"))
+    ce->chunk_size = std::atoll(e);
+  if (const char *e = std::getenv("PTC_MCA_comm_inflight")) {
+    ce->inflight = (int32_t)std::atoi(e);
+    if (ce->inflight < 1) ce->inflight = 1;
+  }
   if (const char *e = std::getenv("PTC_MCA_comm_fence_timeout_s"))
     ce->fence_timeout_s = std::atoll(e);
   if (ce->ops->start(ce, base_port) != 0) {
     delete ce;
     return -1;
   }
+  if (ce->eager_adaptive) calibrate_eager_limit(ce);
   if (ptc_context_verbose(ctx, PTC_DBG_COMM) >= 1)
     std::fprintf(stderr,
                  "ptc [comm]: rank %u/%u mesh connected (transport %s, "
-                 "eager_limit %lld)\n", ce->myrank, ce->nodes,
-                 ce->ops->name, (long long)ce->eager_limit);
+                 "eager_limit %lld%s, chunk %lld x%d in flight)\n",
+                 ce->myrank, ce->nodes, ce->ops->name,
+                 (long long)ce->eager_limit,
+                 ce->eager_adaptive ? " [adaptive]" : "",
+                 (long long)ce->chunk_size, ce->inflight);
   ce->running.store(true);
   ctx->comm = ce;
   return 0;
@@ -2326,7 +2806,8 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
        * not yet applied means the system is not quiescent even if no
        * frame was posted since the last snapshot */
       mydirty = (act != ce->fence_prev_activity ||
-                 !ce->pending_gets.empty() || !ce->mem_reg.empty()) ? 1 : 0;
+                 !ce->pending_gets.empty() || !ce->mem_reg.empty() ||
+                 !ce->chunk_serves.empty()) ? 1 : 0;
       ce->fence_prev_activity = act;
     }
     for (uint32_t r = 0; r < ce->nodes; r++) {
@@ -2408,7 +2889,8 @@ int32_t ptc_comm_quiesce(ptc_context_t *ctx, ptc_taskpool_t *tp) {
       gen = ce->td_next++;
       mine.sent = ce->app_sent.load(std::memory_order_relaxed);
       mine.recv = ce->app_recv.load(std::memory_order_relaxed);
-      bool busy = !ce->pending_gets.empty() || !ce->mem_reg.empty();
+      bool busy = !ce->pending_gets.empty() || !ce->mem_reg.empty() ||
+                  !ce->chunk_serves.empty();
       if (tp) {
         busy = busy || tp->nb_tasks.load() > 0;
       } else {
@@ -2534,6 +3016,24 @@ void ptc_comm_rdv_stats(ptc_context_t *ctx, int64_t *out4) {
     pend = (int64_t)ce->pending_gets.size();
   }
   out4[3] = pend;
+}
+
+/* transfer-path tuning + chunk-protocol counters (the harness reads
+ * this to report the effective knobs and the adaptive derivation):
+ * [0] eager_limit  [1] chunk_size  [2] inflight window
+ * [3] measured RTT ns (adaptive probes; 0 = not measured)
+ * [4] measured memcpy bytes/s (0 = not measured)
+ * [5] chunks sent  [6] chunks received  [7] adaptive flag */
+void ptc_comm_tuning(ptc_context_t *ctx, int64_t *out8) {
+  CommEngine *ce = ctx->comm;
+  out8[0] = ce ? ce->eager_limit : -1;
+  out8[1] = ce ? ce->chunk_size : 0;
+  out8[2] = ce ? (int64_t)ce->inflight : 0;
+  out8[3] = ce ? ce->rtt_ns.load() : 0;
+  out8[4] = ce ? ce->memcpy_bps.load() : 0;
+  out8[5] = ce ? (int64_t)ce->chunks_sent.load() : 0;
+  out8[6] = ce ? (int64_t)ce->chunks_recv.load() : 0;
+  out8[7] = (ce && ce->eager_adaptive) ? 1 : 0;
 }
 
 } /* extern "C" */

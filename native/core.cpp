@@ -1465,6 +1465,13 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
           else
             std::memcpy(dst->host_copy->ptr, copy->ptr,
                         (size_t)std::min(dst->host_copy->size, copy->size));
+          /* the tile's host bytes are now authoritative: drop any stale
+           * device mirror of dst (a Mem-rooted earlier task may have
+           * left a dirty one bound to this very buffer — flushing it
+           * later would clobber the bytes just written; the version
+           * store below cannot catch that, it copies the SOURCE
+           * version, which can collide with the mirror's) */
+          ptc_copy_host_written(ctx, dst->host_copy);
         }
         if (dst && dst->host_copy)
           dst->host_copy->version.store(copy->version.load());
@@ -2980,6 +2987,18 @@ void ptc_copy_sync_for_host(ptc_context *ctx, ptc_copy *c) {
   if (!c || c->handle == 0) return; /* never touched a device */
   ptc_copy_sync_cb cb = ctx->copy_sync_cb;
   if (cb) cb(ctx->copy_sync_user, c->handle);
+}
+
+void ptc_set_copy_invalidate_cb(ptc_context_t *ctx,
+                                ptc_copy_invalidate_cb cb, void *user) {
+  ctx->copy_invalidate_cb = cb;
+  ctx->copy_invalidate_user = user;
+}
+
+void ptc_copy_host_written(ptc_context *ctx, ptc_copy *c) {
+  if (!c || c->handle == 0) return; /* never touched a device */
+  ptc_copy_invalidate_cb cb = ctx->copy_invalidate_cb;
+  if (cb) cb(ctx->copy_invalidate_user, c->handle);
 }
 
 void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,

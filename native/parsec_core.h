@@ -389,6 +389,18 @@ void ptc_set_copy_release_cb(ptc_context_t *ctx, ptc_copy_release_cb cb,
 typedef void (*ptc_copy_sync_cb)(void *user, int64_t handle);
 void ptc_set_copy_sync_cb(ptc_context_t *ctx, ptc_copy_sync_cb cb,
                           void *user);
+/* Host-written invalidation: called right after the runtime OVERWRITES
+ * the host bytes of a copy with a nonzero handle (collection write-back
+ * memcpy — local release_deps or a remote PUT frame).  The host is now
+ * authoritative, so the device layer must DROP any device mirror of the
+ * copy: a stale dirty mirror left behind would be flushed over the newer
+ * host bytes later (observed: a Mem-rooted chain's first-hop mirror
+ * clobbering the final result at flush()).  A version check cannot
+ * replace this — the write-back stores the SOURCE copy's version, which
+ * can collide with the mirror's. */
+typedef void (*ptc_copy_invalidate_cb)(void *user, int64_t handle);
+void ptc_set_copy_invalidate_cb(ptc_context_t *ctx,
+                                ptc_copy_invalidate_cb cb, void *user);
 
 /* ---- device data plane (ICI seam) ----------------------------------
  * When registered, remote dependency payloads whose copy is device-
@@ -470,6 +482,9 @@ int32_t ptc_comm_enabled(ptc_context_t *ctx);
 void ptc_comm_stats(ptc_context_t *ctx, int64_t *out4);
 /* rendezvous: [gets_sent, gets_served, registered_bytes, pending_pulls] */
 void ptc_comm_rdv_stats(ptc_context_t *ctx, int64_t *out4);
+/* transfer tuning: [eager_limit, chunk_size, inflight, rtt_ns,
+ * memcpy_bps, chunks_sent, chunks_recv, eager_adaptive] */
+void ptc_comm_tuning(ptc_context_t *ctx, int64_t *out8);
 
 /* distributed taskpool id (SPMD creation order; assigned at add_taskpool) */
 int32_t ptc_tp_id(ptc_taskpool_t *tp);

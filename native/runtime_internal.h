@@ -595,6 +595,13 @@ struct ptc_context {
   ptc_copy_sync_cb copy_sync_cb = nullptr;
   void *copy_sync_user = nullptr;
 
+  /* device-layer hook: host bytes of a device-touched copy were just
+   * OVERWRITTEN by the runtime (collection write-back memcpy, remote
+   * PUT) — the device module drops its now-stale mirror so a later
+   * flush cannot write old device bytes over the newer host state */
+  ptc_copy_invalidate_cb copy_invalidate_cb = nullptr;
+  void *copy_invalidate_user = nullptr;
+
   /* device data plane (ICI seam; see parsec_core.h) */
   ptc_dp_register_cb dp_register = nullptr;
   ptc_dp_serve_cb dp_serve = nullptr;
@@ -777,6 +784,11 @@ void ptc_comm_shutdown(ptc_context *ctx);
  * ptc_set_copy_sync_cb) — safe from any thread, no-op without a handle.
  * (extern "C": defined inside core.cpp's public-API linkage block) */
 extern "C" void ptc_copy_sync_for_host(ptc_context *ctx, ptc_copy *c);
+
+/* stale-mirror drop after the runtime overwrote a copy's host bytes
+ * (core.cpp; see ptc_set_copy_invalidate_cb) — safe from any thread,
+ * no-op without a handle */
+extern "C" void ptc_copy_host_written(ptc_context *ctx, ptc_copy *c);
 
 /* outgoing memory write-back to a collection datum owned by `rank`.
  * ltype >= 0: selective write-back — the receiver applies only the

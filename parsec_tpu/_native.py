@@ -112,6 +112,7 @@ RANK_OF_CB_T = C.CFUNCTYPE(C.c_uint32, C.c_void_p, C.POINTER(C.c_int64), C.c_int
 DATA_OF_CB_T = C.CFUNCTYPE(C.c_void_p, C.c_void_p, C.POINTER(C.c_int64), C.c_int32)
 COPY_RELEASE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 COPY_SYNC_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
+COPY_INVALIDATE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64)
 DP_REGISTER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64, C.c_int64,
                                C.c_int64)
 DP_SERVE_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_int64, C.c_int32,
@@ -199,6 +200,8 @@ _sigs = {
                                        C.c_void_p]),
     "ptc_set_copy_sync_cb": (None, [C.c_void_p, COPY_SYNC_CB_T,
                                     C.c_void_p]),
+    "ptc_set_copy_invalidate_cb": (None, [C.c_void_p, COPY_INVALIDATE_CB_T,
+                                          C.c_void_p]),
     "ptc_set_dataplane": (None, [C.c_void_p, DP_REGISTER_CB_T, DP_SERVE_CB_T,
                                  DP_SERVE_DONE_CB_T, DP_DELIVER_CB_T,
                                  DP_BOUND_CB_T, C.c_void_p]),
@@ -245,6 +248,7 @@ _sigs = {
     "ptc_comm_enabled": (C.c_int32, [C.c_void_p]),
     "ptc_comm_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_rdv_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
+    "ptc_comm_tuning": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_tp_id": (C.c_int32, [C.c_void_p]),
     "ptc_dtile_set_owner": (None, [C.c_void_p, C.c_uint32]),
     "ptc_dtask_set_rank": (None, [C.c_void_p, C.c_int32]),

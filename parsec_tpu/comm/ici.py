@@ -17,14 +17,24 @@ never touch the host at all.  This module provides that path:
   XLA collective on ICI).  jit caching makes each (shape, shift) compile
   exactly once, the executable-cache discipline the reference applies to
   GPU kernels (cuda_find_incarnation, device_cuda_module.c:175).
+- `TransferSessionPool`: persistent per-peer cross-process transfer
+  sessions (jax.experimental.transfer connections).  A connection is an
+  endpoint handshake plus transport setup — ~100 ms class on real links
+  — so it is established ONCE per (local server, peer address) pair and
+  reused by every later pull; the pool records the setup cost per peer
+  so benchmarks can report first-transfer setup separately from the
+  steady-state per-transfer latency.
 """
+import threading
+import time
 from functools import partial
 from typing import Dict, Tuple
 
 import jax
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.jaxcompat import shard_map
 
 
 def device_transfer(arr, dst_device):
@@ -58,12 +68,11 @@ class PermuteEngine:
             pspec = P(*spec)
             perm = [(i, (i + shift) % self.n) for i in range(self.n)]
 
-            @jax.jit
-            @partial(shard_map, mesh=self.mesh, in_specs=pspec,
-                     out_specs=pspec, check_vma=False)
-            def f(xs):
+            def body(xs):
                 return lax.ppermute(xs, self.axis, perm)
 
+            f = jax.jit(shard_map(body, mesh=self.mesh, in_specs=pspec,
+                                  out_specs=pspec))
             self._progs[key] = f
         return f
 
@@ -82,3 +91,59 @@ class PermuteEngine:
         spec = [None] * x.ndim
         spec[shard_dim] = self.axis
         return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+
+
+class TransferSessionPool:
+    """Persistent per-peer transfer-plane sessions.
+
+    jax.experimental.transfer connections carry the cross-process
+    device-to-device pulls of the PK_DEVICE data plane (device/tpu.py).
+    Establishing one is endpoint negotiation + transport setup — the
+    fixed cost that made cold per-transfer numbers ~100 ms class — so a
+    connection is made ONCE per (server, peer address) pair and reused
+    for every later pull.  The pool records establishment cost per peer
+    (`setup_ms`) separately from use counts, which is exactly the split
+    the transfer-economics harness reports: first-transfer setup vs
+    steady-state per-transfer latency.
+
+    Thread-safe: pulls arrive on the comm thread while probes run on
+    the caller's thread.  A lost race establishes two connections and
+    keeps the first registered (the loser is dropped; connections are
+    cheap to leak once, unlike per-pull setup).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: Dict[str, object] = {}
+        self._setup_ms: Dict[str, float] = {}
+        self._established = 0
+        self._reused = 0
+
+    def get(self, server, addr: str):
+        """The session for `addr`, establishing it on first use."""
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is not None:
+                self._reused += 1
+                return conn
+        t0 = time.perf_counter()
+        conn = server.connect(addr)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        with self._lock:
+            prior = self._conns.get(addr)
+            if prior is not None:  # lost an establishment race
+                self._reused += 1
+                return prior
+            self._conns[addr] = conn
+            self._setup_ms[addr] = dt_ms
+            self._established += 1
+        return conn
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "peers": len(self._conns),
+                "established": self._established,
+                "reused": self._reused,
+                "setup_ms": dict(self._setup_ms),
+            }

@@ -347,6 +347,20 @@ class Context:
         return {"gets_sent": buf[0], "gets_served": buf[1],
                 "registered_bytes": buf[2], "pending_pulls": buf[3]}
 
+    def comm_tuning(self) -> dict:
+        """Effective transfer-path tuning + chunk-protocol counters:
+        the eager/rendezvous threshold actually in force (fixed, or
+        derived by the adaptive calibration from measured RTT and
+        memcpy rate), the chunk/window knobs, and how many pipelined
+        chunks moved.  The transfer-economics harness embeds this in
+        its JSON so every report names the knobs it ran under."""
+        buf = (C.c_int64 * 8)()
+        N.lib.ptc_comm_tuning(self._ptr, buf)
+        return {"eager_limit": buf[0], "chunk_size": buf[1],
+                "inflight": buf[2], "rtt_ns": buf[3],
+                "memcpy_bps": buf[4], "chunks_sent": buf[5],
+                "chunks_recv": buf[6], "eager_adaptive": bool(buf[7])}
+
     # ------------------------------------------------------------ registries
     def register_expr_cb(self, fn: Callable) -> int:
         cb = N.EXPR_CB_T(fn)

@@ -147,7 +147,10 @@ _DP_STATE = {"next_tag": 1}
 # payloads keep their reinterpret-at-stage-in semantics
 _DP_REG: Dict[int, list] = {}
 _DP_BY_KEY: Dict[tuple, int] = {}
-_DP_SERVING: Dict[int, object] = {}  # tag -> host bytes pinned during serve
+# tag -> [pinned host-byte buffers], one entry per live serve: with the
+# chunked rendezvous two pulls of one tag can be mid-serve at once, so a
+# single slot would unpin the first buffer when the second serve lands
+_DP_SERVING: Dict[int, list] = {}
 # colocated by-reference handoff: tag -> device array (same process)
 _DP_XFER: Dict[int, object] = {}
 _DP_REF_MAGIC = b"PTCDPRF1"
@@ -166,7 +169,20 @@ _DP_REF_MAGIC = b"PTCDPRF1"
 _DP_XFER_MAGIC = b"PTCDPXF1"
 _XFER_LOCK = threading.Lock()
 _XFER_STATE: Dict[str, object] = {"server": None, "failed": False,
-                                  "conns": {}, "next_uuid": 1}
+                                  "sessions": None, "next_uuid": 1}
+
+
+def _xfer_sessions():
+    """Process-wide persistent per-peer transfer sessions (the pool in
+    comm/ici.py): connections are established once per peer address and
+    reused by every pull — the endpoint-setup cost is paid once, not
+    per transfer."""
+    with _XFER_LOCK:
+        pool = _XFER_STATE["sessions"]
+        if pool is None:
+            from ..comm.ici import TransferSessionPool
+            pool = _XFER_STATE["sessions"] = TransferSessionPool()
+    return pool
 
 
 def _xfer_enabled() -> bool:
@@ -229,15 +245,9 @@ def _xfer_can_pull(client, device) -> bool:
                 uuid = _XFER_STATE["next_uuid"]
                 _XFER_STATE["next_uuid"] += 1
             srv.await_pull(uuid, [probe])
-            addr = srv.address()
-            with _XFER_LOCK:
-                conn = _XFER_STATE["conns"].get(addr)
-            if conn is None:
-                conn = srv.connect(addr)
-                with _XFER_LOCK:
-                    # cache for _xfer_pull: tokens advertising this rank's
-                    # own server (loopback jobs) reuse the probe's conn
-                    _XFER_STATE["conns"][addr] = conn
+            # session pool: tokens advertising this rank's own server
+            # (loopback jobs) reuse the probe's connection forever
+            conn = _xfer_sessions().get(srv, srv.address())
             sds = jax.ShapeDtypeStruct((4,), np.float32,
                                        sharding=SingleDeviceSharding(device))
             out = conn.pull(uuid, [sds])[0]
@@ -298,15 +308,10 @@ def _xfer_pull(raw_tok: bytes, device):
     o += 8 * ndim
     alen = int.from_bytes(raw_tok[o:o + 2], "little"); o += 2
     addr = raw_tok[o:o + alen].decode()
-    with _XFER_LOCK:
-        conn = _XFER_STATE["conns"].get(addr)
-    if conn is None:
-        srv = _xfer_server(device.client)
-        if srv is None:
-            raise RuntimeError("transfer plane unavailable on consumer")
-        conn = srv.connect(addr)
-        with _XFER_LOCK:
-            _XFER_STATE["conns"][addr] = conn
+    srv = _xfer_server(device.client)
+    if srv is None:
+        raise RuntimeError("transfer plane unavailable on consumer")
+    conn = _xfer_sessions().get(srv, addr)  # persistent per-peer session
     sds = jax.ShapeDtypeStruct(shape, dt,
                                sharding=SingleDeviceSharding(device))
     return conn.pull(uuid, [sds])[0], bool(rawf)
@@ -377,7 +382,7 @@ def _make_dp_callbacks(ctx):
                 if buf is None:
                     buf = np.ascontiguousarray(np.asarray(arr))
             with _DP_LOCK:
-                _DP_SERVING[tag] = buf  # pin until serve_done
+                _DP_SERVING.setdefault(tag, []).append(buf)  # pin: serve_done
             ptr_out[0] = buf.ctypes.data
             real_out[0] = arr.nbytes
             return buf.nbytes
@@ -388,7 +393,11 @@ def _make_dp_callbacks(ctx):
 
     def dp_serve_done(user, tag) -> None:
         with _DP_LOCK:
-            _DP_SERVING.pop(tag, None)
+            pins = _DP_SERVING.get(tag)
+            if pins:
+                pins.pop()
+                if not pins:
+                    _DP_SERVING.pop(tag, None)
             rec = _DP_REG.get(tag)
             if rec is not None:
                 rec[1] -= 1
@@ -423,7 +432,7 @@ def _make_dp_callbacks(ctx):
                 # rawness travels with the array: a relay's raw-bytes
                 # mirror stays raw (consumers reinterpret at stage-in)
                 dev._cache_put(uid, 0, darr, arr.nbytes, raw=was_raw)
-                dev.stats["dp_d2d_bytes"] += arr.nbytes
+                dev._stats_add("dp_d2d_bytes", arr.nbytes)
                 return uid
             if size > 21 and raw[:8] == _DP_XFER_MAGIC:
                 # cross-process transfer token: pull device-to-device
@@ -432,7 +441,7 @@ def _make_dp_callbacks(ctx):
                 darr, was_raw = _xfer_pull(raw, dev.device)
                 uid = _next_uid()
                 dev._cache_put(uid, 0, darr, darr.nbytes, raw=was_raw)
-                dev.stats["dp_xfer_bytes"] += darr.nbytes
+                dev._stats_add("dp_xfer_bytes", darr.nbytes)
                 return uid
             host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
             darr = dev._jax.device_put(host, dev.device)
@@ -440,7 +449,7 @@ def _make_dp_callbacks(ctx):
             # version 0 matches the fresh wire-materialized ptc_copy;
             # raw=True: stage-in reinterprets to the consumer's dtype/shape
             dev._cache_put(uid, 0, darr, size, raw=True)
-            dev.stats["dp_recv_bytes"] += size
+            dev._stats_add("dp_recv_bytes", size)
             return uid
         except Exception:
             import traceback
@@ -837,6 +846,20 @@ class TpuDevice:
                     d.sync_handle(handle)
             ctx._copy_sync_cb = N.COPY_SYNC_CB_T(_ctx_sync)
             N.lib.ptc_set_copy_sync_cb(ctx._ptr, ctx._copy_sync_cb, None)
+        # host-written invalidation: the runtime just OVERWROTE a copy's
+        # host bytes (collection write-back memcpy, remote PUT) — every
+        # device mirror of it is now stale and must drop, or a later
+        # flush writes old device bytes over the newer host state
+        # (observed: a Mem-rooted chain's hop-0 mirror clobbering the
+        # final result at flush)
+        if getattr(ctx, "_copy_invalidate_cb", None) is None:
+            def _ctx_inval(user, handle, _ctx=ctx):
+                for d in list(_ctx._devices):
+                    d._drop_mirror(handle)
+                N.lib.ptc_device_clear_data_owner(_ctx._ptr, handle, -1)
+            ctx._copy_invalidate_cb = N.COPY_INVALIDATE_CB_T(_ctx_inval)
+            N.lib.ptc_set_copy_invalidate_cb(ctx._ptr,
+                                             ctx._copy_invalidate_cb, None)
         # device data plane: remote deps with a current device mirror ride
         # PK_DEVICE rendezvous instead of the host eager/GET paths
         if not hasattr(ctx, "_colocated"):
@@ -869,6 +892,15 @@ class TpuDevice:
             self.start()
 
     # ------------------------------------------------------------ cache
+    def _stats_add(self, key: str, n: int = 1) -> None:
+        """Merge a counter delta under self._lock.  Stats are written
+        from the manager thread, the writeback lane AND the comm
+        thread's data-plane callbacks; a bare `+=` is a read-modify-
+        write that loses updates across threads — and these counters
+        feed bench evidence, so losses corrupt the harness too."""
+        with self._lock:
+            self.stats[key] += n
+
     def _copy_uid(self, cptr) -> int:
         with self._lock:  # races: manager vs stage_collection/gather
             h = N.lib.ptc_copy_handle(cptr)
@@ -903,6 +935,17 @@ class TpuDevice:
                     self._cache_used -= ent.stack.nbytes
         else:
             self._cache_used -= ent.nbytes
+
+    def _drop_mirror(self, uid: int) -> None:
+        """Drop a mirror whose HOST bytes were just overwritten by the
+        runtime (the host is authoritative now; dirty or not, the device
+        bytes are stale).  Owner clearing is done once by the context-
+        level fan-out, not per device."""
+        with self._lock:
+            ent = self._cache.pop(uid, None)
+            if ent is not None:
+                self._uncharge(ent)
+                self.stats["invalidations"] += 1
 
     def _on_copy_released(self, user, handle):
         with self._lock:
@@ -1014,8 +1057,8 @@ class TpuDevice:
                 return
         res = np.asarray(_conc(ent))  # blocks until the XLA result is ready
         _host_write(ent, res)
-        self.stats["d2h_bytes"] += res.nbytes
-        with self._lock:
+        with self._lock:  # d2h_bytes merge: callers span three threads
+            self.stats["d2h_bytes"] += res.nbytes
             ent.dirty = False
 
     def info(self) -> dict:
@@ -1077,8 +1120,8 @@ class TpuDevice:
                 grouped_stack(jnp, [e.arr for e in ents]))
             for e, res in zip(ents, stacked):
                 _host_write(e, res)
-                self.stats["d2h_bytes"] += res.nbytes
                 with self._lock:
+                    self.stats["d2h_bytes"] += res.nbytes
                     e.dirty = False
 
     # ------------------------------------------------------------ attach
@@ -1175,7 +1218,7 @@ class TpuDevice:
         stacked = self._jax.device_put(np.stack(tiles), self.device)
         for i, (uid, ver) in enumerate(uids):
             self._cache_put(uid, ver, stacked[i], tiles[i].nbytes)
-        self.stats["h2d_bytes"] += stacked.nbytes
+        self._stats_add("h2d_bytes", stacked.nbytes)  # user thread
 
     def warm(self, kernel: Callable, example_args) -> None:
         """Pre-compile a kernel for given example shapes (optional)."""
@@ -1221,7 +1264,7 @@ class TpuDevice:
                 for t in tasks:
                     self.ctx.task_fail(t)
                 continue
-            self.stats["wb_tasks"] += len(tasks)
+            self._stats_add("wb_tasks", len(tasks))
             for t in tasks:
                 self.ctx.task_complete(t)
 
@@ -1238,17 +1281,24 @@ class TpuDevice:
             self.sync_handle(uid)
             return
         _host_write(ent, res)
-        self.stats["d2h_bytes"] += res.nbytes
-        with self._lock:
+        with self._lock:  # writeback lane vs manager: merge under lock
+            self.stats["d2h_bytes"] += res.nbytes
             ent.dirty = False
 
     def _wb_barrier(self, timeout: float = 300.0):
-        """Coherence point: block until every queued writeback retired."""
+        """Coherence point: block until every queued writeback retired.
+        A timeout is a hard error: proceeding would snapshot/clear dirty
+        mirrors the writeback lane may still be writing (silent
+        corruption of the host tiles a flush claims to make coherent)."""
         if self._wb_thread is None or not self._wb_thread.is_alive():
             return
         ev = threading.Event()
         self._wb_q.put(("barrier", ev))
-        ev.wait(timeout=timeout)
+        if not ev.wait(timeout=timeout):
+            raise RuntimeError(
+                f"ptc [device]: writeback barrier timed out after "
+                f"{timeout:.0f}s — the writeback lane is wedged or still "
+                "draining; dirty mirrors are NOT coherent")
 
     def stop(self):
         """Flush dirty mirrors and stop the manager (idempotent)."""
@@ -1397,7 +1447,7 @@ class TpuDevice:
         # into freed-chunk heap metadata (tests/comm potrf device runs).
         darr = self._jax.device_put(np.array(host, copy=True), self.device)
         self._cache_put(uid, ver, darr, host.nbytes)
-        self.stats["h2d_bytes"] += host.nbytes
+        self._stats_add("h2d_bytes", host.nbytes)  # vs stage_collection
         return darr
 
     def _dispatch(self, task):

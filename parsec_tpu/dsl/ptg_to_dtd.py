@@ -252,11 +252,22 @@ def run_ptg_as_dtd(ctx: Context, tp: Taskpool,
                 return d
         return None
 
+    _IN_PROGRESS = ("...",)  # cycle-guard sentinel (never a real root)
+
     def root_of(cname: str, params: tuple, fname: str):
         key = (cname, params, fname)
         if key in roots:
-            return roots[key]
-        roots[key] = ("...",)  # cycle guard
+            r = roots[key]
+            if r is _IN_PROGRESS:
+                # re-entered while resolving this very instance: the In
+                # chain loops.  Raise here — letting the sentinel escape
+                # surfaces later as an opaque tuple-unpack ValueError at
+                # the caller, far from the cycle.
+                raise ValueError(
+                    f"ptg_to_dtd: cyclic In chain at {cname}/{fname} "
+                    f"(params {params})")
+            return r
+        roots[key] = _IN_PROGRESS
         tc = classes[cname]
         loc = dict(zip([n for n, _, _ in tc.locals], params))
         # re-derive non-param locals (params covers ALL locals here

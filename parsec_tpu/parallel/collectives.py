@@ -10,7 +10,7 @@ array and get one back — XLA lowers the inner op onto ICI.
 from functools import partial
 
 from jax import lax
-from jax import shard_map
+from ..utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -28,7 +28,7 @@ def ring_permute(x, mesh: Mesh, axis: str, shift: int = 1, shard_dim: int = 0):
     spec[shard_dim] = axis
     pspec = P(*spec)
 
-    @partial(shard_map, mesh=mesh, in_specs=pspec, out_specs=pspec, check_vma=False)
+    @partial(shard_map, mesh=mesh, in_specs=pspec, out_specs=pspec)
     def _f(xs):
         return lax.ppermute(xs, axis, _ring_perm(n, shift))
 
@@ -43,7 +43,7 @@ def seq_all_gather(x, mesh: Mesh, axis: str, shard_dim: int = 0):
     in_spec = P(*spec)
     out_spec = P(*([None] * x.ndim))
 
-    @partial(shard_map, mesh=mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False)
+    @partial(shard_map, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
     def _f(xs):
         return lax.all_gather(xs, axis, axis=shard_dim, tiled=True)
 
@@ -58,7 +58,7 @@ def seq_reduce_scatter(x, mesh: Mesh, axis: str, shard_dim: int = 0):
     out_sp = list(spec)
     out_sp[shard_dim] = axis
 
-    @partial(shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*out_sp), check_vma=False)
+    @partial(shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*out_sp))
     def _f(xs):
         return lax.psum_scatter(xs, axis, scatter_dimension=shard_dim,
                                 tiled=True)
@@ -76,7 +76,7 @@ def seq_all_to_all(x, mesh: Mesh, axis: str, split_dim: int, concat_dim: int):
     out_sp = [None] * x.ndim
     out_sp[split_dim] = axis
 
-    @partial(shard_map, mesh=mesh, in_specs=P(*in_sp), out_specs=P(*out_sp), check_vma=False)
+    @partial(shard_map, mesh=mesh, in_specs=P(*in_sp), out_specs=P(*out_sp))
     def _f(xs):
         return lax.all_to_all(xs, axis, split_axis=split_dim,
                               concat_axis=concat_dim, tiled=True)

@@ -19,7 +19,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -89,7 +91,7 @@ def moe_ffn(x, w_gate, w_up, w_down, mesh: Mesh, axis: str = "ep",
 
     @partial(shard_map, mesh=mesh,
              in_specs=(xs, P(None, None), ws, ws),
-             out_specs=xs, check_vma=False)
+             out_specs=xs)
     def _moe(x_loc, wg, wu_loc, wd_loc):
         bl, sl, dm = x_loc.shape
         tok = x_loc.reshape(bl * sl, dm)

@@ -15,7 +15,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -38,7 +40,7 @@ def gpipe(stage_fn: Callable, stage_params, x_mb, mesh: Mesh,
     rest = P(*([None] * x_mb.ndim))
 
     @partial(shard_map, mesh=mesh, in_specs=(p_spec, rest),
-             out_specs=rest, check_vma=False)
+             out_specs=rest)
     def _pipe(params_loc, xs):
         # leading stage dim is 1 on each device — squeeze it away
         params_i = jax.tree.map(lambda a: a[0], params_loc)

@@ -15,7 +15,7 @@ from functools import partial
 from typing import Optional
 
 from jax import lax
-from jax import shard_map
+from ..utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .ring_attention import blockwise_attention_reference
@@ -40,7 +40,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     pspec = P(None, axis, None, None)
 
     @partial(shard_map, mesh=mesh, in_specs=(pspec, pspec, pspec),
-             out_specs=pspec, check_vma=False)
+             out_specs=pspec)
     def _uly(q_loc, k_loc, v_loc):
         # [B, L/n, H, D] -> [B, L, H/n, D]: gather sequence, split heads.
         def fwd(x):

@@ -196,7 +196,30 @@ register("comm.engine", "tcp", str,
 register("comm.eager_limit", 64 * 1024, int,
          "payloads <= this ride inline in ACTIVATE; larger ones are pulled "
          "via GET rendezvous (reference: runtime_comm_short_limit, "
-         "remote_dep_mpi.c:241-253); negative disables rendezvous")
+         "remote_dep_mpi.c:241-253); negative disables rendezvous.  Set "
+         "the env form (PTC_MCA_comm_eager_limit) to the string 'auto' "
+         "to derive the threshold at comm init from the measured "
+         "per-peer round trip and host memcpy rate (see "
+         "comm.eager_adaptive)")
+register("comm.eager_adaptive", False, bool,
+         "derive the eager/rendezvous threshold at comm init instead of "
+         "using the fixed comm.eager_limit: PING/PONG probes measure the "
+         "per-peer RTT, a memcpy calibration measures the per-byte copy "
+         "cost, and the threshold lands where the payload's copy time is "
+         "4x the round trip a rendezvous adds (<=25% RTT overhead at the "
+         "crossover; clamped to [16 KiB, 16 MiB]).  The derived value is "
+         "reported by Context.comm_tuning()")
+register("comm.chunk_size", 1 << 20, int,
+         "rendezvous payloads above this stream as pipelined ranged "
+         "chunks (GET[offset,len] -> PUT_CHUNK) instead of one frame: "
+         "the wire, the producer's serve and the consumer's reassembly "
+         "overlap, and fences/activations interleave between chunks "
+         "instead of stalling behind one giant frame.  <= 0 disables "
+         "chunking (whole-payload pulls)")
+register("comm.inflight", 4, int,
+         "chunked-pull window: how many ranged GETs a consumer keeps "
+         "outstanding per pull.  Bounds in-flight memory to "
+         "inflight * chunk_size per pull while keeping the pipe full")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
 register("device.dp_transfer", False, bool,

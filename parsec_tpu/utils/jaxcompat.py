@@ -1,0 +1,34 @@
+"""jax version compatibility shims.
+
+The shard_map API moved twice across the jax versions this project must
+run under (0.4.x on the current container, 0.5+/0.6+ on pod images):
+
+  - location: `jax.experimental.shard_map.shard_map` -> `jax.shard_map`
+  - kwarg:    `check_rep=` -> `check_vma=`
+
+Every sharded module (comm/ici, parallel/*) routes through this one
+shim so a jax upgrade is a one-file change, and so an import of any of
+them cannot fail on the container's jax (the seed's broken
+`from jax import shard_map` took down 8 test modules at collection).
+"""
+from functools import partial
+
+try:  # jax >= 0.5 exports it at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs):
+    """shard_map(f, mesh=..., in_specs=..., out_specs=...) with the
+    replication check disabled under whichever kwarg this jax spells it.
+    Usable directly or as a decorator factory (f=None)."""
+    if f is None:
+        return partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs)
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)

@@ -1437,3 +1437,219 @@ def getrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
         st = ctx.comm_stats()
         assert st["msgs_sent"] > 0, st
         ctx.comm_fini()
+
+
+def chunked_chain(rank: int, nodes: int, port: int, nb: int = 8,
+                  elems: int = 8192, chunk: int = 4096, inflight: int = 3):
+    """RW chain whose datum is a multi-KiB int64 tile forced through the
+    CHUNKED rendezvous (eager off, chunk_size << payload): every hop's
+    payload streams as a pipelined window of ranged GET/PUT_CHUNK
+    frames and is reassembled before delivery.  Every task verifies the
+    FULL payload (all elements == k), so a mis-assembled, reordered or
+    short chunk is a hard failure, not a perf blip."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
+    os.environ["PTC_MCA_comm_inflight"] = str(inflight)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        size = elems * 8
+        arr = np.zeros((nodes, elems), dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=size,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", size)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                arena="t")
+
+        def body(view):
+            a = view.data("A", dtype=np.int64, shape=(elems,))
+            kk = view["k"]
+            assert (a == kk).all(), (kk, a[:4], a[-4:])
+            a += 1
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        if rank == 0:
+            assert (arr[0] == nb + 1).all(), arr[0][:4]
+        tune = ctx.comm_tuning()
+        # every rank consumed at least one cross-rank hop above the
+        # chunk size, so the pipelined protocol must have engaged
+        assert tune["chunks_recv"] > 0, tune
+        st = ctx.comm_rdv_stats()
+        assert st["pending_pulls"] == 0 and st["registered_bytes"] == 0, st
+        ctx.comm_fini()
+
+
+def adaptive_eager_chain(rank: int, nodes: int, port: int, nb: int = 8):
+    """eager_limit=auto: the comm engine derives the eager/rendezvous
+    threshold at init from PING/PONG RTT probes + a memcpy calibration.
+    The job must run normally and report a clamped, measured-based
+    threshold via comm_tuning()."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "auto"
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        arr = np.zeros(nodes, dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=8, nodes=nodes,
+                                       myrank=rank)
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                arena="t")
+
+        def body(view):
+            view.data("A", dtype=np.int64)[0] += 1
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        tune = ctx.comm_tuning()
+        assert tune["eager_adaptive"], tune
+        assert 16 * 1024 <= tune["eager_limit"] <= 16 * 1024 * 1024, tune
+        assert tune["rtt_ns"] > 0, tune        # at least one pong landed
+        assert tune["memcpy_bps"] > 0, tune
+        ctx.comm_fini()
+
+
+def chunked_bcast(rank: int, nodes: int, port: int, elems: int = 4096,
+                  topo: str = "star"):
+    """Root broadcasts one multi-KiB tile to every rank through the
+    chunked rendezvous: with star topology the consumers pull the SAME
+    shared registration concurrently (mem_by_copy dedup + chunk_refs
+    pinning), with chain/binomial each relay re-registers and re-serves
+    what it pulled.  Every consumer verifies the full payload."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = "2048"
+    os.environ["PTC_MCA_comm_inflight"] = "3"
+    pt, ctx = _mk_ctx(rank, nodes, port, topo=topo)
+    with ctx:
+        size = elems * 8
+        arr = np.zeros((nodes, elems), dtype=np.int64)
+        ctx.register_linear_collection("V", arr, elem_size=size,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", size)
+        tp = pt.Taskpool(ctx, globals={"NT": nodes - 1})
+        k = pt.L("k")
+        root = tp.task_class("Root")
+        root.affinity("V", 0)
+        recv = tp.task_class("Recv")
+        recv.param("k", 0, pt.G("NT"))
+        recv.affinity("V", k)
+
+        def root_body(view):
+            x = view.data("X", dtype=np.int64, shape=(elems,))
+            x[:] = np.arange(elems, dtype=np.int64) + 7
+
+        root.flow("X", "W",
+                  pt.Out(pt.Ref("Recv", pt.Range(0, pt.G("NT")),
+                                flow="X")),
+                  arena="t")
+        root.body(root_body)
+
+        def recv_body(view):
+            x = view.data("X", dtype=np.int64, shape=(elems,))
+            expect = np.arange(elems, dtype=np.int64) + 7
+            assert (x == expect).all(), (view["k"], x[:4], x[-4:])
+            y = view.data("Y", dtype=np.int64, shape=(elems,))
+            y[:] = x + view["k"]
+
+        recv.flow("X", "R", pt.In(pt.Ref("Root", flow="X")), arena="t")
+        recv.flow("Y", "W", pt.Out(pt.Mem("V", k)), arena="t")
+        recv.body(recv_body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        expect = np.arange(elems, dtype=np.int64) + 7
+        for i in range(nodes):
+            if i % nodes == rank:
+                assert (arr[i] == expect + i).all(), (i, arr[i][:4])
+        if rank != 0:
+            tune = ctx.comm_tuning()
+            assert tune["chunks_recv"] > 0, tune
+        st = ctx.comm_rdv_stats()
+        assert st["pending_pulls"] == 0 and st["registered_bytes"] == 0, st
+        ctx.comm_fini()
+
+
+def device_chain_flush(rank: int, nodes: int, port: int, nb: int = 8,
+                       elems: int = 16384, chunk: int = 4096):
+    """Device-chore RW chain over the PK_DEVICE data plane ending in a
+    collection write-back, then flush().  Regression for the
+    stale-mirror clobber: hop 0's flow copy IS the collection tile's
+    host copy; its dirty device mirror was never synced (PK_DEVICE
+    sends do not touch host bytes), so before the host-written
+    invalidation hook, dev.flush() wrote hop 0's value (1.0) over the
+    final result.  chunk=0 runs the whole-payload pull, chunk>0 the
+    pipelined chunked pull."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
+    pt, ctx = _mk_ctx(rank, nodes, port, nb_workers=1)
+    from parsec_tpu.device import TpuDevice
+
+    with ctx:
+        size = elems * 4
+        arr = np.zeros((nodes, elems), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=size,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", size)
+        dev = TpuDevice(ctx)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Hop")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Hop", k - 1, flow="A")),
+                pt.Out(pt.Ref("Hop", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                arena="t")
+
+        def kern(x):
+            return x + 1.0
+
+        dev.attach(tc, tp, kernel=kern, reads=["A"], writes=["A"],
+                   shapes={"A": (elems,)}, dtype=np.float32)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        dev.flush()  # must NOT clobber the written-back tile
+        if rank == (nb % nodes):
+            pass  # final task ran here; tile owner asserts below
+        if rank == 0:
+            assert np.allclose(arr[0], float(nb + 1)), arr[0][:4]
+            # the final write-back must have dropped the stale mirror
+            assert dev.stats["invalidations"] >= 1, dev.stats
+        if chunk:
+            tune = ctx.comm_tuning()
+            assert tune["chunks_recv"] > 0, tune
+        dev.stop()
+        ctx.comm_fini()

@@ -1,0 +1,75 @@
+"""Chunked pipelined rendezvous (wire v3) + adaptive eager threshold.
+
+The chunk protocol splits rendezvous payloads above comm.chunk_size
+into a pipelined window of ranged GET/PUT_CHUNK exchanges (reference
+contrast: the v2 whole-payload pull, itself the analog of
+remote_dep_mpi.c's GET rendezvous).  Correctness bar: payload bytes
+must reassemble exactly, registrations must drain (bounded-memory
+invariant), and fences must still prove quiescence mid-chunking.
+"""
+from . import _workers
+from .test_multirank import _run_spmd
+
+
+def test_chunked_rendezvous_chain_2ranks():
+    """64 KiB payloads in 4 KiB chunks, window 3: every hop crosses
+    ranks and every task verifies the full payload."""
+    _run_spmd(_workers.chunked_chain, 2)
+
+
+def test_chunked_tiny_chunks_deep_window():
+    """Pathological shape: 8 KiB payloads in 64-byte chunks with an
+    8-deep window — two orders of magnitude more chunk round trips per
+    pull than the default, all reassembly/bookkeeping edges hot."""
+    _run_spmd(_workers.chunked_chain, 2, nb=4, elems=1024, chunk=64,
+              inflight=8)
+
+
+def test_chunked_three_ranks():
+    """Three ranks: concurrent chunk sessions from different pullers
+    against one producer (distinct cookies, shared engine state)."""
+    _run_spmd(_workers.chunked_chain, 3, nb=6)
+
+
+def test_chunked_single_chunk_window():
+    """inflight=1 degenerates to stop-and-wait: still correct, just
+    unpipelined (the window knob's lower bound)."""
+    _run_spmd(_workers.chunked_chain, 2, nb=4, chunk=1024, inflight=1)
+
+
+def test_adaptive_eager_threshold():
+    """PTC_MCA_comm_eager_limit=auto derives the threshold from measured
+    RTT + memcpy rate and reports it via comm_tuning()."""
+    _run_spmd(_workers.adaptive_eager_chain, 2)
+
+
+def test_chunked_bcast_star_shared_registration():
+    """Star broadcast: 2 consumers chunk-pull ONE shared registration
+    concurrently — the chunk_refs pin must keep the snapshot alive until
+    the last chunk of the last puller, then free it (rdv stats drain)."""
+    _run_spmd(_workers.chunked_bcast, 3, timeout=180.0)
+
+
+def test_chunked_bcast_chain_relay():
+    """Chain broadcast: each relay chunk-pulls from its parent, then
+    re-registers and chunk-serves its children (re-rooted data
+    movement through the chunk protocol)."""
+    _run_spmd(_workers.chunked_bcast, 3, topo="chain", timeout=180.0)
+
+
+def test_chunked_bcast_binomial():
+    # 4 spawned processes: generous timeout for contended 1-core hosts
+    _run_spmd(_workers.chunked_bcast, 4, topo="binomial", timeout=180.0)
+
+
+def test_device_chain_flush_not_clobbered_chunked():
+    """PK_DEVICE chunked chain + final Mem write-back + flush(): the
+    host-written invalidation must drop hop 0's stale dirty mirror or
+    flush() writes 1.0 over the result (latent seed bug found by the
+    PR1 verify probe)."""
+    _run_spmd(_workers.device_chain_flush, 2, timeout=180.0)
+
+
+def test_device_chain_flush_not_clobbered_whole_pull():
+    """Same regression through the whole-payload (unchunked) pull."""
+    _run_spmd(_workers.device_chain_flush, 2, chunk=0, timeout=180.0)

@@ -116,6 +116,20 @@ def test_device_dataplane_2ranks():
     _run_spmd(_workers.device_dataplane, 2, timeout=180.0)
 
 
+def _has_jax_transfer() -> bool:
+    try:
+        import jax.experimental.transfer  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(not _has_jax_transfer(),
+                    reason="this jax build ships no "
+                           "jax.experimental.transfer (the cross-process "
+                           "transfer plane probes and falls back to host "
+                           "bytes, so the zero-host-copy assertion cannot "
+                           "hold here)")
 def test_device_dataplane_transfer_2processes():
     """Separate-PROCESS zero-host-copy device payload (VERDICT r3 #5):
     the producer serves a jax.experimental.transfer pull token; the

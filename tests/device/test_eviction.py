@@ -1,0 +1,110 @@
+"""LRU eviction under HBM pressure through a LIVE DAG (VERDICT weak #5:
+the reference's subtlest GPU-cache bugs live in eviction-under-pressure,
+parsec_gpu_data_reserve_device_space, device_cuda_module.c:864).
+
+tests/device/test_batch.py::test_stack_accounting exercises the
+ACCOUNTING with hand-inserted entries; here the pressure comes from real
+task execution: a chain whose every task stages a distinct large input
+tile into a cache too small to hold them all, so the manager's
+_cache_put must evict clean LRU entries mid-run while dirty outputs stay
+pinned — and the numerical result must still be exact."""
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.device import TpuDevice
+
+TILES = 12
+ELEMS = 32 * 1024            # 128 KiB per input tile (f32)
+ACC = 16                     # small accumulator flow
+
+
+def _acc_kernel(x, t):
+    return x + t.sum()
+
+
+def test_lru_eviction_under_pressure_live_dag():
+    tile_bytes = ELEMS * 4
+    rng = np.random.default_rng(7)
+    tiles = rng.integers(0, 100, size=(TILES, ELEMS)).astype(np.float32)
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_linear_collection("T", tiles, elem_size=tile_bytes,
+                                       nodes=1, myrank=0)
+        acc = np.zeros(ACC, dtype=np.float32)
+        ctx.register_linear_collection("S", acc.reshape(1, ACC),
+                                       elem_size=ACC * 4, nodes=1,
+                                       myrank=0)
+        ctx.register_arena("ta", ACC * 4)
+        ctx.register_arena("tt", tile_bytes)
+        # capacity for ~3 input tiles: 12 staged inputs MUST evict
+        dev = TpuDevice(ctx, cache_bytes=3 * tile_bytes + ACC * 4)
+        tp = pt.Taskpool(ctx, globals={"NT": TILES - 1})
+        k = pt.L("k")
+        tc = tp.task_class("Acc")
+        tc.param("k", 0, pt.G("NT"))
+        tc.flow("X", "RW",
+                pt.In(pt.Mem("S", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Acc", k - 1, flow="X")),
+                pt.Out(pt.Ref("Acc", k + 1, flow="X"),
+                       guard=(k < pt.G("NT"))),
+                pt.Out(pt.Mem("S", 0), guard=(k == pt.G("NT"))),
+                arena="ta")
+        tc.flow("T", "R", pt.In(pt.Mem("T", k)), arena="tt")
+        dev.attach(tc, tp, kernel=_acc_kernel, reads=["X", "T"],
+                   writes=["X"], shapes={"X": (ACC,), "T": (ELEMS,)},
+                   dtype=np.float32)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        stats = dict(dev.stats)
+        # pressure actually evicted mid-run ...
+        assert stats["evictions"] > 0, stats
+        # ... and the accounting never exceeded capacity by more than
+        # the unpinnable set (dirty outputs + the entry being inserted)
+        assert dev._cache_used <= dev._cache_bytes + 2 * tile_bytes, (
+            dev._cache_used, dev._cache_bytes)
+        dev.stop()
+        # correctness under eviction: every tile's sum accumulated once
+        expect = np.zeros(ACC, dtype=np.float64)
+        for i in range(TILES):
+            expect += tiles[i].astype(np.float64).sum()
+        got = acc.astype(np.float64)
+        np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_no_eviction_when_cache_fits():
+    """Control: same DAG with ample capacity must not evict (an LRU that
+    evicts without pressure would silently thrash h2d)."""
+    tile_bytes = ELEMS * 4
+    tiles = np.ones((TILES, ELEMS), dtype=np.float32)
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_linear_collection("T", tiles, elem_size=tile_bytes,
+                                       nodes=1, myrank=0)
+        acc = np.zeros(ACC, dtype=np.float32)
+        ctx.register_linear_collection("S", acc.reshape(1, ACC),
+                                       elem_size=ACC * 4, nodes=1,
+                                       myrank=0)
+        ctx.register_arena("ta", ACC * 4)
+        ctx.register_arena("tt", tile_bytes)
+        dev = TpuDevice(ctx, cache_bytes=4 << 30)
+        tp = pt.Taskpool(ctx, globals={"NT": TILES - 1})
+        k = pt.L("k")
+        tc = tp.task_class("Acc")
+        tc.param("k", 0, pt.G("NT"))
+        tc.flow("X", "RW",
+                pt.In(pt.Mem("S", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Acc", k - 1, flow="X")),
+                pt.Out(pt.Ref("Acc", k + 1, flow="X"),
+                       guard=(k < pt.G("NT"))),
+                pt.Out(pt.Mem("S", 0), guard=(k == pt.G("NT"))),
+                arena="ta")
+        tc.flow("T", "R", pt.In(pt.Mem("T", k)), arena="tt")
+        dev.attach(tc, tp, kernel=_acc_kernel, reads=["X", "T"],
+                   writes=["X"], shapes={"X": (ACC,), "T": (ELEMS,)},
+                   dtype=np.float32)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        assert dev.stats["evictions"] == 0, dev.stats
+        dev.stop()
+        np.testing.assert_allclose(acc, np.full(ACC, TILES * ELEMS,
+                                                dtype=np.float32))

@@ -185,3 +185,31 @@ def test_crosstile_memout_writeback():
         tp, arr = _crosstile_spec(ctx, n)
         run_ptg_as_dtd(ctx, tp, {"A": None})
         np.testing.assert_array_equal(arr, ptg)
+
+
+def test_cyclic_in_chain_raises_loudly():
+    """An In chain that loops through a PHANTOM instance (outside the
+    class's declared range, so Kahn's instance graph never sees it: the
+    enumerated T(0) pulls from T(-1), whose own active In resolves to
+    T(-1) again) must raise a named cycle error — not leak the internal
+    cycle-guard sentinel as an opaque tuple-unpack ValueError at the
+    caller."""
+    import pytest
+
+    with pt.Context(nb_workers=1) as ctx:
+        arr = np.zeros(1, dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=8, nodes=1,
+                                       myrank=0)
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": 0})
+        k = pt.L("k")
+        T = tp.task_class("T")
+        T.param("k", 0, pt.G("NB"))
+        T.flow("X", "RW",
+               pt.In(pt.Ref("T", k - 1, flow="X"), guard=(k == 0)),
+               pt.In(pt.Ref("T", k * 0 - 1, flow="X"), guard=(k < 0)),
+               pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+               arena="t")
+        T.body(lambda view: None)
+        with pytest.raises(ValueError, match="cyclic In chain"):
+            run_ptg_as_dtd(ctx, tp, {"A": None})

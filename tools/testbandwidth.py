@@ -1,19 +1,38 @@
 #!/usr/bin/env python
-"""Comm-engine bandwidth/latency microbench (reference roles:
-tests/apps/pingpong/bandwidth.jdf for the transport and
-tools/gpu/testbandwidth for the device staging path).
+"""Transfer-economics harness (reference roles: tests/apps/pingpong/
+bandwidth.jdf for the transport, tools/gpu/testbandwidth for the device
+staging path) — the project's tunnel-independent way to validate
+dispatch/transfer economics on loopback.
 
-Two SPMD processes over loopback TCP run a rank-hopping RW chain whose
-datum is a tile of the given size: each hop is one full payload transfer
-(eager inline, or GET rendezvous above the eager limit).  Reported per
-size: hop latency (wall / hops) and payload bandwidth.  With --device,
-the same chain runs with device chores so every hop additionally pays
-device stage-out/stage-in (the h2d/d2h testbandwidth role; uses the real
-chip when the tunnel is up, else the CPU jax backend).
+Two SPMD processes over loopback TCP run rank-hopping RW chains whose
+datum is a tile of the given size; every hop is one full cross-rank
+payload transfer.  ONE persistent process pair serves an entire path
+sweep — all sizes and reps share the TCP mesh, the device, the jit
+cache and (for PK_DEVICE) the transfer sessions — so the numbers
+measure steady-state per-transfer cost, with the first (warmup) rep's
+wall reported separately as `setup_ms` (session establishment, first
+compile, first staging).  That split is the point: the old
+per-process-pair, per-rep-recompile measurement charged ~100 ms of
+setup to every transfer (BASELINE.md row 1d, 118 ms / 4 MiB).
 
-  python tools/testbandwidth.py                 # host path, 4K..16M
-  python tools/testbandwidth.py --sizes 1048576 --hops 64
-  python tools/testbandwidth.py --device
+Paths swept (each in its own process pair, selected by env knobs):
+  eager   — payloads ride inline in ACTIVATE frames (eager_limit huge)
+  rdv     — every payload pulled via GET rendezvous (eager_limit 0);
+            payloads above comm.chunk_size stream as pipelined chunks
+  device  — TpuDevice attached (jax CPU backend on loopback, the real
+            chip when PTC_BENCH_TPU=1): payloads ride the PK_DEVICE
+            device data plane (d2h at serve / h2d at deliver)
+
+Per path the harness fits  t(size) = fixed_overhead + size * per_byte
+by least squares over the per-size minima and reports both legs — the
+same two quantities the adaptive eager threshold is derived from, so
+the model is checkable against the engine's own calibration (also
+reported, from a dedicated eager_limit=auto run).
+
+  python tools/testbandwidth.py                        # full sweep
+  python tools/testbandwidth.py --paths device --sizes 4194304
+  python tools/testbandwidth.py --quick --json /tmp/comm.json
+  make bench-comm                                      # BENCH-style file
 """
 import json
 import multiprocessing as mp
@@ -25,82 +44,128 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+_PATH_ENV = {
+    # eager: everything inline (rendezvous never engages)
+    "eager": {"PTC_MCA_comm_eager_limit": str(1 << 30)},
+    # rdv: everything pulled (chunked above comm.chunk_size)
+    "rdv": {"PTC_MCA_comm_eager_limit": "0"},
+    # device: rendezvous forced so device-resident payloads advertise
+    # PK_DEVICE transfer tags
+    "device": {"PTC_MCA_comm_eager_limit": "0"},
+}
+
 
 def _bump(x):
-    # module-level: the device executable cache keys on kernel identity,
-    # so the warmup build really pre-compiles for the timed build
+    # module-level ON PURPOSE: the process-wide jit cache keys on kernel
+    # identity, so every taskpool of a sweep reuses ONE compiled
+    # executable per shape.  A per-rep lambda would recompile each rep —
+    # exactly the setup cost the old 118 ms/4 MiB number was paying.
     return x + 1.0
 
 
-def _worker(rank, port, size, hops, device, q):
+def _worker(rank, port, sizes, hops, reps, path, env, q):
     try:
+        for k, v in env.items():
+            os.environ[k] = v
         import jax
-        if os.environ.get("JAX_PLATFORMS") == "cpu" or not device:
+        if not os.environ.get("PTC_BENCH_TPU"):
             jax.config.update("jax_platforms", "cpu")
         import parsec_tpu as pt
 
         ctx = pt.Context(nb_workers=1)
         ctx.set_rank(rank, 2)
         ctx.comm_init(port)
-        elems = size // 4
-        arr = np.zeros((2, elems), dtype=np.float32)
-        ctx.register_linear_collection("A", arr, elem_size=size,
-                                       nodes=2, myrank=rank)
-        ctx.register_arena("t", size)
         dev = None
-        if device:
+        if path == "device":
             from parsec_tpu.device import TpuDevice
             dev = TpuDevice(ctx)
         k = pt.L("k")
+        out = []
+        for si, size in enumerate(sizes):
+            elems = max(1, size // 4)
+            arr = np.zeros((2, elems), dtype=np.float32)
+            ctx.register_linear_collection(f"A{si}", arr, elem_size=size,
+                                           nodes=2, myrank=rank)
+            ctx.register_arena(f"t{si}", size)
 
-        def build():
-            tp = pt.Taskpool(ctx, globals={"NB": hops})
-            tc = tp.task_class("Hop")
-            tc.param("k", 0, pt.G("NB"))
-            tc.affinity("A", k % 2)
-            tc.flow("A", "RW",
-                    pt.In(pt.Mem("A", 0), guard=(k == 0)),
-                    pt.In(pt.Ref("Hop", k - 1, flow="A")),
-                    pt.Out(pt.Ref("Hop", k + 1, flow="A"),
-                           guard=(k < pt.G("NB"))),
-                    arena="t")
-            if dev is not None:
-                dev.attach(tc, tp, kernel=_bump, reads=["A"],
-                           writes=["A"], shapes={"A": (elems,)},
-                           dtype=np.float32)
-            tc.body_noop()
-            return tp
+            def build():
+                tp = pt.Taskpool(ctx, globals={"NB": hops})
+                tc = tp.task_class("Hop")
+                tc.param("k", 0, pt.G("NB"))
+                tc.affinity(f"A{si}", k % 2)
+                tc.flow("A", "RW",
+                        pt.In(pt.Mem(f"A{si}", 0), guard=(k == 0)),
+                        pt.In(pt.Ref("Hop", k - 1, flow="A")),
+                        pt.Out(pt.Ref("Hop", k + 1, flow="A"),
+                               guard=(k < pt.G("NB"))),
+                        arena=f"t{si}")
+                if dev is not None:
+                    dev.attach(tc, tp, kernel=_bump, reads=["A"],
+                               writes=["A"], shapes={"A": (elems,)},
+                               dtype=np.float32)
+                else:
+                    tc.body_noop()
+                return tp
 
-        tp = build()  # warmup: connections + (device) compile
-        tp.run()
-        tp.wait()
-        ctx.comm_fence()
-        tp = build()
-        t0 = time.perf_counter()
-        tp.run()
-        tp.wait()
-        ctx.comm_fence()
-        dt = time.perf_counter() - t0
+            walls = []
+            for rep in range(reps + 1):  # rep 0 = setup (reported apart)
+                tp = build()
+                ctx.comm_fence()  # both ranks ready: isolate the chain
+                t0 = time.perf_counter()
+                tp.run()
+                tp.wait()
+                ctx.comm_fence()
+                walls.append(time.perf_counter() - t0)
+            out.append({"size_bytes": size, "setup_ms": walls[0] * 1e3,
+                        "walls": walls[1:]})
+        tuning = ctx.comm_tuning()
+        dstats = dict(dev.stats) if dev is not None else None
         if dev is not None:
             dev.stop()
         ctx.comm_fini()
         ctx.destroy()
-        q.put(("ok", rank, dt))
+        q.put(("ok", rank, out, tuning, dstats))
     except Exception:
         import traceback
-        q.put(("err", rank, traceback.format_exc()))
+        q.put(("err", rank, traceback.format_exc(), None, None))
 
 
-def run_size(size, hops, port, device=False):
+def _fit(points):
+    """Least-squares t = a + b*size over (size, seconds) points.
+    Returns the harness's two headline quantities: the fixed per-
+    transfer overhead and the per-byte cost."""
+    if len({s for s, _ in points}) < 2:
+        return None
+    xs = np.array([s for s, _ in points], dtype=np.float64)
+    ys = np.array([t for _, t in points], dtype=np.float64)
+    A = np.vstack([np.ones_like(xs), xs]).T
+    (a, b), *_ = np.linalg.lstsq(A, ys, rcond=None)
+    pred = a + b * xs
+    ss_res = float(((ys - pred) ** 2).sum())
+    ss_tot = float(((ys - ys.mean()) ** 2).sum())
+    return {
+        "fixed_overhead_us": round(a * 1e6, 2),
+        "per_byte_ns": round(b * 1e9, 6),
+        "eff_gbps": round(8.0 / b / 1e9, 3) if b > 0 else None,
+        "r2": round(1.0 - ss_res / ss_tot, 4) if ss_tot > 0 else None,
+        "npoints": len(points),
+    }
+
+
+def run_path(path, sizes, hops, reps, port, extra_env=None):
+    """Sweep all `sizes` on one persistent 2-process pair; returns the
+    path's report dict (latencies, setup costs, fit, tunables)."""
+    env = dict(_PATH_ENV[path])
+    env.update(extra_env or {})
     mpctx = mp.get_context("spawn")
     q = mpctx.Queue()
     procs = [mpctx.Process(target=_worker,
-                           args=(r, port, size, hops, device, q))
+                           args=(r, port, sizes, hops, reps, path, env, q))
              for r in range(2)]
     for p in procs:
         p.start()
     try:
-        res = [q.get(timeout=900) for _ in range(2)]
+        res = [q.get(timeout=1800) for _ in range(2)]
     finally:
         for p in procs:
             p.join(timeout=30)
@@ -109,33 +174,92 @@ def run_size(size, hops, port, device=False):
     errs = [r for r in res if r[0] != "ok"]
     if errs:
         raise RuntimeError(str(errs))
-    wall = max(r[2] for r in res)
+    # per size: the transfer completes on the slower side
+    by_rank = {r[1]: r for r in res}
+    rows, points = [], []
+    for si, size in enumerate(sizes):
+        walls = [max(by_rank[0][2][si]["walls"][i],
+                     by_rank[1][2][si]["walls"][i])
+                 for i in range(len(by_rank[0][2][si]["walls"]))]
+        per_transfer = [w / hops for w in walls]
+        best = min(per_transfer)
+        rows.append({
+            "size_bytes": size,
+            "setup_ms": round(max(by_rank[0][2][si]["setup_ms"],
+                                  by_rank[1][2][si]["setup_ms"]), 2),
+            "per_transfer_ms": round(best * 1e3, 3),
+            "per_transfer_ms_all": [round(t * 1e3, 3)
+                                    for t in per_transfer],
+            "gbps": round(size * 8 / best / 1e9, 3),
+        })
+        points.append((size, best))
     return {
-        "size_bytes": size,
-        "hops": hops,
-        "hop_latency_us": round(wall / hops * 1e6, 2),
-        "bandwidth_gbps": round(size * hops / wall * 8 / 1e9, 3),
-        "path": "device" if device else "host",
+        "sizes": rows,
+        "fit": _fit(points),
+        "tunables": by_rank[0][3],
+        "device_stats": by_rank[0][4],
     }
 
 
+def run_adaptive_probe(port):
+    """One tiny eager_limit=auto job, reported so every sweep records
+    what threshold the engine would derive on this host (the measured
+    RTT and memcpy legs come back via comm_tuning)."""
+    rep = run_path("eager", [4096], hops=8, reps=1, port=port,
+                   extra_env={"PTC_MCA_comm_eager_limit": "auto"})
+    t = rep["tunables"]
+    return {"derived_eager_limit": t["eager_limit"],
+            "rtt_ns": t["rtt_ns"], "memcpy_bps": t["memcpy_bps"]}
+
+
+def _arg(flag, default=None):
+    if flag in sys.argv:
+        return sys.argv[sys.argv.index(flag) + 1]
+    return default
+
+
 def main():
-    sizes = [4096, 65536, 1048576, 16777216]
-    hops = 32
-    device = "--device" in sys.argv
-    if "--sizes" in sys.argv:
-        sizes = [int(x) for x in
-                 sys.argv[sys.argv.index("--sizes") + 1].split(",")]
-    if "--hops" in sys.argv:
-        hops = int(sys.argv[sys.argv.index("--hops") + 1])
+    quick = "--quick" in sys.argv
+    sizes = [65536, 1048576, 4194304] if not quick else [4096, 65536]
+    hops = int(_arg("--hops", 8 if quick else 16))
+    reps = int(_arg("--reps", 2 if quick else 3))
+    paths = ["eager", "rdv", "device"]
+    if "--device" in sys.argv:  # legacy spelling
+        paths = ["device"]
+    if _arg("--paths"):
+        paths = _arg("--paths").split(",")
+    if _arg("--sizes"):
+        sizes = [int(x) for x in _arg("--sizes").split(",")]
     base = int(os.environ.get("PTC_PORT", "31300"))
-    for i, size in enumerate(sizes):
+    doc = {
+        "bench": "transfer_economics",
+        "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "meta": {"hops": hops, "reps": reps, "sizes": sizes,
+                 "nodes": 2,
+                 "platform": ("tpu" if os.environ.get("PTC_BENCH_TPU")
+                              else "cpu-loopback")},
+        "paths": {},
+    }
+    port = base
+    try:
+        doc["adaptive_eager"] = run_adaptive_probe(port)
+    except Exception as e:
+        doc["adaptive_eager"] = {"error": str(e)[:300]}
+    port += 4
+    for path in paths:
         try:
-            print(json.dumps(run_size(size, hops, base + 2 * i,
-                                      device=device)), flush=True)
+            doc["paths"][path] = run_path(path, sizes, hops, reps, port)
         except Exception as e:
-            print(json.dumps({"size_bytes": size, "error": str(e)[:300]}),
-                  flush=True)
+            doc["paths"][path] = {"error": str(e)[:300]}
+        print(json.dumps({path: doc["paths"][path]}), flush=True)
+        port += 4
+    out = _arg("--json")
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {out}", file=sys.stderr)
+    else:
+        print(json.dumps(doc), flush=True)
 
 
 if __name__ == "__main__":
