@@ -33,4 +33,12 @@ $(TSAN_LIB): $(SRCS) $(HDRS)
 bench-comm: $(LIB)
 	python tools/testbandwidth.py --json BENCH_comm.json
 
-.PHONY: all clean tsan bench-comm
+# Dispatch-latency suite (bench.py --dispatch --json): single-chain +
+# contended successor-begin percentiles with sched_stats evidence
+# (bypass hits, freelist hit rate, inject traffic) and host provenance
+# (cpu_count vs workers — oversubscribed runs are flagged, not silently
+# reported).  Rung-1 of the measurement ladder.
+bench-dispatch: $(LIB)
+	python bench.py --dispatch --json BENCH_dispatch.json
+
+.PHONY: all clean tsan bench-comm bench-dispatch

@@ -28,10 +28,15 @@ import parsec_tpu as pt
 
 
 def bench_dispatch_chain(nb_tasks: int = 20000, reps: int = 5):
-    p50s = []
+    """Single-chain steady-state dispatch latency (measurement-ladder
+    rung 1): p50/p99 of successor EXEC-begin deltas on an Ex04-style RW
+    chain, 1 worker, span tracing on.  Returns the best rep's
+    percentiles plus that run's Context.sched_stats() — the bypass/
+    freelist counters are the evidence the fast path actually ran."""
+    best = None
     for _ in range(reps):
         with pt.Context(nb_workers=1) as ctx:
-            ctx.profile_enable(1)  # spans only: keep the hot path lean
+            ctx.profile_enable(1)  # EXEC spans only: keep the hot path lean
             ctx.register_arena("t", 8)
             tp = pt.Taskpool(ctx, globals={"NB": nb_tasks - 1})
             k = pt.L("k")
@@ -47,19 +52,26 @@ def bench_dispatch_chain(nb_tasks: int = 20000, reps: int = 5):
             tp.run()
             tp.wait()
             ev = ctx.profile_take()
+            stats = ctx.sched_stats()
         begins = ev[(ev[:, 0] == 0) & (ev[:, 1] == 0)]
         order = np.argsort(begins[:, 3])   # sort by l0 = k
         t = begins[order, 7]               # t_ns (8-word event format)
         deltas_us = np.diff(t) / 1e3
         deltas_us = deltas_us[len(deltas_us) // 10:]
-        p50s.append(float(np.percentile(deltas_us, 50)))
-    return min(p50s)
+        rep = {"p50_us": round(float(np.percentile(deltas_us, 50)), 3),
+               "p99_us": round(float(np.percentile(deltas_us, 99)), 3)}
+        if best is None or rep["p50_us"] < best["p50_us"]:
+            best = rep
+            best["sched_stats"] = stats
+    best.update(tasks=nb_tasks, reps=reps, workers=1)
+    return best
 
 
 def bench_profiling_overhead(nb_tasks: int = 20000, reps: int = 5):
     """Tracing cost per task (the reference's sp-perf standalone profiler
     benchmark role, tests/profiling-standalone/sp-perf.c): wall time of
-    the 20k noop chain at trace level 0 (off), 1 (spans), 2 (+edges)."""
+    the 20k noop chain at trace level 0 (off), 1 (EXEC spans), and
+    2 (+RELEASE spans +EDGE pairs)."""
     walls = {}
     for level in (0, 1, 2):
         best = None
@@ -103,12 +115,22 @@ def bench_dispatch_mt(nb_tasks: int = 4000, lanes: int = 8, workers: int = 4,
     """Multi-worker dispatch latency (VERDICT r3 weak #4: the single-
     worker chain p50 says nothing about release-path contention).
     `lanes` independent RW chains run concurrently on `workers` workers:
-    every release_deps hits the dense-slot mutex stripes while other
-    workers do the same.  Reported: p50 of intra-chain successor-begin
-    deltas across all lanes — dispatch latency WITH contention."""
-    p50s = []
+    every release_deps hits the dense dep engine while other workers do
+    the same.  Reported: p50/p99 of intra-chain successor-begin deltas
+    across all lanes — dispatch latency WITH contention.
+
+    The output records os.cpu_count() and the EFFECTIVE worker count,
+    and flags oversubscription explicitly: with workers > cores the
+    workers timeshare one core, so the number measures context-switch
+    luck, not lock contention (the r5 mt-dispatch caveat, now machine-
+    readable instead of a footnote)."""
+    import os
+    cpus = os.cpu_count() or 1
+    best = None
+    eff_workers = workers
     for _ in range(reps):
         with pt.Context(nb_workers=workers) as ctx:
+            eff_workers = ctx.nb_workers
             ctx.profile_enable(1)
             ctx.register_arena("t", 8)
             tp = pt.Taskpool(ctx, globals={"NB": nb_tasks - 1,
@@ -127,6 +149,7 @@ def bench_dispatch_mt(nb_tasks: int = 4000, lanes: int = 8, workers: int = 4,
             tp.run()
             tp.wait()
             ev = ctx.profile_take()
+            stats = ctx.sched_stats()
         begins = ev[(ev[:, 0] == 0) & (ev[:, 1] == 0)]
         deltas = []
         for lane in range(lanes):
@@ -136,8 +159,23 @@ def bench_dispatch_mt(nb_tasks: int = 4000, lanes: int = 8, workers: int = 4,
             d = np.diff(t) / 1e3
             deltas.append(d[len(d) // 10:])
         deltas = np.concatenate(deltas)
-        p50s.append(float(np.percentile(deltas, 50)))
-    return min(p50s)
+        rep = {"p50_us": round(float(np.percentile(deltas, 50)), 3),
+               "p99_us": round(float(np.percentile(deltas, 99)), 3)}
+        if best is None or rep["p50_us"] < best["p50_us"]:
+            best = rep
+            best["sched_stats"] = stats
+    over = eff_workers > cpus
+    best.update(tasks=nb_tasks, lanes=lanes, reps=reps,
+                workers_requested=workers, workers=eff_workers,
+                cpu_count=cpus, oversubscribed=over)
+    if over:
+        best["caveat"] = (
+            f"workers ({eff_workers}) > cores ({cpus}): workers "
+            "timeshare, so this measures scheduling luck, NOT lock "
+            "contention — re-run on a multicore host for a real "
+            "contended number")
+        sys.stderr.write(f"bench-dispatch-mt WARNING: {best['caveat']}\n")
+    return best
 
 
 _LAST_POTRF_INFO = None  # per-rung dispatch evidence (see _potrf_once)
@@ -460,8 +498,10 @@ def _ep_json():
     })
 
 
-def _dispatch_json():
-    p50_us = bench_dispatch_chain()
+def _dispatch_json(single=None):
+    if single is None:
+        single = bench_dispatch_chain()
+    p50_us = single["p50_us"]
     return json.dumps({
         "metric": "task_dispatch_p50",
         "value": round(p50_us, 3),
@@ -470,9 +510,39 @@ def _dispatch_json():
     })
 
 
+def bench_dispatch_suite(tasks=20000, mt_tasks=4000, reps=5, workers=4,
+                         lanes=8):
+    """The `make bench-dispatch` document (BENCH_dispatch.json):
+    single-chain AND contended dispatch percentiles, each carrying the
+    sched_stats counters that prove which fast paths fired, plus host
+    provenance so a 1-core contended number can't masquerade as a
+    contention measurement."""
+    import os
+    import platform
+    from parsec_tpu.utils import params as _mca
+    single = bench_dispatch_chain(tasks, reps)
+    contended = bench_dispatch_mt(mt_tasks, lanes, workers, reps)
+    return {
+        "bench": "dispatch",
+        "host": {"cpu_count": os.cpu_count(), "platform": sys.platform,
+                 "machine": platform.machine()},
+        "sched": _mca.get("runtime.sched"),
+        "sched_bypass": bool(_mca.get("sched.bypass")),
+        "budget_us": 5.0,
+        "single_chain": single,
+        "contended": contended,
+    }
+
+
 def _arg_after(flag, default):
     if flag in sys.argv:
         return int(sys.argv[sys.argv.index(flag) + 1])
+    return default
+
+
+def _arg_str_after(flag, default):
+    if flag in sys.argv:
+        return sys.argv[sys.argv.index(flag) + 1]
     return default
 
 
@@ -620,22 +690,43 @@ def _probe_tpu(timeout_s: int) -> int:
 
 def main():
     if "--dispatch" in sys.argv:
-        print(_dispatch_json())
+        out = _arg_str_after("--json", None)
+        if out:
+            # full document (make bench-dispatch -> BENCH_dispatch.json):
+            # single-chain + contended percentiles, sched_stats evidence,
+            # host provenance
+            doc = bench_dispatch_suite(
+                tasks=_arg_after("--tasks", 20000),
+                mt_tasks=_arg_after("--mt-tasks", 4000),
+                reps=_arg_after("--reps", 5),
+                workers=_arg_after("--workers", 4),
+                lanes=_arg_after("--lanes", 8))
+            with open(out, "w") as f:
+                json.dump(doc, f, indent=1)
+            sys.stderr.write(f"wrote {out}\n")
+            print(_dispatch_json(doc["single_chain"]))
+        else:
+            print(_dispatch_json())
         return 0
     if "--ep" in sys.argv:
         print(_ep_json())
         return 0
     if "--dispatch-mt" in sys.argv:
-        p50 = bench_dispatch_mt(workers=_arg_after("--workers", 4),
-                                lanes=_arg_after("--lanes", 8))
-        print(json.dumps({
+        mt = bench_dispatch_mt(workers=_arg_after("--workers", 4),
+                               lanes=_arg_after("--lanes", 8))
+        line = {
             "metric": "task_dispatch_mt_p50",
-            "value": round(p50, 3),
+            "value": mt["p50_us"],
             "unit": "us",
-            "vs_baseline": round(5.0 / p50, 3),
-            "config": {"workers": _arg_after("--workers", 4),
-                       "lanes": _arg_after("--lanes", 8)},
-        }))
+            "vs_baseline": round(5.0 / mt["p50_us"], 3),
+            "config": {k: mt[k] for k in
+                       ("workers", "workers_requested", "lanes", "tasks",
+                        "cpu_count", "oversubscribed")},
+            "p99_us": mt["p99_us"],
+        }
+        if "caveat" in mt:
+            line["caveat"] = mt["caveat"]
+        print(json.dumps(line))
         return 0
     if "--profov" in sys.argv:
         print(bench_profiling_overhead())

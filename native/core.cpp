@@ -35,36 +35,158 @@
 #include <sched.h>
 #endif
 #include <cstdlib>
+#if defined(__x86_64__)
+#include <x86intrin.h>
+#endif
 
-int64_t ptc_now_ns() {
+static inline int64_t chrono_now_ns() {
   return std::chrono::duration_cast<std::chrono::nanoseconds>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
+}
+
+/* Trace timestamp source.  steady_clock::now costs ~33 ns/call on the
+ * measurement host — one call per task at trace level 1 — so on x86-64
+ * the hot path reads the invariant TSC (~8 ns) and converts through a
+ * rate calibrated once per process against steady_clock (two ~1 ms
+ * windows; if they disagree > 1% — non-invariant TSC, paused VM — the
+ * chrono path is kept).  Timestamps stay on the steady_clock epoch, so
+ * traces mix freely with pre-calibration events. */
+int64_t ptc_now_ns() {
+#if defined(__x86_64__)
+  struct Calib {
+    double ns_per_tick = 0.0;
+    int64_t base_ns = 0;
+    uint64_t base_tsc = 0;
+    bool ok = false;
+    Calib() {
+      uint64_t c0 = __rdtsc();
+      int64_t n0 = chrono_now_ns();
+      while (chrono_now_ns() - n0 < 1000000) { /* spin ~1 ms */ }
+      uint64_t c1 = __rdtsc();
+      int64_t n1 = chrono_now_ns();
+      while (chrono_now_ns() - n1 < 1000000) { }
+      uint64_t c2 = __rdtsc();
+      int64_t n2 = chrono_now_ns();
+      if (c1 == c0 || c2 == c1) return;
+      double r1 = (double)(n1 - n0) / (double)(c1 - c0);
+      double r2 = (double)(n2 - n1) / (double)(c2 - c1);
+      if (r1 <= 0.0 || r2 <= 0.0 || r1 / r2 > 1.01 || r2 / r1 > 1.01)
+        return;
+      ns_per_tick = (double)(n2 - n0) / (double)(c2 - c0);
+      base_ns = n2;
+      base_tsc = c2;
+      ok = true;
+    }
+  };
+  static const Calib cal; /* magic-static: one calibration per process */
+  if (cal.ok)
+    return cal.base_ns +
+           (int64_t)((double)(__rdtsc() - cal.base_tsc) * cal.ns_per_tick);
+#endif
+  return chrono_now_ns();
+}
+
+/* ------------------------------------------------------------------ */
+/* worker-thread identity (magazine routing)                           */
+/* ------------------------------------------------------------------ */
+
+/* Which context's worker thread is this?  Set once in worker_main.
+ * Non-worker threads (main, comm, device managers) and workers of OTHER
+ * contexts in the same process resolve to slot -1 and take the locked
+ * shared paths — magazines are touched only by their owning thread. */
+static thread_local ptc_context *tl_mag_ctx = nullptr;
+static thread_local int tl_mag_worker = -1;
+
+static inline int mag_slot(ptc_context *ctx) {
+  return tl_mag_ctx == ctx ? tl_mag_worker : -1;
+}
+
+/* single-writer counter bump: relaxed load+store (plain add codegen,
+ * no lock prefix) — TSan-visible for the cross-thread stats read */
+static inline void tick1(std::atomic<int64_t> &c) {
+  c.store(c.load(std::memory_order_relaxed) + 1, std::memory_order_relaxed);
 }
 
 /* ------------------------------------------------------------------ */
 /* arena                                                               */
 /* ------------------------------------------------------------------ */
 
-void *Arena::alloc() {
+void Arena::init_mags(int32_t n) {
+  nb_mags = n > 0 ? n : 0;
+  if (nb_mags) mags.reset(new Mag[(size_t)nb_mags]);
+}
+
+void *Arena::alloc(int32_t slot) {
+  if (slot >= 0 && slot < nb_mags) {
+    Mag &m = mags[(size_t)slot];
+    if (m.items.empty()) {
+      /* refill: up to a batch from the shared pool, ONE lock */
+      std::lock_guard<std::mutex> g(lock);
+      int take = (int)std::min<size_t>(freelist.size(), PTC_MAG_BATCH);
+      if (take > 0) {
+        m.items.insert(m.items.end(), freelist.end() - take,
+                       freelist.end());
+        freelist.resize(freelist.size() - (size_t)take);
+      }
+    }
+    if (!m.items.empty()) {
+      void *p = m.items.back();
+      m.items.pop_back();
+      tick1(m.hits);
+      return p;
+    }
+    tick1(m.misses);
+    return std::malloc((size_t)elem_size);
+  }
   {
     std::lock_guard<std::mutex> g(lock);
     if (!freelist.empty()) {
       void *p = freelist.back();
       freelist.pop_back();
+      ext_hits.fetch_add(1, std::memory_order_relaxed);
       return p;
     }
   }
+  ext_misses.fetch_add(1, std::memory_order_relaxed);
   return std::malloc((size_t)elem_size);
 }
 
-void Arena::dealloc(void *p) {
+void Arena::dealloc(int32_t slot, void *p) {
+  if (slot >= 0 && slot < nb_mags) {
+    Mag &m = mags[(size_t)slot];
+    m.items.push_back(p);
+    if (m.items.size() >= 2 * PTC_MAG_BATCH) {
+      /* spill one batch back so idle workers don't hoard blocks */
+      std::lock_guard<std::mutex> g(lock);
+      freelist.insert(freelist.end(), m.items.end() - PTC_MAG_BATCH,
+                      m.items.end());
+      m.items.resize(m.items.size() - PTC_MAG_BATCH);
+    }
+    return;
+  }
   std::lock_guard<std::mutex> g(lock);
   freelist.push_back(p);
 }
 
+int64_t Arena::stat_hits() const {
+  int64_t s = ext_hits.load(std::memory_order_relaxed);
+  for (int32_t i = 0; i < nb_mags; i++)
+    s += mags[(size_t)i].hits.load(std::memory_order_relaxed);
+  return s;
+}
+
+int64_t Arena::stat_misses() const {
+  int64_t s = ext_misses.load(std::memory_order_relaxed);
+  for (int32_t i = 0; i < nb_mags; i++)
+    s += mags[(size_t)i].misses.load(std::memory_order_relaxed);
+  return s;
+}
+
 Arena::~Arena() {
   for (void *p : freelist) std::free(p);
+  for (int32_t i = 0; i < nb_mags; i++)
+    for (void *p : mags[(size_t)i].items) std::free(p);
 }
 
 /* ------------------------------------------------------------------ */
@@ -78,6 +200,7 @@ ptc_context::~ptc_context() {
   for (auto *p : prof) delete p;
   for (auto *c : worker_executed) delete c;
   for (auto *c : worker_cpu) delete c;
+  for (auto *c : worker_bypass) delete c;
   delete sched;
   ptc_task *t = free_list;
   while (t) {
@@ -85,16 +208,103 @@ ptc_context::~ptc_context() {
     delete t;
     t = n;
   }
+  for (TaskMag *m : task_mags) {
+    ptc_task *mt = m ? m->head : nullptr;
+    while (mt) {
+      ptc_task *n = mt->next;
+      delete mt;
+      mt = n;
+    }
+    delete m;
+  }
 }
 
 /* ------------------------------------------------------------------ */
 /* expression evaluation                                               */
 /* ------------------------------------------------------------------ */
 
+namespace {
+
+/* fast-form operand fetch (kinds: 1 imm, 2 local, 3 global) */
+static inline int64_t fast_atom(int8_t kind, int64_t v,
+                                const int64_t *locals,
+                                const int64_t *globals) {
+  if (kind == 2) return locals[v];
+  if (kind == 3) return globals[v];
+  return v;
+}
+
+} // namespace
+
+void ptc_expr_finalize(Expr &e) {
+  const std::vector<int64_t> &c = e.code;
+  auto atom_of = [](int64_t op) -> int8_t {
+    switch (op) {
+    case PTC_OP_IMM: return 1;
+    case PTC_OP_LOCAL: return 2;
+    case PTC_OP_GLOBAL: return 3;
+    default: return 0;
+    }
+  };
+  auto binop_ok = [](int64_t op) {
+    switch (op) {
+    case PTC_OP_ADD: case PTC_OP_SUB: case PTC_OP_MUL: case PTC_OP_DIV:
+    case PTC_OP_MOD: case PTC_OP_EQ: case PTC_OP_NE: case PTC_OP_LT:
+    case PTC_OP_LE: case PTC_OP_GT: case PTC_OP_GE: case PTC_OP_AND:
+    case PTC_OP_OR: case PTC_OP_MIN: case PTC_OP_MAX: case PTC_OP_SHL:
+    case PTC_OP_SHR:
+      return true;
+    default:
+      return false;
+    }
+  };
+  e.fast_op = 0;
+  if (c.size() == 2 && atom_of(c[0])) {
+    e.fast_op = 1;
+    e.fa_kind = atom_of(c[0]);
+    e.fa = c[1];
+  } else if (c.size() == 5 && atom_of(c[0]) && atom_of(c[2]) &&
+             binop_ok(c[4])) {
+    e.fast_op = (int8_t)c[4];
+    e.fa_kind = atom_of(c[0]);
+    e.fa = c[1];
+    e.fb_kind = atom_of(c[2]);
+    e.fb = c[3];
+  }
+}
+
 int64_t ptc_eval_expr(const Expr &e, ptc_context *ctx, const int64_t *locals,
                       int nb_locals, const int64_t *globals,
                       int64_t empty_value) {
   if (e.empty()) return empty_value;
+  if (e.fast_op) {
+    int64_t a = fast_atom(e.fa_kind, e.fa, locals, globals);
+    if (e.fast_op == 1) return a;
+    int64_t b = fast_atom(e.fb_kind, e.fb, locals, globals);
+    switch (e.fast_op) {
+    case PTC_OP_ADD: return a + b;
+    case PTC_OP_SUB: return a - b;
+    case PTC_OP_MUL: return a * b;
+    case PTC_OP_DIV: return b ? a / b : 0;
+    case PTC_OP_MOD: return b ? a % b : 0;
+    case PTC_OP_EQ: return a == b;
+    case PTC_OP_NE: return a != b;
+    case PTC_OP_LT: return a < b;
+    case PTC_OP_LE: return a <= b;
+    case PTC_OP_GT: return a > b;
+    case PTC_OP_GE: return a >= b;
+    case PTC_OP_AND: return a && b;
+    case PTC_OP_OR: return a || b;
+    case PTC_OP_MIN: return a < b ? a : b;
+    case PTC_OP_MAX: return a > b ? a : b;
+    case PTC_OP_SHL:
+      return (int64_t)((uint64_t)a
+                       << std::min<int64_t>(std::max<int64_t>(b, 0), 62));
+    case PTC_OP_SHR:
+      return a >> std::min<int64_t>(std::max<int64_t>(b, 0), 62);
+    default: break; /* unreachable (binop_ok-filtered) */
+    }
+  }
   constexpr int STACK_MAX = 64;
   int64_t stack[STACK_MAX];
   int sp = 0;
@@ -190,6 +400,8 @@ uint64_t ptc_fnv_hash(int32_t class_id, const std::vector<int64_t> &params) {
 
 namespace {
 
+static bool expr_has_call(const Expr &e); /* defined below */
+
 struct SpecReader {
   const int64_t *p;
   const int64_t *end;
@@ -204,6 +416,7 @@ struct SpecReader {
     if (n < 0 || n > 4096) { ok = false; return e; }
     e.code.reserve((size_t)n);
     for (int64_t i = 0; i < n && ok; i++) e.code.push_back(next());
+    if (ok) ptc_expr_finalize(e);
     return e;
   }
 };
@@ -231,6 +444,7 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
       tc.range_locals.push_back((int32_t)i);
     } else {
       l.value = r.expr();
+      tc.has_derived = true;
     }
     tc.locals.push_back(std::move(l));
   }
@@ -249,6 +463,7 @@ static bool decode_class(TaskClass &tc, const int64_t *spec, int64_t len) {
       Dep dep;
       dep.direction = (int32_t)r.next();
       dep.guard = r.expr();
+      dep.guard_dyn = expr_has_call(dep.guard);
       dep.kind = (int32_t)r.next();
       if (dep.kind == DEP_TASK) {
         dep.peer_class = (int32_t)r.next();
@@ -328,7 +543,7 @@ void ptc_copy_release_internal(ptc_context *ctx, ptc_copy *c) {
       delete rc;
     }
     if (c->arena_id >= 0 && c->ptr)
-      ctx->arenas[(size_t)c->arena_id]->dealloc(c->ptr);
+      ctx->arenas[(size_t)c->arena_id]->dealloc(mag_slot(ctx), c->ptr);
     else if (c->owns_ptr && c->ptr)
       std::free(c->ptr);
     delete c;
@@ -550,19 +765,67 @@ uint32_t ptc_collection_rank_of(ptc_context *ctx, int32_t dc_id,
 
 namespace {
 
+/* Task alloc/free with per-worker magazines: the steady-state pair
+ * (alloc in deliver → free in complete, both on the executing worker)
+ * touches only the worker's own magazine — no lock.  Refill/flush move
+ * PTC_MAG_BATCH tasks per free_lock acquisition; external threads
+ * (startup enumeration, comm deliveries) use the shared pool directly. */
 static ptc_task *task_alloc(ptc_context *ctx) {
+  int slot = mag_slot(ctx);
+  if (slot >= 0 && slot < (int)ctx->task_mags.size()) {
+    ptc_context::TaskMag &m = *ctx->task_mags[(size_t)slot];
+    if (!m.head) {
+      std::lock_guard<std::mutex> g(ctx->free_lock);
+      for (int i = 0; i < PTC_MAG_BATCH && ctx->free_list; i++) {
+        ptc_task *t = ctx->free_list;
+        ctx->free_list = t->next;
+        t->next = m.head;
+        m.head = t;
+        m.count++;
+      }
+    }
+    if (m.head) {
+      ptc_task *t = m.head;
+      m.head = t->next;
+      m.count--;
+      tick1(m.hits);
+      return t;
+    }
+    tick1(m.misses);
+    return new ptc_task();
+  }
   {
     std::lock_guard<std::mutex> g(ctx->free_lock);
     if (ctx->free_list) {
       ptc_task *t = ctx->free_list;
       ctx->free_list = t->next;
+      ctx->free_ext_hits.fetch_add(1, std::memory_order_relaxed);
       return t;
     }
   }
+  ctx->free_ext_misses.fetch_add(1, std::memory_order_relaxed);
   return new ptc_task();
 }
 
 static void task_free(ptc_context *ctx, ptc_task *t) {
+  int slot = mag_slot(ctx);
+  if (slot >= 0 && slot < (int)ctx->task_mags.size()) {
+    ptc_context::TaskMag &m = *ctx->task_mags[(size_t)slot];
+    t->next = m.head;
+    m.head = t;
+    if (++m.count >= 2 * PTC_MAG_BATCH) {
+      /* spill one batch so idle workers don't hoard task memory */
+      std::lock_guard<std::mutex> g(ctx->free_lock);
+      for (int i = 0; i < PTC_MAG_BATCH && m.head; i++) {
+        ptc_task *s = m.head;
+        m.head = s->next;
+        m.count--;
+        s->next = ctx->free_list;
+        ctx->free_list = s;
+      }
+    }
+    return;
+  }
   std::lock_guard<std::mutex> g(ctx->free_lock);
   t->next = ctx->free_list;
   ctx->free_list = t;
@@ -571,16 +834,17 @@ static void task_free(ptc_context *ctx, ptc_task *t) {
 static void complete_task(ptc_context *ctx, int worker, ptc_task *t);
 static void execute_task(ptc_context *ctx, int worker, ptc_task *t);
 static void prof_event(ptc_context *ctx, int worker, int64_t key,
-                       int64_t phase, ptc_task *t);
+                       int64_t phase, ptc_task *t, int32_t min_level = 1);
 static void prof_edge(ptc_context *ctx, int worker, ptc_task *src,
                       int64_t dst_class, int64_t dl0, int64_t dl1);
 static void prof_edge_params(ptc_context *ctx, int worker, ptc_task *src,
                              ptc_taskpool *tp, int32_t peer_class,
-                             const std::vector<int64_t> &params);
+                             const int64_t *params, size_t nparams);
 
 /* Fill derived locals given range-local values already in `locals`. */
 static void fill_derived_locals(ptc_context *ctx, ptc_taskpool *tp,
                                 const TaskClass &tc, int64_t *locals) {
+  if (!tc.has_derived) return; /* decode-time memo: nothing to derive */
   for (size_t i = 0; i < tc.locals.size(); i++) {
     if (!tc.locals[i].is_range)
       locals[i] = eval_expr(tc.locals[i].value, ctx, locals,
@@ -826,7 +1090,7 @@ static const Dep *select_input_dep(ptc_context *ctx, ptc_taskpool *tp,
                                    int nb_locals, const int64_t *g,
                                    bool conservative = false) {
   for (const Dep &d : fl.in_deps) {
-    if (conservative && expr_has_call(d.guard)) {
+    if (conservative && d.guard_dyn) {
       if (d.kind != DEP_TASK)
         continue; /* dynamic memory source: cannot deliver; keep looking */
       if (!dep_producer_in_domain(ctx, tp, d, locals, nb_locals, g))
@@ -960,10 +1224,12 @@ static int32_t count_task_inputs(ptc_context *ctx, ptc_taskpool *tp,
   return remaining;
 }
 
-/* Build a ready task from class + range-local params + staged copies. */
+/* Build a ready task from class + range-local params + staged copies.
+ * Span form: the dispatch hot path hands params as a stack array — no
+ * vector materialization between release_deps and the ready task. */
 static ptc_task *make_task(ptc_context *ctx, ptc_taskpool *tp,
-                           const TaskClass &tc,
-                           const std::vector<int64_t> &params,
+                           const TaskClass &tc, const int64_t *params,
+                           size_t nparams,
                            ptc_copy *const staged[PTC_MAX_FLOWS]) {
   ptc_task *t = task_alloc(ctx);
   t->tp = tp;
@@ -971,7 +1237,7 @@ static ptc_task *make_task(ptc_context *ctx, ptc_taskpool *tp,
   t->chore_idx = 0;
   std::memset(t->locals, 0, sizeof(t->locals));
   std::memset(t->data, 0, sizeof(t->data));
-  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+  for (size_t i = 0; i < tc.range_locals.size() && i < nparams; i++)
     t->locals[tc.range_locals[(size_t)i]] = params[i];
   fill_derived_locals(ctx, tp, tc, t->locals);
   if (staged)
@@ -979,6 +1245,13 @@ static ptc_task *make_task(ptc_context *ctx, ptc_taskpool *tp,
   t->priority = (int32_t)eval_expr(tc.priority, ctx, t->locals,
                                    (int)tc.locals.size(), tp->globals.data());
   return t;
+}
+
+static inline ptc_task *make_task(ptc_context *ctx, ptc_taskpool *tp,
+                                  const TaskClass &tc,
+                                  const std::vector<int64_t> &params,
+                                  ptc_copy *const staged[PTC_MAX_FLOWS]) {
+  return make_task(ctx, tp, tc, params.data(), params.size(), staged);
 }
 
 /* A batch of remote activations accumulated during one release_deps pass:
@@ -996,11 +1269,11 @@ struct RemoteSend {
 /* Compute the placement rank of a successor instance (affinity expr over
  * its collection); myrank when the class has no affinity. */
 static uint32_t successor_rank(ptc_context *ctx, ptc_taskpool *tp,
-                               const TaskClass &tc,
-                               const std::vector<int64_t> &params) {
+                               const TaskClass &tc, const int64_t *params,
+                               size_t nparams) {
   if (tc.aff_dc < 0 || ctx->nodes <= 1) return ctx->myrank;
   int64_t locals[PTC_MAX_LOCALS] = {0};
-  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+  for (size_t i = 0; i < tc.range_locals.size() && i < nparams; i++)
     locals[tc.range_locals[(size_t)i]] = params[i];
   fill_derived_locals(ctx, tp, tc, locals);
   int64_t idx[PTC_MAX_LOCALS];
@@ -1011,29 +1284,41 @@ static uint32_t successor_rank(ptc_context *ctx, ptc_taskpool *tp,
   return ptc_collection_rank_of(ctx, tc.aff_dc, idx, ni);
 }
 
+/* span-based local delivery core (defined below, after the dep-table
+ * machinery).  `owned` non-null lets the hash path MOVE the caller's
+ * vector instead of re-materializing one from the span. */
+static void deliver_local_impl(ptc_context *ctx, int worker,
+                               ptc_taskpool *tp, int32_t class_id,
+                               const int64_t *params, size_t nparams,
+                               std::vector<int64_t> *owned, int32_t flow_idx,
+                               ptc_copy *copy, bool domain_checked);
+
 /* Deliver one dependency release to a successor task instance: local
  * successors stage into the dep table; remote successors batch into an
- * ACTIVATE send (or go out immediately when batch == nullptr). */
+ * ACTIVATE send (or go out immediately when batch == nullptr).  Params
+ * arrive as a span — the local dense-engine path (the dispatch hot
+ * path) never materializes a heap vector from them. */
 static void deliver_dep(ptc_context *ctx, int worker, ptc_taskpool *tp,
-                        int32_t class_id, std::vector<int64_t> &&params,
-                        int32_t flow_idx, ptc_copy *copy,
+                        int32_t class_id, const int64_t *params,
+                        size_t nparams, int32_t flow_idx, ptc_copy *copy,
                         std::vector<RemoteSend> *batch,
                         int32_t send_dtype = -1) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
-  uint32_t rank = successor_rank(ctx, tp, tc, params);
+  uint32_t rank = successor_rank(ctx, tp, tc, params, nparams);
   if (rank != ctx->myrank) {
+    std::vector<int64_t> pv(params, params + nparams);
     if (batch) {
       for (RemoteSend &rs : *batch) {
         if (rs.rank == rank && rs.flow_idx == flow_idx && rs.copy == copy &&
             rs.send_dtype == send_dtype) {
-          rs.targets.emplace_back(class_id, std::move(params));
+          rs.targets.emplace_back(class_id, std::move(pv));
           return;
         }
       }
       batch->push_back(RemoteSend{rank, flow_idx, copy, send_dtype, {}});
-      batch->back().targets.emplace_back(class_id, std::move(params));
+      batch->back().targets.emplace_back(class_id, std::move(pv));
     } else {
-      ptc_comm_send_activate(ctx, rank, tp, class_id, params, flow_idx, copy,
+      ptc_comm_send_activate(ctx, rank, tp, class_id, pv, flow_idx, copy,
                              send_dtype);
     }
     return;
@@ -1043,8 +1328,9 @@ static void deliver_dep(ptc_context *ctx, int worker, ptc_taskpool *tp,
    * datatype engine sits in the remote-dep path).  release_deps already
    * domain-checked these params (domain_checked=true skips the re-check
    * — with dynamic bounds it would re-fire Python escape evaluations). */
-  ptc_deliver_dep_local(ctx, worker, tp, class_id, std::move(params),
-                        flow_idx, copy, /*domain_checked=*/true);
+  deliver_local_impl(ctx, worker, tp, class_id, params, nparams,
+                     /*owned=*/nullptr, flow_idx, copy,
+                     /*domain_checked=*/true);
 }
 
 } // namespace
@@ -1057,10 +1343,10 @@ DepEntry *const DENSE_PROMOTED = reinterpret_cast<DepEntry *>(1);
 /* first touch of a dependency entry: compute how many task-inputs this
  * instance expects, per consumer flow (exact over-delivery detection) */
 static void init_dep_entry(ptc_context *ctx, ptc_taskpool *tp,
-                           const TaskClass &tc,
-                           const std::vector<int64_t> &params, DepEntry &e) {
+                           const TaskClass &tc, const int64_t *params,
+                           size_t nparams, DepEntry &e) {
   int64_t locals[PTC_MAX_LOCALS] = {0};
-  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+  for (size_t i = 0; i < tc.range_locals.size() && i < nparams; i++)
     locals[tc.range_locals[(size_t)i]] = params[i];
   fill_derived_locals(ctx, tp, tc, locals);
   e.remaining = count_task_inputs(ctx, tp, tc, locals, e.flow_remaining);
@@ -1094,11 +1380,11 @@ static int apply_delivery(ptc_context *ctx, const TaskClass &tc, DepEntry &e,
 }
 
 /* linearized slot index within the class's bounding box, or -1 */
-static int64_t dense_index(const DenseDeps &dd,
-                           const std::vector<int64_t> &params) {
-  if (params.size() != dd.lo.size()) return -1;
+static int64_t dense_index(const DenseDeps &dd, const int64_t *params,
+                           size_t nparams) {
+  if (nparams != dd.lo.size()) return -1;
   int64_t idx = 0;
-  for (size_t i = 0; i < params.size(); i++) {
+  for (size_t i = 0; i < nparams; i++) {
     int64_t d = params[i] - dd.lo[i];
     if (d < 0 || d >= dd.span[i]) return -1;
     idx = idx * dd.span[i] + d;
@@ -1167,9 +1453,21 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
                            int32_t class_id, std::vector<int64_t> &&params,
                            int32_t flow_idx, ptc_copy *copy,
                            bool domain_checked) {
+  deliver_local_impl(ctx, worker, tp, class_id, params.data(), params.size(),
+                     &params, flow_idx, copy, domain_checked);
+}
+
+namespace {
+
+static void deliver_local_impl(ptc_context *ctx, int worker,
+                               ptc_taskpool *tp, int32_t class_id,
+                               const int64_t *params, size_t nparams,
+                               std::vector<int64_t> *owned, int32_t flow_idx,
+                               ptc_copy *copy, bool domain_checked) {
   const TaskClass &tc = tp->classes[(size_t)class_id];
 
-  if (!domain_checked && !task_params_in_domain(ctx, tp, tc, params)) {
+  if (!domain_checked &&
+      !task_params_in_domain(ctx, tp, tc, params, nparams)) {
     /* out-of-domain successor: dropped by JDF semantics (see
      * task_params_in_domain).  Not an error. */
     return;
@@ -1191,7 +1489,8 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
       (size_t)flow_idx < tc.flows.size()) {
     const Flow &fl = tc.flows[(size_t)flow_idx];
     if (!(fl.flags & PTC_FLOW_CTL)) {
-      const Dep *sel = ptc_select_consumer_in_dep(ctx, tp, tc, params,
+      std::vector<int64_t> pvec(params, params + nparams);
+      const Dep *sel = ptc_select_consumer_in_dep(ctx, tp, tc, pvec,
                                                   flow_idx);
       if (sel && sel->ltype_id >= 0)
         copy = ltype_hold.c = ptc_reshape_get(ctx, copy, sel->ltype_id);
@@ -1200,17 +1499,63 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
 
   /* dense engine: O(1) slot in the class's bounding box (reference:
    * parsec_default_find_deps over the dense deps array vs
-   * parsec_hash_find_deps, parsec_internal.h:343-346) */
+   * parsec_hash_find_deps, parsec_internal.h:343-346).
+   *
+   * Slot protocol: the null -> {entry | PROMOTED} transition is a CAS
+   * (lock-free), so a first delivery that SATISFIES the instance — the
+   * steady state of chains and every single-producer flow set — counts
+   * its inputs on the stack, fires the task, and never touches a mutex
+   * or the heap.  Only live multi-input entries serialize on the shard
+   * stripe (their fields are plain); entry -> PROMOTED happens under
+   * that stripe, and slots never return to null, so a CAS loser can
+   * safely re-resolve under the lock. */
   if ((size_t)class_id < tp->dense.size() &&
       tp->dense[(size_t)class_id].enabled) {
     DenseDeps &dd = tp->dense[(size_t)class_id];
-    int64_t sidx = dense_index(dd, params);
+    int64_t sidx = dense_index(dd, params, nparams);
     if (sidx >= 0) {
+      std::atomic<DepEntry *> &slot = dd.slots[sidx];
+      DepEntry *e0 = slot.load(std::memory_order_acquire);
+      if (e0 == DENSE_PROMOTED) {
+        std::fprintf(stderr, "ptc: duplicate dependency delivery to "
+                             "already-fired %s; ignored\n",
+                     tc.name.c_str());
+        return;
+      }
+      if (!e0) {
+        /* first touch: count + apply on the STACK, publish by CAS */
+        DepEntry se;
+        init_dep_entry(ctx, tp, tc, params, nparams, se);
+        int rc = apply_delivery(ctx, tc, se, flow_idx, copy);
+        if (rc < 0) return; /* zero-expectation flow: nothing retained */
+        DepEntry *expect = nullptr;
+        if (rc > 0) {
+          if (slot.compare_exchange_strong(expect, DENSE_PROMOTED,
+                                           std::memory_order_acq_rel,
+                                           std::memory_order_acquire)) {
+            ptc_schedule_task(ctx, worker,
+                              make_task(ctx, tp, tc, params, nparams,
+                                        se.staged));
+            return;
+          }
+        } else {
+          DepEntry *he = new DepEntry(se);
+          if (slot.compare_exchange_strong(expect, he,
+                                           std::memory_order_acq_rel,
+                                           std::memory_order_acquire))
+            return;
+          delete he;
+        }
+        /* lost the first-touch race: drop the stack stage refs and
+         * re-deliver against the winner's slot state under the stripe */
+        for (int f = 0; f < PTC_MAX_FLOWS; f++)
+          if (se.staged[f]) copy_release(ctx, se.staged[f]);
+      }
       DepShard &shard = tp->shards[(size_t)(sidx % NB_SHARDS)];
       ptc_task *ready = nullptr;
       {
         std::lock_guard<std::mutex> g(shard.lock);
-        DepEntry *e = dd.slots[sidx].load(std::memory_order_relaxed);
+        DepEntry *e = slot.load(std::memory_order_acquire);
         if (e == DENSE_PROMOTED) {
           std::fprintf(stderr, "ptc: duplicate dependency delivery to "
                                "already-fired %s; ignored\n",
@@ -1218,16 +1563,17 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
           return;
         }
         if (!e) {
+          /* cannot happen (slots never revert to null) — defensive */
           e = new DepEntry();
-          init_dep_entry(ctx, tp, tc, params, *e);
-          dd.slots[sidx].store(e, std::memory_order_relaxed);
+          init_dep_entry(ctx, tp, tc, params, nparams, *e);
+          slot.store(e, std::memory_order_release);
         }
         int rc = apply_delivery(ctx, tc, *e, flow_idx, copy);
         if (rc < 0) return;
         if (rc > 0) {
-          ready = make_task(ctx, tp, tc, params, e->staged);
+          ready = make_task(ctx, tp, tc, params, nparams, e->staged);
           delete e;
-          dd.slots[sidx].store(DENSE_PROMOTED, std::memory_order_relaxed);
+          slot.store(DENSE_PROMOTED, std::memory_order_release);
         }
       }
       if (ready) ptc_schedule_task(ctx, worker, ready);
@@ -1236,7 +1582,11 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
     /* out-of-box instance (shouldn't happen): hash path below is exact */
   }
 
-  DepKey key{class_id, ptc_fnv_hash(class_id, params), std::move(params)};
+  std::vector<int64_t> pv = owned
+                                ? std::move(*owned)
+                                : std::vector<int64_t>(params,
+                                                       params + nparams);
+  DepKey key{class_id, ptc_fnv_hash(class_id, pv), std::move(pv)};
   DepShard &shard = tp->shards[key.hash % NB_SHARDS];
 
   ptc_task *ready = nullptr;
@@ -1249,7 +1599,8 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
       return;
     }
     DepEntry &e = shard.map[key];
-    if (!e.initialized) init_dep_entry(ctx, tp, tc, key.params, e);
+    if (!e.initialized)
+      init_dep_entry(ctx, tp, tc, key.params.data(), key.params.size(), e);
     int rc = apply_delivery(ctx, tc, e, flow_idx, copy);
     if (rc < 0) return;
     if (rc > 0) {
@@ -1267,6 +1618,8 @@ void ptc_deliver_dep_local(ptc_context *ctx, int worker, ptc_taskpool *tp,
   }
   if (ready) ptc_schedule_task(ctx, worker, ready);
 }
+
+} // namespace
 
 namespace {
 
@@ -1321,7 +1674,7 @@ static int prepare_input(ptc_context *ctx, ptc_task *t) {
       if ((fl.flags & PTC_FLOW_WRITE) && fl.arena_id >= 0) {
         Arena *a = ctx->arenas[(size_t)fl.arena_id];
         ptc_copy *c = new ptc_copy();
-        c->ptr = a->alloc();
+        c->ptr = a->alloc(mag_slot(ctx));
         c->size = a->elem_size;
         c->arena_id = fl.arena_id;
         t->data[f] = c;
@@ -1375,12 +1728,18 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
           }
           return ecopy_v;
         };
-        /* expand range params (broadcast outputs) */
+        /* expand range params (broadcast outputs).  All-stack storage:
+         * the scalar case (every chain/chord successor) runs from here
+         * through the dense dep engine to the ready task without one
+         * heap allocation. */
         size_t np = d.params.size();
-        std::vector<int64_t> vals(np, 0);
-        std::vector<size_t> range_idx;
+        if (np > (size_t)PTC_MAX_LOCALS)
+          return; /* cannot be in any class's domain (> max range locals) */
+        int64_t vals[PTC_MAX_LOCALS] = {0};
+        size_t range_idx[PTC_MAX_LOCALS];
+        size_t nri = 0;
         for (size_t i = 0; i < np; i++)
-          if (d.params[i].is_range) range_idx.push_back(i);
+          if (d.params[i].is_range) range_idx[nri++] = i;
         /* evaluate scalar params once */
         for (size_t i = 0; i < np; i++)
           if (!d.params[i].is_range)
@@ -1392,50 +1751,48 @@ static void release_deps(ptc_context *ctx, int worker, ptc_task *t) {
          * (Remote arrivals re-check in ptc_deliver_dep_local as wire
          * defense; local deliveries skip the re-check.) */
         const TaskClass &peer_tc = tp->classes[(size_t)d.peer_class];
-        if (range_idx.empty()) {
-          std::vector<int64_t> pv(vals);
-          if (!task_params_in_domain(ctx, tp, peer_tc, pv)) return;
-          prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
-          deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
-                      d.peer_flow, ecopy(), &batch, d.dtype_id);
+        if (nri == 0) {
+          if (!task_params_in_domain(ctx, tp, peer_tc, vals, np)) return;
+          prof_edge_params(ctx, worker, t, tp, d.peer_class, vals, np);
+          deliver_dep(ctx, worker, tp, d.peer_class, vals, np, d.peer_flow,
+                      ecopy(), &batch, d.dtype_id);
           return;
         }
         /* nested iteration over up to a few range params */
         struct R { int64_t lo, hi, st, cur; };
-        std::vector<R> rs;
-        for (size_t ri : range_idx) {
-          const DepParam &pm = d.params[ri];
-          R r;
+        R rs[PTC_MAX_LOCALS];
+        for (size_t i = 0; i < nri; i++) {
+          const DepParam &pm = d.params[range_idx[i]];
+          R &r = rs[i];
           r.lo = eval_expr(pm.lo, ctx, locs, nb, g);
           r.hi = eval_expr(pm.hi, ctx, locs, nb, g);
           r.st = eval_expr(pm.st, ctx, locs, nb, g, 1);
           if (r.st == 0) r.st = 1;
           r.cur = r.lo;
-          rs.push_back(r);
         }
         bool live = true;
-        for (const R &r : rs)
-          if ((r.st > 0 && r.cur > r.hi) || (r.st < 0 && r.cur < r.hi))
+        for (size_t i = 0; i < nri; i++)
+          if ((rs[i].st > 0 && rs[i].cur > rs[i].hi) ||
+              (rs[i].st < 0 && rs[i].cur < rs[i].hi))
             live = false;
         while (live) {
-          for (size_t i = 0; i < rs.size(); i++)
+          for (size_t i = 0; i < nri; i++)
             vals[range_idx[i]] = rs[i].cur;
-          std::vector<int64_t> pv(vals);
-          if (task_params_in_domain(ctx, tp, peer_tc, pv)) {
-            prof_edge_params(ctx, worker, t, tp, d.peer_class, pv);
-            deliver_dep(ctx, worker, tp, d.peer_class, std::move(pv),
+          if (task_params_in_domain(ctx, tp, peer_tc, vals, np)) {
+            prof_edge_params(ctx, worker, t, tp, d.peer_class, vals, np);
+            deliver_dep(ctx, worker, tp, d.peer_class, vals, np,
                         d.peer_flow, ecopy(), &batch, d.dtype_id);
           }
           /* advance odometer */
           size_t i = 0;
-          for (; i < rs.size(); i++) {
+          for (; i < nri; i++) {
             rs[i].cur += rs[i].st;
             if ((rs[i].st > 0 && rs[i].cur <= rs[i].hi) ||
                 (rs[i].st < 0 && rs[i].cur >= rs[i].hi))
               break;
             rs[i].cur = rs[i].lo;
           }
-          if (i == rs.size()) live = false;
+          if (i == nri) live = false;
         }
       };
       auto emit_mem_dep = [&](const int64_t *locs, int nb) {
@@ -1560,7 +1917,7 @@ void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
   /* comm-thread deliveries can precede/overlap the lazy start */
   if (!ctx->started.load(std::memory_order_acquire))
     ptc_context_start(ctx);
-  if (tl_bypass) {
+  if (tl_bypass && ctx->sched_bypass.load(std::memory_order_relaxed)) {
     if (!tl_next_task) {
       tl_next_task = t;
       return;
@@ -1681,15 +2038,16 @@ void ptc_set_pins_cb(ptc_context_t *ctx, ptc_pins_cb cb, void *user,
 }
 
 void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
-                   int64_t class_id, int64_t l0, int64_t l1, int64_t aux) {
-  bool trace = ctx->prof_level.load(std::memory_order_relaxed) >= 1;
+                   int64_t class_id, int64_t l0, int64_t l1, int64_t aux,
+                   int32_t min_level) {
+  bool trace = ctx->prof_level.load(std::memory_order_relaxed) >= min_level;
   bool pins = ctx->pins_state.load(std::memory_order_relaxed) != nullptr;
   if (!trace && !pins) return;
   int64_t w[PROF_WORDS] = {key,         phase, class_id, l0, l1,
                            (int64_t)worker, aux,   ptc_now_ns()};
   if (trace) {
     ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
-    std::lock_guard<std::mutex> g(b->lock);
+    ProfLockGuard g(b);
     b->words.insert(b->words.end(), w, w + PROF_WORDS);
   }
   if (pins) pins_fire(ctx, key, w);
@@ -1706,16 +2064,40 @@ void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
   if (pins) pins_fire(ctx, key, w); /* begin event only: instant span */
   if (!trace) return;
   ProfBuf *b = ctx->prof[0];
-  std::lock_guard<std::mutex> g(b->lock);
+  ProfLockGuard g(b);
   b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
 }
 
 namespace {
 
 static void prof_event(ptc_context *ctx, int worker, int64_t key,
-                       int64_t phase, ptc_task *t) {
+                       int64_t phase, ptc_task *t, int32_t min_level) {
   ptc_prof_push(ctx, worker, key, phase, t ? t->class_id : -1,
-                t ? t->locals[0] : 0, t ? t->locals[1] : 0, 0);
+                t ? t->locals[0] : 0, t ? t->locals[1] : 0, 0, min_level);
+}
+
+/* begin+end of a zero-duration body as ONE buffer transaction (one lock,
+ * one timestamp) — the noop-chore dispatch path; PINS still sees both
+ * phases as separate callbacks */
+static void prof_event_pair(ptc_context *ctx, int worker, int64_t key,
+                            ptc_task *t) {
+  bool trace = ctx->prof_level.load(std::memory_order_relaxed) >= 1;
+  bool pins = ctx->pins_state.load(std::memory_order_relaxed) != nullptr;
+  if (!trace && !pins) return;
+  int64_t now = ptc_now_ns();
+  int64_t cid = t ? t->class_id : -1;
+  int64_t l0 = t ? t->locals[0] : 0, l1 = t ? t->locals[1] : 0;
+  int64_t w[2 * PROF_WORDS] = {key, 0, cid, l0, l1, (int64_t)worker, 0, now,
+                               key, 1, cid, l0, l1, (int64_t)worker, 0, now};
+  if (trace) {
+    ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
+    ProfLockGuard g(b);
+    b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
+  }
+  if (pins) {
+    pins_fire(ctx, key, w);
+    pins_fire(ctx, key, w + PROF_WORDS);
+  }
 }
 
 /* dep edge = consecutive src/dst event pair, pushed under ONE lock so a
@@ -1726,7 +2108,7 @@ static void prof_edge(ptc_context *ctx, int worker, ptc_task *src,
                       int64_t dst_class, int64_t dl0, int64_t dl1) {
   if (ctx->prof_level.load(std::memory_order_relaxed) < 2) return;
   ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
-  std::lock_guard<std::mutex> g(b->lock);
+  ProfLockGuard g(b);
   int64_t now = ptc_now_ns();
   int64_t w[2 * PROF_WORDS] = {
       PROF_KEY_EDGE, 0, src ? src->class_id : -1,
@@ -1742,11 +2124,11 @@ static void prof_edge(ptc_context *ctx, int worker, ptc_task *src,
  * node matches that task's EXEC identity in the captured DAG. */
 static void prof_edge_params(ptc_context *ctx, int worker, ptc_task *src,
                              ptc_taskpool *tp, int32_t peer_class,
-                             const std::vector<int64_t> &params) {
+                             const int64_t *params, size_t nparams) {
   if (ctx->prof_level.load(std::memory_order_relaxed) < 2) return;
   const TaskClass &tc = tp->classes[(size_t)peer_class];
   int64_t locals[PTC_MAX_LOCALS] = {0};
-  for (size_t i = 0; i < tc.range_locals.size() && i < params.size(); i++)
+  for (size_t i = 0; i < tc.range_locals.size() && i < nparams; i++)
     locals[tc.range_locals[(size_t)i]] = params[i];
   fill_derived_locals(ctx, tp, tc, locals);
   prof_edge(ctx, worker, src, peer_class, locals[0], locals[1]);
@@ -1807,9 +2189,12 @@ static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   }
   ptc_taskpool *tp = t->tp;
   const TaskClass &tc = tp->classes[(size_t)t->class_id];
-  prof_event(ctx, worker, PROF_KEY_RELEASE, 0, t);
+  /* RELEASE spans are level-2 trace events: level 1 (the dispatch
+   * bench's lean setting) pays two locked pushes per task, not four.
+   * PINS sinks still see them at any level (mask-gated). */
+  prof_event(ctx, worker, PROF_KEY_RELEASE, 0, t, /*min_level=*/2);
   release_deps(ctx, worker, t);
-  prof_event(ctx, worker, PROF_KEY_RELEASE, 1, t);
+  prof_event(ctx, worker, PROF_KEY_RELEASE, 1, t, /*min_level=*/2);
   for (size_t f = 0; f < tc.flows.size(); f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   task_free(ctx, t);
@@ -1867,8 +2252,7 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
   }
   switch (dx->body_kind) {
   case PTC_BODY_NOOP:
-    prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
-    prof_event(ctx, worker, PROF_KEY_EXEC, 1, t);
+    prof_event_pair(ctx, worker, PROF_KEY_EXEC, t);
     break;
   case PTC_BODY_CB: {
     BodyCb &cb = ctx->body_cbs[(size_t)dx->body_arg];
@@ -2036,10 +2420,8 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
     }
     switch (rc) {
     case PTC_HOOK_DONE:
-      if (ch.body_kind == PTC_BODY_NOOP) {
-        prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
-        prof_event(ctx, worker, PROF_KEY_EXEC, 1, t);
-      }
+      if (ch.body_kind == PTC_BODY_NOOP)
+        prof_event_pair(ctx, worker, PROF_KEY_EXEC, t);
       complete_task(ctx, worker, t);
       return;
     case PTC_HOOK_ASYNC:
@@ -2109,18 +2491,24 @@ static void worker_main(ptc_context *ctx, int worker) {
     int cpu = bind_worker_thread(worker);
     ctx->worker_cpu[(size_t)worker]->store(cpu, std::memory_order_relaxed);
   }
+  /* magazine routing: this thread now owns ctx's per-worker freelists */
+  tl_mag_ctx = ctx;
+  tl_mag_worker = worker;
+  std::atomic<int64_t> *bypass_ctr = ctx->worker_bypass[(size_t)worker];
+  std::atomic<int64_t> *exec_ctr = ctx->worker_executed[(size_t)worker];
   int misses = 0;
   tl_bypass = true;
   while (!ctx->shutdown.load(std::memory_order_acquire)) {
     ptc_task *t = tl_next_task;
-    if (t)
+    if (t) {
       tl_next_task = nullptr; /* bypass hit: no scheduler round-trip */
-    else
+      tick1(*bypass_ctr);
+    } else {
       t = ctx->sched->select(worker);
+    }
     if (t) {
       misses = 0;
-      ctx->worker_executed[(size_t)worker]->fetch_add(
-          1, std::memory_order_relaxed);
+      tick1(*exec_ctr); /* single writer: this worker */
       execute_task(ctx, worker, t);
       continue;
     }
@@ -2490,9 +2878,18 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
     ctx->prof.push_back(new ProfBuf());
     ctx->worker_executed.push_back(new std::atomic<int64_t>(0));
     ctx->worker_cpu.push_back(new std::atomic<int32_t>(-1));
+    ctx->worker_bypass.push_back(new std::atomic<int64_t>(0));
+    ctx->task_mags.push_back(new ptc_context::TaskMag());
   }
   if (const char *e = std::getenv("PTC_MCA_deptable_dense_max"))
     ctx->dense_max_slots = std::atoll(e);
+  /* same-worker ready-task bypass: on unless PTC_MCA_sched_bypass=0
+   * (the Python MCA layer re-applies its resolved value via
+   * ptc_context_set_sched_bypass; this env read covers native-only
+   * embeddings and keeps the two spellings consistent) */
+  if (const char *e = std::getenv("PTC_MCA_sched_bypass"))
+    ctx->sched_bypass.store(!(*e == '0' && e[1] == '\0'),
+                            std::memory_order_relaxed);
   /* the weak-hash sanitizer targets the HASH engine: force it (same
    * value parse as ptc_fnv_hash — "0" means off) */
   if (const char *wh = std::getenv("PTC_DEBUG_WEAK_HASH"))
@@ -2527,6 +2924,53 @@ int64_t ptc_worker_steals(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   int64_t n = 0;
   for (; n < (int64_t)st.size() && n < cap; n++)
     out[n] = st[(size_t)n]->load(std::memory_order_relaxed);
+  return n;
+}
+
+/* Same-worker ready-task bypass knob (PTC_MCA_sched_bypass): when off,
+ * every ready successor takes the full schedule()+select() round trip —
+ * the control the dispatch bench measures the bypass against. */
+void ptc_context_set_sched_bypass(ptc_context_t *ctx, int32_t on) {
+  ctx->sched_bypass.store(on != 0, std::memory_order_relaxed);
+}
+
+int32_t ptc_context_get_sched_bypass(ptc_context_t *ctx) {
+  return ctx->sched_bypass.load(std::memory_order_relaxed) ? 1 : 0;
+}
+
+/* Dispatch fast-path counters (Context.sched_stats()).  Layout:
+ *  [0] bypass hits (sum over workers)   [1] bypass enabled (0/1)
+ *  [2] task-freelist hits               [3] task-freelist misses
+ *  [4] arena-freelist hits              [5] arena-freelist misses
+ *  [6] DTD insert batches               [7] DTD batch-inserted tasks
+ *  [8] scheduler inject pushes          [9] scheduler inject pops
+ * Returns the number of slots written (<= cap). */
+int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
+  int64_t v[10] = {0};
+  for (auto *c : ctx->worker_bypass)
+    v[0] += c->load(std::memory_order_relaxed);
+  v[1] = ctx->sched_bypass.load(std::memory_order_relaxed) ? 1 : 0;
+  v[2] = ctx->free_ext_hits.load(std::memory_order_relaxed);
+  v[3] = ctx->free_ext_misses.load(std::memory_order_relaxed);
+  for (auto *m : ctx->task_mags) {
+    v[2] += m->hits.load(std::memory_order_relaxed);
+    v[3] += m->misses.load(std::memory_order_relaxed);
+  }
+  {
+    std::lock_guard<std::mutex> g(ctx->reg_lock);
+    for (Arena *a : ctx->arenas) {
+      v[4] += a->stat_hits();
+      v[5] += a->stat_misses();
+    }
+  }
+  v[6] = ctx->insert_batches.load(std::memory_order_relaxed);
+  v[7] = ctx->insert_batched_tasks.load(std::memory_order_relaxed);
+  if (ctx->sched) {
+    v[8] = ctx->sched->inject_pushes.load(std::memory_order_relaxed);
+    v[9] = ctx->sched->inject_pops.load(std::memory_order_relaxed);
+  }
+  int64_t n = cap < 10 ? (cap < 0 ? 0 : cap) : 10;
+  for (int64_t i = 0; i < n; i++) out[i] = v[i];
   return n;
 }
 
@@ -2695,6 +3139,7 @@ int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size) {
   std::lock_guard<std::mutex> g(ctx->reg_lock);
   Arena *a = new Arena();
   a->elem_size = elem_size;
+  a->init_mags(ctx->nb_workers);
   ctx->arenas.push_back(a);
   return (int32_t)ctx->arenas.size() - 1;
 }
@@ -3346,6 +3791,45 @@ int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
   return 0;
 }
 
+/* Batched DTD insertion: ONE native crossing (and one GIL release from
+ * ctypes) inserts a whole window of dynamic tasks, instead of the
+ * 2+nargs crossings per task the begin/arg/submit triple costs from
+ * Python.  Spec stream, per task:
+ *   [body_kind, body_arg, priority, rank(-1 = auto), nargs,
+ *    (tile_ptr, mode) * nargs]
+ * Window throttling applies per task, exactly as ptc_dtask_submit.
+ * Returns the number of tasks inserted (== the whole stream), or
+ * ~inserted when the pool refused an insertion (aborted) or the stream
+ * is malformed — the first `inserted` tasks stay in. */
+int64_t ptc_dtask_insert_batch(ptc_context_t *ctx, ptc_taskpool_t *tp,
+                               const int64_t *spec, int64_t len,
+                               int64_t window) {
+  int64_t i = 0, inserted = 0;
+  while (i < len) {
+    if (i + 5 > len) return ~inserted;
+    int32_t body_kind = (int32_t)spec[i];
+    int64_t body_arg = spec[i + 1];
+    int32_t prio = (int32_t)spec[i + 2];
+    int64_t rank = spec[i + 3];
+    int64_t nargs = spec[i + 4];
+    i += 5;
+    if (nargs < 0 || nargs > PTC_MAX_FLOWS || i + 2 * nargs > len)
+      return ~inserted; /* validated BEFORE building the task */
+    ptc_task *t = ptc_dtask_begin(tp, body_kind, body_arg, prio);
+    for (int64_t a = 0; a < nargs; a++) {
+      ptc_dtile *tile = (ptc_dtile *)(intptr_t)spec[i + 2 * a];
+      ptc_dtask_arg(t, tile, (int32_t)spec[i + 2 * a + 1]);
+    }
+    i += 2 * nargs;
+    if (rank >= 0) ptc_dtask_set_rank(t, (int32_t)rank);
+    if (ptc_dtask_submit(ctx, t, window) != 0) return ~inserted;
+    inserted++;
+  }
+  ctx->insert_batches.fetch_add(1, std::memory_order_relaxed);
+  ctx->insert_batched_tasks.fetch_add(inserted, std::memory_order_relaxed);
+  return inserted;
+}
+
 /* profiling */
 void ptc_profile_enable(ptc_context_t *ctx, int32_t enable) {
   ctx->prof_level.store(enable, std::memory_order_release);
@@ -3354,7 +3838,7 @@ void ptc_profile_enable(ptc_context_t *ctx, int32_t enable) {
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   int64_t written = 0;
   for (auto *b : ctx->prof) {
-    std::lock_guard<std::mutex> g(b->lock);
+    ProfLockGuard g(b);
     int64_t n = (int64_t)b->words.size();
     int64_t take = std::min(n, cap - written);
     take -= take % PROF_WORDS;

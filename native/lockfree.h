@@ -7,6 +7,13 @@
  * loads/stores, thieves race a CAS at the top.  Memory ordering follows
  * Lê/Pop/Cohen-Fradet, "Correct and Efficient Work-Stealing for Weak
  * Memory Models" (PPoPP'13).
+ *
+ * MPSCQueue is the external-producer inject channel (Vyukov
+ * intrusive-node MPSC): producers — the main thread's DTD inserts and
+ * startup schedules, the comm thread, device managers — push with one
+ * wait-free exchange instead of a mutex; consumption is serialized by
+ * an internal try-flag so ANY worker may drain, but never two
+ * concurrently (the single-consumer contract is enforced, not assumed).
  */
 #pragma once
 
@@ -103,6 +110,76 @@ public:
                                         std::memory_order_relaxed))
         return T{};
     }
+    return v;
+  }
+};
+
+/* Vyukov-style MPSC queue (unbounded, node-based).  push() is wait-free
+ * for any number of producers: one exchange on the head plus one release
+ * store linking the predecessor.  pop() is single-consumer; the internal
+ * try-flag lets any thread ATTEMPT to consume and simply returns T{}
+ * when another consumer holds the role — callers treat that exactly like
+ * "empty" and retry on their next pass (the scheduler's select loop).
+ *
+ * A pop may also observe T{} transiently while a producer sits between
+ * its exchange and the next-link store; `size()` stays > 0 through that
+ * window, so emptiness checks for termination must use size(), not a
+ * failed pop.  (Reference analog: parsec/class/lifo.h's atomic LIFO
+ * feeding the system queue — same producer contract, FIFO here so
+ * injected work cannot be starved by later injections.) */
+template <typename T> class MPSCQueue {
+  struct Node {
+    std::atomic<Node *> next{nullptr};
+    T value{};
+  };
+  alignas(64) std::atomic<Node *> head_; /* producers exchange here */
+  alignas(64) Node *tail_;               /* consumer end (stub node) */
+  std::atomic_flag consuming_ = ATOMIC_FLAG_INIT;
+  alignas(64) std::atomic<int64_t> count_{0};
+
+public:
+  MPSCQueue() {
+    Node *stub = new Node();
+    head_.store(stub, std::memory_order_relaxed);
+    tail_ = stub;
+  }
+  MPSCQueue(const MPSCQueue &) = delete;
+  MPSCQueue &operator=(const MPSCQueue &) = delete;
+  ~MPSCQueue() {
+    Node *n = tail_;
+    while (n) {
+      Node *nx = n->next.load(std::memory_order_relaxed);
+      delete n;
+      n = nx;
+    }
+  }
+
+  int64_t size() const { return count_.load(std::memory_order_acquire); }
+
+  /* any thread, lock-free */
+  void push(T v) {
+    Node *n = new Node();
+    n->value = v;
+    Node *prev = head_.exchange(n, std::memory_order_acq_rel);
+    prev->next.store(n, std::memory_order_release);
+    count_.fetch_add(1, std::memory_order_release);
+  }
+
+  /* any thread; T{} when empty, mid-push, or another consumer is active */
+  T pop() {
+    if (count_.load(std::memory_order_acquire) <= 0) return T{};
+    if (consuming_.test_and_set(std::memory_order_acquire)) return T{};
+    T v{};
+    Node *t = tail_;
+    Node *next = t->next.load(std::memory_order_acquire);
+    if (next) {
+      v = next->value;
+      next->value = T{};
+      tail_ = next;
+      delete t;
+      count_.fetch_sub(1, std::memory_order_release);
+    }
+    consuming_.clear(std::memory_order_release);
     return v;
   }
 };

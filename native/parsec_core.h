@@ -126,10 +126,21 @@ int32_t ptc_context_wait(ptc_context_t *ctx);
 /* non-blocking: 1 if all taskpools complete, 0 otherwise */
 int32_t ptc_context_test(ptc_context_t *ctx);
 /* scheduler selection, by name ("lfq", "gd", "ap"); default lfq.
- * Unknown names fall back to lfq; aliases collapse ("lhq" -> "pbq"). */
+ * Unknown names fall back to lfq (with a one-shot stderr warning). */
 int32_t ptc_context_set_scheduler(ptc_context_t *ctx, const char *name);
 /* canonical name of the module that will run (valid until ctx destroy) */
 const char *ptc_context_get_scheduler(ptc_context_t *ctx);
+/* same-worker ready-task bypass (PTC_MCA_sched_bypass; reference:
+ * keep_highest_priority_task, parsec/scheduling.c:373-396): a worker
+ * completing a task executes its best ready successor directly instead
+ * of round-tripping schedule()+select().  Default on. */
+void ptc_context_set_sched_bypass(ptc_context_t *ctx, int32_t on);
+int32_t ptc_context_get_sched_bypass(ptc_context_t *ctx);
+/* dispatch fast-path counters — [0] bypass hits, [1] bypass enabled,
+ * [2]/[3] task-freelist hits/misses, [4]/[5] arena hits/misses,
+ * [6]/[7] DTD insert batches / batch-inserted tasks, [8]/[9] scheduler
+ * inject pushes/pops.  Returns slots written (<= cap). */
+int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap);
 
 /* registries: return non-negative id, or -1 on error */
 int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user);
@@ -369,6 +380,14 @@ int32_t ptc_dtask_arg(ptc_task_t *t, ptc_dtile_t *tile, int32_t mode);
 /* submit; blocks while more than `window` tasks are in flight (0: no
  * throttle).  Returns 0, or -1 if the pool aborted (task refused). */
 int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window);
+/* batched insertion: one crossing inserts a stream of task specs —
+ * per task [body_kind, body_arg, priority, rank(-1 = auto), nargs,
+ * (tile_ptr, mode) * nargs].  Same per-task window throttle as
+ * ptc_dtask_submit.  Returns tasks inserted, or ~inserted on refusal /
+ * malformed stream (the first `inserted` tasks stay in). */
+int64_t ptc_dtask_insert_batch(ptc_context_t *ctx, ptc_taskpool_t *tp,
+                               const int64_t *spec, int64_t len,
+                               int64_t window);
 int32_t ptc_dtask_nb_flows(ptc_task_t *t);
 /* opaque user tag on a task (stored in the last local slot; used by the
  * device layer to key per-task DTD bodies without pointer-ABA issues) */

@@ -33,8 +33,21 @@
 
 struct Expr {
   std::vector<int64_t> code; /* empty == constant 0 (or "true" for guards) */
+  /* decode-time fast form (ptc_expr_finalize): almost every guard /
+   * dep-param / range-bound expression in real JDFs is `atom` or
+   * `atom op atom` (k==0, k-1, k<NB, ...).  Those evaluate here with
+   * two loads and a switch instead of the VM's fetch-decode loop —
+   * the dominant cost of the dispatch critical path before this.
+   *   fast_op: 0 = none (run the VM), 1 = single atom, else the binop
+   *   opcode; f*_kind: 1 imm, 2 local, 3 global. */
+  int8_t fast_op = 0;
+  int8_t fa_kind = 0, fb_kind = 0;
+  int64_t fa = 0, fb = 0;
   bool empty() const { return code.empty(); }
 };
+
+/* populate Expr::fast_* from code (called once at spec decode) */
+void ptc_expr_finalize(Expr &e);
 
 struct ExprCb {
   ptc_expr_cb fn;
@@ -96,6 +109,9 @@ struct DepIter {
 struct Dep {
   int32_t direction = 0; /* 0 in, 1 out */
   Expr guard;            /* empty == always true */
+  /* guard contains a Python escape (decode-time memo of expr_has_call:
+   * the conservative counting path checks this per dep per instance) */
+  bool guard_dyn = false;
   int32_t kind = DEP_NONE;
   /* DEP_TASK */
   int32_t peer_class = -1;
@@ -218,12 +234,15 @@ struct TaskClass {
   /* any IN dep declares a local reshape type (checked per delivery only
    * when true — keeps ltype-free classes off the select_input_dep path) */
   bool has_in_ltype = false;
+  /* any non-range (derived) local exists — fill_derived_locals runs 3x
+   * per task on the dispatch path; derived-free classes skip the walk */
+  bool has_derived = false;
   TaskClass() = default;
   TaskClass(const TaskClass &o)
       : name(o.name), id(o.id), locals(o.locals),
         range_locals(o.range_locals), aff_dc(o.aff_dc), aff_idx(o.aff_idx),
         priority(o.priority), flows(o.flows), chores(o.chores),
-        has_in_ltype(o.has_in_ltype) {}
+        has_in_ltype(o.has_in_ltype), has_derived(o.has_derived) {}
 };
 
 /* ------------------------------------------------------------------ */
@@ -248,12 +267,36 @@ struct Collection {
   std::mutex linear_lock;
 };
 
+/* Arena block allocator with per-worker magazines (reference:
+ * parsec/mempool.c's per-thread mempools).  A worker thread allocates
+ * and frees against its own magazine with no lock; magazines refill
+ * from / spill to the shared freelist in PTC_MAG_BATCH-sized moves
+ * under ONE lock acquisition, so the steady-state alloc/free pair
+ * crosses no mutex.  Non-worker threads (slot < 0: main, comm, device
+ * managers) take the locked shared path directly.
+ *
+ * hits/misses use single-writer relaxed atomics (plain add codegen on
+ * x86, TSan-visible for the cross-thread stats read). */
+constexpr int PTC_MAG_BATCH = 64;
+
 struct Arena {
   int64_t elem_size = 0;
   std::vector<void *> freelist;
   std::mutex lock;
-  void *alloc();
-  void dealloc(void *p);
+  struct alignas(64) Mag {
+    std::vector<void *> items;
+    std::atomic<int64_t> hits{0}, misses{0};
+  };
+  std::unique_ptr<Mag[]> mags; /* one per worker; owner-thread only */
+  int32_t nb_mags = 0;
+  std::atomic<int64_t> ext_hits{0}, ext_misses{0};
+  void init_mags(int32_t n);
+  /* slot = calling worker's index when the caller IS that worker
+   * thread of the owning context, else -1 (locked shared path) */
+  void *alloc(int32_t slot);
+  void dealloc(int32_t slot, void *p);
+  int64_t stat_hits() const;
+  int64_t stat_misses() const;
   ~Arena();
 };
 
@@ -397,6 +440,9 @@ struct Scheduler {
    * observability role (reference: mca/pins/print_steals); global-queue
    * schedulers never tick.  Sized by the install caller (core.cpp). */
   std::vector<std::unique_ptr<std::atomic<int64_t>>> steals;
+  /* external-producer inject traffic (lock-free MPSC modules tick these;
+   * mutex/global modules leave them 0) — Context.sched_stats() rows */
+  std::atomic<int64_t> inject_pushes{0}, inject_pops{0};
   void steals_init(int n) {
     steals.clear();
     for (int i = 0; i < (n < 1 ? 1 : n); i++)
@@ -444,8 +490,24 @@ struct DeviceQueue {
 };
 
 struct ProfBuf {
-  std::mutex lock;
+  /* spinlock, not a mutex: the push critical section is a ~16-word
+   * append (amortized), paid once per task at trace level 1 — an
+   * uncontended std::mutex costs ~3x the test_and_set pair.  Contention
+   * is rare (owner worker + comm-thread instants on buffer 0 + take). */
+  std::atomic_flag lock = ATOMIC_FLAG_INIT;
   std::vector<int64_t> words; /* PROF_WORDS words per event */
+  void acquire() {
+    while (lock.test_and_set(std::memory_order_acquire))
+      std::this_thread::yield();
+  }
+  void release() { lock.clear(std::memory_order_release); }
+};
+
+/* RAII for ProfBuf::acquire/release */
+struct ProfLockGuard {
+  ProfBuf *b;
+  explicit ProfLockGuard(ProfBuf *buf) : b(buf) { b->acquire(); }
+  ~ProfLockGuard() { b->release(); }
 };
 
 /* Paired-event trace keys (reference: the profiling dictionary +
@@ -579,9 +641,32 @@ struct ptc_context {
   std::unordered_map<int32_t, ptc_taskpool *> tp_registry;
   std::unordered_map<int32_t, std::vector<std::vector<uint8_t>>> tp_early;
 
-  /* task freelist (mempool stand-in; reference parsec/mempool.c) */
+  /* task freelist (mempool stand-in; reference parsec/mempool.c).
+   * free_lock/free_list is the SHARED spill pool; each worker owns a
+   * magazine (task_mags[w], owner-thread only) that refills from and
+   * flushes to it in PTC_MAG_BATCH-sized moves, so the steady-state
+   * task alloc/free pair on a worker never takes free_lock. */
   std::mutex free_lock;
   ptc_task *free_list = nullptr;
+  struct alignas(64) TaskMag {
+    ptc_task *head = nullptr;
+    int32_t count = 0;
+    std::atomic<int64_t> hits{0}, misses{0}; /* single-writer relaxed */
+  };
+  std::vector<TaskMag *> task_mags; /* one per worker */
+  std::atomic<int64_t> free_ext_hits{0}, free_ext_misses{0};
+
+  /* same-worker ready-task bypass knob (PTC_MCA_sched_bypass /
+   * ptc_context_set_sched_bypass; reference: keep_highest_priority_task,
+   * parsec/scheduling.c:373-396).  worker_bypass[w] counts tasks worker
+   * w executed straight from its thread-local slot — the proof the
+   * schedule()+select() round trip was skipped. */
+  std::atomic<bool> sched_bypass{true};
+  std::vector<std::atomic<int64_t> *> worker_bypass;
+
+  /* batched DTD insertion accounting (ptc_dtask_insert_batch) */
+  std::atomic<int64_t> insert_batches{0};
+  std::atomic<int64_t> insert_batched_tasks{0};
 
   /* device-layer hook: copy with handle released */
   ptc_copy_release_cb copy_release_cb = nullptr;
@@ -699,9 +784,13 @@ void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t);
 void ptc_tp_abort_internal(ptc_context *ctx, ptc_taskpool *tp);
 
 /* trace push (core.cpp): event = (key, phase, class, l0, l1, worker,
- * aux, t_ns); no-op unless profiling enabled */
+ * aux, t_ns); no-op unless profiling >= min_level (PINS callbacks fire
+ * regardless of trace level — their mask is the gate).  RELEASE spans
+ * ride min_level 2 so level-1 tracing keeps the dispatch path to two
+ * locked pushes per task (the sp-perf lean-trace setting). */
 void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
-                   int64_t class_id, int64_t l0, int64_t l1, int64_t aux);
+                   int64_t class_id, int64_t l0, int64_t l1, int64_t aux,
+                   int32_t min_level = 1);
 /* instant span: begin+end with the SAME timestamp, one lock (comm thread
  * events; buffer 0 is shared with worker 0) */
 void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,

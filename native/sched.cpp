@@ -22,6 +22,7 @@
 #include "lockfree.h"
 
 #include <algorithm>
+#include <cstdio>
 #include <random>
 
 namespace {
@@ -34,24 +35,40 @@ namespace {
 thread_local const void *tls_owner = nullptr;
 thread_local int tls_worker = -1;
 
-/* lws: per-worker Chase–Lev deque + multi-producer inject queue
- * (reference analog: hbbuffer local queues + system queue, SURVEY §2.4
- * sched lfq).  Owner pop is LIFO (cache warmth), steals are FIFO. */
+/* lws: per-worker Chase–Lev deque + LOCK-FREE multi-producer inject
+ * queue (reference analog: hbbuffer local queues + the atomic-LIFO
+ * system queue, SURVEY §2.4 sched lfq).  Owner pop is LIFO (cache
+ * warmth), steals are FIFO.  External producers — the main thread's
+ * startup/DTD inserts, the comm thread, device managers — push into a
+ * Vyukov MPSC queue with one wait-free exchange (was: a mutex deque).
+ *
+ * Inject-drain rule: a worker whose local deque never empties (a chain
+ * of self-pushed successors) serves the inject queue FIRST every 64th
+ * select, so externally injected tasks cannot starve behind it.  The
+ * empty-local path still drains inject before stealing. */
 struct SchedLWS : Scheduler {
   std::vector<WSDeque<ptc_task *> *> dq;
-  std::mutex inj_lock;
-  std::deque<ptc_task *> inj; /* external producers */
-  std::atomic<int64_t> inj_count{0}; /* lock-free emptiness check */
+  MPSCQueue<ptc_task *> inj; /* external producers, lock-free */
+  struct alignas(64) Tick {
+    int64_t v = 0; /* owner-worker only */
+  };
+  std::vector<Tick> tick;
   void install(int n) override {
     for (auto *d : dq)
       delete d;
     dq.clear();
     for (int i = 0; i < std::max(1, n); i++)
       dq.push_back(new WSDeque<ptc_task *>());
+    tick.assign(dq.size(), Tick{});
   }
   ~SchedLWS() override {
     for (auto *d : dq)
       delete d;
+  }
+  ptc_task *inj_pop() {
+    ptc_task *t = inj.pop();
+    if (t) inject_pops.fetch_add(1, std::memory_order_relaxed);
+    return t;
   }
   void schedule(int w, ptc_task *t) override {
     int n = (int)dq.size();
@@ -59,29 +76,24 @@ struct SchedLWS : Scheduler {
       dq[(size_t)w]->push(t);
       return;
     }
-    std::lock_guard<std::mutex> g(inj_lock);
-    inj.push_back(t);
-    inj_count.fetch_add(1, std::memory_order_release);
+    inj.push(t);
+    inject_pushes.fetch_add(1, std::memory_order_relaxed);
   }
   ptc_task *select(int w) override {
     int n = (int)dq.size();
+    int me = w % n;
     tls_owner = this;
-    tls_worker = w % n;
-    ptc_task *t = dq[(size_t)(w % n)]->pop();
-    if (t) return t;
-    if (inj_count.load(std::memory_order_acquire) > 0) {
-      std::lock_guard<std::mutex> g(inj_lock);
-      if (!inj.empty()) {
-        t = inj.front();
-        inj.pop_front();
-        inj_count.fetch_sub(1, std::memory_order_relaxed);
-        return t;
-      }
-    }
+    tls_worker = me;
+    ptc_task *t;
+    if (inj.size() > 0 && (++tick[(size_t)me].v & 63) == 0 &&
+        (t = inj_pop()))
+      return t; /* drain rule: inject ahead of a never-empty local deque */
+    if ((t = dq[(size_t)me]->pop())) return t;
+    if ((t = inj_pop())) return t;
     for (int i = 1; i < n; i++) {
       t = dq[(size_t)((w + i) % n)]->steal();
       if (t) {
-        steal_tick(w % n);
+        steal_tick(me);
         return t;
       }
     }
@@ -390,6 +402,17 @@ const char *ptc_sched_canonical(const char *name) {
     std::string n(name);
     for (const char *k : known)
       if (n == k) return k;
+  }
+  /* one-shot diagnostic: a typo in PTC_MCA_sched used to resolve to the
+   * fallback SILENTLY, making "why is my scheduler not in effect?"
+   * undiagnosable.  Name both the request and the resolution. */
+  if (name && *name) {
+    static std::atomic<bool> warned{false};
+    if (!warned.exchange(true, std::memory_order_relaxed))
+      std::fprintf(stderr,
+                   "ptc [sched]: unknown scheduler module '%s' requested; "
+                   "resolving to 'lfq' (known: gd ap ll ltq pbq lhq ip spq "
+                   "rnd lfq lws)\n", name);
   }
   return "lfq";
 }

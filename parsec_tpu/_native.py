@@ -135,6 +135,10 @@ _sigs = {
     "ptc_context_wait": (C.c_int32, [C.c_void_p]),
     "ptc_context_test": (C.c_int32, [C.c_void_p]),
     "ptc_context_set_scheduler": (C.c_int32, [C.c_void_p, C.c_char_p]),
+    "ptc_context_set_sched_bypass": (None, [C.c_void_p, C.c_int32]),
+    "ptc_context_get_sched_bypass": (C.c_int32, [C.c_void_p]),
+    "ptc_sched_stats": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64),
+                                    C.c_int64]),
     "ptc_context_set_rank": (None, [C.c_void_p, C.c_uint32, C.c_uint32]),
     "ptc_context_set_binding": (None, [C.c_void_p, C.c_int32]),
     "ptc_worker_binding": (C.c_int32, [C.c_void_p, C.c_int32]),
@@ -230,6 +234,9 @@ _sigs = {
                                      C.c_int32]),
     "ptc_dtask_arg": (C.c_int32, [C.c_void_p, C.c_void_p, C.c_int32]),
     "ptc_dtask_submit": (C.c_int32, [C.c_void_p, C.c_void_p, C.c_int64]),
+    "ptc_dtask_insert_batch": (C.c_int64, [C.c_void_p, C.c_void_p,
+                                           C.POINTER(C.c_int64), C.c_int64,
+                                           C.c_int64]),
     "ptc_dtask_nb_flows": (C.c_int32, [C.c_void_p]),
     "ptc_task_set_tag": (None, [C.c_void_p, C.c_int64]),
     "ptc_task_get_tag": (C.c_int64, [C.c_void_p]),

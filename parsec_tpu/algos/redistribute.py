@@ -50,6 +50,10 @@ def redistribute(ctx: Context, src, dst, size_row: int, size_col: int,
 
     tp = DtdTaskpool(ctx, window=window)
     try:
+        # accumulate (body, args) specs; ONE native crossing per
+        # dtd.insert_batch tasks (tp.insert_tasks) instead of a
+        # begin/arg/submit triple per copy task
+        batch = []
         tm_lo, tm_hi = _tile_range(disi_dst, disi_dst + size_row, dmb)
         tn_lo, tn_hi = _tile_range(disj_dst, disj_dst + size_col, dnb)
         for tm in range(tm_lo, tm_hi + 1):
@@ -86,8 +90,9 @@ def redistribute(ctx: Context, src, dst, size_row: int, size_col: int,
                             d[di:di + h, dj:dj + w] = \
                                 s[si:si + h, sj:sj + w].astype(ddt)
 
-                        tp.insert_task(body, (src_tile, "INPUT"),
-                                       (dst_tile, "INOUT"))
+                        batch.append((body, ((src_tile, "INPUT"),
+                                             (dst_tile, "INOUT"))))
+        tp.insert_tasks(batch)
         tp.wait()
     finally:
         tp.destroy()

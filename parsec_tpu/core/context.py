@@ -108,6 +108,9 @@ class Context:
             _live(self, _mca.get("runtime.live"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
+        # same-worker ready-task bypass (sched.bypass / PTC_MCA_sched_bypass)
+        N.lib.ptc_context_set_sched_bypass(
+            self._ptr, 1 if _mca.get("sched.bypass") else 0)
         if _mca.get("runtime.vpmap") not in ("", "flat"):
             self.set_vpmap(_mca.get("runtime.vpmap"))
         N.lib.ptc_device_set_affinity_skew(
@@ -295,6 +298,31 @@ class Context:
         n = N.lib.ptc_worker_steals(self._ptr, buf, cap)
         return [buf[i] for i in range(n)]
 
+    def sched_stats(self) -> dict:
+        """Dispatch fast-path counters: same-worker bypass hits (tasks
+        that skipped the schedule/select round trip), task/arena
+        freelist magazine hit rates, batched-insert accounting, and the
+        lock-free inject queue's traffic — plus the per-worker steal
+        and selected-task vectors (the print_steals data, readable from
+        Python at last instead of only at PINS teardown)."""
+        buf = (C.c_int64 * 10)()
+        n = N.lib.ptc_sched_stats(self._ptr, buf, 10)
+        v = [buf[i] for i in range(n)] + [0] * (10 - n)
+        return {
+            "bypass_hits": v[0],
+            "bypass_enabled": bool(v[1]),
+            "freelist_hits": v[2],
+            "freelist_misses": v[3],
+            "arena_hits": v[4],
+            "arena_misses": v[5],
+            "insert_batches": v[6],
+            "insert_batched_tasks": v[7],
+            "inject_pushes": v[8],
+            "inject_pops": v[9],
+            "steals": self.worker_steals(),
+            "executed": self.worker_stats(),
+        }
+
     def rusage(self) -> dict:
         """Process resource usage (the reference's per-EU rusage dumps,
         parsec/scheduling.c:45-86 — user/sys time, maxrss, context
@@ -318,6 +346,14 @@ class Context:
         steals = self.worker_steals()
         if any(steals):
             lines.append(f"worker steals: {steals}")
+        ss = self.sched_stats()
+        if ss["bypass_hits"] or ss["freelist_hits"] or ss["inject_pushes"]:
+            lines.append(
+                "dispatch: bypass=%d freelist=%d/%d arena=%d/%d inject=%d"
+                % (ss["bypass_hits"], ss["freelist_hits"],
+                   ss["freelist_hits"] + ss["freelist_misses"],
+                   ss["arena_hits"], ss["arena_hits"] + ss["arena_misses"],
+                   ss["inject_pushes"]))
         bindings = [self.worker_binding(w) for w in range(self.nb_workers)]
         if any(b >= 0 for b in bindings):
             lines.append(f"worker cpu bindings: {bindings}")
@@ -575,9 +611,11 @@ class Context:
 
     # ------------------------------------------------------------ profiling
     def profile_enable(self, enable=True):
-        """Tracing level: 0/False off; 1 span events only (EXEC/RELEASE/
-        COMM_SEND/RECV — cheapest, what bench.py uses); 2/True adds dep-EDGE pairs
-        for DAG capture (parsec_tpu.profiling.to_dot)."""
+        """Tracing level: 0/False off; 1 EXEC + comm spans only (the
+        lean dispatch-bench setting — one buffer transaction per task);
+        2/True adds RELEASE_DEPS spans and dep-EDGE pairs for DAG
+        capture (parsec_tpu.profiling.to_dot).  PINS callbacks fire at
+        any level (their key mask is the gate)."""
         level = 2 if enable is True else int(enable)
         N.lib.ptc_profile_enable(self._ptr, level)
 

@@ -12,8 +12,9 @@ insert_function.c:69,472-509.
 """
 from __future__ import annotations
 
+import ctypes as C
 import traceback
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
@@ -146,6 +147,63 @@ class DtdTaskpool:
         if N.lib.ptc_dtask_submit(self.ctx._ptr, t, self.window) != 0:
             raise RuntimeError("taskpool aborted: insertion refused")
         return t
+
+    def insert_tasks(self, tasks: Iterable, batch: Optional[int] = None
+                     ) -> int:
+        """Batched insert_task: ONE native crossing (and one GIL bounce)
+        per `batch` tasks instead of 2+nargs crossings per task — the
+        amortized path for DAG builders that insert thousands of tasks
+        in a loop (ptg_to_dtd, redistribute).
+
+        `tasks` yields (fn, args) or (fn, args, priority) or
+        (fn, args, priority, rank) tuples, where args is the usual
+        ((tile, mode), ...) sequence.  `batch` defaults to the
+        dtd.insert_batch MCA param; the window throttle still applies
+        per task inside the native batch.  Returns tasks inserted."""
+        if self._closed:
+            raise RuntimeError("taskpool already closed")
+        if batch is None:
+            from ..utils import params as _mca
+            batch = _mca.get("dtd.insert_batch")
+        batch = max(1, int(batch))
+        spec: list = []
+        pending = 0
+        inserted = 0
+
+        def flush():
+            nonlocal spec, pending, inserted
+            if not pending:
+                return
+            arr = (C.c_int64 * len(spec))(*spec)
+            rc = N.lib.ptc_dtask_insert_batch(
+                self.ctx._ptr, self.tp._ptr, arr, len(spec), self.window)
+            if rc < 0:
+                inserted += ~rc
+                raise RuntimeError(
+                    f"taskpool aborted: insertion refused after "
+                    f"{inserted} tasks")
+            inserted += rc
+            spec = []
+            pending = 0
+
+        for item in tasks:
+            fn, args = item[0], item[1]
+            prio = int(item[2]) if len(item) > 2 else 0
+            rank = int(item[3]) if len(item) > 3 and item[3] is not None \
+                else -1
+            if len(args) > N.MAX_FLOWS:
+                raise ValueError(
+                    f"insert_tasks: too many arguments (max {N.MAX_FLOWS})")
+            spec += [N.BODY_CB, self._body_id(fn), prio, rank, len(args)]
+            for tile, mode in args:
+                m = _MODES[mode.upper()] if isinstance(mode, str) \
+                    else int(mode)
+                spec += [tile._ptr, m]
+            pending += 1
+            if pending >= batch:
+                flush()
+        flush()
+        return inserted
 
     def insert_tpu_task(self, dev, kernel: Callable, *args,
                         shapes=None, dtype=np.float32, priority: int = 0):

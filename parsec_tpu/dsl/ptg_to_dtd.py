@@ -327,9 +327,14 @@ def run_ptg_as_dtd(ctx: Context, tp: Taskpool,
     if len(order) != len(insts):
         raise ValueError("ptg_to_dtd: dependency cycle in the PTG spec")
 
-    # ---- insert in topo order; DTD rediscovers the DAG from access order
+    # ---- insert in topo order; DTD rediscovers the DAG from access
+    # order.  Specs accumulate into a batch stream: ONE native crossing
+    # per dtd.insert_batch tasks (ptc_dtask_insert_batch) instead of the
+    # per-task begin/arg/submit triple — access order is the batch
+    # stream's order, so the discovered DAG is identical.
     dtp = DtdTaskpool(ctx, window=window)
     n_inserted = 0
+    batch_stream = []
 
     def _copy_body(v):
         src = v.data(0)
@@ -379,11 +384,12 @@ def run_ptg_as_dtd(ctx: Context, tp: Taskpool,
                 return lambda v: None
             return lambda v: body(_ConvView(v, loc, glb, slot))
 
-        dtp.insert_task(mk(body, dict(loc), dict(slot)), *args)
+        batch_stream.append((mk(body, dict(loc), dict(slot)), tuple(args)))
         n_inserted += 1
         for src_tile, dst_tile in writebacks:
-            dtp.insert_task(_copy_body, (src_tile, "INPUT"),
-                            (dst_tile, "INOUT"))
+            batch_stream.append((_copy_body, ((src_tile, "INPUT"),
+                                              (dst_tile, "INOUT"))))
+    dtp.insert_tasks(batch_stream)
     dtp.wait()
     dtp.destroy()  # tiles go before their transient Data backings
     for d in transients.values():

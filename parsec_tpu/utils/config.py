@@ -222,6 +222,17 @@ register("comm.inflight", 4, int,
          "inflight * chunk_size per pull while keeping the pipe full")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
+register("dtd.insert_batch", 256, int,
+         "tasks per native crossing for DtdTaskpool.insert_tasks: the "
+         "batched spec stream is chunked at this size so the window "
+         "throttle still engages mid-batch and the spec buffer stays "
+         "bounded; <= 1 degenerates to one crossing per task")
+register("sched.bypass", True, bool,
+         "same-worker ready-task bypass: a worker completing a task "
+         "executes its highest-priority ready successor directly, "
+         "skipping the schedule()+select() round trip (reference: "
+         "keep_highest_priority_task, parsec/scheduling.c:373-396).  "
+         "Bypass hits are counted per worker (Context.sched_stats)")
 register("device.dp_transfer", False, bool,
          "cross-process device data plane via jax.experimental.transfer: "
          "PK_DEVICE payloads between NON-colocated ranks are pulled "

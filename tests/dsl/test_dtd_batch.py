@@ -1,0 +1,122 @@
+"""Batched DTD insertion (ptc_dtask_insert_batch / insert_tasks): one
+native crossing per batch must discover the SAME dependence structure
+as per-task insert_task — access order is the stream order — while the
+insert_batches/insert_batched_tasks counters prove the amortized path
+actually ran."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.dsl import DtdTaskpool
+
+
+def test_batch_chain_matches_sequential():
+    """An INOUT chain inserted as one batch serializes exactly like the
+    per-task path (RAW/WAW ordering from the stream order)."""
+    with pt.Context(nb_workers=2) as ctx:
+        buf = np.zeros(1, dtype=np.int64)
+        d = ctx.data(0, buf)
+        dtd = DtdTaskpool(ctx)
+        t = dtd.tile_of(d)
+        NB = 200
+
+        def fold_k(v, k):
+            a = v.data(0, np.int64)
+            a[0] = (a[0] * 31 + k) % 1000003  # order-sensitive, bounded
+
+        n = dtd.insert_tasks(
+            [(lambda v, k=k: fold_k(v, k), ((t, "INOUT"),))
+             for k in range(NB)])
+        dtd.wait()
+        st = ctx.sched_stats()
+        dtd.destroy()
+    assert n == NB
+    # oracle: the same fold sequentially
+    acc = 0
+    for k in range(NB):
+        acc = (acc * 31 + k) % 1000003
+    assert buf[0] == acc
+    assert st["insert_batched_tasks"] == NB, st
+    assert st["insert_batches"] >= 1, st
+
+
+def test_batch_chunking_respects_batch_param():
+    """batch=16 chunks the stream into multiple native crossings (the
+    dtd.insert_batch knob's mechanism); results are unaffected."""
+    with pt.Context(nb_workers=2) as ctx:
+        buf = np.zeros(1, dtype=np.int64)
+        d = ctx.data(0, buf)
+        dtd = DtdTaskpool(ctx)
+        t = dtd.tile_of(d)
+
+        def add1(v):
+            v.data(0, np.int64)[0] += 1
+
+        n = dtd.insert_tasks([(add1, ((t, "INOUT"),))] * 100, batch=16)
+        dtd.wait()
+        st = ctx.sched_stats()
+        dtd.destroy()
+    assert n == 100 and buf[0] == 100
+    assert st["insert_batches"] == 7, st  # ceil(100/16)
+
+
+def test_batch_war_diamond():
+    """Readers + writer + readers in ONE batch: WAR/RAW edges derive
+    from within-batch order, same as test_dtd_war_readers_before_writer."""
+    with pt.Context(nb_workers=3) as ctx:
+        buf = np.array([5], dtype=np.int64)
+        d = ctx.data(0, buf)
+        seen = []
+        import threading
+        lock = threading.Lock()
+        dtd = DtdTaskpool(ctx)
+        t = dtd.tile_of(d)
+
+        def read(v):
+            with lock:
+                seen.append(int(v.data(0, np.int64)[0]))
+
+        def write(v):
+            v.data(0, np.int64)[0] = 99
+
+        stream = [(read, ((t, "INPUT"),)) for _ in range(10)]
+        stream.append((write, ((t, "INOUT"),)))
+        stream += [(read, ((t, "INPUT"),)) for _ in range(10)]
+        dtd.insert_tasks(stream)
+        dtd.wait()
+        dtd.destroy()
+    assert sorted(seen) == [5] * 10 + [99] * 10
+
+
+def test_batch_priority_and_too_many_args():
+    """Optional (fn, args, priority) tuples ride through; arg overflow
+    is rejected BEFORE anything reaches the native side."""
+    with pt.Context(nb_workers=1) as ctx:
+        bufs = [np.zeros(1, np.int64) for _ in range(2)]
+        ds = [ctx.data(i, b) for i, b in enumerate(bufs)]
+        dtd = DtdTaskpool(ctx)
+        tiles = [dtd.tile_of(d) for d in ds]
+
+        def bump(v):
+            v.data(0, np.int64)[0] += 1
+
+        assert dtd.insert_tasks(
+            [(bump, ((tiles[0], "INOUT"),), 5),
+             (bump, ((tiles[1], "INOUT"),), 0)]) == 2
+        with pytest.raises(ValueError, match="too many arguments"):
+            dtd.insert_tasks(
+                [(bump, tuple((tiles[0], "INPUT") for _ in range(25)))])
+        dtd.wait()
+        dtd.destroy()
+    assert bufs[0][0] == 1 and bufs[1][0] == 1
+
+
+def test_batch_on_closed_pool_raises():
+    with pt.Context(nb_workers=1) as ctx:
+        d = ctx.data(0, np.zeros(1, np.int64))
+        dtd = DtdTaskpool(ctx)
+        t = dtd.tile_of(d)
+        dtd.wait()
+        with pytest.raises(RuntimeError, match="closed"):
+            dtd.insert_tasks([(lambda v: None, ((t, "INPUT"),))])
+        dtd.destroy()

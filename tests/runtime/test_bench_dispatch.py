@@ -1,0 +1,79 @@
+"""Dispatch-suite schema smoke (mirror of tests/comm/
+test_transfer_economics.py for rung 1): `bench.py --dispatch --json`
+must run at small task counts and emit the schema `make bench-dispatch`
+commits to BENCH_dispatch.json — single-chain AND contended percentiles
+with sched_stats evidence and the honest cpu-count provenance."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+_PCTL_KEYS = {"p50_us", "p99_us", "tasks", "reps", "workers",
+              "sched_stats"}
+_STATS_KEYS = {"bypass_hits", "bypass_enabled", "freelist_hits",
+               "freelist_misses", "arena_hits", "arena_misses",
+               "insert_batches", "insert_batched_tasks", "inject_pushes",
+               "inject_pops", "steals", "executed"}
+
+
+def test_dispatch_suite_schema(tmp_path):
+    out = tmp_path / "dispatch.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, _BENCH, "--dispatch", "--json", str(out),
+           "--tasks", "2000", "--mt-tasks", "600", "--reps", "2"]
+    res = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+
+    # driver contract: the one-line JSON still lands on stdout
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "task_dispatch_p50"
+    assert line["value"] > 0
+
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["bench"] == "dispatch"
+    assert doc["host"]["cpu_count"] == os.cpu_count()
+    assert doc["budget_us"] == 5.0
+
+    single = doc["single_chain"]
+    assert _PCTL_KEYS <= set(single), single.keys()
+    assert 0 < single["p50_us"] <= single["p99_us"]
+    assert _STATS_KEYS <= set(single["sched_stats"])
+    # acceptance: the bypass fires on the Ex04-style chain
+    assert single["sched_stats"]["bypass_hits"] > 0, single["sched_stats"]
+
+    mt = doc["contended"]
+    assert _PCTL_KEYS <= set(mt), mt.keys()
+    # the r5 caveat, machine-readable: cpu_count + effective workers
+    # recorded, and workers > cores is FLAGGED, not silently reported
+    assert mt["cpu_count"] == os.cpu_count()
+    assert mt["workers"] >= 1 and mt["lanes"] >= 1
+    assert mt["oversubscribed"] == (mt["workers"] > mt["cpu_count"])
+    if mt["oversubscribed"]:
+        assert "caveat" in mt and "timeshare" in mt["caveat"]
+        assert "WARNING" in res.stderr
+
+
+def test_dispatch_mt_line_records_host(tmp_path):
+    """The standalone --dispatch-mt driver line carries the same
+    provenance fields."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, _BENCH, "--dispatch-mt"], cwd=_REPO, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "task_dispatch_mt_p50"
+    cfg = line["config"]
+    assert cfg["cpu_count"] == os.cpu_count()
+    assert {"workers", "workers_requested", "lanes",
+            "oversubscribed"} <= set(cfg)
+    if cfg["oversubscribed"]:
+        assert "caveat" in line

@@ -1,0 +1,96 @@
+"""Context.sched_stats(): the dispatch fast path's observability
+contract.  The bypass/freelist/inject counters are the acceptance
+evidence for the lock-free task lifecycle — if they stop ticking, the
+fast path silently stopped running (a perf regression no correctness
+test would catch)."""
+import os
+import subprocess
+import sys
+
+import parsec_tpu as pt
+from .chain_util import chain_task_class
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run_chain(n=600, workers=1):
+    with pt.Context(nb_workers=workers) as ctx:
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": n})
+        tc = chain_task_class(tp)
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        return ctx.sched_stats()
+
+
+def test_bypass_fires_on_chain():
+    """Ex04-style chain: every steady-state successor must ride the
+    same-worker bypass (acceptance criterion: > 0 hits; in practice
+    n-1 of n)."""
+    st = _run_chain()
+    assert st["bypass_enabled"]
+    assert st["bypass_hits"] > 0, st
+    assert sum(st["executed"]) == 601
+
+
+def test_task_freelist_magazines_hit():
+    """Steady-state chain tasks recycle through the per-worker magazine:
+    after the first magazine fill, every alloc is a hit (no free_lock)."""
+    st = _run_chain()
+    assert st["freelist_hits"] > 500, st
+    assert st["freelist_misses"] <= 100, st
+
+
+def test_sched_stats_exports_steals_and_executed():
+    """The per-worker steal counters collected since r5 are finally
+    readable from Python through the same stats call."""
+    st = _run_chain(workers=2)
+    assert isinstance(st["steals"], list) and len(st["steals"]) == 2
+    assert isinstance(st["executed"], list) and len(st["executed"]) == 2
+
+
+def test_sched_stats_before_start():
+    """A fresh context (scheduler not yet installed) must report zeros,
+    not crash on the missing scheduler."""
+    with pt.Context(nb_workers=1) as ctx:
+        st = ctx.sched_stats()
+        assert st["bypass_hits"] == 0
+        assert st["inject_pushes"] == 0
+
+
+def test_lws_inject_counted():
+    """Startup tasks are scheduled by the MAIN thread — external
+    producers to the lws inject MPSC queue; pushes and pops must
+    balance once the pool drained."""
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        tp = pt.Taskpool(ctx, globals={"N": 50})
+        tc = tp.task_class("Ep")
+        tc.param("k", 0, pt.G("N"))
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        st = ctx.sched_stats()
+    assert st["inject_pushes"] > 0, st
+    assert st["inject_pops"] == st["inject_pushes"], st
+
+
+def test_unknown_scheduler_warns_once():
+    """ptc_sched_canonical must name the requested and resolved module
+    on stderr, once per process — a typo in PTC_MCA_sched used to fall
+    back to lfq in complete silence.  Subprocess: the warning is
+    one-shot and other tests in this process may have consumed it."""
+    code = (
+        "import parsec_tpu as pt\n"
+        "c1 = pt.Context(nb_workers=1, scheduler='bogus')\n"
+        "assert c1.scheduler_name == 'lfq'\n"
+        "c2 = pt.Context(nb_workers=1, scheduler='bogus2')\n"
+        "c1.destroy(); c2.destroy()\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, "-c", code], cwd=_REPO, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stderr.count("unknown scheduler module") == 1, res.stderr
+    assert "'bogus'" in res.stderr and "'lfq'" in res.stderr, res.stderr
