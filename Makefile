@@ -41,4 +41,12 @@ bench-comm: $(LIB)
 bench-dispatch: $(LIB)
 	python bench.py --dispatch --json BENCH_dispatch.json
 
-.PHONY: all clean tsan bench-comm bench-dispatch
+# Device-pipeline suite (bench.py --device --json): staged-vs-prefetched
+# wave dispatch (per-wave h2d stall off the DEVICE span aux, overlap
+# fraction from paired DEVICE/H2D spans) + the 2x-budget out-of-core
+# GEMM, with host provenance and an oversubscription flag.  Runs on the
+# CPU jax backend — no TPU needed.
+bench-device: $(LIB)
+	python bench.py --device --json BENCH_device.json
+
+.PHONY: all clean tsan bench-comm bench-dispatch bench-device

@@ -275,8 +275,11 @@ struct CommEngine {
   std::atomic<bool> running{false};
   std::atomic<bool> stop{false};
 
-  std::mutex lock; /* protects tcp out-queues + fence + rendezvous state */
-  std::condition_variable fence_cv;
+  /* ptc_mutex, not std::mutex: explicit pthread init/destroy keeps
+   * TSan's per-address mutex state fresh across sequential jobs that
+   * heap-recycle engine addresses (see runtime_internal.h) */
+  ptc_mutex lock; /* protects tcp out-queues + fence + rendezvous state */
+  ptc_condvar fence_cv;
   uint64_t fence_next = 1; /* next generation to issue */
   /* per-peer fence progress (generic across transports) */
   std::vector<uint64_t> fence_gen; /* highest generation received */
@@ -361,7 +364,7 @@ struct CommEngine {
  * fence and the counting-termdet waves so their timeout/liveness
  * behavior can never diverge. */
 template <typename HaveRank>
-static int wave_wait(CommEngine *ce, std::unique_lock<std::mutex> &g,
+static int wave_wait(CommEngine *ce, std::unique_lock<ptc_mutex> &g,
                      const HaveRank &have_rank) {
   bool lost = false;
   auto ready = [&] {
@@ -439,7 +442,7 @@ static void comm_post(CommEngine *ce, uint32_t rank,
     /* activity ticks before the transport enqueues: a fence snapshot
      * must never see the queued frame but miss the count (the transport
      * post takes ce->lock, so the snapshot orders after the tick) */
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     ce->activity.fetch_add(1, std::memory_order_relaxed);
     ce->app_sent.fetch_add(1, std::memory_order_relaxed);
   }
@@ -558,7 +561,7 @@ static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
                !(pg.pk == PK_DEVICE && can_pull);
   std::vector<std::vector<uint8_t>> frames;
   {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     if (peer_lost_locked(ce, from)) {
       std::fprintf(stderr, "ptc-comm: not pulling from lost rank %u; "
                            "delivery dropped\n", from);
@@ -1235,7 +1238,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     std::vector<uint8_t> cf;
     ptc_copy *rel = nullptr;
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       if (ce->tokened.count({from, cookie}))
         return; /* pull completed by token */
       auto cs = ce->chunk_serves.find({from, cookie});
@@ -1278,7 +1281,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
 
   uint8_t pk = PK_GET;
   {
-    std::unique_lock<std::mutex> g(ce->lock);
+    std::unique_lock<ptc_mutex> g(ce->lock);
     if (chunked && ce->tokened.count({from, cookie})) return;
     auto it = ce->mem_reg.find(src_handle);
     if (it == ce->mem_reg.end()) {
@@ -1368,7 +1371,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     bool finish = clen >= total;
     ptc_copy *rel = nullptr;
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       if (!finish) {
         ChunkServe s;
         s.handle = src_handle;
@@ -1399,7 +1402,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
   frame_finish(f);
   ptc_copy *rel = nullptr;
   {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     rel = retire_pull_locked(ce, src_handle, from);
     if (chunked) /* token answered a chunked pull: absorb its window */
       remember_tokened_locked(ce, from, cookie);
@@ -1440,7 +1443,7 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
     if (tag > 0) {
       size_t excess = 0;
       {
-        std::lock_guard<std::mutex> g(ce->lock);
+        std::lock_guard<ptc_mutex> g(ce->lock);
         fh = (uint64_t)tag | DP_HANDLE_FLAG;
         MemReg &m = ce->mem_reg[fh];
         m.pk = PK_DEVICE;
@@ -1452,7 +1455,7 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
         if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
       fpk = (excess == rchildren.size()) ? 0 : PK_DEVICE;
     } else if (plen == real_len) {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       MemReg m;
       m.pk = PK_GET;
       reg_live_children(ce, m, rchildren);
@@ -1500,7 +1503,7 @@ static void handle_put_data_body(CommEngine *ce, const uint8_t *body,
   }
   PendingGet pg;
   {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     auto it = ce->pending_gets.find(cookie);
     if (it == ce->pending_gets.end()) {
       std::fprintf(stderr, "ptc-comm: PUT_DATA for unknown cookie %llu "
@@ -1532,7 +1535,7 @@ static void handle_put_chunk_body(CommEngine *ce, const uint8_t *body,
   uint32_t src = 0;
   std::vector<uint8_t> next; /* the next ranged GET, if any */
   {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     auto it = ce->pending_gets.find(cookie);
     if (it == ce->pending_gets.end()) {
       std::fprintf(stderr, "ptc-comm: PUT_CHUNK for unknown cookie %llu "
@@ -1678,7 +1681,7 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     uint64_t gen = r.u64();
     uint8_t dirty = r.u8();
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       if (gen > ce->fence_gen[from]) ce->fence_gen[from] = gen;
       ce->fence_dirty[from][gen] = dirty;
     }
@@ -1693,7 +1696,7 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     rec.recv = r.u64();
     rec.idle = r.u8();
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       ce->td_info[from][gen] = rec;
     }
     ce->fence_cv.notify_all();
@@ -1701,7 +1704,7 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   }
   case MSG_FINI: {
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       if (from < ce->fin_seen.size()) ce->fin_seen[from] = 1;
     }
     ce->fence_cv.notify_all();
@@ -1754,7 +1757,7 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
   size_t dropped_pulls = 0;
   bool fin_ok;
   {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     ce->peer_lost[rank] = 1;
     /* EOF after the peer's FIN is the clean-teardown handshake, not a
      * loss: stay silent (peer_lost still set so any stray later wave
@@ -1887,7 +1890,7 @@ static void comm_main(CommEngine *ce) {
       if (stop_deadline == 0) stop_deadline = ptc_now_ns() + 5000000000ll;
       bool pending = false;
       {
-        std::lock_guard<std::mutex> g(ce->lock);
+        std::lock_guard<ptc_mutex> g(ce->lock);
         for (TcpPeer &p : tt.peers)
           if (p.fd >= 0 && !p.out.empty()) pending = true;
       }
@@ -1898,7 +1901,7 @@ static void comm_main(CommEngine *ce) {
     pfds.push_back({tt.wake_pipe[0], POLLIN, 0});
     pfd_rank.push_back(UINT32_MAX);
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       for (uint32_t r = 0; r < ce->nodes; r++) {
         TcpPeer &p = tt.peers[r];
         if (p.fd < 0) continue;
@@ -1945,7 +1948,7 @@ static void comm_main(CommEngine *ce) {
         if (p.fd >= 0) parse_inbuf(ce, r);
       }
       if (p.fd >= 0 && (pfds[i].revents & POLLOUT)) {
-        std::unique_lock<std::mutex> g(ce->lock);
+        std::unique_lock<ptc_mutex> g(ce->lock);
         while (!p.out.empty()) {
           std::vector<uint8_t> &f = p.out.front();
           size_t todo = f.size() - p.out_off;
@@ -2028,7 +2031,7 @@ static void tcp_wake(CommEngine *ce) {
 static void tcp_post(CommEngine *ce, uint32_t rank,
                      std::vector<uint8_t> &&frame) {
   {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     ce->tcp.peers[rank].out.push_back(std::move(frame));
   }
   tcp_wake(ce);
@@ -2246,7 +2249,7 @@ void ptc_comm_send_activate_batch(
     /* dead target: drop the activation (the fence reports the loss);
      * checked under ce->lock so a registration below can never slip in
      * after mark_peer_lost's reap */
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     if (peer_lost_locked(ce, rank)) return;
   }
   bool has_payload = copy && copy->ptr && copy->size > 0;
@@ -2287,7 +2290,7 @@ void ptc_comm_send_activate_batch(
     uint64_t dp_h = (uint64_t)dp_tag | DP_HANDLE_FLAG;
     bool lost;
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       lost = peer_lost_locked(ce, rank);
       if (!lost) {
         MemReg &m = ce->mem_reg[dp_h];
@@ -2312,7 +2315,7 @@ void ptc_comm_send_activate_batch(
       ptc_copy_sync_for_host(ctx, copy); /* coherence before snapshot */
     uint64_t h;
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       if (peer_lost_locked(ce, rank)) return; /* raced with the loss */
       bool found = false;
       if (is_packed) {
@@ -2459,7 +2462,7 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
       uint64_t dp_h = (uint64_t)tag | DP_HANDLE_FLAG;
       size_t excess = 0;
       {
-        std::lock_guard<std::mutex> g(ce->lock);
+        std::lock_guard<ptc_mutex> g(ce->lock);
         MemReg &m = ce->mem_reg[dp_h];
         m.pk = PK_DEVICE;
         excess = reg_live_children(ce, m, children);
@@ -2481,7 +2484,7 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
        * other broadcasts of the same copy): one mem_by_copy entry, one
        * byte buffer, expected bumped per pull.  Packed sends register a
        * layout-specific snapshot (no cross-dep sharing). */
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       bool found = false;
       if (is_packed) {
         auto itp = ce->mem_by_packed.find({copy, send_dtype});
@@ -2700,7 +2703,7 @@ static void calibrate_eager_limit(CommEngine *ce) {
     }
   }
   {
-    std::unique_lock<std::mutex> g(ce->lock);
+    std::unique_lock<ptc_mutex> g(ce->lock);
     ce->fence_cv.wait_for(g, std::chrono::seconds(2), [&] {
       return ce->pongs.load(std::memory_order_relaxed) >=
                  ce->nodes - 1 ||
@@ -2799,7 +2802,7 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
     uint64_t gen;
     uint8_t mydirty;
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       gen = ce->fence_next++;
       uint64_t act = ce->activity.load(std::memory_order_relaxed);
       /* in-flight rendezvous keeps the fence looping: a pulled payload
@@ -2821,7 +2824,7 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
     }
     bool any_dirty = mydirty != 0;
     {
-      std::unique_lock<std::mutex> g(ce->lock);
+      std::unique_lock<ptc_mutex> g(ce->lock);
       int rc = wave_wait(ce, g, [&](uint32_t r) {
         return ce->fence_gen[r] >= gen && ce->fence_dirty[r].count(gen);
       });
@@ -2885,7 +2888,7 @@ int32_t ptc_comm_quiesce(ptc_context_t *ctx, ptc_taskpool_t *tp) {
     uint64_t gen;
     CommEngine::TdRec mine;
     {
-      std::lock_guard<std::mutex> g(ce->lock);
+      std::lock_guard<ptc_mutex> g(ce->lock);
       gen = ce->td_next++;
       mine.sent = ce->app_sent.load(std::memory_order_relaxed);
       mine.recv = ce->app_recv.load(std::memory_order_relaxed);
@@ -2916,7 +2919,7 @@ int32_t ptc_comm_quiesce(ptc_context_t *ctx, ptc_taskpool_t *tp) {
     uint64_t sum_sent = mine.sent, sum_recv = mine.recv;
     bool all_idle = mine.idle != 0;
     {
-      std::unique_lock<std::mutex> g(ce->lock);
+      std::unique_lock<ptc_mutex> g(ce->lock);
       int rc = wave_wait(ce, g, [&](uint32_t r) {
         return ce->td_info[r].count(gen) != 0;
       });
@@ -2979,7 +2982,7 @@ int32_t ptc_comm_fini(ptc_context_t *ctx) {
       comm_post(ce, r, std::move(f));
     }
     int64_t budget_s = ce->fence_timeout_s > 0 ? ce->fence_timeout_s : 30;
-    std::unique_lock<std::mutex> g(ce->lock);
+    std::unique_lock<ptc_mutex> g(ce->lock);
     ce->fence_cv.wait_for(g, std::chrono::seconds(budget_s), [&] {
       if (ce->stop.load(std::memory_order_acquire)) return true;
       for (uint32_t r = 0; r < ce->nodes; r++) {
@@ -3012,7 +3015,7 @@ void ptc_comm_rdv_stats(ptc_context_t *ctx, int64_t *out4) {
   out4[2] = ce ? (int64_t)ce->mem_reg_bytes.load() : 0;
   int64_t pend = 0;
   if (ce) {
-    std::lock_guard<std::mutex> g(ce->lock);
+    std::lock_guard<ptc_mutex> g(ce->lock);
     pend = (int64_t)ce->pending_gets.size();
   }
   out4[3] = pend;

@@ -2265,7 +2265,7 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
     DeviceQueue *q = ctx->dev_queues[(size_t)dx->body_arg];
     q->depth.fetch_add(1, std::memory_order_relaxed);
     {
-      std::lock_guard<std::mutex> g(q->lock);
+      std::lock_guard<ptc_mutex> g(q->lock);
       q->dq.push_back(t);
     }
     q->cv.notify_one();
@@ -2408,7 +2408,7 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
       DeviceQueue *q = ctx->dev_queues[(size_t)ch.body_arg];
       q->depth.fetch_add(1, std::memory_order_relaxed);
       {
-        std::lock_guard<std::mutex> g(q->lock);
+        std::lock_guard<ptc_mutex> g(q->lock);
         q->dq.push_back(t);
       }
       q->cv.notify_one();
@@ -2516,7 +2516,7 @@ static void worker_main(ptc_context *ctx, int worker) {
       std::this_thread::yield();
       continue;
     }
-    std::unique_lock<std::mutex> lk(ctx->idle_lock);
+    std::unique_lock<ptc_mutex> lk(ctx->idle_lock);
     int64_t sig = ctx->work_signal.load(std::memory_order_acquire);
     ctx->idle_cv.wait_for(lk, std::chrono::milliseconds(1), [&] {
       return ctx->shutdown.load(std::memory_order_acquire) ||
@@ -3542,7 +3542,7 @@ void ptc_device_set_affinity_skew(ptc_context_t *ctx, double skew) {
 
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) {
   DeviceQueue *q = ctx->dev_queues[(size_t)qid];
-  std::unique_lock<std::mutex> lk(q->lock);
+  std::unique_lock<ptc_mutex> lk(q->lock);
   if (q->dq.empty()) {
     q->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
       return !q->dq.empty() || ctx->shutdown.load();
@@ -3552,6 +3552,67 @@ ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms) 
   ptc_task *t = q->dq.front();
   q->dq.pop_front();
   return t;
+}
+
+/* Ready-peek for the device prefetch lane (span-based like the
+ * release->deliver path: flat caller buffer, no heap traffic).
+ * Snapshots tasks still QUEUED on `qid` — ready, every input final —
+ * WITHOUT popping, so the prefetch lane can stage the NEXT wave's h2d
+ * while the manager computes the current one.  Per task:
+ *   [task_ref, n_copies, (copy_ptr, data_ptr, size, version) * n]
+ * task_ref is an opaque wave-grouping key — the task may be popped,
+ * executed and recycled the moment the queue lock drops, so it must
+ * never be dereferenced.  Each emitted copy is RETAINED under the
+ * queue lock (its host bytes outlive the task even if the wave
+ * completes mid-stage); the caller MUST ptc_copy_unpin every copy_ptr
+ * exactly once.  Only READ data flows are emitted (CTL and write-only
+ * flows stage nothing); DTD shadow tasks are skipped. */
+int64_t ptc_peek_ready(ptc_context_t *ctx, int32_t qid, int64_t *out,
+                       int64_t max_words, int32_t max_tasks) {
+  if (!ctx || !out || qid < 0 || (size_t)qid >= ctx->dev_queues.size())
+    return 0;
+  DeviceQueue *q = ctx->dev_queues[(size_t)qid];
+  int64_t w = 0;
+  int32_t n = 0;
+  std::lock_guard<ptc_mutex> g(q->lock);
+  for (ptc_task *t : q->dq) {
+    if (n >= max_tasks) break;
+    if (w + 2 + 4 * PTC_MAX_FLOWS > max_words) break;
+    if (t->dyn && t->dyn->shadow) continue;
+    int64_t hdr = w;
+    out[w++] = (int64_t)(intptr_t)t;
+    out[w++] = 0;
+    int64_t nc = 0;
+    int32_t nflows = t->dyn ? t->dyn->nb_flows
+                            : (int32_t)t->tp->classes[(size_t)t->class_id]
+                                  .flows.size();
+    for (int32_t f = 0; f < nflows; f++) {
+      if (t->dyn) {
+        if (!(t->dyn->modes[f] & PTC_DTD_INPUT)) continue;
+      } else {
+        const Flow &fl =
+            t->tp->classes[(size_t)t->class_id].flows[(size_t)f];
+        if (!(fl.flags & PTC_FLOW_READ) || (fl.flags & PTC_FLOW_CTL))
+          continue;
+      }
+      ptc_copy *c = t->data[f];
+      if (!c || !c->ptr || c->size <= 0) continue;
+      ptc_copy_retain(c);
+      out[w++] = (int64_t)(intptr_t)c;
+      out[w++] = (int64_t)(intptr_t)c->ptr;
+      out[w++] = c->size;
+      out[w++] = c->version.load(std::memory_order_acquire);
+      nc++;
+    }
+    out[hdr + 1] = nc;
+    n++;
+  }
+  return w;
+}
+
+/* drop one ptc_peek_ready pin (the copy frees if this was the last ref) */
+void ptc_copy_unpin(ptc_context_t *ctx, ptc_copy_t *c) {
+  if (ctx && c) ptc_copy_release_internal(ctx, c);
 }
 
 /* depth bookkeeping for load balancing: resolve which device queue an

@@ -310,6 +310,17 @@ void ptc_device_queue_set_weight(ptc_context_t *ctx, int32_t qid, double w);
 int64_t ptc_device_queue_depth(ptc_context_t *ctx, int32_t qid);
 /* blocking pop with timeout (ms); NULL on timeout or shutdown */
 ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms);
+/* Ready-peek span for the device prefetch lane: snapshot up to
+ * `max_tasks` tasks still queued on `qid` WITHOUT popping.  Per task the
+ * flat buffer receives
+ *   [task_ref, n_copies, (copy_ptr, data_ptr, size, version) * n]
+ * with one record per READ data flow.  task_ref is an opaque grouping
+ * key — never dereference it (the task may be popped and recycled at
+ * any moment).  Emitted copies are retained; the caller MUST
+ * ptc_copy_unpin each copy_ptr exactly once.  Returns words written. */
+int64_t ptc_peek_ready(ptc_context_t *ctx, int32_t qid, int64_t *out,
+                       int64_t max_words, int32_t max_tasks);
+void ptc_copy_unpin(ptc_context_t *ctx, ptc_copy_t *copy);
 /* data-affinity routing (reference: parsec_get_best_device's
  * owner_device/preferred_device pass, device.c:100-117, before the load
  * pass at :129-160).  The device layer stamps which queue holds a

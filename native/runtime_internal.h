@@ -14,6 +14,7 @@
 #include "parsec_core.h"
 
 #include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -21,11 +22,87 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <pthread.h>
 #include <string>
 #include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
+
+/* ------------------------------------------------------------------ */
+/* sanitizer-correct mutex                                             */
+/* ------------------------------------------------------------------ */
+
+/* glibc's std::mutex is zero-initialized and has a trivial destructor:
+ * pthread_mutex_init/destroy are NEVER called, and ThreadSanitizer keys
+ * mutex sync state by ADDRESS.  When sequential jobs in one process
+ * heap-recycle a context/comm-engine address, the old object's free
+ * marks the mutex at that offset "destroyed"; the next object's first
+ * lock at the same address then reports "double lock of a mutex
+ * (already destroyed)" and — with the lock's happens-before voided —
+ * phantom data races on every field it guards (the 7 comm-fini
+ * teardown warnings of the PR 2 TSan soak).  Explicit
+ * pthread_mutex_init/destroy give each object's mutex a fresh TSan
+ * identity.  Lockable, so std::lock_guard/std::unique_lock work;
+ * condition variables on it use the ptc_condvar companion below. */
+class ptc_mutex {
+  pthread_mutex_t m_;
+
+public:
+  ptc_mutex() { pthread_mutex_init(&m_, nullptr); }
+  ~ptc_mutex() { pthread_mutex_destroy(&m_); }
+  ptc_mutex(const ptc_mutex &) = delete;
+  ptc_mutex &operator=(const ptc_mutex &) = delete;
+  void lock() { pthread_mutex_lock(&m_); }
+  bool try_lock() { return pthread_mutex_trylock(&m_) == 0; }
+  void unlock() { pthread_mutex_unlock(&m_); }
+  pthread_mutex_t *native() { return &m_; }
+};
+
+/* Companion condvar: std::condition_variable_any is NOT a substitute —
+ * it guards its own state with an internal make_shared<std::mutex>()
+ * whose 56-byte block recycles across engines exactly like the outer
+ * object, re-creating the aliasing the wrapper exists to kill.
+ * pthread_cond_init/destroy are TSan-visible; timed waits run on
+ * CLOCK_MONOTONIC so a wall-clock step cannot stretch a fence budget. */
+class ptc_condvar {
+  pthread_cond_t c_;
+
+public:
+  ptc_condvar() {
+    pthread_condattr_t a;
+    pthread_condattr_init(&a);
+    pthread_condattr_setclock(&a, CLOCK_MONOTONIC);
+    pthread_cond_init(&c_, &a);
+    pthread_condattr_destroy(&a);
+  }
+  ~ptc_condvar() { pthread_cond_destroy(&c_); }
+  ptc_condvar(const ptc_condvar &) = delete;
+  ptc_condvar &operator=(const ptc_condvar &) = delete;
+  void notify_one() { pthread_cond_signal(&c_); }
+  void notify_all() { pthread_cond_broadcast(&c_); }
+  template <class Pred>
+  void wait(std::unique_lock<ptc_mutex> &lk, Pred pred) {
+    while (!pred()) pthread_cond_wait(&c_, lk.mutex()->native());
+  }
+  template <class Rep, class Period, class Pred>
+  bool wait_for(std::unique_lock<ptc_mutex> &lk,
+                const std::chrono::duration<Rep, Period> &d, Pred pred) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    int64_t ns =
+        (int64_t)ts.tv_nsec +
+        std::chrono::duration_cast<std::chrono::nanoseconds>(d).count();
+    ts.tv_sec += ns / 1000000000;
+    ts.tv_nsec = ns % 1000000000;
+    while (!pred()) {
+      if (pthread_cond_timedwait(&c_, lk.mutex()->native(), &ts) ==
+          ETIMEDOUT)
+        return pred();
+    }
+    return true;
+  }
+};
 
 /* ------------------------------------------------------------------ */
 /* expressions                                                         */
@@ -479,8 +556,8 @@ Scheduler *ptc_sched_create(const std::string &name);
 /* ------------------------------------------------------------------ */
 
 struct DeviceQueue {
-  std::mutex lock;
-  std::condition_variable cv;
+  ptc_mutex lock;
+  ptc_condvar cv;
   std::deque<ptc_task *> dq;
   /* load-balancing inputs (reference: parsec_get_best_device's
    * flop-rate weights + per-device load, parsec/mca/device/device.c:79;
@@ -596,8 +673,8 @@ struct ptc_context {
   int64_t dense_max_slots = 1 << 22;
 
   /* idle-worker parking */
-  std::mutex idle_lock;
-  std::condition_variable idle_cv;
+  ptc_mutex idle_lock;
+  ptc_condvar idle_cv;
   std::atomic<int64_t> work_signal{0};
 
   /* registries */

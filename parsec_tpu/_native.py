@@ -220,6 +220,10 @@ _sigs = {
     "ptc_device_queue_set_weight": (None, [C.c_void_p, C.c_int32, C.c_double]),
     "ptc_device_queue_depth": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_device_pop": (C.c_void_p, [C.c_void_p, C.c_int32, C.c_int32]),
+    "ptc_peek_ready": (C.c_int64, [C.c_void_p, C.c_int32,
+                                   C.POINTER(C.c_int64), C.c_int64,
+                                   C.c_int32]),
+    "ptc_copy_unpin": (None, [C.c_void_p, C.c_void_p]),
     "ptc_device_set_data_owner": (None, [C.c_void_p, C.c_int64, C.c_int32,
                                          C.c_int32]),
     "ptc_device_clear_data_owner": (None, [C.c_void_p, C.c_int64,

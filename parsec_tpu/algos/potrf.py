@@ -411,7 +411,10 @@ def _build_panel_factorization(ctx: pt.Context, A: TwoDimBlockCyclic,
                             else None),
             dst_params=lambda v: (v.local("k"),),
             kernel=k_factor,
-            ops=lambda key: [np.asarray([key[0]], dtype=np.int32)])
+            ops=lambda key: [np.asarray([key[0]], dtype=np.int32)],
+            # KS is the pivot-index flow: constant per k and folded into
+            # ops (single-varying-input contract, see attach_epilogue)
+            const_flows=("KS",))
 
     fa.body(b_factor(nt, nb, pshp, dt))
     up.body(b_update(nt, nb, pshp, dt))

@@ -601,6 +601,53 @@ class Context:
     def device_pop(self, qid: int, timeout_ms: int = 100):
         return N.lib.ptc_device_pop(self._ptr, qid, timeout_ms)
 
+    def device_peek(self, qid: int, max_tasks: int = 64) -> list:
+        """Observational snapshot of the ready tasks queued on a device
+        queue (native ptc_peek_ready): [(task_ref, [(handle, size,
+        version), ...]), ...].  Test/tooling probe — the peek pins are
+        released before returning, so records must not be dereferenced;
+        the prefetch lane consumes the span directly and holds its pins
+        across the staging h2d."""
+        words = max_tasks * (2 + 4 * N.MAX_FLOWS)
+        buf = (C.c_int64 * words)()
+        n = N.lib.ptc_peek_ready(self._ptr, qid, buf, words, max_tasks)
+        out, w, pins = [], 0, []
+        while w + 2 <= n:
+            tref, nc = buf[w], buf[w + 1]
+            w += 2
+            recs = []
+            for _ in range(nc):
+                cptr, _dptr, size, ver = (buf[w], buf[w + 1], buf[w + 2],
+                                          buf[w + 3])
+                w += 4
+                pins.append(cptr)
+                recs.append((N.lib.ptc_copy_handle(cptr), size, ver))
+            out.append((tref, recs))
+        for cptr in pins:
+            N.lib.ptc_copy_unpin(self._ptr, cptr)
+        return out
+
+    def device_stats(self) -> dict:
+        """Aggregated device-pipeline counters across this context's
+        devices: prefetch hits/misses/staged bytes, reserve failures,
+        spill traffic, dispatch-time h2d stall, and the counter-level
+        overlap ratio — the fraction of h2d nanoseconds spent on the
+        prefetch lane (overlapping compute) rather than stalling a
+        dispatch.  Per-device info objects ride along under
+        "devices"."""
+        devs = [dev.info() for dev in self._devices]
+        keys = ("prefetch_staged", "prefetch_bytes", "prefetch_hits",
+                "prefetch_misses", "prefetch_wasted", "reserve_fails",
+                "spills", "spill_bytes", "h2d_stall_ns",
+                "prefetch_h2d_ns", "ooc_waits", "h2d_hits", "h2d_bytes",
+                "evictions")
+        agg = {k: sum(d["stats"].get(k, 0) for d in devs) for k in keys}
+        moved = agg["prefetch_h2d_ns"] + agg["h2d_stall_ns"]
+        agg["overlap_ratio"] = (
+            round(agg["prefetch_h2d_ns"] / moved, 4) if moved else 0.0)
+        agg["devices"] = devs
+        return agg
+
     def task_complete(self, task_ptr):
         N.lib.ptc_task_complete(self._ptr, task_ptr)
 

@@ -740,10 +740,17 @@ def _host_write(ent: "_CacheEnt", res: np.ndarray) -> None:
 
 class _CacheEnt:
     __slots__ = ("version", "arr", "nbytes", "dirty", "host", "persistent",
-                 "raw", "stack")
+                 "raw", "stack", "pf", "spilling")
 
     def __init__(self, version, arr, nbytes, dirty=False, host=None,
                  persistent=True, raw=False):
+        # pf: staged ahead of time by the prefetch lane, not consumed yet
+        # (cleared — and counted as a prefetch hit — at first stage-in)
+        self.pf = False
+        # spilling: picked by the residency planner for an out-of-core
+        # writeback+evict riding the writeback lane; the lane drops the
+        # entry only if it is still THIS object when the d2h lands
+        self.spilling = False
         self.version = version
         self.arr = arr
         self.nbytes = nbytes
@@ -765,7 +772,8 @@ class TpuDevice:
     """One TPU device (one jax device) with a manager thread."""
 
     def __init__(self, ctx: Context, jax_device=None, pipeline_depth: int = 16,
-                 cache_bytes: int = 4 << 30, autostart: bool = True):
+                 cache_bytes: int = 4 << 30, autostart: bool = True,
+                 prefetch: Optional[bool] = None):
         import jax  # deferred: tests may pin the platform first
         from collections import OrderedDict
         self._jax = jax
@@ -810,6 +818,29 @@ class TpuDevice:
         # dispatch, version-checked (see attach_epilogue)
         self._spec: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
+        # ---- device pipeline (prefetch lane + residency planner) ----
+        from ..utils import params as _mca
+        if prefetch is None:
+            prefetch = bool(_mca.get("device.prefetch"))
+        self._pf_enabled = prefetch
+        self._pf_depth = max(1, int(_mca.get("device.prefetch_depth")))
+        self._pf_slots_max = max(1, int(_mca.get("device.staging_slots")))
+        self._ooc = bool(_mca.get("device.out_of_core"))
+        self._overcommit = max(1.0, float(_mca.get("device.overcommit")))
+        # uids in the current ready-task lookahead: eviction under
+        # pressure prefers tiles OUTSIDE this set (they are not about to
+        # be consumed), and the planner never spills into it
+        self._pf_pin: set = set()
+        # bytes the prefetch lane has reserved but not yet installed:
+        # reservations keep the lane from staging the cache past budget
+        # and thrashing tiles the executing wave still needs
+        self._pf_reserved = 0
+        self._pf_lane = None  # _PrefetchLane once started
+        # dispatch-time h2d stall accumulator for the CURRENT dispatch
+        # call (manager thread only); emitted as the DEVICE span's aux,
+        # so the bench can tell prefetch-hit waves (aux == 0) from
+        # staged ones without a second event
+        self._disp_stall_ns = 0
         self._dbg(f"device up: {self.device} queue={self.qid} "
                   f"cache={cache_bytes >> 20}MiB batch<= {self.batch_max}")
         self._stop = threading.Event()
@@ -824,7 +855,14 @@ class TpuDevice:
                       "dp_recv_bytes": 0, "invalidations": 0,
                       "eager_gathers": 0, "fused_flows": 0,
                       "wb_tasks": 0, "f64_refused": 0,
-                      "spec_store": 0, "spec_hits": 0, "spec_misses": 0}
+                      "spec_store": 0, "spec_hits": 0, "spec_misses": 0,
+                      # device pipeline (prefetch lane + residency planner)
+                      "prefetch_staged": 0, "prefetch_bytes": 0,
+                      "prefetch_hits": 0, "prefetch_misses": 0,
+                      "prefetch_wasted": 0, "reserve_fails": 0,
+                      "spills": 0, "spill_bytes": 0,
+                      "h2d_stall_ns": 0, "prefetch_h2d_ns": 0,
+                      "ooc_waits": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -958,6 +996,7 @@ class TpuDevice:
 
     def _cache_put(self, uid, version, arr, nbytes, dirty=False, host=None,
                    persistent=True, raw=False):
+        spill = []
         with self._lock:
             old = self._cache.pop(uid, None)
             if old is not None:
@@ -971,13 +1010,24 @@ class TpuDevice:
             # route here instead of staging on a cold sibling
             N.lib.ptc_device_set_data_owner(self.ctx._ptr, uid,
                                             self.qid, version)
-            evict = []
-            if self._cache_used > self._cache_bytes:
+            # evict-under-pressure, preference order (reference: the
+            # clean-first reserve protocol of
+            # parsec_gpu_data_reserve_device_space, :864): clean tiles
+            # OUTSIDE the prefetch lookahead first — a pinned tile is
+            # about to be consumed and would be re-staged immediately —
+            # then clean lookahead tiles; dirty tiles never evict here
+            # (their device bytes are the only truth).
+            for only_unpinned in (True, False):
+                if self._cache_used <= self._cache_bytes:
+                    break
+                evict = []
                 for k, e in self._cache.items():
                     if self._cache_used <= self._cache_bytes:
                         break
                     if e.dirty or k == uid:
                         continue  # dirty entries are pinned until flushed
+                    if only_unpinned and k in self._pf_pin:
+                        continue
                     evict.append((k, e))
                     self._uncharge(e)
                 for k, e in evict:
@@ -985,6 +1035,130 @@ class TpuDevice:
                     self.stats["evictions"] += 1
                     N.lib.ptc_device_clear_data_owner(self.ctx._ptr, k,
                                                       self.qid)
+            if self._ooc and self._cache_used > self._cache_bytes:
+                spill = self._spill_pick_locked(uid)
+        if spill:
+            # out-of-core degrade: write the dirty mirrors back through
+            # the writeback lane (host becomes authoritative, entry
+            # evicted, re-staged on demand) instead of pinning HBM past
+            # budget until the pool OOMs — the panel-cyclic residency of
+            # the TPU distributed-LA paper (arXiv:2112.09017)
+            self._wb_q.put(("spill", [], spill))
+
+    def _spill_pick_locked(self, new_uid: int) -> list:
+        """Residency planner, out-of-core leg (caller holds self._lock):
+        pick dirty mirrors to spill through the writeback lane until the
+        projected usage is back under budget.  Only persistent
+        (collection-backed) entries qualify — a transient arena host
+        buffer can be freed by its last consumer while the d2h is in
+        flight — and lookahead-pinned tiles are skipped (they are about
+        to be consumed).  Entries are marked `spilling` so one pressure
+        wave cannot enqueue them twice."""
+        picked, projected = [], self._cache_used
+        for k, e in self._cache.items():
+            if projected <= self._cache_bytes:
+                break
+            if (not e.dirty or e.spilling or not e.persistent
+                    or e.host is None or k == new_uid
+                    or k in self._pf_pin):
+                continue
+            e.spilling = True
+            picked.append(k)
+            projected -= e.nbytes if e.stack is None else 0
+        return picked
+
+    def _spill_one(self, uid: int) -> None:
+        """Writeback-lane half of the spill: d2h the dirty mirror into
+        its host buffer, then evict — IF the entry is still the one the
+        planner picked (a re-put at a newer version since then must not
+        be dropped; its own pressure wave will handle it)."""
+        with self._lock:
+            ent = self._cache.get(uid)
+            if ent is None or not ent.spilling:
+                return
+        res = np.asarray(_conc(ent)) if ent.dirty else None  # blocking d2h
+        with self._lock:
+            cur = self._cache.get(uid)
+            if cur is not ent:
+                return
+            if res is not None and ent.dirty:
+                _host_write(ent, res)
+                ent.dirty = False
+                self.stats["d2h_bytes"] += int(res.nbytes)
+            del self._cache[uid]
+            self._uncharge(ent)
+            self.stats["spills"] += 1
+            self.stats["spill_bytes"] += int(ent.nbytes)
+            N.lib.ptc_device_clear_data_owner(self.ctx._ptr, uid, self.qid)
+
+    # ------------------------------------------------- prefetch lane seam
+    def _prefetch_reserve(self, nbytes: int) -> bool:
+        """Reserve byte budget BEFORE staging a lookahead tile (the
+        reserve half of the reserve/evict protocol): evicts clean
+        non-lookahead tiles if needed, never dirty ones and never the
+        lookahead itself.  A False means the working set does not fit —
+        the lane skips the tile and execution degrades to on-demand
+        (out-of-core) staging instead of thrashing."""
+        with self._lock:
+            budget = self._cache_bytes - self._pf_reserved - nbytes
+            if self._cache_used <= budget:
+                self._pf_reserved += nbytes
+                return True
+            evict = []
+            for k, e in self._cache.items():
+                if self._cache_used <= budget:
+                    break
+                if e.dirty or e.pf or k in self._pf_pin:
+                    continue
+                evict.append((k, e))
+                self._uncharge(e)
+            for k, e in evict:
+                del self._cache[k]
+                self.stats["evictions"] += 1
+                N.lib.ptc_device_clear_data_owner(self.ctx._ptr, k,
+                                                  self.qid)
+            if self._cache_used <= budget:
+                self._pf_reserved += nbytes
+                return True
+            self.stats["reserve_fails"] += 1
+            return False
+
+    def _prefetch_unreserve(self, nbytes: int) -> None:
+        with self._lock:
+            self._pf_reserved = max(0, self._pf_reserved - nbytes)
+
+    def _cache_put_prefetch(self, uid, version, arr, nbytes) -> bool:
+        """Install a prefetched raw (flat uint8) mirror and release its
+        reservation.  NEVER displaces an existing entry — the in-flight
+        wave may be mid-read, and a dirty entry is newer truth than the
+        host bytes this was staged from (the double-buffer discipline:
+        prefetch writes land only in empty slots).  Returns False when
+        the slot was taken since the peek (wasted stage, counted)."""
+        with self._lock:
+            self._pf_reserved = max(0, self._pf_reserved - nbytes)
+            if uid in self._cache:
+                self.stats["prefetch_wasted"] += 1
+                return False
+            ent = _CacheEnt(version, arr, nbytes, persistent=False,
+                            raw=True)
+            ent.pf = True
+            self._cache[uid] = ent
+            self._charge(ent)
+            self.stats["prefetch_staged"] += 1
+            self.stats["prefetch_bytes"] += int(nbytes)
+            N.lib.ptc_device_set_data_owner(self.ctx._ptr, uid,
+                                            self.qid, version)
+        return True
+
+    def _consume_pf(self, uid: int) -> bool:
+        """First stage-in of a prefetched tile: clear the flag (so the
+        hit counts once and the staging slot can recycle) and report."""
+        with self._lock:
+            ent = self._cache.get(uid)
+            if ent is not None and ent.pf:
+                ent.pf = False
+                return True
+        return False
 
     def _invalidate_siblings(self, uid: int) -> None:
         """Writer-side invalidation (MOESI 'owned' takeover): after this
@@ -1004,6 +1178,14 @@ class TpuDevice:
                     sib.stats["invalidations"] += 1
                     N.lib.ptc_device_clear_data_owner(self.ctx._ptr, uid,
                                                       sib.qid)
+
+    def set_cache_budget(self, nbytes: int) -> None:
+        """Retarget the device byte budget at runtime (ops lever for
+        multi-tenant hosts; tests use it to re-run one DAG resident vs
+        out-of-core).  The residency planner reacts at the next insert —
+        an over-budget cache evicts/spills then, not here."""
+        with self._lock:
+            self._cache_bytes = int(nbytes)
 
     def _cache_ent(self, uid, version) -> Optional["_CacheEnt"]:
         """Entry lookup without materializing _StackRefs (batched stage-in
@@ -1172,7 +1354,8 @@ class TpuDevice:
 
     def attach_epilogue(self, src_tc: TaskClass, dst_tc: TaskClass, tp,
                         src_flow: str, dst_in_flow: str, pick, dst_params,
-                        kernel: Callable, ops) -> None:
+                        kernel: Callable, ops,
+                        const_flows: Sequence[str] = ()) -> None:
         """Speculative cross-class fusion (the dispatch-economics lever
         for factor chains): when a wave of `src_tc` contains the lane
         whose output is `dst_tc`'s next input, compute `kernel` (the
@@ -1187,6 +1370,17 @@ class TpuDevice:
           dst_params(view)-> the same key computed on the dst side
           ops(key)        -> extra host operands for `kernel` (tiny)
 
+        SINGLE-VARYING-INPUT CONTRACT: the parked result was computed
+        from the src lane's output plus `ops(key)` ONLY — the hit path
+        version-checks just the `dst_in_flow` copy.  Every OTHER read
+        flow of `dst_tc` must therefore be constant over the fused
+        pair's lifetime and folded into `ops` (e.g. potrf/getrf's pivot
+        index flow), and must be DECLARED in `const_flows`; an
+        undeclared varying read flow would let a dst task complete from
+        a result computed without that input — silent wrong answers.
+        Raises ValueError for any dst read flow that is neither
+        `dst_in_flow` nor declared.
+
         Both classes must already be attach()ed to this device.
         Disable via PTC_DEVICE_EPILOGUE=0 (bench comparison)."""
         if os.environ.get("PTC_DEVICE_EPILOGUE", "1") == "0":
@@ -1195,6 +1389,17 @@ class TpuDevice:
         dst = self.bodies.get((id(tp), dst_tc.id))
         if src is None or dst is None:
             return  # not device-attached (e.g. f64 refusal): no fusion
+        uncovered = [f for f in dst.reads
+                     if f != dst_in_flow and f not in const_flows]
+        if uncovered:
+            raise ValueError(
+                f"attach_epilogue({getattr(src_tc, 'name', '?')} -> "
+                f"{getattr(dst_tc, 'name', '?')}): dst read flow(s) "
+                f"{uncovered} are neither dst_in_flow nor declared in "
+                "const_flows.  The parked result is computed from the "
+                "src lane + ops alone; a varying undeclared input would "
+                "complete dst tasks with stale data (single-varying-"
+                "input contract — see docstring)")
         epi = _Epilogue((id(tp), dst_tc.id), kernel, pick, dst_params,
                         ops, src_flow, dst_in_flow, len(dst.writes))
         src.epilogue = epi
@@ -1235,6 +1440,11 @@ class TpuDevice:
                                            daemon=True,
                                            name="ptc-tpu-writeback")
         self._wb_thread.start()
+        if self._pf_enabled:
+            from .prefetch import _PrefetchLane
+            self._pf_lane = _PrefetchLane(self, depth=self._pf_depth,
+                                          slots=self._pf_slots_max)
+            self._pf_lane.start()
 
     def _wb_loop(self):
         """Writeback lane: materialize deferred mem-out d2h, then
@@ -1255,6 +1465,10 @@ class TpuDevice:
                         res = np.asarray(ostack[:len(uids)])  # one d2h
                         for i, uid in enumerate(uids):
                             self._wb_write(uid, ostack, i, res[i])
+                elif kind == "spill":
+                    # out-of-core residency: d2h + evict (see _spill_one)
+                    for uid in payload:
+                        self._spill_one(uid)
                 else:
                     for uid in payload:
                         self.sync_handle(uid)
@@ -1304,6 +1518,11 @@ class TpuDevice:
         """Flush dirty mirrors and stop the manager (idempotent)."""
         if self._stop.is_set():
             return
+        # prefetch lane first: it peeks the native queue and pins copies,
+        # so it must be quiesced before the context can tear down
+        if self._pf_lane is not None:
+            self._pf_lane.stop()
+            self._pf_lane = None
         self.flush()
         self._stop.set()
         if self._thread:
@@ -1348,6 +1567,16 @@ class TpuDevice:
             task = self.ctx.device_pop(self.qid, timeout_ms=50)
             if not task:
                 continue
+            if self._ooc and self._cache_used > \
+                    self._cache_bytes * self._overcommit:
+                # out-of-core hard cap: spills ride the writeback lane,
+                # so usage can transiently overshoot budget; past
+                # overcommit * budget the pipeline drains the lane
+                # between waves — bounded residency, the panel-cyclic
+                # throttle point (racy read: an approximate trigger is
+                # fine, the barrier itself is exact)
+                self._stats_add("ooc_waits", 1)
+                self._wb_barrier()
             batch = [task]
             while len(batch) < self.batch_max:
                 t2 = self.ctx.device_pop(self.qid, timeout_ms=0)
@@ -1422,6 +1651,8 @@ class TpuDevice:
                                     body.shapes.get(flow))
         if arr is not None:
             self.stats["h2d_hits"] += 1
+            if self._consume_pf(uid):
+                self.stats["prefetch_hits"] += 1
             return arr
         # D2D: a sibling device of this context may hold the current
         # mirror — stage device-to-device over the fabric instead of
@@ -1439,6 +1670,15 @@ class TpuDevice:
                 return darr
         host = view.data(flow, dtype=body.dtypes[flow],
                          shape=body.shapes.get(flow), sync=False)
+        # cold staging: a synchronous h2d ON the dispatch critical path —
+        # exactly the stall the prefetch lane exists to absorb.  Timed
+        # (h2d_stall_ns + the wave's DEVICE-span aux) and traced as a
+        # dispatch-lane H2D span so the bench can pair it against
+        # compute spans for the overlap fraction.
+        from ..profiling.trace import KEY_H2D
+        t0 = time.perf_counter_ns()
+        N.lib.ptc_prof_event(self.ctx._ptr, KEY_H2D, 0, -1,
+                             host.nbytes, self.qid, 0)
         # OWNED snapshot, not the raw view: jax may read the h2d source
         # AFTER device_put returns (async dispatch), and `host` is a view
         # over native-owned memory — a wire-arrival copy dies at its last
@@ -1446,6 +1686,13 @@ class TpuDevice:
         # Observed failure: the first 16 bytes of a consumed panel turn
         # into freed-chunk heap metadata (tests/comm potrf device runs).
         darr = self._jax.device_put(np.array(host, copy=True), self.device)
+        N.lib.ptc_prof_event(self.ctx._ptr, KEY_H2D, 1, -1,
+                             host.nbytes, self.qid, 0)
+        stall = time.perf_counter_ns() - t0
+        self._disp_stall_ns += stall
+        self.stats["h2d_stall_ns"] += stall
+        if self._pf_lane is not None:
+            self.stats["prefetch_misses"] += 1
         self._cache_put(uid, ver, darr, host.nbytes)
         self._stats_add("h2d_bytes", host.nbytes)  # vs stage_collection
         return darr
@@ -1529,11 +1776,20 @@ class TpuDevice:
         end after the async enqueue.  Same native buffer, dictionary,
         and PINS fan-out as worker events; no-op when both are off.
         l1 carries the device's queue id so concurrent same-class spans
-        from sibling devices pair and render distinctly."""
+        from sibling devices pair and render distinctly.  The END
+        event's aux carries the wave's dispatch-time h2d stall in ns
+        (0 == every input was resident/prefetched: a prefetch-hit
+        wave), so the bench reads staged-vs-prefetched latency straight
+        off paired spans."""
         from ..profiling.trace import KEY_DEVICE
         cid = body.tc.id if body.tc is not None else -1
+        if phase == 0:
+            self._disp_stall_ns = 0
+            aux = 0
+        else:
+            aux = self._disp_stall_ns
         N.lib.ptc_prof_event(self.ctx._ptr, KEY_DEVICE, phase, cid,
-                             lanes, self.qid, 0)
+                             lanes, self.qid, aux)
 
     def _dispatch_group_chunk(self, body: _DeviceBody, tasks: List):
         self._prof(0, body, len(tasks))

@@ -17,13 +17,13 @@ TPU-native equivalent over the native core's 8-word event stream
                  points (parsec/mca/pins/pins.h analog), MCA-selected
 """
 from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
-                    KEY_COMM_SEND, KEY_COMM_RECV, KEY_DEVICE,
+                    KEY_COMM_SEND, KEY_COMM_RECV, KEY_DEVICE, KEY_H2D,
                     Dictionary, Trace, take_trace, to_dot)
 from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
                    CommVolume, REGISTRY, enable_pins)
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
-           "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE",
+           "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE", "KEY_H2D",
            "Dictionary", "Trace", "take_trace", "to_dot",
            "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
            "CommVolume", "REGISTRY", "enable_pins"]

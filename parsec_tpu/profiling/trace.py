@@ -22,7 +22,11 @@ KEY_RELEASE = 1    # release_deps begin/end
 KEY_EDGE = 2       # dep edge, consecutive src(phase0)/dst(phase1) pair
 KEY_COMM_SEND = 3  # per-target activation send (instant span), aux = bytes
 KEY_COMM_RECV = 4  # per-target activation delivery (instant span)
-KEY_DEVICE = 5     # device dispatch call begin/end, l0 = lanes
+KEY_DEVICE = 5     # device dispatch call begin/end, l0 = lanes; the END
+                   # event's aux = the wave's dispatch-time h2d stall ns
+                   # (0 == prefetch-hit wave)
+KEY_H2D = 6        # h2d staging span, l0 = bytes, l1 = device queue,
+                   # aux = lane (0 dispatch-time stall, 1 prefetch lane)
 
 _MAGIC = b"#PTCPROF"
 _VERSION = 1
@@ -34,6 +38,7 @@ _DEFAULT_KEYS = {
     KEY_COMM_SEND: ("COMM_SEND", "#ff0000"),
     KEY_COMM_RECV: ("COMM_RECV", "#ff8800"),
     KEY_DEVICE: ("DEVICE_DISPATCH", "#aa00ff"),
+    KEY_H2D: ("DEVICE_H2D", "#00aaff"),
 }
 
 

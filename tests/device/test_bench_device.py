@@ -1,0 +1,75 @@
+"""Device-pipeline bench schema smoke (mirror of test_bench_dispatch
+for the device rung): `bench.py --device --json` must run at small
+sizes and emit the schema `make bench-device` commits to
+BENCH_device.json — staged-vs-prefetched wave evidence, the 2x-budget
+out-of-core GEMM, and honest host provenance."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+_WAVE_KEYS = {"tiles", "tile_bytes", "batch", "reps", "staged",
+              "prefetched", "hit_wave_stall_reduction",
+              "total_stall_reduction"}
+_RUN_KEYS = {"waves", "wall_s", "wave_p50_us", "stall_per_wave_us",
+             "stall_total_ms", "prefetch_hit_waves", "staged_waves",
+             "device_stats"}
+_GEMM_KEYS = {"m", "n", "k", "mb", "tile_set_bytes", "budget_bytes",
+              "budget_ratio", "wall_s", "correct", "spills",
+              "spill_bytes", "reserve_fails", "end_residency_bytes"}
+
+
+def test_device_suite_schema(tmp_path):
+    out = tmp_path / "device.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, _BENCH, "--device", "--json", str(out),
+           "--tiles", "24", "--elems", "4096", "--batch", "4",
+           "--reps", "1", "--gemm-m", "128", "--gemm-k", "32",
+           "--gemm-mb", "16"]
+    res = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+
+    # driver contract: the one-line JSON lands on stdout
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "device_h2d_stall_reduction"
+    assert line["config"]["ooc_gemm_correct"] is True
+
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["bench"] == "device"
+    assert doc["host"]["cpu_count"] == os.cpu_count()
+    assert {"prefetch_depth", "staging_slots", "out_of_core",
+            "overcommit"} <= set(doc["knobs"])
+
+    wp = doc["wave_pipeline"]
+    assert _WAVE_KEYS <= set(wp), wp.keys()
+    assert _RUN_KEYS <= set(wp["staged"]), wp["staged"].keys()
+    assert _RUN_KEYS <= set(wp["prefetched"]), wp["prefetched"].keys()
+    # the staged baseline really paid dispatch-time h2d ...
+    assert wp["staged"]["stall_total_ms"] > 0
+    # ... and the prefetch run produced hit waves with zero stall
+    assert wp["prefetched"]["prefetch_hit_waves"] > 0
+    assert wp["hit_wave_stall_reduction"] is not None
+    # acceptance: prefetch-hit waves show >= 80% lower dispatch h2d
+    # stall than the staged baseline on the same host
+    assert wp["hit_wave_stall_reduction"] >= 0.8, wp
+
+    g = doc["out_of_core_gemm"]
+    assert _GEMM_KEYS <= set(g), g.keys()
+    assert g["correct"] is True
+    assert g["budget_ratio"] >= 2.0
+    assert g["spills"] > 0 and g["spill_bytes"] > 0
+
+    # oversubscription provenance, machine-readable (like
+    # bench_dispatch_mt): threads > cores is FLAGGED, never silent
+    assert doc["oversubscribed"] == \
+        (doc["pipeline_threads"] > doc["host"]["cpu_count"])
+    if doc["oversubscribed"]:
+        assert "caveat" in doc and "timeshare" in doc["caveat"]
+        assert "WARNING" in res.stderr

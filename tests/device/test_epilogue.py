@@ -107,3 +107,45 @@ def test_epilogue_getrf_two_outputs():
     ref = getrf_nopiv_reference(M.astype(np.float64))
     np.testing.assert_allclose(out, ref.astype(np.float32),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_epilogue_rejects_undeclared_varying_read_flow():
+    """Single-varying-input contract (ADVICE r5 medium): _try_spec
+    version-checks only dst_in_flow, so a dst class with another
+    non-constant device read flow would complete from a result computed
+    WITHOUT that input.  attach_epilogue must refuse the wiring unless
+    every other read flow is declared constant via const_flows."""
+    import pytest
+
+    with pt.Context(nb_workers=1) as ctx:
+        val = np.zeros((8, 8), dtype=np.float32)
+        A = TwoDimBlockCyclic(8, 8, 8, 8, dtype=np.float32)
+        A.from_dense(val)
+        A.register(ctx, "A")
+        dev = TpuDevice(ctx)
+        tp = pt.Taskpool(ctx)
+        src = tp.task_class("Src")
+        src.flow("X", "RW", pt.In(pt.Mem("A", 0, 0)),
+                 pt.Out(pt.Mem("A", 0, 0)))
+        dst = tp.task_class("Dst")
+        dst.flow("P", "RW", pt.In(pt.Mem("A", 0, 0)),
+                 pt.Out(pt.Mem("A", 0, 0)))
+        dst.flow("Q", "READ", pt.In(pt.Mem("A", 0, 0)))
+        dev.attach(src, tp, kernel=lambda x: x, reads=["X"],
+                   writes=["X"], shapes={"X": (8, 8)}, dtype=np.float32)
+        dev.attach(dst, tp, kernel=lambda p, q: p + q,
+                   reads=["P", "Q"], writes=["P"],
+                   shapes={"P": (8, 8), "Q": (8, 8)}, dtype=np.float32)
+        # Q varies and is not declared: must refuse
+        with pytest.raises(ValueError, match="single-varying-input"):
+            dev.attach_epilogue(
+                src, dst, tp, src_flow="X", dst_in_flow="P",
+                pick=lambda v: None, dst_params=lambda v: (),
+                kernel=lambda x: x, ops=lambda key: [])
+        # declared constant: accepted (the caller owns the claim)
+        dev.attach_epilogue(
+            src, dst, tp, src_flow="X", dst_in_flow="P",
+            pick=lambda v: None, dst_params=lambda v: (),
+            kernel=lambda x: x, ops=lambda key: [],
+            const_flows=("Q",))
+        dev.stop()

@@ -1,0 +1,145 @@
+"""Device pipeline: ready-peek span API + prefetch lane.
+
+The prefetch lane walks the device queue's ready lookahead
+(ptc_peek_ready) and stages the NEXT wave's h2d while the manager
+computes the current one; a wave whose inputs were all prefetched
+dispatches with zero synchronous h2d (DEVICE span aux == 0)."""
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.device import TpuDevice
+
+TILES = 48
+ELEMS = 4 * 1024
+TB = ELEMS * 4
+
+
+def _wave_dag(ctx, tiles, out):
+    tp = pt.Taskpool(ctx, globals={"NT": TILES - 1})
+    k = pt.L("k")
+    tc = tp.task_class("Scale")
+    tc.param("k", 0, pt.G("NT"))
+    tc.flow("X", "R", pt.In(pt.Mem("T", k)), arena="t")
+    tc.flow("Y", "RW", pt.In(pt.Mem("O", k)), pt.Out(pt.Mem("O", k)),
+            arena="t")
+    return tp, tc
+
+
+def _mk(ctx, seed=0):
+    tiles = np.random.default_rng(seed).standard_normal(
+        (TILES, ELEMS)).astype(np.float32)
+    out = np.zeros((TILES, ELEMS), dtype=np.float32)
+    ctx.register_linear_collection("T", tiles, elem_size=TB)
+    ctx.register_linear_collection("O", out, elem_size=TB)
+    ctx.register_arena("t", TB)
+    return tiles, out
+
+
+def test_peek_ready_span():
+    """ptc_peek_ready snapshots queued tasks without popping: with the
+    manager stopped, every routed task is visible with its read-flow
+    copies (size + version), and the queue drains normally afterwards —
+    the peek pins released cleanly."""
+    with pt.Context(nb_workers=1) as ctx:
+        tiles, out = _mk(ctx)
+        dev = TpuDevice(ctx, autostart=False)
+        tp, tc = _wave_dag(ctx, tiles, out)
+        dev.attach(tc, tp, kernel=lambda x, y: x * 2.0 + y,
+                   reads=["X", "Y"], writes=["Y"],
+                   shapes={"X": (ELEMS,), "Y": (ELEMS,)},
+                   dtype=np.float32)
+        tp.run()
+        # workers route every ready task to the (undrained) device queue
+        import time
+        for _ in range(200):
+            if ctx.device_queue_depth(dev.qid) >= TILES:
+                break
+            time.sleep(0.01)
+        peeked = ctx.device_peek(dev.qid, max_tasks=TILES)
+        assert len(peeked) == TILES, len(peeked)
+        for tref, recs in peeked:
+            assert tref != 0
+            # two read flows (X and the RW Y), each a full tile
+            assert len(recs) == 2, recs
+            for handle, size, ver in recs:
+                assert size == TB and ver >= 0
+        # double peek: pins are balanced, nothing leaks or double-frees
+        assert len(ctx.device_peek(dev.qid, max_tasks=8)) == 8
+        dev.start()
+        tp.wait()
+        dev.flush()
+        assert dev.stats["tasks"] == TILES
+        dev.stop()
+        np.testing.assert_allclose(out, tiles * 2.0, rtol=1e-5)
+
+
+def test_prefetch_lane_stages_next_waves():
+    """Wide wave workload, small batch: the lane must stage later waves
+    while earlier ones compute — prefetch hits on most stage-ins, and
+    prefetch-hit waves pay zero dispatch-time h2d stall."""
+    with pt.Context(nb_workers=2) as ctx:
+        tiles, out = _mk(ctx, seed=1)
+        dev = TpuDevice(ctx, autostart=False, prefetch=True)
+        dev.batch_max = 8
+        dev.start()
+        tp, tc = _wave_dag(ctx, tiles, out)
+        dev.attach(tc, tp, kernel=lambda x, y: x * 2.0 + y,
+                   reads=["X", "Y"], writes=["Y"],
+                   shapes={"X": (ELEMS,), "Y": (ELEMS,)},
+                   dtype=np.float32)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        stats = ctx.device_stats()
+        dev.stop()
+        np.testing.assert_allclose(out, tiles * 2.0, rtol=1e-5)
+    assert stats["prefetch_staged"] > 0, stats
+    assert stats["prefetch_hits"] > 0, stats
+    # the lane's h2d time is accounted separately from dispatch stalls
+    assert stats["prefetch_h2d_ns"] > 0, stats
+    assert 0.0 <= stats["overlap_ratio"] <= 1.0
+
+
+def test_prefetch_off_knob():
+    """prefetch=False: no lane, no prefetch traffic — every cold tile
+    stages synchronously at dispatch (the staged baseline the bench
+    compares against)."""
+    with pt.Context(nb_workers=1) as ctx:
+        tiles, out = _mk(ctx, seed=2)
+        dev = TpuDevice(ctx, prefetch=False)
+        tp, tc = _wave_dag(ctx, tiles, out)
+        dev.attach(tc, tp, kernel=lambda x, y: x * 2.0 + y,
+                   reads=["X", "Y"], writes=["Y"],
+                   shapes={"X": (ELEMS,), "Y": (ELEMS,)},
+                   dtype=np.float32)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        stats = dict(dev.stats)
+        dev.stop()
+        np.testing.assert_allclose(out, tiles * 2.0, rtol=1e-5)
+    assert stats["prefetch_staged"] == 0, stats
+    assert stats["prefetch_hits"] == 0, stats
+    assert stats["h2d_stall_ns"] > 0, stats  # cold staging was paid
+
+
+def test_device_stats_export():
+    """Context.device_stats() aggregates the pipeline counters and
+    derives the counter-level overlap ratio."""
+    with pt.Context(nb_workers=1) as ctx:
+        tiles, out = _mk(ctx, seed=3)
+        dev = TpuDevice(ctx)
+        tp, tc = _wave_dag(ctx, tiles, out)
+        dev.attach(tc, tp, kernel=lambda x, y: x + y, reads=["X", "Y"],
+                   writes=["Y"], shapes={"X": (ELEMS,), "Y": (ELEMS,)},
+                   dtype=np.float32)
+        tp.run()
+        tp.wait()
+        st = ctx.device_stats()
+        dev.stop()
+    for key in ("prefetch_staged", "prefetch_hits", "prefetch_misses",
+                "reserve_fails", "spills", "spill_bytes", "h2d_stall_ns",
+                "prefetch_h2d_ns", "overlap_ratio", "ooc_waits",
+                "devices"):
+        assert key in st, key
+    assert len(st["devices"]) == 1
