@@ -49,4 +49,12 @@ bench-dispatch: $(LIB)
 bench-device: $(LIB)
 	python bench.py --device --json BENCH_device.json
 
-.PHONY: all clean tsan bench-comm bench-dispatch bench-device
+# Cross-rank streaming sweep (bench.py --stream --json): steady-state
+# >=4 MiB device-to-device tile latency with the wire-v4 streaming
+# pipeline (progressive serve + 2 rails) vs the serialized baseline
+# (stream off, 1 rail), rails=1 vs rails=2 throughput, and per-hop
+# d2h/wire overlap evidence.  Loopback, CPU jax backend — no TPU needed.
+bench-stream: $(LIB)
+	python bench.py --stream --json BENCH_stream.json
+
+.PHONY: all clean tsan bench-comm bench-dispatch bench-device bench-stream

@@ -27,6 +27,26 @@ import numpy as np
 import parsec_tpu as pt
 
 
+def host_provenance(threads=None):
+    """ONE capture of host provenance + the oversubscription flag (the
+    bench_dispatch_mt convention), shared by every bench document —
+    bench-comm / bench-dispatch / bench-device / bench-stream each used
+    to carry its own copy, which had already drifted three ways.
+    `threads` (if given) is the number of runtime threads the measured
+    configuration keeps busy; threads > cores flags the run as
+    oversubscribed — the numbers then measure scheduling luck, not
+    concurrency, and documents must say so."""
+    import os
+    import platform
+    cpus = os.cpu_count() or 1
+    doc = {"host": {"cpu_count": cpus, "platform": sys.platform,
+                    "machine": platform.machine()}}
+    if threads is not None:
+        doc["pipeline_threads"] = threads
+        doc["oversubscribed"] = threads > cpus
+    return doc
+
+
 def bench_dispatch_chain(nb_tasks: int = 20000, reps: int = 5):
     """Single-chain steady-state dispatch latency (measurement-ladder
     rung 1): p50/p99 of successor EXEC-begin deltas on an Ex04-style RW
@@ -517,15 +537,12 @@ def bench_dispatch_suite(tasks=20000, mt_tasks=4000, reps=5, workers=4,
     sched_stats counters that prove which fast paths fired, plus host
     provenance so a 1-core contended number can't masquerade as a
     contention measurement."""
-    import os
-    import platform
     from parsec_tpu.utils import params as _mca
     single = bench_dispatch_chain(tasks, reps)
     contended = bench_dispatch_mt(mt_tasks, lanes, workers, reps)
     return {
         "bench": "dispatch",
-        "host": {"cpu_count": os.cpu_count(), "platform": sys.platform,
-                 "machine": platform.machine()},
+        **host_provenance(),
         "sched": _mca.get("runtime.sched"),
         "sched_bypass": bool(_mca.get("sched.bypass")),
         "budget_us": 5.0,
@@ -734,36 +751,213 @@ def bench_device_suite(tiles=96, elems=32 * 1024, batch=8, reps=3,
     out-of-core GEMM, and host provenance (the pipeline threads —
     workers + manager + writeback + prefetch — timeshare on small
     hosts, which is flagged, not silently reported)."""
-    import os
-    import platform
     from parsec_tpu.utils import params as _mca
-    cpus = os.cpu_count() or 1
     workers = 2
     threads = workers + 3  # manager + writeback + prefetch lanes
     doc = {
         "bench": "device",
-        "host": {"cpu_count": cpus, "platform": sys.platform,
-                 "machine": platform.machine()},
+        **host_provenance(threads=threads),
         "knobs": {
             "prefetch_depth": _mca.get("device.prefetch_depth"),
             "staging_slots": _mca.get("device.staging_slots"),
             "out_of_core": _mca.get("device.out_of_core"),
             "overcommit": _mca.get("device.overcommit"),
         },
-        "pipeline_threads": threads,
-        "oversubscribed": threads > cpus,
         "wave_pipeline": bench_device_pipeline(tiles, elems, batch, reps),
         "out_of_core_gemm": bench_device_ooc_gemm(
             m=gemm_m, n=gemm_m, k=gemm_k, mb=gemm_mb),
     }
     if doc["oversubscribed"]:
         doc["caveat"] = (
-            f"pipeline threads ({threads}) > cores ({cpus}): the "
+            f"pipeline threads ({threads}) > cores "
+            f"({doc['host']['cpu_count']}): the "
             "prefetch lane timeshares with the manager, so the overlap "
             "fraction measures scheduling luck, not true concurrency — "
             "stall accounting (what moved OFF the dispatch path) "
             "remains valid")
         sys.stderr.write(f"bench-device WARNING: {doc['caveat']}\n")
+    return doc
+
+
+# --------------------------------------------------------------- stream
+def _stream_worker(rank, port, size, hops, reps, env, q):
+    """One rank of the cross-rank device-to-device streaming sweep: a
+    rank-hopping RW chain of device chores whose datum is a `size`-byte
+    tile — every hop is a full PK_DEVICE cross-rank move (producer d2h →
+    wire → consumer h2d), the exact path the streaming pipeline rewires.
+    One persistent process pair serves all reps (testbandwidth's
+    steady-state discipline: rep 0 carries session/compile setup and is
+    reported apart)."""
+    try:
+        import os
+        for k, v in env.items():
+            os.environ[k] = v
+        import jax
+        if not os.environ.get("PTC_BENCH_TPU"):
+            jax.config.update("jax_platforms", "cpu")
+        import parsec_tpu as pt
+        from parsec_tpu.device import TpuDevice
+
+        ctx = pt.Context(nb_workers=1)
+        ctx.set_rank(rank, 2)
+        ctx.comm_init(port)
+        dev = TpuDevice(ctx)
+        elems = max(1, size // 4)
+        arr = np.zeros((2, elems), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=size,
+                                       nodes=2, myrank=rank)
+        ctx.register_arena("t", size)
+        k = pt.L("k")
+
+        def build():
+            tp = pt.Taskpool(ctx, globals={"NB": hops})
+            tc = tp.task_class("Hop")
+            tc.param("k", 0, pt.G("NB"))
+            tc.affinity("A", k % 2)
+            tc.flow("A", "RW",
+                    pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                    pt.In(pt.Ref("Hop", k - 1, flow="A")),
+                    pt.Out(pt.Ref("Hop", k + 1, flow="A"),
+                           guard=(k < pt.G("NB"))),
+                    arena="t")
+            dev.attach(tc, tp, kernel=_stream_bump, reads=["A"],
+                       writes=["A"], shapes={"A": (elems,)},
+                       dtype=np.float32)
+            return tp
+
+        walls = []
+        for rep in range(reps + 1):  # rep 0 = setup, reported apart
+            tp = build()
+            ctx.comm_fence()
+            t0 = time.perf_counter()
+            tp.run()
+            tp.wait()
+            ctx.comm_fence()
+            walls.append(time.perf_counter() - t0)
+        stream = ctx.comm_stream_stats()
+        dstats = {k2: dev.stats.get(k2, 0) for k2 in
+                  ("stream_serves", "stream_slices", "stream_d2h_ns",
+                   "stream_bytes", "prefetch_wakeups", "dp_recv_bytes",
+                   "h2d_stall_ns")}
+        dev.stop()
+        ctx.comm_fini()
+        ctx.destroy()
+        q.put(("ok", rank, walls, stream, dstats))
+    except Exception:
+        import traceback
+        q.put(("err", rank, traceback.format_exc(), None, None))
+
+
+def _stream_bump(x):
+    # module-level: the process-wide jit cache keys on kernel identity
+    return x + 1.0
+
+
+def _stream_pair(size, hops, reps, port, stream, rails,
+                 chunk=1 << 20, inflight=4):
+    """Run one knob configuration on a fresh persistent 2-process pair;
+    returns per-transfer latency + the producer-side span evidence."""
+    import multiprocessing as mp
+    env = {"PTC_MCA_comm_eager_limit": "0",
+           "PTC_MCA_comm_chunk_size": str(chunk),
+           "PTC_MCA_comm_inflight": str(inflight),
+           "PTC_MCA_comm_stream": str(stream),
+           "PTC_MCA_comm_rails": str(rails)}
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    procs = [mpctx.Process(target=_stream_worker,
+                           args=(r, port, size, hops, reps, env, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        res = [q.get(timeout=900) for _ in range(2)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in res if r[0] != "ok"]
+    if errs:
+        raise RuntimeError(str(errs))
+    by_rank = {r[1]: r for r in res}
+    walls = [max(by_rank[0][2][i], by_rank[1][2][i])
+             for i in range(reps + 1)]
+    per = [w / hops for w in walls[1:]]
+    best = min(per)
+    # span evidence accumulates on BOTH ranks (each serves the hops it
+    # produced): sum the windows for the pair-level overlap fraction
+    s0, s1 = by_rank[0][3], by_rank[1][3]
+    d2h = s0["d2h_ns"] + s1["d2h_ns"]
+    overlap = s0["overlap_ns"] + s1["overlap_ns"]
+    return {
+        "size_bytes": size, "stream": bool(stream), "rails": rails,
+        "setup_ms": round(walls[0] * 1e3, 2),
+        "per_transfer_ms": round(best * 1e3, 3),
+        "per_transfer_ms_all": [round(t * 1e3, 3) for t in per],
+        "gbps": round(size * 8 / best / 1e9, 3),
+        "sessions": s0["sessions"] + s1["sessions"],
+        "parked_gets": s0["parked_gets"] + s1["parked_gets"],
+        "d2h_ns": d2h, "wire_ns": s0["wire_ns"] + s1["wire_ns"],
+        "overlap_ns": overlap,
+        "overlap_fraction": round(overlap / d2h, 4) if d2h else None,
+        "device": {r: by_rank[r][4] for r in (0, 1)},
+    }
+
+
+def bench_stream_suite(size=4 << 20, hops=8, reps=3, chunk=1 << 20,
+                       inflight=4):
+    """The `make bench-stream` document (BENCH_stream.json): steady-
+    state ≥4 MiB cross-rank device-to-device tile latency with the
+    streaming pipeline ON (progressive serve + 2 rails) vs the
+    serialized PR3 baseline (stream off, 1 rail), plus a rails=1 vs
+    rails=2 sweep at fixed stream=on.  Per-hop span evidence (d2h
+    window, wire window, their overlap) comes from the engine's stream
+    stats; the acceptance ratio is streamed/serialized per-transfer
+    latency (target <= 0.6).  Knobs + host provenance ride along — a
+    1-core host is flagged per the bench_dispatch_mt convention (the
+    producer's slicer, the comm threads and the consumer's prefetch
+    lane must timeshare there, which caps the visible overlap)."""
+    import os
+    from parsec_tpu.utils import params as _mca
+    base = int(os.environ.get("PTC_PORT", "31500"))
+    # per rank: worker + comm thread + device manager + writeback +
+    # prefetch lane, two ranks
+    doc = {
+        "bench": "stream",
+        **host_provenance(threads=2 * 5),
+        "knobs": {"comm_rails": int(_mca.get("comm.rails")),
+                  "comm_chunk_size": chunk,
+                  "comm_inflight": inflight,
+                  "comm_stream": bool(_mca.get("comm.stream")),
+                  "comm_eager_limit": 0,
+                  "size_bytes": size, "hops": hops, "reps": reps},
+    }
+    doc["serialized"] = _stream_pair(size, hops, reps, base, stream=0,
+                                     rails=1, chunk=chunk,
+                                     inflight=inflight)
+    doc["streamed"] = _stream_pair(size, hops, reps, base + 4, stream=1,
+                                   rails=2, chunk=chunk,
+                                   inflight=inflight)
+    doc["rails1_streamed"] = _stream_pair(size, hops, reps, base + 8,
+                                          stream=1, rails=1, chunk=chunk,
+                                          inflight=inflight)
+    ser = doc["serialized"]["per_transfer_ms"]
+    stm = doc["streamed"]["per_transfer_ms"]
+    doc["stream_vs_serialized_ratio"] = round(stm / ser, 4) if ser else None
+    doc["ratio_target"] = 0.6
+    r1 = doc["rails1_streamed"]["gbps"]
+    r2 = doc["streamed"]["gbps"]
+    doc["rails2_vs_rails1_throughput"] = round(r2 / r1, 4) if r1 else None
+    if doc["oversubscribed"]:
+        doc["caveat"] = (
+            f"pipeline threads ({doc['pipeline_threads']}) > cores "
+            f"({doc['host']['cpu_count']}): the producer's d2h slicer, "
+            "both comm threads and the consumer's prefetch lane "
+            "timeshare, so the measured overlap/ratio understate what "
+            "distinct cores deliver — re-run on a multicore host for "
+            "the real pipeline number")
+        sys.stderr.write(f"bench-stream WARNING: {doc['caveat']}\n")
     return doc
 
 
@@ -969,6 +1163,36 @@ def main():
                        "ooc_gemm_spills":
                            doc["out_of_core_gemm"]["spills"]},
         }))
+        return 0
+    if "--stream" in sys.argv:
+        doc = bench_stream_suite(
+            size=_arg_after("--size", 4 << 20),
+            hops=_arg_after("--hops", 8),
+            reps=_arg_after("--reps", 3),
+            chunk=_arg_after("--chunk", 1 << 20),
+            inflight=_arg_after("--inflight", 4))
+        out = _arg_str_after("--json", None)
+        if out:
+            with open(out, "w") as f:
+                json.dump(doc, f, indent=1)
+            sys.stderr.write(f"wrote {out}\n")
+        line = {
+            "metric": "stream_vs_serialized_latency_ratio",
+            "value": doc["stream_vs_serialized_ratio"],
+            "unit": "x (lower is better; serialized PR3 serve = 1.0)",
+            "vs_baseline": (round(0.6 / doc["stream_vs_serialized_ratio"],
+                                  3)
+                            if doc["stream_vs_serialized_ratio"] else None),
+            "config": {"size_bytes": doc["knobs"]["size_bytes"],
+                       "hops": doc["knobs"]["hops"],
+                       "rails2_vs_rails1_throughput":
+                           doc["rails2_vs_rails1_throughput"],
+                       "overlap_fraction":
+                           doc["streamed"]["overlap_fraction"]},
+        }
+        if "caveat" in doc:
+            line["caveat"] = doc["caveat"]
+        print(json.dumps(line))
         return 0
     if "--ep" in sys.argv:
         print(_ep_json())

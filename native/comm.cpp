@@ -50,6 +50,34 @@
  * overlap, and no single giant frame can monopolize a link that fences
  * and activations share.  PING/PONG (control frames) measure per-peer
  * RTT for the adaptive eager threshold (PTC_MCA_comm_eager_limit=auto).
+ *
+ * Wire v4 — cross-rank tile STREAMING (same frame grammar as v3; the
+ * version bump covers the connect handshake, which now carries a rail
+ * index):
+ *   - multi-rail transport: PTC_MCA_comm_rails (default 2) striped TCP
+ *     connections per peer.  Order-sensitive traffic (everything except
+ *     PUT_CHUNK) stays on rail 0, so every FIFO argument the fence and
+ *     the session-creation protocol rely on is untouched; PUT_CHUNK
+ *     payload frames round-robin across rails (reassembly is
+ *     offset-addressed, chunk order is irrelevant) so one in-order TCP
+ *     stream cannot cap cross-rank throughput.
+ *   - zero-copy chunk sends: PUT_CHUNK frames are queued as scatter-
+ *     gather messages (header bytes + a pointer into the pinned
+ *     snapshot, written with sendmsg) — zero payload memcpy per chunk;
+ *     a shared_ptr pin keeps the snapshot alive until the kernel took
+ *     the bytes even if the registration retires first.
+ *   - progressive serve (PTC_MCA_comm_stream, default on): a chunked
+ *     pull of a device-resident payload no longer waits for the full
+ *     d2h snapshot — the device layer streams d2h slices through
+ *     ptc_dp_serve_progress, each advancing a ready-bytes watermark on
+ *     the ChunkServe session; ranged GETs at or below the watermark are
+ *     answered immediately, the rest park on the session and flush as
+ *     the watermark advances, so the wire starts moving after the FIRST
+ *     d2h slice instead of the last (T3, arXiv:2401.16677: sub-tile
+ *     tracking collapses d2h+wire+h2d toward max(hop)).
+ *   - receiver-side, chunks reassemble directly into the final ptc_copy
+ *     allocation (no chunk_buf -> deliver memcpy), and delivery
+ *     completion wakes the consumer's prefetch lane event-driven.
  */
 
 #include "runtime_internal.h"
@@ -65,6 +93,7 @@
 #include <netinet/tcp.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 namespace {
@@ -114,12 +143,32 @@ enum {
  * handing the tag back to dp_serve/dp_serve_done. */
 static constexpr uint64_t DP_HANDLE_FLAG = 1ULL << 63;
 
-struct TcpPeer {
+/* one queued outgoing message: either a self-contained frame (hdr holds
+ * everything) or a scatter-gather chunk send whose payload bytes stay in
+ * the pinned snapshot (`ext` into `pin`) — zero payload memcpy per
+ * chunk.  The shared_ptr pin keeps the snapshot alive until the kernel
+ * took the bytes, even if the serving session or registration retires
+ * while the frame still sits in the out queue. */
+struct OutMsg {
+  std::vector<uint8_t> hdr;
+  std::shared_ptr<std::vector<uint8_t>> pin;
+  const uint8_t *ext = nullptr;
+  size_t ext_len = 0;
+  size_t size() const { return hdr.size() + ext_len; }
+};
+
+/* one TCP connection of a (possibly multi-rail) peer link */
+struct TcpRail {
   int fd = -1;
   std::vector<uint8_t> inbuf;
   size_t in_off = 0; /* consumed prefix of inbuf */
-  std::deque<std::vector<uint8_t>> out; /* pending frames */
-  size_t out_off = 0; /* sent prefix of out.front() */
+  std::deque<OutMsg> out; /* pending messages */
+  size_t out_off = 0; /* sent prefix of out.front() (hdr then ext) */
+};
+
+struct TcpPeer {
+  std::vector<TcpRail> rails; /* rail 0 = ordered traffic; others carry
+                                 only offset-addressed PUT_CHUNK frames */
 };
 
 struct Writer {
@@ -166,8 +215,8 @@ struct CeOps {
   bool (*available)(void);
   /* bring up links to all peers; spawn the progress thread */
   int32_t (*start)(CommEngine *ce, int base_port);
-  /* queue one framed message for `rank` (any thread) */
-  void (*post)(CommEngine *ce, uint32_t rank, std::vector<uint8_t> &&frame);
+  /* queue one message for `rank` on `rail` (any thread) */
+  void (*post)(CommEngine *ce, uint32_t rank, OutMsg &&msg, uint32_t rail);
   /* kick the progress thread (posted work / shutdown) */
   void (*wake)(CommEngine *ce);
   /* drain deliverable queues, join the thread, close links */
@@ -182,7 +231,8 @@ struct TcpTransport {
 
   ~TcpTransport() {
     for (TcpPeer &p : peers)
-      if (p.fd >= 0) close(p.fd);
+      for (TcpRail &r : p.rails)
+        if (r.fd >= 0) close(r.fd);
     if (listen_fd >= 0) close(listen_fd);
     if (wake_pipe[0] >= 0) close(wake_pipe[0]);
     if (wake_pipe[1] >= 0) close(wake_pipe[1]);
@@ -193,10 +243,16 @@ struct TcpTransport {
  * retained until every expected GET was served (reference: the remote
  * memory handle an ACTIVATE advertises, parsec/remote_dep.h:59-65) */
 struct MemReg {
-  std::vector<uint8_t> bytes;
+  /* shared, not owned: zero-copy chunk frames pin the snapshot through
+   * the out queue, so it may outlive the registration (null for
+   * PK_DEVICE registrations, which have no host snapshot) */
+  std::shared_ptr<std::vector<uint8_t>> bytes;
   ptc_copy *src = nullptr; /* retained: keeps pointer identity stable */
   int32_t expected = 0;
   int32_t served = 0;
+  /* PK_DEVICE: the advertised payload size — a progressive-serve
+   * (streaming) session is allocated from it before any d2h happened */
+  int64_t dp_total = 0;
   /* live chunk sessions reading `bytes` (host-rendezvous chunked pulls
    * retire their served++ at the FIRST chunk; this ref keeps the
    * snapshot alive until the last chunk left the wire) */
@@ -231,10 +287,12 @@ struct PendingGet {
   uint8_t pk;
   /* chunked pipelined pull (payloads above comm.chunk_size): ranges are
    * requested with up to comm.inflight outstanding and reassembled
-   * here; empty chunk_buf = whole-payload pull (the v2 shape) */
-  std::vector<uint8_t> chunk_buf;
+   * DIRECTLY into the final ptc_copy allocation (`dst`) — delivery then
+   * reuses the copy instead of memcpying a staging buffer into a fresh
+   * one.  dst == nullptr: whole-payload pull (the v2 shape). */
+  ptc_copy *dst = nullptr;
   uint64_t total = 0;    /* advertised payload size */
-  uint64_t received = 0; /* bytes landed in chunk_buf */
+  uint64_t received = 0; /* bytes landed in dst */
   uint64_t next_req = 0; /* next offset not yet requested */
   /* datatype the payload bytes are ALREADY in (from the ACTIVATE frame's
    * shaped field): a consumer whose recv type matches must not re-apply
@@ -259,8 +317,24 @@ struct ChunkServe {
   uint64_t handle = 0;
   uint32_t from = 0;  /* the pulling rank (peer-loss reaping) */
   uint64_t total = 0;
-  uint64_t served = 0;          /* cumulative bytes served */
-  std::vector<uint8_t> buf;     /* owned bytes (PK_DEVICE serves) */
+  uint64_t served = 0; /* cumulative bytes served */
+  /* owned bytes (PK_DEVICE serves); shared so zero-copy chunk frames in
+   * the out queue can outlive the session.  null = host-rendezvous
+   * session reading the MemReg snapshot in place. */
+  std::shared_ptr<std::vector<uint8_t>> buf;
+  /* progressive serve (streaming): the producer's d2h fills `buf` in
+   * slices via ptc_dp_serve_progress; `watermark` is the ready-bytes
+   * frontier.  Ranged GETs above the watermark park on `parked` and
+   * flush as it advances, so the wire starts after the first slice. */
+  bool streaming = false;
+  uint64_t watermark = 0;
+  uint64_t stream_id = 0; /* ptc_dp_serve_progress addressing */
+  int64_t tag = 0;        /* device tag: dp_serve_done at retire/reap */
+  std::vector<std::pair<uint64_t, uint64_t>> parked; /* (offset, len) */
+  /* per-hop span evidence: d2h window [t_start, t_d2h_done], wire
+   * window [t_first_post, retire] — their intersection is the overlap
+   * the progressive serve exists to create */
+  int64_t t_start = 0, t_first_post = 0, t_d2h_done = 0;
 };
 
 } // namespace
@@ -308,6 +382,29 @@ struct CommEngine {
    * disables chunking (v2 whole-payload pulls). */
   int64_t chunk_size = 1 << 20;
   int32_t inflight = 4;
+  /* multi-rail striping (PTC_MCA_comm_rails): PUT_CHUNK frames round-
+   * robin across this many TCP connections per peer; everything else
+   * rides rail 0 (FIFO-order preserving).  Must be uniform across the
+   * job — the accept handshake rejects out-of-range rail indices. */
+  int32_t rails = 2;
+  /* progressive streaming serve (PTC_MCA_comm_stream, default on): off
+   * reproduces the PR3 serialized d2h-then-wire behavior bit-exactly */
+  bool stream = true;
+  /* per-peer chunk-send round robin: bumped by the comm thread AND the
+   * writeback thread (ptc_dp_serve_progress flushes), hence atomic */
+  std::vector<std::atomic<uint32_t>> rail_rr;
+  /* streaming sessions: stream_id -> (puller, cookie).  `active` flips
+   * once the session exists; ptc_dp_serve_progress on a not-yet-active
+   * id asks the caller to retry (the accept callback races the session
+   * install by design — the slicer thread may start first). */
+  struct StreamRef { uint32_t from; uint64_t cookie; bool active; };
+  std::map<uint64_t, StreamRef> streams;
+  uint64_t next_stream = 1;
+  /* fault injection (PTC_COMM_FAULT_*): recv-size cap (forces short
+   * reads / frame fragmentation) and a per-recv delay — the soak
+   * harness for the chunk/stream session state machines */
+  int64_t fault_recv_max = 0;
+  int64_t fault_delay_us = 0;
   /* producer chunk sessions (under `lock`), keyed by (puller rank,
    * cookie) — cookies are allocated by each CONSUMER's own counter, so
    * two consumers pulling one producer concurrently WILL present the
@@ -334,6 +431,13 @@ struct CommEngine {
   std::atomic<uint64_t> gets_sent{0}, gets_served{0};
   std::atomic<uint64_t> chunks_sent{0}, chunks_recv{0};
   std::atomic<uint64_t> mem_reg_bytes{0}; /* currently registered */
+  /* streaming / reap stats (ptc_comm_stream_stats) */
+  std::atomic<uint64_t> stream_sessions{0}; /* progressive serves run */
+  std::atomic<uint64_t> stream_parked{0};   /* GETs parked > watermark */
+  std::atomic<int64_t> stream_d2h_ns{0};    /* sum of d2h windows */
+  std::atomic<int64_t> stream_wire_ns{0};   /* sum of wire windows */
+  std::atomic<int64_t> stream_overlap_ns{0}; /* d2h ∩ wire */
+  std::atomic<uint64_t> reaps{0}; /* sessions/pins reaped on peer loss */
 
   /* counting termination detection (reference: the fourcounter global-TD
    * module, parsec/mca/termdet/fourcounter/termdet_fourcounter.h:16-59):
@@ -393,9 +497,6 @@ static int wave_wait(CommEngine *ce, std::unique_lock<ptc_mutex> &g,
 
 namespace {
 
-static void comm_wake(CommEngine *ce) { ce->ops->wake(ce); }
-
-/* enqueue a finished frame for `rank` (worker threads call this) */
 /* true when `rank` has been marked lost; ce->lock must be held — this
  * linearizes against mark_peer_lost's reap: a registration made under
  * the same lock either sees the flag (and skips) or is visible to the
@@ -428,16 +529,19 @@ static size_t reg_live_children(CommEngine *ce, MemReg &m,
  * canary, since a byte-swapped peer presents it reversed. */
 enum : uint32_t {
   PTC_WIRE_MAGIC = 0x50544331u, /* "PTC1" */
-  PTC_WIRE_VERSION = 3, /* v3: ranged GET + PUT_CHUNK (chunked
-                           pipelined rendezvous) + PING/PONG probes */
+  PTC_WIRE_VERSION = 4, /* v4: multi-rail handshake (hello carries a
+                           rail index) + progressive streaming serve.
+                           Frame grammar is v3's; the bump exists
+                           because a v3 peer's 3-word hello cannot
+                           join a v4 mesh (see MIGRATION.md). */
 };
 
-static void comm_post(CommEngine *ce, uint32_t rank,
-                      std::vector<uint8_t> &&frame) {
-  bool is_ctl = frame.size() > 4 &&
-                (frame[4] == MSG_FENCE || frame[4] == MSG_TD ||
-                 frame[4] == MSG_FINI || frame[4] == MSG_PING ||
-                 frame[4] == MSG_PONG);
+static void comm_post_msg(CommEngine *ce, uint32_t rank, OutMsg &&msg,
+                          uint32_t rail) {
+  bool is_ctl = msg.hdr.size() > 4 &&
+                (msg.hdr[4] == MSG_FENCE || msg.hdr[4] == MSG_TD ||
+                 msg.hdr[4] == MSG_FINI || msg.hdr[4] == MSG_PING ||
+                 msg.hdr[4] == MSG_PONG);
   if (!is_ctl) {
     /* activity ticks before the transport enqueues: a fence snapshot
      * must never see the queued frame but miss the count (the transport
@@ -447,7 +551,26 @@ static void comm_post(CommEngine *ce, uint32_t rank,
     ce->app_sent.fetch_add(1, std::memory_order_relaxed);
   }
   ce->msgs_sent.fetch_add(1, std::memory_order_relaxed);
-  ce->ops->post(ce, rank, std::move(frame));
+  ce->ops->post(ce, rank, std::move(msg), rail);
+}
+
+static void comm_post(CommEngine *ce, uint32_t rank,
+                      std::vector<uint8_t> &&frame) {
+  OutMsg m;
+  m.hdr = std::move(frame);
+  comm_post_msg(ce, rank, std::move(m), 0);
+}
+
+/* PUT_CHUNK frames stripe across the rails (offset-addressed
+ * reassembly: chunk order across connections is irrelevant).  The
+ * round-robin counter is per peer; racy increments merely skew the
+ * striping, never correctness. */
+static void comm_post_chunk(CommEngine *ce, uint32_t rank, OutMsg &&msg) {
+  uint32_t rail = 0;
+  if (ce->rails > 1 && rank < ce->rail_rr.size())
+    rail = ce->rail_rr[rank].fetch_add(1, std::memory_order_relaxed) %
+           (uint32_t)ce->rails;
+  comm_post_msg(ce, rank, std::move(msg), rail);
 }
 
 static std::vector<uint8_t> frame_begin(uint8_t type) {
@@ -498,7 +621,8 @@ static ptc_copy *maybe_free_reg_locked(CommEngine *ce, uint64_t handle) {
   if (it == ce->mem_reg.end()) return nullptr;
   MemReg &m = it->second;
   if (m.served < m.expected || m.chunk_refs > 0) return nullptr;
-  ce->mem_reg_bytes.fetch_sub(m.bytes.size(), std::memory_order_relaxed);
+  ce->mem_reg_bytes.fetch_sub(m.bytes ? m.bytes->size() : 0,
+                              std::memory_order_relaxed);
   ptc_copy *rel = m.src;
   if (rel && m.in_by_copy) ce->mem_by_copy.erase(rel);
   if (rel && m.packed_dtype >= 0)
@@ -570,7 +694,13 @@ static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
     cookie = ce->next_cookie++;
     if (chunk) {
       pg.total = plen;
-      pg.chunk_buf.resize((size_t)plen);
+      /* reassemble straight into the copy delivery will hand out: the
+       * old chunk_buf -> fresh-copy memcpy is gone from the tail of
+       * every chunked pull */
+      pg.dst = new ptc_copy();
+      pg.dst->size = (int64_t)plen;
+      pg.dst->ptr = std::malloc((size_t)(plen > 0 ? plen : 1));
+      pg.dst->owns_ptr = true;
       uint32_t win = ce->inflight > 0 ? (uint32_t)ce->inflight : 1;
       for (uint32_t i = 0; i < win && pg.next_req < plen; i++) {
         uint64_t off = pg.next_req;
@@ -602,7 +732,8 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             std::vector<WireTarget> &&targets,
                             const uint8_t *payload, uint64_t plen,
                             int64_t device_uid = 0,
-                            uint64_t alloc_len = 0, int32_t shaped = -1) {
+                            uint64_t alloc_len = 0, int32_t shaped = -1,
+                            ptc_copy *ready = nullptr) {
   if (alloc_len == 0) alloc_len = plen;
   ptc_copy *copy = nullptr;
   /* ptc_has_dtypes: zero-registered-datatype workloads skip the
@@ -731,7 +862,17 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
       return;
     }
   }
-  if (alloc_len > 0) {
+  if (alloc_len > 0 && ready && plen == alloc_len &&
+      ready->size == (int64_t)alloc_len && payload == ready->ptr) {
+    /* chunked pull: the payload was reassembled straight into its final
+     * copy — deliver THAT (retained; the caller keeps its own ref) */
+    copy = ready;
+    ptc_copy_retain(copy);
+    copy->shaped_as = shaped;
+    copy->handle = device_uid;
+    if (device_uid != 0 && ctx->dp_bound)
+      ctx->dp_bound(ctx->dp_user, device_uid, copy->ptr, copy->size, 1);
+  } else if (alloc_len > 0) {
     copy = new ptc_copy();
     copy->ptr = std::malloc((size_t)alloc_len);
     copy->size = (int64_t)alloc_len;
@@ -790,7 +931,8 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
                             const uint8_t *targets_bytes, size_t targets_len,
                             const uint8_t *payload, uint64_t plen,
                             int64_t device_uid, bool allow_park,
-                            uint64_t alloc_len = 0, int32_t shaped = -1) {
+                            uint64_t alloc_len = 0, int32_t shaped = -1,
+                            ptc_copy *ready = nullptr) {
   ptc_taskpool *tp = find_tp(ctx, tp_id);
   if (!tp) {
     /* Re-check the registry under the lock: add_taskpool may have
@@ -848,7 +990,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
     return;
   }
   deliver_targets(ctx, tp, flow_idx, std::move(targets), payload, plen,
-                  device_uid, alloc_len, shaped);
+                  device_uid, alloc_len, shaped, ready);
 }
 
 /* body excludes the type byte.  `from` is the sending rank (rendezvous
@@ -1182,20 +1324,29 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
                   r.p, plen, 0, /*allow_park=*/true, 0, shaped);
 }
 
-/* build one PUT_CHUNK frame serving [offset, offset+clen) of a payload */
-static std::vector<uint8_t> make_chunk_frame(uint64_t cookie,
-                                             uint64_t offset, uint64_t total,
-                                             const uint8_t *base,
-                                             uint64_t clen) {
-  std::vector<uint8_t> f = frame_begin(MSG_PUT_CHUNK);
-  Writer w{f};
+/* build one PUT_CHUNK message serving [offset, offset+clen) of a
+ * payload.  Scatter-gather (wire v4): the header is framed here, the
+ * payload bytes ride as a pointer into `pin` — zero payload memcpy; the
+ * pin keeps the snapshot alive until the bytes left for the kernel. */
+static OutMsg make_chunk_msg(uint64_t cookie, uint64_t offset,
+                             uint64_t total,
+                             std::shared_ptr<std::vector<uint8_t>> pin,
+                             uint64_t clen) {
+  OutMsg m;
+  if (!pin) pin = std::make_shared<std::vector<uint8_t>>();
+  m.hdr = frame_begin(MSG_PUT_CHUNK);
+  Writer w{m.hdr};
   w.u64(cookie);
   w.u64(offset);
   w.u64(total);
   w.u64(clen);
-  w.raw(base + offset, (size_t)clen);
-  frame_finish(f);
-  return f;
+  m.ext = pin->data() + offset;
+  m.ext_len = (size_t)clen;
+  m.pin = std::move(pin);
+  /* patch the length to cover header body + external payload */
+  uint32_t body_len = (uint32_t)(m.hdr.size() - 4 + m.ext_len);
+  std::memcpy(m.hdr.data(), &body_len, 4);
+  return m;
 }
 
 /* remember a cookie whose chunked pull was answered by a token, so the
@@ -1211,9 +1362,37 @@ static void remember_tokened_locked(CommEngine *ce, uint32_t from,
   }
 }
 
+/* retire a finished STREAMING session (ce->lock held): erase the
+ * session + its stream id, fold the per-hop span evidence into the
+ * stream stats.  Returns the device tag whose pin the caller must drop
+ * (dp_serve_done) outside the lock. */
+static int64_t stream_retire_locked(CommEngine *ce,
+                                    std::map<std::pair<uint32_t, uint64_t>,
+                                             ChunkServe>::iterator cs) {
+  ChunkServe &s = cs->second;
+  int64_t tag = s.tag;
+  int64_t now = ptc_now_ns();
+  if (s.t_d2h_done > s.t_start)
+    ce->stream_d2h_ns.fetch_add(s.t_d2h_done - s.t_start,
+                                std::memory_order_relaxed);
+  if (s.t_first_post) {
+    ce->stream_wire_ns.fetch_add(now - s.t_first_post,
+                                 std::memory_order_relaxed);
+    if (s.t_d2h_done > s.t_first_post)
+      ce->stream_overlap_ns.fetch_add(s.t_d2h_done - s.t_first_post,
+                                      std::memory_order_relaxed);
+  }
+  ce->streams.erase(s.stream_id);
+  ce->chunk_serves.erase(cs);
+  ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+  return tag;
+}
+
 /* serve a rendezvous pull: respond with the registered payload bytes —
  * whole (len == 0, the v2 shape) or as ranged chunks of a persistent
- * per-pull session (the pipelined path; see ChunkServe) */
+ * per-pull session (the pipelined path; see ChunkServe).  Streaming
+ * sessions (progressive serve) may PARK a ranged GET above the d2h
+ * watermark; ptc_dp_serve_progress flushes it later. */
 static void handle_get_body(CommEngine *ce, uint32_t from,
                             const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
@@ -1234,9 +1413,12 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
 
   if (chunked && offset > 0) {
     /* continuation chunk of an existing session (offset 0 creates it;
-     * per-link FIFO guarantees the creating GET arrived first) */
-    std::vector<uint8_t> cf;
+     * GETs ride rail 0, so per-link FIFO still guarantees the creating
+     * GET arrived first even on a striped mesh) */
+    OutMsg cf;
+    bool have = false;
     ptc_copy *rel = nullptr;
+    int64_t done_tag = 0;
     {
       std::lock_guard<ptc_mutex> g(ce->lock);
       if (ce->tokened.count({from, cookie}))
@@ -1244,42 +1426,66 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
       auto cs = ce->chunk_serves.find({from, cookie});
       if (cs == ce->chunk_serves.end()) return; /* reaped (peer loss) */
       ChunkServe &s = cs->second;
-      const uint8_t *base = s.buf.empty() ? nullptr : s.buf.data();
-      if (base == nullptr) {
-        auto mr = ce->mem_reg.find(s.handle);
-        if (mr == ce->mem_reg.end()) { /* should be pinned by chunk_refs */
-          ce->chunk_serves.erase(cs);
-          return;
-        }
-        base = mr->second.bytes.data();
-      }
       if (offset > s.total || req_len > s.total - offset) {
         std::fprintf(stderr, "ptc-comm: chunk GET out of range; session "
                              "dropped\n");
-        ce->chunk_serves.erase(cs);
-        return;
-      }
-      cf = make_chunk_frame(cookie, offset, s.total, base, req_len);
-      s.served += req_len;
-      if (s.served >= s.total) { /* last chunk: session retires */
-        uint64_t h = s.handle;
-        bool host_reg = s.buf.empty();
-        ce->chunk_serves.erase(cs);
-        if (host_reg) {
-          auto mr = ce->mem_reg.find(h);
-          if (mr != ce->mem_reg.end()) mr->second.chunk_refs--;
-          rel = maybe_free_reg_locked(ce, h);
+        if (s.streaming) {
+          done_tag = s.tag;
+          ce->streams.erase(s.stream_id);
         }
-        ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+        ce->chunk_serves.erase(cs);
+      } else if (s.streaming && offset + req_len > s.watermark) {
+        /* progressive serve: the requested range is beyond the d2h
+         * frontier — park it; the next watermark advance flushes it */
+        s.parked.push_back({offset, req_len});
+        ce->stream_parked.fetch_add(1, std::memory_order_relaxed);
+        return;
+      } else {
+        std::shared_ptr<std::vector<uint8_t>> base = s.buf;
+        if (!base) {
+          auto mr = ce->mem_reg.find(s.handle);
+          if (mr == ce->mem_reg.end() || !mr->second.bytes) {
+            /* should be pinned by chunk_refs */
+            ce->chunk_serves.erase(cs);
+            return;
+          }
+          base = mr->second.bytes;
+        }
+        cf = make_chunk_msg(cookie, offset, s.total, std::move(base),
+                            req_len);
+        have = true;
+        if (s.streaming && s.t_first_post == 0)
+          s.t_first_post = ptc_now_ns();
+        s.served += req_len;
+        if (s.served >= s.total) { /* last chunk: session retires */
+          if (s.streaming) {
+            done_tag = stream_retire_locked(ce, cs);
+          } else {
+            uint64_t h = s.handle;
+            bool host_reg = !s.buf;
+            ce->chunk_serves.erase(cs);
+            if (host_reg) {
+              auto mr = ce->mem_reg.find(h);
+              if (mr != ce->mem_reg.end()) mr->second.chunk_refs--;
+              rel = maybe_free_reg_locked(ce, h);
+            }
+            ce->gets_served.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
       }
     }
-    ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
-    comm_post(ce, from, std::move(cf));
+    if (have) {
+      ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
+      comm_post_chunk(ce, from, std::move(cf));
+    }
+    if (done_tag && ctx->dp_serve_done)
+      ctx->dp_serve_done(ctx->dp_user, done_tag);
     if (rel) ptc_copy_release_internal(ctx, rel);
     return;
   }
 
   uint8_t pk = PK_GET;
+  int64_t dp_total = 0;
   {
     std::unique_lock<ptc_mutex> g(ce->lock);
     if (chunked && ce->tokened.count({from, cookie})) return;
@@ -1293,16 +1499,16 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     }
     MemReg &m = it->second;
     pk = m.pk;
+    dp_total = m.dp_total;
     if (m.pk == PK_DEVICE) {
       /* fall through: serve outside the lock (calls into Python) */
     } else if (chunked) {
       /* chunked host-rendezvous serve: first chunk now; the session
        * reads the SHARED snapshot in place (chunk_refs pins it) —
        * fan-out dedup survives chunking, no per-puller copy */
-      uint64_t total = (uint64_t)m.bytes.size();
+      uint64_t total = m.bytes ? (uint64_t)m.bytes->size() : 0;
       uint64_t clen = std::min<uint64_t>(req_len, total);
-      std::vector<uint8_t> cf =
-          make_chunk_frame(cookie, 0, total, m.bytes.data(), clen);
+      OutMsg cf = make_chunk_msg(cookie, 0, total, m.bytes, clen);
       ptc_copy *rel = nullptr;
       if (clen < total) {
         ChunkServe s;
@@ -1323,7 +1529,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
       g.unlock();
       if (rel) ptc_copy_release_internal(ctx, rel);
       ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
-      comm_post(ce, from, std::move(cf));
+      comm_post_chunk(ce, from, std::move(cf));
       return;
     } else {
       /* whole-payload host serve (the v2 shape) */
@@ -1331,8 +1537,8 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
       Writer w{f};
       w.u64(cookie);
       w.u8(m.pk);
-      w.u64((uint64_t)m.bytes.size());
-      w.raw(m.bytes.data(), m.bytes.size());
+      w.u64(m.bytes ? (uint64_t)m.bytes->size() : 0);
+      if (m.bytes) w.raw(m.bytes->data(), m.bytes->size());
       frame_finish(f);
       ptc_copy *rel = retire_pull_locked(ce, src_handle, from);
       g.unlock();
@@ -1342,13 +1548,74 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
       return;
     }
   }
+  int64_t tag = (int64_t)(src_handle & ~DP_HANDLE_FLAG);
+  if (chunked && ce->stream && ctx->dp_serve_stream && dp_total > 0) {
+    /* PROGRESSIVE SERVE: offer the pull to the device layer as a
+     * streaming session — its writeback lane d2h's the mirror in
+     * chunk-sized slices and advances the session watermark through
+     * ptc_dp_serve_progress, so the first chunk hits the wire after the
+     * first slice instead of after the whole-tile d2h.  The device
+     * layer declines (returns 0) when a by-ref/transfer token is the
+     * better serve (colocated or transfer-capable puller) or the knob
+     * is off — then the synchronous dp_serve below takes over,
+     * reproducing the PR3 path bit-exactly. */
+    uint64_t sid;
+    {
+      std::lock_guard<ptc_mutex> g(ce->lock);
+      if (peer_lost_locked(ce, from)) return;
+      sid = ce->next_stream++;
+      ce->streams[sid] = CommEngine::StreamRef{from, cookie, false};
+    }
+    int32_t acc = ctx->dp_serve_stream(ctx->dp_user, tag, (int32_t)from,
+                                       (int32_t)xfer_ok, sid, dp_total);
+    if (acc > 0) {
+      ptc_copy *rel = nullptr;
+      /* allocate (and zero-fill) the session buffer BEFORE taking the
+       * engine lock: a multi-MiB value-init under ce->lock would stall
+       * every comm_post and the slicer's progress calls */
+      auto sbuf = std::make_shared<std::vector<uint8_t>>((size_t)dp_total);
+      {
+        std::lock_guard<ptc_mutex> g(ce->lock);
+        auto sit = ce->streams.find(sid);
+        if (sit == ce->streams.end() || peer_lost_locked(ce, from)) {
+          /* the puller died between the offer and the install: the
+           * reap already dropped its expectation records and pins —
+           * installing a session now would orphan it forever.  The
+           * slicer's first progress call sees the missing id and
+           * stops. */
+          ce->streams.erase(sid);
+          return;
+        }
+        sit->second.active = true;
+        ChunkServe s;
+        s.handle = src_handle;
+        s.from = from;
+        s.total = (uint64_t)dp_total;
+        s.streaming = true;
+        s.stream_id = sid;
+        s.tag = tag;
+        s.buf = std::move(sbuf);
+        s.t_start = ptc_now_ns();
+        /* the creating GET's range parks too: nothing is ready yet */
+        s.parked.push_back({0, std::min<uint64_t>(req_len, s.total)});
+        ce->chunk_serves.emplace(std::make_pair(from, cookie),
+                                 std::move(s));
+        rel = retire_pull_locked(ce, src_handle, from);
+      }
+      ce->stream_sessions.fetch_add(1, std::memory_order_relaxed);
+      ce->stream_parked.fetch_add(1, std::memory_order_relaxed);
+      if (rel) ptc_copy_release_internal(ctx, rel);
+      return;
+    }
+    std::lock_guard<ptc_mutex> g(ce->lock);
+    ce->streams.erase(sid);
+  }
   /* device-resident source: the device layer produces the bytes, or —
    * for a colocated/transfer-capable consumer — a small by-reference
    * token whose payload rides the device fabric instead of this host
    * transport */
   void *ptr = nullptr;
   int64_t real = 0;
-  int64_t tag = (int64_t)(src_handle & ~DP_HANDLE_FLAG);
   int64_t n = ctx->dp_serve ? ctx->dp_serve(ctx->dp_user, tag,
                                             (int32_t)from,
                                             (int32_t)xfer_ok, &ptr, &real)
@@ -1363,11 +1630,12 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
   if (chunked && !is_token) {
     /* chunked device serve: the d2h snapshot is taken ONCE into the
      * session (the persistent-session amortization — every later chunk
-     * is a memcpy off it), and the device pin drops immediately */
+     * is a zero-copy send off it), and the device pin drops immediately */
     uint64_t total = (uint64_t)n;
     uint64_t clen = std::min<uint64_t>(req_len, total);
-    std::vector<uint8_t> cf =
-        make_chunk_frame(cookie, 0, total, (const uint8_t *)ptr, clen);
+    auto snap = std::make_shared<std::vector<uint8_t>>(
+        (const uint8_t *)ptr, (const uint8_t *)ptr + n);
+    OutMsg cf = make_chunk_msg(cookie, 0, total, snap, clen);
     bool finish = clen >= total;
     ptc_copy *rel = nullptr;
     {
@@ -1378,7 +1646,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
         s.from = from;
         s.total = total;
         s.served = clen;
-        s.buf.assign((const uint8_t *)ptr, (const uint8_t *)ptr + n);
+        s.buf = std::move(snap);
         ce->chunk_serves.emplace(std::make_pair(from, cookie),
                                  std::move(s));
       }
@@ -1388,7 +1656,7 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
     if (rel) ptc_copy_release_internal(ctx, rel);
     if (finish) ce->gets_served.fetch_add(1, std::memory_order_relaxed);
     ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
-    comm_post(ce, from, std::move(cf));
+    comm_post_chunk(ce, from, std::move(cf));
     return;
   }
   /* token, or whole-payload device serve */
@@ -1447,6 +1715,7 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
         fh = (uint64_t)tag | DP_HANDLE_FLAG;
         MemReg &m = ce->mem_reg[fh];
         m.pk = PK_DEVICE;
+        m.dp_total = (int64_t)real_len;
         /* children that died while our pull was in flight never pull */
         excess = reg_live_children(ce, m, rchildren);
         if (m.expected == 0 && m.served == 0) ce->mem_reg.erase(fh);
@@ -1461,8 +1730,9 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
       reg_live_children(ce, m, rchildren);
       if (m.expected > 0) {
         fh = ce->next_handle++;
-        m.bytes.assign(payload, payload + plen);
-        ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+        m.bytes = std::make_shared<std::vector<uint8_t>>(payload,
+                                                         payload + plen);
+        ce->mem_reg_bytes.fetch_add(m.bytes->size(),
                                     std::memory_order_relaxed);
         ce->mem_reg.emplace(fh, std::move(m));
         fpk = PK_GET;
@@ -1478,11 +1748,17 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
   }
   /* by-reference delivery (real_len != plen): the payload rode the device
    * fabric; the host copy is allocated at real_len and materialized
-   * lazily from the device mirror via the coherence pull */
+   * lazily from the device mirror via the coherence pull.  A chunked
+   * pull hands its reassembled copy (`pg.dst`) through so delivery can
+   * reuse it instead of memcpying into a fresh one. */
   if (!pg.targets_bytes.empty())
     deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
                     pg.targets_bytes.size(), payload, plen, device_uid,
-                    /*allow_park=*/true, real_len, pg.shaped);
+                    /*allow_park=*/true, real_len, pg.shaped, pg.dst);
+  if (pg.dst) {
+    ptc_copy_release_internal(ctx, pg.dst);
+    pg.dst = nullptr;
+  }
 }
 
 /* rendezvous payload arrived whole: release the parked delivery.  Also
@@ -1543,12 +1819,13 @@ static void handle_put_chunk_body(CommEngine *ce, const uint8_t *body,
       return;
     }
     PendingGet &pg = it->second;
-    if (pg.chunk_buf.size() != total || offset > total ||
+    if (pg.dst == nullptr || pg.total != total || offset > total ||
         clen > total - offset) {
       std::fprintf(stderr, "ptc-comm: PUT_CHUNK out of range dropped\n");
       return;
     }
-    std::memcpy(pg.chunk_buf.data() + offset, r.p, (size_t)clen);
+    /* reassemble straight into the final delivery copy */
+    std::memcpy((uint8_t *)pg.dst->ptr + offset, r.p, (size_t)clen);
     pg.received += clen;
     src = pg.src_rank;
     if (pg.next_req < pg.total) {
@@ -1567,9 +1844,10 @@ static void handle_put_chunk_body(CommEngine *ce, const uint8_t *body,
   if (!next.empty()) comm_post(ce, src, std::move(next));
   if (done) {
     uint8_t pk = done_pg.pk;
-    std::vector<uint8_t> buf = std::move(done_pg.chunk_buf);
-    complete_pull(ce, std::move(done_pg), pk, buf.data(),
-                  (uint64_t)buf.size(), (uint64_t)buf.size(), cookie);
+    const uint8_t *payload = (const uint8_t *)done_pg.dst->ptr;
+    uint64_t plen = done_pg.total;
+    complete_pull(ce, std::move(done_pg), pk, payload, plen, plen,
+                  cookie);
   }
 }
 
@@ -1744,10 +2022,24 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
  * never arrive.  One helper for all three paths — clean FIN, fatal recv
  * error, desynchronized stream — so loss handling cannot drift. */
 static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
-  if (p.fd >= 0) close(p.fd);
-  p.fd = -1;
-  p.inbuf.clear();
-  p.in_off = 0;
+  {
+    /* under ce->lock: tcp_post reads rail fds and appends to the out
+     * queues under the same lock, so closing/clearing unlocked would
+     * race it */
+    std::lock_guard<ptc_mutex> g(ce->lock);
+    for (TcpRail &rl : p.rails) {
+      if (rl.fd >= 0) close(rl.fd);
+      rl.fd = -1;
+      rl.inbuf.clear();
+      rl.in_off = 0;
+      /* undeliverable queued frames die with the link: zero-copy chunk
+       * OutMsgs hold shared_ptr pins to whole payload snapshots, which
+       * would otherwise be retained for the life of the engine while
+       * the reap accounting below claims they were freed */
+      rl.out.clear();
+      rl.out_off = 0;
+    }
+  }
   if (ce->stop.load(std::memory_order_acquire)) {
     ce->fence_cv.notify_all();
     return;
@@ -1767,21 +2059,41 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
       std::fprintf(stderr, "ptc-comm: rank %u connection lost\n", rank);
     /* Reap chunk-serve sessions whose puller died: their pull was
      * already retired at session start, so only the snapshot pin
-     * (chunk_refs) remains to drop.  Device sessions own their bytes
-     * and their dp pin was already released — erasing suffices. */
+     * (chunk_refs) remains to drop.  Non-streaming device sessions own
+     * their bytes and their dp pin was already released — erasing
+     * suffices; STREAMING sessions still hold the device tag pin
+     * (dp_serve_done runs at retire), so the reap must drop it or a
+     * consumer dying between chunks pins the device array for the life
+     * of the engine. */
     for (auto it = ce->chunk_serves.begin();
          it != ce->chunk_serves.end();) {
       if (it->second.from != rank) {
         ++it;
         continue;
       }
-      if (it->second.buf.empty()) {
+      if (it->second.streaming) {
+        if (it->second.tag) dp_done.push_back(it->second.tag);
+        ce->streams.erase(it->second.stream_id);
+      } else if (!it->second.buf) {
         auto mr = ce->mem_reg.find(it->second.handle);
         if (mr != ce->mem_reg.end()) mr->second.chunk_refs--;
         ptc_copy *rel = maybe_free_reg_locked(ce, it->second.handle);
         if (rel) rels.push_back(rel);
       }
+      ce->reaps.fetch_add(1, std::memory_order_relaxed);
       it = ce->chunk_serves.erase(it);
+    }
+    /* streaming sessions not yet installed (accept-callback race) and
+     * tokened markers for the dead rank are garbage now too */
+    for (auto it = ce->streams.begin(); it != ce->streams.end();)
+      it = (it->second.from == rank) ? ce->streams.erase(it) : ++it;
+    for (auto it = ce->tokened.begin(); it != ce->tokened.end();) {
+      if (it->first == rank) {
+        ce->reaps.fetch_add(1, std::memory_order_relaxed);
+        it = ce->tokened.erase(it);
+      } else {
+        ++it;
+      }
     }
     /* Reap rendezvous registrations whose puller died: the dead rank's
      * GETs will never arrive, so drop its expectation records and free
@@ -1803,12 +2115,13 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
         continue;
       }
       m.expected -= removed;
+      ce->reaps.fetch_add((uint64_t)removed, std::memory_order_relaxed);
       if (m.pk == PK_DEVICE)
         for (int32_t k = 0; k < removed; k++)
           dp_done.push_back(
               (int64_t)(it->first & ~DP_HANDLE_FLAG));
       if (m.served >= m.expected && m.chunk_refs == 0) {
-        ce->mem_reg_bytes.fetch_sub(m.bytes.size(),
+        ce->mem_reg_bytes.fetch_sub(m.bytes ? m.bytes->size() : 0,
                                     std::memory_order_relaxed);
         if (m.src && m.in_by_copy) ce->mem_by_copy.erase(m.src);
         if (m.src && m.packed_dtype >= 0)
@@ -1825,6 +2138,7 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
          it != ce->pending_gets.end();) {
       if (it->second.src_rank == rank) {
         dropped_pulls++;
+        if (it->second.dst) rels.push_back(it->second.dst);
         it = ce->pending_gets.erase(it);
       } else {
         ++it;
@@ -1842,14 +2156,15 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
   ce->fence_cv.notify_all();
 }
 
-/* parse all complete frames in a peer's inbuf */
-static void parse_inbuf(CommEngine *ce, uint32_t rank) {
+/* parse all complete frames in one rail's inbuf */
+static void parse_inbuf(CommEngine *ce, uint32_t rank, uint32_t rail) {
   TcpPeer &p = ce->tcp.peers[rank];
+  TcpRail &rl = p.rails[rail];
   while (true) {
-    size_t avail = p.inbuf.size() - p.in_off;
+    size_t avail = rl.inbuf.size() - rl.in_off;
     if (avail < 5) break;
     uint32_t body_len;
-    std::memcpy(&body_len, p.inbuf.data() + p.in_off, 4);
+    std::memcpy(&body_len, rl.inbuf.data() + rl.in_off, 4);
     if (body_len < 1 || body_len > (1u << 30)) {
       /* desynchronized stream: resyncing is impossible — drop the peer
        * rather than misinterpreting payload bytes as frame headers */
@@ -1859,18 +2174,18 @@ static void parse_inbuf(CommEngine *ce, uint32_t rank) {
       return;
     }
     if (avail < 4 + (size_t)body_len) break;
-    const uint8_t *frame = p.inbuf.data() + p.in_off + 4;
+    const uint8_t *frame = rl.inbuf.data() + rl.in_off + 4;
     uint8_t type = frame[0];
     ce->bytes_recv.fetch_add(4 + body_len, std::memory_order_relaxed);
     handle_frame(ce, rank, type, frame + 1, body_len - 1);
-    p.in_off += 4 + body_len;
+    rl.in_off += 4 + body_len;
   }
-  if (p.in_off > 0 && p.in_off == p.inbuf.size()) {
-    p.inbuf.clear();
-    p.in_off = 0;
-  } else if (p.in_off > (1u << 20)) {
-    p.inbuf.erase(p.inbuf.begin(), p.inbuf.begin() + (long)p.in_off);
-    p.in_off = 0;
+  if (rl.in_off > 0 && rl.in_off == rl.inbuf.size()) {
+    rl.inbuf.clear();
+    rl.in_off = 0;
+  } else if (rl.in_off > (1u << 20)) {
+    rl.inbuf.erase(rl.inbuf.begin(), rl.inbuf.begin() + (long)rl.in_off);
+    rl.in_off = 0;
   }
 }
 
@@ -1879,9 +2194,15 @@ static void parse_inbuf(CommEngine *ce, uint32_t rank) {
 static void comm_main(CommEngine *ce) {
   TcpTransport &tt = ce->tcp;
   std::vector<struct pollfd> pfds;
-  std::vector<uint32_t> pfd_rank;
+  std::vector<uint32_t> pfd_rank, pfd_rail;
   uint8_t rbuf[1 << 16];
   int64_t stop_deadline = 0;
+  /* fault injection: cap each recv (forces short reads — the frame
+   * parser must reassemble fragments no matter where they split) */
+  size_t recv_cap = sizeof(rbuf);
+  if (ce->fault_recv_max > 0 &&
+      (size_t)ce->fault_recv_max < sizeof(rbuf))
+    recv_cap = (size_t)ce->fault_recv_max;
   while (true) {
     /* on stop, keep going until every deliverable out-queue drained (a
      * fence posted just before shutdown must reach the wire) — bounded
@@ -1892,23 +2213,30 @@ static void comm_main(CommEngine *ce) {
       {
         std::lock_guard<ptc_mutex> g(ce->lock);
         for (TcpPeer &p : tt.peers)
-          if (p.fd >= 0 && !p.out.empty()) pending = true;
+          for (TcpRail &rl : p.rails)
+            if (rl.fd >= 0 && !rl.out.empty()) pending = true;
       }
       if (!pending || ptc_now_ns() > stop_deadline) break;
     }
     pfds.clear();
     pfd_rank.clear();
+    pfd_rail.clear();
     pfds.push_back({tt.wake_pipe[0], POLLIN, 0});
     pfd_rank.push_back(UINT32_MAX);
+    pfd_rail.push_back(0);
     {
       std::lock_guard<ptc_mutex> g(ce->lock);
       for (uint32_t r = 0; r < ce->nodes; r++) {
         TcpPeer &p = tt.peers[r];
-        if (p.fd < 0) continue;
-        short ev = POLLIN;
-        if (!p.out.empty()) ev |= POLLOUT;
-        pfds.push_back({p.fd, ev, 0});
-        pfd_rank.push_back(r);
+        for (uint32_t l = 0; l < p.rails.size(); l++) {
+          TcpRail &rl = p.rails[l];
+          if (rl.fd < 0) continue;
+          short ev = POLLIN;
+          if (!rl.out.empty()) ev |= POLLOUT;
+          pfds.push_back({rl.fd, ev, 0});
+          pfd_rank.push_back(r);
+          pfd_rail.push_back(l);
+        }
       }
     }
     int rc = poll(pfds.data(), (nfds_t)pfds.size(), 50);
@@ -1919,16 +2247,23 @@ static void comm_main(CommEngine *ce) {
     }
     for (size_t i = 1; i < pfds.size(); i++) {
       uint32_t r = pfd_rank[i];
+      uint32_t l = pfd_rail[i];
       TcpPeer &p = tt.peers[r];
+      TcpRail &rl = p.rails[l];
+      /* a sibling rail's loss closed this whole peer link mid-pass: the
+       * polled fd is stale (closed), recv on it would be EBADF noise */
+      if (rl.fd < 0 || rl.fd != pfds[i].fd) continue;
       if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
         while (true) {
-          ssize_t n = recv(p.fd, rbuf, sizeof(rbuf), 0);
+          if (ce->fault_delay_us > 0)
+            usleep((useconds_t)ce->fault_delay_us);
+          ssize_t n = recv(rl.fd, rbuf, recv_cap, 0);
           if (n > 0) {
-            p.inbuf.insert(p.inbuf.end(), rbuf, rbuf + n);
-            if ((size_t)n < sizeof(rbuf)) break;
+            rl.inbuf.insert(rl.inbuf.end(), rbuf, rbuf + n);
+            if ((size_t)n < recv_cap) break;
           } else if (n == 0) {
             /* peer closed (clean FIN): expected at shutdown, a failure
-             * otherwise */
+             * otherwise.  Any rail's death kills the whole peer link. */
             mark_peer_lost(ce, p, r);
             break;
           } else {
@@ -1945,22 +2280,48 @@ static void comm_main(CommEngine *ce) {
             break;
           }
         }
-        if (p.fd >= 0) parse_inbuf(ce, r);
+        if (rl.fd >= 0) parse_inbuf(ce, r, l);
       }
-      if (p.fd >= 0 && (pfds[i].revents & POLLOUT)) {
+      if (rl.fd >= 0 && (pfds[i].revents & POLLOUT)) {
         std::unique_lock<ptc_mutex> g(ce->lock);
-        while (!p.out.empty()) {
-          std::vector<uint8_t> &f = p.out.front();
-          size_t todo = f.size() - p.out_off;
+        while (!rl.out.empty()) {
+          /* scatter-gather send: header bytes + (for zero-copy chunk
+           * frames) payload straight from the pinned snapshot.  The
+           * deque front reference stays valid across the unlocked
+           * sendmsg — producers only push_back, this loop is the only
+           * popper. */
+          OutMsg &m = rl.out.front();
+          size_t off = rl.out_off;
+          struct iovec iov[2];
+          int niov = 0;
+          if (off < m.hdr.size()) {
+            iov[niov].iov_base = m.hdr.data() + off;
+            iov[niov].iov_len = m.hdr.size() - off;
+            niov++;
+            off = 0;
+          } else {
+            off -= m.hdr.size();
+          }
+          if (m.ext && off < m.ext_len) {
+            iov[niov].iov_base = (void *)(m.ext + off);
+            iov[niov].iov_len = m.ext_len - off;
+            niov++;
+          }
+          size_t todo = 0;
+          for (int k = 0; k < niov; k++) todo += iov[k].iov_len;
+          struct msghdr mh;
+          std::memset(&mh, 0, sizeof(mh));
+          mh.msg_iov = iov;
+          mh.msg_iovlen = (size_t)niov;
           g.unlock();
-          ssize_t n = send(p.fd, f.data() + p.out_off, todo, MSG_NOSIGNAL);
+          ssize_t n = sendmsg(rl.fd, &mh, MSG_NOSIGNAL);
           g.lock();
           if (n > 0) {
             ce->bytes_sent.fetch_add((uint64_t)n, std::memory_order_relaxed);
-            p.out_off += (size_t)n;
-            if (p.out_off == f.size()) {
-              p.out.pop_front();
-              p.out_off = 0;
+            rl.out_off += (size_t)n;
+            if (rl.out_off == m.size()) {
+              rl.out.pop_front();
+              rl.out_off = 0;
             }
             if ((size_t)n < todo) break; /* kernel buffer full */
           } else {
@@ -2028,25 +2389,36 @@ static void tcp_wake(CommEngine *ce) {
   (void)n;
 }
 
-static void tcp_post(CommEngine *ce, uint32_t rank,
-                     std::vector<uint8_t> &&frame) {
+static void tcp_post(CommEngine *ce, uint32_t rank, OutMsg &&msg,
+                     uint32_t rail) {
   {
     std::lock_guard<ptc_mutex> g(ce->lock);
-    ce->tcp.peers[rank].out.push_back(std::move(frame));
+    TcpPeer &p = ce->tcp.peers[rank];
+    /* a rail lost mid-run falls back to rail 0 (peer-loss handling
+     * closes all rails together, so this only covers transient skew);
+     * a fully-dead peer link drops the message — queueing it would pin
+     * its payload snapshot forever with nothing to drain it */
+    if (rail >= p.rails.size() || p.rails[rail].fd < 0) rail = 0;
+    if (p.rails.empty() || p.rails[0].fd < 0) return;
+    p.rails[rail].out.push_back(std::move(msg));
   }
   tcp_wake(ce);
 }
 
 static int32_t tcp_start(CommEngine *ce, int base_port) {
   TcpTransport &tt = ce->tcp;
+  uint32_t rails = ce->rails > 0 ? (uint32_t)ce->rails : 1;
   tt.peers.resize(ce->nodes);
+  for (TcpPeer &p : tt.peers) p.rails.resize(rails);
   if (pipe(tt.wake_pipe) != 0) return -1;
   {
     int fl = fcntl(tt.wake_pipe[0], F_GETFL, 0);
     fcntl(tt.wake_pipe[0], F_SETFL, fl | O_NONBLOCK);
   }
   /* rank r listens on base+r; connects to all lower ranks, accepts from
-   * all higher ranks.  Loopback full mesh (DCN analog). */
+   * all higher ranks.  Loopback full mesh (DCN analog); with rails > 1
+   * each peer link is `rails` striped connections (the hello names the
+   * rail — wire v4). */
   tt.listen_fd = make_listen(base_port + (int)ce->myrank);
   if (tt.listen_fd < 0) {
     std::fprintf(stderr, "ptc-comm: cannot listen on port %d: %s\n",
@@ -2054,26 +2426,30 @@ static int32_t tcp_start(CommEngine *ce, int base_port) {
     return -1;
   }
   for (uint32_t r = 0; r < ce->myrank; r++) {
-    int fd = connect_retry(base_port + (int)r, 30000);
-    if (fd < 0) {
-      std::fprintf(stderr, "ptc-comm: cannot connect to rank %u\n", r);
-      return -1;
+    for (uint32_t l = 0; l < rails; l++) {
+      int fd = connect_retry(base_port + (int)r, 30000);
+      if (fd < 0) {
+        std::fprintf(stderr, "ptc-comm: cannot connect to rank %u\n", r);
+        return -1;
+      }
+      /* magic + protocol version + rank + rail: a mismatched build (or
+       * a stray client) is rejected at connect instead of
+       * desynchronizing the frame stream later (reference: the OOB
+       * version handshake role) */
+      uint32_t hello[4] = {PTC_WIRE_MAGIC, PTC_WIRE_VERSION, ce->myrank,
+                           l};
+      if (send(fd, hello, sizeof(hello), 0) != (ssize_t)sizeof(hello)) {
+        close(fd);
+        return -1;
+      }
+      set_sock_opts(fd);
+      tt.peers[r].rails[l].fd = fd;
     }
-    /* magic + protocol version + rank: a mismatched build (or a stray
-     * client) is rejected at connect instead of desynchronizing the
-     * frame stream later (reference: the OOB version handshake role) */
-    uint32_t hello[3] = {PTC_WIRE_MAGIC, PTC_WIRE_VERSION, ce->myrank};
-    if (send(fd, hello, sizeof(hello), 0) != (ssize_t)sizeof(hello)) {
-      close(fd);
-      return -1;
-    }
-    set_sock_opts(fd);
-    tt.peers[r].fd = fd;
   }
-  /* accept until every higher rank has handshaken; stray connections
-   * (port scanners, test port probes) are rejected without consuming a
-   * peer slot */
-  uint32_t accepted = 0, expected = ce->nodes - 1 - ce->myrank;
+  /* accept until every higher rank has handshaken all its rails; stray
+   * connections (port scanners, test port probes) are rejected without
+   * consuming a peer slot */
+  uint32_t accepted = 0, expected = (ce->nodes - 1 - ce->myrank) * rails;
   int strays = 0;
   while (accepted < expected) {
     int fd = accept(tt.listen_fd, nullptr, nullptr);
@@ -2085,18 +2461,25 @@ static int32_t tcp_start(CommEngine *ce, int base_port) {
      * socket open must not wedge the single-threaded accept loop */
     struct timeval hs_to = {5, 0};
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hs_to, sizeof(hs_to));
-    uint32_t hello[3] = {0, 0, 0};
+    uint32_t hello[4] = {0, 0, 0, 0};
     ssize_t got = recv(fd, hello, sizeof(hello), MSG_WAITALL);
-    uint32_t who = hello[2];
+    uint32_t who = hello[2], rail = hello[3];
     if (got != (ssize_t)sizeof(hello) || hello[0] != PTC_WIRE_MAGIC ||
         hello[1] != PTC_WIRE_VERSION || who <= ce->myrank ||
-        who >= ce->nodes || tt.peers[who].fd >= 0) {
-      if (got == (ssize_t)sizeof(hello) && hello[0] == PTC_WIRE_MAGIC &&
-          hello[1] != PTC_WIRE_VERSION)
+        who >= ce->nodes || rail >= rails ||
+        tt.peers[who].rails[rail].fd >= 0) {
+      if (got >= (ssize_t)(3 * sizeof(uint32_t)) &&
+          hello[0] == PTC_WIRE_MAGIC && hello[1] != PTC_WIRE_VERSION)
         std::fprintf(stderr,
                      "ptc-comm: peer speaks wire version %u, this build "
                      "speaks %u — mixed builds in one job?\n", hello[1],
                      PTC_WIRE_VERSION);
+      else if (got == (ssize_t)sizeof(hello) &&
+               hello[0] == PTC_WIRE_MAGIC && rail >= rails)
+        std::fprintf(stderr,
+                     "ptc-comm: peer rank %u presents rail %u but this "
+                     "rank runs %u rail(s) — PTC_MCA_comm_rails must be "
+                     "uniform across the job\n", who, rail, rails);
       else
         std::fprintf(stderr, "ptc-comm: rejecting bad peer handshake\n");
       close(fd);
@@ -2104,7 +2487,7 @@ static int32_t tcp_start(CommEngine *ce, int base_port) {
       continue;
     }
     set_sock_opts(fd);
-    tt.peers[who].fd = fd;
+    tt.peers[who].rails[rail].fd = fd;
     accepted++;
   }
   tt.thread = std::thread(comm_main, ce);
@@ -2295,6 +2678,7 @@ void ptc_comm_send_activate_batch(
       if (!lost) {
         MemReg &m = ce->mem_reg[dp_h];
         m.pk = PK_DEVICE;
+        m.dp_total = copy->size; /* streaming session allocation size */
         m.expected++;
         m.targets.push_back(rank);
       }
@@ -2344,13 +2728,15 @@ void ptc_comm_send_activate_batch(
         m.src = copy;
         ptc_copy_retain(copy); /* pointer identity pin until last pull */
         if (is_packed)
-          m.bytes = std::move(packed);
+          m.bytes = std::make_shared<std::vector<uint8_t>>(
+              std::move(packed));
         else
-          m.bytes.assign((const uint8_t *)copy->ptr,
-                         (const uint8_t *)copy->ptr + copy->size);
+          m.bytes = std::make_shared<std::vector<uint8_t>>(
+              (const uint8_t *)copy->ptr,
+              (const uint8_t *)copy->ptr + copy->size);
         m.in_by_copy = !is_packed;
         m.packed_dtype = is_packed ? send_dtype : -1;
-        ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+        ce->mem_reg_bytes.fetch_add(m.bytes->size(),
                                     std::memory_order_relaxed);
         ce->mem_reg.emplace(h, std::move(m));
         if (is_packed)
@@ -2465,6 +2851,7 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
         std::lock_guard<ptc_mutex> g(ce->lock);
         MemReg &m = ce->mem_reg[dp_h];
         m.pk = PK_DEVICE;
+        m.dp_total = (int64_t)plen;
         excess = reg_live_children(ce, m, children);
         if (m.expected == 0 && m.served == 0) ce->mem_reg.erase(dp_h);
       }
@@ -2513,13 +2900,15 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
         m.src = copy;
         ptc_copy_retain(copy);
         if (is_packed)
-          m.bytes = std::move(packed);
+          m.bytes = std::make_shared<std::vector<uint8_t>>(
+              std::move(packed));
         else
-          m.bytes.assign((const uint8_t *)copy->ptr,
-                         (const uint8_t *)copy->ptr + copy->size);
+          m.bytes = std::make_shared<std::vector<uint8_t>>(
+              (const uint8_t *)copy->ptr,
+              (const uint8_t *)copy->ptr + copy->size);
         m.in_by_copy = !is_packed;
         m.packed_dtype = is_packed ? send_dtype : -1;
-        ce->mem_reg_bytes.fetch_add(m.bytes.size(),
+        ce->mem_reg_bytes.fetch_add(m.bytes->size(),
                                     std::memory_order_relaxed);
         ce->mem_reg.emplace(h, std::move(m));
         if (is_packed)
@@ -2656,6 +3045,15 @@ void ptc_comm_shutdown(ptc_context *ctx) {
   /* release rendezvous sources that were never fully pulled */
   for (auto &kv : ce->mem_reg)
     if (kv.second.src) ptc_copy_release_internal(ctx, kv.second.src);
+  /* release reassembly copies of pulls that never completed */
+  for (auto &kv : ce->pending_gets)
+    if (kv.second.dst) ptc_copy_release_internal(ctx, kv.second.dst);
+  /* drop the device pins of streaming sessions that never retired
+   * (puller hung / fence timed out): the _DP_REG refcount otherwise
+   * stays pinned in the process-global device registry forever */
+  for (auto &kv : ce->chunk_serves)
+    if (kv.second.streaming && kv.second.tag && ctx->dp_serve_done)
+      ctx->dp_serve_done(ctx->dp_user, kv.second.tag);
   ctx->comm = nullptr;
   delete ce;
 }
@@ -2755,6 +3153,18 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
     ce->inflight = (int32_t)std::atoi(e);
     if (ce->inflight < 1) ce->inflight = 1;
   }
+  if (const char *e = std::getenv("PTC_MCA_comm_rails")) {
+    ce->rails = (int32_t)std::atoi(e);
+    if (ce->rails < 1) ce->rails = 1;
+    if (ce->rails > 16) ce->rails = 16;
+  }
+  if (const char *e = std::getenv("PTC_MCA_comm_stream"))
+    ce->stream = std::atoi(e) != 0;
+  if (const char *e = std::getenv("PTC_COMM_FAULT_RECV_MAX"))
+    ce->fault_recv_max = std::atoll(e);
+  if (const char *e = std::getenv("PTC_COMM_FAULT_DELAY_US"))
+    ce->fault_delay_us = std::atoll(e);
+  ce->rail_rr = std::vector<std::atomic<uint32_t>>(ctx->nodes);
   if (const char *e = std::getenv("PTC_MCA_comm_fence_timeout_s"))
     ce->fence_timeout_s = std::atoll(e);
   if (ce->ops->start(ce, base_port) != 0) {
@@ -2810,7 +3220,8 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
        * frame was posted since the last snapshot */
       mydirty = (act != ce->fence_prev_activity ||
                  !ce->pending_gets.empty() || !ce->mem_reg.empty() ||
-                 !ce->chunk_serves.empty()) ? 1 : 0;
+                 !ce->chunk_serves.empty() || !ce->streams.empty())
+                    ? 1 : 0;
       ce->fence_prev_activity = act;
     }
     for (uint32_t r = 0; r < ce->nodes; r++) {
@@ -2893,7 +3304,7 @@ int32_t ptc_comm_quiesce(ptc_context_t *ctx, ptc_taskpool_t *tp) {
       mine.sent = ce->app_sent.load(std::memory_order_relaxed);
       mine.recv = ce->app_recv.load(std::memory_order_relaxed);
       bool busy = !ce->pending_gets.empty() || !ce->mem_reg.empty() ||
-                  !ce->chunk_serves.empty();
+                  !ce->chunk_serves.empty() || !ce->streams.empty();
       if (tp) {
         busy = busy || tp->nb_tasks.load() > 0;
       } else {
@@ -3037,6 +3448,89 @@ void ptc_comm_tuning(ptc_context_t *ctx, int64_t *out8) {
   out8[5] = ce ? (int64_t)ce->chunks_sent.load() : 0;
   out8[6] = ce ? (int64_t)ce->chunks_recv.load() : 0;
   out8[7] = (ce && ce->eager_adaptive) ? 1 : 0;
+}
+
+/* streaming-pipeline counters + per-hop span evidence:
+ * [0] progressive-serve sessions   [1] ranged GETs parked > watermark
+ * [2] d2h∩wire overlap ns          [3] d2h window ns (sum)
+ * [4] wire window ns (sum)         [5] sessions/pins reaped (peer loss)
+ * [6] rails per peer               [7] streaming enabled flag */
+void ptc_comm_stream_stats(ptc_context_t *ctx, int64_t *out8) {
+  CommEngine *ce = ctx->comm;
+  out8[0] = ce ? (int64_t)ce->stream_sessions.load() : 0;
+  out8[1] = ce ? (int64_t)ce->stream_parked.load() : 0;
+  out8[2] = ce ? ce->stream_overlap_ns.load() : 0;
+  out8[3] = ce ? ce->stream_d2h_ns.load() : 0;
+  out8[4] = ce ? ce->stream_wire_ns.load() : 0;
+  out8[5] = ce ? (int64_t)ce->reaps.load() : 0;
+  out8[6] = ce ? (int64_t)ce->rails : 0;
+  out8[7] = (ce && ce->stream) ? 1 : 0;
+}
+
+/* PROGRESSIVE SERVE d2h hook (wire v4 streaming): the device layer's
+ * writeback lane pushes one d2h slice of a streaming session's payload.
+ * Bytes land at `offset` in the session buffer, the ready-bytes
+ * watermark advances, and every parked ranged GET now at or below the
+ * watermark is answered (striped across the rails).  Returns
+ *   2  slice absorbed and the session completed with it: stop
+ *   1  slice absorbed, keep streaming
+ *   0  session is gone (retired / puller lost / engine stopping): the
+ *      slice was NOT absorbed, stop
+ *  -1  session not installed yet (the accept callback races the
+ *      session install by design): retry the same slice shortly        */
+int32_t ptc_dp_serve_progress(ptc_context_t *ctx, uint64_t stream_id,
+                              const void *bytes, uint64_t offset,
+                              uint64_t len) {
+  CommEngine *ce = ctx->comm;
+  if (!ce || ce->stop.load(std::memory_order_acquire)) return 0;
+  std::vector<OutMsg> frames;
+  uint32_t dest = 0;
+  int64_t done_tag = 0;
+  {
+    std::lock_guard<ptc_mutex> g(ce->lock);
+    auto sit = ce->streams.find(stream_id);
+    if (sit == ce->streams.end()) return 0;
+    if (!sit->second.active) return -1;
+    dest = sit->second.from;
+    auto cs = ce->chunk_serves.find({sit->second.from,
+                                     sit->second.cookie});
+    if (cs == ce->chunk_serves.end()) {
+      ce->streams.erase(sit);
+      return 0;
+    }
+    ChunkServe &s = cs->second;
+    if (offset > s.total || len > s.total - offset) {
+      std::fprintf(stderr, "ptc-comm: stream progress out of range "
+                           "(off %llu len %llu total %llu); dropped\n",
+                   (unsigned long long)offset, (unsigned long long)len,
+                   (unsigned long long)s.total);
+      return 0;
+    }
+    std::memcpy(s.buf->data() + offset, bytes, (size_t)len);
+    if (offset + len > s.watermark) s.watermark = offset + len;
+    if (s.watermark >= s.total && s.t_d2h_done == 0)
+      s.t_d2h_done = ptc_now_ns();
+    /* flush every parked range the watermark now covers */
+    for (auto it = s.parked.begin(); it != s.parked.end();) {
+      if (it->first + it->second <= s.watermark) {
+        frames.push_back(make_chunk_msg(sit->second.cookie, it->first,
+                                        s.total, s.buf, it->second));
+        if (s.t_first_post == 0) s.t_first_post = ptc_now_ns();
+        s.served += it->second;
+        it = s.parked.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    if (s.served >= s.total) done_tag = stream_retire_locked(ce, cs);
+  }
+  for (auto &f : frames) {
+    ce->chunks_sent.fetch_add(1, std::memory_order_relaxed);
+    comm_post_chunk(ce, dest, std::move(f));
+  }
+  if (done_tag && ctx->dp_serve_done)
+    ctx->dp_serve_done(ctx->dp_user, done_tag);
+  return done_tag ? 2 : 1;
 }
 
 } /* extern "C" */

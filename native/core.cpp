@@ -3462,6 +3462,10 @@ void ptc_set_dp_can_pull(ptc_context_t *ctx, int32_t ok) {
   if (ctx) ctx->dp_can_pull.store(ok, std::memory_order_relaxed);
 }
 
+void ptc_set_dp_stream(ptc_context_t *ctx, ptc_dp_serve_stream_cb cb) {
+  if (ctx) ctx->dp_serve_stream = cb;
+}
+
 /* task accessors */
 int64_t ptc_task_local(ptc_task_t *t, int32_t i) {
   return (t && i >= 0 && i < PTC_MAX_LOCALS) ? t->locals[i] : 0;

@@ -476,6 +476,27 @@ void ptc_set_dataplane(ptc_context_t *ctx, ptc_dp_register_cb reg,
                        ptc_dp_serve_cb serve, ptc_dp_serve_done_cb done,
                        ptc_dp_deliver_cb deliver, ptc_dp_bound_cb bound,
                        void *user);
+/* PROGRESSIVE SERVE (wire v4 streaming, PTC_MCA_comm_stream): when
+ * registered, a chunked pull of a device payload is first OFFERED to
+ * the device layer as a streaming session:
+ *   dp_serve_stream(tag, from, xfer_ok, stream_id, total) -> 1 to
+ *       accept (the device layer then d2h's the mirror in slices on its
+ *       writeback lane, pushing each through ptc_dp_serve_progress), or
+ *       0 to decline (the synchronous dp_serve path takes over — the
+ *       right answer when a colocated/transfer token is the better
+ *       serve).  Called on the comm thread; accept must only ENQUEUE
+ *       the slicing work, never block on it.
+ * ptc_dp_serve_progress returns 2 (absorbed, session completed: stop),
+ * 1 (absorbed, keep streaming), 0 (session gone: stop), -1 (session
+ * not installed yet: retry the same slice). */
+typedef int32_t (*ptc_dp_serve_stream_cb)(void *user, int64_t tag,
+                                          int32_t from, int32_t xfer_ok,
+                                          uint64_t stream_id,
+                                          int64_t total);
+void ptc_set_dp_stream(ptc_context_t *ctx, ptc_dp_serve_stream_cb cb);
+int32_t ptc_dp_serve_progress(ptc_context_t *ctx, uint64_t stream_id,
+                              const void *bytes, uint64_t offset,
+                              uint64_t len);
 /* Advertise this rank's transfer-plane PULL capability on outgoing GET
  * frames (0 until the device layer's probe succeeds).  Producers serve
  * cross-process device tokens only to capable pullers; everyone else
@@ -515,6 +536,9 @@ void ptc_comm_rdv_stats(ptc_context_t *ctx, int64_t *out4);
 /* transfer tuning: [eager_limit, chunk_size, inflight, rtt_ns,
  * memcpy_bps, chunks_sent, chunks_recv, eager_adaptive] */
 void ptc_comm_tuning(ptc_context_t *ctx, int64_t *out8);
+/* streaming pipeline: [sessions, parked_gets, overlap_ns, d2h_ns,
+ * wire_ns, reaps, rails, stream_enabled] */
+void ptc_comm_stream_stats(ptc_context_t *ctx, int64_t *out8);
 
 /* distributed taskpool id (SPMD creation order; assigned at add_taskpool) */
 int32_t ptc_tp_id(ptc_taskpool_t *tp);

@@ -770,6 +770,8 @@ struct ptc_context {
   ptc_dp_serve_done_cb dp_serve_done = nullptr;
   ptc_dp_deliver_cb dp_deliver = nullptr;
   ptc_dp_bound_cb dp_bound = nullptr;
+  /* progressive-serve offer (wire v4 streaming; see parsec_core.h) */
+  ptc_dp_serve_stream_cb dp_serve_stream = nullptr;
   void *dp_user = nullptr;
   /* this rank's transfer-plane pull capability, stamped on GET frames */
   std::atomic<int32_t> dp_can_pull{0};

@@ -123,6 +123,10 @@ DP_DELIVER_CB_T = C.CFUNCTYPE(C.c_int64, C.c_void_p, C.c_void_p, C.c_int64,
                               C.c_int64)
 DP_BOUND_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_int64, C.c_void_p,
                             C.c_int64, C.c_int32)
+# progressive-serve offer (wire v4 streaming): (user, tag, from, xfer_ok,
+# stream_id, total) -> 1 accept / 0 decline
+DP_STREAM_CB_T = C.CFUNCTYPE(C.c_int32, C.c_void_p, C.c_int64, C.c_int32,
+                             C.c_int32, C.c_uint64, C.c_int64)
 TP_COMPLETE_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.c_void_p)
 PINS_CB_T = C.CFUNCTYPE(None, C.c_void_p, C.POINTER(C.c_int64))
 
@@ -210,6 +214,10 @@ _sigs = {
                                  DP_SERVE_DONE_CB_T, DP_DELIVER_CB_T,
                                  DP_BOUND_CB_T, C.c_void_p]),
     "ptc_set_dp_can_pull": (None, [C.c_void_p, C.c_int32]),
+    "ptc_set_dp_stream": (None, [C.c_void_p, DP_STREAM_CB_T]),
+    "ptc_dp_serve_progress": (C.c_int32, [C.c_void_p, C.c_uint64,
+                                          C.c_void_p, C.c_uint64,
+                                          C.c_uint64]),
     "ptc_task_local": (C.c_int64, [C.c_void_p, C.c_int32]),
     "ptc_task_class": (C.c_int32, [C.c_void_p]),
     "ptc_task_priority": (C.c_int32, [C.c_void_p]),
@@ -260,6 +268,7 @@ _sigs = {
     "ptc_comm_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_rdv_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_tuning": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
+    "ptc_comm_stream_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_tp_id": (C.c_int32, [C.c_void_p]),
     "ptc_dtile_set_owner": (None, [C.c_void_p, C.c_uint32]),
     "ptc_dtask_set_rank": (None, [C.c_void_p, C.c_int32]),

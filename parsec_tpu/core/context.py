@@ -392,10 +392,56 @@ class Context:
         its JSON so every report names the knobs it ran under."""
         buf = (C.c_int64 * 8)()
         N.lib.ptc_comm_tuning(self._ptr, buf)
-        return {"eager_limit": buf[0], "chunk_size": buf[1],
-                "inflight": buf[2], "rtt_ns": buf[3],
-                "memcpy_bps": buf[4], "chunks_sent": buf[5],
-                "chunks_recv": buf[6], "eager_adaptive": bool(buf[7])}
+        out = {"eager_limit": buf[0], "chunk_size": buf[1],
+               "inflight": buf[2], "rtt_ns": buf[3],
+               "memcpy_bps": buf[4], "chunks_sent": buf[5],
+               "chunks_recv": buf[6], "eager_adaptive": bool(buf[7])}
+        out["stream"] = self.comm_stream_stats()
+        return out
+
+    def comm_stream_stats(self) -> dict:
+        """Cross-rank streaming-pipeline counters (wire v4): progressive-
+        serve sessions, ranged GETs parked above the d2h watermark, the
+        per-hop span sums (d2h window, wire window, their overlap — the
+        serialized PR3 serve has overlap 0 by construction), peer-loss
+        session/pin reaps, and the rail count.  overlap_fraction is the
+        share of producer d2h time the wire was already moving under —
+        the tentpole's evidence number."""
+        buf = (C.c_int64 * 8)()
+        N.lib.ptc_comm_stream_stats(self._ptr, buf)
+        d2h = buf[3]
+        return {"sessions": buf[0], "parked_gets": buf[1],
+                "overlap_ns": buf[2], "d2h_ns": d2h, "wire_ns": buf[4],
+                "reaps": buf[5], "rails": buf[6],
+                "stream_enabled": bool(buf[7]),
+                "overlap_fraction":
+                    round(buf[2] / d2h, 4) if d2h > 0 else None}
+
+    def stats(self) -> dict:
+        """Unified counter snapshot: every stats surface this context
+        exports, merged under one namespaced dict — ONE call for the
+        serving/observability layers instead of four, taken at a single
+        point in time.
+          sched   -> sched_stats() (dispatch fast paths, steals, ...)
+          device  -> device_stats() (prefetch/spill/h2d, per-device info)
+          comm    -> engine/rdv/tuning/stream counter groups (empty
+                     sub-dicts stay present when comm is off, so the
+                     schema is stable across single- and multi-rank runs)
+        """
+        tuning = self.comm_tuning()
+        return {
+            "sched": self.sched_stats(),
+            "device": self.device_stats(),
+            "comm": {
+                "enabled": self.comm_enabled,
+                "engine": self.comm_stats(),
+                "rdv": self.comm_rdv_stats(),
+                "tuning": tuning,
+                # same snapshot as tuning["stream"], surfaced at the top
+                # level too — one native read, two access paths, no skew
+                "stream": tuning["stream"],
+            },
+        }
 
     # ------------------------------------------------------------ registries
     def register_expr_cb(self, fn: Callable) -> int:
@@ -640,7 +686,8 @@ class Context:
                 "prefetch_misses", "prefetch_wasted", "reserve_fails",
                 "spills", "spill_bytes", "h2d_stall_ns",
                 "prefetch_h2d_ns", "ooc_waits", "h2d_hits", "h2d_bytes",
-                "evictions")
+                "evictions", "stream_serves", "stream_slices",
+                "stream_d2h_ns", "stream_bytes", "prefetch_wakeups")
         agg = {k: sum(d["stats"].get(k, 0) for d in devs) for k in keys}
         moved = agg["prefetch_h2d_ns"] + agg["h2d_stall_ns"]
         agg["overlap_ratio"] = (

@@ -81,18 +81,30 @@ class _PrefetchLane:
 
     # ------------------------------------------------------------ loop
     def _loop(self):
+        """Sweep whenever there is lookahead to stage.  Idle waits ride
+        the device's wake event instead of a fixed poll interval: a
+        remote delivery (dp_deliver) sets it, so staging of tile k
+        starts the moment its bytes land — while tile k+1 is still on
+        the wire — instead of up to a poll period later (the sweep-poll
+        latency the event-driven wakeup removes for remote tiles)."""
         dev = self.dev
         ctx = dev.ctx
+
+        def wait(timeout: float) -> None:
+            if dev._pf_wake.wait(timeout):
+                dev._stats_add("prefetch_wakeups", 1)
+            dev._pf_wake.clear()
+
         while not self._stop.is_set():
             try:
                 if N.lib.ptc_device_queue_depth(ctx._ptr, dev.qid) <= 0:
                     if dev._pf_pin:
                         with dev._lock:
                             dev._pf_pin = set()
-                    time.sleep(0.001)
+                    wait(0.001)
                     continue
                 if not self._sweep():
-                    time.sleep(0.0005)
+                    wait(0.0005)
             except Exception:
                 import traceback
                 traceback.print_exc()

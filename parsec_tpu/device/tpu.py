@@ -141,10 +141,11 @@ def maybe_sync_copy(cptr) -> None:
 
 _DP_LOCK = threading.Lock()
 _DP_STATE = {"next_tag": 1}
-# tag -> [device array, refcount, key, raw]; tags are shared per
+# tag -> [device array, refcount, key, raw, dev]; tags are shared per
 # (copy_handle, version) across send batches so a fan-out pins ONE array;
 # `raw` (flat-uint8 mirror) travels with by-ref handoffs so relayed
-# payloads keep their reinterpret-at-stage-in semantics
+# payloads keep their reinterpret-at-stage-in semantics; `dev` is the
+# owning TpuDevice (its writeback lane runs progressive-serve slicing)
 _DP_REG: Dict[int, list] = {}
 _DP_BY_KEY: Dict[tuple, int] = {}
 # tag -> [pinned host-byte buffers], one entry per live serve: with the
@@ -340,7 +341,7 @@ def _make_dp_callbacks(ctx):
                                 tag = _DP_STATE["next_tag"]
                                 _DP_STATE["next_tag"] += 1
                                 _DP_REG[tag] = [_conc(ent), 1, key,
-                                                ent.raw]
+                                                ent.raw, dev]
                                 _DP_BY_KEY[key] = tag
                         dev.stats["dp_sends"] += 1
                         return tag
@@ -391,6 +392,47 @@ def _make_dp_callbacks(ctx):
             traceback.print_exc()
             return -1
 
+    def dp_serve_stream(user, tag, from_rank, xfer_ok, stream_id,
+                        total) -> int:
+        """Progressive-serve offer (wire v4 streaming): accept by
+        ENQUEUEING the sliced d2h onto the owning device's writeback
+        lane (never block — this runs on the comm thread).  Decline
+        whenever the synchronous dp_serve would produce a better
+        answer: a colocated by-ref handoff or a transfer-plane token
+        moves the tile over the device fabric, which no byte stream
+        beats."""
+        try:
+            from ..utils import params as _mca
+            if not _mca.get("device.stream_serve"):
+                return 0
+            if from_rank in ctx._colocated:
+                return 0  # by-ref handoff wins
+            if xfer_ok and _xfer_enabled():
+                return 0  # device-fabric transfer token wins
+            with _DP_LOCK:
+                rec = _DP_REG.get(tag)
+            if rec is None:
+                return 0
+            arr, dev = rec[0], rec[4]
+            if dev is None or int(arr.nbytes) != int(total):
+                return 0
+            if dev._wb_thread is None or not dev._wb_thread.is_alive():
+                return 0
+            with _DP_LOCK:
+                # placeholder pin: the engine calls dp_serve_done once
+                # per serve, streaming or not — without a matching push
+                # the retire would pop a CONCURRENT synchronous serve's
+                # buffer pin early (use-after-free on the wire).  The
+                # pin list only guarantees balanced counts, so a None
+                # entry is enough.
+                _DP_SERVING.setdefault(tag, []).append(None)
+            dev._wb_q.put(("stream", [], (int(stream_id), int(tag))))
+            return 1
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            return 0
+
     def dp_serve_done(user, tag) -> None:
         with _DP_LOCK:
             pins = _DP_SERVING.get(tag)
@@ -433,6 +475,7 @@ def _make_dp_callbacks(ctx):
                 # mirror stays raw (consumers reinterpret at stage-in)
                 dev._cache_put(uid, 0, darr, arr.nbytes, raw=was_raw)
                 dev._stats_add("dp_d2d_bytes", arr.nbytes)
+                dev._pf_wake.set()
                 return uid
             if size > 21 and raw[:8] == _DP_XFER_MAGIC:
                 # cross-process transfer token: pull device-to-device
@@ -442,6 +485,7 @@ def _make_dp_callbacks(ctx):
                 uid = _next_uid()
                 dev._cache_put(uid, 0, darr, darr.nbytes, raw=was_raw)
                 dev._stats_add("dp_xfer_bytes", darr.nbytes)
+                dev._pf_wake.set()
                 return uid
             host = np.frombuffer(src, dtype=np.uint8, count=size).copy()
             darr = dev._jax.device_put(host, dev.device)
@@ -450,6 +494,10 @@ def _make_dp_callbacks(ctx):
             # raw=True: stage-in reinterprets to the consumer's dtype/shape
             dev._cache_put(uid, 0, darr, size, raw=True)
             dev._stats_add("dp_recv_bytes", size)
+            # event-driven prefetch: a remote tile just landed — wake the
+            # lane NOW instead of waiting out its poll interval, so h2d
+            # staging of tile k starts while tile k+1 is on the wire
+            dev._pf_wake.set()
             return uid
         except Exception:
             import traceback
@@ -479,7 +527,8 @@ def _make_dp_callbacks(ctx):
             import traceback
             traceback.print_exc()
 
-    return dp_register, dp_serve, dp_serve_done, dp_deliver, dp_bound
+    return (dp_register, dp_serve, dp_serve_done, dp_deliver, dp_bound,
+            dp_serve_stream)
 
 
 def _get_jitted(jax_mod, kernel: Callable) -> Callable:
@@ -836,6 +885,11 @@ class TpuDevice:
         # and thrashing tiles the executing wave still needs
         self._pf_reserved = 0
         self._pf_lane = None  # _PrefetchLane once started
+        # event-driven prefetch wakeup: remote deliveries (dp_deliver)
+        # set it so the lane sweeps NOW instead of waiting out its poll
+        # interval — within a wave, tile k h2d-stages while tile k+1 is
+        # still on the wire
+        self._pf_wake = threading.Event()
         # dispatch-time h2d stall accumulator for the CURRENT dispatch
         # call (manager thread only); emitted as the DEVICE span's aux,
         # so the bench can tell prefetch-hit waves (aux == 0) from
@@ -862,7 +916,12 @@ class TpuDevice:
                       "prefetch_wasted": 0, "reserve_fails": 0,
                       "spills": 0, "spill_bytes": 0,
                       "h2d_stall_ns": 0, "prefetch_h2d_ns": 0,
-                      "ooc_waits": 0}
+                      "ooc_waits": 0,
+                      # cross-rank streaming (progressive serve + event-
+                      # driven prefetch wakeups on remote delivery)
+                      "stream_serves": 0, "stream_slices": 0,
+                      "stream_d2h_ns": 0, "stream_bytes": 0,
+                      "prefetch_wakeups": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -903,13 +962,17 @@ class TpuDevice:
         if not hasattr(ctx, "_colocated"):
             ctx._colocated = set()
         if getattr(ctx, "_dp_cbs", None) is None:
-            reg, srv, done, dlv, bnd = _make_dp_callbacks(ctx)
+            reg, srv, done, dlv, bnd, strm = _make_dp_callbacks(ctx)
             ctx._dp_cbs = (N.DP_REGISTER_CB_T(reg),
                            N.DP_SERVE_CB_T(srv),
                            N.DP_SERVE_DONE_CB_T(done),
                            N.DP_DELIVER_CB_T(dlv),
                            N.DP_BOUND_CB_T(bnd))
             N.lib.ptc_set_dataplane(ctx._ptr, *ctx._dp_cbs, None)
+            # progressive-serve offer hook (kept alive alongside the
+            # dataplane tuple — ctypes thunks die with their last ref)
+            ctx._dp_stream_cb = N.DP_STREAM_CB_T(strm)
+            N.lib.ptc_set_dp_stream(ctx._ptr, ctx._dp_stream_cb)
             if _xfer_enabled():
                 # advertise pull capability to producers (GET-frame bit);
                 # probe once per process, stamp per context
@@ -1469,6 +1532,10 @@ class TpuDevice:
                     # out-of-core residency: d2h + evict (see _spill_one)
                     for uid in payload:
                         self._spill_one(uid)
+                elif kind == "stream":
+                    # progressive serve: slice the remote-pulled mirror's
+                    # d2h through the comm engine's watermark
+                    self._stream_serve(*payload)
                 else:
                     for uid in payload:
                         self.sync_handle(uid)
@@ -1481,6 +1548,81 @@ class TpuDevice:
             self._stats_add("wb_tasks", len(tasks))
             for t in tasks:
                 self.ctx.task_complete(t)
+
+    def _stream_serve(self, stream_id: int, tag: int) -> None:
+        """Progressive-serve slicer (writeback lane): d2h the registered
+        device array in comm.chunk_size slices, pushing each through
+        ptc_dp_serve_progress so the comm engine's watermark advances —
+        the wire starts moving after the FIRST slice instead of the
+        whole-tile snapshot.  The engine answers 0 when the session is
+        gone (retired early / puller lost): stop, the _DP_REG pin is
+        dropped by the engine's dp_serve_done."""
+        with _DP_LOCK:
+            rec = _DP_REG.get(tag)
+        if rec is None:
+            return  # raced a release; the engine reaps on peer loss
+        arr = rec[0]
+        total = int(arr.nbytes)
+        itemsize = int(np.dtype(arr.dtype).itemsize)
+        chunk = int(self.ctx.comm_tuning().get("chunk_size") or (1 << 20))
+        chunk_elems = max(1, chunk // itemsize)
+        if getattr(self.device, "platform", "") == "cpu":
+            # CPU backend: the mirror IS host memory — np.asarray is a
+            # (near-)zero-copy view, so slices are plain views with no
+            # per-slice dispatch.  The watermark protocol is identical;
+            # the serialized path's whole-tile snapshot copy is what
+            # this skips.
+            host = np.ascontiguousarray(np.asarray(arr))
+            hb = host.reshape(-1).view(np.uint8)
+
+            def get_slice(ei):
+                a = ei * itemsize
+                return hb[a:a + chunk_elems * itemsize]
+        else:
+            # accelerator: slice ON DEVICE, d2h one slice at a time —
+            # the wire starts after the first slice instead of the last
+            flat = arr.reshape(-1)
+
+            def get_slice(ei):
+                sl = np.ascontiguousarray(
+                    np.asarray(flat[ei:ei + chunk_elems]))  # blocking d2h
+                return sl.view(np.uint8).reshape(-1)
+
+        n = total // itemsize
+        from ..profiling.trace import KEY_STREAM
+        N.lib.ptc_prof_event(self.ctx._ptr, KEY_STREAM, 0, -1, total,
+                             self.qid, 0)
+        t0 = time.perf_counter_ns()
+        slices = 0
+        off = 0
+        ei = 0
+        try:
+            while ei < n:
+                b = get_slice(ei)
+                while True:
+                    rc = N.lib.ptc_dp_serve_progress(
+                        self.ctx._ptr, stream_id, b.ctypes.data, off,
+                        b.nbytes)
+                    if rc != -1:
+                        break
+                    # session install races the accept callback: retry
+                    time.sleep(0.0002)
+                if rc == 0:
+                    return  # session reaped (puller lost): stop slicing
+                slices += 1
+                off += int(b.nbytes)
+                ei += chunk_elems
+                if rc == 2:
+                    return  # absorbed and the session completed with it
+        finally:
+            dt = time.perf_counter_ns() - t0
+            N.lib.ptc_prof_event(self.ctx._ptr, KEY_STREAM, 1, -1, total,
+                                 self.qid, 0)
+            with self._lock:
+                self.stats["stream_serves"] += 1
+                self.stats["stream_slices"] += slices
+                self.stats["stream_d2h_ns"] += dt
+                self.stats["stream_bytes"] += off
 
     def _wb_write(self, uid, ostack, i, res) -> None:
         """Host-write one stack row's result if the cache entry is still

@@ -27,6 +27,8 @@ KEY_DEVICE = 5     # device dispatch call begin/end, l0 = lanes; the END
                    # (0 == prefetch-hit wave)
 KEY_H2D = 6        # h2d staging span, l0 = bytes, l1 = device queue,
                    # aux = lane (0 dispatch-time stall, 1 prefetch lane)
+KEY_STREAM = 7     # progressive-serve d2h span (writeback lane slicing a
+                   # remote-pulled mirror), l0 = bytes, l1 = device queue
 
 _MAGIC = b"#PTCPROF"
 _VERSION = 1
@@ -39,6 +41,7 @@ _DEFAULT_KEYS = {
     KEY_COMM_RECV: ("COMM_RECV", "#ff8800"),
     KEY_DEVICE: ("DEVICE_DISPATCH", "#aa00ff"),
     KEY_H2D: ("DEVICE_H2D", "#00aaff"),
+    KEY_STREAM: ("STREAM_D2H", "#ffaa00"),
 }
 
 

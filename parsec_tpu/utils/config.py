@@ -220,6 +220,23 @@ register("comm.inflight", 4, int,
          "chunked-pull window: how many ranged GETs a consumer keeps "
          "outstanding per pull.  Bounds in-flight memory to "
          "inflight * chunk_size per pull while keeping the pipe full")
+register("comm.stream", True, bool,
+         "progressive streaming serve (wire v4): a chunked pull of a "
+         "device-resident payload streams d2h slices through "
+         "ptc_dp_serve_progress — ranged GETs at or below the ready-"
+         "bytes watermark are answered immediately, the rest park on "
+         "the session and flush as the watermark advances, so the wire "
+         "starts after the FIRST d2h slice instead of the last.  0 "
+         "reproduces the serialized (PR3) d2h-then-wire serve "
+         "bit-exactly")
+register("comm.rails", 2, int,
+         "striped TCP connections per peer (wire v4): PUT_CHUNK payload "
+         "frames round-robin across the rails (offset-addressed "
+         "reassembly makes chunk order irrelevant) so one in-order "
+         "stream cannot cap cross-rank throughput; everything order-"
+         "sensitive stays on rail 0.  Must be uniform across the job "
+         "(the accept handshake rejects mismatches); 1 = the v3 single-"
+         "connection mesh")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
 register("dtd.insert_batch", 256, int,
@@ -254,6 +271,13 @@ register("device.dp_pull", True, bool,
          "e.g. a rank behind a NAT the token addresses cannot cross)")
 register("device.tpu_enabled", True, bool,
          "allow TPU device module (reference: --mca device_cuda_enabled)")
+register("device.stream_serve", True, bool,
+         "accept the comm engine's progressive-serve offers "
+         "(dp_serve_stream): the writeback lane d2h's the remote-pulled "
+         "mirror in comm.chunk_size slices, each advancing the serve "
+         "session's watermark, so the wire overlaps the d2h instead of "
+         "waiting for the whole-tile snapshot.  0 declines every offer "
+         "(the synchronous dp_serve path serves, as in comm.stream=0)")
 register("device.prefetch", True, bool,
          "device prefetch lane: a dedicated thread walks the runtime's "
          "ready-task lookahead (ptc_peek_ready) and stages the NEXT "

@@ -10,11 +10,44 @@ paths (mandatory on TPU pods — preemptions, ICI link flaps).  This module
 is that harness: wrap any task body to inject chore failures (DISABLE /
 NEXT) or hard body errors at chosen invocations, then assert on the
 runtime's recovery behavior.
+
+COMM-LAYER faults (the chunk/stream soak knobs): the native comm thread
+reads two env knobs at engine init —
+  PTC_COMM_FAULT_RECV_MAX   cap every recv() to this many bytes, so
+                            frames fragment at arbitrary boundaries
+                            (short reads: the parser must reassemble no
+                            matter where a chunk header splits)
+  PTC_COMM_FAULT_DELAY_US   sleep this long before every recv(), skewing
+                            the chunk window / watermark timing so
+                            session races (the PR1 cross-wiring shape)
+                            get hammered
+`comm_fault_env()` builds the env dict; `apply_comm_faults()` applies it
+to THIS process (call before Context.comm_init — the engine snapshots
+the knobs once).
 """
+import os
 import threading
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 from .._native import HOOK_DISABLE, HOOK_NEXT
+
+
+def comm_fault_env(delay_us: int = 0, recv_max: int = 0) -> Dict[str, str]:
+    """Env dict arming the native comm engine's fault injection: a
+    per-recv delay (µs) and/or a recv-size cap (bytes — short reads /
+    frame fragmentation).  Hand to a spawned rank's environment, or to
+    apply_comm_faults() for this process."""
+    env: Dict[str, str] = {}
+    if delay_us:
+        env["PTC_COMM_FAULT_DELAY_US"] = str(int(delay_us))
+    if recv_max:
+        env["PTC_COMM_FAULT_RECV_MAX"] = str(int(recv_max))
+    return env
+
+
+def apply_comm_faults(delay_us: int = 0, recv_max: int = 0) -> None:
+    """Arm comm fault injection for THIS process (before comm_init)."""
+    os.environ.update(comm_fault_env(delay_us, recv_max))
 
 
 class InjectedFault(RuntimeError):

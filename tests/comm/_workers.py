@@ -1440,7 +1440,8 @@ def getrf_panels_dist(rank: int, nodes: int, port: int, N: int = 128,
 
 
 def chunked_chain(rank: int, nodes: int, port: int, nb: int = 8,
-                  elems: int = 8192, chunk: int = 4096, inflight: int = 3):
+                  elems: int = 8192, chunk: int = 4096, inflight: int = 3,
+                  rails: int = 0):
     """RW chain whose datum is a multi-KiB int64 tile forced through the
     CHUNKED rendezvous (eager off, chunk_size << payload): every hop's
     payload streams as a pipelined window of ranged GET/PUT_CHUNK
@@ -1452,6 +1453,8 @@ def chunked_chain(rank: int, nodes: int, port: int, nb: int = 8,
     os.environ["PTC_MCA_comm_eager_limit"] = "0"
     os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
     os.environ["PTC_MCA_comm_inflight"] = str(inflight)
+    if rails:
+        os.environ["PTC_MCA_comm_rails"] = str(rails)
     pt, ctx = _mk_ctx(rank, nodes, port)
     with ctx:
         size = elems * 8
@@ -1535,16 +1538,28 @@ def adaptive_eager_chain(rank: int, nodes: int, port: int, nb: int = 8):
 
 
 def chunked_bcast(rank: int, nodes: int, port: int, elems: int = 4096,
-                  topo: str = "star"):
+                  topo: str = "star", chunk: int = 2048,
+                  fault_delay_us: int = 0, fault_recv_max: int = 0):
     """Root broadcasts one multi-KiB tile to every rank through the
     chunked rendezvous: with star topology the consumers pull the SAME
     shared registration concurrently (mem_by_copy dedup + chunk_refs
     pinning), with chain/binomial each relay re-registers and re-serves
-    what it pulled.  Every consumer verifies the full payload."""
+    what it pulled.  Every consumer verifies the full payload.
+
+    fault_delay_us / fault_recv_max arm the native comm engine's fault
+    injection (parsec_tpu.utils.faults) — the multi-puller soak for the
+    chunk-session state machine (the PR1 cross-wiring bug's shape):
+    payloads must still reassemble bit-exactly and every session must
+    drain (rdv stats at zero) under skewed timing and short reads."""
     import os
 
+    from parsec_tpu.utils.faults import apply_comm_faults
+
+    if fault_delay_us or fault_recv_max:
+        apply_comm_faults(delay_us=fault_delay_us,
+                          recv_max=fault_recv_max)
     os.environ["PTC_MCA_comm_eager_limit"] = "0"
-    os.environ["PTC_MCA_comm_chunk_size"] = "2048"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
     os.environ["PTC_MCA_comm_inflight"] = "3"
     pt, ctx = _mk_ctx(rank, nodes, port, topo=topo)
     with ctx:
@@ -1726,4 +1741,172 @@ def gemm_dist_ooc(rank: int, nodes: int, port: int, N: int = 64,
             np.testing.assert_allclose(
                 got, ref[m * nb:(m + 1) * nb, n * nb:(n + 1) * nb],
                 rtol=2e-3, atol=2e-3)
+        ctx.comm_fini()
+
+
+def stream_chain(rank: int, nodes: int, port: int, nb: int = 8,
+                 elems: int = 16384, chunk: int = 4096, inflight: int = 4,
+                 stream: int = 1, rails: int = 2, prefetch: bool = False,
+                 expect_stream=None, expect_parked: bool = False,
+                 check_wakeups: bool = False):
+    """Device-chore RW chain over the PK_DEVICE data plane with the wire
+    v4 streaming knobs pinned: every cross-rank hop is a chunked pull of
+    a device-resident tile, served progressively (stream=1) or through
+    the serialized PR3 d2h-then-wire path (stream=0), striped over
+    `rails` connections.  The arithmetic assertion at the end covers
+    every element of every hop, so a mis-assembled, reordered or
+    watermark-violating chunk is a hard failure on ANY knob setting —
+    which is what makes rails=1 vs rails=2 and stream on/off
+    bit-identical-by-assertion, not by luck.
+
+    expect_stream=True/False asserts the progressive serve did / did not
+    engage; expect_parked asserts ranged GETs actually parked above the
+    watermark (watermark-ordered answers); check_wakeups asserts the
+    consumer prefetch lane was woken event-driven by remote deliveries.
+    """
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
+    os.environ["PTC_MCA_comm_inflight"] = str(inflight)
+    os.environ["PTC_MCA_comm_stream"] = str(stream)
+    os.environ["PTC_MCA_comm_rails"] = str(rails)
+    if not prefetch:
+        os.environ["PTC_MCA_device_prefetch"] = "0"
+    pt, ctx = _mk_ctx(rank, nodes, port, nb_workers=1)
+    from parsec_tpu.device import TpuDevice
+
+    with ctx:
+        size = elems * 4
+        arr = np.zeros((nodes, elems), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=size,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", size)
+        dev = TpuDevice(ctx)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Hop")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Hop", k - 1, flow="A")),
+                pt.Out(pt.Ref("Hop", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                arena="t")
+
+        def kern(x):
+            return x + 1.0
+
+        dev.attach(tc, tp, kernel=kern, reads=["A"], writes=["A"],
+                   shapes={"A": (elems,)}, dtype=np.float32)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        dev.flush()
+        if rank == 0:
+            assert np.allclose(arr[0], float(nb + 1)), arr[0][:4]
+        st = ctx.comm_stream_stats()
+        if expect_stream is True:
+            # every rank produced hops the other pulled: progressive
+            # sessions must have run, with span evidence recorded
+            assert st["sessions"] > 0, st
+            assert st["d2h_ns"] > 0 and st["wire_ns"] > 0, st
+            assert dev.stats["stream_serves"] > 0, dev.stats
+            assert dev.stats["stream_bytes"] > 0, dev.stats
+            # unified export surfaces the same counters
+            agg = ctx.device_stats()
+            assert agg["stream_serves"] == dev.stats["stream_serves"]
+        elif expect_stream is False:
+            assert st["sessions"] == 0, st
+            assert dev.stats["stream_serves"] == 0, dev.stats
+        if expect_parked:
+            assert st["parked_gets"] > 0, st
+        if check_wakeups:
+            # remote deliveries must have woken the lane event-driven
+            assert dev.stats["prefetch_wakeups"] > 0, dev.stats
+        assert st["rails"] == rails, st
+        rd = ctx.comm_rdv_stats()
+        assert rd["pending_pulls"] == 0 and rd["registered_bytes"] == 0, rd
+        dev.stop()
+        ctx.comm_fini()
+
+
+def stream_reap_on_death(rank: int, nodes: int, port: int,
+                         elems: int = 262144, chunk: int = 4096,
+                         die_rank: int = 2, die_after_s: float = 1.0):
+    """Kill-a-puller reap coverage: rank 0 star-broadcasts one large
+    host tile through the chunked rendezvous; `die_rank` arms a recv
+    delay (so its pull crawls) and hard-exits mid-pull; the survivors
+    must observe the producer REAP the dead puller's chunk session and
+    expectation records — registered bytes back to zero, reap counter
+    up — instead of pinning the snapshot for the life of the engine.
+
+    The dying rank pushes nothing to the result queue; the test runner
+    only collects from survivors."""
+    import os
+    import threading
+    import time as _time
+
+    from parsec_tpu.utils.faults import apply_comm_faults
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
+    os.environ["PTC_MCA_comm_inflight"] = "2"
+    if rank == die_rank:
+        # crawl: ~20 ms per recv makes the 64-chunk pull take far longer
+        # than die_after_s, so death lands mid-session deterministically
+        apply_comm_faults(delay_us=20000)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        size = elems * 8
+        arr = np.zeros((nodes, elems), dtype=np.int64)
+        ctx.register_linear_collection("V", arr, elem_size=size,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", size)
+        tp = pt.Taskpool(ctx, globals={"NT": nodes - 1})
+        k = pt.L("k")
+        root = tp.task_class("Root")
+        root.affinity("V", 0)
+        recv = tp.task_class("Recv")
+        recv.param("k", 0, pt.G("NT"))
+        recv.affinity("V", k)
+
+        def root_body(view):
+            x = view.data("X", dtype=np.int64, shape=(elems,))
+            x[:] = np.arange(elems, dtype=np.int64)
+
+        root.flow("X", "W",
+                  pt.Out(pt.Ref("Recv", pt.Range(0, pt.G("NT")),
+                                flow="X")),
+                  arena="t")
+        root.body(root_body)
+
+        def recv_body(view):
+            x = view.data("X", dtype=np.int64, shape=(elems,))
+            assert (x == np.arange(elems, dtype=np.int64)).all()
+
+        recv.flow("X", "R", pt.In(pt.Ref("Root", flow="X")), arena="t")
+        recv.body(recv_body)
+        if rank == die_rank:
+            threading.Timer(die_after_s, lambda: os._exit(0)).start()
+        tp.run()
+        if rank == die_rank:
+            tp.wait()  # never finishes: the timer kills the process
+            return
+        tp.wait()
+        if rank == 0:
+            # poll until the dead puller's session/expectation records
+            # are reaped and the snapshot pin is gone
+            deadline = _time.time() + 90.0
+            st = rd = None
+            while _time.time() < deadline:
+                st = ctx.comm_stream_stats()
+                rd = ctx.comm_rdv_stats()
+                if st["reaps"] >= 1 and rd["registered_bytes"] == 0:
+                    break
+                _time.sleep(0.1)
+            assert st is not None and st["reaps"] >= 1, (st, rd)
+            assert rd["registered_bytes"] == 0, rd
         ctx.comm_fini()

@@ -1,0 +1,62 @@
+"""Cross-rank streaming bench schema smoke (mirror of test_bench_device
+for the stream rung): `bench.py --stream --json` must run at small sizes
+and emit the schema `make bench-stream` commits to BENCH_stream.json —
+serialized-vs-streamed per-transfer latency, rails=1 vs rails=2
+throughput, per-hop d2h/wire overlap evidence, the streaming knobs
+(comm_rails / comm_chunk_size / comm_inflight) and honest host
+provenance."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+_RUN_KEYS = {"size_bytes", "stream", "rails", "setup_ms",
+             "per_transfer_ms", "per_transfer_ms_all", "gbps",
+             "sessions", "parked_gets", "d2h_ns", "wire_ns",
+             "overlap_ns", "overlap_fraction", "device"}
+
+
+def test_stream_suite_schema(tmp_path):
+    out = tmp_path / "stream.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, _BENCH, "--stream", "--json", str(out),
+           "--size", str(512 * 1024), "--chunk", str(64 * 1024),
+           "--hops", "3", "--reps", "1"]
+    res = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+
+    # driver contract: the one-line JSON lands on stdout
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "stream_vs_serialized_latency_ratio"
+    assert line["value"] is not None
+
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["bench"] == "stream"
+    assert doc["host"]["cpu_count"] == os.cpu_count()
+    # satellite: the document records the streaming knobs alongside the
+    # (deduplicated) host provenance
+    assert {"comm_rails", "comm_chunk_size", "comm_inflight",
+            "comm_stream"} <= set(doc["knobs"])
+    assert "oversubscribed" in doc
+    if doc["oversubscribed"]:
+        assert "caveat" in doc  # the bench_dispatch_mt convention
+
+    for k in ("serialized", "streamed", "rails1_streamed"):
+        assert _RUN_KEYS <= set(doc[k]), (k, doc[k].keys())
+    # the serialized baseline must NOT have streamed ...
+    assert doc["serialized"]["sessions"] == 0
+    # ... the streamed run must have, with overlap span evidence
+    assert doc["streamed"]["sessions"] > 0
+    assert doc["streamed"]["d2h_ns"] > 0
+    assert doc["streamed"]["wire_ns"] > 0
+    assert doc["streamed"]["overlap_fraction"] is not None
+    assert doc["stream_vs_serialized_ratio"] is not None
+    assert doc["rails2_vs_rails1_throughput"] is not None
+    assert doc["ratio_target"] == 0.6

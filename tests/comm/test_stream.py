@@ -1,0 +1,102 @@
+"""Cross-rank tile streaming (wire v4): progressive serve, watermark-
+ordered chunk answers, multi-rail striping, peer-loss session reaping,
+and the delay/short-read fault soak.
+
+The streaming pipeline's correctness bar: payload bytes must reassemble
+bit-exactly no matter how the d2h watermark, the chunk window and the
+rail striping interleave; sessions must drain (rdv/stream stats at
+zero); and the off-knob must reproduce the serialized PR3 serve —
+sessions == 0, same results.
+"""
+import multiprocessing as mp
+
+from . import _workers
+from .test_multirank import _pick_base_port, _run_spmd
+
+
+def test_stream_chain_2ranks():
+    """Device chain over the PK_DEVICE plane with progressive serve on:
+    every hop streams d2h slices through the watermark, the span sums
+    (d2h window, wire window) are recorded, and the full payload is
+    verified at the end."""
+    _run_spmd(_workers.stream_chain, 2, timeout=240.0,
+              expect_stream=True)
+
+
+def test_stream_off_reproduces_serialized():
+    """PTC_MCA_comm_stream=0: zero streaming sessions, the synchronous
+    dp_serve path serves (PR3 behavior), identical results."""
+    _run_spmd(_workers.stream_chain, 2, timeout=240.0, stream=0,
+              expect_stream=False)
+
+
+def test_stream_watermark_parked_answers():
+    """Tiny chunks + a deep GET window outrun the d2h watermark: ranged
+    GETs must PARK and be answered in watermark order — the payload
+    assertion catches any answer served from not-yet-ready bytes."""
+    _run_spmd(_workers.stream_chain, 2, timeout=240.0, chunk=1024,
+              inflight=8, expect_stream=True, expect_parked=True)
+
+
+def test_stream_single_rail():
+    """rails=1 degenerates to the v3 single-connection mesh; streaming
+    still works (striping is an independent axis)."""
+    _run_spmd(_workers.stream_chain, 2, timeout=240.0, rails=1,
+              expect_stream=True)
+
+
+def test_rails1_vs_rails2_bit_identical_host_chunks():
+    """The host-rendezvous chunked chain verifies every element of every
+    hop internally — running it under one rail and under two proves the
+    striped reassembly is bit-identical to the ordered one."""
+    _run_spmd(_workers.chunked_chain, 2, rails=1)
+    _run_spmd(_workers.chunked_chain, 2, rails=2)
+
+
+def test_fault_soak_short_reads():
+    """Star fan-out of chunked pulls with every recv capped to 7 bytes:
+    frames fragment at arbitrary boundaries (chunk headers split
+    mid-field) and the payloads must still reassemble bit-exactly with
+    zero hung sessions."""
+    _run_spmd(_workers.chunked_bcast, 3, timeout=300.0, elems=2048,
+              chunk=1024, fault_recv_max=7)
+
+
+def test_fault_soak_delay():
+    """Star fan-out of chunked pulls with a per-recv delay skewing the
+    window/watermark timing (the PR1 cross-wiring bug's shape, hammered
+    with concurrent pullers presenting equal cookies)."""
+    _run_spmd(_workers.chunked_bcast, 3, timeout=300.0, elems=8192,
+              chunk=1024, fault_delay_us=200)
+
+
+def test_kill_a_puller_reaps_sessions():
+    """3-rank kill-a-puller: rank 2 dies mid-chunked-pull; the producer
+    must reap its chunk session + expectation records (reap counter up,
+    registered bytes back to zero) instead of pinning the snapshot for
+    the life of the engine.  The dying rank pushes no result; only the
+    survivors are collected."""
+    nodes = 3
+    port = _pick_base_port(nodes)
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    procs = [
+        mpctx.Process(target=_workers.run,
+                      args=(_workers.stream_reap_on_death, r, nodes,
+                            port, q))
+        for r in range(nodes)
+    ]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nodes - 1):  # rank 2 dies silently
+            results.append(q.get(timeout=240.0))
+    finally:
+        for p in procs:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in results if r[0] != "ok"]
+    assert not errs, "\n".join(str(e) for e in errs)
+    assert sorted(r[1] for r in results) == [0, 1], results

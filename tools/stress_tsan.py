@@ -88,12 +88,21 @@ def dtd_churn(workers: int, tiles: int, rounds: int) -> None:
         dtp.destroy()
 
 
-def colocated_comm(workers: int, nb: int = 64, port: int = 29900) -> None:
+def colocated_comm(workers: int, nb: int = 64, port: int = 29900,
+                   elems: int = 1, env=None) -> None:
     """Two ranks in ONE process (a thread per rank, loopback TCP): the
     comm threads' delivery paths run against both ranks' workers on a
-    cross-rank RW chain, all inside one TSan-observed address space."""
+    cross-rank RW chain, all inside one TSan-observed address space.
+
+    elems > 1 (with `env` forcing rendezvous + small chunks + 2 rails)
+    drives the wire-v4 socket/session paths — ranged-chunk sessions,
+    shared_ptr-pinned zero-copy sendmsg frames, multi-rail striping —
+    under TSan's happens-before analysis."""
     import threading
 
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
     errs = []
 
     def rank_prog(rank):
@@ -102,10 +111,11 @@ def colocated_comm(workers: int, nb: int = 64, port: int = 29900) -> None:
             ctx.set_rank(rank, 2)
             ctx.comm_init(port)
             with ctx:
-                arr = np.zeros(2, dtype=np.int64)
-                ctx.register_linear_collection("A", arr, elem_size=8,
+                size = 8 * elems
+                arr = np.zeros((2, elems), dtype=np.int64)
+                ctx.register_linear_collection("A", arr, elem_size=size,
                                                nodes=2, myrank=rank)
-                ctx.register_arena("t", 8)
+                ctx.register_arena("t", size)
                 tp = pt.Taskpool(ctx, globals={"NB": nb})
                 k = pt.L("k")
                 tc = tp.task_class("Task")
@@ -119,7 +129,9 @@ def colocated_comm(workers: int, nb: int = 64, port: int = 29900) -> None:
                         arena="t")
 
                 def body(view):
-                    view.data("A", dtype=np.int64)[0] += 1
+                    a = view.data("A", dtype=np.int64, shape=(elems,))
+                    assert (a == view["k"]).all()
+                    a += 1
 
                 tc.body(body)
                 tp.run()
@@ -129,14 +141,22 @@ def colocated_comm(workers: int, nb: int = 64, port: int = 29900) -> None:
         except Exception as e:  # pragma: no cover - stress harness
             errs.append((rank, repr(e)))
 
-    ts = [threading.Thread(target=rank_prog, args=(r,)) for r in range(2)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=300)
-    hung = [t.name for t in ts if t.is_alive()]
-    assert not hung, f"deadlocked rank threads: {hung}"
-    assert not errs, errs
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
@@ -191,6 +211,13 @@ def main():
         dtd_churn(workers=8, tiles=8, rounds=100)
         reshape_churn(workers=8, fanout=8, rounds=60)
         colocated_comm(workers=4, port=29900 + rep)
+        # wire-v4 socket/session paths: chunk sessions, zero-copy
+        # sendmsg pins, 2-rail striping (16 KiB payloads, 2 KiB chunks)
+        colocated_comm(workers=4, nb=24, port=29940 + rep, elems=2048,
+                       env={"PTC_MCA_comm_eager_limit": "0",
+                            "PTC_MCA_comm_chunk_size": "2048",
+                            "PTC_MCA_comm_inflight": "3",
+                            "PTC_MCA_comm_rails": "2"})
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")
 

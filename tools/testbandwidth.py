@@ -231,9 +231,14 @@ def main():
     if _arg("--sizes"):
         sizes = [int(x) for x in _arg("--sizes").split(",")]
     base = int(os.environ.get("PTC_PORT", "31300"))
+    # shared provenance/oversubscription capture (bench.host_provenance
+    # replaced this harness's private copy): 2 ranks x (worker + comm
+    # thread [+ device lanes on the device path])
+    from bench import host_provenance
     doc = {
         "bench": "transfer_economics",
         "when": time.strftime("%Y-%m-%d %H:%M:%S"),
+        **host_provenance(threads=2 * 2),
         "meta": {"hops": hops, "reps": reps, "sizes": sizes,
                  "nodes": 2,
                  "platform": ("tpu" if os.environ.get("PTC_BENCH_TPU")
