@@ -57,4 +57,12 @@ bench-device: $(LIB)
 bench-stream: $(LIB)
 	python bench.py --stream --json BENCH_stream.json
 
-.PHONY: all clean tsan bench-comm bench-dispatch bench-device bench-stream
+# Tracing-overhead ladder (bench.py --trace --json): per-task cost at
+# trace levels 0/1/2 and the flight-recorder ring vs unbounded buffers
+# at level 1 (the PR2 one-transaction-per-task contract), with host
+# provenance.  No TPU needed.
+bench-trace: $(LIB)
+	python bench.py --trace --json BENCH_trace.json
+
+.PHONY: all clean tsan bench-comm bench-dispatch bench-device \
+	bench-stream bench-trace

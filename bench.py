@@ -130,6 +130,72 @@ def bench_profiling_overhead(nb_tasks: int = 20000, reps: int = 5):
     })
 
 
+def bench_trace_suite(tasks: int = 20000, reps: int = 5,
+                      ring_bytes: int = 1 << 16):
+    """Tracing-cost ladder (make bench-trace -> BENCH_trace.json): wall
+    cost per task of the noop chain at trace levels 0 (off), 1 (EXEC
+    spans — the PR2 one-buffer-transaction-per-task contract), 2
+    (+RELEASE spans +EDGE pairs), and level 1 under the flight-recorder
+    RING (overwrite-oldest bounded buffers).  The ring push replaces the
+    vector append with fixed-slot writes, so ring-vs-unbounded at level
+    1 must stay within noise of 1.0 — that ratio is the acceptance
+    number, recorded alongside the dropped-event count that proves the
+    ring actually wrapped."""
+    def run(level, ring):
+        best, dropped = None, 0
+        for _ in range(reps):
+            with pt.Context(nb_workers=1) as ctx:
+                if level:
+                    ctx.profile_enable(level)
+                if ring:
+                    ctx.profile_ring(ring)
+                ctx.register_arena("t", 8)
+                tp = pt.Taskpool(ctx, globals={"NB": tasks - 1})
+                k = pt.L("k")
+                tc = tp.task_class("Task")
+                tc.param("k", 0, pt.G("NB"))
+                tc.flow("A", "RW",
+                        pt.In(None, guard=(k == 0)),
+                        pt.In(pt.Ref("Task", k - 1, flow="A")),
+                        pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                               guard=(k < pt.G("NB"))),
+                        arena="t")
+                tc.body_noop()
+                t0 = time.perf_counter()
+                tp.run()
+                tp.wait()
+                dt = time.perf_counter() - t0
+                if ring:
+                    dropped = max(dropped, ctx.profile_dropped())
+            if best is None or dt < best:
+                best = dt
+        return best, dropped
+
+    walls = {lv: run(lv, 0)[0] for lv in (0, 1, 2)}
+    ring_wall, ring_dropped = run(1, ring_bytes)
+    per = {lv: walls[lv] / tasks * 1e9 for lv in walls}
+    ring_per = ring_wall / tasks * 1e9
+    return {
+        "schema": "bench-trace-v1",
+        "knobs": {"tasks": tasks, "reps": reps, "ring_bytes": ring_bytes},
+        "ns_per_task": {str(lv): round(per[lv], 1) for lv in per},
+        "overhead_ns_per_task": {
+            "level1": round(per[1] - per[0], 1),
+            "level2": round(per[2] - per[0], 1),
+            "ring_level1": round(ring_per - per[0], 1),
+        },
+        "ring": {
+            "ns_per_task": round(ring_per, 1),
+            "dropped_events": int(ring_dropped),
+            # the acceptance ratio: ring mode vs the PR2 unbounded
+            # level-1 cost (1.0 = identical; < 1.1 required)
+            "vs_unbounded_level1": (round(ring_per / per[1], 3)
+                                    if per[1] else None),
+        },
+        **host_provenance(threads=1),
+    }
+
+
 def bench_dispatch_mt(nb_tasks: int = 4000, lanes: int = 8, workers: int = 4,
                       reps: int = 5):
     """Multi-worker dispatch latency (VERDICT r3 weak #4: the single-
@@ -1216,6 +1282,29 @@ def main():
         return 0
     if "--profov" in sys.argv:
         print(bench_profiling_overhead())
+        return 0
+    if "--trace" in sys.argv:
+        doc = bench_trace_suite(tasks=_arg_after("--tasks", 20000),
+                                reps=_arg_after("--reps", 5),
+                                ring_bytes=_arg_after("--ring", 1 << 16))
+        out = _arg_str_after("--json", None)
+        if out:
+            with open(out, "w") as f:
+                json.dump(doc, f, indent=1)
+            sys.stderr.write(f"wrote {out}\n")
+        print(json.dumps({
+            "metric": "trace_ring_vs_unbounded_level1",
+            "value": doc["ring"]["vs_unbounded_level1"],
+            "unit": "x (1.0 = no ring overhead; acceptance < 1.1)",
+            "vs_baseline": (round(1.1 / doc["ring"]["vs_unbounded_level1"],
+                                  3)
+                            if doc["ring"]["vs_unbounded_level1"] else None),
+            "config": {"tasks": doc["knobs"]["tasks"],
+                       "ring_bytes": doc["knobs"]["ring_bytes"],
+                       "level1_overhead_ns":
+                           doc["overhead_ns_per_task"]["level1"],
+                       "ring_dropped": doc["ring"]["dropped_events"]},
+        }))
         return 0
     if "--ring" in sys.argv:
         print(bench_ring(S=_arg_after("--s", 8), T=_arg_after("--t", 2048),

@@ -78,6 +78,16 @@
  *   - receiver-side, chunks reassemble directly into the final ptc_copy
  *     allocation (no chunk_buf -> deliver memcpy), and delivery
  *     completion wakes the consumer's prefetch lane event-driven.
+ *
+ * Wire v5 — distributed tracing: ACTIVATE and ACTIVATE_BCAST bodies
+ * carry a [u64 corr] flow-correlation cookie (after `shaped`), stamped
+ * on the COMM_SEND trace event as (dst, corr) and replayed on the
+ * delivery-side COMM_RECV as (src, corr), so merged multi-rank traces
+ * pair sends with deliveries (Perfetto flow arrows, per-message wire
+ * latency).  PONG frames append the echoer's ptc_now_ns so every rank
+ * estimates its TSC-clock offset to rank 0 (min-RTT midpoint sample,
+ * probed at bring-up and refreshed at each fence) — Trace.merge aligns
+ * per-rank timelines with it.
  */
 
 #include "runtime_internal.h"
@@ -298,6 +308,10 @@ struct PendingGet {
    * shaped field): a consumer whose recv type matches must not re-apply
    * a cast (round-4 review: cast double-apply across the wire) */
   int32_t shaped = -1;
+  /* flow-correlation cookie from the ACTIVATE frame (tracing v2): the
+   * delivery-time COMM_RECV event carries it, tying the whole
+   * rendezvous (GET window included) back to the producer's COMM_SEND */
+  uint64_t corr = 0;
   /* broadcast-relay rendezvous: once the pull resolves, deliver locally
    * AND re-root — re-register the payload and forward to these children
    * along `topo` (reference: re-rooted bcast data movement,
@@ -425,6 +439,27 @@ struct CommEngine {
   std::atomic<int64_t> memcpy_bps{0};   /* measured host copy rate */
   std::atomic<uint32_t> pongs{0};
 
+  /* clock sync (distributed tracing v2): every rank != 0 estimates
+   * offset = rank0_now - local_now from PING/PONG midpoints against
+   * rank 0 (PONGs carry the echoer's ptc_now_ns; the sample with the
+   * smallest RTT wins — its uncertainty is bounded by that RTT).
+   * Probed at comm bring-up, refreshed at every fence; Trace.merge
+   * applies the offset so merged timelines are causally consistent.
+   * clock_best_rtt is guarded by `lock`; the atomics are the readers'
+   * snapshot (ptc_comm_clock_stats). */
+  int64_t clock_best_rtt = 0;
+  std::atomic<int64_t> clock_offset_ns{0};
+  std::atomic<int64_t> clock_err_ns{0};
+  std::atomic<uint64_t> clock_samples{0};
+
+  /* per-message flow-correlation cookie (tracing v2): stamped on every
+   * ACTIVATE/ACTIVATE_BCAST frame; COMM_SEND carries (dst, corr) and the
+   * matching COMM_RECV (src, corr) in l0/l1, so merged traces pair
+   * sends with deliveries across ranks (Perfetto flow events + the
+   * wire_latency table).  Unique per SENDER — match keys are
+   * (src_rank, corr). */
+  std::atomic<uint64_t> next_corr{1};
+
   /* stats (reference: parsec/remote_dep.c counters) */
   std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
   std::atomic<uint64_t> bytes_sent{0}, bytes_recv{0};
@@ -529,11 +564,12 @@ static size_t reg_live_children(CommEngine *ce, MemReg &m,
  * canary, since a byte-swapped peer presents it reversed. */
 enum : uint32_t {
   PTC_WIRE_MAGIC = 0x50544331u, /* "PTC1" */
-  PTC_WIRE_VERSION = 4, /* v4: multi-rail handshake (hello carries a
-                           rail index) + progressive streaming serve.
-                           Frame grammar is v3's; the bump exists
-                           because a v3 peer's 3-word hello cannot
-                           join a v4 mesh (see MIGRATION.md). */
+  PTC_WIRE_VERSION = 5, /* v5 (tracing v2): ACTIVATE/ACTIVATE_BCAST
+                           bodies carry a u64 flow-correlation cookie
+                           after `shaped`, and PONG frames append the
+                           echoer's clock sample for cross-rank clock
+                           sync.  v4: multi-rail handshake + progressive
+                           streaming serve (see MIGRATION.md). */
 };
 
 static void comm_post_msg(CommEngine *ce, uint32_t rank, OutMsg &&msg,
@@ -733,8 +769,18 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             const uint8_t *payload, uint64_t plen,
                             int64_t device_uid = 0,
                             uint64_t alloc_len = 0, int32_t shaped = -1,
-                            ptc_copy *ready = nullptr) {
+                            ptc_copy *ready = nullptr,
+                            uint32_t src_rank = UINT32_MAX,
+                            uint64_t corr = 0) {
   if (alloc_len == 0) alloc_len = plen;
+  /* ONE COMM_RECV per delivered frame, keyed (src, corr) in l0/l1 to
+   * mirror the producer's COMM_SEND (dst, corr) — the merged-trace flow
+   * pair (tracing v2).  Parked replays lost their true src (UINT32_MAX
+   * sign-extends to -1-ish l0): they stay unmatched, which is honest. */
+  ptc_prof_instant(ctx, PROF_KEY_COMM_RECV,
+                   targets.empty() ? -1 : (int64_t)targets[0].class_id,
+                   src_rank == UINT32_MAX ? -1 : (int64_t)src_rank,
+                   (int64_t)corr, (int64_t)plen);
   ptc_copy *copy = nullptr;
   /* ptc_has_dtypes: zero-registered-datatype workloads skip the
    * per-target selection below (it evaluates guards — possibly Python
@@ -850,10 +896,6 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
         for (size_t j = i; j < targets.size(); j++) {
           if (dts[j] != dt) continue;
           WireTarget &t = targets[j];
-          ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
-                           t.params.size() > 0 ? t.params[0] : 0,
-                           t.params.size() > 1 ? t.params[1] : 0,
-                           (int64_t)plen /* wire bytes, not extent */);
           ptc_deliver_dep_local(ctx, -1, tp, t.class_id,
                                 std::move(t.params), flow_idx, c);
         }
@@ -911,10 +953,6 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                     plen == alloc_len ? 1 : 0);
   }
   for (WireTarget &t : targets) {
-    ptc_prof_instant(ctx, PROF_KEY_COMM_RECV, (int64_t)t.class_id,
-                     t.params.size() > 0 ? t.params[0] : 0,
-                     t.params.size() > 1 ? t.params[1] : 0,
-                     copy ? copy->size : 0);
     ptc_deliver_dep_local(ctx, -1, tp, t.class_id, std::move(t.params),
                           flow_idx, copy);
   }
@@ -932,7 +970,9 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
                             const uint8_t *payload, uint64_t plen,
                             int64_t device_uid, bool allow_park,
                             uint64_t alloc_len = 0, int32_t shaped = -1,
-                            ptc_copy *ready = nullptr) {
+                            ptc_copy *ready = nullptr,
+                            uint32_t src_rank = UINT32_MAX,
+                            uint64_t corr = 0) {
   ptc_taskpool *tp = find_tp(ctx, tp_id);
   if (!tp) {
     /* Re-check the registry under the lock: add_taskpool may have
@@ -956,6 +996,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
       w.i32(tp_id);
       w.i32(flow_idx);
       w.i32(shaped);
+      w.u64(corr); /* flow cookie survives the park (ACTIVATE grammar) */
       w.raw(targets_bytes, targets_len);
       if (alloc_len && alloc_len != plen) {
         if (device_uid == 0) {
@@ -990,7 +1031,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
     return;
   }
   deliver_targets(ctx, tp, flow_idx, std::move(targets), payload, plen,
-                  device_uid, alloc_len, shaped, ready);
+                  device_uid, alloc_len, shaped, ready, src_rank, corr);
 }
 
 /* body excludes the type byte.  `from` is the sending rank (rendezvous
@@ -1003,6 +1044,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   int32_t tp_id = r.i32();
   int32_t flow_idx = r.i32();
   int32_t shaped = r.i32(); /* datatype the payload bytes are already in */
+  uint64_t corr = r.u64();  /* flow-correlation cookie (tracing v2) */
   const uint8_t *targets_start = r.p;
   uint32_t nb_targets = r.u32();
   (void)parse_targets(r, nb_targets); /* skip to measure the slice */
@@ -1016,7 +1058,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   case PK_NONE:
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0, 0,
-                    allow_park, 0, shaped);
+                    allow_park, 0, shaped, nullptr, from, corr);
     return;
   case PK_EAGER: {
     uint64_t plen = r.u64();
@@ -1026,7 +1068,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     }
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), r.p, plen, 0,
-                    allow_park, 0, shaped);
+                    allow_park, 0, shaped, nullptr, from, corr);
     return;
   }
   case PK_PARKED_DEVICE: {
@@ -1045,7 +1087,8 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     if (!r.ok) return;
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0,
-                    (int64_t)uid, allow_park, alloc_len, shaped);
+                    (int64_t)uid, allow_park, alloc_len, shaped, nullptr,
+                    from, corr);
     return;
   }
   case PK_GET:
@@ -1079,6 +1122,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     pg.targets_bytes.assign(targets_start, targets_end);
     pg.pk = pk;
     pg.shaped = shaped;
+    pg.corr = corr;
     send_rendezvous_pull(ce, from, src_handle, plen, std::move(pg));
     return;
   }
@@ -1203,6 +1247,10 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
     w.i32(tp_id);
     w.i32(flow_idx);
     w.i32(shaped);
+    /* per-hop flow cookie: each relay edge of the broadcast tree is its
+     * own send/recv pair in the merged trace */
+    uint64_t corr = ce->next_corr.fetch_add(1, std::memory_order_relaxed);
+    w.u64(corr);
     w.u8(topo);
     w.u32((uint32_t)take);
     for (size_t k = i; k < i + take; k++) {
@@ -1219,7 +1267,7 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
     }
     frame_finish(f);
     ptc_prof_instant(ce->ctx, PROF_KEY_COMM_SEND, groups[i].first_class,
-                     (int64_t)groups[i].rank, (int64_t)(take - 1),
+                     (int64_t)groups[i].rank, (int64_t)corr,
                      (int64_t)plen);
     comm_post(ce, groups[i].rank, std::move(f));
     i += take;
@@ -1233,6 +1281,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
   int32_t tp_id = r.i32();
   int32_t flow_idx = r.i32();
   int32_t shaped = r.i32();
+  uint64_t corr = r.u64(); /* this hop's flow cookie (tracing v2) */
   uint8_t topo = r.u8();
   uint32_t nb_groups = r.u32();
   std::vector<BcastWireGroup> groups;
@@ -1292,6 +1341,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     pg.targets_bytes = std::move(my_targets);
     pg.pk = pk;
     pg.shaped = shaped;
+    pg.corr = corr;
     pg.bcast = true;
     pg.topo = topo;
     pg.groups = std::move(groups);
@@ -1315,13 +1365,14 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     Reader tr{my_targets.data(), my_targets.data() + my_targets.size()};
     uint32_t nb_targets = tr.u32();
     deliver_targets(ctx, tp, flow_idx, parse_targets(tr, nb_targets),
-                    r.p, plen, 0, 0, shaped);
+                    r.p, plen, 0, 0, shaped, nullptr, from, corr);
     return;
   }
   /* unknown taskpool (SPMD skew): park via the shared eager-form path (a
    * parked frame must NOT re-forward on replay — this form cannot) */
   deliver_or_park(ctx, tp_id, flow_idx, my_targets.data(), my_targets.size(),
-                  r.p, plen, 0, /*allow_park=*/true, 0, shaped);
+                  r.p, plen, 0, /*allow_park=*/true, 0, shaped, nullptr,
+                  from, corr);
 }
 
 /* build one PUT_CHUNK message serving [offset, offset+clen) of a
@@ -1754,7 +1805,8 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
   if (!pg.targets_bytes.empty())
     deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
                     pg.targets_bytes.size(), payload, plen, device_uid,
-                    /*allow_park=*/true, real_len, pg.shaped, pg.dst);
+                    /*allow_park=*/true, real_len, pg.shaped, pg.dst,
+                    pg.src_rank, pg.corr);
   if (pg.dst) {
     ptc_copy_release_internal(ctx, pg.dst);
     pg.dst = nullptr;
@@ -1988,10 +2040,11 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     ce->fence_cv.notify_all();
     break;
   }
-  case MSG_PING: { /* RTT probe: echo the body back verbatim */
+  case MSG_PING: { /* RTT probe: echo the body back + our clock sample */
     std::vector<uint8_t> f = frame_begin(MSG_PONG);
     Writer w{f};
     w.raw(body, len);
+    w.i64(ptc_now_ns()); /* echoer's clock at the RTT midpoint (v5) */
     frame_finish(f);
     comm_post(ce, from, std::move(f));
     break;
@@ -2000,12 +2053,29 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     Reader r{body, body + len};
     uint64_t t0 = r.u64();
     if (r.ok) {
-      int64_t rtt = ptc_now_ns() - (int64_t)t0;
+      int64_t t3 = ptc_now_ns();
+      int64_t rtt = t3 - (int64_t)t0;
       if (rtt > 0) {
         int64_t cur = ce->rtt_ns.load(std::memory_order_relaxed);
         while ((cur == 0 || rtt < cur) &&
                !ce->rtt_ns.compare_exchange_weak(cur, rtt)) {
         }
+      }
+      /* clock sync: a pong FROM rank 0 carries rank 0's clock sampled
+       * mid-roundtrip; offset = t_rank0 - (t0 + rtt/2).  Keep the
+       * min-RTT sample — its error is bounded by the asymmetry of that
+       * (smallest) round trip. */
+      if (from == 0 && ce->myrank != 0 && rtt > 0 &&
+          (size_t)(r.end - r.p) >= 8) {
+        int64_t t_rank0 = r.i64();
+        std::lock_guard<ptc_mutex> g(ce->lock);
+        if (ce->clock_best_rtt == 0 || rtt < ce->clock_best_rtt) {
+          ce->clock_best_rtt = rtt;
+          ce->clock_offset_ns.store(t_rank0 - ((int64_t)t0 + rtt / 2),
+                                    std::memory_order_relaxed);
+          ce->clock_err_ns.store(rtt, std::memory_order_relaxed);
+        }
+        ce->clock_samples.fetch_add(1, std::memory_order_relaxed);
       }
       ce->pongs.fetch_add(1, std::memory_order_relaxed);
     }
@@ -2146,6 +2216,10 @@ static void mark_peer_lost(CommEngine *ce, TcpPeer &p, uint32_t rank) {
     }
   }
   ptc_context *ctx = ce->ctx;
+  /* flight recorder: a genuinely lost peer (not the clean FIN-then-EOF
+   * handshake) is exactly the moment production wants the last-N-seconds
+   * trace on disk (dumped once per context, outside ce->lock) */
+  if (!fin_ok) ptc_flight_autodump(ctx, "peer lost");
   for (ptc_copy *c : rels) ptc_copy_release_internal(ctx, c);
   for (int64_t tag : dp_done)
     if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
@@ -2648,6 +2722,11 @@ void ptc_comm_send_activate_batch(
   w.i32(tp->id);
   w.i32(flow_idx);
   w.i32(shaped);
+  /* flow-correlation cookie (tracing v2): unique per sender; COMM_SEND
+   * here and the consumer's COMM_RECV both carry it, so merged traces
+   * pair the two events across ranks */
+  uint64_t corr = ce->next_corr.fetch_add(1, std::memory_order_relaxed);
+  w.u64(corr);
   w.u32((uint32_t)targets.size());
   for (const auto &t : targets) {
     w.i32(t.first);
@@ -2757,10 +2836,13 @@ void ptc_comm_send_activate_batch(
           (size_t)payload_size);
   }
   frame_finish(f);
-  for (const auto &t : targets)
-    ptc_prof_instant(ctx, PROF_KEY_COMM_SEND, (int64_t)t.first,
-                     t.second.size() > 0 ? t.second[0] : 0,
-                     t.second.size() > 1 ? t.second[1] : 0, payload_size);
+  /* ONE COMM_SEND per frame, keyed (dst, corr) in l0/l1 — the flow pair
+   * of the consumer's COMM_RECV (src, corr).  Fan-in targets share the
+   * frame, so per-message wire latency is measured once, not nb_targets
+   * times. */
+  ptc_prof_instant(ctx, PROF_KEY_COMM_SEND,
+                   targets.empty() ? -1 : (int64_t)targets[0].first,
+                   (int64_t)rank, (int64_t)corr, payload_size);
   comm_post(ce, rank, std::move(f));
 }
 
@@ -3119,6 +3201,30 @@ static void calibrate_eager_limit(CommEngine *ce) {
   ce->eager_limit = lim;
 }
 
+/* Clock-sync probe (tracing v2): rank r != 0 sends a burst of PINGs to
+ * rank 0; the PONG handler folds each answer into the min-RTT offset
+ * estimate.  `wait` blocks (<= 2s) until at least one fresh sample
+ * landed — used at comm bring-up so even short runs trace with a
+ * measured offset; the per-fence refresh fires and forgets. */
+static void clock_sync_probe(CommEngine *ce, bool wait) {
+  if (ce->nodes <= 1 || ce->myrank == 0) return; /* rank 0 IS the base */
+  uint64_t before = ce->clock_samples.load(std::memory_order_relaxed);
+  for (int i = 0; i < 8; i++) {
+    std::vector<uint8_t> f = frame_begin(MSG_PING);
+    Writer w{f};
+    w.u64((uint64_t)ptc_now_ns());
+    frame_finish(f);
+    comm_post(ce, 0, std::move(f));
+  }
+  if (wait) {
+    std::unique_lock<ptc_mutex> g(ce->lock);
+    ce->fence_cv.wait_for(g, std::chrono::seconds(2), [&] {
+      return ce->clock_samples.load(std::memory_order_relaxed) > before ||
+             ce->stop.load(std::memory_order_acquire);
+    });
+  }
+}
+
 extern "C" {
 
 int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
@@ -3172,6 +3278,9 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
     return -1;
   }
   if (ce->eager_adaptive) calibrate_eager_limit(ce);
+  /* clock sync at bring-up: block for the first sample so even a short
+   * traced run merges with a measured offset (refreshed at each fence) */
+  clock_sync_probe(ce, /*wait=*/true);
   if (ptc_context_verbose(ctx, PTC_DBG_COMM) >= 1)
     std::fprintf(stderr,
                  "ptc [comm]: rank %u/%u mesh connected (transport %s, "
@@ -3208,6 +3317,10 @@ void ptc_comm_set_topology(ptc_context_t *ctx, int32_t topo) {
 int32_t ptc_comm_fence(ptc_context_t *ctx) {
   CommEngine *ce = ctx->comm;
   if (!ce) return 0;
+  /* refresh the clock-sync estimate at each fence (fire and forget:
+   * PING/PONG are control frames, so they never dirty the fence; the
+   * answers fold in while the wave itself round-trips) */
+  clock_sync_probe(ce, /*wait=*/false);
   while (true) {
     uint64_t gen;
     uint8_t mydirty;
@@ -3465,6 +3578,26 @@ void ptc_comm_stream_stats(ptc_context_t *ctx, int64_t *out8) {
   out8[5] = ce ? (int64_t)ce->reaps.load() : 0;
   out8[6] = ce ? (int64_t)ce->rails : 0;
   out8[7] = (ce && ce->stream) ? 1 : 0;
+}
+
+/* clock-sync snapshot (tracing v2): [offset_ns (rank0 - local),
+ * err_ns (winning sample's RTT), samples, measured flag].  Rank 0 (and
+ * single-process contexts) report offset 0; rank 0 of a live mesh is
+ * "measured" by definition — it IS the reference clock. */
+void ptc_comm_clock_stats(ptc_context_t *ctx, int64_t *out4) {
+  CommEngine *ce = ctx->comm;
+  out4[0] = ce ? ce->clock_offset_ns.load(std::memory_order_relaxed) : 0;
+  out4[1] = ce ? ce->clock_err_ns.load(std::memory_order_relaxed) : 0;
+  out4[2] = ce ? (int64_t)ce->clock_samples.load(std::memory_order_relaxed)
+               : 0;
+  out4[3] = ce && (ce->myrank == 0 || out4[2] > 0) ? 1 : 0;
+}
+
+int64_t ptc_comm_clock_sync(ptc_context_t *ctx) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return 0;
+  clock_sync_probe(ce, /*wait=*/true);
+  return (int64_t)ce->clock_samples.load(std::memory_order_relaxed);
 }
 
 /* PROGRESSIVE SERVE d2h hook (wire v4 streaming): the device layer's

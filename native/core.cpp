@@ -1993,6 +1993,7 @@ static void tp_task_done(ptc_context *ctx, ptc_taskpool *tp) {
  * complete it with an error mark instead and let waiters observe it. */
 static void tp_abort(ptc_context *ctx, ptc_taskpool *tp) {
   tp->nb_errors.fetch_add(1, std::memory_order_acq_rel);
+  ptc_flight_autodump(ctx, "taskpool abort");
   tp_mark_complete(ctx, tp);
 }
 
@@ -2004,6 +2005,25 @@ static void tp_abort(ptc_context *ctx, ptc_taskpool *tp) {
  * way a body error does — waiters observe the error instead of garbage */
 void ptc_tp_abort_internal(ptc_context *ctx, ptc_taskpool *tp) {
   tp_abort(ctx, tp);
+}
+
+/* Flight-recorder autodump: at most ONE dump per context (the first
+ * failure is the interesting one; later aborts of cascading pools would
+ * overwrite it with a trace of the wreckage).  No-op when tracing is
+ * off or no dump path is armed (ring mode arms the /tmp default). */
+void ptc_flight_autodump(ptc_context *ctx, const char *reason) {
+  if (ctx->prof_level.load(std::memory_order_relaxed) <= 0) return;
+  if (ctx->flight_dump_path.empty()) return;
+  if (ctx->flight_dumped.exchange(true, std::memory_order_acq_rel)) return;
+  char path[512];
+  std::snprintf(path, sizeof path, "%s.%u.ptt",
+                ctx->flight_dump_path.c_str(), ctx->myrank);
+  if (ptc_flight_dump(ctx, path) == 0)
+    std::fprintf(stderr, "ptc: flight-recorder trace dumped to %s (%s)\n",
+                 path, reason);
+  else
+    std::fprintf(stderr, "ptc: flight-recorder dump to %s FAILED (%s)\n",
+                 path, reason);
 }
 
 /* ---- paired-event trace (reference: parsec/profiling.c + the PINS hook
@@ -2048,7 +2068,7 @@ void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
   if (trace) {
     ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
     ProfLockGuard g(b);
-    b->words.insert(b->words.end(), w, w + PROF_WORDS);
+    b->append(w, PROF_WORDS);
   }
   if (pins) pins_fire(ctx, key, w);
 }
@@ -2065,7 +2085,7 @@ void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
   if (!trace) return;
   ProfBuf *b = ctx->prof[0];
   ProfLockGuard g(b);
-  b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
+  b->append(w, 2 * PROF_WORDS);
 }
 
 namespace {
@@ -2092,7 +2112,7 @@ static void prof_event_pair(ptc_context *ctx, int worker, int64_t key,
   if (trace) {
     ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
     ProfLockGuard g(b);
-    b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
+    b->append(w, 2 * PROF_WORDS);
   }
   if (pins) {
     pins_fire(ctx, key, w);
@@ -2116,7 +2136,7 @@ static void prof_edge(ptc_context *ctx, int worker, ptc_task *src,
       (int64_t)worker, 0, now,
       PROF_KEY_EDGE, 1, dst_class, dl0, dl1,
       (int64_t)worker, 0, now};
-  b->words.insert(b->words.end(), w, w + 2 * PROF_WORDS);
+  b->append(w, 2 * PROF_WORDS);
 }
 
 /* PTG-path edge: dep params arrive in range-param order; translate them
@@ -2883,6 +2903,14 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
   }
   if (const char *e = std::getenv("PTC_MCA_deptable_dense_max"))
     ctx->dense_max_slots = std::atoll(e);
+  /* flight recorder: bound per-worker trace buffers (overwrite-oldest)
+   * and/or arm the failure autodump path.  The Python MCA layer
+   * re-applies its resolved value via ptc_profile_set_ring, same
+   * pattern as sched_bypass below. */
+  if (const char *e = std::getenv("PTC_MCA_runtime_trace_dump"))
+    if (*e) ctx->flight_dump_path = e;
+  if (const char *e = std::getenv("PTC_MCA_runtime_trace_ring"))
+    ptc_profile_set_ring(ctx, std::atoll(e));
   /* same-worker ready-task bypass: on unless PTC_MCA_sched_bypass=0
    * (the Python MCA layer re-applies its resolved value via
    * ptc_context_set_sched_bypass; this env read covers native-only
@@ -3904,16 +3932,100 @@ int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   int64_t written = 0;
   for (auto *b : ctx->prof) {
     ProfLockGuard g(b);
-    int64_t n = (int64_t)b->words.size();
-    int64_t take = std::min(n, cap - written);
-    take -= take % PROF_WORDS;
-    if (take > 0) {
-      std::memcpy(out + written, b->words.data(), (size_t)take * 8);
-      written += take;
-      b->words.erase(b->words.begin(), b->words.begin() + take);
-    }
+    written += b->drain(out + written, cap - written, /*clear=*/true);
   }
   return written;
+}
+
+int32_t ptc_profile_level(ptc_context_t *ctx) {
+  return ctx->prof_level.load(std::memory_order_relaxed);
+}
+
+/* flight-recorder ring: bound each worker's trace buffer to `nbytes`,
+ * overwriting oldest whole events when full (dropped counted).  0
+ * restores unbounded buffers.  Reconfiguring clears buffered events —
+ * arm it before the traced run, as the env form does. */
+void ptc_profile_set_ring(ptc_context_t *ctx, int64_t nbytes) {
+  size_t cap_words = 0;
+  if (nbytes > 0) {
+    cap_words = ((size_t)nbytes / sizeof(int64_t) / PROF_WORDS) * PROF_WORDS;
+    if (cap_words == 0) cap_words = PROF_WORDS; /* at least one event */
+  }
+  ctx->trace_ring_bytes.store(
+      cap_words ? (int64_t)(cap_words * sizeof(int64_t)) : 0,
+      std::memory_order_relaxed);
+  for (auto *b : ctx->prof) {
+    ProfLockGuard g(b);
+    b->cap_words = cap_words;
+    b->head = b->count = 0;
+    b->words.clear();
+    if (cap_words) b->words.resize(cap_words);
+  }
+  /* ring mode arms the failure autodump even without an explicit path */
+  if (cap_words && ctx->flight_dump_path.empty())
+    ctx->flight_dump_path = "/tmp/ptc_flight";
+}
+
+int64_t ptc_profile_ring(ptc_context_t *ctx) {
+  return ctx->trace_ring_bytes.load(std::memory_order_relaxed);
+}
+
+void ptc_flight_set_dump_path(ptc_context_t *ctx, const char *prefix) {
+  ctx->flight_dump_path = prefix ? prefix : "";
+}
+
+int64_t ptc_profile_dropped(ptc_context_t *ctx) {
+  int64_t total = 0;
+  for (auto *b : ctx->prof) {
+    ProfLockGuard g(b);
+    total += b->dropped;
+  }
+  return total;
+}
+
+/* Dump the live trace buffers (WITHOUT draining) as a valid .ptt v2
+ * container: magic + version + a minimal JSON header (the Python layer's
+ * Trace.load fills in the default dictionary) + the raw event words.
+ * The clock-sync meta rides along so a merged post-mortem is still
+ * causally alignable. */
+int32_t ptc_flight_dump(ptc_context_t *ctx, const char *path) {
+  FILE *f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int64_t clock[4] = {0, 0, 0, 0};
+  ptc_comm_clock_stats(ctx, clock);
+  char hdr[512];
+  int hlen = std::snprintf(
+      hdr, sizeof hdr,
+      "{\"rank\": %u, \"dictionary\": {}, \"class_names\": [], "
+      "\"meta\": {\"flight\": 1, \"dropped_events\": %lld, "
+      "\"ring_bytes\": %lld, \"clock_offset_ns\": %lld, "
+      "\"clock_err_ns\": %lld}}",
+      ctx->myrank, (long long)ptc_profile_dropped(ctx),
+      (long long)ctx->trace_ring_bytes.load(std::memory_order_relaxed),
+      (long long)clock[0], (long long)clock[1]);
+  if (hlen <= 0 || hlen >= (int)sizeof hdr) {
+    std::fclose(f);
+    return -1;
+  }
+  const char magic[8] = {'#', 'P', 'T', 'C', 'P', 'R', 'O', 'F'};
+  uint32_t ver = 2, h = (uint32_t)hlen;
+  bool ok = std::fwrite(magic, 1, 8, f) == 8 &&
+            std::fwrite(&ver, 4, 1, f) == 1 &&
+            std::fwrite(&h, 4, 1, f) == 1 &&
+            std::fwrite(hdr, 1, (size_t)hlen, f) == (size_t)hlen;
+  std::vector<int64_t> tmp;
+  for (auto *b : ctx->prof) {
+    if (!ok) break;
+    ProfLockGuard g(b);
+    int64_t n = b->cap_words ? (int64_t)b->count : (int64_t)b->words.size();
+    tmp.resize((size_t)(n > 0 ? n : 1));
+    int64_t got = b->drain(tmp.data(), n, /*clear=*/false);
+    if (got > 0)
+      ok = std::fwrite(tmp.data(), sizeof(int64_t), (size_t)got, f) ==
+           (size_t)got;
+  }
+  ok = (std::fclose(f) == 0) && ok;
+  return ok ? 0 : -1;
 }
 
 } /* extern "C" */

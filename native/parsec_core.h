@@ -359,6 +359,25 @@ void ptc_prof_event(ptc_context_t *ctx, int64_t key, int64_t phase,
                     int64_t class_id, int64_t l0, int64_t l1, int64_t aux);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
+/* current trace level (0 off, 1 spans, 2 +edges) */
+int32_t ptc_profile_level(ptc_context_t *ctx);
+/* flight-recorder ring mode (PTC_MCA_runtime_trace_ring): bound each
+ * worker's trace buffer to `nbytes` (rounded down to whole events),
+ * overwriting OLDEST events when full; 0 restores unbounded buffers.
+ * Reconfiguring clears buffered events (set it before the run). */
+void ptc_profile_set_ring(ptc_context_t *ctx, int64_t nbytes);
+int64_t ptc_profile_ring(ptc_context_t *ctx); /* configured bytes/worker */
+/* events overwritten-before-taken across all workers (ring mode) */
+int64_t ptc_profile_dropped(ptc_context_t *ctx);
+/* Dump the current trace buffers (WITHOUT draining them) as a valid
+ * .ptt v2 file at `path` — the flight-recorder sink.  Also fired
+ * automatically (once, to PTC_MCA_runtime_trace_dump or
+ * /tmp/ptc_flight.<rank>.ptt) on taskpool abort and peer loss when
+ * tracing is on.  Returns 0, or -1 when the file cannot be written. */
+int32_t ptc_flight_dump(ptc_context_t *ctx, const char *path);
+/* arm/replace the autodump path prefix (NULL or "" disarms unless ring
+ * mode re-arms the /tmp default); call before the traced run */
+void ptc_flight_set_dump_path(ptc_context_t *ctx, const char *prefix);
 
 /* PINS: pluggable instrumentation callback at the trace event points
  * (reference: parsec/mca/pins/pins.h:26-54).  cb receives the 8-word
@@ -539,6 +558,16 @@ void ptc_comm_tuning(ptc_context_t *ctx, int64_t *out8);
 /* streaming pipeline: [sessions, parked_gets, overlap_ns, d2h_ns,
  * wire_ns, reaps, rails, stream_enabled] */
 void ptc_comm_stream_stats(ptc_context_t *ctx, int64_t *out8);
+/* distributed clock sync (tracing v2): each rank estimates its
+ * ptc_now_ns offset to RANK 0's clock from PING/PONG midpoints over the
+ * existing wire (probed at comm bring-up and refreshed at each fence;
+ * the minimum-RTT sample wins).  out4 = [offset_ns (rank0 - local),
+ * err_ns (RTT of the winning sample — the uncertainty bound),
+ * samples used, measured flag].  Rank 0 reports offset 0/measured 1. */
+void ptc_comm_clock_stats(ptc_context_t *ctx, int64_t *out4);
+/* re-probe now (blocks up to ~2s for at least one fresh sample);
+ * returns samples accumulated so far */
+int64_t ptc_comm_clock_sync(ptc_context_t *ctx);
 
 /* distributed taskpool id (SPMD creation order; assigned at add_taskpool) */
 int32_t ptc_tp_id(ptc_taskpool_t *tp);

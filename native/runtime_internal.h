@@ -13,6 +13,7 @@
 
 #include "parsec_core.h"
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <condition_variable>
@@ -566,6 +567,8 @@ struct DeviceQueue {
   std::atomic<double> weight{1.0};   /* relative device speed */
 };
 
+enum { PROF_WORDS_K = 8 }; /* words per event (== PROF_WORDS below) */
+
 struct ProfBuf {
   /* spinlock, not a mutex: the push critical section is a ~16-word
    * append (amortized), paid once per task at trace level 1 — an
@@ -573,11 +576,62 @@ struct ProfBuf {
    * is rare (owner worker + comm-thread instants on buffer 0 + take). */
   std::atomic_flag lock = ATOMIC_FLAG_INIT;
   std::vector<int64_t> words; /* PROF_WORDS words per event */
+  /* flight-recorder ring (PTC_MCA_runtime_trace_ring): cap_words > 0
+   * bounds the buffer; pushes wrap, overwriting oldest whole events
+   * (dropped counts them), so long production runs always keep the
+   * last-N-bytes tail instead of growing without bound.  head = next
+   * write offset, count = live words; all fields are lock-guarded. */
+  size_t cap_words = 0, head = 0, count = 0;
+  int64_t dropped = 0; /* events overwritten before being taken */
   void acquire() {
     while (lock.test_and_set(std::memory_order_acquire))
       std::this_thread::yield();
   }
   void release() { lock.clear(std::memory_order_release); }
+  /* append n words (a multiple of PROF_WORDS); lock held by caller */
+  void append(const int64_t *w, size_t n) {
+    if (cap_words == 0) {
+      words.insert(words.end(), w, w + n);
+      return;
+    }
+    if (n > cap_words) { /* degenerate cap: keep the newest tail */
+      dropped += (int64_t)((n - cap_words) / PROF_WORDS_K);
+      w += n - cap_words;
+      n = cap_words;
+    }
+    if (words.size() != cap_words) words.resize(cap_words);
+    if (count + n > cap_words)
+      dropped += (int64_t)((count + n - cap_words) / PROF_WORDS_K);
+    for (size_t i = 0; i < n; i++) {
+      words[head] = w[i];
+      head = head + 1 == cap_words ? 0 : head + 1;
+    }
+    count = std::min(cap_words, count + n);
+  }
+  /* copy the live contents oldest-first into out (<= cap_out words,
+   * whole events only); lock held.  clear=true resets the buffer. */
+  int64_t drain(int64_t *out, int64_t cap_out, bool clear) {
+    int64_t n = cap_words ? (int64_t)count : (int64_t)words.size();
+    int64_t take = std::min(n, cap_out);
+    take -= take % PROF_WORDS_K;
+    if (take > 0) {
+      if (cap_words) {
+        size_t start = (head + cap_words - count) % cap_words;
+        for (int64_t i = 0; i < take; i++)
+          out[i] = words[(start + (size_t)i) % cap_words];
+      } else {
+        std::memcpy(out, words.data(), (size_t)take * sizeof(int64_t));
+      }
+    }
+    if (clear && take > 0) {
+      if (cap_words) {
+        count -= (size_t)take; /* newest `count` words stay */
+      } else {
+        words.erase(words.begin(), words.begin() + take);
+      }
+    }
+    return take;
+  }
 };
 
 /* RAII for ProfBuf::acquire/release */
@@ -779,6 +833,14 @@ struct ptc_context {
   /* profiling */
   std::atomic<int32_t> prof_level{0}; /* 0 off, 1 spans, 2 +edges */
   std::vector<ProfBuf *> prof;
+  /* flight recorder: per-worker ring cap in bytes (0 = unbounded
+   * buffers; PTC_MCA_runtime_trace_ring) and the dump-path prefix the
+   * autodump writes "<prefix>.<rank>.ptt" to on taskpool abort / peer
+   * loss (PTC_MCA_runtime_trace_dump; defaults to /tmp/ptc_flight when
+   * ring mode is on) */
+  std::atomic<int64_t> trace_ring_bytes{0};
+  std::string flight_dump_path;
+  std::atomic<bool> flight_dumped{false};
   /* PINS instrumentation sink (pins.h:26-54 analog; see pins_fire).
    * cb/user/mask live in one atomically-swapped block so a racing reader
    * can never pair an old callback with a new user pointer; retired
@@ -874,6 +936,12 @@ void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
  * events; buffer 0 is shared with worker 0) */
 void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
                       int64_t l0, int64_t l1, int64_t aux);
+
+/* flight-recorder autodump: writes the current (ring) trace contents to
+ * "<flight_dump_path>.<rank>.ptt" at most once per context — called on
+ * taskpool abort (core.cpp) and peer loss (comm.cpp) so production
+ * failures always leave a last-N-seconds trace behind. */
+void ptc_flight_autodump(ptc_context *ctx, const char *reason);
 
 /* deliver one dependency release to a local successor instance (the
  * incoming half of the remote ACTIVATE path calls this).

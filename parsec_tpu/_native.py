@@ -254,6 +254,12 @@ _sigs = {
     "ptc_task_get_tag": (C.c_int64, [C.c_void_p]),
     "ptc_profile_enable": (None, [C.c_void_p, C.c_int32]),
     "ptc_profile_take": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
+    "ptc_profile_level": (C.c_int32, [C.c_void_p]),
+    "ptc_profile_set_ring": (None, [C.c_void_p, C.c_int64]),
+    "ptc_profile_ring": (C.c_int64, [C.c_void_p]),
+    "ptc_profile_dropped": (C.c_int64, [C.c_void_p]),
+    "ptc_flight_dump": (C.c_int32, [C.c_void_p, C.c_char_p]),
+    "ptc_flight_set_dump_path": (None, [C.c_void_p, C.c_char_p]),
     "ptc_worker_stats": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
     "ptc_worker_steals": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
     "ptc_prof_event": (None, [C.c_void_p, C.c_int64, C.c_int64, C.c_int64,
@@ -269,6 +275,8 @@ _sigs = {
     "ptc_comm_rdv_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_tuning": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_stream_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
+    "ptc_comm_clock_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
+    "ptc_comm_clock_sync": (C.c_int64, [C.c_void_p]),
     "ptc_tp_id": (C.c_int32, [C.c_void_p]),
     "ptc_dtile_set_owner": (None, [C.c_void_p, C.c_uint32]),
     "ptc_dtask_set_rank": (None, [C.c_void_p, C.c_int32]),

@@ -95,6 +95,16 @@ class Context:
         if _mca.get("runtime.profile"):
             # same meaning as profile_enable(True): full tracing incl. EDGE
             N.lib.ptc_profile_enable(self._ptr, 2)
+        if _mca.get("runtime.trace_ring"):
+            # flight recorder: the native env read in ptc_context_new
+            # covers native-only embeddings; re-applying the resolved MCA
+            # value keeps file/set() spellings working (sched_bypass
+            # pattern)
+            N.lib.ptc_profile_set_ring(self._ptr,
+                                       _mca.get("runtime.trace_ring"))
+        if _mca.get("runtime.trace_dump"):
+            N.lib.ptc_flight_set_dump_path(
+                self._ptr, _mca.get("runtime.trace_dump").encode())
         self._pins_chain = None
         # monitors/devices lists exist before any hook can install into
         # them (the live monitor registers for teardown at construction)
@@ -427,6 +437,8 @@ class Context:
           comm    -> engine/rdv/tuning/stream counter groups (empty
                      sub-dicts stay present when comm is off, so the
                      schema is stable across single- and multi-rank runs)
+          trace   -> tracing health: level, ring/drop state of the
+                     flight recorder, and the clock-sync estimate
         """
         tuning = self.comm_tuning()
         return {
@@ -440,6 +452,12 @@ class Context:
                 # same snapshot as tuning["stream"], surfaced at the top
                 # level too — one native read, two access paths, no skew
                 "stream": tuning["stream"],
+            },
+            "trace": {
+                "level": self.profile_level(),
+                "ring_bytes": self.profile_ring(),
+                "dropped_events": self.profile_dropped(),
+                "clock": self.comm_clock(),
             },
         }
 
@@ -712,6 +730,54 @@ class Context:
         any level (their key mask is the gate)."""
         level = 2 if enable is True else int(enable)
         N.lib.ptc_profile_enable(self._ptr, level)
+
+    def profile_level(self) -> int:
+        """Current trace level (0 off, 1 spans, 2 +edges)."""
+        return N.lib.ptc_profile_level(self._ptr)
+
+    def profile_ring(self, nbytes: Optional[int] = None) -> int:
+        """Flight-recorder ring mode (runtime.trace_ring /
+        PTC_MCA_runtime_trace_ring): bound each worker's trace buffer to
+        `nbytes`, overwriting OLDEST whole events when full — long
+        production runs keep the last-N-seconds tail instead of growing
+        without bound, and a taskpool abort / lost peer auto-dumps it
+        (see flight_dump).  Call with no argument to read the configured
+        bytes-per-worker (0 = unbounded); reconfiguring clears buffered
+        events, so arm it before the run."""
+        if nbytes is not None:
+            N.lib.ptc_profile_set_ring(self._ptr, int(nbytes))
+        return N.lib.ptc_profile_ring(self._ptr)
+
+    def profile_dropped(self) -> int:
+        """Events overwritten before being taken (ring mode), summed
+        across workers — the flight recorder's loss meter."""
+        return N.lib.ptc_profile_dropped(self._ptr)
+
+    def flight_dump(self, path: str) -> None:
+        """Write the CURRENT trace buffers (without draining them) as a
+        loadable .ptt v2 file — the flight-recorder sink.  The runtime
+        fires this automatically (once) on taskpool abort and peer loss,
+        to PTC_MCA_runtime_trace_dump or /tmp/ptc_flight.<rank>.ptt."""
+        if N.lib.ptc_flight_dump(self._ptr, str(path).encode()) != 0:
+            raise OSError(f"flight dump to {path!r} failed")
+
+    def comm_clock(self) -> dict:
+        """Clock-sync estimate against rank 0 (distributed tracing v2):
+        offset_ns such that local_t + offset_ns ≈ rank 0's ptc_now_ns,
+        measured from PING/PONG midpoints at comm bring-up and refreshed
+        at each fence (minimum-RTT sample wins; err_ns is that RTT — the
+        uncertainty bound).  Trace.merge applies it so merged timelines
+        are causally consistent.  measured is False before the first
+        sample (and in single-process contexts)."""
+        buf = (C.c_int64 * 4)()
+        N.lib.ptc_comm_clock_stats(self._ptr, buf)
+        return {"offset_ns": buf[0], "err_ns": buf[1],
+                "samples": buf[2], "measured": bool(buf[3])}
+
+    def comm_clock_sync(self) -> int:
+        """Force a fresh clock-sync probe burst (blocks up to ~2s for at
+        least one sample); returns total samples accumulated."""
+        return N.lib.ptc_comm_clock_sync(self._ptr)
 
     def profile_take(self) -> np.ndarray:
         """Drain profiling buffers; returns an (n, 8) int64 array of

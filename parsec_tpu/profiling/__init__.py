@@ -12,18 +12,25 @@ TPU-native equivalent over the native core's 8-word event stream
   Dictionary     event-key registry with names/colors
   Trace          take/save/load/merge + to_pandas() trace tables +
                  to_perfetto() standard-tool sink (the OTF2-writer analog)
+                 — merge applies cross-rank CLOCK SYNC, detects
+                 dictionary conflicts and matches send/recv FLOW ids
+                 (tracing v2); flows()/wire_latency() expose the
+                 per-message pairs
+  critpath       critical_path() / lost_time() over the executed DAG
   to_dot         executed-DAG capture from EDGE event pairs
   pins           pluggable instrumentation-module chain at the event
                  points (parsec/mca/pins/pins.h analog), MCA-selected
 """
 from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
                     KEY_COMM_SEND, KEY_COMM_RECV, KEY_DEVICE, KEY_H2D,
-                    Dictionary, Trace, take_trace, to_dot)
+                    KEY_STREAM, Dictionary, Trace, take_trace, to_dot)
+from .critpath import critical_path, lost_time
 from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
-                   CommVolume, REGISTRY, enable_pins)
+                   CommVolume, DeviceActivity, REGISTRY, enable_pins)
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE", "KEY_H2D",
-           "Dictionary", "Trace", "take_trace", "to_dot",
+           "KEY_STREAM", "Dictionary", "Trace", "take_trace", "to_dot",
+           "critical_path", "lost_time",
            "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
-           "CommVolume", "REGISTRY", "enable_pins"]
+           "CommVolume", "DeviceActivity", "REGISTRY", "enable_pins"]

@@ -87,8 +87,23 @@ class LiveMonitor:
             qid = getattr(dev, "qid", None)
             if qid is not None:
                 rec[f"dev{i}_qdepth"] = ctx.device_queue_depth(qid)
+        if ctx._devices:
+            # device-pipeline counters (PR3): prefetch effectiveness +
+            # stall/overlap evolution is what a live dashboard watches
+            ds = ctx.device_stats()
+            rec["device"] = {k: ds[k] for k in
+                             ("prefetch_hits", "prefetch_misses",
+                              "prefetch_staged", "h2d_stall_ns",
+                              "prefetch_h2d_ns", "overlap_ratio",
+                              "spills", "reserve_fails")}
         if ctx.comm_enabled:
             rec["comm"] = ctx.comm_stats()
+            # streaming-pipeline counters (PR4): session count + the
+            # d2h/wire overlap fraction, live
+            ss = ctx.comm_stream_stats()
+            rec["stream"] = {k: ss[k] for k in
+                             ("sessions", "parked_gets",
+                              "overlap_fraction")}
         ru = ctx.rusage()
         rec["maxrss_kb"] = ru["maxrss_kb"]
         rec["utime_s"] = ru["utime_s"]

@@ -22,8 +22,8 @@ import threading
 from typing import Dict, List, Optional, Type
 
 from .. import _native as N
-from .trace import (KEY_COMM_RECV, KEY_COMM_SEND, KEY_EDGE, KEY_EXEC,
-                    KEY_RELEASE)
+from .trace import (KEY_COMM_RECV, KEY_COMM_SEND, KEY_DEVICE, KEY_EDGE,
+                    KEY_EXEC, KEY_H2D, KEY_RELEASE, KEY_STREAM)
 
 PINS_CB_T = N.PINS_CB_T
 
@@ -31,11 +31,15 @@ PINS_CB_T = N.PINS_CB_T
 class PinsModule:
     """Base instrumentation module.  Override `mask` (bitmask of event
     keys to receive) and `on_event`.  on_event runs synchronously on
-    worker/comm threads — keep it tiny and non-blocking."""
+    worker/comm threads — keep it tiny and non-blocking.  Every native
+    trace key is subscribable, including the device-pipeline keys
+    (KEY_DEVICE dispatch waves, KEY_H2D staging with aux = lane,
+    KEY_STREAM progressive-serve d2h slices) — the device manager pushes
+    them through the same ptc_prof_event sink the worker events use."""
 
     name = "module"
     mask = (1 << KEY_EXEC) | (1 << KEY_RELEASE) | (1 << KEY_COMM_SEND) | \
-           (1 << KEY_COMM_RECV)
+           (1 << KEY_COMM_RECV) | (1 << KEY_DEVICE)
 
     def on_event(self, key: int, phase: int, class_id: int, l0: int,
                  l1: int, worker: int, aux: int, t_ns: int) -> None:
@@ -200,12 +204,48 @@ class HwCounters(PinsModule):
             sys.stderr.write("ptc [pins] hwcounters:\n" + rep + "\n")
 
 
+class DeviceActivity(PinsModule):
+    """Device-pipeline accounting at the PINS seam (the PR3/PR4 counters
+    as a live instrumentation module): dispatch waves + lanes, h2d bytes
+    split by lane (0 = dispatch-time stall, 1 = prefetch overlap), and
+    progressive-serve d2h slices.  The same numbers Context.device_stats
+    aggregates, but streamed per event — usable without tracing on."""
+
+    name = "device_activity"
+    mask = (1 << KEY_DEVICE) | (1 << KEY_H2D) | (1 << KEY_STREAM)
+
+    def __init__(self):
+        self.waves = 0
+        self.lanes = 0
+        self.stall_ns = 0          # DEVICE end-aux: dispatch h2d stall
+        self.h2d_bytes = [0, 0]    # by lane: [dispatch-stall, prefetch]
+        self.stream_slices = 0
+        self.stream_bytes = 0
+        self._lock = threading.Lock()  # see TaskCounter
+
+    def on_event(self, key, phase, class_id, l0, l1, worker, aux, t_ns):
+        with self._lock:
+            if key == KEY_DEVICE:
+                if phase == 1:
+                    self.waves += 1
+                    self.lanes += l0
+                    self.stall_ns += aux
+            elif key == KEY_H2D:
+                if phase == 1:
+                    self.h2d_bytes[1 if aux else 0] += l0
+            elif key == KEY_STREAM:
+                if phase == 1:
+                    self.stream_slices += 1
+                    self.stream_bytes += l0
+
+
 REGISTRY: Dict[str, Type[PinsModule]] = {
     TaskCounter.name: TaskCounter,
     TaskProfiler.name: TaskProfiler,
     CommVolume.name: CommVolume,
     PrintSteals.name: PrintSteals,
     HwCounters.name: HwCounters,
+    DeviceActivity.name: DeviceActivity,
 }
 
 

@@ -3,13 +3,21 @@
 File format (".ptt", the dbp analog — parsec/parsec_binary_profile.h:45
 magic "#PARSEC BINARY PROFILE" becomes "#PTCPROF"):
   bytes 0..7   magic b"#PTCPROF"
-  bytes 8..11  version (u32 LE) = 1
+  bytes 8..11  version (u32 LE) = 2
   bytes 12..15 header length H (u32 LE)
   bytes 16..16+H  JSON header {rank, dictionary:{key:{name,color}}, meta}
   rest         int64 LE event words, 8 per event:
                (key, phase, class_id, l0, l1, worker, aux, t_ns)
-Per-rank files merge by concatenation of event tables (rank column added),
-the same property the reference's dbp merge tooling relies on.
+
+Header v2 (distributed tracing): `meta` carries the rank's measured
+clock offset to rank 0 (`clock_offset_ns`, PING/PONG midpoint estimate
+with `clock_err_ns` = the winning sample's RTT), flight-recorder
+provenance (`dropped_events`, `ring_bytes`), and COMM_SEND/COMM_RECV
+events carry a flow-correlation id — (peer, cookie) in (l0, l1) — so
+`Trace.merge` can align per-rank timelines and pair sends with their
+deliveries across ranks (reference: the dbp merge's cross-rank clock
+resolution + OTF2 message matching, parsec/profiling.c,
+parsec/profiling_otf2.c).  v1 files still load (offset 0, no flows).
 """
 import json
 import struct
@@ -20,8 +28,12 @@ import numpy as np
 KEY_EXEC = 0       # task body begin/end
 KEY_RELEASE = 1    # release_deps begin/end
 KEY_EDGE = 2       # dep edge, consecutive src(phase0)/dst(phase1) pair
-KEY_COMM_SEND = 3  # per-target activation send (instant span), aux = bytes
-KEY_COMM_RECV = 4  # per-target activation delivery (instant span)
+KEY_COMM_SEND = 3  # per-frame activation send (instant span),
+                   # l0 = destination rank, l1 = correlation cookie,
+                   # aux = payload bytes
+KEY_COMM_RECV = 4  # per-frame activation delivery (instant span),
+                   # l0 = source rank, l1 = correlation cookie (matches
+                   # the producer's COMM_SEND), aux = payload bytes
 KEY_DEVICE = 5     # device dispatch call begin/end, l0 = lanes; the END
                    # event's aux = the wave's dispatch-time h2d stall ns
                    # (0 == prefetch-hit wave)
@@ -31,7 +43,8 @@ KEY_STREAM = 7     # progressive-serve d2h span (writeback lane slicing a
                    # remote-pulled mirror), l0 = bytes, l1 = device queue
 
 _MAGIC = b"#PTCPROF"
-_VERSION = 1
+_VERSION = 2
+_LOADABLE_VERSIONS = (1, 2)
 
 _DEFAULT_KEYS = {
     KEY_EXEC: ("EXEC", "#00ff00"),
@@ -105,7 +118,7 @@ class Trace:
         if raw[:8] != _MAGIC:
             raise ValueError(f"{path}: not a ptt trace (bad magic)")
         ver, hlen = struct.unpack("<II", raw[8:16])
-        if ver != _VERSION:
+        if ver not in _LOADABLE_VERSIONS:
             raise ValueError(f"{path}: unsupported trace version {ver}")
         hdr = json.loads(raw[16:16 + hlen])
         ev = np.frombuffer(raw[16 + hlen:], dtype="<i8").reshape(-1, 8)
@@ -114,39 +127,194 @@ class Trace:
                    hdr.get("class_names"))
 
     @classmethod
-    def merge(cls, traces: List["Trace"]) -> "Trace":
-        """Concatenate per-rank traces (the dbp-merge analog)."""
-        out = cls(np.concatenate([t.events for t in traces]),
-                  traces[0].dict, traces[0].rank,
-                  {"merged_ranks": [t.rank for t in traces]},
-                  traces[0].class_names)
-        out.ranks = np.concatenate([t.ranks for t in traces])
+    def merge(cls, traces: List["Trace"], apply_offsets: bool = True,
+              causal: bool = True) -> "Trace":
+        """Merge per-rank traces into one causally-consistent timeline
+        (the dbp-merge analog, now with cross-rank clock resolution).
+
+        - Dictionaries and class_names are merged with CONFLICT
+          DETECTION: the same key id (or class id) mapped to two
+          different names raises ValueError instead of silently taking
+          traces[0]'s — dynamic keys registered on one rank no longer
+          mislabel merged events; a name present on only some ranks is
+          adopted.
+        - `apply_offsets` shifts each trace's timestamps by its
+          `meta["clock_offset_ns"]` (the PING/PONG estimate against
+          rank 0 taken at comm bring-up/fence), putting every rank on
+          rank 0's clock.
+        - `causal` then enforces the physical invariant the estimate
+          can only approximate: every matched COMM_RECV begins at or
+          after its COMM_SEND.  Residual violations first move whole
+          ranks (difference-constraint relaxation), then clamp the few
+          stragglers event-wise; the corrections applied are recorded in
+          meta ("causal_shift_ns", "clamped_recvs").
+        """
+        dictionary = Dictionary()
+        dictionary.keys = {}
+        for t in traces:
+            for k, v in t.dict.keys.items():
+                k = int(k)
+                have = dictionary.keys.get(k)
+                if have is not None and have["name"] != v["name"]:
+                    raise ValueError(
+                        f"dictionary conflict merging rank {t.rank}: key "
+                        f"{k} is {have['name']!r} on an earlier rank but "
+                        f"{v['name']!r} here — register dynamic keys "
+                        "identically on every rank")
+                if have is None:
+                    dictionary.keys[k] = dict(v)
+        class_names: List[str] = []
+        for t in traces:
+            for i, nm in enumerate(t.class_names or []):
+                if i < len(class_names):
+                    if class_names[i] != nm:
+                        raise ValueError(
+                            f"class_names conflict merging rank {t.rank}: "
+                            f"class {i} is {class_names[i]!r} on an "
+                            f"earlier rank but {nm!r} here")
+                else:
+                    class_names.append(nm)
+        offsets = {}
+        evs = []
+        for t in traces:
+            e = t.events.copy()
+            off = int(t.meta.get("clock_offset_ns", 0)) if apply_offsets \
+                else 0
+            if off:
+                e[:, 7] += off
+            offsets[int(t.rank)] = off
+            evs.append(e)
+        out = cls(np.concatenate(evs) if evs else
+                  np.empty((0, 8), dtype=np.int64),
+                  dictionary, traces[0].rank if traces else 0,
+                  {"merged_ranks": [t.rank for t in traces],
+                   "clock_offsets_ns": offsets},
+                  class_names)
+        out.ranks = np.concatenate([t.ranks for t in traces]) if traces \
+            else out.ranks
+        if causal:
+            out._enforce_causality()
         return out
 
+    def _enforce_causality(self, max_passes: int = 16):
+        """Post-offset fix-up: recv-before-send across ranks is a clock
+        artifact, never physics.  Pass 1..n relax whole-rank shifts (the
+        difference-constraint system recv >= send per rank pair); an
+        infeasible system — offset error larger than true wire latency,
+        common on loopback where both are microseconds — falls back to
+        clamping the violated recv instants to their send time."""
+        shifts: Dict[int, int] = {}
+        for _ in range(max_passes):
+            fl = self._match_flows()
+            viol = fl["send_ns"] - fl["recv_ns"]
+            bad = viol > 0
+            if not bad.any():
+                break
+            worst_dst = {}
+            for dst in np.unique(fl["dst"][bad]):
+                worst_dst[int(dst)] = int(
+                    viol[bad & (fl["dst"] == dst)].max())
+            # relax: shift each violated receiver's whole rank forward
+            for dst, d in worst_dst.items():
+                self.events[self.ranks == dst, 7] += d
+                shifts[dst] = shifts.get(dst, 0) + d
+        clamped = 0
+        fl = self._match_flows()
+        viol = fl["send_ns"] - fl["recv_ns"]
+        bad = np.flatnonzero(viol > 0)
+        for i in bad:
+            ri = int(fl["recv_idx"][i])
+            t_send = int(fl["send_ns"][i])
+            self.events[ri, 7] = t_send
+            # the paired instant END row rides directly after the begin
+            if (ri + 1 < len(self.events)
+                    and self.events[ri + 1, 0] == KEY_COMM_RECV
+                    and self.events[ri + 1, 1] == 1
+                    and self.events[ri + 1, 4] == self.events[ri, 4]):
+                self.events[ri + 1, 7] = max(
+                    int(self.events[ri + 1, 7]), t_send)
+            clamped += 1
+        if shifts:
+            self.meta["causal_shift_ns"] = shifts
+        self.meta["clamped_recvs"] = clamped
+
     # ----------------------------------------------------- trace tables
+    def _spans_table(self) -> np.ndarray:
+        """Vectorized begin/end pairing: an (n, 10) int64 table with
+        columns (rank, worker, key, class_id, l0, l1, aux, begin_ns,
+        end_ns, end_event_index), ordered like the historical per-event
+        loop (by end-event position).  Pairing is per (rank, worker,
+        key, class, l0, l1); the numpy fast path pairs each end with its
+        immediate predecessor inside the group (the alternating-span
+        common case — one pass, no Python loop); groups where that rule
+        fails (nested same-signature spans) re-pair with the LIFO stack
+        the old implementation used."""
+        ev = self.events
+        empty = np.empty((0, 10), dtype=np.int64)
+        if not len(ev):
+            return empty
+        keep = ev[:, 0] != KEY_EDGE
+        idx = np.flatnonzero(keep)
+        if not len(idx):
+            return empty
+        e = ev[idx]
+        rk = self.ranks[idx]
+        sig = np.stack([rk, e[:, 5], e[:, 0], e[:, 2], e[:, 3], e[:, 4]],
+                       axis=1)
+        _, ginv = np.unique(sig, axis=0, return_inverse=True)
+        ginv = ginv.reshape(-1)
+        order = np.lexsort((np.arange(len(e)), ginv))
+        g = ginv[order]
+        ph = e[order, 1]
+        ends = np.flatnonzero(ph == 1)
+        ok = np.zeros(len(ends), dtype=bool)
+        valid = ends > 0
+        pv = ends[valid] - 1
+        ok[valid] = (g[pv] == g[ends[valid]]) & (ph[pv] == 0)
+        bad_groups = np.unique(g[ends[~ok]])
+        pairs_b: List[np.ndarray] = []
+        pairs_e: List[np.ndarray] = []
+        good = ok.copy()
+        if len(bad_groups):
+            good &= ~np.isin(g[ends], bad_groups)
+        ge = ends[good]
+        pairs_b.append(order[ge - 1])
+        pairs_e.append(order[ge])
+        if len(bad_groups):
+            # stack fallback, only for the (rare) nested groups
+            fb_b, fb_e = [], []
+            stacks: Dict[int, list] = {}
+            for p in np.flatnonzero(np.isin(g, bad_groups)):
+                i_e = order[p]
+                if ph[p] == 0:
+                    stacks.setdefault(int(g[p]), []).append(i_e)
+                else:
+                    st = stacks.get(int(g[p]))
+                    if st:
+                        fb_b.append(st.pop())
+                        fb_e.append(i_e)
+            pairs_b.append(np.asarray(fb_b, dtype=np.int64))
+            pairs_e.append(np.asarray(fb_e, dtype=np.int64))
+        bi = np.concatenate(pairs_b) if pairs_b else np.empty(0, np.int64)
+        ei = np.concatenate(pairs_e) if pairs_e else np.empty(0, np.int64)
+        if not len(ei):
+            return empty
+        eb, ee = e[bi], e[ei]
+        table = np.column_stack([
+            rk[ei], ee[:, 5], ee[:, 0], ee[:, 2], ee[:, 3], ee[:, 4],
+            np.maximum(eb[:, 6], ee[:, 6]), eb[:, 7], ee[:, 7], idx[ei]])
+        return table[np.argsort(table[:, 9], kind="stable")]
+
     def spans(self):
         """Pair begin/end events into spans — the single pairing rule
         shared by to_pandas and to_perfetto.  Yields tuples
         (rank, worker, key, class_id, l0, l1, aux, begin_ns, end_ns);
         EDGE events are excluded (use edges()/to_dot).  Pairing is per
         (rank, worker, key, class, l0, l1) with a begin stack; aux is the
-        max of the begin/end words."""
-        ev = self.events
-        open_spans: Dict[tuple, list] = {}
-        for i in range(len(ev)):
-            key, phase, cid, l0, l1, worker, aux, t = (int(x) for x in ev[i])
-            if key == KEY_EDGE:
-                continue
-            rank = int(self.ranks[i])
-            sig = (rank, worker, key, cid, l0, l1)
-            if phase == 0:
-                open_spans.setdefault(sig, []).append((aux, t))
-            else:
-                st = open_spans.get(sig)
-                if st:
-                    aux0, t0 = st.pop()
-                    yield (rank, worker, key, cid, l0, l1, max(aux, aux0),
-                           t0, t)
+        max of the begin/end words.  (Generator API preserved; the
+        pairing itself is vectorized — see _spans_table.)"""
+        for row in self._spans_table():
+            yield tuple(int(x) for x in row[:9])
 
     def to_pandas(self):
         """Paired begin/end events -> one row per span (the reference's
@@ -155,13 +323,17 @@ class Trace:
         Returns a DataFrame with columns: rank, worker, key, name, class_id,
         class_name, l0, l1, aux, begin_ns, end_ns, dur_ns."""
         import pandas as pd
-        rows = [(rank, worker, key, self.dict.name(key), cid,
-                 self._cname(cid), l0, l1, aux, t0, t1, t1 - t0)
-                for (rank, worker, key, cid, l0, l1, aux, t0, t1)
-                in self.spans()]
-        return pd.DataFrame(rows, columns=[
-            "rank", "worker", "key", "name", "class_id", "class_name",
-            "l0", "l1", "aux", "begin_ns", "end_ns", "dur_ns"])
+        t = self._spans_table()
+        df = pd.DataFrame({
+            "rank": t[:, 0], "worker": t[:, 1], "key": t[:, 2],
+            "name": [self.dict.name(int(k)) for k in t[:, 2]],
+            "class_id": t[:, 3],
+            "class_name": [self._cname(int(c)) for c in t[:, 3]],
+            "l0": t[:, 4], "l1": t[:, 5], "aux": t[:, 6],
+            "begin_ns": t[:, 7], "end_ns": t[:, 8],
+            "dur_ns": t[:, 8] - t[:, 7],
+        })
+        return df
 
     def _cname(self, cid: int) -> str:
         if 0 <= cid < len(self.class_names):
@@ -185,6 +357,77 @@ class Trace:
                 i += 1
         return out
 
+    # ------------------------------------------------ flow correlation
+    def _match_flows(self) -> Dict[str, np.ndarray]:
+        """Pair COMM_SEND with COMM_RECV across ranks by the flow key
+        (src_rank, correlation cookie) — the wire-v5 (l0, l1) stamps.
+        Returns parallel arrays: src, dst, corr, bytes, send_ns,
+        recv_ns, send_idx, recv_idx (begin-row indices into events)."""
+        ev, rk = self.events, self.ranks
+        nothing = {k: np.empty(0, dtype=np.int64) for k in
+                   ("src", "dst", "corr", "bytes", "send_ns", "recv_ns",
+                    "send_idx", "recv_idx")}
+        si = np.flatnonzero((ev[:, 0] == KEY_COMM_SEND) & (ev[:, 1] == 0)
+                            & (ev[:, 4] > 0))
+        ri = np.flatnonzero((ev[:, 0] == KEY_COMM_RECV) & (ev[:, 1] == 0)
+                            & (ev[:, 4] > 0) & (ev[:, 3] >= 0))
+        if not len(si) or not len(ri):
+            return nothing
+        # flow key: src rank in the high bits, per-sender cookie low
+        skey = (rk[si] << 44) | ev[si, 4]
+        rkey = (ev[ri, 3] << 44) | ev[ri, 4]
+        so = np.argsort(skey, kind="stable")
+        skey_s = skey[so]
+        pos = np.searchsorted(skey_s, rkey)
+        pos_c = np.minimum(pos, len(skey_s) - 1)
+        hit = skey_s[pos_c] == rkey
+        rsel = np.flatnonzero(hit)
+        if not len(rsel):
+            return nothing
+        s_at = si[so[pos_c[rsel]]]
+        r_at = ri[rsel]
+        return {
+            "src": ev[r_at, 3], "dst": rk[r_at], "corr": ev[r_at, 4],
+            "bytes": ev[s_at, 6], "send_ns": ev[s_at, 7],
+            "recv_ns": ev[r_at, 7], "send_idx": s_at, "recv_idx": r_at,
+        }
+
+    def flows(self) -> np.ndarray:
+        """Matched cross-rank messages: an (m, 7) int64 array with
+        columns (src, dst, corr, bytes, send_ns, recv_ns, latency_ns).
+        Requires a merged (or at least multi-rank) trace whose COMM
+        events carry wire-v5 correlation ids."""
+        m = self._match_flows()
+        return np.column_stack([
+            m["src"], m["dst"], m["corr"], m["bytes"], m["send_ns"],
+            m["recv_ns"], m["recv_ns"] - m["send_ns"],
+        ]) if len(m["src"]) else np.empty((0, 7), dtype=np.int64)
+
+    def wire_latency(self):
+        """Per-message wire latency table (pandas): one row per matched
+        COMM_SEND -> COMM_RECV pair, post clock sync.  The per-(src,dst)
+        aggregate of `latency_ns` is the measured wire cost the
+        transfer-economics harness models."""
+        import pandas as pd
+        f = self.flows()
+        return pd.DataFrame(f, columns=[
+            "src", "dst", "corr", "bytes", "send_ns", "recv_ns",
+            "latency_ns"])
+
+    # -------------------------------------------------------- analysis
+    def critical_path(self, **kw):
+        """Executed-DAG critical path (see profiling.critpath): walks
+        EDGE pairs weighted by EXEC span durations and returns the
+        longest chain with per-class attribution."""
+        from .critpath import critical_path
+        return critical_path(self, **kw)
+
+    def lost_time(self, **kw):
+        """Per-(rank, worker) lost-time breakdown (compute / release /
+        h2d stall / comm wait / idle) — see profiling.critpath."""
+        from .critpath import lost_time
+        return lost_time(self, **kw)
+
     def to_perfetto(self, path: Optional[str] = None):
         """Standard-tool sink: Chrome/Perfetto trace-event JSON (the
         reference ships an OTF2 writer, parsec/profiling_otf2.c, for
@@ -192,8 +435,10 @@ class Trace:
         TPU-era equivalent — ui.perfetto.dev opens it directly).
 
         Spans become "X" complete events with pid=rank / tid=worker;
-        COMM instant spans (begin==end) become "i" instant events.
-        Returns the JSON object; writes it to `path` when given."""
+        COMM instant spans (begin==end) become "i" instant events, and
+        matched send/recv pairs additionally emit "s"/"f" FLOW events so
+        the UI draws arrows between ranks.  Returns the JSON object;
+        writes it to `path` when given."""
         out = []
         for (rank, worker, key, cid, l0, l1, aux, t0, t1) in self.spans():
             name = (self._cname(cid) if key == KEY_EXEC and cid >= 0
@@ -213,6 +458,14 @@ class Trace:
                 rec["ph"] = "X"
                 rec["dur"] = (t1 - t0) / 1e3
             out.append(rec)
+        for row in self.flows():
+            src, dst, corr, nbytes, t_s, t_r, _lat = (int(x) for x in row)
+            fid = f"{src}:{corr}"
+            out.append({"ph": "s", "id": fid, "name": "msg", "cat": "comm",
+                        "pid": src, "tid": -1, "ts": t_s / 1e3})
+            out.append({"ph": "f", "bp": "e", "id": fid, "name": "msg",
+                        "cat": "comm", "pid": dst, "tid": -1,
+                        "ts": t_r / 1e3})
         doc = {"traceEvents": out, "displayTimeUnit": "ns"}
         if path is not None:
             with open(path, "w") as f:
@@ -229,11 +482,30 @@ class Trace:
         return out
 
 
-def take_trace(ctx, rank: int = 0, class_names: Optional[List[str]] = None,
+def take_trace(ctx, rank: Optional[int] = None,
+               class_names: Optional[List[str]] = None,
                meta: Optional[dict] = None) -> Trace:
-    """Drain a Context's native profiling buffers into a Trace."""
+    """Drain a Context's native profiling buffers into a Trace.  The
+    header meta is auto-stamped with the rank's clock-sync estimate and
+    flight-recorder drop count so a later Trace.merge can align ranks
+    without extra plumbing.  `rank` defaults to the context's rank."""
+    m = dict(meta or {})
+    if rank is None:
+        rank = getattr(ctx, "myrank", 0)
+    try:
+        ck = ctx.comm_clock()
+        if ck["measured"]:
+            m.setdefault("clock_offset_ns", ck["offset_ns"])
+            m.setdefault("clock_err_ns", ck["err_ns"])
+    except Exception:
+        pass
+    try:
+        m.setdefault("dropped_events", ctx.profile_dropped())
+        m.setdefault("ring_bytes", ctx.profile_ring())
+    except Exception:
+        pass
     return Trace(ctx.profile_take(), rank=rank, class_names=class_names,
-                 meta=meta)
+                 meta=m)
 
 
 def _node_id(cid, l0, l1, cname):

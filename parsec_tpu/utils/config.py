@@ -173,6 +173,18 @@ register("runtime.bind", "none", str,
 register("runtime.nb_workers", 0, int,
          "worker threads; 0 = hardware count")
 register("runtime.profile", False, bool, "enable event tracing at init")
+register("runtime.trace_ring", 0, int,
+         "flight-recorder mode: bound each worker's trace buffer to this "
+         "many BYTES, overwriting oldest events when full (dropped "
+         "events are counted — Context.profile_dropped).  Production "
+         "runs keep a last-N-seconds trace at O(1) memory; a taskpool "
+         "abort or lost peer dumps it automatically as a loadable .ptt "
+         "(see runtime.trace_dump).  0 = unbounded buffers")
+register("runtime.trace_dump", "", str,
+         "flight-recorder dump path PREFIX: on the first taskpool abort "
+         "or peer loss (tracing on), the runtime writes "
+         "'<prefix>.<rank>.ptt' with the current buffer contents.  "
+         "Empty = /tmp/ptc_flight when ring mode is armed, else off")
 register("runtime.stats", False, bool,
          "print the counter dump (stats_dump) to stderr at context "
          "teardown (reference: --mca device_show_statistics / "

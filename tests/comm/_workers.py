@@ -1910,3 +1910,54 @@ def stream_reap_on_death(rank: int, nodes: int, port: int,
             assert st is not None and st["reaps"] >= 1, (st, rd)
             assert rd["registered_bytes"] == 0, rd
         ctx.comm_fini()
+
+
+def traced_chain(rank: int, nodes: int, port: int, out_dir: str,
+                 nb: int = 24, rendezvous: bool = False):
+    """Tracing-v2 round-trip worker: run the rank-hopping RW chain with
+    level-1 tracing on, fence (which refreshes the clock-sync probe),
+    and save this rank's .ptt (v2 header: clock offset + flow-corr COMM
+    events) for the parent to merge and assert causality on."""
+    import os
+
+    from parsec_tpu.profiling import take_trace
+
+    if rendezvous:
+        os.environ["PTC_MCA_comm_eager_limit"] = "0"  # force GET pulls
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        ctx.profile_enable(1)
+        arr = np.zeros(nodes, dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=8, nodes=nodes,
+                                       myrank=rank)
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                arena="t")
+
+        def body(view):
+            view.data("A", dtype=np.int64)[0] += 1
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        ck = ctx.comm_clock()
+        assert ck["measured"], ck  # rank 0 by definition, peers probed
+        if rank != 0:
+            assert ck["samples"] > 0, ck
+        tr = take_trace(ctx, class_names=["Task"])
+        assert tr.rank == rank  # take_trace defaults to ctx.myrank
+        if rank != 0:
+            assert "clock_offset_ns" in tr.meta, tr.meta
+        tr.save(os.path.join(out_dir, f"r{rank}.ptt"))
+        ctx.comm_fini()

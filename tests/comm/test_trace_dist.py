@@ -1,0 +1,78 @@
+"""Distributed tracing v2: 2-rank SPMD trace round-trip.
+
+Each rank saves its own .ptt (v2 header: measured clock offset + flow
+correlation ids on COMM events); the parent merges them and asserts the
+tentpole's acceptance properties — post-merge causal consistency (every
+matched recv begins at-or-after its send) and 1:1 matched flow ids."""
+import os
+
+import numpy as np
+import pytest
+
+from parsec_tpu.profiling import (KEY_COMM_RECV, KEY_COMM_SEND, Trace)
+
+from . import _workers
+from .test_multirank import _run_spmd
+
+
+def _merged(tmp_path, nodes=2, **kw):
+    out = str(tmp_path)
+    _run_spmd(_workers.traced_chain, nodes, out_dir=out, **kw)
+    traces = [Trace.load(os.path.join(out, f"r{r}.ptt"))
+              for r in range(nodes)]
+    return traces, Trace.merge(traces)
+
+
+def test_2rank_trace_roundtrip_causal(tmp_path):
+    traces, m = _merged(tmp_path, nb=24)
+    # every rank produced events; rank column survived the merge
+    assert set(np.unique(m.ranks)) == {0, 1}
+    # rank 1 carried a measured clock offset in its v2 header
+    assert "clock_offset_ns" in traces[1].meta
+    assert m.meta["clock_offsets_ns"][1] == \
+        traces[1].meta["clock_offset_ns"]
+
+    ev = m.events
+    sends = ev[(ev[:, 0] == KEY_COMM_SEND) & (ev[:, 1] == 0)
+               & (ev[:, 4] > 0)]
+    recvs = ev[(ev[:, 0] == KEY_COMM_RECV) & (ev[:, 1] == 0)
+               & (ev[:, 4] > 0)]
+    assert len(sends) > 0 and len(recvs) > 0
+    fl = m.flows()
+    # MATCHED FLOW IDS: every delivery pairs with exactly one send
+    assert len(fl) == len(recvs), (len(fl), len(recvs))
+    # a 24-hop chain alternating 2 ranks crosses the wire ~24 times
+    assert len(fl) >= 20
+    # corr keys are unique per (src, corr)
+    keys = {(int(r[0]), int(r[2])) for r in fl}
+    assert len(keys) == len(fl)
+    # CAUSAL CONSISTENCY (the acceptance criterion): post-offset, no
+    # matched recv begins before its send
+    assert (fl[:, 6] >= 0).all(), fl[fl[:, 6] < 0]
+    # messages flowed both directions on the alternating chain
+    assert {(int(r[0]), int(r[1])) for r in fl} == {(0, 1), (1, 0)}
+
+    # wire_latency table mirrors flows()
+    wl = m.wire_latency()
+    assert len(wl) == len(fl)
+    assert (wl["latency_ns"] >= 0).all()
+
+
+def test_2rank_rendezvous_flows_match(tmp_path):
+    """eager_limit=0 pushes every payload through the GET rendezvous;
+    the delivery-time COMM_RECV must still carry the ACTIVATE's corr id
+    (the pull window rides inside one logical flow)."""
+    traces, m = _merged(tmp_path, nb=16, rendezvous=True)
+    fl = m.flows()
+    assert len(fl) >= 12
+    assert (fl[:, 6] >= 0).all()
+
+
+def test_merged_perfetto_has_flow_events(tmp_path):
+    _, m = _merged(tmp_path, nb=12)
+    doc = m.to_perfetto()
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert "s" in phases and "f" in phases  # flow arrows present
+    starts = [e for e in doc["traceEvents"] if e["ph"] == "s"]
+    finishes = {e["id"] for e in doc["traceEvents"] if e["ph"] == "f"}
+    assert starts and {e["id"] for e in starts} == finishes

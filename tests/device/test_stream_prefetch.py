@@ -65,7 +65,9 @@ def test_unified_stats_schema_single_rank():
         dev = TpuDevice(ctx)
         try:
             s = ctx.stats()
-            assert set(s) == {"sched", "device", "comm"}
+            assert set(s) == {"sched", "device", "comm", "trace"}
+            for k in ("level", "ring_bytes", "dropped_events", "clock"):
+                assert k in s["trace"], k
             assert "bypass_hits" in s["sched"]
             assert "steals" in s["sched"]
             for k in ("prefetch_hits", "spills", "stream_serves",

@@ -1,0 +1,35 @@
+"""Schema smoke for the tracing-overhead bench (make bench-trace).
+Small task count — this asserts the document shape and that the ring
+run actually wrapped, not the timings."""
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trace_schema():
+    doc = _bench().bench_trace_suite(tasks=1500, reps=1, ring_bytes=4096)
+    assert doc["schema"] == "bench-trace-v1"
+    assert doc["knobs"] == {"tasks": 1500, "reps": 1, "ring_bytes": 4096}
+    assert set(doc["ns_per_task"]) == {"0", "1", "2"}
+    for v in doc["ns_per_task"].values():
+        assert v >= 0
+    ov = doc["overhead_ns_per_task"]
+    assert set(ov) == {"level1", "level2", "ring_level1"}
+    ring = doc["ring"]
+    assert ring["dropped_events"] > 0  # 1500 tasks wrapped a 64-evt ring
+    assert ring["vs_unbounded_level1"] is not None
+    assert ring["ns_per_task"] > 0
+    # shared provenance block (bench.host_provenance)
+    assert "host" in doc and "cpu_count" in doc["host"]
+    assert doc["pipeline_threads"] == 1
+    assert isinstance(doc["oversubscribed"], bool)

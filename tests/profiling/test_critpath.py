@@ -1,0 +1,117 @@
+"""Critical-path & lost-time analysis (tracing v2): the diamond-DAG
+fixture's path must equal the hand-computed one, exactly — the analysis
+walks the same EDGE/EXEC events the runtime emitted, so there is no
+tolerance to hide behind."""
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import (KEY_EXEC, Trace, critical_path,
+                                  lost_time, take_trace)
+
+
+def _run_diamond(slow="B", sleep_slow=0.04, sleep_fast=0.004):
+    """A -> {B, C} -> D with one deliberately slow middle task; returns
+    the level-2 trace.  Hand-computed critical path: [A, <slow>, D]."""
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.profile_enable(2)  # EDGE pairs needed for the DAG walk
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx)
+        sleeps = {"A": 0.002, "B": sleep_fast, "C": sleep_fast,
+                  "D": 0.002, slow: sleep_slow}
+
+        def body_of(name):
+            def body(view):
+                time.sleep(sleeps[name])
+            return body
+
+        a = tp.task_class("A")
+        a.flow("X", "W", pt.Out(pt.Ref("B", flow="X")),
+               pt.Out(pt.Ref("C", flow="X")), arena="t")
+        a.body(body_of("A"))
+        b = tp.task_class("B")
+        b.flow("X", "RW", pt.In(pt.Ref("A", flow="X")),
+               pt.Out(pt.Ref("D", flow="X")), arena="t")
+        b.body(body_of("B"))
+        c = tp.task_class("C")
+        c.flow("X", "RW", pt.In(pt.Ref("A", flow="X")),
+               pt.Out(pt.Ref("D", flow="Y")), arena="t")
+        c.body(body_of("C"))
+        d = tp.task_class("D")
+        d.flow("X", "R", pt.In(pt.Ref("B", flow="X")), arena="t")
+        d.flow("Y", "R", pt.In(pt.Ref("C", flow="X")), arena="t")
+        d.body(body_of("D"))
+        tp.run()
+        tp.wait()
+        return take_trace(ctx, class_names=["A", "B", "C", "D"])
+
+
+@pytest.mark.parametrize("slow", ["B", "C"])
+def test_diamond_critical_path_exact(slow):
+    tr = _run_diamond(slow=slow)
+    cp = critical_path(tr)
+    assert cp["nodes"] == 4 and cp["edges"] == 4, cp
+    names = [p[0] for p in cp["path"]]
+    assert names == ["A", slow, "D"], cp["path"]
+    # the total is EXACTLY the sum of the path's EXEC durations
+    assert cp["total_ns"] == sum(p[3] for p in cp["path"])
+    # the slow leg dominates per-class attribution
+    per = cp["per_class_ns"]
+    assert per[slow] == max(per.values()), per
+    # coverage: path time over total EXEC time, in (0, 1]
+    assert 0 < cp["coverage"] <= 1
+
+
+def test_diamond_method_alias():
+    tr = _run_diamond()
+    assert tr.critical_path()["path"] == critical_path(tr)["path"]
+
+
+def test_critical_path_needs_edges():
+    """Level-1 traces (no EDGE events) degrade to the longest single
+    EXEC span, not a crash."""
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.profile_enable(1)
+        tp = pt.Taskpool(ctx, globals={"NB": 3})
+        k = pt.L("k")
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("NB"))
+        tc.body(lambda t: None)
+        tp.run()
+        tp.wait()
+        tr = take_trace(ctx, class_names=["T"])
+    cp = critical_path(tr)
+    assert cp["edges"] == 0
+    assert len(cp["path"]) == 1  # no deps captured: best single task
+
+
+def test_cycle_detection():
+    """A corrupted EDGE capture (cycle) raises instead of looping."""
+    ev = []
+    now = 1000
+    # EXEC spans for two fake tasks + a 2-cycle between them
+    for cid in (0, 1):
+        ev.append([KEY_EXEC, 0, cid, 0, 0, 0, 0, now])
+        ev.append([KEY_EXEC, 1, cid, 0, 0, 0, 0, now + 10])
+    ev += [[2, 0, 0, 0, 0, 0, 0, now], [2, 1, 1, 0, 0, 0, 0, now],
+           [2, 0, 1, 0, 0, 0, 0, now], [2, 1, 0, 0, 0, 0, 0, now]]
+    tr = Trace(np.array(ev, dtype=np.int64))
+    with pytest.raises(ValueError, match="cycle"):
+        critical_path(tr)
+
+
+def test_lost_time_breakdown():
+    tr = _run_diamond()
+    lt = lost_time(tr)
+    assert lt["workers"], lt
+    tot = lt["totals"]
+    for bucket in ("compute", "release", "h2d_stall", "comm_wait", "idle"):
+        assert bucket in tot and tot[bucket] >= 0
+    # the diamond computes ~52ms across 4 tasks: compute dominates zero
+    assert tot["compute"] > 0
+    for (rank, worker), b in lt["workers"].items():
+        assert b["window_ns"] >= b["compute"], b
+        # single-process run: no comm starvation to attribute
+        assert b["comm_wait"] == 0, b

@@ -1,0 +1,119 @@
+"""Flight-recorder mode (tracing v2): bounded ring buffers with
+overwrite-oldest + dropped-event counters, manual dumps, and the
+dump-on-abort path that leaves a last-N-seconds .ptt behind when a
+production run dies."""
+import os
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import KEY_EXEC, Trace, take_trace
+
+RING_EVENTS = 64  # 64 events/worker: 64 * 8 words * 8 bytes = 4096 B
+
+
+def _chain(ctx, nb):
+    ctx.register_arena("t", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": nb - 1})
+    k = pt.L("k")
+    tc = tp.task_class("Task")
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref("Task", k - 1, flow="A")),
+            pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                   guard=(k < pt.G("NB"))),
+            arena="t")
+    return tp, tc
+
+
+def test_ring_drops_oldest_keeps_newest(tmp_path):
+    nb = 1000
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.profile_enable(1)
+        assert ctx.profile_ring() == 0  # unbounded by default
+        ctx.profile_ring(RING_EVENTS * 8 * 8)
+        assert ctx.profile_ring() == RING_EVENTS * 8 * 8
+        tp, tc = _chain(ctx, nb)
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        dropped = ctx.profile_dropped()
+        dump = str(tmp_path / "manual.ptt")
+        ctx.flight_dump(dump)  # dump does NOT drain...
+        tr = take_trace(ctx, class_names=["Task"])
+    # 1000 tasks emitted ~2000 events into a 64-event ring: most dropped
+    assert dropped > 0
+    assert len(tr.events) <= RING_EVENTS
+    ex = tr.events[tr.events[:, 0] == KEY_EXEC]
+    # overwrite-OLDEST: the final task of the chain must have survived
+    assert ex[:, 3].max() == nb - 1, ex[:, 3]
+    # drop accounting rides the trace meta (take_trace auto-stamp)
+    assert tr.meta["dropped_events"] == dropped
+    assert tr.meta["ring_bytes"] == RING_EVENTS * 8 * 8
+    # ...so the manual dump holds the same tail, loadable as .ptt v2
+    ft = Trace.load(dump)
+    assert len(ft.events) == len(tr.events)
+    assert ft.meta["flight"] == 1
+    assert ft.meta["dropped_events"] == dropped
+    np.testing.assert_array_equal(ft.events, tr.events)
+
+
+def test_ring_take_then_refill():
+    """Draining a ring resets it: a second burst is captured fresh."""
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.profile_enable(1)
+        ctx.profile_ring(RING_EVENTS * 8 * 8)
+        tp, tc = _chain(ctx, 10)
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        first = ctx.profile_take()
+        assert len(first) > 0
+        assert len(ctx.profile_take()) == 0  # drained
+        tp2, tc2 = _chain(ctx, 10)
+        tc2.body_noop()
+        tp2.run()
+        tp2.wait()
+        assert len(ctx.profile_take()) > 0
+
+
+def test_unbounded_mode_drops_nothing():
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.profile_enable(1)
+        tp, tc = _chain(ctx, 500)
+        tc.body_noop()
+        tp.run()
+        tp.wait()
+        assert ctx.profile_dropped() == 0
+        tr = take_trace(ctx, class_names=["Task"])
+    assert int(np.sum((tr.events[:, 0] == KEY_EXEC)
+                      & (tr.events[:, 1] == 0))) == 500
+
+
+def test_dump_on_abort(tmp_path, monkeypatch):
+    """A failing task body aborts its pool — with the flight recorder
+    armed, the runtime must leave '<prefix>.<rank>.ptt' behind."""
+    prefix = str(tmp_path / "fl")
+    monkeypatch.setenv("PTC_MCA_runtime_trace_ring", "8192")
+    monkeypatch.setenv("PTC_MCA_runtime_trace_dump", prefix)
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.profile_enable(1)
+        tp, tc = _chain(ctx, 20)
+
+        def body(view):
+            if view["k"] == 10:
+                raise RuntimeError("boom")
+
+        tc.body(body)
+        tp.run()
+        with pytest.raises(RuntimeError, match="aborted"):
+            tp.wait()
+    path = f"{prefix}.0.ptt"
+    assert os.path.exists(path), os.listdir(tmp_path)
+    ft = Trace.load(path)
+    assert ft.meta["flight"] == 1
+    # the tail contains the EXEC history leading up to the failure
+    ex = ft.events[(ft.events[:, 0] == KEY_EXEC) & (ft.events[:, 1] == 0)]
+    assert len(ex) > 0

@@ -123,3 +123,43 @@ def test_live_tail_merge_real_streams(tmp_path):
     assert all(sum(merged[r]["workers"]) == 101 for r in (0, 1))
     view = lt.render_merged(merged)
     assert view.splitlines()[-1].startswith("== 2 rank(s) tasks=202")
+
+
+def test_live_sample_device_counters(tmp_path):
+    """Live samples carry the PR3 device-pipeline counters (prefetch
+    hits/misses, stall/overlap) once a device is attached."""
+    import jax
+
+    from parsec_tpu.device import TpuDevice
+
+    path = str(tmp_path / "live_dev_{rank}.jsonl")
+    nb = 8
+    with pt.Context(nb_workers=2) as ctx:
+        mon = LiveMonitor(ctx, path=path, interval=5.0)
+        arr = np.zeros((nb, 4), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=16, nodes=1,
+                                       myrank=0)
+        ctx.register_arena("t", 16)
+        dev = TpuDevice(ctx, jax_device=jax.devices()[0])
+        tp = pt.Taskpool(ctx, globals={"NB": nb - 1})
+        k = pt.L("k")
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW", pt.In(pt.Mem("A", k)),
+                pt.Out(pt.Mem("A", k)), arena="t")
+        dev.attach(tc, tp, kernel=lambda x: x + 1.0, reads=["A"],
+                   writes=["A"], shapes={"A": (4,)})
+        tp.run()
+        tp.wait()
+        dev.flush()
+        mon.stop()  # final snapshot
+        dev.stop()
+        fname = path.format(rank=0)
+    recs = [json.loads(x) for x in open(fname)]
+    last = recs[-1]
+    assert "device" in last, last
+    for key in ("prefetch_hits", "prefetch_misses", "h2d_stall_ns",
+                "prefetch_h2d_ns", "overlap_ratio", "spills"):
+        assert key in last["device"], last["device"]
+    # single-process context: comm/stream sections absent, by design
+    assert "stream" not in last

@@ -161,3 +161,48 @@ def test_pins_hwcounters():
     assert c[1] + c[2] > 0        # cpu time attributed
     rep = hw.report({0: "Busy"})
     assert rep.startswith("Busy: tasks=200")
+
+
+def test_pins_device_activity_module(monkeypatch):
+    """DEVICE/H2D keys reach PINS modules (tracing v2 satellite): the
+    device manager's dispatch/staging events ride the same native sink,
+    so DeviceActivity counts waves + h2d bytes with tracing OFF."""
+    import time
+
+    import jax
+
+    from parsec_tpu.device import TpuDevice
+    from parsec_tpu.profiling import DeviceActivity
+
+    nb = 8
+    with pt.Context(nb_workers=2) as ctx:
+        chain = enable_pins(ctx, "device_activity")
+        arr = np.zeros((nb, 4), dtype=np.float32)
+        ctx.register_linear_collection("A", arr, elem_size=16, nodes=1,
+                                       myrank=0)
+        ctx.register_arena("t", 16)
+        dev = TpuDevice(ctx, jax_device=jax.devices()[0], autostart=False)
+        tp = pt.Taskpool(ctx, globals={"NB": nb - 1})
+        k = pt.L("k")
+        tc = tp.task_class("T")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW", pt.In(pt.Mem("A", k)),
+                pt.Out(pt.Mem("A", k)), arena="t")
+        dev.attach(tc, tp, kernel=lambda x: x + 1.0, reads=["A"],
+                   writes=["A"], shapes={"A": (4,)})
+        tp.run()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ctx.device_queue_depth(dev.qid) == nb:
+                break
+            time.sleep(0.005)
+        dev.start()
+        tp.wait()
+        dev.flush()
+        dev.stop()
+        assert ctx.profile_take().shape[0] == 0  # tracing stayed off
+    mod = chain["device_activity"]
+    assert isinstance(mod, DeviceActivity)
+    assert mod.waves >= 1
+    assert mod.lanes == nb  # every task dispatched through the device
+    assert sum(mod.h2d_bytes) > 0  # stage-in bytes observed by lane

@@ -1,0 +1,50 @@
+"""Smoke for tools/ptt_critpath.py: a real level-2 trace in, a report
+(stdout + JSON) out."""
+import json
+import os
+import subprocess
+import sys
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import take_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _make_trace(path, nb=8):
+    with pt.Context(nb_workers=2) as ctx:
+        ctx.profile_enable(2)
+        ctx.register_arena("t", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                arena="t")
+        tc.body(lambda t: None)
+        tp.run()
+        tp.wait()
+        take_trace(ctx, class_names=["Task"]).save(path)
+
+
+def test_ptt_critpath_tool(tmp_path):
+    trace = str(tmp_path / "r0.ptt")
+    out = str(tmp_path / "report.json")
+    _make_trace(trace)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ptt_critpath.py"),
+         trace, "--json", out],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "critical path:" in proc.stdout
+    assert "lost time per (rank, worker):" in proc.stdout
+    rep = json.loads(open(out).read())
+    # a 9-task chain IS its own critical path
+    assert len(rep["critical_path"]["path"]) == 9
+    assert rep["critical_path"]["coverage"] == 1.0
+    assert "lost_time_totals" in rep

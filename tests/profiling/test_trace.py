@@ -77,6 +77,111 @@ def test_trace_save_load_merge(tmp_path):
     assert len(df[(df["rank"] == 1) & (df["key"] == KEY_EXEC)]) == 4
 
 
+def test_merge_dictionary_conflict_detected():
+    """Tracing v2: merge unions dictionaries/class_names across ranks
+    and REFUSES conflicting registrations instead of silently taking
+    traces[0]'s (dynamic keys registered on one rank used to mislabel
+    merged events)."""
+    a = _run_chain(3)
+    b = _run_chain(3)
+    b.rank = 1
+    b.ranks[:] = 1
+    b.dict.add(40, "RANK1_ONLY", "#123456")
+    m = Trace.merge([a, b])
+    assert m.dict.name(40) == "RANK1_ONLY"  # union adopts it
+    b.dict.add(KEY_EXEC, "NOT_EXEC")  # same key, different name
+    with pytest.raises(ValueError, match="dictionary conflict"):
+        Trace.merge([a, b])
+
+
+def test_merge_class_names_conflict_detected():
+    a = _run_chain(3)
+    b = _run_chain(3)
+    b.rank = 1
+    b.ranks[:] = 1
+    b.class_names = ["Task", "Extra"]  # superset: fine, adopted
+    m = Trace.merge([a, b])
+    assert m.class_names == ["Task", "Extra"]
+    b.class_names = ["Other"]
+    with pytest.raises(ValueError, match="class_names conflict"):
+        Trace.merge([a, b])
+
+
+def test_merge_applies_clock_offsets():
+    """meta['clock_offset_ns'] (the PING/PONG estimate) shifts that
+    rank's timestamps onto rank 0's clock at merge."""
+    a = _run_chain(3)
+    b = _run_chain(3)
+    b.rank = 1
+    b.ranks[:] = 1
+    b.meta["clock_offset_ns"] = 1_000_000
+    t_before = b.events[:, 7].copy()
+    m = Trace.merge([a, b], causal=False)
+    shifted = m.events[m.ranks == 1][:, 7]
+    np.testing.assert_array_equal(shifted, t_before + 1_000_000)
+    assert m.meta["clock_offsets_ns"][1] == 1_000_000
+    # opt-out reproduces plain concatenation
+    m2 = Trace.merge([a, b], apply_offsets=False, causal=False)
+    np.testing.assert_array_equal(m2.events[m2.ranks == 1][:, 7], t_before)
+
+
+def test_spans_nested_same_signature_fallback():
+    """The vectorized pairing must reproduce the LIFO stack for nested
+    spans of one signature (the numpy fast path bails to the stack for
+    exactly those groups)."""
+    E = KEY_EXEC
+    ev = np.array([
+        [E, 0, 7, 1, 2, 0, 0, 100],   # begin outer
+        [E, 0, 7, 1, 2, 0, 0, 110],   # begin inner
+        [E, 1, 7, 1, 2, 0, 0, 120],   # end inner  (pairs 110)
+        [E, 1, 7, 1, 2, 0, 0, 130],   # end outer  (pairs 100)
+        [E, 0, 9, 0, 0, 0, 5, 200],   # plain span, other signature
+        [E, 1, 9, 0, 0, 0, 9, 210],
+    ], dtype=np.int64)
+    tr = Trace(ev)
+    got = sorted(tr.spans(), key=lambda s: s[7])
+    assert [(s[7], s[8]) for s in got] == [(100, 130), (110, 120),
+                                           (200, 210)]
+    assert got[2][6] == 9  # aux = max(begin, end)
+
+
+def test_spans_matches_reference_pairing():
+    """Vectorized spans() == the historical per-event stack loop on a
+    real trace (order included)."""
+    tr = _run_chain(20)
+
+    def reference(trace):
+        open_spans = {}
+        for i in range(len(trace.events)):
+            key, phase, cid, l0, l1, worker, aux, t = (
+                int(x) for x in trace.events[i])
+            if key == KEY_EDGE:
+                continue
+            sig = (int(trace.ranks[i]), worker, key, cid, l0, l1)
+            if phase == 0:
+                open_spans.setdefault(sig, []).append((aux, t))
+            else:
+                st = open_spans.get(sig)
+                if st:
+                    aux0, t0 = st.pop()
+                    yield (sig[0], worker, key, cid, l0, l1,
+                           max(aux, aux0), t0, t)
+
+    assert list(tr.spans()) == list(reference(tr))
+
+
+def test_trace_v2_roundtrip_meta(tmp_path):
+    tr = _run_chain(4)
+    tr.meta["clock_offset_ns"] = 42
+    tr.meta["clock_err_ns"] = 7
+    p = str(tmp_path / "v2.ptt")
+    tr.save(p)
+    lt = Trace.load(p)
+    assert lt.meta["clock_offset_ns"] == 42
+    assert lt.meta["clock_err_ns"] == 7
+    np.testing.assert_array_equal(lt.events, tr.events)
+
+
 def test_device_dispatch_spans(monkeypatch):
     """Device-executed DAGs are visible in traces: the manager emits
     DEVICE_DISPATCH spans (key 5, l0 = lanes) through the same native

@@ -218,6 +218,14 @@ def main():
                             "PTC_MCA_comm_chunk_size": "2048",
                             "PTC_MCA_comm_inflight": "3",
                             "PTC_MCA_comm_rails": "2"})
+        # tracing v2 under load: level-2 tracing + flight-recorder RING
+        # on a 2-rank job — worker pushes racing the ring's wraparound,
+        # comm-thread COMM instants + clock-sync PONG handling on buffer
+        # 0, PINS-off trace path (the observability PR's new code under
+        # TSan's happens-before analysis)
+        colocated_comm(workers=4, nb=48, port=29980 + rep,
+                       env={"PTC_MCA_runtime_profile": "1",
+                            "PTC_MCA_runtime_trace_ring": "16384"})
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")
 
