@@ -57,6 +57,15 @@ bench-device: $(LIB)
 bench-stream: $(LIB)
 	python bench.py --stream --json BENCH_stream.json
 
+# Runtime-native collective suite (bench.py --collective --json):
+# DAG-dependency chain reduction vs runtime-native streamed collective
+# across message sizes on a 2-rank pair, the whole-array XLA shard_map
+# psum baseline, and the level-2 trace evidence (comm_wait+coll_wait
+# lost time, compute/wire overlap fraction) for the largest size.
+# Loopback, CPU jax backend — no TPU needed.
+bench-collective: $(LIB)
+	python bench.py --collective --json BENCH_collective.json
+
 # Tracing-overhead ladder (bench.py --trace --json): per-task cost at
 # trace levels 0/1/2 and the flight-recorder ring vs unbounded buffers
 # at level 1 (the PR2 one-transaction-per-task contract), with host
@@ -65,4 +74,4 @@ bench-trace: $(LIB)
 	python bench.py --trace --json BENCH_trace.json
 
 .PHONY: all clean tsan bench-comm bench-dispatch bench-device \
-	bench-stream bench-trace
+	bench-stream bench-collective bench-trace

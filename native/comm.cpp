@@ -629,6 +629,13 @@ static ptc_taskpool *find_tp(ptc_context *ctx, int32_t tp_id) {
   return it == ctx->tp_registry.end() ? nullptr : it->second;
 }
 
+/* does class `cid` of `tp` belong to the ptc_coll_* collective family?
+ * (frames to/from those classes feed the ptc_coll_stats counters) */
+static bool coll_class(ptc_taskpool *tp, int32_t cid) {
+  return tp && cid >= 0 && (size_t)cid < tp->classes.size() &&
+         tp->classes[(size_t)cid].is_coll;
+}
+
 struct WireTarget {
   int32_t class_id;
   std::vector<int64_t> params;
@@ -781,6 +788,19 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                    targets.empty() ? -1 : (int64_t)targets[0].class_id,
                    src_rank == UINT32_MAX ? -1 : (int64_t)src_rank,
                    (int64_t)corr, (int64_t)plen);
+  /* collective-step delivery (ptc_coll_* consumer): a second instant
+   * under its own key, so the lost-time analysis can split coll_wait
+   * out of comm_wait without guessing from class ids */
+  if (!targets.empty() && targets[0].class_id >= 0 &&
+      (size_t)targets[0].class_id < tp->classes.size() &&
+      tp->classes[(size_t)targets[0].class_id].is_coll) {
+    ctx->coll_recv_msgs.fetch_add(1, std::memory_order_relaxed);
+    ctx->coll_recv_bytes.fetch_add((int64_t)plen,
+                                   std::memory_order_relaxed);
+    ptc_prof_instant(ctx, PROF_KEY_COLL, (int64_t)targets[0].class_id,
+                     src_rank == UINT32_MAX ? -1 : (int64_t)src_rank,
+                     (int64_t)corr, (int64_t)plen);
+  }
   ptc_copy *copy = nullptr;
   /* ptc_has_dtypes: zero-registered-datatype workloads skip the
    * per-target selection below (it evaluates guards — possibly Python
@@ -818,9 +838,9 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
             (size_t)flow_idx < tp->classes[(size_t)cid].flows.size()) {
           int32_t aid =
               tp->classes[(size_t)cid].flows[(size_t)flow_idx].arena_id;
-          if (aid >= 0 && (size_t)aid < ctx->arenas.size() &&
-              ctx->arenas[(size_t)aid]->elem_size > min_alloc)
-            min_alloc = ctx->arenas[(size_t)aid]->elem_size;
+          if (aid >= 0 && aid < ctx->arenas_n() &&
+              ctx->arena_at(aid)->elem_size > min_alloc)
+            min_alloc = ctx->arena_at(aid)->elem_size;
         }
       }
       /* one materialized copy per distinct receive layout */
@@ -1269,6 +1289,11 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
     ptc_prof_instant(ce->ctx, PROF_KEY_COMM_SEND, groups[i].first_class,
                      (int64_t)groups[i].rank, (int64_t)corr,
                      (int64_t)plen);
+    if (coll_class(find_tp(ce->ctx, tp_id), groups[i].first_class)) {
+      ce->ctx->coll_send_msgs.fetch_add(1, std::memory_order_relaxed);
+      ce->ctx->coll_send_bytes.fetch_add((int64_t)plen,
+                                         std::memory_order_relaxed);
+    }
     comm_post(ce, groups[i].rank, std::move(f));
     i += take;
   }
@@ -2843,6 +2868,10 @@ void ptc_comm_send_activate_batch(
   ptc_prof_instant(ctx, PROF_KEY_COMM_SEND,
                    targets.empty() ? -1 : (int64_t)targets[0].first,
                    (int64_t)rank, (int64_t)corr, payload_size);
+  if (!targets.empty() && coll_class(tp, targets[0].first)) {
+    ctx->coll_send_msgs.fetch_add(1, std::memory_order_relaxed);
+    ctx->coll_send_bytes.fetch_add(payload_size, std::memory_order_relaxed);
+  }
   comm_post(ce, rank, std::move(f));
 }
 

@@ -195,7 +195,12 @@ Arena::~Arena() {
 
 ptc_context::~ptc_context() {
   for (auto *c : collections) delete c;
-  for (auto *a : arenas) delete a;
+  {
+    Arena **t = arena_tab.load(std::memory_order_relaxed);
+    int32_t n = arena_count.load(std::memory_order_relaxed);
+    for (int32_t i = 0; i < n; i++) delete t[i];
+    for (Arena **tt : arena_tables) delete[] tt;
+  }
   for (auto *q : dev_queues) delete q;
   for (auto *p : prof) delete p;
   for (auto *c : worker_executed) delete c;
@@ -543,7 +548,7 @@ void ptc_copy_release_internal(ptc_context *ctx, ptc_copy *c) {
       delete rc;
     }
     if (c->arena_id >= 0 && c->ptr)
-      ctx->arenas[(size_t)c->arena_id]->dealloc(mag_slot(ctx), c->ptr);
+      ctx->arena_at(c->arena_id)->dealloc(mag_slot(ctx), c->ptr);
     else if (c->owns_ptr && c->ptr)
       std::free(c->ptr);
     delete c;
@@ -1672,7 +1677,7 @@ static int prepare_input(ptc_context *ctx, ptc_task *t) {
     } else if (!sel || sel->kind == DEP_NONE) {
       /* pure WRITE flow: allocate from its arena */
       if ((fl.flags & PTC_FLOW_WRITE) && fl.arena_id >= 0) {
-        Arena *a = ctx->arenas[(size_t)fl.arena_id];
+        Arena *a = ctx->arena_at(fl.arena_id);
         ptc_copy *c = new ptc_copy();
         c->ptr = a->alloc(mag_slot(ctx));
         c->size = a->elem_size;
@@ -2209,6 +2214,8 @@ static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   }
   ptc_taskpool *tp = t->tp;
   const TaskClass &tc = tp->classes[(size_t)t->class_id];
+  if (tc.is_coll)
+    ctx->coll_steps.fetch_add(1, std::memory_order_relaxed);
   /* RELEASE spans are level-2 trace events: level 1 (the dispatch
    * bench's lean setting) pays two locked pushes per task, not four.
    * PINS sinks still see them at any level (mask-gated). */
@@ -2943,6 +2950,18 @@ void ptc_prof_event(ptc_context_t *ctx, int64_t key, int64_t phase,
   ptc_prof_push(ctx, -1, key, phase, class_id, l0, l1, aux);
 }
 
+/* runtime-native collective counters (the ptc_coll_* task-class family):
+ * out6 = [steps executed, coll frames sent, bytes sent, coll frames
+ * received, bytes received, reserved] */
+void ptc_coll_stats(ptc_context_t *ctx, int64_t *out6) {
+  out6[0] = ctx->coll_steps.load(std::memory_order_relaxed);
+  out6[1] = ctx->coll_send_msgs.load(std::memory_order_relaxed);
+  out6[2] = ctx->coll_send_bytes.load(std::memory_order_relaxed);
+  out6[3] = ctx->coll_recv_msgs.load(std::memory_order_relaxed);
+  out6[4] = ctx->coll_recv_bytes.load(std::memory_order_relaxed);
+  out6[5] = 0;
+}
+
 /* per-worker steal counters (selects served from a victim's queue);
  * 0 for global-queue schedulers.  (Reference observability role:
  * mca/pins/print_steals.) */
@@ -2986,9 +3005,11 @@ int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   }
   {
     std::lock_guard<std::mutex> g(ctx->reg_lock);
-    for (Arena *a : ctx->arenas) {
-      v[4] += a->stat_hits();
-      v[5] += a->stat_misses();
+    Arena **t = ctx->arena_tab.load(std::memory_order_relaxed);
+    int32_t n = ctx->arena_count.load(std::memory_order_relaxed);
+    for (int32_t i = 0; i < n; i++) {
+      v[4] += t[i]->stat_hits();
+      v[5] += t[i]->stat_misses();
     }
   }
   v[6] = ctx->insert_batches.load(std::memory_order_relaxed);
@@ -3168,8 +3189,22 @@ int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size) {
   Arena *a = new Arena();
   a->elem_size = elem_size;
   a->init_mags(ctx->nb_workers);
-  ctx->arenas.push_back(a);
-  return (int32_t)ctx->arenas.size() - 1;
+  int32_t n = ctx->arena_count.load(std::memory_order_relaxed);
+  if (n == ctx->arena_cap) {
+    /* grow by table replacement: copy into a fresh table and retire
+     * the old one until teardown — concurrent lock-free readers keep
+     * indexing whichever table they loaded */
+    int32_t nc = ctx->arena_cap ? ctx->arena_cap * 2 : 16;
+    Arena **nt = new Arena *[nc];
+    Arena **ot = ctx->arena_tab.load(std::memory_order_relaxed);
+    for (int32_t i = 0; i < n; i++) nt[i] = ot[i];
+    ctx->arena_tables.push_back(nt);
+    ctx->arena_tab.store(nt, std::memory_order_release);
+    ctx->arena_cap = nc;
+  }
+  ctx->arena_tab.load(std::memory_order_relaxed)[n] = a;
+  ctx->arena_count.store(n + 1, std::memory_order_release);
+  return n;
 }
 
 int32_t ptc_register_datatype(ptc_context_t *ctx, int64_t elem_bytes,
@@ -3290,6 +3325,11 @@ int32_t ptc_tp_add_class(ptc_taskpool_t *tp, const char *name,
   TaskClass tc;
   tc.name = name ? name : "";
   tc.id = (int32_t)tp->classes.size();
+  /* the ptc_coll_* family (runtime-native collective steps) is detected
+   * by name so the comm/trace layers can attribute its traffic without
+   * a second registration call — the prefix IS the contract
+   * (parsec_tpu/comm/coll.py names every class it builds this way) */
+  tc.is_coll = tc.name.compare(0, 8, "ptc_coll") == 0;
   if (!decode_class(tc, spec, spec_len)) return -1;
   tp->classes.push_back(std::move(tc));
   return (int32_t)tp->classes.size() - 1;

@@ -357,6 +357,10 @@ int64_t ptc_worker_steals(ptc_context_t *ctx, int64_t *out, int64_t cap);
  * profiling and PINS are off */
 void ptc_prof_event(ptc_context_t *ctx, int64_t key, int64_t phase,
                     int64_t class_id, int64_t l0, int64_t l1, int64_t aux);
+/* runtime-native collective counters (the ptc_coll_* task-class family,
+ * parsec_tpu/comm/coll.py): out6 = [steps executed, frames sent, bytes
+ * sent, frames received, bytes received, reserved] */
+void ptc_coll_stats(ptc_context_t *ctx, int64_t *out6);
 /* returns number of int64 words written into out (5 per event), up to cap */
 int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap);
 /* current trace level (0 off, 1 spans, 2 +edges) */

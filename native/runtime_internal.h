@@ -315,12 +315,17 @@ struct TaskClass {
   /* any non-range (derived) local exists — fill_derived_locals runs 3x
    * per task on the dispatch path; derived-free classes skip the walk */
   bool has_derived = false;
+  /* runtime-native collective step (class name starts with "ptc_coll"):
+   * completions and cross-rank deliveries feed the ptc_coll_stats
+   * counters and PROF_KEY_COLL trace spans */
+  bool is_coll = false;
   TaskClass() = default;
   TaskClass(const TaskClass &o)
       : name(o.name), id(o.id), locals(o.locals),
         range_locals(o.range_locals), aff_dc(o.aff_dc), aff_idx(o.aff_idx),
         priority(o.priority), flows(o.flows), chores(o.chores),
-        has_in_ltype(o.has_in_ltype), has_derived(o.has_derived) {}
+        has_in_ltype(o.has_in_ltype), has_derived(o.has_derived),
+        is_coll(o.is_coll) {}
 };
 
 /* ------------------------------------------------------------------ */
@@ -656,6 +661,14 @@ enum {
                              l0 = lanes in the batched call)            */
   PROF_KEY_COMM_RECV = 4, /* per-target activation delivery: instant
                            * span, aux = payload bytes                */
+  /* 6 (DEVICE_H2D) and 7 (STREAM_D2H) are emitted by the Python device
+   * layer through ptc_prof_event — keep this enum in sync with
+   * profiling/trace.py when extending */
+  PROF_KEY_COLL = 8,      /* collective-step traffic on a ptc_coll_*
+                           * task class: instant span at delivery
+                           * (l0 = src rank, l1 = corr, aux = bytes) —
+                           * the evidence behind the coll_wait lost-time
+                           * bucket (profiling/critpath.py)            */
 };
 enum { PROF_WORDS = 8 };
 
@@ -735,7 +748,25 @@ struct ptc_context {
   std::vector<ExprCb> expr_cbs;
   std::vector<BodyCb> body_cbs;
   std::vector<Collection *> collections;
-  std::vector<Arena *> arenas;
+  /* arena registry: lock-free reads on the copy-release / comm sizing
+   * hot paths while registration stays OPEN for the context's life
+   * (runtime-native collectives register one arena per op with comm
+   * traffic still draining — a plain vector's push_back realloc would
+   * move the data under a concurrent reader).  Writers (reg_lock held)
+   * publish slot-then-count; growth installs a fresh table and retires
+   * the old one until teardown, so a reader holding a stale table
+   * pointer still indexes valid memory. */
+  std::atomic<Arena **> arena_tab{nullptr};
+  std::atomic<int32_t> arena_count{0};
+  int32_t arena_cap = 0;              /* writer-side, under reg_lock */
+  std::vector<Arena **> arena_tables; /* every table ever published */
+
+  Arena *arena_at(int32_t id) {
+    return arena_tab.load(std::memory_order_acquire)[(size_t)id];
+  }
+  int32_t arenas_n() const {
+    return arena_count.load(std::memory_order_acquire);
+  }
   std::vector<DtypeDef> dtypes; /* wire datatypes — ALWAYS read via
                                  * ptc_dtype_get (reg_lock-guarded) */
   std::atomic<bool> has_dtypes{false};
@@ -760,6 +791,14 @@ struct ptc_context {
   /* activation-broadcast topology: 0 star (direct sends), 1 chain,
    * 2 binomial (reference: runtime_comm_coll_bcast, remote_dep.c:39-47) */
   std::atomic<int32_t> comm_topo{0};
+
+  /* runtime-native collective counters (ptc_coll_stats): steps = executed
+   * ptc_coll_* task bodies; send/recv = cross-rank activation frames
+   * whose (first) target is a ptc_coll_* class, with their payload bytes.
+   * The Python coll layer adds op-level counters on top. */
+  std::atomic<int64_t> coll_steps{0};
+  std::atomic<int64_t> coll_send_msgs{0}, coll_send_bytes{0};
+  std::atomic<int64_t> coll_recv_msgs{0}, coll_recv_bytes{0};
 
   /* active taskpools */
   std::atomic<int64_t> active_tps{0};

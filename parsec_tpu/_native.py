@@ -264,6 +264,7 @@ _sigs = {
     "ptc_worker_steals": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
     "ptc_prof_event": (None, [C.c_void_p, C.c_int64, C.c_int64, C.c_int64,
                               C.c_int64, C.c_int64, C.c_int64]),
+    "ptc_coll_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_context_get_scheduler": (C.c_char_p, [C.c_void_p]),
     "ptc_comm_init": (C.c_int32, [C.c_void_p, C.c_int32]),
     "ptc_comm_fence": (C.c_int32, [C.c_void_p]),

@@ -130,3 +130,135 @@ def run_gemm(ctx, A, B, C, dev=None) -> None:
     tp.wait()
     if dev is not None:
         dev.flush()
+
+
+def gemm_panel_reduce(ctx: pt.Context, a_slab: np.ndarray,
+                      b_slab: np.ndarray, reduce: str = "coll",
+                      topo=None, panel_rows: int = 0) -> np.ndarray:
+    """k-split GEMM with a cross-rank panel reduction:
+    C = sum_r a_slab_r @ b_slab_r, rank r holding k-slab r.  Returns the
+    full C on every rank (all-reduce shape).
+
+    reduce="coll" (ISSUE 6 tentpole): C is split into row panels and
+    each Partial(r, p) feeds the runtime-native ptc_coll reduction the
+    moment it completes — panel p's reduction (and its wire traffic)
+    overlaps panel p+1's compute, so the collective starts after the
+    FIRST panel, not the last (T3, arXiv:2401.16677).  Topology per the
+    transfer-economics selector (PTC_MCA_coll_topo override).
+
+    reduce="chain": the DAG-dependency baseline — each rank computes its
+    WHOLE partial, a serial rank chain sums them, the result fans out —
+    exactly how reductions were expressed before runtime-native
+    collectives existed.  Bit-identical to "coll" on integer-valued
+    inputs (both sum in rank order along their chains)."""
+    from ..comm.coll import RefReduce, rank_affinity_collection
+
+    M, _ = a_slab.shape
+    Nc = b_slab.shape[1]
+    R = max(1, ctx.nodes)
+    if R == 1 or not ctx.comm_enabled:
+        return (a_slab @ b_slab).astype(np.float32)
+    if panel_rows <= 0:
+        from ..utils import params as _mca
+        q = _mca.get("coll.slice") or _mca.get("comm.chunk_size")
+        panel_rows = max(1, min(M, int(q) // max(1, Nc * 4)))
+    c_out = np.zeros((M, Nc), dtype=np.float32)
+    rankc = rank_affinity_collection(ctx)
+    r_, p_, t_, q_ = pt.L("r"), pt.L("p"), pt.L("t"), pt.L("q")
+
+    if reduce == "coll":
+        P = (M + panel_rows - 1) // panel_rows
+        panel_bytes = panel_rows * Nc * 4
+        tp = pt.Taskpool(ctx)
+        part = tp.task_class("GemmPartial")
+        part.param("r", 0, R - 1)
+        part.param("p", 0, P - 1)
+        part.affinity(rankc, r_)
+        rr = RefReduce(
+            ctx, tp, nseg=P,
+            contributors_of=lambda p: [(r, (p, r)) for r in range(R)],
+            root_of=lambda p: p % R,
+            prod_class="GemmPartial", prod_flow="P", prod_nparams=2,
+            prod_params_of=lambda cid: (cid[1], cid[0]),
+            arena_bytes=panel_bytes, dtype=np.float32, topo=topo,
+            bcast=True,
+            fanout_sink=lambda seg, sl, arr: _store_panel(
+                c_out, seg, panel_rows, arr))
+        part.flow("P", "W",
+                  *rr.producer_out_deps(lambda l, g: (l[1], l[0])),
+                  arena=f"__ptc_coll_{rr.uid}")
+
+        def part_body(view):
+            p = view["p"]
+            rows = slice(p * panel_rows, min(M, (p + 1) * panel_rows))
+            out = (a_slab[rows] @ b_slab).astype(np.float32).ravel()
+            view.data("P", dtype=np.float32)[:out.size] = out
+
+        part.body(part_body)
+        tp.run()
+        tp.wait()
+        return c_out
+
+    if reduce != "chain":
+        raise ValueError(f"gemm_panel_reduce: unknown reduce={reduce!r}")
+    # DAG-dependency baseline: whole-matrix partials, serial rank chain
+    from ..comm.coll import _next_uid
+    full_bytes = M * Nc * 4
+    arena = f"__gemm_chain_{_next_uid(ctx)}"
+    ctx.register_arena(arena, full_bytes)
+    tp = pt.Taskpool(ctx)
+    whole = tp.task_class("GemmWhole")
+    whole.param("r", 0, R - 1)
+    whole.affinity(rankc, r_)
+    whole.flow("W", "W", pt.Out(pt.Ref("GemmChain", r_, flow="B")),
+               arena=arena)
+
+    def whole_body(view):
+        out = (a_slab @ b_slab).astype(np.float32).ravel()
+        view.data("W", dtype=np.float32)[:out.size] = out
+
+    whole.body(whole_body)
+    chain = tp.task_class("GemmChain")
+    chain.param("t", 0, R - 1)
+    chain.affinity(rankc, t_)
+    chain.flow("B", "READ", pt.In(pt.Ref("GemmWhole", t_, flow="W")),
+               arena=arena)
+    chain.flow("A", "READ", pt.In(pt.Ref("GemmChain", t_ - 1, flow="R")),
+               arena=arena)
+    chain.flow("R", "W",
+               pt.Out(pt.Ref("GemmChain", t_ + 1, flow="A"),
+                      guard=(t_ < R - 1)),
+               pt.Out(pt.Ref("GemmFan", pt.Range(0, R - 1), flow="X"),
+                      guard=(t_ == R - 1)),
+               arena=arena)
+
+    def chain_body(view):
+        b = view.data("B", dtype=np.float32)
+        r = view.data("R", dtype=np.float32)
+        if view.data_ptr("A"):
+            r[:] = view.data("A", dtype=np.float32) + b
+        else:
+            r[:b.size] = b
+
+    chain.body(chain_body)
+    fan = tp.task_class("GemmFan")
+    fan.param("q", 0, R - 1)
+    fan.affinity(rankc, q_)
+    fan.flow("X", "READ", pt.In(pt.Ref("GemmChain", R - 1, flow="R")),
+             arena=arena)
+
+    def fan_body(view):
+        x = view.data("X", dtype=np.float32)
+        c_out[...] = x[:M * Nc].reshape(M, Nc)
+
+    fan.body(fan_body)
+    tp.run()
+    tp.wait()
+    return c_out
+
+
+def _store_panel(c_out, seg, panel_rows, arr):
+    M, Nc = c_out.shape
+    rows = slice(seg * panel_rows, min(M, (seg + 1) * panel_rows))
+    n = (rows.stop - rows.start) * Nc
+    c_out[rows] = arr[:n].reshape(-1, Nc)

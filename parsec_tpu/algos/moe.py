@@ -102,10 +102,22 @@ def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
               capacity: Optional[int] = None,
               activation: Callable = _relu,
               activation_jax: Optional[Callable] = None,
-              dev=None) -> pt.Taskpool:
+              dev=None, combine: str = "chain",
+              coll_topo: Optional[str] = None) -> pt.Taskpool:
     """`activation` runs in the CPU bodies (numpy); when `dev` is given
     the EXP FFN offloads to the device and needs a jax-traceable
-    `activation_jax` (defaulted for the stock relu)."""
+    `activation_jax` (defaulted for the stock relu).
+
+    combine="chain" (default): the expert-combine leg is the original
+    sequential ACC chain over e on the shard-owner rank — every expert's
+    full dispatch tile crosses to the owner and the adds serialize.
+    combine="coll" (ISSUE 6): each expert rank first folds ITS experts'
+    contributions into one Y-shaped partial locally (CMB, zero wire
+    traffic), then the per-rank partials ride a runtime-native ptc_coll
+    reduction (topology per the transfer-economics selector) to the
+    shard owner, which adds the result into Y — E tiles on the wire
+    become min(E, nodes) partials, and the reduction starts as soon as
+    the FIRST expert finishes instead of waiting for the chain head."""
     S, T, d = Xc.mt, Xc.mb, Xc.nb
     f = WUc.nb
     C = capacity if capacity is not None else T
@@ -145,22 +157,73 @@ def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
     exp.param("e", 0, Eg)
     exp.param("s", 0, Sg)
     exp.affinity("WU", e, 0)  # expert-owner computes: the all-to-all
+    cmb_cls = "ACC" if combine == "chain" else "CMB"
     exp.flow("D", "RW", pt.In(pt.Ref("DISP", s, e, flow="D")),
-             pt.Out(pt.Ref("ACC", s, e, flow="C")), arena="moe_d")
+             pt.Out(pt.Ref(cmb_cls, s, e, flow="C")), arena="moe_d")
     exp.flow("WU", "READ", pt.In(pt.Mem("WU", e, 0)))
     exp.flow("WD", "READ", pt.In(pt.Mem("WD", e, 0)))
 
-    acc = tp.task_class("ACC")
-    acc.param("s", 0, Sg)
-    acc.param("e", 0, Eg)
-    acc.affinity("X", s, 0)
-    acc.flow("A", "RW",
-             pt.In(pt.Mem("Y", s, 0), guard=(e == 0)),
-             pt.In(pt.Ref("ACC", s, e - 1, flow="A")),
-             pt.Out(pt.Ref("ACC", s, e + 1, flow="A"), guard=(e < Eg)),
-             pt.Out(pt.Mem("Y", s, 0), guard=(e == Eg)), arena="moe_y")
-    acc.flow("C", "READ", pt.In(pt.Ref("EXP", e, s, flow="D")),
-             arena="moe_d")
+    if combine == "chain":
+        acc = tp.task_class("ACC")
+        acc.param("s", 0, Sg)
+        acc.param("e", 0, Eg)
+        acc.affinity("X", s, 0)
+        acc.flow("A", "RW",
+                 pt.In(pt.Mem("Y", s, 0), guard=(e == 0)),
+                 pt.In(pt.Ref("ACC", s, e - 1, flow="A")),
+                 pt.Out(pt.Ref("ACC", s, e + 1, flow="A"), guard=(e < Eg)),
+                 pt.Out(pt.Mem("Y", s, 0), guard=(e == Eg)), arena="moe_y")
+        acc.flow("C", "READ", pt.In(pt.Ref("EXP", e, s, flow="D")),
+                 arena="moe_d")
+    elif combine == "coll":
+        from ..comm.coll import RefReduce
+
+        # CMB(s, e): on the EXPERT rank, fold expert e's dispatch tile
+        # into a Y-shaped partial (the elementwise-reducible form)
+        cmb = tp.task_class("CMB")
+        cmb.param("s", 0, Sg)
+        cmb.param("e", 0, Eg)
+        cmb.affinity("WU", e, 0)
+        cmb.flow("C", "READ", pt.In(pt.Ref("EXP", e, s, flow="D")),
+                 arena="moe_d")
+        rr = RefReduce(
+            ctx, tp, nseg=S,
+            contributors_of=lambda ss: [(WUc.rank_of(ee, 0), (ss, ee))
+                                        for ee in range(E)],
+            root_of=lambda ss: Xc.rank_of(ss, 0),
+            prod_class="CMB", prod_flow="P", prod_nparams=2,
+            prod_params_of=lambda cid: cid,
+            arena_bytes=T * d * 4, dtype=np.float32, topo=coll_topo)
+        cmb.flow("P", "W",
+                 *rr.producer_out_deps(lambda l, g: (l[0], l[1])),
+                 arena="moe_y")
+
+        def b_cmb(view):
+            c = view.data("C", np.float32, (C, d + 2))
+            p = view.data("P", np.float32)[:T * d].reshape(T, d)
+            p[...] = 0.0
+            for row in range(C):
+                pr = c[row, d + 1]
+                if pr != 0.0:
+                    p[int(c[row, d])] += pr * c[row, :d]
+
+        cmb.body(b_cmb)
+        # STORE(s): on the shard owner, add the reduced combine into Y
+        store = tp.task_class("STORE")
+        store.param("s", 0, Sg)
+        store.affinity("X", s, 0)
+        store.flow("C", "READ", rr.final_in_dep(0), arena="moe_y")
+        store.flow("A", "RW", pt.In(pt.Mem("Y", s, 0)),
+                   pt.Out(pt.Mem("Y", s, 0)), arena="moe_y")
+        rr.wire_final_consumer(tp, "STORE", "C", lambda seg: (seg,))
+
+        def b_store(view):
+            a = view.data("A", np.float32, (T, d))
+            a += view.data("C", np.float32)[:T * d].reshape(T, d)
+
+        store.body(b_store)
+    else:
+        raise ValueError(f"build_moe: unknown combine={combine!r}")
 
     def b_gate(view):
         x = view.data("X", np.float32, (T, d))
@@ -225,7 +288,8 @@ def build_moe(ctx: pt.Context, Xc, Yc, WGc, WUc, WDc, E: int, k: int = 2,
     gate.body(b_gate)
     disp.body(b_disp)
     exp.body(b_exp)
-    acc.body(b_acc)
+    if combine == "chain":
+        acc.body(b_acc)
     return tp
 
 

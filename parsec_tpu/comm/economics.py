@@ -1,0 +1,198 @@
+"""Transfer-economics model + collective topology selector.
+
+The transfer-economics harness (tools/testbandwidth.py) sweeps the
+eager / rendezvous / device transfer paths on loopback and fits, per
+path,  t(size) = fixed_overhead + size * per_byte  over the per-size
+minima (BENCH_comm.json).  This module is the REUSABLE side of that
+harness: the least-squares fit itself (`fit_points`, imported by the
+harness so the model can never diverge from its producer), a loader
+over the JSON report (`TransferEconomics`), and the collective topology
+selector that consumes the fitted (alpha, beta) legs — the classic
+LogP-style choice (reference: PaRSEC's remote_dep bcast trees,
+parsec/remote_dep.c:39-47, pick chain vs binomial by size; the TPU
+distributed-linear-algebra work, arXiv:2112.09017, shows topology-
+matched collective shapes dominate at pod scale):
+
+  star      1 round, root serializes (R-1) messages — minimal latency
+            terms, worst bandwidth term
+  binomial  ceil(log2 R) rounds of full-size messages — log-depth
+            latency, log bandwidth factor
+  ring      R-1 rounds of size/R messages — (R-1) latency terms, but
+            the bandwidth-optimal 1x payload factor
+
+ROADMAP item 5 (per-link-class routing: loopback/intra-host/ICI/DCN
+economics) will key instances of this model per link class; the loader
+is deliberately dumb about WHERE its numbers came from.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Fallback (alpha seconds, beta seconds/byte) when no BENCH_comm.json is
+# available: conservative loopback-TCP numbers in the ballpark of the
+# committed report (rdv path: ~50 us fixed, ~1 ns/B ≈ 8 Gb/s effective).
+DEFAULT_FIT = {"fixed_overhead_us": 50.0, "per_byte_ns": 1.0}
+
+TOPOLOGIES = ("ring", "binomial", "star")
+
+
+def fit_points(points: Sequence[Tuple[float, float]]) -> Optional[dict]:
+    """Least-squares t = a + b*size over (size_bytes, seconds) points.
+    Returns the model's two headline quantities (fixed per-transfer
+    overhead, per-byte cost) plus fit quality, or None with fewer than
+    two distinct sizes.  This is THE fit testbandwidth.py publishes into
+    BENCH_comm.json — selector and harness share one definition."""
+    if len({s for s, _ in points}) < 2:
+        return None
+    xs = np.array([s for s, _ in points], dtype=np.float64)
+    ys = np.array([t for _, t in points], dtype=np.float64)
+    A = np.vstack([np.ones_like(xs), xs]).T
+    (a, b), *_ = np.linalg.lstsq(A, ys, rcond=None)
+    pred = a + b * xs
+    ss_res = float(((ys - pred) ** 2).sum())
+    ss_tot = float(((ys - ys.mean()) ** 2).sum())
+    return {
+        "fixed_overhead_us": round(a * 1e6, 2),
+        "per_byte_ns": round(b * 1e9, 6),
+        "eff_gbps": round(8.0 / b / 1e9, 3) if b > 0 else None,
+        "r2": round(1.0 - ss_res / ss_tot, 4) if ss_tot > 0 else None,
+        "npoints": len(points),
+    }
+
+
+class TransferEconomics:
+    """Fitted transfer costs per path, loaded from a BENCH_comm.json.
+
+    `alpha(path)` / `beta(path)` return the fixed (seconds) and per-byte
+    (seconds/byte) legs; `cost(nbytes, path)` the modeled one-transfer
+    time.  Negative fitted intercepts (a 3-point fit can dip below zero)
+    clamp to 0 — a transfer cannot have negative fixed cost, and the
+    selector only needs the relative ordering."""
+
+    def __init__(self, fits: Dict[str, dict], source: str = "defaults"):
+        self.fits = fits
+        self.source = source
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "TransferEconomics":
+        """Load from `path`, else coll.econ_path, else the repo's
+        BENCH_comm.json, else built-in defaults (never raises for a
+        missing/garbled file — the selector must work on fresh hosts)."""
+        if path is None:
+            from ..utils import params as _mca
+            path = _mca.get("coll.econ_path") or None
+        if path is None:
+            repo = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            cand = os.path.join(repo, "BENCH_comm.json")
+            path = cand if os.path.exists(cand) else None
+        if path is None:
+            return cls({}, source="defaults")
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            fits = {name: p["fit"] for name, p in doc.get("paths", {}).items()
+                    if isinstance(p, dict) and p.get("fit")}
+            if not fits:
+                return cls({}, source="defaults")
+            return cls(fits, source=path)
+        except (OSError, ValueError, KeyError):
+            return cls({}, source="defaults")
+
+    # ------------------------------------------------------------- model
+    def path_fit(self, path: str = "rdv") -> dict:
+        """The (fixed_overhead_us, per_byte_ns) legs for `path`, falling
+        back eager -> rdv -> defaults so a partial sweep still answers."""
+        for cand in (path, "rdv", "eager"):
+            if cand in self.fits:
+                return self.fits[cand]
+        return dict(DEFAULT_FIT)
+
+    def alpha(self, path: str = "rdv") -> float:
+        return max(0.0, self.path_fit(path)["fixed_overhead_us"]) * 1e-6
+
+    def beta(self, path: str = "rdv") -> float:
+        return max(0.0, self.path_fit(path)["per_byte_ns"]) * 1e-9
+
+    def cost(self, nbytes: int, path: str = "rdv") -> float:
+        """Modeled seconds for one transfer of `nbytes` on `path`."""
+        return self.alpha(path) + nbytes * self.beta(path)
+
+    # ---------------------------------------------------------- selector
+    def topology_costs(self, kind: str, nbytes: int, nranks: int,
+                       path: str = "rdv") -> Dict[str, float]:
+        """Modeled completion time per topology for one collective of
+        `nbytes` (the per-rank contribution / broadcast payload) across
+        `nranks`.  `kind`: "reduce" (reduce-scatter-shaped: the unit is
+        a 1/R segment converging on its root) or "fanout" (bcast /
+        all-gather-shaped: the full payload leaves one root)."""
+        if nranks <= 1:
+            return {t: 0.0 for t in TOPOLOGIES}
+        a, b = self.alpha(path), self.beta(path)
+        R = nranks
+        L = max(1, math.ceil(math.log2(R)))
+        if kind == "reduce":
+            seg = nbytes / R
+            return {
+                # R-1 pipelined hops of one segment each
+                "ring": (R - 1) * (a + seg * b),
+                # log rounds, each hop carries a segment
+                "binomial": L * (a + seg * b),
+                # one round, but the root's link serializes R-1 segments
+                "star": a + (R - 1) * seg * b,
+            }
+        # fanout: full payload from the root
+        return {
+            # chain pipeline: R-1 latency terms, one payload down the pipe
+            # (wire chunking overlaps the hops for large payloads)
+            "ring": (R - 1) * a + nbytes * b,
+            "binomial": L * (a + nbytes * b),
+            "star": a + (R - 1) * nbytes * b,
+        }
+
+    def choose_topology(self, kind: str, nbytes: int, nranks: int,
+                        path: str = "rdv",
+                        override: Optional[str] = None) -> str:
+        """Pick the cheapest topology under the fitted model.  `override`
+        (or the PTC_MCA_coll_topo param when it is not 'auto') wins
+        unconditionally — the knob is the escape hatch when the model is
+        wrong for a deployment."""
+        if override is None:
+            from ..utils import params as _mca
+            ov = _mca.get("coll.topo")
+            override = None if ov in (None, "", "auto") else ov
+        if override is not None:
+            if override not in TOPOLOGIES:
+                raise ValueError(
+                    f"unknown collective topology {override!r} "
+                    f"(coll.topo): expected one of {list(TOPOLOGIES)} "
+                    "or 'auto'")
+            return override
+        costs = self.topology_costs(kind, nbytes, nranks, path)
+        return min(costs, key=lambda t: costs[t])
+
+
+_cached: Optional[TransferEconomics] = None
+
+
+def default_economics() -> TransferEconomics:
+    """Process-wide cached TransferEconomics.load() (the selector runs
+    per collective build; re-reading the JSON each time would be silly)."""
+    global _cached
+    if _cached is None:
+        _cached = TransferEconomics.load()
+    return _cached
+
+
+def choose_topology(kind: str, nbytes: int, nranks: int,
+                    override: Optional[str] = None,
+                    econ: Optional[TransferEconomics] = None) -> str:
+    """Module-level convenience over default_economics()."""
+    return (econ or default_economics()).choose_topology(
+        kind, nbytes, nranks, override=override)

@@ -427,6 +427,25 @@ class Context:
                 "overlap_fraction":
                     round(buf[2] / d2h, 4) if d2h > 0 else None}
 
+    def coll_stats(self) -> dict:
+        """Runtime-native collective counters (the ptc_coll_* task-class
+        family built by parsec_tpu.comm.coll): native step/frame/byte
+        counters plus the Python builder's op-level records (ops built,
+        topology chosen per op — the economics selector's decisions are
+        auditable, not implicit)."""
+        buf = (C.c_int64 * 6)()
+        N.lib.ptc_coll_stats(self._ptr, buf)
+        py = getattr(self, "_coll_py_stats", None) or {
+            "ops": 0, "by_kind": {}, "by_topo": {}}
+        return {
+            "steps": buf[0],
+            "send_msgs": buf[1], "send_bytes": buf[2],
+            "recv_msgs": buf[3], "recv_bytes": buf[4],
+            "ops": py["ops"],
+            "by_kind": dict(py["by_kind"]),
+            "by_topo": dict(py["by_topo"]),
+        }
+
     def stats(self) -> dict:
         """Unified counter snapshot: every stats surface this context
         exports, merged under one namespaced dict — ONE call for the
@@ -437,6 +456,8 @@ class Context:
           comm    -> engine/rdv/tuning/stream counter groups (empty
                      sub-dicts stay present when comm is off, so the
                      schema is stable across single- and multi-rank runs)
+          coll    -> coll_stats() (runtime-native collective steps,
+                     frames/bytes, per-op topology decisions)
           trace   -> tracing health: level, ring/drop state of the
                      flight recorder, and the clock-sync estimate
         """
@@ -453,6 +474,7 @@ class Context:
                 # level too — one native read, two access paths, no skew
                 "stream": tuning["stream"],
             },
+            "coll": self.coll_stats(),
             "trace": {
                 "level": self.profile_level(),
                 "ring_bytes": self.profile_ring(),

@@ -13,7 +13,8 @@ composed from shardings — the way §2.10's checklist prescribes.
 """
 from .mesh import MeshSpec, make_mesh
 from .collectives import (ring_permute, seq_all_gather, seq_reduce_scatter,
-                          seq_all_to_all)
+                          seq_all_to_all, all_reduce, reduce_scatter,
+                          all_gather, broadcast)
 from .ring_attention import ring_attention, blockwise_attention_reference
 from .ulysses import ulysses_attention
 from .expert import moe_ffn, moe_ffn_reference
@@ -23,6 +24,7 @@ __all__ = [
     "init_distributed", "process_info",
     "MeshSpec", "make_mesh",
     "ring_permute", "seq_all_gather", "seq_reduce_scatter", "seq_all_to_all",
+    "all_reduce", "reduce_scatter", "all_gather", "broadcast",
     "ring_attention", "blockwise_attention_reference", "ulysses_attention",
     "moe_ffn", "moe_ffn_reference",
 ]

@@ -23,14 +23,16 @@ TPU-native equivalent over the native core's 8-word event stream
 """
 from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
                     KEY_COMM_SEND, KEY_COMM_RECV, KEY_DEVICE, KEY_H2D,
-                    KEY_STREAM, Dictionary, Trace, take_trace, to_dot)
+                    KEY_STREAM, KEY_COLL, Dictionary, Trace, take_trace,
+                    to_dot)
 from .critpath import critical_path, lost_time
 from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
                    CommVolume, DeviceActivity, REGISTRY, enable_pins)
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE", "KEY_H2D",
-           "KEY_STREAM", "Dictionary", "Trace", "take_trace", "to_dot",
+           "KEY_STREAM", "KEY_COLL", "Dictionary", "Trace", "take_trace",
+           "to_dot",
            "critical_path", "lost_time",
            "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
            "CommVolume", "DeviceActivity", "REGISTRY", "enable_pins"]

@@ -11,9 +11,11 @@ overlap).  This module runs on a (merged) Trace:
         The path is exact for the executed DAG, not a model: a diamond
         A->{B,C}->D with a slow B returns [A, B, D].
   lost_time(trace)      — per-(rank, worker) wall breakdown: compute /
-        release / h2d_stall / comm_wait / idle, from the non-overlapping
-        union of that worker's spans; idle gaps that end at a COMM_RECV
-        delivery on the same rank are attributed to comm_wait.
+        release / h2d_stall / comm_wait / coll_wait / idle, from the
+        non-overlapping union of that worker's spans; idle gaps that end
+        at a COMM_RECV delivery on the same rank are attributed to
+        comm_wait — or to coll_wait when the delivery targeted a
+        ptc_coll_* collective step (KEY_COLL instants, comm.cpp).
   wire latency rides on Trace.wire_latency() (flow-correlated COMM
         events) — see profiling.trace.
 
@@ -25,8 +27,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .trace import (KEY_COMM_RECV, KEY_EXEC, KEY_H2D, KEY_RELEASE,
-                    KEY_STREAM, Trace)
+from .trace import (KEY_COLL, KEY_COMM_RECV, KEY_EXEC, KEY_H2D,
+                    KEY_RELEASE, KEY_STREAM, Trace)
 
 Node = Tuple[int, int, int]  # (class_id, l0, l1) — the EDGE identity
 
@@ -146,12 +148,19 @@ def lost_time(trace: Trace, comm_wait_window_ns: int = 50_000) -> dict:
                    `comm_wait_window_ns` after the start of) a COMM_RECV
                    delivery on the same rank: the worker was starved
                    waiting for a remote dependency
+      coll_wait  — the subset of that starvation whose delivery targeted
+                   a ptc_coll_* collective step (a COLL_RECV instant with
+                   the same (src, corr) flow id rode along): time spent
+                   waiting on the collective's wire traffic, split out of
+                   comm_wait so a reduction misfiled as generic comm (or
+                   idle) is visible on its own line
       idle       — the rest of the gap time
     Returns {"workers": {(rank, worker): {...}}, "totals": {...}} where
     every bucket also exists summed in "totals"."""
     t = trace._spans_table()
     ev, rk = trace.events, trace.ranks
-    buckets = ("compute", "release", "h2d_stall", "comm_wait", "idle")
+    buckets = ("compute", "release", "h2d_stall", "comm_wait",
+               "coll_wait", "idle")
     out: Dict[Tuple[int, int], Dict[str, int]] = {}
     if not len(t):
         return {"workers": {}, "totals": {b: 0 for b in buckets}}
@@ -160,11 +169,24 @@ def lost_time(trace: Trace, comm_wait_window_ns: int = 50_000) -> dict:
     for r in np.unique(rk):
         ts = ev[rk == r, 7]
         win[int(r)] = (int(ts.min()), int(ts.max()))
-    # COMM_RECV delivery times per rank (sorted, for the gap classifier)
+    # COMM_RECV delivery times per rank (sorted, for the gap classifier),
+    # each tagged collective when a COLL_RECV instant with the same
+    # (source rank, correlation cookie) flow id exists on that rank —
+    # comm.cpp emits the two instants for the same delivered frame
     recv_at: Dict[int, np.ndarray] = {}
+    recv_coll: Dict[int, np.ndarray] = {}
     rm = (ev[:, 0] == KEY_COMM_RECV) & (ev[:, 1] == 0)
+    cm = (ev[:, 0] == KEY_COLL) & (ev[:, 1] == 0)
     for r in np.unique(rk[rm]):
-        recv_at[int(r)] = np.sort(ev[rm & (rk == r), 7])
+        rows = ev[rm & (rk == r)]
+        order = np.argsort(rows[:, 7])
+        rows = rows[order]
+        coll_ids = {(int(s), int(c))
+                    for s, c in ev[cm & (rk == r)][:, 3:5]}
+        recv_at[int(r)] = rows[:, 7]
+        recv_coll[int(r)] = np.array(
+            [(int(s), int(c)) in coll_ids for s, c in rows[:, 3:5]],
+            dtype=bool)
     workers = {}
     wk = t[:, 1] >= 0  # device/comm thread rows (worker -1) excluded
     for key in {(int(r), int(w)) for r, w in t[wk][:, :2]}:
@@ -184,11 +206,17 @@ def lost_time(trace: Trace, comm_wait_window_ns: int = 50_000) -> dict:
         busy_ns = _union_ns(list(busy))
         w0, w1 = win[key[0]]
         gap_ns = max(0, (w1 - w0) - busy_ns)
-        # classify idle gaps: walk the busy union's complement
+        # classify idle gaps: walk the busy union's complement.  The
+        # credited starvation interval splits per delivery: the segment
+        # ending at each delivery takes THAT delivery's category
+        # (collective step vs generic activation), so one gap fed by
+        # both kinds attributes each portion to the right bucket.
         comm_wait = 0
+        coll_wait = 0
         busy.sort()
         cursor = w0
         rts = recv_at.get(key[0])
+        cfl = recv_coll.get(key[0])
         merged: List[Tuple[int, int]] = []
         for b, e in busy:
             if merged and b <= merged[-1][1]:
@@ -201,14 +229,22 @@ def lost_time(trace: Trace, comm_wait_window_ns: int = 50_000) -> dict:
                 # a delivery inside (or just after) the gap starved us
                 lo = np.searchsorted(rts, cursor)
                 hi = np.searchsorted(rts, b + comm_wait_window_ns)
-                if hi > lo:
-                    last = int(min(rts[hi - 1], b))
-                    comm_wait += max(0, last - cursor)
+                prev = cursor
+                for j in range(lo, hi):
+                    tj = int(min(int(rts[j]), b))
+                    if tj <= prev:
+                        continue
+                    if cfl[j]:
+                        coll_wait += tj - prev
+                    else:
+                        comm_wait += tj - prev
+                    prev = tj
             cursor = max(cursor, e)
-        idle = max(0, gap_ns - comm_wait)
+        idle = max(0, gap_ns - comm_wait - coll_wait)
         workers[key] = {
             "compute": compute, "release": release,
-            "h2d_stall": h2d_stall, "comm_wait": comm_wait, "idle": idle,
+            "h2d_stall": h2d_stall, "comm_wait": comm_wait,
+            "coll_wait": coll_wait, "idle": idle,
             "window_ns": w1 - w0,
         }
     totals = {b: sum(w[b] for w in workers.values()) for b in buckets}

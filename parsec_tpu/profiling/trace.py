@@ -41,6 +41,11 @@ KEY_H2D = 6        # h2d staging span, l0 = bytes, l1 = device queue,
                    # aux = lane (0 dispatch-time stall, 1 prefetch lane)
 KEY_STREAM = 7     # progressive-serve d2h span (writeback lane slicing a
                    # remote-pulled mirror), l0 = bytes, l1 = device queue
+KEY_COLL = 8       # collective-step delivery on a ptc_coll_* task class
+                   # (instant span, emitted ALONGSIDE the COMM_RECV of
+                   # the same frame): l0 = source rank, l1 = correlation
+                   # cookie, aux = payload bytes — the evidence behind
+                   # the coll_wait lost-time bucket (critpath.lost_time)
 
 _MAGIC = b"#PTCPROF"
 _VERSION = 2
@@ -55,6 +60,7 @@ _DEFAULT_KEYS = {
     KEY_DEVICE: ("DEVICE_DISPATCH", "#aa00ff"),
     KEY_H2D: ("DEVICE_H2D", "#00aaff"),
     KEY_STREAM: ("STREAM_D2H", "#ffaa00"),
+    KEY_COLL: ("COLL_RECV", "#00ffcc"),
 }
 
 

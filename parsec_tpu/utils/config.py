@@ -249,6 +249,27 @@ register("comm.rails", 2, int,
          "sensitive stays on rail 0.  Must be uniform across the job "
          "(the accept handshake rejects mismatches); 1 = the v3 single-"
          "connection mesh")
+register("coll.topo", "auto", str,
+         "runtime-native collective topology (parsec_tpu.comm.coll): "
+         "ring|binomial|star, or 'auto' to choose per (message size, "
+         "rank count) from the BENCH_comm.json transfer-economics fits "
+         "(fixed overhead + per-byte cost; see comm/economics.py).  The "
+         "fan-out legs of bcast/all_gather map star|chain|binomial onto "
+         "the native ACTIVATE_BCAST trees (comm.bcast_topo machinery)")
+register("coll.slice", 0, int,
+         "collective slice quantum in bytes: a producer tile enters a "
+         "runtime-native collective in slices of this size, each its own "
+         "pipelined dataflow chain, so the wire (and the downstream "
+         "partial reduction) starts after the FIRST slice instead of "
+         "the last (T3, arXiv:2401.16677).  0 = use comm.chunk_size, "
+         "so collective slicing and wire chunking stay aligned")
+register("coll.max_slices", 16, int,
+         "cap on slices per collective segment (bounds task count per "
+         "op; tiny messages collapse to one slice)")
+register("coll.econ_path", "", str,
+         "path to a transfer-economics JSON (BENCH_comm.json schema) "
+         "for the topology selector; empty = the repo's BENCH_comm.json "
+         "when present, else built-in loopback defaults")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
 register("dtd.insert_batch", 256, int,

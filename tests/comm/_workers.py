@@ -800,7 +800,7 @@ def ptg_datatype_column(rank: int, nodes: int, port: int,
 
 def moe_taskpool_spmd(rank: int, nodes: int, port: int, S: int = 4,
                       T: int = 8, d: int = 4, f: int = 6, E: int = 4,
-                      k: int = 2):
+                      k: int = 2, combine: str = "chain"):
     """MoE through the runtime across ranks: token shards live on rank
     s%nodes, experts on rank e%nodes — the dispatch tiles moving to the
     expert ranks and the results moving back are the two all-to-all legs,
@@ -819,10 +819,13 @@ def moe_taskpool_spmd(rank: int, nodes: int, port: int, S: int = 4,
         Xc, Yc, WGc, WUc, WDc = make_moe_collections(
             S, T, d, f, E, nodes=nodes, myrank=rank, x=x, w_gate=wg,
             w_up=wu, w_down=wd)
-        tp = build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=k)
+        tp = build_moe(ctx, Xc, Yc, WGc, WUc, WDc, E, k=k, combine=combine)
         tp.run()
         tp.wait()
         ctx.comm_fence()
+        if combine == "coll":
+            st = ctx.coll_stats()
+            assert st["steps"] > 0, st
         ref = moe_oracle(x, wg, wu, wd, k=k)
         for s_ in range(S):
             if s_ % nodes != rank:
@@ -1960,4 +1963,170 @@ def traced_chain(rank: int, nodes: int, port: int, out_dir: str,
         if rank != 0:
             assert "clock_offset_ns" in tr.meta, tr.meta
         tr.save(os.path.join(out_dir, f"r{rank}.ptt"))
+        ctx.comm_fini()
+
+
+def coll_primitives(rank: int, nodes: int, port: int, topo=None,
+                    stream=None, elems: int = 4096, slice_bytes=None,
+                    eager_limit=None, faults: bool = False):
+    """All four runtime-native collectives vs in-process numpy references.
+    Integer-valued float32 data: every reduction order yields bit-exact
+    sums, so ring/binomial/star and stream on/off must all match the
+    reference EXACTLY (ISSUE 6 acceptance).  Knobs: topo overrides the
+    economics selector; slice_bytes forces multi-slice pipelining;
+    eager_limit=0 forces the GET rendezvous/streaming wire; faults=True
+    soaks under PTC_COMM_FAULT_* (short reads + per-recv delay)."""
+    import math
+    import os
+
+    if stream is not None:
+        os.environ["PTC_MCA_comm_stream"] = str(stream)
+    if slice_bytes is not None:
+        os.environ["PTC_MCA_coll_slice"] = str(slice_bytes)
+    if eager_limit is not None:
+        os.environ["PTC_MCA_comm_eager_limit"] = str(eager_limit)
+    if faults:
+        os.environ["PTC_COMM_FAULT_RECV_MAX"] = "1500"
+        os.environ["PTC_COMM_FAULT_DELAY_US"] = "50"
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.comm import coll
+    with ctx:
+        alls = [np.random.default_rng(100 + r)
+                .integers(-50, 50, size=elems).astype(np.float32)
+                for r in range(nodes)]
+        local = alls[rank]
+        total = np.sum(np.stack(alls), axis=0, dtype=np.float32)
+
+        got = coll.all_reduce(ctx, local, topo=topo)
+        np.testing.assert_array_equal(got, total)
+
+        got = coll.reduce_scatter(ctx, local, topo=topo)
+        seg = math.ceil(elems / nodes)
+        lo = rank * seg
+        np.testing.assert_array_equal(got, total[lo:lo + seg])
+
+        got = coll.all_gather(ctx, local, topo=topo)
+        np.testing.assert_array_equal(got, np.concatenate(alls))
+
+        root = 1 % nodes
+        got = coll.broadcast(ctx, local.copy(), root=root, topo=topo)
+        np.testing.assert_array_equal(got, alls[root])
+
+        st = ctx.stats()["coll"]
+        assert st["steps"] > 0, st
+        assert st["ops"] == 4, st
+        if topo is not None:
+            assert st["by_topo"].get(topo, 0) >= 1, (topo, st)
+        ctx.comm_fence()
+        if faults or eager_limit == 0:
+            # streamed/rendezvous sessions must drain (bounded comm
+            # memory even under fault injection)
+            rdv = ctx.comm_rdv_stats()
+            assert rdv["registered_bytes"] == 0, rdv
+            assert rdv["pending_pulls"] == 0, rdv
+        ctx.comm_fini()
+
+
+def coll_allreduce_stream_soak(rank: int, nodes: int, port: int,
+                               elems: int = 65536):
+    """4-rank streamed all-reduce under comm fault injection: payloads
+    far above the eager limit ride the chunked/streamed wire while every
+    recv is capped + delayed; the result must stay bit-exact and every
+    session drained (ISSUE 6 satellite: fault soak)."""
+    import os
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "1024"
+    os.environ["PTC_MCA_comm_chunk_size"] = "16384"
+    os.environ["PTC_MCA_coll_slice"] = "65536"
+    os.environ["PTC_COMM_FAULT_RECV_MAX"] = "2000"
+    os.environ["PTC_COMM_FAULT_DELAY_US"] = "20"
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.comm import coll
+    with ctx:
+        alls = [np.random.default_rng(7 + r)
+                .integers(-9, 9, size=elems).astype(np.float32)
+                for r in range(nodes)]
+        total = np.sum(np.stack(alls), axis=0, dtype=np.float32)
+        got = coll.all_reduce(ctx, alls[rank], topo="ring")
+        np.testing.assert_array_equal(got, total)
+        ctx.comm_fence()
+        rdv = ctx.comm_rdv_stats()
+        assert rdv["registered_bytes"] == 0, rdv
+        assert rdv["pending_pulls"] == 0, rdv
+        st = ctx.coll_stats()
+        assert st["steps"] > 0 and st["recv_msgs"] > 0, st
+        ctx.comm_fini()
+
+
+def gemm_panel_reduce_modes(rank: int, nodes: int, port: int,
+                            M: int = 48, K: int = 32, Nc: int = 40,
+                            trace_dir=None):
+    """k-split GEMM panel reduction: C = sum_r A_r @ B_r with rank r
+    holding k-slab r.  Runs the DAG-dependency chain baseline and the
+    runtime-native panel-streamed collective, asserts both equal the
+    numpy reference bit-for-bit (integer-valued inputs), and (with
+    trace_dir) saves level-2 traces of both modes for lost-time
+    comparison."""
+    import os
+
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.algos.gemm import gemm_panel_reduce
+    with ctx:
+        rng = np.random.default_rng(3)
+        a = rng.integers(-4, 4, size=(M, K)).astype(np.float32)
+        b = rng.integers(-4, 4, size=(K, Nc)).astype(np.float32)
+        ks = K // nodes
+        ref = sum(a[:, r * ks:(r + 1) * ks] @ b[r * ks:(r + 1) * ks]
+                  for r in range(nodes))
+        a_slab = a[:, rank * ks:(rank + 1) * ks].copy()
+        b_slab = b[rank * ks:(rank + 1) * ks].copy()
+        outs = {}
+        for mode in ("chain", "coll"):
+            if trace_dir:
+                ctx.profile_enable(2)
+            c = gemm_panel_reduce(ctx, a_slab, b_slab, reduce=mode,
+                                  panel_rows=8)
+            np.testing.assert_array_equal(c, ref)
+            outs[mode] = c
+            ctx.comm_fence()
+            if trace_dir:
+                from parsec_tpu.profiling.trace import take_trace
+                tr = take_trace(ctx)
+                tr.save(os.path.join(trace_dir,
+                                     f"{mode}_r{rank}.ptt"))
+        np.testing.assert_array_equal(outs["chain"], outs["coll"])
+        st = ctx.coll_stats()
+        assert st["steps"] > 0, st
+        ctx.comm_fini()
+
+
+def coll_dispatch_runtime(rank: int, nodes: int, port: int,
+                          elems: int = 1024):
+    """parallel.collectives front door with a live multi-rank Context:
+    every primitive must route to the runtime-native ptc_coll_* path
+    (coll_stats ops recorded) and match the numpy references bit-exactly
+    (ISSUE 6 tentpole wiring)."""
+    import math
+
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu import parallel as pp
+    with ctx:
+        alls = [np.random.default_rng(100 + r)
+                .integers(-50, 50, size=elems).astype(np.float32)
+                for r in range(nodes)]
+        local = alls[rank]
+        total = np.sum(np.stack(alls), axis=0, dtype=np.float32)
+
+        np.testing.assert_array_equal(pp.all_reduce(local, ctx=ctx), total)
+        seg = math.ceil(elems / nodes)
+        np.testing.assert_array_equal(
+            pp.reduce_scatter(local, ctx=ctx),
+            total[rank * seg:rank * seg + seg])
+        np.testing.assert_array_equal(pp.all_gather(local, ctx=ctx),
+                                      np.concatenate(alls))
+        np.testing.assert_array_equal(
+            pp.broadcast(local.copy(), root=0, ctx=ctx), alls[0])
+        st = ctx.coll_stats()
+        assert st["ops"] == 4, st  # every call took the runtime path
+        ctx.comm_fence()
         ctx.comm_fini()

@@ -1,0 +1,71 @@
+"""Collective bench schema smoke (mirror of test_bench_stream for the
+collective rung): `bench.py --collective --json` must run at small
+sizes and emit the schema `make bench-collective` commits to
+BENCH_collective.json — chain-vs-coll sweep with per-size ratios, the
+merged-trace lost-time/overlap evidence for both modes (comm_wait +
+coll_wait, overlap_fraction), the XLA psum baseline, the collective
+knobs and honest host provenance."""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+_MODE_KEYS = {"lost_time_totals", "comm_plus_coll_wait_ns",
+              "wire_inflight_ns", "matched_flows", "overlap_fraction"}
+_BUCKETS = {"compute", "release", "h2d_stall", "comm_wait", "coll_wait",
+            "idle"}
+
+
+def test_collective_suite_schema(tmp_path):
+    out = tmp_path / "coll.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, _BENCH, "--collective", "--json", str(out),
+           "--sizes", f"{64 * 1024},{256 * 1024}", "--reps", "1"]
+    res = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+
+    # driver contract: the one-line JSON lands on stdout
+    line = json.loads(res.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "coll_vs_chain_reduction_latency_ratio"
+    assert line["value"] is not None
+
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["bench"] == "collective"
+    assert doc["host"]["cpu_count"] == os.cpu_count()
+    assert {"coll_topo", "coll_slice", "coll_max_slices",
+            "comm_chunk_size", "comm_rails",
+            "comm_stream"} <= set(doc["knobs"])
+    assert "oversubscribed" in doc
+    if doc["oversubscribed"]:
+        assert "caveat" in doc  # the bench_dispatch_mt convention
+
+    assert len(doc["sweep"]) == 2
+    for entry in doc["sweep"]:
+        assert {"size_bytes", "chain_ms", "coll_ms",
+                "coll_vs_chain_ratio"} <= set(entry)
+        assert entry["chain_ms"] > 0 and entry["coll_ms"] > 0
+
+    # the traced evidence section: both modes, full bucket schema, the
+    # chain baseline has NO coll_wait (no ptc_coll_* classes in it)
+    gp = doc["gemm_panel"]
+    for mode in ("chain", "coll"):
+        assert _MODE_KEYS <= set(gp[mode]), gp[mode].keys()
+        assert _BUCKETS <= set(gp[mode]["lost_time_totals"])
+    assert gp["chain"]["lost_time_totals"]["coll_wait"] == 0
+    assert gp["coll"]["lost_time_totals"]["coll_wait"] > 0
+    assert gp["coll"]["matched_flows"] > gp["chain"]["matched_flows"]
+    assert "wait_reduction" in gp and "overlap_fraction_gain" in gp
+
+    # the economics selector's decisions are recorded
+    assert doc["coll_topology_ops"], doc
+    # XLA psum baseline per size (None only if jax came up 1-device)
+    xla = doc["xla_psum_ms"]
+    if xla is not None:
+        assert set(xla) == {str(64 * 1024), str(256 * 1024)}

@@ -1,0 +1,167 @@
+"""Runtime-native tiled collectives (ISSUE 6): multi-rank bit-exactness
+for reduce-scatter / all-reduce / all-gather / broadcast vs numpy
+references, topology-override knob coverage, stream-off bit-exactness,
+the 4-rank fault soak, and the unified-stats `coll` schema."""
+import numpy as np
+import pytest
+
+from .test_multirank import _run_spmd
+from . import _workers
+
+
+@pytest.mark.parametrize("nodes", [2, 4])
+def test_coll_primitives(nodes):
+    _run_spmd(_workers.coll_primitives, nodes)
+
+
+@pytest.mark.parametrize("topo", ["ring", "binomial", "star"])
+def test_coll_topology_override(topo):
+    """PTC_MCA_coll_topo-equivalent override: every topology produces
+    the same bit-exact results (integer-valued float32 data)."""
+    _run_spmd(_workers.coll_primitives, 3, topo=topo)
+
+
+def test_coll_stream_off_bit_exact():
+    """PTC_MCA_comm_stream=0 must reproduce the streamed collective's
+    results bit-exactly (acceptance criterion): rendezvous-forced,
+    multi-slice run with the progressive serve disabled."""
+    _run_spmd(_workers.coll_primitives, 2, stream=0, eager_limit=0,
+              slice_bytes=2048, elems=8192)
+
+
+def test_coll_rendezvous_sliced():
+    """Sliced collectives over the GET rendezvous wire (eager off):
+    chunk-granular slices each ride their own pull."""
+    _run_spmd(_workers.coll_primitives, 2, eager_limit=0,
+              slice_bytes=2048, elems=8192)
+
+
+@pytest.mark.slow
+def test_coll_fault_soak_4rank():
+    """4-rank streamed all-reduce under PTC_COMM_FAULT_RECV_MAX /
+    PTC_COMM_FAULT_DELAY_US: bit-exact results, drained sessions."""
+    _run_spmd(_workers.coll_allreduce_stream_soak, 4, timeout=240.0)
+
+
+def test_coll_faults_small():
+    """Tier-1-sized fault soak: 2 ranks, short reads + recv delay."""
+    _run_spmd(_workers.coll_primitives, 2, faults=True, elems=2048,
+              timeout=180.0)
+
+
+def test_coll_stats_schema():
+    """`coll` namespace in the unified Context.stats(): present and
+    fully populated even on a single-rank context (schema stability)."""
+    import parsec_tpu as pt
+
+    ctx = pt.Context(nb_workers=1)
+    try:
+        st = ctx.stats()
+        assert "coll" in st
+        coll = st["coll"]
+        for key in ("steps", "send_msgs", "send_bytes", "recv_msgs",
+                    "recv_bytes", "ops", "by_kind", "by_topo"):
+            assert key in coll, (key, coll)
+        assert coll["steps"] == 0 and coll["ops"] == 0
+    finally:
+        ctx.destroy()
+
+
+def test_coll_single_rank_local_fallback():
+    """nodes == 1 (or comm off): the primitives degrade to their local
+    semantics without building any taskpool."""
+    import parsec_tpu as pt
+    from parsec_tpu.comm import coll
+
+    ctx = pt.Context(nb_workers=1)
+    try:
+        x = np.arange(10, dtype=np.float32)
+        np.testing.assert_array_equal(coll.all_reduce(ctx, x), x)
+        np.testing.assert_array_equal(coll.reduce_scatter(ctx, x), x)
+        np.testing.assert_array_equal(coll.all_gather(ctx, x), x)
+        np.testing.assert_array_equal(coll.broadcast(ctx, x), x)
+    finally:
+        ctx.destroy()
+
+
+def test_topology_selector_economics():
+    """The economics-driven selector: star wins tiny messages (one
+    fixed-overhead term), ring wins big ones (bandwidth-optimal), and
+    an explicit override always wins."""
+    from parsec_tpu.comm.economics import TransferEconomics
+
+    econ = TransferEconomics(
+        {"rdv": {"fixed_overhead_us": 100.0, "per_byte_ns": 1.0}},
+        source="synthetic")
+    # tiny: fixed-overhead terms dominate -> one-round star
+    assert econ.choose_topology("reduce", 256, 8) == "star"
+    assert econ.choose_topology("fanout", 256, 8) == "star"
+    # large reduce: log-depth tree with 1/R segments per hop
+    assert econ.choose_topology("reduce", 64 << 20, 8) == "binomial"
+    # large fan-out: the chain pipeline moves ONE payload down the pipe
+    assert econ.choose_topology("fanout", 64 << 20, 8) == "ring"
+    # explicit override (the PTC_MCA_coll_topo escape hatch) always wins
+    assert econ.choose_topology("reduce", 64 << 20, 8,
+                                override="star") == "star"
+    with pytest.raises(ValueError):
+        econ.choose_topology("reduce", 1, 4, override="hypercube")
+
+
+def test_coll_parallel_dispatch_runtime():
+    """parallel.collectives front door routes to the runtime-native
+    path when a live multi-rank Context is passed (tentpole wiring)."""
+    _run_spmd(_workers.coll_dispatch_runtime, 2)
+
+
+def test_gemm_panel_reduce_2rank():
+    """k-split GEMM panel reduction: DAG-dependency chain baseline and
+    runtime-native streamed collective both equal the numpy reference
+    bit-for-bit."""
+    _run_spmd(_workers.gemm_panel_reduce_modes, 2)
+
+
+@pytest.mark.slow
+def test_gemm_panel_reduce_4rank():
+    _run_spmd(_workers.gemm_panel_reduce_modes, 4, timeout=240.0)
+
+
+def test_moe_combine_coll_2rank():
+    """MoE expert combine over the runtime-native reduction (combine=
+    'coll'): bit-identical to the oracle, coll steps recorded."""
+    _run_spmd(_workers.moe_taskpool_spmd, 2, combine="coll")
+
+
+@pytest.mark.slow
+def test_moe_combine_coll_4rank():
+    _run_spmd(_workers.moe_taskpool_spmd, 4, combine="coll",
+              timeout=240.0)
+
+
+def test_coll_wait_lost_time_2rank(tmp_path):
+    """ISSUE 6 satellite: the coll_wait lost-time category.  A 2-rank
+    GEMM panel reduction traced at level 2, merged: the runtime-native
+    mode's merged trace carries COLL_RECV instants and lost_time splits
+    a nonzero coll_wait out of comm_wait; the chain baseline (ordinary
+    task deps, no ptc_coll_* classes) reports coll_wait == 0."""
+    import os
+    from parsec_tpu.profiling import KEY_COLL, Trace, lost_time
+
+    out = str(tmp_path)
+    _run_spmd(_workers.gemm_panel_reduce_modes, 2, trace_dir=out)
+    for mode, expect_coll in (("chain", False), ("coll", True)):
+        traces = [Trace.load(os.path.join(out, f"{mode}_r{r}.ptt"))
+                  for r in range(2)]
+        m = Trace.merge(traces)
+        ev = m.events
+        n_coll = int(((ev[:, 0] == KEY_COLL) & (ev[:, 1] == 0)).sum())
+        lt = lost_time(m)
+        assert "coll_wait" in lt["totals"]
+        for b in lt["workers"].values():
+            assert set(b) >= {"compute", "release", "h2d_stall",
+                              "comm_wait", "coll_wait", "idle"}
+        if expect_coll:
+            assert n_coll > 0, "no COLL_RECV instants in coll mode"
+            assert lt["totals"]["coll_wait"] > 0, lt["totals"]
+        else:
+            assert n_coll == 0
+            assert lt["totals"]["coll_wait"] == 0, lt["totals"]

@@ -1,0 +1,77 @@
+"""Transfer-economics loader (ISSUE 6 satellite): the reusable fit +
+loader over BENCH_comm.json that the collective topology selector (and
+ROADMAP item 5's per-link-class routing) consume."""
+import json
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.economics import (DEFAULT_FIT, TransferEconomics,
+                                       choose_topology, fit_points)
+
+
+def test_fit_points_exact_line():
+    """A noiseless line recovers its own (alpha, beta) legs."""
+    a_us, b_ns = 120.0, 2.5
+    pts = [(s, a_us * 1e-6 + s * b_ns * 1e-9)
+           for s in (1024, 65536, 1 << 20, 4 << 20)]
+    fit = fit_points(pts)
+    assert fit["npoints"] == 4
+    assert abs(fit["fixed_overhead_us"] - a_us) < 0.5
+    assert abs(fit["per_byte_ns"] - b_ns) < 0.01
+    assert fit["r2"] > 0.9999
+    # one distinct size cannot fit a slope
+    assert fit_points([(4096, 1e-3), (4096, 2e-3)]) is None
+
+
+def test_loader_roundtrip(tmp_path):
+    """Load the exact schema testbandwidth.py publishes."""
+    doc = {"bench": "comm", "paths": {
+        "rdv": {"fit": {"fixed_overhead_us": 80.0, "per_byte_ns": 1.2}},
+        "eager": {"fit": {"fixed_overhead_us": 30.0, "per_byte_ns": 2.0}},
+        "broken": {"fit": None},
+    }}
+    p = tmp_path / "BENCH_comm.json"
+    p.write_text(json.dumps(doc))
+    econ = TransferEconomics.load(str(p))
+    assert econ.source == str(p)
+    assert set(econ.fits) == {"rdv", "eager"}  # fitless paths skipped
+    assert econ.alpha("rdv") == pytest.approx(80e-6)
+    assert econ.beta("eager") == pytest.approx(2e-9)
+    # unknown path falls back rdv -> eager -> defaults
+    assert econ.path_fit("pk_device") == econ.fits["rdv"]
+    # cost model is alpha + n*beta
+    assert econ.cost(1 << 20, "rdv") == pytest.approx(
+        80e-6 + (1 << 20) * 1.2e-9)
+
+
+def test_loader_missing_and_garbled(tmp_path):
+    """Fresh hosts (no sweep yet) and corrupt files both degrade to the
+    built-in defaults instead of raising."""
+    econ = TransferEconomics.load(str(tmp_path / "nope.json"))
+    assert econ.source == "defaults"
+    assert econ.path_fit("rdv") == DEFAULT_FIT
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert TransferEconomics.load(str(bad)).source == "defaults"
+    # negative fitted intercepts clamp to zero in the model legs
+    neg = TransferEconomics(
+        {"rdv": {"fixed_overhead_us": -3.0, "per_byte_ns": 1.0}})
+    assert neg.alpha("rdv") == 0.0
+
+
+def test_topology_cost_model_shapes():
+    """The modeled costs keep the LogP-style structure: star pays one
+    alpha, binomial log2(R) alphas, ring R-1 alphas on the reduce leg;
+    single rank costs nothing."""
+    econ = TransferEconomics(
+        {"rdv": {"fixed_overhead_us": 100.0, "per_byte_ns": 0.0}})
+    c = econ.topology_costs("reduce", 1 << 20, 8)
+    assert c["star"] == pytest.approx(100e-6)
+    assert c["binomial"] == pytest.approx(3 * 100e-6)
+    assert c["ring"] == pytest.approx(7 * 100e-6)
+    assert all(v == 0.0 for v in
+               econ.topology_costs("reduce", 1 << 20, 1).values())
+    # module-level convenience routes through the default instance
+    assert choose_topology("reduce", 64, 4,
+                           override="binomial") == "binomial"

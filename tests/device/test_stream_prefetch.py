@@ -65,7 +65,7 @@ def test_unified_stats_schema_single_rank():
         dev = TpuDevice(ctx)
         try:
             s = ctx.stats()
-            assert set(s) == {"sched", "device", "comm", "trace"}
+            assert set(s) == {"sched", "device", "comm", "coll", "trace"}
             for k in ("level", "ring_bytes", "dropped_events", "clock"):
                 assert k in s["trace"], k
             assert "bypass_hits" in s["sched"]

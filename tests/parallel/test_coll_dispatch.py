@@ -1,0 +1,76 @@
+"""Dispatching collectives (ISSUE 6 tentpole wiring): the shard_map/XLA
+fallback and the local path must reproduce the runtime-native streamed
+collective's results bit-exactly.  The runtime path itself is exercised
+multi-rank in tests/comm/test_coll.py against the SAME integer-valued
+numpy references used here — equality to a common reference on both
+sides is the bit-exactness acceptance criterion, checked without
+spawning ranks inside an XLA test."""
+import numpy as np
+import pytest
+
+from parsec_tpu.parallel import (all_gather, all_reduce, broadcast,
+                                 make_mesh, reduce_scatter)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(sp=8)
+
+
+def _contribs(n=8, elems=192):
+    # same recipe as tests/comm/_workers.coll_primitives: integer-valued
+    # float32, so every reduction order sums bit-exactly
+    return np.stack([np.random.default_rng(100 + r)
+                     .integers(-50, 50, size=elems).astype(np.float32)
+                     for r in range(n)])
+
+
+def test_xla_all_reduce_bit_exact(mesh):
+    xs = _contribs()
+    ref = np.sum(xs, axis=0, dtype=np.float32)
+    got = np.asarray(all_reduce(xs, mesh=mesh, axis="sp"))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_xla_reduce_scatter_bit_exact(mesh):
+    xs = _contribs()
+    ref = np.sum(xs, axis=0, dtype=np.float32)
+    got = np.asarray(reduce_scatter(xs, mesh=mesh, axis="sp"))
+    np.testing.assert_array_equal(np.ravel(got)[:ref.size], ref)
+
+
+def test_xla_all_gather_bit_exact(mesh):
+    xs = _contribs()
+    got = np.asarray(all_gather(xs, mesh=mesh, axis="sp"))
+    np.testing.assert_array_equal(got, np.ravel(xs))
+
+
+def test_xla_broadcast_bit_exact(mesh):
+    xs = _contribs()
+    got = np.asarray(broadcast(xs, root=3, mesh=mesh, axis="sp"))
+    np.testing.assert_array_equal(got, xs[3])
+
+
+def test_xla_stacking_contract(mesh):
+    with pytest.raises(ValueError, match="stacked on dim 0"):
+        all_reduce(np.zeros((3, 4), np.float32), mesh=mesh, axis="sp")
+
+
+def test_local_fallback_no_mesh_no_ctx():
+    x = np.arange(12, dtype=np.float32)
+    np.testing.assert_array_equal(all_reduce(x), x)
+    np.testing.assert_array_equal(reduce_scatter(x), x)
+    np.testing.assert_array_equal(all_gather(x), x)
+    np.testing.assert_array_equal(broadcast(x), x)
+
+
+def test_runtime_routing_single_rank():
+    """A live single-rank Context does NOT qualify for the runtime path
+    (nothing to reduce across) — the call degrades to local semantics
+    instead of building a taskpool."""
+    import parsec_tpu as pt
+
+    with pt.Context(nb_workers=1) as ctx:
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_array_equal(all_reduce(x, ctx=ctx), x)
+        assert ctx.coll_stats()["ops"] == 0

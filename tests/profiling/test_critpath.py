@@ -115,3 +115,38 @@ def test_lost_time_breakdown():
         assert b["window_ns"] >= b["compute"], b
         # single-process run: no comm starvation to attribute
         assert b["comm_wait"] == 0, b
+
+
+def test_lost_time_coll_wait_split():
+    """Synthetic trace, hand-computed: one worker idles 100us before a
+    plain COMM_RECV delivery and 200us before a collective delivery
+    (COMM_RECV + COLL_RECV with the same (src, corr) flow id — the way
+    comm.cpp emits them for a ptc_coll_* target).  lost_time must put
+    100us in comm_wait and 200us in coll_wait, exactly."""
+    from parsec_tpu.profiling import (KEY_COLL, KEY_COMM_RECV, Trace,
+                                      lost_time)
+
+    us = 1000
+    ev = []
+    # window anchor: a 10us EXEC span at t=0
+    ev.append([KEY_EXEC, 0, 0, 0, 0, 0, 0, 0])
+    ev.append([KEY_EXEC, 1, 0, 0, 0, 0, 0, 10 * us])
+    # gap 10..110us ends at a PLAIN delivery (src 1, corr 7)
+    ev.append([KEY_COMM_RECV, 0, 0, 1, 7, -1, 64, 110 * us])
+    # EXEC 110..120us, then gap 120..320us ends at a COLLECTIVE delivery
+    ev.append([KEY_EXEC, 0, 0, 0, 1, 0, 0, 110 * us])
+    ev.append([KEY_EXEC, 1, 0, 0, 1, 0, 0, 120 * us])
+    ev.append([KEY_COMM_RECV, 0, 1, 1, 9, -1, 64, 320 * us])
+    ev.append([KEY_COLL, 0, 1, 1, 9, -1, 64, 320 * us])
+    # closing EXEC span 320..330us pins the window end
+    ev.append([KEY_EXEC, 0, 0, 0, 2, 0, 0, 320 * us])
+    ev.append([KEY_EXEC, 1, 0, 0, 2, 0, 0, 330 * us])
+    tr = Trace(np.array(ev, dtype=np.int64))
+    lt = lost_time(tr)
+    b = lt["workers"][(0, 0)]
+    assert b["comm_wait"] == 100 * us, b
+    assert b["coll_wait"] == 200 * us, b
+    assert b["compute"] == 30 * us, b
+    # categories still sum to the window
+    assert (b["compute"] + b["comm_wait"] + b["coll_wait"]
+            + b["release"] + b["idle"]) == b["window_ns"], b

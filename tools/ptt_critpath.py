@@ -10,7 +10,7 @@ Multiple per-rank files are merged with cross-rank clock sync (header-v2
 clock_offset_ns) and causal enforcement, then:
   - the executed DAG's critical path (needs level-2 traces: EDGE pairs),
   - a per-(rank, worker) lost-time breakdown
-    (compute / release / h2d stall / comm wait / idle),
+    (compute / release / h2d stall / comm wait / coll wait / idle),
   - the matched-flow wire-latency summary per (src, dst) pair.
 """
 import argparse
@@ -77,6 +77,7 @@ def main(argv=None):
                   f"release {_fmt_ns(b['release'])}  "
                   f"h2d_stall {_fmt_ns(b['h2d_stall'])}  "
                   f"comm_wait {_fmt_ns(b['comm_wait'])}  "
+                  f"coll_wait {_fmt_ns(b['coll_wait'])}  "
                   f"idle {_fmt_ns(b['idle'])}")
         report["lost_time_totals"] = lt["totals"]
         report["lost_time"] = {f"r{r}_w{w}": b

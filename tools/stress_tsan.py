@@ -159,6 +159,61 @@ def colocated_comm(workers: int, nb: int = 64, port: int = 29900,
                 os.environ[k] = v
 
 
+def colocated_coll(workers: int, elems: int, port: int, env=None) -> None:
+    """Two ranks in ONE process running runtime-native streamed
+    collectives (ptc_coll_* task classes, parsec_tpu.comm.coll): the
+    reduction/fan-out step deliveries, the coll-stats counters, the
+    native bcast-tree switches and (with `env` forcing rendezvous +
+    small chunks) the chunked wire sessions under TSan's happens-before
+    analysis — every topology exercised."""
+    import threading
+
+    from parsec_tpu.comm import coll
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    errs = []
+
+    def rank_prog(rank):
+        try:
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            with ctx:
+                alls = [np.arange(elems, dtype=np.float32) + 100.0 * r
+                        for r in range(2)]
+                total = alls[0] + alls[1]
+                for topo in ("ring", "binomial", "star"):
+                    got = coll.all_reduce(ctx, alls[rank], topo=topo)
+                    assert (got == total).all(), topo
+                got = coll.broadcast(ctx, alls[rank].copy(), root=1)
+                assert (got == alls[1]).all()
+                st = ctx.coll_stats()
+                assert st["steps"] > 0, st
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
     """Concurrent consumers of the same (copy, [type]) — the memoized
     reshape cache's create/hit race — plus write-back version bumps that
@@ -217,6 +272,14 @@ def main():
                        env={"PTC_MCA_comm_eager_limit": "0",
                             "PTC_MCA_comm_chunk_size": "2048",
                             "PTC_MCA_comm_inflight": "3",
+                            "PTC_MCA_comm_rails": "2"})
+        # runtime-native collectives over the chunked wire: ptc_coll_*
+        # step deliveries + coll counters + per-op bcast-tree switches,
+        # every topology, sliced contributions riding 2 KiB chunks
+        colocated_coll(workers=4, elems=4096, port=29960 + rep,
+                       env={"PTC_MCA_comm_eager_limit": "0",
+                            "PTC_MCA_comm_chunk_size": "2048",
+                            "PTC_MCA_coll_slice": "4096",
                             "PTC_MCA_comm_rails": "2"})
         # tracing v2 under load: level-2 tracing + flight-recorder RING
         # on a 2-rank job — worker pushes racing the ring's wraparound,

@@ -130,26 +130,11 @@ def _worker(rank, port, sizes, hops, reps, path, env, q):
         q.put(("err", rank, traceback.format_exc(), None, None))
 
 
-def _fit(points):
-    """Least-squares t = a + b*size over (size, seconds) points.
-    Returns the harness's two headline quantities: the fixed per-
-    transfer overhead and the per-byte cost."""
-    if len({s for s, _ in points}) < 2:
-        return None
-    xs = np.array([s for s, _ in points], dtype=np.float64)
-    ys = np.array([t for _, t in points], dtype=np.float64)
-    A = np.vstack([np.ones_like(xs), xs]).T
-    (a, b), *_ = np.linalg.lstsq(A, ys, rcond=None)
-    pred = a + b * xs
-    ss_res = float(((ys - pred) ** 2).sum())
-    ss_tot = float(((ys - ys.mean()) ** 2).sum())
-    return {
-        "fixed_overhead_us": round(a * 1e6, 2),
-        "per_byte_ns": round(b * 1e9, 6),
-        "eff_gbps": round(8.0 / b / 1e9, 3) if b > 0 else None,
-        "r2": round(1.0 - ss_res / ss_tot, 4) if ss_tot > 0 else None,
-        "npoints": len(points),
-    }
+# the fit lives in parsec_tpu/comm/economics.py now: the topology
+# selector consumes exactly the model this harness publishes, so the
+# two can never diverge (and ROADMAP item 5's per-link-class routing
+# reuses the same loader)
+from parsec_tpu.comm.economics import fit_points as _fit  # noqa: E402
 
 
 def run_path(path, sizes, hops, reps, port, extra_env=None):
