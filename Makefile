@@ -68,10 +68,20 @@ bench-collective: $(LIB)
 
 # Tracing-overhead ladder (bench.py --trace --json): per-task cost at
 # trace levels 0/1/2 and the flight-recorder ring vs unbounded buffers
-# at level 1 (the PR2 one-transaction-per-task contract), with host
-# provenance.  No TPU needed.
+# at level 1 (the PR2 one-transaction-per-task contract), plus the
+# always-on metrics on/off cost at level 0, with host provenance.
+# No TPU needed.
 bench-trace: $(LIB)
 	python bench.py --trace --json BENCH_trace.json
 
+# Bench-trajectory regression guard (the CI gate): compares the working
+# tree's BENCH_*.json against the committed copies with per-metric
+# tolerances (dispatch p50, stream overlap_fraction, trace ring ratio
+# and level-0 cost, coll ratios, device stall reduction), honoring each
+# file's recorded `oversubscribed` flag.  Run the bench suite first,
+# then this; exit 1 = a guarded metric regressed.
+bench-check:
+	python tools/bench_check.py
+
 .PHONY: all clean tsan bench-comm bench-dispatch bench-device \
-	bench-stream bench-collective bench-trace
+	bench-stream bench-collective bench-trace bench-check

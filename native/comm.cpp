@@ -130,6 +130,12 @@ enum {
   MSG_PUT_CHUNK = 14, /* chunked rendezvous payload range:
                         [u64 cookie][u64 offset][u64 total][u64 clen]
                         [bytes] — the pipelined answer to a ranged GET */
+  MSG_METRICS = 15,   /* fence-time metrics merge (control frame, like
+                        PING/PONG — never dirties a fence): rank != 0
+                        sends [i64 rtt_ns][i64 offset_ns] + the
+                        ptc_met_serialize body to rank 0 after each
+                        quiesced fence; rank 0 keeps the latest per
+                        peer for ptc_metrics_snapshot(merged=1) */
 };
 
 /* ACTIVATE payload kinds (reference: short/eager piggy-back vs GET
@@ -312,6 +318,9 @@ struct PendingGet {
    * delivery-time COMM_RECV event carries it, tying the whole
    * rendezvous (GET window included) back to the producer's COMM_SEND */
   uint64_t corr = 0;
+  /* always-on metrics: pull-window start (first GET posted) — the
+   * online comm_wait/coll_wait histogram sample closes at delivery */
+  int64_t t_pull_start = 0;
   /* broadcast-relay rendezvous: once the pull resolves, deliver locally
    * AND re-root — re-register the payload and forward to these children
    * along `topo` (reference: re-rooted bcast data movement,
@@ -577,7 +586,7 @@ static void comm_post_msg(CommEngine *ce, uint32_t rank, OutMsg &&msg,
   bool is_ctl = msg.hdr.size() > 4 &&
                 (msg.hdr[4] == MSG_FENCE || msg.hdr[4] == MSG_TD ||
                  msg.hdr[4] == MSG_FINI || msg.hdr[4] == MSG_PING ||
-                 msg.hdr[4] == MSG_PONG);
+                 msg.hdr[4] == MSG_PONG || msg.hdr[4] == MSG_METRICS);
   if (!is_ctl) {
     /* activity ticks before the transport enqueues: a fence snapshot
      * must never see the queued frame but miss the count (the transport
@@ -722,6 +731,7 @@ static void send_rendezvous_pull(CommEngine *ce, uint32_t from,
   uint64_t cookie;
   pg.src_rank = from;
   pg.src_handle = src_handle;
+  pg.t_pull_start = ptc_now_ns();
   bool can_pull =
       ce->ctx->dp_can_pull.load(std::memory_order_relaxed) != 0;
   bool chunk = ce->chunk_size > 0 && plen > (uint64_t)ce->chunk_size &&
@@ -1764,6 +1774,23 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
                           const uint8_t *payload, uint64_t plen,
                           uint64_t real_len, uint64_t cookie) {
   ptc_context *ctx = ce->ctx;
+  /* always-on metrics: the whole pull window (GET posted -> payload
+   * materialized) is the online comm-wait signal; deliveries whose
+   * first target is a ptc_coll_* class classify as coll_wait — the
+   * live counterpart of the critpath coll_wait/comm_wait split */
+  if (pg.t_pull_start > 0 &&
+      ctx->metrics_on.load(std::memory_order_relaxed)) {
+    int kind = PTC_MET_COMM_WAIT;
+    if (pg.targets_bytes.size() >= 8) {
+      uint32_t nb;
+      int32_t cid;
+      std::memcpy(&nb, pg.targets_bytes.data(), 4);
+      std::memcpy(&cid, pg.targets_bytes.data() + 4, 4);
+      if (nb > 0 && coll_class(find_tp(ctx, pg.tp_id), cid))
+        kind = PTC_MET_COLL_WAIT;
+    }
+    ptc_met_record(ctx, -1, kind, -1, ptc_now_ns() - pg.t_pull_start);
+  }
   int64_t device_uid = 0;
   if (pk == PK_DEVICE && ctx->dp_deliver)
     device_uid = ctx->dp_deliver(ctx->dp_user, payload, (int64_t)plen,
@@ -2001,7 +2028,7 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   ptc_context *ctx = ce->ctx;
   ce->msgs_recv.fetch_add(1, std::memory_order_relaxed);
   if (type != MSG_FENCE && type != MSG_TD && type != MSG_FINI &&
-      type != MSG_PING && type != MSG_PONG)
+      type != MSG_PING && type != MSG_PONG && type != MSG_METRICS)
     ce->app_recv.fetch_add(1, std::memory_order_relaxed);
   switch (type) {
   case MSG_ACTIVATE:
@@ -2063,6 +2090,14 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
       if (from < ce->fin_seen.size()) ce->fin_seen[from] = 1;
     }
     ce->fence_cv.notify_all();
+    break;
+  }
+  case MSG_METRICS: { /* fence-time metrics merge (rank 0 keeps latest) */
+    Reader r{body, body + len};
+    int64_t rtt = r.i64();
+    int64_t offset = r.i64();
+    if (r.ok)
+      ptc_met_absorb(ctx, from, rtt, offset, r.p, (size_t)(r.end - r.p));
     break;
   }
   case MSG_PING: { /* RTT probe: echo the body back + our clock sample */
@@ -3411,6 +3446,27 @@ int32_t ptc_comm_fence(ptc_context_t *ctx) {
         std::fprintf(stderr,
                      "ptc [comm]: fence quiesced at round %llu\n",
                      (unsigned long long)gen);
+      /* rank-wide metrics merge: ship this rank's histogram snapshot to
+       * rank 0 on the quiesced fence (a control frame, like the clock
+       * probes riding the same wave — it can never dirty a fence).  The
+       * frame carries the clock-sync RTT so rank 0's watchdog can flag
+       * slow-rank outliers without another round trip. */
+      if (ce->myrank != 0) {
+        std::vector<uint8_t> f = frame_begin(MSG_METRICS);
+        Writer w{f};
+        int64_t rtt;
+        {
+          std::lock_guard<ptc_mutex> g(ce->lock);
+          rtt = ce->clock_best_rtt;
+        }
+        w.i64(rtt);
+        w.i64(ce->clock_offset_ns.load(std::memory_order_relaxed));
+        std::vector<uint8_t> body;
+        ptc_met_serialize(ctx, body);
+        w.raw(body.data(), body.size());
+        frame_finish(f);
+        comm_post(ce, 0, std::move(f));
+      }
       return 0;
     }
   }

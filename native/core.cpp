@@ -203,6 +203,7 @@ ptc_context::~ptc_context() {
   }
   for (auto *q : dev_queues) delete q;
   for (auto *p : prof) delete p;
+  for (auto *m : met_workers) delete m;
   for (auto *c : worker_executed) delete c;
   for (auto *c : worker_cpu) delete c;
   for (auto *c : worker_bypass) delete c;
@@ -2031,6 +2032,189 @@ void ptc_flight_autodump(ptc_context *ctx, const char *reason) {
                  path, reason);
 }
 
+/* ---- always-on runtime metrics (log2-bucket latency histograms) ----
+ * Reference role: the PINS counter modules + aggregator_visu's live
+ * counter streaming, made native so the serving stack gets p50/p99
+ * without tracing on.  Recording is lock-free (per-worker histograms,
+ * relaxed atomics); interning and snapshotting take met_lock, both off
+ * the hot path. */
+
+int32_t ptc_met_intern(ptc_context *ctx, const std::string &name) {
+  if (name.empty()) return -1;
+  std::lock_guard<std::mutex> g(ctx->met_lock);
+  auto it = ctx->met_ids.find(name);
+  if (it != ctx->met_ids.end()) return it->second;
+  if ((int32_t)ctx->met_names.size() >= PTC_MET_MAX_CLASSES) return -1;
+  int32_t mid = (int32_t)ctx->met_names.size();
+  ctx->met_names.push_back(name);
+  ctx->met_ids.emplace(name, mid);
+  return mid;
+}
+
+MetWorker *ptc_met_worker(ptc_context *ctx, int worker) {
+  size_t i = (worker < 0 || worker >= ctx->nb_workers)
+                 ? (size_t)ctx->nb_workers
+                 : (size_t)worker;
+  return ctx->met_workers[i];
+}
+
+/* get-or-create the per-class EXEC histogram (CAS install: losers free) */
+static MetHist *met_exec_hist(MetWorker *mw, int32_t mid) {
+  std::atomic<MetHist *> &slot = mw->exec[(size_t)mid];
+  MetHist *h = slot.load(std::memory_order_acquire);
+  if (!h) {
+    MetHist *nh = new MetHist();
+    if (slot.compare_exchange_strong(h, nh, std::memory_order_acq_rel))
+      h = nh;
+    else
+      delete nh;
+  }
+  return h;
+}
+
+static void met_record_mw(MetWorker *mw, int kind, int32_t mid, int64_t ns) {
+  if (kind == PTC_MET_EXEC && mid >= 0 && mid < PTC_MET_MAX_CLASSES)
+    met_exec_hist(mw, mid)->record(ns);
+  else if (kind >= 0 && kind < PTC_MET_NKINDS)
+    mw->kind[kind].record(ns);
+}
+
+void ptc_met_record(ptc_context *ctx, int worker, int kind, int32_t mid,
+                    int64_t ns) {
+  if (!ctx->metrics_on.load(std::memory_order_relaxed)) return;
+  met_record_mw(ptc_met_worker(ctx, worker), kind, mid, ns);
+}
+
+/* release-sampling stride -> power-of-two mask (stride rounds UP, so
+ * the realized sampling rate never exceeds the requested one) */
+static int32_t met_pow2_mask(int32_t n) {
+  if (n <= 1) return 0;
+  int32_t p = 1;
+  while (p < n && p < (1 << 30)) p <<= 1;
+  return p - 1;
+}
+
+/* one aggregated record: (kind, mid) summed across workers */
+namespace {
+struct MetAggRec {
+  int32_t kind;
+  int32_t mid; /* -1 = no class / unnamed overflow */
+  int64_t count = 0, sum = 0;
+  std::vector<int64_t> b;
+  MetAggRec(int32_t k, int32_t m)
+      : kind(k), mid(m), b((size_t)PTC_MET_BUCKETS, 0) {}
+};
+
+static void met_fold_hist(MetAggRec &r, const MetHist &h) {
+  r.count += h.count.load(std::memory_order_relaxed);
+  r.sum += h.sum.load(std::memory_order_relaxed);
+  for (int i = 0; i < PTC_MET_BUCKETS; i++)
+    r.b[(size_t)i] += h.b[i].load(std::memory_order_relaxed);
+}
+
+/* local per-worker histograms -> aggregated records (count > 0 only) */
+static void met_aggregate_local(ptc_context *ctx,
+                                std::vector<MetAggRec> &out) {
+  for (int32_t mid = 0; mid < PTC_MET_MAX_CLASSES; mid++) {
+    MetAggRec r(PTC_MET_EXEC, mid);
+    for (MetWorker *mw : ctx->met_workers) {
+      MetHist *h = mw->exec[(size_t)mid].load(std::memory_order_acquire);
+      if (h) met_fold_hist(r, *h);
+    }
+    if (r.count > 0) out.push_back(std::move(r));
+  }
+  for (int kind = 0; kind < PTC_MET_NKINDS; kind++) {
+    MetAggRec r((int32_t)kind, -1);
+    for (MetWorker *mw : ctx->met_workers)
+      met_fold_hist(r, mw->kind[kind]);
+    if (r.count > 0) out.push_back(std::move(r));
+  }
+}
+
+/* tiny native-endian byte writer/reader for the MSG_METRICS body (the
+ * comm layer's Writer/Reader are file-local to comm.cpp) */
+template <typename T>
+static void met_put(std::vector<uint8_t> &v, T x) {
+  const uint8_t *p = (const uint8_t *)&x;
+  v.insert(v.end(), p, p + sizeof(T));
+}
+template <typename T>
+static bool met_get(const uint8_t *&p, const uint8_t *end, T &x) {
+  if ((size_t)(end - p) < sizeof(T)) return false;
+  std::memcpy(&x, p, sizeof(T));
+  p += sizeof(T);
+  return true;
+}
+} // namespace
+
+/* wire body: [u32 nrec] then per record [u8 kind][u16 nlen][name bytes]
+ * [i64 count][i64 sum][u16 npairs][(u16 bucket, i64 count)*] — buckets
+ * ship sparse (real workloads touch a handful of octaves). */
+void ptc_met_serialize(ptc_context *ctx, std::vector<uint8_t> &out) {
+  std::vector<MetAggRec> recs;
+  std::vector<std::string> names;
+  {
+    std::lock_guard<std::mutex> g(ctx->met_lock);
+    met_aggregate_local(ctx, recs);
+    names = ctx->met_names;
+  }
+  met_put<uint32_t>(out, (uint32_t)recs.size());
+  for (const MetAggRec &r : recs) {
+    std::string name;
+    if (r.kind == PTC_MET_EXEC && r.mid >= 0 &&
+        (size_t)r.mid < names.size())
+      name = names[(size_t)r.mid];
+    met_put<uint8_t>(out, (uint8_t)r.kind);
+    met_put<uint16_t>(out, (uint16_t)name.size());
+    out.insert(out.end(), name.begin(), name.end());
+    met_put<int64_t>(out, r.count);
+    met_put<int64_t>(out, r.sum);
+    uint16_t npairs = 0;
+    for (int i = 0; i < PTC_MET_BUCKETS; i++)
+      if (r.b[(size_t)i]) npairs++;
+    met_put<uint16_t>(out, npairs);
+    for (int i = 0; i < PTC_MET_BUCKETS; i++)
+      if (r.b[(size_t)i]) {
+        met_put<uint16_t>(out, (uint16_t)i);
+        met_put<int64_t>(out, r.b[(size_t)i]);
+      }
+  }
+}
+
+void ptc_met_absorb(ptc_context *ctx, uint32_t from, int64_t rtt_ns,
+                    int64_t offset_ns, const uint8_t *body, size_t len) {
+  const uint8_t *p = body, *end = body + len;
+  uint32_t nrec = 0;
+  if (!met_get(p, end, nrec) || nrec > 4096) return;
+  MetRemote rem;
+  rem.rtt_ns = rtt_ns;
+  rem.offset_ns = offset_ns;
+  rem.recs.reserve(nrec);
+  for (uint32_t i = 0; i < nrec; i++) {
+    MetRemote::Rec rec;
+    uint8_t kind;
+    uint16_t nlen, npairs;
+    if (!met_get(p, end, kind) || !met_get(p, end, nlen)) return;
+    if ((size_t)(end - p) < nlen) return;
+    rec.kind = kind;
+    rec.name.assign((const char *)p, nlen);
+    p += nlen;
+    if (!met_get(p, end, rec.count) || !met_get(p, end, rec.sum) ||
+        !met_get(p, end, npairs))
+      return;
+    rec.pairs.reserve(npairs);
+    for (uint16_t j = 0; j < npairs; j++) {
+      uint16_t idx;
+      int64_t c;
+      if (!met_get(p, end, idx) || !met_get(p, end, c)) return;
+      if (idx < PTC_MET_BUCKETS) rec.pairs.emplace_back((int32_t)idx, c);
+    }
+    rem.recs.push_back(std::move(rec));
+  }
+  std::lock_guard<std::mutex> g(ctx->met_lock);
+  ctx->met_peers[from] = std::move(rem);
+}
+
 /* ---- paired-event trace (reference: parsec/profiling.c + the PINS hook
  * points of parsec/mca/pins/pins.h:26-54; format doc at PROF_WORDS).    */
 /* PINS: synchronous instrumentation callback chain at the event points
@@ -2219,9 +2403,27 @@ static void complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   /* RELEASE spans are level-2 trace events: level 1 (the dispatch
    * bench's lean setting) pays two locked pushes per task, not four.
    * PINS sinks still see them at any level (mask-gated). */
+  /* always-on metrics: release latency is 1-in-N SAMPLED (met_rel_mask)
+   * — the full clock pair on every task would cost ~20 ns on the noop
+   * dispatch path, which the level-0 <5% overhead contract forbids; the
+   * steady-state cost is one relaxed fetch_add on the worker's own line */
+  int64_t r0 = 0;
+  MetWorker *mw = nullptr;
+  if (ctx->metrics_on.load(std::memory_order_relaxed)) {
+    mw = ptc_met_worker(ctx, worker);
+    int32_t mask = ctx->met_rel_mask.load(std::memory_order_relaxed);
+    /* load+store, not fetch_add: the tick is a sampling phase, not a
+     * count — a lost increment when two external-slot writers collide
+     * only shifts which task gets sampled, and the RMW's lock prefix
+     * is the single biggest cost in the level-0 metrics path */
+    int64_t tick = mw->rel_tick.load(std::memory_order_relaxed);
+    mw->rel_tick.store(tick + 1, std::memory_order_relaxed);
+    if ((tick & mask) == 0) r0 = ptc_now_ns();
+  }
   prof_event(ctx, worker, PROF_KEY_RELEASE, 0, t, /*min_level=*/2);
   release_deps(ctx, worker, t);
   prof_event(ctx, worker, PROF_KEY_RELEASE, 1, t, /*min_level=*/2);
+  if (r0) mw->kind[PTC_MET_RELEASE].record(ptc_now_ns() - r0);
   for (size_t f = 0; f < tc.flows.size(); f++)
     if (t->data[f]) copy_release(ctx, t->data[f]);
   task_free(ctx, t);
@@ -2283,9 +2485,26 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
     break;
   case PTC_BODY_CB: {
     BodyCb &cb = ctx->body_cbs[(size_t)dx->body_arg];
+    /* DTD bodies share one interned class ("dtd"); same inflight-slot
+     * protocol as the PTG path so the watchdog sees them too */
+    bool met = ctx->metrics_on.load(std::memory_order_relaxed);
+    MetWorker *mw = nullptr;
+    int64_t m0 = 0;
+    if (met) {
+      mw = ptc_met_worker(ctx, worker);
+      m0 = ptc_now_ns();
+      mw->cur_mid.store(ctx->met_dtd_mid, std::memory_order_relaxed);
+      mw->cur_begin.store(m0, std::memory_order_relaxed);
+    }
     prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
     rc = cb.fn(cb.user, t);
     prof_event(ctx, worker, PROF_KEY_EXEC, 1, t);
+    if (met) {
+      mw->cur_begin.store(0, std::memory_order_relaxed);
+      mw->cur_mid.store(-1, std::memory_order_relaxed);
+      met_record_mw(mw, PTC_MET_EXEC, ctx->met_dtd_mid,
+                    ptc_now_ns() - m0);
+    }
     break;
   }
   case PTC_BODY_DEVICE: {
@@ -2426,9 +2645,29 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
       break;
     case PTC_BODY_CB: {
       BodyCb &cb = ctx->body_cbs[(size_t)ch.body_arg];
+      /* always-on metrics: bracket the body with its own clock pair
+       * (~10 ns each, trivial against a real body) and publish the
+       * inflight slot the watchdog's stuck-task scan reads.  Noop
+       * chores stay unmetered — their "duration" is the dispatch
+       * path itself, which the level-0 overhead contract protects. */
+      bool met = ctx->metrics_on.load(std::memory_order_relaxed);
+      MetWorker *mw = nullptr;
+      int64_t m0 = 0;
+      if (met) {
+        mw = ptc_met_worker(ctx, worker);
+        m0 = ptc_now_ns();
+        mw->cur_mid.store(tc.metric_id, std::memory_order_relaxed);
+        mw->cur_begin.store(m0, std::memory_order_relaxed);
+      }
       prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
       rc = cb.fn(cb.user, t);
       prof_event(ctx, worker, PROF_KEY_EXEC, 1, t);
+      if (met) {
+        mw->cur_begin.store(0, std::memory_order_relaxed);
+        mw->cur_mid.store(-1, std::memory_order_relaxed);
+        met_record_mw(mw, PTC_MET_EXEC, tc.metric_id,
+                      ptc_now_ns() - m0);
+      }
       break;
     }
     case PTC_BODY_DEVICE: {
@@ -2908,6 +3147,17 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
     ctx->worker_bypass.push_back(new std::atomic<int64_t>(0));
     ctx->task_mags.push_back(new ptc_context::TaskMag());
   }
+  /* always-on metrics: one histogram set per worker + the shared
+   * external slot (comm thread, device managers, main thread) */
+  for (int i = 0; i < nb_workers + 1; i++)
+    ctx->met_workers.push_back(new MetWorker());
+  ctx->met_dtd_mid = ptc_met_intern(ctx, "dtd");
+  if (const char *e = std::getenv("PTC_MCA_runtime_metrics"))
+    ctx->metrics_on.store(!(*e == '0' && e[1] == '\0'),
+                          std::memory_order_relaxed);
+  if (const char *e = std::getenv("PTC_MCA_runtime_metrics_relsample"))
+    ctx->met_rel_mask.store(met_pow2_mask((int32_t)std::atoi(e)),
+                            std::memory_order_relaxed);
   if (const char *e = std::getenv("PTC_MCA_deptable_dense_max"))
     ctx->dense_max_slots = std::atoll(e);
   /* flight recorder: bound per-worker trace buffers (overwrite-oldest)
@@ -2962,11 +3212,147 @@ void ptc_coll_stats(ptc_context_t *ctx, int64_t *out6) {
   out6[5] = 0;
 }
 
+/* ---- always-on metrics ABI (ptc_metrics; see MetHist above) ---- */
+
+void ptc_metrics_enable(ptc_context_t *ctx, int32_t on) {
+  ctx->metrics_on.store(on != 0, std::memory_order_relaxed);
+}
+
+int32_t ptc_metrics_enabled(ptc_context_t *ctx) {
+  return ctx->metrics_on.load(std::memory_order_relaxed) ? 1 : 0;
+}
+
+void ptc_metrics_set_release_sample(ptc_context_t *ctx, int32_t n) {
+  ctx->met_rel_mask.store(met_pow2_mask(n), std::memory_order_relaxed);
+}
+
+/* external producers (device layer h2d stall, Python embeddings) feed
+ * the same histograms the native span-close paths use */
+void ptc_metrics_record(ptc_context_t *ctx, int32_t kind, int32_t mid,
+                        int64_t ns) {
+  ptc_met_record(ctx, -1, (int)kind, mid, ns);
+}
+
+int32_t ptc_metrics_intern(ptc_context_t *ctx, const char *name) {
+  return ptc_met_intern(ctx, name ? name : "");
+}
+
+int32_t ptc_metrics_nclasses(ptc_context_t *ctx) {
+  std::lock_guard<std::mutex> g(ctx->met_lock);
+  return (int32_t)ctx->met_names.size();
+}
+
+/* copy-out (interned std::string data moves when the registry grows) */
+int32_t ptc_metrics_class_name(ptc_context_t *ctx, int32_t mid, char *out,
+                               int32_t cap) {
+  std::lock_guard<std::mutex> g(ctx->met_lock);
+  if (mid < 0 || (size_t)mid >= ctx->met_names.size() || cap <= 0)
+    return -1;
+  const std::string &s = ctx->met_names[(size_t)mid];
+  int32_t n = (int32_t)std::min<size_t>(s.size(), (size_t)cap - 1);
+  std::memcpy(out, s.data(), (size_t)n);
+  out[n] = 0;
+  return n;
+}
+
+/* bucket-scheme constants for the Python decoder:
+ * [nkinds, max_classes, buckets, subbits] */
+void ptc_metrics_layout(int64_t *out4) {
+  out4[0] = PTC_MET_NKINDS;
+  out4[1] = PTC_MET_MAX_CLASSES;
+  out4[2] = PTC_MET_BUCKETS;
+  out4[3] = PTC_MET_SUBBITS;
+}
+
+/* Flat histogram dump: per record [kind, mid, count, sum, b0..b<N-1>]
+ * (stride 4 + buckets; records with count == 0 are omitted).  merged=1
+ * folds in the latest fence-time peer snapshots (rank 0) — peer class
+ * names intern into this rank's registry so mids stay meaningful. */
+int64_t ptc_metrics_snapshot(ptc_context_t *ctx, int64_t *out, int64_t cap,
+                             int32_t merged) {
+  std::vector<MetAggRec> recs;
+  std::map<uint32_t, MetRemote> peers;
+  {
+    std::lock_guard<std::mutex> g(ctx->met_lock);
+    met_aggregate_local(ctx, recs);
+    if (merged) peers = ctx->met_peers;
+  }
+  if (merged && !peers.empty()) {
+    for (auto &kv : peers)
+      for (auto &rr : kv.second.recs) {
+        int32_t mid = -1;
+        if (rr.kind == PTC_MET_EXEC && !rr.name.empty())
+          mid = ptc_met_intern(ctx, rr.name);
+        MetAggRec *r = nullptr;
+        for (auto &cand : recs)
+          if (cand.kind == rr.kind && cand.mid == mid) {
+            r = &cand;
+            break;
+          }
+        if (!r) {
+          recs.emplace_back(rr.kind, mid);
+          r = &recs.back();
+        }
+        r->count += rr.count;
+        r->sum += rr.sum;
+        for (auto &pr : rr.pairs) r->b[(size_t)pr.first] += pr.second;
+      }
+  }
+  const int64_t stride = 4 + PTC_MET_BUCKETS;
+  int64_t n = 0;
+  for (auto &r : recs) {
+    if (n + stride > cap) break;
+    out[n] = r.kind;
+    out[n + 1] = r.mid;
+    out[n + 2] = r.count;
+    out[n + 3] = r.sum;
+    for (int i = 0; i < PTC_MET_BUCKETS; i++)
+      out[n + 4 + i] = r.b[(size_t)i];
+    n += stride;
+  }
+  return n;
+}
+
+/* open EXEC bodies: [worker, mid, begin_ns] triplets — the watchdog's
+ * stuck-task scan (deadline = k * p99 of the class's histogram) */
+int64_t ptc_metrics_inflight(ptc_context_t *ctx, int64_t *out, int64_t cap) {
+  int64_t n = 0;
+  for (size_t w = 0; w < ctx->met_workers.size() && n + 3 <= cap; w++) {
+    MetWorker *mw = ctx->met_workers[w];
+    int64_t b = mw->cur_begin.load(std::memory_order_relaxed);
+    if (!b) continue;
+    out[n] = (int64_t)w;
+    out[n + 1] = mw->cur_mid.load(std::memory_order_relaxed);
+    out[n + 2] = b;
+    n += 3;
+  }
+  return n;
+}
+
+/* per-peer fence-time clock-sync RTTs as seen by rank 0 (fed by the
+ * MSG_METRICS frames; all-zero on other ranks / before the first
+ * fence).  The watchdog's slow-rank outlier scan reads this. */
+int32_t ptc_metrics_peer_rtts(ptc_context_t *ctx, int64_t *out,
+                              int32_t cap) {
+  int32_t n = (int32_t)ctx->nodes;
+  if (n > cap) n = cap;
+  for (int32_t i = 0; i < n; i++) out[i] = 0;
+  std::lock_guard<std::mutex> g(ctx->met_lock);
+  for (auto &kv : ctx->met_peers)
+    if ((int32_t)kv.first < n) out[kv.first] = kv.second.rtt_ns;
+  return n;
+}
+
 /* per-worker steal counters (selects served from a victim's queue);
  * 0 for global-queue schedulers.  (Reference observability role:
  * mca/pins/print_steals.) */
 int64_t ptc_worker_steals(ptc_context_t *ctx, int64_t *out, int64_t cap) {
-  if (!ctx->sched) return 0;
+  /* gate on started (acquire), NOT on the plain ctx->sched pointer: a
+   * monitor thread (watchdog tick, Prometheus scrape) can call this
+   * while another thread's add_taskpool is inside the lazy
+   * ptc_context_start — `started` is released only after the scheduler
+   * is fully built, so this acquire pairs with it */
+  if (!ctx->started.load(std::memory_order_acquire)) return 0;
   auto &st = ctx->sched->steals;
   int64_t n = 0;
   for (; n < (int64_t)st.size() && n < cap; n++)
@@ -3014,7 +3400,9 @@ int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   }
   v[6] = ctx->insert_batches.load(std::memory_order_relaxed);
   v[7] = ctx->insert_batched_tasks.load(std::memory_order_relaxed);
-  if (ctx->sched) {
+  /* started-gate, not a plain sched-pointer test: see ptc_worker_steals
+   * (monitor threads race the lazy context start otherwise) */
+  if (ctx->started.load(std::memory_order_acquire)) {
     v[8] = ctx->sched->inject_pushes.load(std::memory_order_relaxed);
     v[9] = ctx->sched->inject_pops.load(std::memory_order_relaxed);
   }
@@ -3106,7 +3494,7 @@ int32_t ptc_context_set_vpmap(ptc_context_t *ctx, const int32_t *vp,
  * when the active scheduler has no explicit order (flat modules). */
 int32_t ptc_sched_victim_order(ptc_context_t *ctx, int32_t worker,
                                int32_t *out, int32_t cap) {
-  if (!ctx || !ctx->sched) return -1;
+  if (!ctx || !ctx->started.load(std::memory_order_acquire)) return -1;
   auto *lhq = dynamic_cast<SchedVictimOrder *>(ctx->sched);
   if (!lhq) return -1;
   return lhq->victim_order(worker, out, cap);
@@ -3331,6 +3719,9 @@ int32_t ptc_tp_add_class(ptc_taskpool_t *tp, const char *name,
    * (parsec_tpu/comm/coll.py names every class it builds this way) */
   tc.is_coll = tc.name.compare(0, 8, "ptc_coll") == 0;
   if (!decode_class(tc, spec, spec_len)) return -1;
+  /* always-on metrics: intern the class name context-wide so same-named
+   * classes across taskpools share one latency histogram */
+  if (tp->ctx) tc.metric_id = ptc_met_intern(tp->ctx, tc.name);
   tp->classes.push_back(std::move(tc));
   return (int32_t)tp->classes.size() - 1;
 }

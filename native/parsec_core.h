@@ -383,6 +383,39 @@ int32_t ptc_flight_dump(ptc_context_t *ctx, const char *path);
  * mode re-arms the /tmp default); call before the traced run */
 void ptc_flight_set_dump_path(ptc_context_t *ctx, const char *prefix);
 
+/* ------------------------------------------------------- ptc_metrics
+ * Always-on, low-overhead latency metrics: per-worker lock-free
+ * log2-bucket histograms (8 linear sub-buckets per octave) accumulated
+ * on the span-close paths — task EXEC duration per class, sampled
+ * release latency, dispatch-time h2d stall, comm/coll rendezvous wait.
+ * Independent of tracing (works at trace level 0); disable with
+ * PTC_MCA_runtime_metrics=0 or ptc_metrics_enable(ctx, 0).             */
+void ptc_metrics_enable(ptc_context_t *ctx, int32_t on);
+int32_t ptc_metrics_enabled(ptc_context_t *ctx);
+/* release-latency sampling stride (1 = every task; default 64) */
+void ptc_metrics_set_release_sample(ptc_context_t *ctx, int32_t n);
+/* feed an external duration into a histogram (device layer h2d stall;
+ * kind = PTC_MET_*, mid = interned class id or -1) */
+void ptc_metrics_record(ptc_context_t *ctx, int32_t kind, int32_t mid,
+                        int64_t ns);
+/* intern / inspect the class-name registry (mid is stable per context) */
+int32_t ptc_metrics_intern(ptc_context_t *ctx, const char *name);
+int32_t ptc_metrics_nclasses(ptc_context_t *ctx);
+int32_t ptc_metrics_class_name(ptc_context_t *ctx, int32_t mid, char *out,
+                               int32_t cap);
+/* decoder constants: [nkinds, max_classes, buckets, subbits] */
+void ptc_metrics_layout(int64_t *out4);
+/* flat dump, per record [kind, mid, count, sum, b0..]; stride =
+ * 4 + buckets.  merged=1 folds the fence-time peer snapshots (rank 0).
+ * Returns words written. */
+int64_t ptc_metrics_snapshot(ptc_context_t *ctx, int64_t *out, int64_t cap,
+                             int32_t merged);
+/* open EXEC bodies: [worker, mid, begin_ns] triplets (watchdog scan) */
+int64_t ptc_metrics_inflight(ptc_context_t *ctx, int64_t *out, int64_t cap);
+/* per-peer fence-time clock-sync RTTs (rank 0; watchdog slow-rank scan) */
+int32_t ptc_metrics_peer_rtts(ptc_context_t *ctx, int64_t *out,
+                              int32_t cap);
+
 /* PINS: pluggable instrumentation callback at the trace event points
  * (reference: parsec/mca/pins/pins.h:26-54).  cb receives the 8-word
  * event record; key_mask selects event keys (bit k = PROF key k).

@@ -319,13 +319,16 @@ struct TaskClass {
    * completions and cross-rank deliveries feed the ptc_coll_stats
    * counters and PROF_KEY_COLL trace spans */
   bool is_coll = false;
+  /* always-on metrics: interned class-name id (context-wide, stable
+   * across taskpools sharing a name); -1 past the interning cap */
+  int32_t metric_id = -1;
   TaskClass() = default;
   TaskClass(const TaskClass &o)
       : name(o.name), id(o.id), locals(o.locals),
         range_locals(o.range_locals), aff_dc(o.aff_dc), aff_idx(o.aff_idx),
         priority(o.priority), flows(o.flows), chores(o.chores),
         has_in_ltype(o.has_in_ltype), has_derived(o.has_derived),
-        is_coll(o.is_coll) {}
+        is_coll(o.is_coll), metric_id(o.metric_id) {}
 };
 
 /* ------------------------------------------------------------------ */
@@ -673,6 +676,86 @@ enum {
 enum { PROF_WORDS = 8 };
 
 /* ------------------------------------------------------------------ */
+/* always-on runtime metrics (reference role: the PINS counter modules
+ * + aggregator_visu live streaming, made native and always-on)         */
+/* ------------------------------------------------------------------ */
+
+/* Metric kinds.  EXEC is per task class (one histogram per interned
+ * class name per worker); the others are one histogram per worker. */
+enum {
+  PTC_MET_EXEC = 0,      /* task body duration (per class)             */
+  PTC_MET_RELEASE = 1,   /* release_deps latency (1-in-N sampled)      */
+  PTC_MET_H2D_STALL = 2, /* dispatch-time synchronous h2d stall        */
+  PTC_MET_COMM_WAIT = 3, /* rendezvous pull window (GET -> delivered)  */
+  PTC_MET_COLL_WAIT = 4, /* same, delivered to a ptc_coll_* class      */
+  PTC_MET_NKINDS = 5,
+};
+
+/* log2 buckets with 3-bit linear sub-buckets (HDR-histogram style):
+ * bucket width is 12.5% of the value, so a quantile read off the bucket
+ * midpoint is within ~6% of exact — the "p50/p99 within 10% of a
+ * level-2 trace" contract.  ns < 8 index exactly; octaves 3..44 get 8
+ * linear sub-buckets each; >= 2^45 ns (~9.7 h) clamps to the last. */
+constexpr int PTC_MET_SUBBITS = 3;
+constexpr int PTC_MET_SUB = 1 << PTC_MET_SUBBITS;
+constexpr int PTC_MET_MAX_OCT = 45;
+constexpr int PTC_MET_BUCKETS =
+    PTC_MET_SUB + (PTC_MET_MAX_OCT - PTC_MET_SUBBITS) * PTC_MET_SUB;
+constexpr int PTC_MET_MAX_CLASSES = 256; /* interned class-name cap */
+
+inline int ptc_met_bucket(int64_t ns) {
+  if (ns < PTC_MET_SUB) return ns < 0 ? 0 : (int)ns;
+  int oct = 63 - __builtin_clzll((uint64_t)ns);
+  if (oct >= PTC_MET_MAX_OCT) return PTC_MET_BUCKETS - 1;
+  int sub = (int)((ns >> (oct - PTC_MET_SUBBITS)) & (PTC_MET_SUB - 1));
+  return PTC_MET_SUB + (oct - PTC_MET_SUBBITS) * PTC_MET_SUB + sub;
+}
+
+/* One histogram.  Writers are per-worker (single-writer in steady
+ * state), readers snapshot cross-thread: relaxed atomics everywhere —
+ * a torn snapshot only misclassifies in-flight events, never corrupts. */
+struct MetHist {
+  std::atomic<int64_t> count{0};
+  std::atomic<int64_t> sum{0};
+  std::atomic<int64_t> b[PTC_MET_BUCKETS] = {};
+  void record(int64_t ns) {
+    count.fetch_add(1, std::memory_order_relaxed);
+    if (ns > 0) sum.fetch_add(ns, std::memory_order_relaxed);
+    b[ptc_met_bucket(ns)].fetch_add(1, std::memory_order_relaxed);
+  }
+};
+
+/* Per-worker metric state.  Index nb_workers is the shared EXTERNAL
+ * slot (comm thread, device managers, main) — multi-writer there, the
+ * relaxed atomics stay correct.  The inflight slot feeds the watchdog:
+ * cur_begin != 0 means an EXEC body is open on this worker since then
+ * (write order: mid then begin at open; begin=0 then mid=-1 at close). */
+struct MetWorker {
+  std::atomic<MetHist *> exec[PTC_MET_MAX_CLASSES] = {};
+  MetHist kind[PTC_MET_NKINDS]; /* kind[EXEC] = unnamed-class overflow */
+  std::atomic<int64_t> cur_begin{0};
+  std::atomic<int32_t> cur_mid{-1};
+  std::atomic<int64_t> rel_tick{0}; /* release-latency sampling */
+  ~MetWorker() {
+    for (auto &h : exec) delete h.load(std::memory_order_relaxed);
+  }
+};
+
+/* latest rank-wide merge input from one peer (rank 0 only; fed by the
+ * fence-time MSG_METRICS frames, guarded by ctx->met_lock) */
+struct MetRemote {
+  int64_t rtt_ns = 0;    /* the peer's clock-sync min RTT to rank 0 */
+  int64_t offset_ns = 0; /* the peer's clock offset estimate */
+  struct Rec {
+    int32_t kind;
+    std::string name; /* empty = no class (non-EXEC kinds) */
+    int64_t count, sum;
+    std::vector<std::pair<int32_t, int64_t>> pairs; /* (bucket, count) */
+  };
+  std::vector<Rec> recs;
+};
+
+/* ------------------------------------------------------------------ */
 /* taskpool + context                                                  */
 /* ------------------------------------------------------------------ */
 
@@ -911,6 +994,22 @@ struct ptc_context {
   std::atomic<int64_t> reshape_conversions{0};
   std::atomic<int64_t> reshape_hits{0};
 
+  /* always-on runtime metrics (PTC_MCA_runtime_metrics, default on):
+   * per-worker log2-bucket latency histograms + the watchdog's inflight
+   * slots.  met_workers has nb_workers + 1 entries; the last is the
+   * shared slot for external threads (comm, device managers, main). */
+  std::atomic<bool> metrics_on{true};
+  /* release 1-in-N sampling as a power-of-two mask (N-1): the per-task
+   * sampler is one fetch_add + AND — an integer modulo here costs more
+   * than everything else in the level-0 metrics path combined */
+  std::atomic<int32_t> met_rel_mask{63};
+  std::vector<MetWorker *> met_workers;
+  std::mutex met_lock; /* interning + peer snapshots */
+  std::vector<std::string> met_names; /* mid -> class name */
+  std::unordered_map<std::string, int32_t> met_ids;
+  int32_t met_dtd_mid = -1; /* all DTD bodies share one class bucket */
+  std::map<uint32_t, MetRemote> met_peers; /* rank 0: latest per peer */
+
   ~ptc_context();
 };
 
@@ -975,6 +1074,21 @@ void ptc_prof_push(ptc_context *ctx, int worker, int64_t key, int64_t phase,
  * events; buffer 0 is shared with worker 0) */
 void ptc_prof_instant(ptc_context *ctx, int64_t key, int64_t class_id,
                       int64_t l0, int64_t l1, int64_t aux);
+
+/* always-on metrics internals (core.cpp).  ptc_met_intern returns -1
+ * past PTC_MET_MAX_CLASSES; ptc_met_record routes (kind, mid) to the
+ * calling worker's histogram set (worker < 0 or >= nb_workers = the
+ * external slot).  serialize/absorb carry the fence-time rank-wide
+ * merge: serialize writes this rank's aggregated records in the wire
+ * form MSG_METRICS ships, absorb parses a peer's frame into
+ * ctx->met_peers (rank 0). */
+int32_t ptc_met_intern(ptc_context *ctx, const std::string &name);
+MetWorker *ptc_met_worker(ptc_context *ctx, int worker);
+void ptc_met_record(ptc_context *ctx, int worker, int kind, int32_t mid,
+                    int64_t ns);
+void ptc_met_serialize(ptc_context *ctx, std::vector<uint8_t> &out);
+void ptc_met_absorb(ptc_context *ctx, uint32_t from, int64_t rtt_ns,
+                    int64_t offset_ns, const uint8_t *body, size_t len);
 
 /* flight-recorder autodump: writes the current (ring) trace contents to
  * "<flight_dump_path>.<rank>.ptt" at most once per context — called on

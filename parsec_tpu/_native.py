@@ -54,6 +54,14 @@ BODY_DEVICE = 2
 # element kinds for cast datatypes (must mirror PTC_ELEM_* in parsec_core.h)
 ELEM_KINDS = {"float32": 0, "float64": 1, "int32": 2, "int64": 3, "uint8": 4}
 
+# always-on metrics kinds (must mirror PTC_MET_* in runtime_internal.h)
+MET_EXEC = 0
+MET_RELEASE = 1
+MET_H2D_STALL = 2
+MET_COMM_WAIT = 3
+MET_COLL_WAIT = 4
+MET_KIND_NAMES = ("exec", "release", "h2d_stall", "comm_wait", "coll_wait")
+
 DEV_CPU = 0
 DEV_TPU = 1
 DEV_RECURSIVE = 2
@@ -265,6 +273,22 @@ _sigs = {
     "ptc_prof_event": (None, [C.c_void_p, C.c_int64, C.c_int64, C.c_int64,
                               C.c_int64, C.c_int64, C.c_int64]),
     "ptc_coll_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
+    "ptc_metrics_enable": (None, [C.c_void_p, C.c_int32]),
+    "ptc_metrics_enabled": (C.c_int32, [C.c_void_p]),
+    "ptc_metrics_set_release_sample": (None, [C.c_void_p, C.c_int32]),
+    "ptc_metrics_record": (None, [C.c_void_p, C.c_int32, C.c_int32,
+                                  C.c_int64]),
+    "ptc_metrics_intern": (C.c_int32, [C.c_void_p, C.c_char_p]),
+    "ptc_metrics_nclasses": (C.c_int32, [C.c_void_p]),
+    "ptc_metrics_class_name": (C.c_int32, [C.c_void_p, C.c_int32,
+                                           C.c_char_p, C.c_int32]),
+    "ptc_metrics_layout": (None, [C.POINTER(C.c_int64)]),
+    "ptc_metrics_snapshot": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64),
+                                         C.c_int64, C.c_int32]),
+    "ptc_metrics_inflight": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64),
+                                         C.c_int64]),
+    "ptc_metrics_peer_rtts": (C.c_int32, [C.c_void_p, C.POINTER(C.c_int64),
+                                          C.c_int32]),
     "ptc_context_get_scheduler": (C.c_char_p, [C.c_void_p]),
     "ptc_comm_init": (C.c_int32, [C.c_void_p, C.c_int32]),
     "ptc_comm_fence": (C.c_int32, [C.c_void_p]),

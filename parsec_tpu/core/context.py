@@ -116,6 +116,22 @@ class Context:
         if _mca.get("runtime.live"):
             from ..profiling.live import enable_from_param as _live
             _live(self, _mca.get("runtime.live"))
+        # always-on metrics (native histograms): re-apply the resolved
+        # MCA value over the native env read (sched_bypass pattern)
+        N.lib.ptc_metrics_enable(
+            self._ptr, 1 if _mca.get("runtime.metrics") else 0)
+        N.lib.ptc_metrics_set_release_sample(
+            self._ptr, _mca.get("runtime.metrics_relsample"))
+        self._metrics_registry = None
+        self._metrics_exporter = None
+        self._watchdog = None
+        if _mca.get("runtime.metrics_port"):
+            from ..profiling.metrics import MetricsExporter
+            self._metrics_exporter = MetricsExporter(
+                self, _mca.get("runtime.metrics_port"))
+        if _mca.get("runtime.watchdog"):
+            from ..profiling.metrics import enable_from_param as _wd
+            self._watchdog = _wd(self, _mca.get("runtime.watchdog"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
         # same-worker ready-task bypass (sched.bypass / PTC_MCA_sched_bypass)
@@ -173,6 +189,13 @@ class Context:
                     chain.uninstall()
                 except Exception:
                     pass
+            for attr in ("_watchdog", "_metrics_exporter"):
+                obj = getattr(self, attr, None)
+                if obj is not None:
+                    try:
+                        obj.stop()
+                    except Exception:
+                        pass
             for mon in list(getattr(self, "_monitors", [])):
                 try:
                     mon.stop()
@@ -460,8 +483,12 @@ class Context:
                      frames/bytes, per-op topology decisions)
           trace   -> tracing health: level, ring/drop state of the
                      flight recorder, and the clock-sync estimate
+          metrics -> always-on histogram subsystem health: enabled
+                     flag, interned class count, watchdog status
         """
         tuning = self.comm_tuning()
+        wd = getattr(self, "_watchdog", None)
+        exp = getattr(self, "_metrics_exporter", None)
         return {
             "sched": self.sched_stats(),
             "device": self.device_stats(),
@@ -480,6 +507,12 @@ class Context:
                 "ring_bytes": self.profile_ring(),
                 "dropped_events": self.profile_dropped(),
                 "clock": self.comm_clock(),
+            },
+            "metrics": {
+                "enabled": self.metrics_enabled,
+                "classes": N.lib.ptc_metrics_nclasses(self._ptr),
+                "exporter_port": exp.port if exp is not None else 0,
+                "watchdog": wd.status() if wd is not None else None,
             },
         }
 
@@ -800,6 +833,58 @@ class Context:
         """Force a fresh clock-sync probe burst (blocks up to ~2s for at
         least one sample); returns total samples accumulated."""
         return N.lib.ptc_comm_clock_sync(self._ptr)
+
+    # ------------------------------------------------------------ metrics
+    @property
+    def metrics_enabled(self) -> bool:
+        """Always-on latency histograms (runtime.metrics, default on):
+        per-class EXEC duration, sampled release latency, h2d stall and
+        comm/coll rendezvous wait, accumulated natively at the span-close
+        paths — independent of the trace level."""
+        return bool(N.lib.ptc_metrics_enabled(self._ptr))
+
+    def metrics_enable(self, on: bool = True):
+        N.lib.ptc_metrics_enable(self._ptr, 1 if on else 0)
+
+    def metrics_histograms(self, merged: bool = False):
+        """Decoded histogram records (profiling.metrics.Hist list);
+        merged=True folds the fence-time peer snapshots (rank 0)."""
+        from ..profiling.metrics import snapshot_histograms
+        return snapshot_histograms(self, merged=merged)
+
+    def metrics_registry(self):
+        """The unified MetricsRegistry over this context (lazy,
+        cached): histogram quantiles + Context.stats() counters, with
+        Prometheus text export."""
+        if getattr(self, "_metrics_registry", None) is None:
+            from ..profiling.metrics import MetricsRegistry
+            self._metrics_registry = MetricsRegistry(self)
+        return self._metrics_registry
+
+    def metrics_inflight(self) -> list:
+        """Open EXEC bodies as (worker, class_name, begin_ns) — the
+        watchdog's stuck-task scan input (begin_ns is on the
+        steady_clock/monotonic epoch)."""
+        cap = 3 * (self.nb_workers + 2)
+        buf = (C.c_int64 * cap)()
+        n = N.lib.ptc_metrics_inflight(self._ptr, buf, cap)
+        name_buf = C.create_string_buffer(256)
+        out = []
+        for i in range(0, n, 3):
+            mid = buf[i + 1]
+            k = N.lib.ptc_metrics_class_name(self._ptr, mid, name_buf, 256)
+            name = name_buf.value.decode() if k > 0 else f"#{mid}"
+            out.append((int(buf[i]), name, int(buf[i + 2])))
+        return out
+
+    def metrics_peer_rtts(self) -> list:
+        """Fence-time clock-sync RTT per peer rank as seen by rank 0
+        (zeros elsewhere / before the first fence) — the watchdog's
+        slow-rank outlier input."""
+        cap = max(1, self.nodes)
+        buf = (C.c_int64 * cap)()
+        n = N.lib.ptc_metrics_peer_rtts(self._ptr, buf, cap)
+        return [int(buf[i]) for i in range(n)]
 
     def profile_take(self) -> np.ndarray:
         """Drain profiling buffers; returns an (n, 8) int64 array of

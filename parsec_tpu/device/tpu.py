@@ -1833,6 +1833,11 @@ class TpuDevice:
         stall = time.perf_counter_ns() - t0
         self._disp_stall_ns += stall
         self.stats["h2d_stall_ns"] += stall
+        # always-on metrics: the stall joins the native h2d_stall
+        # histogram (same span-close instant as the H2D trace event),
+        # so serving dashboards see its p99 without tracing on
+        N.lib.ptc_metrics_record(self.ctx._ptr, N.MET_H2D_STALL, -1,
+                                 stall)
         if self._pf_lane is not None:
             self.stats["prefetch_misses"] += 1
         self._cache_put(uid, ver, darr, host.nbytes)

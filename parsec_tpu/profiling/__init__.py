@@ -27,7 +27,10 @@ from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
                     to_dot)
 from .critpath import critical_path, lost_time
 from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
-                   CommVolume, DeviceActivity, REGISTRY, enable_pins)
+                   CommVolume, DeviceActivity, StragglerLog, REGISTRY,
+                   enable_pins)
+from .metrics import (Hist, MetricsRegistry, MetricsExporter, Watchdog,
+                      snapshot_histograms)
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE", "KEY_H2D",
@@ -35,4 +38,7 @@ __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "to_dot",
            "critical_path", "lost_time",
            "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
-           "CommVolume", "DeviceActivity", "REGISTRY", "enable_pins"]
+           "CommVolume", "DeviceActivity", "StragglerLog", "REGISTRY",
+           "enable_pins",
+           "Hist", "MetricsRegistry", "MetricsExporter", "Watchdog",
+           "snapshot_histograms"]

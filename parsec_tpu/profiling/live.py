@@ -3,14 +3,22 @@
 Reference role: tools/aggregator_visu streams per-rank runtime counters
 out of a running job for live display.  TPU-native translation: a
 sampler thread snapshots the context's counters (worker selected-task
-counts, device queue depth / cache occupancy, comm volumes, rusage) at a
-fixed interval and appends one JSON line per sample to a sink — a file
-any dashboard, `tail -f`, or pandas can consume live.  Enable per
-process with `PTC_MCA_runtime_live=<interval_s>` or programmatically:
+counts, device queue depth / cache occupancy, comm volumes, rusage,
+and the always-on latency histograms' per-class p50/p99) at a fixed
+interval and appends one JSON line per sample to a sink — a file any
+dashboard, `tail -f`, or pandas can consume live.  Enable per process
+with `PTC_MCA_runtime_live=<interval_s>` or programmatically:
 
     mon = LiveMonitor(ctx, path="/tmp/ptc_live_{rank}.jsonl", interval=1.0)
     ... run taskpools ...
-    mon.stop()   # or it stops with the context
+    mon.latest()  # newest sample dict (None before the first)
+    mon.stop()    # or it stops with the context
+
+The sink is SIZE-CAPPED (runtime.live_max_bytes, default 64 MiB): when
+it grows past the cap it rotates to `<path>.1` (one generation kept),
+so a long serving run cannot grow /tmp unboundedly.  Watchdog
+detections are written into the same stream via `emit()` — one file
+carries both the periodic samples and the structured incident events.
 
 The sink path is formatted with the context's rank at FIRST SAMPLE (not
 construction), so the env-installed monitor picks up set_rank() done by
@@ -21,6 +29,7 @@ sinks.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -29,14 +38,20 @@ from typing import Optional
 
 class LiveMonitor:
     def __init__(self, ctx, path: str = "/tmp/ptc_live_{rank}.jsonl",
-                 interval: float = 1.0):
+                 interval: float = 1.0,
+                 max_bytes: Optional[int] = None):
+        from ..utils import params as _mca
         self.ctx = ctx
         self._path_tmpl = path
         self.path: Optional[str] = None  # resolved at first sample
         self.interval = float(interval)
+        self.max_bytes = (_mca.get("runtime.live_max_bytes")
+                          if max_bytes is None else int(max_bytes))
         self._stop = threading.Event()
         self._t0 = time.time()
         self._fh = None
+        self._written = 0  # bytes in the current sink generation
+        self._last: Optional[dict] = None
         self._write_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="ptc-live-monitor")
@@ -65,10 +80,47 @@ class LiveMonitor:
                 self._fh.close()
                 self._fh = None
 
-    def _ensure_sink(self):
+    def latest(self) -> Optional[dict]:
+        """The newest sample record (None before the first sample) —
+        the programmatic accessor dashboards-in-process use instead of
+        re-parsing their own JSONL sink."""
+        return self._last
+
+    def emit(self, rec: dict):
+        """Append an arbitrary record to the sink (thread-safe).  The
+        watchdog routes its structured detection events here so one
+        stream carries samples AND incidents."""
+        with self._write_lock:
+            self._write_locked(rec)
+
+    def _ensure_sink_locked(self):
         if self._fh is None:
             self.path = self._path_tmpl.format(rank=self.ctx.myrank)
             self._fh = open(self.path, "a", buffering=1)
+            try:
+                self._written = os.fstat(self._fh.fileno()).st_size
+            except OSError:
+                self._written = 0
+
+    def _write_locked(self, rec: dict):
+        self._ensure_sink_locked()
+        line = json.dumps(rec) + "\n"
+        # size-capped rotation: never let one generation exceed the cap
+        # (checked BEFORE the write, so a line lands whole in exactly
+        # one generation — the rotation-boundary contract the test pins)
+        if self.max_bytes > 0 and self._fh is not None and \
+                self._written + len(line) > self.max_bytes and \
+                self._written > 0:
+            self._fh.close()
+            self._fh = None
+            try:
+                os.replace(self.path, self.path + ".1")
+            except OSError as e:
+                sys.stderr.write(f"ptc-live: rotation failed ({e!r}); "
+                                 "continuing in place\n")
+            self._ensure_sink_locked()
+        self._fh.write(line)
+        self._written += len(line)
 
     def _sample(self):
         ctx = self.ctx
@@ -104,12 +156,34 @@ class LiveMonitor:
             rec["stream"] = {k: ss[k] for k in
                              ("sessions", "parked_gets",
                               "overlap_fraction")}
+        # always-on latency quantiles (PR7): per-class exec p50/p99 +
+        # the per-kind p99s — the continuous-serving signal the offline
+        # trace can't give.  Compact form: [count, p50_ns, p99_ns].
+        if ctx.metrics_enabled:
+            try:
+                from . import metrics as _m
+                lat = {}
+                kinds = {}
+                for h in _m.snapshot_histograms(ctx):
+                    row = [h.count, round(h.quantile(0.5)),
+                           round(h.quantile(0.99))]
+                    if h.kind == 0 and h.name:  # MET_EXEC
+                        lat[h.name] = row
+                    elif h.kind != 0:
+                        kinds[h.kind_name] = row
+                if lat:
+                    rec["latency"] = lat
+                if kinds:
+                    rec["latency_kinds"] = kinds
+            except Exception:
+                pass  # histograms are best-effort in a live sample
+            rec["trace_dropped"] = ctx.profile_dropped()
         ru = ctx.rusage()
         rec["maxrss_kb"] = ru["maxrss_kb"]
         rec["utime_s"] = ru["utime_s"]
+        self._last = rec
         with self._write_lock:
-            self._ensure_sink()
-            self._fh.write(json.dumps(rec) + "\n")
+            self._write_locked(rec)
 
     def _loop(self):
         warned = False

@@ -239,6 +239,50 @@ class DeviceActivity(PinsModule):
                     self.stream_bytes += l0
 
 
+class StragglerLog(PinsModule):
+    """Top-k slowest task executions (class, locals, worker, duration) —
+    the drill-down companion to the always-on latency histograms: the
+    histogram says a class's p99 moved, this module says WHICH task
+    instances sat in the tail.  Bounded memory (a k-entry leaderboard),
+    so it can stay installed on long serving runs."""
+
+    name = "straggler_log"
+    mask = 1 << KEY_EXEC
+
+    def __init__(self, k: int = 16):
+        self.k = int(k)
+        self._open: Dict[tuple, int] = {}
+        self.slowest: List[tuple] = []  # (dur_ns, class_id, l0, l1, worker)
+        self._floor = 0  # admission threshold once the board is full
+        self._lock = threading.Lock()  # see TaskCounter
+
+    def on_event(self, key, phase, class_id, l0, l1, worker, aux, t_ns):
+        sig = (worker, class_id, l0, l1)
+        with self._lock:
+            if phase == 0:
+                self._open[sig] = t_ns
+                return
+            t0 = self._open.pop(sig, None)
+            if t0 is None:
+                return
+            d = t_ns - t0
+            if len(self.slowest) >= self.k and d <= self._floor:
+                return
+            self.slowest.append((d, class_id, l0, l1, worker))
+            self.slowest.sort(reverse=True)
+            del self.slowest[self.k:]
+            self._floor = self.slowest[-1][0] \
+                if len(self.slowest) >= self.k else 0
+
+    def report(self, class_names: Optional[Dict[int, str]] = None) -> str:
+        with self._lock:
+            rows = list(self.slowest)
+        return "\n".join(
+            f"{(class_names or {}).get(cid, f'class{cid}')}({l0},{l1}) "
+            f"worker={w} {d / 1e6:.3f} ms"
+            for d, cid, l0, l1, w in rows)
+
+
 REGISTRY: Dict[str, Type[PinsModule]] = {
     TaskCounter.name: TaskCounter,
     TaskProfiler.name: TaskProfiler,
@@ -246,6 +290,7 @@ REGISTRY: Dict[str, Type[PinsModule]] = {
     PrintSteals.name: PrintSteals,
     HwCounters.name: HwCounters,
     DeviceActivity.name: DeviceActivity,
+    StragglerLog.name: StragglerLog,
 }
 
 

@@ -198,6 +198,45 @@ register("runtime.pins", "", str,
          "comma-separated PINS instrumentation modules to install at init "
          "(reference: --mca pins <list>, parsec/mca/pins/pins.h); "
          "names from parsec_tpu.profiling.pins.REGISTRY")
+register("runtime.metrics", True, bool,
+         "always-on latency metrics: per-worker lock-free log2-bucket "
+         "histograms (task EXEC per class, sampled release latency, h2d "
+         "stall, comm/coll rendezvous wait) accumulated at the native "
+         "span-close paths, independent of the trace level.  Read via "
+         "Context.metrics_registry() / the Prometheus endpoint; 0 "
+         "disables recording entirely")
+register("runtime.metrics_relsample", 64, int,
+         "release-latency sampling stride, rounded UP to a power of two "
+         "(the sampler is one fetch_add + mask on the dispatch path): "
+         "1-in-N tasks pay the release clock pair (1 = every task; the "
+         "default keeps the level-0 noop dispatch path inside its <5% "
+         "overhead contract)")
+register("runtime.metrics_port", 0, int,
+         "Prometheus scrape endpoint port (127.0.0.1): GET /metrics = "
+         "exposition text, /stats.json = raw counters, /healthz = "
+         "watchdog status.  0 = no endpoint; a fixed port is per-rank "
+         "(SPMD ranks on one host each need their own)")
+register("runtime.watchdog", "", str,
+         "health watchdog interval in seconds (empty/0 = off): a "
+         "monitor thread detects stuck tasks (EXEC open past the "
+         "per-class adaptive deadline k*p99), starved workers, "
+         "rendezvous pulls not advancing, and slow ranks (fence-time "
+         "clock-sync RTT outliers, rank 0).  Each detection emits a "
+         "structured event and triggers a flight-recorder dump when "
+         "tracing is on")
+register("runtime.watchdog_k", 8.0, float,
+         "stuck-task deadline multiplier: a body is stuck when its open "
+         "time exceeds max(k * p99(class), watchdog_floor_s)")
+register("runtime.watchdog_floor_s", 30.0, float,
+         "stuck-task deadline floor in seconds: bodies with thin "
+         "histograms (cold classes, first jax compiles) are never "
+         "flagged before this — the tier-1-suite no-false-positive "
+         "guard")
+register("runtime.live_max_bytes", 64 * 1024 * 1024, int,
+         "LiveMonitor JSONL sink rotation threshold: when the sink "
+         "exceeds this many bytes it rotates to <path>.1 (one "
+         "generation kept) so long serving runs cannot grow /tmp "
+         "unboundedly; <= 0 disables rotation")
 register("comm.base_port", 29650, int, "TCP rendezvous base port")
 register("comm.bcast_topo", "star", str,
          "activation broadcast topology: star|chain|binomial "

@@ -65,15 +65,22 @@ class FaultInjector:
                  incarnation, the chore stays enabled (HOOK_RETURN_NEXT)
       "error"    raise InjectedFault: the body errors, the runtime aborts
                  the taskpool and waiters observe the failure
+      "delay"    the body SLEEPS delay_s before running normally — the
+                 stuck-task shape (a wedged accelerator call, a lost
+                 lock) the health watchdog's adaptive k*p99 deadline
+                 exists to catch.  The task still completes correctly,
+                 so recovery assertions can run on the final result.
     at_invocation: fire on the k-th call of the wrapped body (0-based);
                    None = fire on every call.
     """
 
     def __init__(self, mode: str = "disable",
-                 at_invocation: Optional[int] = None):
-        assert mode in ("disable", "next", "error"), mode
+                 at_invocation: Optional[int] = None,
+                 delay_s: float = 0.0):
+        assert mode in ("disable", "next", "error", "delay"), mode
         self.mode = mode
         self.at_invocation = at_invocation
+        self.delay_s = float(delay_s)
         self.calls = 0
         self.injected = 0
         self.executed = 0
@@ -98,6 +105,10 @@ class FaultInjector:
                     return HOOK_DISABLE
                 if self.mode == "next":
                     return HOOK_NEXT
+                if self.mode == "delay":
+                    import time
+                    time.sleep(self.delay_s)
+                    return fn(view)
                 raise InjectedFault("injected body failure")
             return fn(view)
         return wrapped

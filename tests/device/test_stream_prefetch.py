@@ -7,6 +7,8 @@ tests/comm/test_stream.py; these tests pin the device-layer seams —
 dp_deliver waking the prefetch lane instead of leaving it to poll, and
 the writeback-lane slicer's evidence counters.
 """
+import os
+
 import numpy as np
 
 from tests.comm import _workers
@@ -56,8 +58,10 @@ def test_prefetch_wake_event_exists_and_counts():
 
 
 def test_unified_stats_schema_single_rank():
-    """Context.stats() merges sched/device/comm counters under one
-    namespaced dict with a stable schema even when comm is off."""
+    """Golden schema for the unified Context.stats() / metrics-registry
+    namespaces (keys + types): exporter consumers get a stability
+    contract.  Extended across PRs — PR 7 adds the `metrics` namespace
+    and the registry's histogram/counter key sets."""
     import parsec_tpu as pt
     from parsec_tpu.device import TpuDevice
 
@@ -65,7 +69,8 @@ def test_unified_stats_schema_single_rank():
         dev = TpuDevice(ctx)
         try:
             s = ctx.stats()
-            assert set(s) == {"sched", "device", "comm", "coll", "trace"}
+            assert set(s) == {"sched", "device", "comm", "coll", "trace",
+                              "metrics"}
             for k in ("level", "ring_bytes", "dropped_events", "clock"):
                 assert k in s["trace"], k
             assert "bypass_hits" in s["sched"]
@@ -87,12 +92,40 @@ def test_unified_stats_schema_single_rank():
                       "wire_ns", "reaps", "rails", "stream_enabled",
                       "overlap_fraction"):
                 assert k in comm["stream"], k
+            # PR 7: always-on metrics namespace (keys + types)
+            met = s["metrics"]
+            assert set(met) == {"enabled", "classes", "exporter_port",
+                                "watchdog"}
+            assert isinstance(met["enabled"], bool)
+            assert isinstance(met["classes"], int)
+            assert isinstance(met["exporter_port"], int)
+            # None exactly when the env didn't arm it (the suite also
+            # runs under PTC_MCA_runtime_watchdog as the
+            # no-false-positive soak — the schema must hold there too)
+            wd_armed = bool(os.environ.get("PTC_MCA_runtime_watchdog"))
+            assert (met["watchdog"] is None) == (not wd_armed)
+            # metrics-registry namespaces: histogram kinds fixed; the
+            # flattened counter set covers every stats() leaf consumers
+            # scrape (spot-pin the cross-namespace ones)
+            reg = ctx.metrics_registry()
+            snap = reg.snapshot()
+            assert set(snap) == {"t", "rank", "merged", "histograms",
+                                 "counters"}
+            assert set(snap["histograms"]) == {
+                "exec", "release", "h2d_stall", "comm_wait", "coll_wait"}
+            counters = snap["counters"]
+            for k in ("ptc_sched_bypass_hits", "ptc_coll_steps",
+                      "ptc_trace_dropped_events", "ptc_comm_stream_reaps",
+                      "ptc_device_overlap_ratio", "ptc_metrics_enabled"):
+                assert k in counters, k
+                assert isinstance(counters[k], (int, float)), k
             # every counter is JSON-serializable (the export's purpose)
             import json
             sd = dict(s)
             sd["device"] = {k: v for k, v in s["device"].items()
                             if k != "devices"}
             json.dumps(sd)
+            json.dumps(snap)
             # a device result flows into the merged snapshot
             a = ctx.data(1, np.zeros(4, dtype=np.float32))
             assert a is not None

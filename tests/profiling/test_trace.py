@@ -207,9 +207,13 @@ def test_device_dispatch_spans(monkeypatch):
         tp = build_potrf(ctx, A, dev=dev)
         tp.run()
         tp.wait()
-        dev.flush()
-        tr = take_trace(ctx, class_names=["POTRF", "TRSM", "SYRK", "GEMM"])
+        # stop BEFORE draining: tp.wait() returns at task completion,
+        # which the manager signals before it pushes the DEVICE span's
+        # end event — stop() joins the manager thread, so the drain
+        # below can never catch a begin with no end (unpaired spans are
+        # dropped by the pairing pass and the test would flake empty)
         dev.stop()
+        tr = take_trace(ctx, class_names=["POTRF", "TRSM", "SYRK", "GEMM"])
     df = tr.to_pandas()  # paired spans: one row per begin/end pair
     dd = df[df["key"] == KEY_DEVICE]
     assert len(dd) > 0, df
@@ -257,9 +261,12 @@ def test_device_wave_span_deterministic(monkeypatch):
         assert ctx.device_queue_depth(dev.qid) == nb
         dev.start()
         tp.wait()
-        dev.flush()
-        tr = take_trace(ctx, class_names=["T"])
+        # stop() joins the manager thread before the drain — see
+        # test_device_wave_spans: take_trace racing the manager's
+        # DEVICE-span end push drops the unpaired begin and the test
+        # flakes with an empty frame
         dev.stop()
+        tr = take_trace(ctx, class_names=["T"])
     np.testing.assert_allclose(arr, np.ones((nb, 4), dtype=np.float32))
     df = tr.to_pandas()
     dd = df[df["key"] == KEY_DEVICE]

@@ -1,0 +1,140 @@
+"""Health watchdog (PR 7): stuck-task detection end to end, the
+no-false-positive guard, and the MCA wiring.
+
+Acceptance pin: an injected stuck task (utils/faults.py delay mode)
+produces a structured detection event naming the task class and rank,
+plus a flight-recorder dump — every incident leaves a post-mortem
+artifact.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.profiling.metrics import Watchdog
+from parsec_tpu.utils.faults import FaultInjector
+
+
+def _chain(ctx, tp_name, nb, body):
+    ctx.register_arena(f"t_{tp_name}", 8)
+    tp = pt.Taskpool(ctx, globals={"NB": nb - 1})
+    k = pt.L("k")
+    tc = tp.task_class(tp_name)
+    tc.param("k", 0, pt.G("NB"))
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref(tp_name, k - 1, flow="A")),
+            pt.Out(pt.Ref(tp_name, k + 1, flow="A"),
+                   guard=(k < pt.G("NB"))),
+            arena=f"t_{tp_name}")
+    tc.body(body)
+    return tp
+
+
+def test_stuck_task_detection_and_flight_dump(tmp_path):
+    """The e2e acceptance: a delayed body (the stuck-task shape) trips
+    the k*p99 adaptive deadline; the event names class + rank and a
+    flight-recorder dump lands on disk."""
+    from parsec_tpu.utils import params as _mca
+
+    dump_prefix = str(tmp_path / "wd_flight")
+    _mca.set("runtime.trace_dump", dump_prefix)
+    try:
+        with pt.Context(nb_workers=2) as ctx:
+            # ring tracing on, so the dump has content to preserve
+            ctx.profile_enable(1)
+            ctx.profile_ring(1 << 16)
+            wd = Watchdog(ctx, interval=0.1, k=8.0, floor_s=0.8,
+                          min_count=10)
+            ctx._watchdog = wd
+            # train the class's histogram with fast executions first,
+            # so the adaptive deadline k*p99 is meaningful
+            inj = FaultInjector(mode="delay", at_invocation=60,
+                                delay_s=3.0)
+
+            def body(view):
+                time.sleep(0.002)
+
+            tp = _chain(ctx, "Victim", 80, inj.wrap(body))
+            tp.run()
+            tp.wait()
+            # the delayed task completed; the watchdog must have seen it
+            # open past the deadline while it slept
+            stuck = [e for e in wd.events if e["type"] == "stuck_task"]
+            assert stuck, (wd.events, wd.ticks)
+            ev = stuck[0]
+            assert ev["task_class"] == "Victim", ev
+            assert ev["rank"] == 0
+            assert ev["open_ms"] >= 800, ev
+            assert inj.injected == 1
+            # post-mortem artifact: the flight-recorder dump exists and
+            # is a loadable .ptt
+            path = ev.get("flight_dump")
+            assert path and os.path.exists(path), ev
+            from parsec_tpu.profiling.trace import Trace
+            tr = Trace.load(path)
+            assert len(tr.events) > 0
+            wd.stop()
+    finally:
+        _mca.unset("runtime.trace_dump")
+
+
+def test_no_false_positives_on_healthy_run():
+    """Default-tuned watchdog over a normal run: zero detections (the
+    tier-1-suite-with-watchdog contract in miniature)."""
+    with pt.Context(nb_workers=2) as ctx:
+        wd = Watchdog(ctx, interval=0.05)  # default floor_s=30
+        def body(view):
+            time.sleep(0.001)
+        tp = _chain(ctx, "Healthy", 120, body)
+        tp.run()
+        tp.wait()
+        time.sleep(0.2)  # a few idle ticks over the drained context
+        assert wd.events == [], wd.events
+        assert wd.ticks > 0
+        wd.stop()
+
+
+def test_watchdog_via_mca_param(monkeypatch):
+    """PTC_MCA_runtime_watchdog=<secs> installs the watchdog at Context
+    init and surfaces its status through the unified stats()."""
+    monkeypatch.setenv("PTC_MCA_runtime_watchdog", "0.25")
+    with pt.Context(nb_workers=1) as ctx:
+        assert ctx._watchdog is not None
+        st = ctx.stats()["metrics"]["watchdog"]
+        assert st["watchdog"] == "on"
+        assert st["interval_s"] == 0.25
+        assert st["detections"] == 0
+
+
+def test_watchdog_event_reaches_live_monitor(tmp_path):
+    """Detections join the LiveMonitor JSONL stream (one file carries
+    samples AND incidents)."""
+    import json
+
+    from parsec_tpu.profiling.live import LiveMonitor
+
+    with pt.Context(nb_workers=1) as ctx:
+        mon = LiveMonitor(ctx, path=str(tmp_path / "live.jsonl"),
+                          interval=30.0)  # no periodic samples mid-test
+        wd = Watchdog(ctx, interval=30.0)  # manual ticks only
+        wd._emit({"type": "stuck_task", "key": "synthetic",
+                  "task_class": "X"}, dump=False)
+        mon.stop()
+        wd.stop()
+        recs = [json.loads(l) for l in
+                open(tmp_path / "live.jsonl").read().splitlines()]
+        evs = [r for r in recs if r.get("event") == "stuck_task"]
+        assert evs and evs[0]["task_class"] == "X"
+
+
+def test_delay_injector_counts():
+    inj = FaultInjector(mode="delay", at_invocation=2, delay_s=0.01)
+    calls = []
+    fn = inj.wrap(lambda v: calls.append(v))
+    for i in range(4):
+        fn(i)
+    assert inj.injected == 1 and inj.executed == 3
+    assert calls == [0, 1, 2, 3]  # delayed call still ran the body

@@ -1,0 +1,149 @@
+"""Bench-trajectory regression guard (tools/bench_check.py): passes on
+an unchanged bench set, fails on a doctored regression, tolerates
+improvement, and honors the recorded `oversubscribed` flag."""
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+def _load_bench_check():
+    spec = importlib.util.spec_from_file_location(
+        "bench_check", os.path.join(os.path.dirname(__file__),
+                                    "..", "..", "tools", "bench_check.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    return bc
+
+
+BASE_TRACE = {
+    "schema": "bench-trace-v1",
+    "ns_per_task": {"0": 235.7, "1": 317.5, "2": 493.5},
+    "overhead_ns_per_task": {"level1": 81.8, "level2": 257.8,
+                             "ring_level1": 67.0},
+    "ring": {"ns_per_task": 302.7, "dropped_events": 38976,
+             "vs_unbounded_level1": 0.953},
+    "oversubscribed": False,
+}
+
+BASE_DEVICE = {
+    "wave_pipeline": {"hit_wave_stall_reduction": 1.0},
+    "out_of_core_gemm": {"correct": True},
+    "oversubscribed": True,
+}
+
+
+def _write(d, fname, doc):
+    with open(os.path.join(d, fname), "w") as f:
+        json.dump(doc, f)
+
+
+@pytest.fixture
+def dirs(tmp_path):
+    base = tmp_path / "base"
+    cur = tmp_path / "cur"
+    base.mkdir()
+    cur.mkdir()
+    _write(str(base), "BENCH_trace.json", BASE_TRACE)
+    _write(str(base), "BENCH_device.json", BASE_DEVICE)
+    return str(base), str(cur)
+
+
+def test_identical_passes(dirs):
+    base, cur = dirs
+    _write(cur, "BENCH_trace.json", BASE_TRACE)
+    _write(cur, "BENCH_device.json", BASE_DEVICE)
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    assert failures == 0, rows
+
+
+def test_doctored_regression_fails(dirs):
+    """The level-0 cost creeping past its 5% gate MUST fail — this is
+    the <1.05-vs-pre-PR acceptance made executable."""
+    base, cur = dirs
+    doc = copy.deepcopy(BASE_TRACE)
+    doc["ns_per_task"]["0"] = 235.7 * 1.2  # +20% level-0 regression
+    _write(cur, "BENCH_trace.json", doc)
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    bad = [r for r in rows if r["verdict"] == "FAIL"]
+    assert failures >= 1
+    assert any(r["metric"] == "ns_per_task.0" for r in bad), rows
+
+
+def test_improvement_passes(dirs):
+    """The gate is one-directional: getting faster never fails."""
+    base, cur = dirs
+    doc = copy.deepcopy(BASE_TRACE)
+    doc["ns_per_task"]["0"] = 150.0
+    doc["ring"]["vs_unbounded_level1"] = 0.90
+    _write(cur, "BENCH_trace.json", doc)
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    assert failures == 0, rows
+
+
+def test_ring_ratio_regression_fails(dirs):
+    base, cur = dirs
+    doc = copy.deepcopy(BASE_TRACE)
+    doc["ring"]["vs_unbounded_level1"] = 1.25
+    _write(cur, "BENCH_trace.json", doc)
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    assert any(r["metric"] == "ring.vs_unbounded_level1" and
+               r["verdict"] == "FAIL" for r in rows), rows
+
+
+def test_oversubscribed_flag_widens_tolerance(dirs):
+    """A timing metric from a flagged run gets slack (x3 by default) —
+    but a regression past the widened gate still fails."""
+    base, cur = dirs
+    # device file is flagged oversubscribed: -30% stall reduction is
+    # inside 3 * 15% slack -> ok
+    doc = copy.deepcopy(BASE_DEVICE)
+    doc["wave_pipeline"]["hit_wave_stall_reduction"] = 0.70
+    _write(cur, "BENCH_device.json", doc)
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    dev = [r for r in rows
+           if r["metric"] == "wave_pipeline.hit_wave_stall_reduction"]
+    assert dev[0]["verdict"] == "ok" and dev[0].get("oversubscribed")
+    # -60% blows even the widened gate
+    doc["wave_pipeline"]["hit_wave_stall_reduction"] = 0.40
+    _write(cur, "BENCH_device.json", doc)
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    dev = [r for r in rows
+           if r["metric"] == "wave_pipeline.hit_wave_stall_reduction"]
+    assert dev[0]["verdict"] == "FAIL"
+
+
+def test_correctness_flag_never_relaxed(dirs):
+    """out_of_core_gemm.correct flipping is a failure even in an
+    oversubscribed file."""
+    base, cur = dirs
+    doc = copy.deepcopy(BASE_DEVICE)
+    doc["out_of_core_gemm"]["correct"] = False
+    _write(cur, "BENCH_device.json", doc)
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    assert any(r["metric"] == "out_of_core_gemm.correct" and
+               r["verdict"] == "FAIL" for r in rows), rows
+
+
+def test_missing_files_skip(dirs):
+    base, cur = dirs  # cur is empty
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(cur, baseline_dir=base)
+    assert failures == 0
+    assert all(r["verdict"] == "skip" for r in rows)
+
+
+def test_repo_state_passes_against_head():
+    """`make bench-check` semantics on the real working tree: the
+    committed BENCH set compared against itself must pass."""
+    bc = _load_bench_check()
+    rows, failures = bc.check_all(bc.REPO)
+    assert failures == 0, [r for r in rows if r["verdict"] == "FAIL"]

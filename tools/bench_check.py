@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Bench-trajectory regression guard (`make bench-check`).
+
+Compares the current BENCH_*.json set against the committed copies and
+fails when a guarded metric regressed past its tolerance — the CI gate
+that keeps the measurement ladder (BASELINE.md) monotone: dispatch p50,
+stream overlap fraction, trace ring ratio and level-0 cost, collective
+ratios, device stall reduction.
+
+Baselines come from `git show <ref>:<file>` (default ref HEAD) or from
+an explicit `--baseline-dir`.  Current values come from the working
+tree (or `--current-dir`).
+
+Oversubscription honesty: the bench suite records an `oversubscribed`
+flag when the run timeshared more threads than cores (bench.py
+host_provenance).  Timing-sensitive metrics from an oversubscribed run
+(current OR baseline) are judged against `--oversub-slack` times the
+tolerance — the number measures context-switch luck, so a tight gate
+would flap — but they are still judged: a 3x regression fails even on a
+1-core box.  Correctness metrics (bit-exactness flags) are never
+relaxed.
+
+Exit 0 = all guarded metrics within tolerance; 1 = regression; files
+missing on either side are skipped with a note (a bench not yet run is
+not a regression).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (file, json.path, direction, rel_tol, timing_sensitive)
+#   direction: "lower" = lower is better, "higher" = higher is better,
+#              "equal" = must match exactly (correctness flags)
+CHECKS = [
+    ("BENCH_dispatch.json", "single_chain.p50_us", "lower", 0.15, True),
+    ("BENCH_dispatch.json", "contended.p50_us", "lower", 0.20, True),
+    ("BENCH_stream.json", "streamed.overlap_fraction", "higher", 0.35,
+     True),
+    ("BENCH_stream.json", "rails2_vs_rails1_throughput", "higher", 0.15,
+     True),
+    ("BENCH_trace.json", "ns_per_task.0", "lower", 0.05, True),
+    ("BENCH_trace.json", "overhead_ns_per_task.level1", "lower", 0.50,
+     True),
+    ("BENCH_trace.json", "ring.vs_unbounded_level1", "lower", 0.10, True),
+    ("BENCH_collective.json", "coll_vs_chain_ratio", "lower", 0.25, True),
+    ("BENCH_collective.json", "gemm_panel.overlap_fraction_gain",
+     "higher", 0.50, True),
+    ("BENCH_device.json", "wave_pipeline.hit_wave_stall_reduction",
+     "higher", 0.15, True),
+    ("BENCH_device.json", "out_of_core_gemm.correct", "equal", 0.0,
+     False),
+]
+
+
+def dig(obj, path):
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def is_oversubscribed(doc) -> bool:
+    """The recorded flag, wherever the suite put it (top level for the
+    stream/trace/device/collective suites; per-section for dispatch)."""
+    if not isinstance(doc, dict):
+        return False
+    if doc.get("oversubscribed"):
+        return True
+    for v in doc.values():
+        if isinstance(v, dict) and v.get("oversubscribed"):
+            return True
+    return False
+
+
+def load_current(fname, current_dir):
+    path = os.path.join(current_dir, fname)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_baseline(fname, baseline_dir, ref):
+    if baseline_dir:
+        path = os.path.join(baseline_dir, fname)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+    try:
+        out = subprocess.run(["git", "show", f"{ref}:{fname}"], cwd=REPO,
+                             capture_output=True, check=True)
+        return json.loads(out.stdout)
+    except (subprocess.CalledProcessError, json.JSONDecodeError, OSError):
+        return None
+
+
+def check_all(current_dir, baseline_dir=None, ref="HEAD",
+              oversub_slack=3.0):
+    """Returns (rows, failures): rows are report dicts per metric."""
+    cur_docs, base_docs = {}, {}
+    rows, failures = [], 0
+    for fname, path, direction, tol, timing in CHECKS:
+        if fname not in cur_docs:
+            cur_docs[fname] = load_current(fname, current_dir)
+            base_docs[fname] = load_baseline(fname, baseline_dir, ref)
+        cur_doc, base_doc = cur_docs[fname], base_docs[fname]
+        row = {"file": fname, "metric": path, "direction": direction,
+               "tol": tol}
+        if cur_doc is None or base_doc is None:
+            row["verdict"] = "skip"
+            row["note"] = ("no current file" if cur_doc is None
+                           else "no baseline")
+            rows.append(row)
+            continue
+        cur, base = dig(cur_doc, path), dig(base_doc, path)
+        row["current"], row["baseline"] = cur, base
+        if cur is None or base is None:
+            row["verdict"] = "skip"
+            row["note"] = "metric missing"
+            rows.append(row)
+            continue
+        if direction == "equal":
+            ok = cur == base
+            row["verdict"] = "ok" if ok else "FAIL"
+            failures += 0 if ok else 1
+            rows.append(row)
+            continue
+        eff_tol = tol
+        oversub = is_oversubscribed(cur_doc) or is_oversubscribed(base_doc)
+        if timing and oversub:
+            eff_tol = tol * oversub_slack
+            row["oversubscribed"] = True
+            row["tol"] = eff_tol
+        try:
+            cur_f, base_f = float(cur), float(base)
+        except (TypeError, ValueError):
+            row["verdict"] = "skip"
+            row["note"] = "non-numeric"
+            rows.append(row)
+            continue
+        if base_f == 0:
+            # regression direction still checkable against an absolute
+            # epsilon of the tolerance itself
+            delta = cur_f - base_f
+            regressed = (delta > eff_tol if direction == "lower"
+                         else delta < -eff_tol)
+            row["delta"] = round(delta, 4)
+        else:
+            rel = (cur_f - base_f) / abs(base_f)
+            regressed = (rel > eff_tol if direction == "lower"
+                         else rel < -eff_tol)
+            row["delta_rel"] = round(rel, 4)
+        row["verdict"] = "FAIL" if regressed else "ok"
+        failures += 1 if regressed else 0
+        rows.append(row)
+    return rows, failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--current-dir", default=REPO,
+                    help="directory holding the fresh BENCH_*.json set")
+    ap.add_argument("--baseline-dir", default=None,
+                    help="directory of baseline copies (default: git)")
+    ap.add_argument("--ref", default="HEAD",
+                    help="git ref for baselines (default HEAD)")
+    ap.add_argument("--oversub-slack", type=float, default=3.0,
+                    help="tolerance multiplier for timing metrics from "
+                         "oversubscribed runs (default 3.0)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    args = ap.parse_args(argv)
+
+    rows, failures = check_all(args.current_dir, args.baseline_dir,
+                               args.ref, args.oversub_slack)
+    if args.json:
+        print(json.dumps({"failures": failures, "checks": rows},
+                         indent=2))
+    else:
+        for r in rows:
+            extra = ""
+            if "delta_rel" in r:
+                extra = f" ({r['delta_rel']:+.1%})"
+            elif "delta" in r:
+                extra = f" ({r['delta']:+g})"
+            if r.get("oversubscribed"):
+                extra += " [oversubscribed: slacked]"
+            if r["verdict"] == "skip":
+                print(f"skip  {r['file']}:{r['metric']} — {r['note']}")
+            else:
+                print(f"{r['verdict']:<5} {r['file']}:{r['metric']} "
+                      f"{r.get('baseline')} -> {r.get('current')}{extra}")
+        print(f"bench-check: {failures} regression(s)"
+              if failures else "bench-check: ok")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

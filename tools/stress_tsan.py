@@ -214,6 +214,90 @@ def colocated_coll(workers: int, elems: int, port: int, env=None) -> None:
                 os.environ[k] = v
 
 
+def metrics_watchdog_coll(workers: int, elems: int, port: int,
+                          env=None) -> None:
+    """PR 7 observability paths under TSan: the lock-free metrics hot
+    path (per-class EXEC records from CB bodies on every worker, h2d/
+    release/comm-wait records), the watchdog thread scanning inflight
+    slots + histograms, the Prometheus scrape endpoint serializing
+    snapshots, and the fence-time MSG_METRICS rank-wide merge — all
+    concurrently with a 2-rank streamed collective over the chunked
+    wire."""
+    import threading
+    import urllib.request
+
+    from parsec_tpu.comm import coll
+    from parsec_tpu.profiling.metrics import (MetricsExporter,
+                                              MetricsRegistry, Watchdog)
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    errs = []
+
+    def rank_prog(rank):
+        try:
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            with ctx:
+                wd = Watchdog(ctx, interval=0.05, floor_s=30.0)
+                exporter = MetricsExporter(ctx, 0) if rank == 0 else None
+                stop_scrape = threading.Event()
+
+                def scrape():
+                    while not stop_scrape.is_set():
+                        try:
+                            urllib.request.urlopen(
+                                f"http://127.0.0.1:{exporter.port}"
+                                "/metrics", timeout=5).read()
+                        except Exception:
+                            pass
+                        stop_scrape.wait(0.02)
+
+                scraper = None
+                if exporter is not None:
+                    scraper = threading.Thread(target=scrape, daemon=True)
+                    scraper.start()
+                alls = [np.arange(elems, dtype=np.float32) + 100.0 * r
+                        for r in range(2)]
+                total = alls[0] + alls[1]
+                for _ in range(3):
+                    got = coll.all_reduce(ctx, alls[rank], topo="ring")
+                    assert (got == total).all()
+                    ctx.comm_fence()  # fires the MSG_METRICS merge
+                reg = MetricsRegistry(ctx)
+                assert reg.prometheus_text(merged=(rank == 0))
+                assert not wd.events, wd.events  # no false positives
+                stop_scrape.set()
+                if scraper is not None:
+                    scraper.join(timeout=10)
+                if exporter is not None:
+                    exporter.stop()
+                wd.stop()
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
     """Concurrent consumers of the same (copy, [type]) — the memoized
     reshape cache's create/hit race — plus write-back version bumps that
@@ -289,6 +373,15 @@ def main():
         colocated_comm(workers=4, nb=48, port=29980 + rep,
                        env={"PTC_MCA_runtime_profile": "1",
                             "PTC_MCA_runtime_trace_ring": "16384"})
+        # always-on metrics + watchdog + Prometheus scrape concurrent
+        # with a streamed 2-rank collective (PR 7): lock-free histogram
+        # records from every worker, inflight-slot scans, snapshot
+        # serialization on the scrape thread, fence-time MSG_METRICS
+        # merge — TSan watches all of it in one address space
+        metrics_watchdog_coll(workers=4, elems=4096, port=30000 + rep,
+                              env={"PTC_MCA_comm_eager_limit": "0",
+                                   "PTC_MCA_comm_chunk_size": "2048",
+                                   "PTC_MCA_comm_rails": "2"})
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")
 
