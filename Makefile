@@ -27,6 +27,41 @@ $(TSAN_LIB): $(SRCS) $(HDRS)
 	$(CXX) -O1 -g -std=c++17 -fPIC -Wall -pthread -fsanitize=thread \
 		-shared -o $@ $(SRCS)
 
+# UndefinedBehaviorSanitizer build + the stress_tsan job set (the same
+# concurrency workloads, here hunting signed overflow / bad shifts /
+# misaligned access in the spec decoder and dep engine).  halt_on_error
+# + no-recover: the first report fails the run.
+UBSAN_LIB := $(BUILD)/libparsec_core_ubsan.so
+
+$(UBSAN_LIB): $(SRCS) $(HDRS)
+	@mkdir -p $(BUILD)
+	$(CXX) -O1 -g -std=c++17 -fPIC -Wall -pthread \
+		-fsanitize=undefined -fno-sanitize-recover=all \
+		-shared -o $@ $(SRCS)
+
+ubsan: $(UBSAN_LIB)
+	PTC_NATIVE_LIB=$(UBSAN_LIB) \
+	LD_PRELOAD=$$($(CXX) -print-file-name=libubsan.so) \
+	UBSAN_OPTIONS="halt_on_error=1 print_stacktrace=1 exitcode=67" \
+	timeout 900 python tools/stress_tsan.py
+
+# Curated clang-tidy pass over the native core (.clang-tidy: bugprone-*
+# + concurrency-* + performance-*).  Gated: containers without
+# clang-tidy skip with a notice instead of failing the check recipe.
+tidy:
+	@if command -v clang-tidy >/dev/null 2>&1; then \
+		clang-tidy --quiet $(SRCS) -- -std=c++17 -pthread; \
+	else \
+		echo "tidy: clang-tidy not installed; skipped" \
+		     "(config committed in .clang-tidy)"; \
+	fi
+
+# Static dataflow verification of every in-tree graph generator
+# (tools/verify_graphs.py -> parsec_tpu/analysis rules V001-V008).
+# Exit 1 = a graph regressed the clean baseline.
+verify-graphs: $(LIB)
+	python tools/verify_graphs.py
+
 # Transfer-economics sweep (tools/testbandwidth.py): eager / rendezvous
 # / PK_DEVICE paths on loopback, fitted fixed-overhead + per-byte cost,
 # BENCH-style JSON.  Runs entirely without a TPU tunnel.
@@ -83,5 +118,10 @@ bench-trace: $(LIB)
 bench-check:
 	python tools/bench_check.py
 
-.PHONY: all clean tsan bench-comm bench-dispatch bench-device \
-	bench-stream bench-collective bench-trace bench-check
+# Default check recipe: bench-trajectory guard + graph hygiene + native
+# lint — regressions in any fail fast.
+check: bench-check verify-graphs tidy
+
+.PHONY: all clean tsan ubsan tidy verify-graphs check bench-comm \
+	bench-dispatch bench-device bench-stream bench-collective \
+	bench-trace bench-check

@@ -1,0 +1,22 @@
+"""Static analysis over PTG/DTD graphs (the parsec_ptgpp sanity-check
+role, SURVEY §L1: the reference compiler rejects dangling flows and
+malformed dep targets before any task runs).
+
+`flowgraph` extracts a symbolic flow graph from compiled task-class
+tables — one extractor shared by the verifier and tools/jdf2dot.py —
+and `verify` runs the V001–V008 rule engine over it, using
+affine/interval reasoning where index expressions allow and bounded
+concrete enumeration of the execution space as the exact fallback.
+`dtdlint` is the insertion-time linter for the dynamic (DTD) path.
+"""
+from .flowgraph import (ConcreteGraph, FlowGraph, extract_flowgraph,
+                        flowgraph_to_dot)
+from .verify import (RULES, Finding, Report, VerifyError, verify_graph,
+                     verify_taskpool)
+from .dtdlint import DtdLintError, DtdLinter
+
+__all__ = [
+    "FlowGraph", "ConcreteGraph", "extract_flowgraph", "flowgraph_to_dot",
+    "Finding", "Report", "RULES", "VerifyError", "verify_graph",
+    "verify_taskpool", "DtdLinter", "DtdLintError",
+]

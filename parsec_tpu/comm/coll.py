@@ -233,7 +233,7 @@ def _plan_reduce(nseg: int, nranks: int, root_of: Callable[[int], int],
 def _tab(values):
     """Freeze a per-event int table behind an OP_CALL expression."""
     t = list(values)
-    return pt.call(lambda locs, g, t=t: t[locs[0]])
+    return pt.call(lambda locs, g, t=t: t[locs[0]], pure=True)
 
 
 def _emit_reduce(ctx, tp, uid: int, plan: _Plan, ns: int, arena: str,
@@ -382,7 +382,8 @@ def _emit_fanout(ctx, tp, uid: int, nseg: int, ns: int, nranks: int,
     rankc = rank_affinity_collection(ctx)
     s, q, sl = pt.L("s"), pt.L("q"), pt.L("sl")
     owner_tab = [owner_of(i) for i in range(nseg)]
-    owner_e = pt.call(lambda locs, g, t=owner_tab: t[locs[0]])
+    owner_e = pt.call(lambda locs, g, t=owner_tab: t[locs[0]],
+                      pure=True)
 
     src = tp.task_class(src_name)
     src.param("s", 0, nseg - 1)
@@ -514,7 +515,8 @@ def all_reduce(ctx, local: np.ndarray, op: str = "sum",
         ctx, tp, uid, plan, ns, arena, OPS[op][0], flat.dtype,
         local_read=lambda cid, seg, s: work[seg, s])
     # wire the final reduce event of each segment into its fan-out src
-    fin = pt.call(lambda locs, g, t=plan.final_of: t[locs[0]])
+    fin = pt.call(lambda locs, g, t=plan.final_of: t[locs[0]],
+                  pure=True)
     sl = pt.L("sl")
     tp.class_by_name(step_name).flows[2].deps.append(
         pt.Out(pt.Ref(f"ptc_coll_{uid}_src", _tab(
@@ -643,7 +645,8 @@ class RefReduce:
                                          override=topo)
             _set_fanout_topo(ctx, ftopo)
             fin = pt.call(
-                lambda locs, g, t=self.plan.final_of: t[locs[0]])
+                lambda locs, g, t=self.plan.final_of: t[locs[0]],
+                pure=True)
             sl = pt.L("sl")
             tp.class_by_name(self.step_name).flows[2].deps.append(
                 pt.Out(pt.Ref(f"ptc_coll_{self.uid}_src",
@@ -665,9 +668,11 @@ class RefReduce:
 
         def g(side):
             return pt.call(lambda l, gl, side=side:
-                           1 if route[cid_of(l, gl)][1] == side else 0)
+                           1 if route[cid_of(l, gl)][1] == side else 0,
+                           pure=True)
 
-        idx = pt.call(lambda l, gl: route[cid_of(l, gl)][0])
+        idx = pt.call(lambda l, gl: route[cid_of(l, gl)][0],
+                      pure=True)
         return [pt.Out(pt.Ref(self.step_name, idx, 0, flow="A"),
                        guard=g(0)),
                 pt.Out(pt.Ref(self.step_name, idx, 0, flow="B"),
@@ -678,7 +683,7 @@ class RefReduce:
         local number `seg_local_index` holds the segment id (e.g. a
         store task adding the combine result into memory)."""
         fin = pt.call(lambda l, g, t=self.plan.final_of:
-                      t[l[seg_local_index]])
+                      t[l[seg_local_index]], pure=True)
         return pt.In(pt.Ref(self.step_name, fin, 0, flow="R"))
 
     def wire_final_consumer(self, tp, cons_class: str, cons_flow: str,

@@ -156,6 +156,10 @@ class Context:
         self.arenas: Dict[str, int] = {}
         self.arena_sizes: Dict[str, int] = {}  # name -> elem bytes
         self.datatypes: Dict[str, int] = {}
+        # name -> wire payload bytes (None when unknowable, e.g. casts
+        # over the whole copy); read by the static verifier's V007
+        # dtype/shape rule to tell true layout mismatches from renames
+        self.datatype_bytes: Dict[str, Optional[int]] = {}
         self._colocated: set = set()  # ranks sharing this accel client
         self._destroyed = False
 
@@ -634,6 +638,7 @@ class Context:
                 f"stride={stride_bytes} (need elem>0, count>0, "
                 "stride>=elem)")
         self.datatypes[name] = did
+        self.datatype_bytes[name] = elem_bytes * count
         return did
 
     def register_datatype_indexed(self, name: str, segments) -> int:
@@ -654,6 +659,7 @@ class Context:
                 f"bad indexed datatype {name!r}: need >=1 segment, "
                 "offsets >= 0, lens > 0")
         self.datatypes[name] = did
+        self.datatype_bytes[name] = sum(int(ln) for _, ln in segments)
         return did
 
     def register_datatype_cast(self, name: str, from_dtype, to_dtype,
@@ -674,6 +680,8 @@ class Context:
         if did < 0:
             raise ValueError(f"bad cast datatype {name!r}")
         self.datatypes[name] = did
+        self.datatype_bytes[name] = (
+            None if count < 0 else count * np.dtype(to_dtype).itemsize)
         return did
 
     def reshape_stats(self):

@@ -153,18 +153,26 @@ class Call(Expr):
     Compiled to an OP_CALL against a context-registered callback — the analog
     of JDF inline `%{ return ...; %}` expressions.  The callable must be pure
     and non-blocking (it runs on worker threads under the GIL).
-    """
 
-    def __init__(self, fn: Callable[..., int]):
+    `pure=True` declares the callable deterministic over (locals,
+    globals) for the life of the taskpool — a frozen lookup table, not
+    a read of state task bodies mutate (the choice pattern).  The
+    native engine treats every OP_CALL conservatively either way; the
+    declaration lets the static verifier (parsec_tpu.analysis)
+    evaluate the expression as binding instead of degrading the dep to
+    a maybe-edge."""
+
+    def __init__(self, fn: Callable[..., int], pure: bool = False):
         self.fn = fn
+        self.pure = pure
 
     def _emit(self, out, ctx):
         cb_id = ctx.register_call(self.fn)
         out += [N.OP_CALL, cb_id]
 
 
-def call(fn: Callable[..., int]) -> Expr:
-    return Call(fn)
+def call(fn: Callable[..., int], pure: bool = False) -> Expr:
+    return Call(fn, pure=pure)
 
 
 class Range:

@@ -12,12 +12,37 @@ the JDF front-end (parsec_tpu/dsl/ptg) produces exactly these objects.
 from __future__ import annotations
 
 import ctypes as C
+import os
+import sys
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from .. import _native as N
 from .expr import Compr, CompileCtx, Expr, ExprLike, Range, compile_expr
+
+# directories whose frames are builder plumbing, not authorship: the
+# source location of a dep/class is the first frame OUTSIDE these (the
+# algos/ops/comm module or user code that called In()/Out()/task_class())
+_PLUMBING_DIRS = (os.path.dirname(os.path.abspath(__file__)),
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), "dsl"))
+
+
+def _srcloc() -> Optional[str]:
+    """file:line of the nearest non-plumbing caller frame (consumed by
+    parsec_tpu.analysis to report findings at their declaration site)."""
+    try:
+        f = sys._getframe(2)
+        while f is not None:
+            fn = f.f_code.co_filename
+            if not any(fn.startswith(d + os.sep) or fn == d
+                       for d in _PLUMBING_DIRS):
+                return f"{os.path.basename(fn)}:{f.f_lineno}"
+            f = f.f_back
+    except Exception:
+        pass
+    return None
 
 ACCESS = {"READ": N.FLOW_READ, "WRITE": N.FLOW_WRITE, "RW": N.FLOW_RW,
           "CTL": N.FLOW_CTL, "R": N.FLOW_READ, "W": N.FLOW_WRITE}
@@ -64,6 +89,7 @@ class _Dep:
         # guard and target expressions may reference the names, bounds may
         # reference earlier iterators
         self.iters = list(iters or [])
+        self.srcloc = _srcloc()
 
 
 def In(target=None, guard: Optional[ExprLike] = None,
@@ -85,6 +111,7 @@ class _Flow:
         self.access = access
         self.deps = list(deps)
         self.arena = arena
+        self.srcloc = _srcloc()
 
 
 class _Chore:
@@ -104,6 +131,7 @@ class TaskClass:
         self.flows: List[_Flow] = []
         self.chores: List[_Chore] = []
         self.id: int = -1  # assigned by Taskpool
+        self.srcloc = _srcloc()
 
     # ---------------------------------------------------------- declaration
     def param(self, name: str, lo: ExprLike, hi: ExprLike,

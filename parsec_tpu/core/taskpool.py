@@ -86,8 +86,32 @@ class Taskpool:
         return self
 
     # ------------------------------------------------------------- running
-    def run(self) -> "Taskpool":
-        """commit + add to context + start (convenience)."""
+    def verify(self, mode="error", max_instances: int = 200_000):
+        """Run the static dataflow verifier (analysis.verify, rules
+        V001-V008) over this pool's task-class tables.  mode="error"
+        (or True) raises VerifyError on error-severity findings;
+        mode="warn" prints the report to stderr instead.  Returns the
+        Report."""
+        import sys
+
+        from ..analysis import VerifyError, verify_taskpool
+        report = verify_taskpool(self, max_instances=max_instances)
+        if report.errors and mode in (True, "error", "raise"):
+            raise VerifyError(report)
+        if report.findings and mode == "warn":
+            print(report.text(), file=sys.stderr)
+        return report
+
+    def run(self, verify=None) -> "Taskpool":
+        """commit + add to context + start (convenience).
+
+        `verify=` opts into the static dataflow verifier at insert
+        time: "error"/True raises VerifyError before anything is
+        scheduled when a V-rule error-severity finding exists (the
+        known findings are silent runtime hangs — see
+        analysis/verify.py); "warn" prints findings and proceeds."""
+        if verify:
+            self.verify(mode=verify)
         self.commit()
         rc = N.lib.ptc_context_add_taskpool(self.ctx._ptr, self._ptr)
         if rc != 0:

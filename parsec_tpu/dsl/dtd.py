@@ -66,23 +66,35 @@ class DtdTile:
     is the rank that executes tasks writing this tile (distributed DTD
     placement; other ranks keep shadow tasks + mirror copies)."""
 
-    __slots__ = ("_ptr", "data", "owner")
+    __slots__ = ("_ptr", "data", "owner", "_lint_finalized")
 
     def __init__(self, ctx: Context, data: Data, owner: int = 0):
         self.data = data
         self.owner = owner
+        self._lint_finalized = False  # set by the DTD linter on destroy
         self._ptr = N.lib.ptc_dtile_new(ctx._ptr, data._ptr)
         if owner:
             N.lib.ptc_dtile_set_owner(self._ptr, owner)
 
 
 class DtdTaskpool:
-    def __init__(self, ctx: Context, window: Optional[int] = None):
+    def __init__(self, ctx: Context, window: Optional[int] = None,
+                 lint=False):
+        """`lint=True` (or "error") turns on the insertion linter
+        (analysis.dtdlint): undeclared access-mode conflicts and
+        use-after-finalize raise DtdLintError at insert time;
+        lint="warn" records findings in `self.linter.findings`
+        without raising."""
         if window is None:
             from ..utils import params as _mca
             window = _mca.get("dtd.window_size")
         self.ctx = ctx
         self.window = window
+        self.linter = None
+        if lint:
+            from ..analysis.dtdlint import DtdLinter
+            self.linter = DtdLinter(
+                mode="warn" if lint == "warn" else "error")
         self.tp = Taskpool(ctx)
         self.tp.set_open(True)
         self.tp.run()  # zero classes; registers with the context
@@ -135,6 +147,10 @@ class DtdTaskpool:
         ranks keep a shadow released by the owner's completion broadcast."""
         if self._closed:
             raise RuntimeError("taskpool already closed")
+        if self.linter is not None:
+            self.linter.on_insert(
+                [(tile, _MODES[mode.upper()] if isinstance(mode, str)
+                  else int(mode)) for tile, mode in args])
         bid = self._body_id(fn)
         t = N.lib.ptc_dtask_begin(self.tp._ptr, N.BODY_CB, bid, priority)
         for tile, mode in args:
@@ -195,10 +211,14 @@ class DtdTaskpool:
                 raise ValueError(
                     f"insert_tasks: too many arguments (max {N.MAX_FLOWS})")
             spec += [N.BODY_CB, self._body_id(fn), prio, rank, len(args)]
+            normed = []
             for tile, mode in args:
                 m = _MODES[mode.upper()] if isinstance(mode, str) \
                     else int(mode)
+                normed.append((tile, m))
                 spec += [tile._ptr, m]
+            if self.linter is not None:
+                self.linter.on_insert(normed)
             pending += 1
             if pending >= batch:
                 flush()
@@ -212,6 +232,10 @@ class DtdTaskpool:
         args, in order)."""
         if self._closed:
             raise RuntimeError("taskpool already closed")
+        if self.linter is not None:
+            self.linter.on_insert(
+                [(tile, _MODES[mode.upper()] if isinstance(mode, str)
+                  else int(mode)) for tile, mode in args])
         # same hazard attach() guards: float64 without jax x64 silently
         # downcasts on device and corrupts the writeback.  DTD device
         # tasks have no host fallback chore, so fail loudly at insert.
@@ -242,10 +266,14 @@ class DtdTaskpool:
     def wait(self):
         """Close the window and wait for every discovered task."""
         self._closed = True
+        if self.linter is not None:
+            self.linter.on_wait()
         self.tp.set_open(False)
         self.tp.wait()
 
     def destroy(self):
+        if self.linter is not None:
+            self.linter.on_destroy()
         for tile in self._tiles.values():
             N.lib.ptc_dtile_destroy(self.ctx._ptr, tile._ptr)
         self._tiles.clear()

@@ -120,13 +120,14 @@ class JdfDepTarget:
 
 class JdfDep:
     def __init__(self, direction, guard, target, alt=None, props=None,
-                 iters=None):
+                 iters=None, pos=-1):
         self.direction = direction  # 0 in, 1 out
         self.guard = guard          # Expr | None
         self.target = target        # JdfDepTarget
         self.alt = alt              # else-branch target
         self.props = props or {}    # [type=.. layout=.. count=.. displ=..]
         self.iters = iters or []    # dep-level bracketed iterators
+        self.pos = pos              # source offset (for verifier locs)
 
 
 class JdfCompr:
@@ -152,9 +153,10 @@ class JdfBody:
 
 
 class JdfTask:
-    def __init__(self, name, params, props=None):
+    def __init__(self, name, params, props=None, pos=-1):
         self.name = name
         self.params = params  # [str]
+        self.pos = pos        # source offset (for verifier locs)
         self.props = props or {}  # class properties [make_key_fn = ...]
         self.locals: List[Tuple[str, object]] = []  # (name, Range|Expr)
         self.affinity: Optional[Tuple[str, list]] = None
@@ -169,6 +171,7 @@ class JdfProgram:
         self.options: Dict[str, str] = {}  # %option lines
         self.globals: List[JdfGlobal] = []
         self.tasks: List[JdfTask] = []
+        self.src = ""  # body-stripped source (token pos -> line)
 
 
 # ------------------------------------------------------------------ parser
@@ -194,7 +197,10 @@ def _extract_bodies(src: str):
 
     def repl(m):
         bodies.append((m.group("props") or "", m.group("code") or "pass"))
-        return f"BODY {len(bodies) - 1}\n"
+        # newline-preserving so token positions keep mapping to the
+        # original source lines (findings/locations stay accurate)
+        return f"BODY {len(bodies) - 1}" + "\n" * max(
+            1, m.group(0).count("\n"))
 
     return _BODY_RE.sub(repl, src), bodies
 
@@ -289,14 +295,15 @@ class _Parser:
 
     # ------------------------------------------------------- task level
     def _parse_task(self) -> JdfTask:
-        name = self.next().val
+        name_tok = self.next()
+        name = name_tok.val
         self.expect("(")
         params = []
         while not self.accept(")"):
             params.append(self.next().val)
             self.accept(",")
         props = self._parse_props() if self.peek().val == "[" else {}
-        task = JdfTask(name, params, props)
+        task = JdfTask(name, params, props, pos=name_tok.pos)
         # locals until ':' (affinity) — every line `id = ...`
         while True:
             t = self.peek()
@@ -394,6 +401,7 @@ class _Parser:
     def _parse_dep(self, direction: int) -> JdfDep:
         guard = None
         alt = None
+        dep_pos = self.peek().pos
         # dep-level bracketed iterators (local indices):
         #   [ i = 0 .. odd ] guard ? target : target
         iters = self._parse_iters() if self._at_iter_bracket() else []
@@ -433,7 +441,8 @@ class _Parser:
                     raise
         # trailing dep properties: [type = X displ_remote = e ...]
         props = self._parse_props() if self.peek().val == "[" else {}
-        return JdfDep(direction, guard, target, alt, props, iters)
+        return JdfDep(direction, guard, target, alt, props, iters,
+                      pos=dep_pos)
 
     def _parse_target(self) -> JdfDepTarget:
         # target-level iterators: `? [ j = 0 .. e .. 2 ] A tA(...)`
@@ -614,7 +623,9 @@ class _PyEscape(E.Expr):
 
 def parse_jdf(src: str) -> JdfProgram:
     stripped, bodies = _extract_bodies(src)
-    return _Parser(_lex(stripped), stripped, bodies).parse()
+    prog = _Parser(_lex(stripped), stripped, bodies).parse()
+    prog.src = stripped
+    return prog
 
 
 def _target_to_builder(t: JdfDepTarget, flow_name: str):
@@ -633,8 +644,10 @@ class JdfTaskpoolBuilder:
     def __init__(self, prog: JdfProgram, ctx, globals: Dict[str, int],
                  dtype=np.uint8, shapes: Optional[Dict] = None,
                  arenas: Optional[Dict[str, str]] = None, dev=None,
-                 late_bound: Optional[List[str]] = None):
+                 late_bound: Optional[List[str]] = None,
+                 filename: Optional[str] = None):
         self.prog = prog
+        self.filename = filename or "<jdf>"
         self.ctx = ctx
         self.late_bound = set(late_bound or [])
         self.dtype = np.dtype(dtype)
@@ -685,8 +698,16 @@ class JdfTaskpoolBuilder:
     _CLASS_PROPS = ("make_key_fn", "startup_fn", "hash_struct",
                     "high_priority")
 
+    def _loc(self, pos: int) -> Optional[str]:
+        """file:line of a source offset (body-stripped source is
+        newline-preserving, so lines match the original)."""
+        if pos < 0:
+            return None
+        return f"{self.filename}:{self.prog.src[:pos].count(chr(10)) + 1}"
+
     def _build_task(self, jt: JdfTask):
         tc = self.tp.task_class(jt.name)
+        tc.srcloc = self._loc(jt.pos) or tc.srcloc
         tc.jdf_props = dict(jt.props)
         for k in jt.props:
             if k not in self._CLASS_PROPS:
@@ -744,16 +765,20 @@ class JdfTaskpoolBuilder:
                             "(Context.register_datatype*)")
                 tgt = _target_to_builder(d.target, fl.name)
                 its = d.iters + d.target.iters  # dep-level outer
+                loc = self._loc(d.pos)
                 if d.alt is not None:
                     alt = _target_to_builder(d.alt, fl.name)
-                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its,
-                                   ltype=lt))
-                    deps.append(mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard),
-                                   dtype=dt, iters=d.iters + d.alt.iters,
-                                   ltype=lt))
+                    built = [mk(tgt, guard=d.guard, dtype=dt, iters=its,
+                                ltype=lt),
+                             mk(alt, guard=E.UnOp(E.N.OP_NOT, d.guard),
+                                dtype=dt, iters=d.iters + d.alt.iters,
+                                ltype=lt)]
                 else:
-                    deps.append(mk(tgt, guard=d.guard, dtype=dt, iters=its,
-                                   ltype=lt))
+                    built = [mk(tgt, guard=d.guard, dtype=dt, iters=its,
+                                ltype=lt)]
+                for b in built:
+                    b.srcloc = loc or b.srcloc
+                deps += built
             tc.flow(fl.name, fl.access, *deps,
                     arena=self.arenas.get(fl.name))
         self._attach_bodies(jt, tc)

@@ -170,3 +170,84 @@ def flash_attention(q, k, v, causal: bool = False,
     out = _flash(fold(q), fold(k), fold(v), causal, scale,
                  block_q, block_k, interpret)
     return jnp.transpose(out.reshape(b, h, l, d), (0, 2, 1, 3))
+
+
+# ------------------------------------------------------------ PTG builder
+def build_flash_attention(ctx, Qc, Kc, Vc, Oc, causal: bool = False,
+                          scale: Optional[float] = None, dev=None,
+                          names=("FAQ", "FAK", "FAV", "FAO")):
+    """Blockwise attention as a PTG taskpool: FATT(q) attends row block
+    q of `Qc` over the full `Kc`/`Vc` into `Oc` — the runtime-task form
+    of this op (one task per query block, fully parallel; block-level
+    causality masks by absolute row), so attention composes with other
+    tile DAGs instead of leaving the runtime for a whole-array XLA
+    call.  The sequence-sharded, KV-rotating variant is
+    algos/ring_attention.py.
+
+    Qc/Oc: (B*L, d) collections tiled (T, d); Kc/Vc: one (L, d) tile
+    each.  Registers the collections under `names`.  With `dev`, the
+    chore runs the fused Pallas kernel (flash_attention); the CPU body
+    is the numpy reference."""
+    import numpy as np
+
+    import parsec_tpu as pt
+
+    assert Qc.mt == Oc.mt and Qc.mb == Oc.mb and Qc.nb == Oc.nb
+    qn, kn, vn, on = names
+    Qc.register(ctx, qn)
+    Kc.register(ctx, kn)
+    Vc.register(ctx, vn)
+    Oc.register(ctx, on)
+    tp = pt.Taskpool(ctx, globals={"NQ": Qc.mt - 1})
+    q = pt.L("q")
+    T, d = Qc.mb, Qc.nb
+    L = Kc.mb
+    sc = (d ** -0.5) if scale is None else scale
+    qshp, kshp = (T, d), (L, d)
+    dt = Qc.dtype
+
+    tc = tp.task_class("FATT")
+    tc.param("q", 0, pt.G("NQ"))
+    tc.affinity(qn, q, 0)
+    tc.flow("Q", "READ", pt.In(pt.Mem(qn, q, 0)))
+    tc.flow("K", "READ", pt.In(pt.Mem(kn, 0, 0)))
+    tc.flow("V", "READ", pt.In(pt.Mem(vn, 0, 0)))
+    tc.flow("O", "RW", pt.In(pt.Mem(on, q, 0)),
+            pt.Out(pt.Mem(on, q, 0)))
+
+    if dev is not None:
+        def k_fatt(qb, kb, vb, _q=None):
+            # [T, d] block through the fused kernel as [1, T, 1, d]
+            o = flash_attention(qb[None, :, None, :],
+                                kb[None, :, None, :],
+                                vb[None, :, None, :],
+                                causal=False, scale=sc)
+            return o[0, :, 0, :]
+
+        if causal:
+            raise ValueError(
+                "build_flash_attention: causal device chores need the "
+                "per-block row offset; use the CPU bodies (dev=None) "
+                "or algos/ring_attention for causal DAG attention")
+        dev.attach(tc, tp, kernel=k_fatt, reads=["Q", "K", "V"],
+                   writes=["O"],
+                   shapes={"Q": qshp, "K": kshp, "V": kshp, "O": qshp},
+                   dtype=dt)
+
+    def body(t):
+        qb = t.data("Q", dt, qshp).astype(np.float32)
+        kb = t.data("K", dt, kshp).astype(np.float32)
+        vb = t.data("V", dt, kshp).astype(np.float32)
+        o = t.data("O", dt, qshp)
+        s = (qb @ kb.T) * sc
+        if causal:
+            off = t.local("q") * T
+            rows = off + np.arange(T)[:, None]
+            s = np.where(rows >= np.arange(L)[None, :], s, -np.inf)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = np.exp(s)
+        p /= p.sum(axis=-1, keepdims=True)
+        o[...] = (p @ vb).astype(dt)
+
+    tc.body(body)
+    return tp

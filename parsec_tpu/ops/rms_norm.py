@@ -92,3 +92,58 @@ def rms_norm(x, w, eps: float = 1e-6, block_rows: int = 128,
                 * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
     out = _rms(x.reshape(n, d), w, eps, block_rows, interpret)
     return out.reshape(*lead, d)
+
+
+# ------------------------------------------------------------ PTG builder
+def build_rms_norm(ctx, Xc, Wc, Oc, eps: float = 1e-6, dev=None,
+                   names=("RNX", "RNW", "RNO")):
+    """Tile-granular RMSNorm as a PTG taskpool: NORM(r) normalizes row
+    tile r of `Xc` against the shared scale tile `Wc` into `Oc` —
+    the runtime-task form of this op (one task per row block, fully
+    parallel), so norm layers compose with other tile DAGs instead of
+    leaving the runtime for a whole-array XLA call.
+
+    Xc/Oc: (R*T, d) collections tiled (T, d); Wc: one (1, d) tile.
+    Registers the collections under `names`.  With `dev`, the chore is
+    the fused Pallas kernel (rms_norm); the CPU body is the numpy
+    reference."""
+    import numpy as np
+
+    import parsec_tpu as pt
+
+    assert Xc.mt == Oc.mt and Xc.mb == Oc.mb and Xc.nb == Oc.nb
+    xn, wn, on = names
+    Xc.register(ctx, xn)
+    Wc.register(ctx, wn)
+    Oc.register(ctx, on)
+    tp = pt.Taskpool(ctx, globals={"R": Xc.mt - 1})
+    r = pt.L("r")
+    shp = (Xc.mb, Xc.nb)
+    wshp = (Wc.mb, Wc.nb)
+    dt = Xc.dtype
+
+    tc = tp.task_class("NORM")
+    tc.param("r", 0, pt.G("R"))
+    tc.affinity(xn, r, 0)
+    tc.flow("X", "READ", pt.In(pt.Mem(xn, r, 0)))
+    tc.flow("W", "READ", pt.In(pt.Mem(wn, 0, 0)))
+    tc.flow("O", "RW", pt.In(pt.Mem(on, r, 0)),
+            pt.Out(pt.Mem(on, r, 0)))
+
+    if dev is not None:
+        def k_norm(x, w):
+            return rms_norm(x, w[0], eps)
+
+        dev.attach(tc, tp, kernel=k_norm, reads=["X", "W"],
+                   writes=["O"],
+                   shapes={"X": shp, "W": wshp, "O": shp}, dtype=dt)
+
+    def body(t):
+        x = t.data("X", dt, shp).astype(np.float32)
+        w = t.data("W", dt, wshp)[0].astype(np.float32)
+        o = t.data("O", dt, shp)
+        ms = np.mean(np.square(x), axis=-1, keepdims=True)
+        o[...] = (x / np.sqrt(ms + eps) * w).astype(dt)
+
+    tc.body(body)
+    return tp

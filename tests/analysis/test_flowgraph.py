@@ -1,0 +1,183 @@
+"""Flow-graph extractor unit tests: VM-exact expression evaluation,
+execution-space enumeration (native domain semantics), interval
+reasoning, and the DOT rendering with findings overlay."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.analysis import (extract_flowgraph, flowgraph_to_dot,
+                                 verify_graph)
+from parsec_tpu.analysis.flowgraph import (ExprCompiler, expr_is_dynamic,
+                                           expr_is_impure, interval_of)
+
+
+@pytest.fixture()
+def ctx():
+    with pt.Context(nb_workers=1) as c:
+        c.register_arena("default", 64)
+        yield c
+
+
+# --------------------------------------------------------- expression VM
+def _ev(e, l=(), gdict=None, names=None):
+    cc = ExprCompiler(gdict or {}, None)
+    return cc.compile(e, names or {})(list(l))
+
+
+def test_eval_c_division_semantics():
+    # C truncates toward zero; Python floors — the evaluator must match
+    # the native VM (native/core.cpp OP_DIV/OP_MOD)
+    a, b = pt.L("a"), pt.L("b")
+    names = {"a": 0, "b": 1}
+    cc = ExprCompiler({}, None)
+    div = cc.compile(a // b, names)
+    mod = cc.compile(a % b, names)
+    assert div([-7, 2]) == -3       # Python floor would say -4
+    assert div([7, -2]) == -3
+    assert mod([-7, 2]) == -1       # Python % would say 1
+    assert div([5, 0]) == 0         # div-by-zero -> 0, not a crash
+    assert mod([5, 0]) == 0
+
+
+def test_eval_select_minmax_shifts():
+    k = pt.L("k")
+    names = {"k": 0}
+    assert _ev(pt.select(k > 2, k * 10, k - 1), [3], names=names) == 30
+    assert _ev(pt.select(k > 2, k * 10, k - 1), [1], names=names) == 0
+    assert _ev(pt.minimum(k, 5), [9], names=names) == 5
+    assert _ev(pt.maximum(k, 5), [9], names=names) == 9
+    assert _ev(pt.shl(1, k), [4], names=names) == 16
+    assert _ev(pt.shr(k, 1), [9], names=names) == 4
+    assert _ev(pt.shl(1, k), [-3], names=names) == 1  # clamp at 0
+
+
+def test_eval_globals_fold_and_call():
+    g = pt.G("NB")
+    seen = []
+
+    def cb(locs, globs):
+        seen.append((list(locs), dict(globs)))
+        return locs[0] + globs["NB"]
+
+    e = pt.call(cb) + g
+    v = _ev(e, [7], gdict={"NB": 5}, names={"k": 0})
+    assert v == 17
+    assert seen[0][0] == [7] and seen[0][1] == {"NB": 5}
+
+
+def test_dynamic_vs_impure_classification():
+    pure = pt.call(lambda l, g: 1, pure=True)
+    imp = pt.call(lambda l, g: 1)
+    assert expr_is_dynamic(pure) and expr_is_dynamic(imp)
+    assert not expr_is_impure(pure)
+    assert expr_is_impure(imp)
+    assert not expr_is_dynamic(pt.L("k") + 1)
+
+
+def test_interval_affine():
+    k, m = pt.L("k"), pt.L("m")
+    names = {"k": 0, "m": 1}
+    ivals = {0: (0, 9), 1: (2, 4)}
+    assert interval_of(k * 2 + m, ivals, names, {}) == (2, 22)
+    assert interval_of(k - m, ivals, names, {}) == (-4, 7)
+    assert interval_of(pt.minimum(k, m), ivals, names, {}) == (0, 4)
+    assert interval_of(pt.select(k > m, k, m), ivals, names, {}) == (0, 9)
+    # escapes leave the affine fragment
+    assert interval_of(pt.call(lambda l, g: 0), ivals, names, {}) is None
+
+
+# -------------------------------------------------------- space + domain
+def _chain_pool(ctx, n=4):
+    tp = pt.Taskpool(ctx, globals={"NB": n - 1})
+    k = pt.L("k")
+    tc = tp.task_class("Chain")
+    tc.param("k", 0, pt.G("NB"))
+    tc.local("twice", k * 2)
+    tc.flow("A", "RW",
+            pt.In(None, guard=(k == 0)),
+            pt.In(pt.Ref("Chain", k - 1, flow="A")),
+            pt.Out(pt.Ref("Chain", k + 1, flow="A"),
+                   guard=(k < pt.G("NB"))),
+            arena="default")
+    tc.body_noop()
+    return tp
+
+
+def test_space_enumeration_and_derived_locals(ctx):
+    fg = extract_flowgraph(_chain_pool(ctx))
+    cm = fg.by_name["Chain"]
+    assert cm.instances([100]) == [(0,), (1,), (2,), (3,)]
+    assert cm.fill_locals((3,)) == [3, 6]
+    assert cm.in_domain((3,)) and not cm.in_domain((4,))
+    assert not cm.in_domain((-1,))
+
+
+def test_triangular_space_dynamic_domain(ctx):
+    tp = pt.Taskpool(ctx, globals={"NT": 3})
+    k, m = pt.L("k"), pt.L("m")
+    tc = tp.task_class("Tri")
+    tc.param("k", 0, pt.G("NT"))
+    tc.param("m", k + 1, pt.G("NT"))
+    tc.body_noop()
+    fg = extract_flowgraph(tp)
+    cm = fg.by_name["Tri"]
+    inst = cm.instances([100])
+    assert len(inst) == 6  # strict upper triangle of 4x4
+    assert cm.in_domain((0, 3)) and not cm.in_domain((2, 2))
+    # interval layer sees the triangular bounds
+    iv = cm.space_intervals()
+    assert iv[0] == (0, 3) and iv[1] == (1, 3)
+
+
+def test_concretize_chain_edges(ctx):
+    fg = extract_flowgraph(_chain_pool(ctx))
+    cg = fg.concretize()
+    assert cg.nb_instances() == 4
+    assert cg.nb_edges == 3
+    node1 = (0, (1,))
+    assert cg.expected[(node1, 0)] == 1
+    assert cg.ncert[(node1, 0)] == 1
+    # head expects nothing (guard-true In(None))
+    assert ((0, (0,)), 0) not in cg.expected
+
+
+def test_bounded_enumeration_refuses_not_truncates(ctx):
+    tp = pt.Taskpool(ctx, globals={"NB": 10_000_000})
+    tc = tp.task_class("Huge")
+    tc.param("k", 0, pt.G("NB"))
+    tc.body_noop()
+    fg = extract_flowgraph(tp)
+    cg = fg.concretize(max_instances=1000)
+    assert cg.bounded
+    assert cg.nb_instances() == 0  # refused, not partially filled
+    assert any("Huge" in n for n in cg.notes)
+
+
+# ----------------------------------------------------------------- DOT
+def test_dot_overlay_marks_findings(ctx):
+    tp = pt.Taskpool(ctx, globals={"N": 2})
+    k = pt.L("k")
+    p = tp.task_class("P")
+    p.param("k", 0, pt.G("N"))
+    p.flow("X", "W", pt.Out(pt.Ref("C", k, flow="X")), arena="default")
+    p.body_noop()
+    c = tp.task_class("C")
+    c.param("k", 0, pt.G("N"))
+    c.flow("X", "READ", pt.In(None))  # never expects the delivery
+    c.body_noop()
+    fg = extract_flowgraph(tp)
+    report, cg = verify_graph(fg)
+    assert any(f.rule == "V006" for f in report.findings)
+    dot = flowgraph_to_dot(cg, report.findings)
+    assert "digraph" in dot
+    assert dot.count("->") >= 3
+    assert "color=red" in dot
+
+
+def test_dot_without_findings_has_no_red(ctx):
+    fg = extract_flowgraph(_chain_pool(ctx))
+    report, cg = verify_graph(fg)
+    assert report.ok()
+    dot = flowgraph_to_dot(cg)
+    assert "color=red" not in dot
+    assert dot.count("->") == 3

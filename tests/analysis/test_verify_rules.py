@@ -1,0 +1,404 @@
+"""Per-rule seeded-bad fixtures: for each of V001-V008 a minimal graph
+that MUST be flagged with the right rule ID and source location, plus
+its clean twin that MUST pass."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.analysis import (VerifyError, extract_flowgraph,
+                                 verify_graph, verify_taskpool)
+from parsec_tpu.dsl.jdf import compile_jdf
+
+
+@pytest.fixture()
+def ctx():
+    with pt.Context(nb_workers=1) as c:
+        buf = np.zeros(256, dtype=np.int64)
+        c.register_linear_collection("mydata", buf, elem_size=8)
+        c.register_arena("default", 64)
+        yield c
+
+
+def _verify_jdf(ctx, src, name, globs=None, **kw):
+    b = compile_jdf(src, ctx, globals=globs or {"N": 4}, dtype=np.int64,
+                    arenas={"A": "default"}, filename=name, **kw)
+    report, _cg = verify_graph(extract_flowgraph(b.tp))
+    return report
+
+
+def _rules(report):
+    return {f.rule for f in report.findings}
+
+
+def _the(report, rule):
+    fs = [f for f in report.findings if f.rule == rule]
+    assert fs, f"expected a {rule} finding, got {report.findings}"
+    return fs[0]
+
+
+# ------------------------------------------------------------------ V001
+BAD_V001 = """
+N [ type="int" ]
+Prod(k)
+k = 0 .. N
+: mydata(k)
+RW A <- mydata(k)
+BODY
+END
+
+Cons(k)
+k = 0 .. N
+: mydata(k)
+READ A <- A Prod(k)
+BODY
+END
+"""
+
+# clean twin: Prod declares the producing OUT edge
+CLEAN_V001 = BAD_V001.replace(
+    "RW A <- mydata(k)\nBODY",
+    "RW A <- mydata(k)\n     -> A Cons(k)\nBODY")
+
+
+def test_v001_dangling_in(ctx):
+    rep = _verify_jdf(ctx, BAD_V001, "v001.jdf")
+    f = _the(rep, "V001")
+    assert f.severity == "error"
+    assert f.cls == "Cons" and f.flow == "A"
+    assert f.loc == "v001.jdf:13"
+    assert f.count == 5  # every instance waits
+
+
+def test_v001_clean_twin(ctx):
+    assert _verify_jdf(ctx, CLEAN_V001, "v001c.jdf").ok()
+
+
+# ------------------------------------------------------------------ V002
+BAD_V002 = """
+N [ type="int" ]
+extern "C" %{
+def choose(k): return 0
+%}
+T(k)
+k = 0 .. N
+: mydata(k)
+RW A <- %{ return choose(k); %} ? A T(k-1) : mydata(k)
+     -> A T(k+1)
+BODY
+END
+"""
+
+# clean twin: the guard is a plain expression the engine prunes exactly
+CLEAN_V002 = BAD_V002.replace("%{ return choose(k); %} ?", "(k > 0) ?")
+
+
+def test_v002_escape_guard_with_mem_fallback(ctx):
+    rep = _verify_jdf(ctx, BAD_V002, "v002.jdf")
+    f = _the(rep, "V002")
+    assert f.severity == "error"
+    assert f.cls == "T" and f.flow == "A"
+    assert f.loc == "v002.jdf:9"
+
+
+def test_v002_clean_twin(ctx):
+    assert _verify_jdf(ctx, CLEAN_V002, "v002c.jdf").ok()
+
+
+# ------------------------------------------------------------------ V003
+BAD_V003 = """
+N [ type="int" ]
+Loop(k)
+k = 0 .. N
+: mydata(k)
+RW A <- (k == 0) ? mydata(k) : A Loop((k + 1) % (N + 1))
+     -> A Loop((k + N) % (N + 1))
+BODY
+END
+"""
+
+CLEAN_V003 = """
+N [ type="int" ]
+Loop(k)
+k = 0 .. N
+: mydata(k)
+RW A <- (k == 0) ? mydata(k) : A Loop(k - 1)
+     -> (k < N) ? A Loop(k + 1)
+BODY
+END
+"""
+
+
+def test_v003_cycle(ctx):
+    rep = _verify_jdf(ctx, BAD_V003, "v003.jdf")
+    f = _the(rep, "V003")
+    assert f.severity == "error"
+    assert f.cls == "Loop"
+    assert f.loc == "v003.jdf:3"
+    assert f.count == 5  # the whole chain is one SCC
+
+
+def test_v003_clean_twin(ctx):
+    assert _verify_jdf(ctx, CLEAN_V003, "v003c.jdf").ok()
+
+
+# ------------------------------------------------------------------ V004
+BAD_V004 = """
+N [ type="int" ]
+Src(k)
+k = 0 .. N
+: mydata(k)
+RW A <- mydata(k)
+     -> A Dst(k + N + 5)
+BODY
+END
+
+Dst(k)
+k = 0 .. N
+: mydata(k)
+READ A <- A Src(k - N - 5)
+BODY
+END
+"""
+
+CLEAN_V004 = BAD_V004.replace("-> A Dst(k + N + 5)", "-> A Dst(k)") \
+                     .replace("<- A Src(k - N - 5)", "<- A Src(k)")
+
+
+def test_v004_target_outside_space(ctx):
+    rep = _verify_jdf(ctx, BAD_V004, "v004.jdf")
+    f = _the(rep, "V004")
+    assert f.severity == "error"
+    assert f.cls == "Src" and f.flow == "A"
+    assert f.loc == "v004.jdf:7"
+    # the consumer side is NOT a V001: an out-of-domain IN source is an
+    # inactive alternative by engine semantics (the boundary idiom), so
+    # Dst simply reads nothing — only the dead OUT edge is the bug
+    assert _rules(rep) == {"V004"}
+
+
+def test_v004_clean_twin(ctx):
+    assert _verify_jdf(ctx, CLEAN_V004, "v004c.jdf").ok()
+
+
+def test_v004_symbolic_when_enumeration_bounded(ctx):
+    # same dead edge, but the space is past the enumeration budget:
+    # the affine/interval layer must still prove it dead
+    b = compile_jdf(BAD_V004, ctx, globals={"N": 499}, dtype=np.int64,
+                    arenas={"A": "default"}, filename="v004big.jdf")
+    report, cg = verify_graph(extract_flowgraph(b.tp), max_instances=100)
+    assert cg.bounded
+    f = _the(report, "V004")
+    assert f.loc == "v004big.jdf:7"
+    assert any("skipped" in n for n in report.notes)
+
+
+# ------------------------------------------------------------------ V005
+BAD_V005 = """
+N [ type="int" ]
+W1(z)
+z = 0 .. 0
+: mydata(0)
+RW A <- mydata(0)
+     -> mydata(0)
+BODY
+END
+
+W2(z)
+z = 0 .. 0
+: mydata(0)
+RW A <- mydata(1)
+     -> mydata(0)
+BODY
+END
+"""
+
+# clean twin: W2 is ordered after W1 through a dataflow edge
+CLEAN_V005 = """
+N [ type="int" ]
+W1(z)
+z = 0 .. 0
+: mydata(0)
+RW A <- mydata(0)
+     -> A W2(0)
+BODY
+END
+
+W2(z)
+z = 0 .. 0
+: mydata(0)
+RW A <- A W1(0)
+     -> mydata(0)
+BODY
+END
+"""
+
+
+def test_v005_write_write_race(ctx):
+    rep = _verify_jdf(ctx, BAD_V005, "v005.jdf")
+    f = _the(rep, "V005")
+    assert f.severity == "error"
+    assert "mydata[0]" in f.message
+    assert f.loc in ("v005.jdf:7", "v005.jdf:15")
+
+
+def test_v005_clean_twin(ctx):
+    assert _verify_jdf(ctx, CLEAN_V005, "v005c.jdf").ok()
+
+
+# ------------------------------------------------------------------ V006
+BAD_V006 = """
+N [ type="int" ]
+Prod(k)
+k = 0 .. N
+: mydata(k)
+RW A <- mydata(k)
+     -> A Cons(k)
+BODY
+END
+
+Cons(k)
+k = 0 .. N
+: mydata(k)
+READ A <- mydata(k)
+BODY
+END
+"""
+
+CLEAN_V006 = BAD_V006.replace("READ A <- mydata(k)", "READ A <- A Prod(k)")
+
+
+def test_v006_never_read_out(ctx):
+    rep = _verify_jdf(ctx, BAD_V006, "v006.jdf")
+    f = _the(rep, "V006")
+    assert f.severity == "warning"
+    assert f.cls == "Prod" and f.flow == "A"
+    assert f.loc == "v006.jdf:7"
+    assert f.count == 5
+
+
+def test_v006_clean_twin(ctx):
+    assert _verify_jdf(ctx, CLEAN_V006, "v006c.jdf").ok()
+
+
+# ------------------------------------------------------------------ V007
+BAD_V007 = """
+N [ type="int" ]
+Prod(k)
+k = 0 .. N
+: mydata(k)
+RW A <- mydata(k)
+     -> A Cons(k) [type = wide]
+BODY
+END
+
+Cons(k)
+k = 0 .. N
+: mydata(k)
+READ A <- A Prod(k) [type = narrow]
+BODY
+END
+"""
+
+CLEAN_V007 = BAD_V007.replace("[type = narrow]", "[type = wide]")
+
+
+def test_v007_dtype_mismatch(ctx):
+    ctx.register_datatype("wide", 8, 8)
+    ctx.register_datatype("narrow", 8, 4)
+    rep = _verify_jdf(ctx, BAD_V007, "v007.jdf")
+    f = _the(rep, "V007")
+    assert f.severity == "error"
+    assert f.cls == "Prod" and f.flow == "A"
+    assert f.loc == "v007.jdf:7"
+    assert "'wide'" in f.message and "'narrow'" in f.message
+
+
+def test_v007_clean_twin(ctx):
+    ctx.register_datatype("wide", 8, 8)
+    assert _verify_jdf(ctx, CLEAN_V007, "v007c.jdf").ok()
+
+
+def test_v007_same_layout_rename_downgrades_to_warning(ctx):
+    ctx.register_datatype("wide", 8, 8)
+    ctx.register_datatype("narrow", 8, 8)  # same 64 B payload
+    rep = _verify_jdf(ctx, BAD_V007, "v007r.jdf")
+    f = _the(rep, "V007")
+    assert f.severity == "warning"
+    assert "rename" in f.message
+
+
+def test_v007_arena_size_mismatch(ctx):
+    # builder-API twin of the shape half: arena payloads disagree with
+    # no declared reshape
+    ctx.register_arena("small", 32)
+    tp = pt.Taskpool(ctx, globals={"N": 3})
+    k = pt.L("k")
+    a = tp.task_class("Aa")
+    a.param("k", 0, pt.G("N"))
+    a.flow("X", "W", pt.Out(pt.Ref("Bb", k, flow="X")), arena="default")
+    a.body_noop()
+    b = tp.task_class("Bb")
+    b.param("k", 0, pt.G("N"))
+    b.flow("X", "READ", pt.In(pt.Ref("Aa", k, flow="X")), arena="small")
+    b.body_noop()
+    rep = verify_taskpool(tp)
+    f = _the(rep, "V007")
+    assert f.severity == "warning"
+    assert "64" in f.message and "32" in f.message
+    assert f.loc and f.loc.startswith("test_verify_rules.py:")
+
+
+# ------------------------------------------------------------------ V008
+def _coll_step_pool(ctx, guarded: bool):
+    tp = pt.Taskpool(ctx, globals={"N": 3})
+    i = pt.L("i")
+    feed = tp.task_class("Feed")
+    feed.param("i", 0, pt.G("N"))
+    feed.flow("X", "W", pt.Out(pt.Ref("ptc_coll_9_step", i, flow="A")),
+              arena="default")
+    feed.body_noop()
+    step = tp.task_class("ptc_coll_9_step")
+    step.param("i", 0, pt.G("N"))
+    step.flow("A", "READ",
+              pt.In(pt.Ref("Feed", i, flow="X"),
+                    guard=(i >= 0) if guarded else None))
+    step.body_noop()
+    return tp
+
+
+def test_v008_guarded_coll_in(ctx):
+    rep = verify_taskpool(_coll_step_pool(ctx, guarded=True))
+    f = _the(rep, "V008")
+    assert f.severity == "error"
+    assert f.cls == "ptc_coll_9_step" and f.flow == "A"
+    assert f.loc and f.loc.startswith("test_verify_rules.py:")
+
+
+def test_v008_clean_twin(ctx):
+    assert verify_taskpool(_coll_step_pool(ctx, guarded=False)).ok()
+
+
+# ------------------------------------------------- verify= enforcement
+def test_taskpool_run_verify_raises(ctx):
+    b = compile_jdf(BAD_V001, ctx, globals={"N": 4}, dtype=np.int64,
+                    arenas={"A": "default"}, filename="v001.jdf")
+    with pytest.raises(VerifyError) as ei:
+        b.tp.run(verify="error")
+    assert "V001" in str(ei.value)
+    assert ei.value.report.errors
+
+
+def test_taskpool_run_verify_clean_runs(ctx):
+    b = compile_jdf(CLEAN_V001, ctx, globals={"N": 4}, dtype=np.int64,
+                    arenas={"A": "default"}, filename="v001c.jdf")
+    tp = b.tp.run(verify=True)
+    tp.wait()
+    assert tp.nb_total_tasks == 10
+
+
+def test_taskpool_verify_warn_mode(ctx, capsys):
+    b = compile_jdf(BAD_V006, ctx, globals={"N": 4}, dtype=np.int64,
+                    arenas={"A": "default"}, filename="v006.jdf")
+    report = b.tp.verify(mode="warn")
+    assert any(f.rule == "V006" for f in report.findings)
+    assert "V006" in capsys.readouterr().err

@@ -1,0 +1,117 @@
+"""DTD insertion linter (analysis/dtdlint.py): D101 access-mode
+conflicts, D102 use-after-finalize, D103 dead stores — the dynamic-path
+counterpart of ptc-verify."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.analysis import DtdLintError
+from parsec_tpu.dsl.dtd import INOUT, INPUT, OUTPUT, DtdTaskpool
+
+
+@pytest.fixture()
+def ctx():
+    with pt.Context(nb_workers=1) as c:
+        yield c
+
+
+_KEY = [0]
+
+
+def _data(ctx, n=16):
+    _KEY[0] += 1
+    return ctx.data(_KEY[0], np.zeros(n, dtype=np.float32))
+
+
+def _noop(view):
+    pass
+
+
+def test_d101_conflicting_duplicate_tile(ctx):
+    tp = DtdTaskpool(ctx, lint=True)
+    d = _data(ctx)
+    t = tp.tile_of(d)
+    with pytest.raises(DtdLintError) as ei:
+        tp.insert_task(_noop, (t, INPUT), (t, OUTPUT))
+    assert ei.value.rule == "D101"
+    tp.wait()
+    tp.destroy()
+
+
+def test_d101_same_mode_duplicate_is_fine(ctx):
+    tp = DtdTaskpool(ctx, lint=True)
+    t = tp.tile_of(_data(ctx))
+    tp.insert_task(_noop, (t, INPUT), (t, INPUT))
+    tp.wait()
+    tp.destroy()
+
+
+def test_d101_inout_declared_is_fine(ctx):
+    tp = DtdTaskpool(ctx, lint=True)
+    t = tp.tile_of(_data(ctx))
+    tp.insert_task(_noop, (t, INOUT))
+    tp.insert_task(_noop, (t, INPUT))
+    tp.wait()
+    tp.destroy()
+
+
+def test_d102_tile_from_destroyed_pool(ctx):
+    tp1 = DtdTaskpool(ctx, lint=True)
+    t = tp1.tile_of(_data(ctx))
+    tp1.insert_task(_noop, (t, INOUT))
+    tp1.wait()
+    tp1.destroy()
+    tp2 = DtdTaskpool(ctx, lint=True)
+    with pytest.raises(DtdLintError) as ei:
+        tp2.insert_task(_noop, (t, INPUT))
+    assert ei.value.rule == "D102"
+    tp2.wait()
+    tp2.destroy()
+
+
+def test_d103_dead_store_warns_at_wait(ctx):
+    tp = DtdTaskpool(ctx, lint="warn")
+    t = tp.tile_of(_data(ctx))
+    tp.insert_task(_noop, (t, OUTPUT))
+    tp.wait()
+    rules = [r for r, _ in tp.linter.findings]
+    assert "D103" in rules
+    tp.destroy()
+
+
+def test_d103_not_raised_when_read_back(ctx):
+    tp = DtdTaskpool(ctx, lint="warn")
+    t = tp.tile_of(_data(ctx))
+    tp.insert_task(_noop, (t, OUTPUT))
+    tp.insert_task(_noop, (t, INPUT))
+    tp.wait()
+    assert not tp.linter.findings
+    tp.destroy()
+
+
+def test_warn_mode_records_without_raising(ctx):
+    tp = DtdTaskpool(ctx, lint="warn")
+    t = tp.tile_of(_data(ctx))
+    tp.insert_task(_noop, (t, INPUT), (t, OUTPUT))  # D101, not raised
+    tp.insert_task(_noop, (t, INPUT))
+    tp.wait()
+    assert any(r == "D101" for r, _ in tp.linter.findings)
+    tp.destroy()
+
+
+def test_lint_off_by_default(ctx):
+    tp = DtdTaskpool(ctx)
+    assert tp.linter is None
+    t = tp.tile_of(_data(ctx))
+    tp.insert_task(_noop, (t, INPUT), (t, OUTPUT))  # tolerated unlinted
+    tp.wait()
+    tp.destroy()
+
+
+def test_batched_insert_linted(ctx):
+    tp = DtdTaskpool(ctx, lint="warn")
+    t = tp.tile_of(_data(ctx))
+    tp.insert_tasks([(_noop, ((t, "INPUT"), (t, "OUTPUT")))])
+    tp.wait()
+    assert any(r == "D101" for r, _ in tp.linter.findings)
+    tp.destroy()

@@ -7,12 +7,17 @@ properties).
 
 Usage: python tools/jdf2dot.py prog.jdf out.dot [--global N=10 ...]
                 [--simulate P]
-Bodies are replaced with no-ops; the program runs once on a throwaway
-context with full tracing and the executed DAG is captured from EDGE
-events.  --simulate P list-schedules the captured DAG on P virtual
-workers using per-task costs from `BODY [weight = <expr>]` (a Python
-expression over the task's first two parameters; default cost 1) and
-reports total work, critical path, makespan, speedup, and efficiency.
+
+The DAG comes from the SAME symbolic flow-graph extraction the static
+verifier uses (parsec_tpu/analysis/flowgraph.py): the program is
+compiled but never executed — dep targets, guards, broadcast ranges and
+control gathers are enumerated over the execution space exactly as the
+native engine would resolve them.  Verifier findings (rules V001-V008)
+overlay the DOT in red; dynamically-guarded maybe-edges draw dashed.
+--simulate P list-schedules the extracted DAG on P virtual workers
+using per-task costs from `BODY [weight = <expr>]` (a Python expression
+over the task's first two parameters; default cost 1) and reports total
+work, critical path, makespan, speedup, and efficiency.
 """
 import argparse
 import os
@@ -24,8 +29,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 import parsec_tpu as pt  # noqa: E402
+from parsec_tpu.analysis import (extract_flowgraph, flowgraph_to_dot,
+                                 verify_graph)  # noqa: E402
 from parsec_tpu.dsl.jdf import compile_jdf  # noqa: E402
-from parsec_tpu.profiling import take_trace, to_dot  # noqa: E402
 
 
 def _noopify(src: str) -> str:
@@ -37,9 +43,10 @@ def _noopify(src: str) -> str:
         src, flags=re.S)
 
 
-def simulate(trace, prog, gvals, nb_workers):
-    """List-schedule the captured DAG on `nb_workers` virtual workers.
+def simulate(nodes_edges, prog, gvals, nb_workers):
+    """List-schedule the extracted DAG on `nb_workers` virtual workers.
 
+    `nodes_edges` is ((cid, l0, l1) node list, (src, dst) edge list).
     Costs come from each class's first BODY carrying a `weight` property
     (a Python expression over the task's first two declared parameters
     and the program globals; default 1).  Returns a dict with total
@@ -48,6 +55,7 @@ def simulate(trace, prog, gvals, nb_workers):
     properties + the simulation dag enumerators)."""
     import heapq
 
+    node_list, edge_list = nodes_edges
     weight_src = {}
     pnames = {}
     for i, jt in enumerate(prog.tasks):
@@ -70,16 +78,12 @@ def simulate(trace, prog, gvals, nb_workers):
             env[names[1]] = l1
         return max(1, int(eval(code, {}, env)))
 
-    # nodes from EXEC begins; edges from EDGE pairs
-    ev = trace.events
     nodes = {}
-    for row in ev:
-        key, phase, cid, l0, l1 = (int(x) for x in row[:5])
-        if key == 0 and phase == 0:  # KEY_EXEC begin
-            nodes[(cid, l0, l1)] = cost(cid, l0, l1)
+    for (cid, l0, l1) in node_list:
+        nodes[(cid, l0, l1)] = cost(cid, l0, l1)
     succs = {n: [] for n in nodes}
     npred = {n: 0 for n in nodes}
-    for src, dst in trace.edges():
+    for src, dst in edge_list:
         if src in nodes and dst in nodes:
             succs[src].append(dst)
             npred[dst] += 1
@@ -141,6 +145,22 @@ def simulate(trace, prog, gvals, nb_workers):
     }
 
 
+def _sim_view(cg):
+    """(cid, l0, l1) nodes + deduped edges from a concretized flow
+    graph (the shape the trace-based enumerator used to produce)."""
+
+    def key(node):
+        cid, params = node
+        p = tuple(params) + (0, 0)
+        return (cid, p[0], p[1])
+
+    nodes = [key((cid, params))
+             for cid, plist in cg.instances.items() for params in plist]
+    edges = [(key(src), key(dst))
+             for src, outs in cg.succ.items() for dst, _ in outs]
+    return nodes, edges
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jdf")
@@ -165,26 +185,27 @@ def main(argv=None):
     globs.setdefault("N", 10)
 
     with pt.Context(nb_workers=1) as ctx:
-        ctx.profile_enable(True)
         buf = np.zeros(args.size, dtype=np.int64)
         ctx.register_linear_collection(args.collection, buf, elem_size=8)
         ctx.register_arena("default", 64)
         b = compile_jdf(src, ctx, globals=globs, dtype=np.int64,
-                        arenas={"A": "default"})
-        tp = b.run()
-        tp.wait()
-        names = [t.name for t in b.prog.tasks]
-        tr = take_trace(ctx, class_names=names)
+                        arenas={"A": "default"},
+                        filename=os.path.basename(args.jdf))
+        fg = extract_flowgraph(b.tp)
+        report, cg = verify_graph(fg)
 
-    dot = to_dot(tr)
+    dot = flowgraph_to_dot(cg, report.findings,
+                           name=re.sub(r"\W", "_",
+                                       os.path.basename(args.jdf)))
     with open(args.out, "w") as f:
         f.write(dot + "\n")
-    counts = tr.counts()
-    print(f"{tp.nb_total_tasks} tasks, {dot.count('->')} edges -> "
-          f"{args.out}; events: {counts}")
+    print(f"{cg.nb_instances()} tasks, {cg.nb_edges} edges -> "
+          f"{args.out}; findings: {len(report.findings)}")
+    if report.findings:
+        print(report.text(), file=sys.stderr)
     if args.simulate > 0:
         import json
-        sim = simulate(tr, b.prog, b.gvals, args.simulate)
+        sim = simulate(_sim_view(cg), b.prog, b.gvals, args.simulate)
         print("simulate: " + json.dumps(sim))
     return 0
 
