@@ -101,6 +101,14 @@ bench-stream: $(LIB)
 bench-collective: $(LIB)
 	python bench.py --collective --json BENCH_collective.json
 
+# Serving-runtime suite (bench.py --serve --json): mixed-tenant
+# latency p50/p99 (hi-priority tenant vs a no-QoS control over the SAME
+# request mix), admission rejects under tight budgets, and the
+# continuous-batching decode's bit-exactness vs the sequential
+# per-request baseline.  CPU-only — no TPU needed.
+bench-serve: $(LIB)
+	python bench.py --serve --json BENCH_serve.json
+
 # Tracing-overhead ladder (bench.py --trace --json): per-task cost at
 # trace levels 0/1/2 and the flight-recorder ring vs unbounded buffers
 # at level 1 (the PR2 one-transaction-per-task contract), plus the
@@ -124,4 +132,4 @@ check: bench-check verify-graphs tidy
 
 .PHONY: all clean tsan ubsan tidy verify-graphs check bench-comm \
 	bench-dispatch bench-device bench-stream bench-collective \
-	bench-trace bench-check
+	bench-trace bench-serve bench-check

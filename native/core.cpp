@@ -1250,6 +1250,12 @@ static ptc_task *make_task(ptc_context *ctx, ptc_taskpool *tp,
     for (size_t f = 0; f < tc.flows.size(); f++) t->data[f] = staged[f];
   t->priority = (int32_t)eval_expr(tc.priority, ctx, t->locals,
                                    (int)tc.locals.size(), tp->globals.data());
+  /* pool-QoS priority bias: priority-ordered modules (ap/spq/ltq, and
+   * the bypass slot) then order across pools too — the lane-less
+   * fallback of the per-pool QoS contract.  qos_prio is clamped to
+   * ±1023 at set time, so the composed value cannot overflow. */
+  if (tp->qos.load(std::memory_order_relaxed))
+    t->priority += tp->qos_prio * (1 << 20);
   return t;
 }
 
@@ -1923,7 +1929,11 @@ void ptc_schedule_task(ptc_context *ctx, int worker, ptc_task *t) {
   /* comm-thread deliveries can precede/overlap the lazy start */
   if (!ctx->started.load(std::memory_order_acquire))
     ptc_context_start(ctx);
-  if (tl_bypass && ctx->sched_bypass.load(std::memory_order_relaxed)) {
+  if (tl_bypass && ctx->sched_bypass.load(std::memory_order_relaxed) &&
+      !(t->tp && t->tp->qos.load(std::memory_order_relaxed))) {
+    /* QoS pools never ride the thread-local bypass: every ready
+     * successor must pass a select() boundary so a higher-priority
+     * pool's lane can win the wave (see SchedLWS QoS lanes) */
     if (!tl_next_task) {
       tl_next_task = t;
       return;
@@ -1984,6 +1994,8 @@ static void tp_mark_complete(ptc_context *ctx, ptc_taskpool *tp) {
 }
 
 static void tp_task_done(ptc_context *ctx, ptc_taskpool *tp) {
+  if (tp->qos.load(std::memory_order_relaxed))
+    tp->q_executed.fetch_add(1, std::memory_order_relaxed);
   /* seq_cst pairs with ptc_tp_set_open: forbids the store-buffer interleaving
    * where the closer misses nb_tasks==0 and the last worker misses open==false
    * (both would skip completion). */
@@ -3175,6 +3187,11 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
   if (const char *e = std::getenv("PTC_MCA_sched_bypass"))
     ctx->sched_bypass.store(!(*e == '0' && e[1] == '\0'),
                             std::memory_order_relaxed);
+  /* QoS wave-boundary preemption: on unless PTC_MCA_sched_qos_preempt=0
+   * (same re-apply pattern via ptc_context_set_qos_preempt) */
+  if (const char *e = std::getenv("PTC_MCA_sched_qos_preempt"))
+    ctx->qos_preempt.store(!(*e == '0' && e[1] == '\0'),
+                           std::memory_order_relaxed);
   /* the weak-hash sanitizer targets the HASH engine: force it (same
    * value parse as ptc_fnv_hash — "0" means off) */
   if (const char *wh = std::getenv("PTC_DEBUG_WEAK_HASH"))
@@ -3371,15 +3388,67 @@ int32_t ptc_context_get_sched_bypass(ptc_context_t *ctx) {
   return ctx->sched_bypass.load(std::memory_order_relaxed) ? 1 : 0;
 }
 
+/* ---- per-pool QoS (serving runtime) ---- */
+
+/* Arm QoS on a taskpool: priority orders pools strictly (higher wins
+ * every select boundary under lws; negative = background, served only
+ * when the default path is dry), weight shares a priority tier by
+ * stride scheduling.  Call BEFORE add_taskpool (tasks scheduled earlier
+ * would miss the lane routing).  Priority clamps to ±1023 so the
+ * composed task priority (prio << 20 + class priority) stays in int32. */
+void ptc_tp_set_qos(ptc_taskpool_t *tp, int32_t priority, int64_t weight) {
+  if (priority > 1023) priority = 1023;
+  if (priority < -1023) priority = -1023;
+  tp->qos_prio = priority;
+  tp->qos_weight = weight < 1 ? 1 : weight;
+  tp->qos.store(true, std::memory_order_release);
+}
+
+/* Per-pool QoS counters: out = [priority, weight, scheduled, selected,
+ * executed, wait_ns, queued (scheduled - selected), preempts].  Returns
+ * slots written (<= cap); 0 when the pool has no QoS armed. */
+int64_t ptc_tp_qos_stats(ptc_taskpool_t *tp, int64_t *out, int64_t cap) {
+  if (!tp->qos.load(std::memory_order_acquire)) return 0;
+  int64_t sched = tp->q_scheduled.load(std::memory_order_relaxed);
+  int64_t sel = tp->q_selected.load(std::memory_order_relaxed);
+  int64_t v[8] = {
+      tp->qos_prio,
+      tp->qos_weight,
+      sched,
+      sel,
+      tp->q_executed.load(std::memory_order_relaxed),
+      tp->q_wait_ns.load(std::memory_order_relaxed),
+      sched - sel < 0 ? 0 : sched - sel,
+      tp->q_preempts.load(std::memory_order_relaxed),
+  };
+  int64_t n = cap < 8 ? (cap < 0 ? 0 : cap) : 8;
+  for (int64_t i = 0; i < n; i++) out[i] = v[i];
+  return n;
+}
+
+/* Wave-boundary preemption knob (PTC_MCA_sched_qos_preempt): off = a
+ * worker drains the lane it last served until empty instead of
+ * re-ranking lanes by priority at every select. */
+void ptc_context_set_qos_preempt(ptc_context_t *ctx, int32_t on) {
+  ctx->qos_preempt.store(on != 0, std::memory_order_relaxed);
+  if (ctx->started.load(std::memory_order_acquire))
+    ctx->sched->qos_preempt.store(on != 0, std::memory_order_relaxed);
+}
+
+int32_t ptc_context_get_qos_preempt(ptc_context_t *ctx) {
+  return ctx->qos_preempt.load(std::memory_order_relaxed) ? 1 : 0;
+}
+
 /* Dispatch fast-path counters (Context.sched_stats()).  Layout:
  *  [0] bypass hits (sum over workers)   [1] bypass enabled (0/1)
  *  [2] task-freelist hits               [3] task-freelist misses
  *  [4] arena-freelist hits              [5] arena-freelist misses
  *  [6] DTD insert batches               [7] DTD batch-inserted tasks
  *  [8] scheduler inject pushes          [9] scheduler inject pops
+ *  [10] QoS lane selects                [11] QoS wave preemptions
  * Returns the number of slots written (<= cap). */
 int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
-  int64_t v[10] = {0};
+  int64_t v[12] = {0};
   for (auto *c : ctx->worker_bypass)
     v[0] += c->load(std::memory_order_relaxed);
   v[1] = ctx->sched_bypass.load(std::memory_order_relaxed) ? 1 : 0;
@@ -3405,8 +3474,10 @@ int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   if (ctx->started.load(std::memory_order_acquire)) {
     v[8] = ctx->sched->inject_pushes.load(std::memory_order_relaxed);
     v[9] = ctx->sched->inject_pops.load(std::memory_order_relaxed);
+    v[10] = ctx->sched->qos_selects.load(std::memory_order_relaxed);
+    v[11] = ctx->sched->qos_preempts.load(std::memory_order_relaxed);
   }
-  int64_t n = cap < 10 ? (cap < 0 ? 0 : cap) : 10;
+  int64_t n = cap < 12 ? (cap < 0 ? 0 : cap) : 12;
   for (int64_t i = 0; i < n; i++) out[i] = v[i];
   return n;
 }
@@ -3437,6 +3508,9 @@ int32_t ptc_context_start(ptc_context_t *ctx) {
     ctx->sched->set_vpmap(ctx->vp_of_worker);
   ctx->sched->install(ctx->nb_workers);
   ctx->sched->steals_init(ctx->nb_workers);
+  ctx->sched->qos_preempt.store(
+      ctx->qos_preempt.load(std::memory_order_relaxed),
+      std::memory_order_relaxed);
   for (int i = 0; i < ctx->nb_workers; i++)
     ctx->workers.emplace_back(worker_main, ctx, i);
   ctx->started.store(true, std::memory_order_release);
@@ -3726,7 +3800,14 @@ int32_t ptc_tp_add_class(ptc_taskpool_t *tp, const char *name,
   return (int32_t)tp->classes.size() - 1;
 }
 
-int32_t ptc_tp_id(ptc_taskpool_t *tp) { return tp->id; }
+int32_t ptc_tp_id(ptc_taskpool_t *tp) {
+  /* the id is assigned inside add_taskpool under tp_reg_lock; a
+   * monitor thread (Context.stats() pool rows) may ask while the
+   * submitting thread is mid-registration — read under the same lock
+   * (TSan-caught in the serve_churn stress) */
+  std::lock_guard<std::mutex> g(tp->ctx->tp_reg_lock);
+  return tp->id;
+}
 
 int32_t ptc_tp_dense_classes(ptc_taskpool_t *tp) {
   int32_t n = 0;
@@ -4143,6 +4224,9 @@ ptc_task_t *ptc_dtask_begin(ptc_taskpool_t *tp, int32_t body_kind,
   t->tp = tp;
   t->class_id = -1;
   t->priority = priority;
+  /* pool-QoS priority bias, as in make_task */
+  if (tp->qos.load(std::memory_order_relaxed))
+    t->priority += tp->qos_prio * (1 << 20);
   std::memset(t->locals, 0, sizeof(t->locals));
   std::memset(t->data, 0, sizeof(t->data));
   t->dyn = new DynExt();

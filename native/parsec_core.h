@@ -139,8 +139,25 @@ int32_t ptc_context_get_sched_bypass(ptc_context_t *ctx);
 /* dispatch fast-path counters — [0] bypass hits, [1] bypass enabled,
  * [2]/[3] task-freelist hits/misses, [4]/[5] arena hits/misses,
  * [6]/[7] DTD insert batches / batch-inserted tasks, [8]/[9] scheduler
- * inject pushes/pops.  Returns slots written (<= cap). */
+ * inject pushes/pops, [10]/[11] QoS lane selects / wave preemptions.
+ * Returns slots written (<= cap). */
 int64_t ptc_sched_stats(ptc_context_t *ctx, int64_t *out, int64_t cap);
+/* Per-pool QoS (serving runtime): arm a taskpool with a scheduling
+ * priority (strict across pools under the lws module: higher-priority
+ * pools win every select boundary — the wave-boundary preemption point;
+ * negative = background; clamped to +-1023) and a weight (stride-
+ * scheduled sharing within one priority tier).  Priority-ordered
+ * modules (ap/spq/ltq) see the pool priority through the composed task
+ * priority instead.  Call before ptc_context_add_taskpool. */
+void ptc_tp_set_qos(ptc_taskpool_t *tp, int32_t priority, int64_t weight);
+/* out = [priority, weight, scheduled, selected, executed, wait_ns,
+ * queued, preempts]; returns slots written, 0 when QoS is not armed. */
+int64_t ptc_tp_qos_stats(ptc_taskpool_t *tp, int64_t *out, int64_t cap);
+/* QoS wave-boundary preemption knob (PTC_MCA_sched_qos_preempt,
+ * default on): off = a worker drains the lane it last served until
+ * empty instead of re-ranking lanes by priority at every select. */
+void ptc_context_set_qos_preempt(ptc_context_t *ctx, int32_t on);
+int32_t ptc_context_get_qos_preempt(ptc_context_t *ctx);
 
 /* registries: return non-negative id, or -1 on error */
 int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user);

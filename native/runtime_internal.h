@@ -529,6 +529,13 @@ struct Scheduler {
   /* external-producer inject traffic (lock-free MPSC modules tick these;
    * mutex/global modules leave them 0) — Context.sched_stats() rows */
   std::atomic<int64_t> inject_pushes{0}, inject_pops{0};
+  /* per-pool QoS traffic (lanes implemented by lws; other modules rely
+   * on the composed task priority alone and leave these 0).  preempt
+   * off = a worker keeps draining the lane it last served until empty
+   * instead of re-ranking by priority at every select (the
+   * wave-boundary preemption control knob, PTC_MCA_sched_qos_preempt) */
+  std::atomic<bool> qos_preempt{true};
+  std::atomic<int64_t> qos_selects{0}, qos_preempts{0};
   void steals_init(int n) {
     steals.clear();
     for (int i = 0; i < (n < 1 ? 1 : n); i++)
@@ -808,6 +815,24 @@ struct ptc_taskpool {
   std::unordered_map<uint64_t, std::vector<DtdServed>> dtd_served;
   /* requester side: outstanding pulls, (seq, flow) → destination tile */
   std::map<std::pair<uint64_t, int32_t>, ptc_dtile *> dtd_fetch_pending;
+
+  /* ---- per-pool QoS (serving runtime; reference role: the priority
+   * levels of __parsec_schedule generalized to whole taskpools).  A pool
+   * with `qos` set routes its ready tasks through the scheduler's QoS
+   * lanes (SchedLWS: one lane per (priority, weight) class, strict
+   * priority tiers + stride-weighted sharing inside a tier, consulted at
+   * every select() — the wave-boundary preemption point) and skips the
+   * same-worker bypass so a higher-priority pool can win every boundary.
+   * Counters: scheduled = tasks entering a lane, selected = lane pops,
+   * executed = completed tasks (any scheduler), wait_ns = lane queue
+   * time, preempts = selections that overtook a nonempty lower-priority
+   * lane.  qos_prio is clamped to ±1023 so the composed task priority
+   * (pool_prio << 20 + class priority) cannot overflow int32. */
+  std::atomic<bool> qos{false};
+  int32_t qos_prio = 0;
+  int64_t qos_weight = 1;
+  std::atomic<int64_t> q_scheduled{0}, q_selected{0}, q_executed{0};
+  std::atomic<int64_t> q_wait_ns{0}, q_preempts{0};
 };
 
 struct ptc_context {
@@ -915,6 +940,10 @@ struct ptc_context {
    * w executed straight from its thread-local slot — the proof the
    * schedule()+select() round trip was skipped. */
   std::atomic<bool> sched_bypass{true};
+  /* per-pool QoS wave-boundary preemption (PTC_MCA_sched_qos_preempt /
+   * ptc_context_set_qos_preempt): copied into the scheduler at install;
+   * kept here too so pre-start sets survive.  Default on. */
+  std::atomic<bool> qos_preempt{true};
   std::vector<std::atomic<int64_t> *> worker_bypass;
 
   /* batched DTD insertion accounting (ptc_dtask_insert_batch) */

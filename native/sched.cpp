@@ -35,6 +35,29 @@ namespace {
 thread_local const void *tls_owner = nullptr;
 thread_local int tls_worker = -1;
 
+/* ---- per-pool QoS lanes (serving runtime) ----
+ *
+ * One lane per distinct (priority, weight) class of QoS taskpools
+ * (ptc_tp_set_qos): a mutex FIFO of (task, enqueue-ns).  Selection is
+ * strict priority across tiers and stride scheduling inside a tier —
+ * each lane carries a `pass` value advanced by STRIDE/weight per pop,
+ * and the minimum-pass nonempty lane of the top nonempty tier wins —
+ * so two same-priority tenants with weights 3:1 split a saturated
+ * worker 3:1 regardless of arrival order.  Lanes are consulted at
+ * every select(): task bodies are never interrupted, so the select
+ * boundary IS the preemption point (the wave boundary the
+ * ptc_peek_ready lookahead delimits on device queues). */
+struct QLane {
+  int32_t prio = 0;
+  int64_t weight = 1;
+  std::mutex lock;
+  std::deque<std::pair<ptc_task *, int64_t>> q; /* (task, enqueue ns) */
+  std::atomic<int64_t> size{0};  /* lock-free nonempty scan hint */
+  std::atomic<int64_t> pass{0};  /* stride position within the tier */
+};
+constexpr int PTC_QOS_MAX_LANES = 64;
+constexpr int64_t PTC_QOS_STRIDE = 1 << 20;
+
 /* lws: per-worker Chase–Lev deque + LOCK-FREE multi-producer inject
  * queue (reference analog: hbbuffer local queues + the atomic-LIFO
  * system queue, SURVEY §2.4 sched lfq).  Owner pop is LIFO (cache
@@ -45,14 +68,27 @@ thread_local int tls_worker = -1;
  * Inject-drain rule: a worker whose local deque never empties (a chain
  * of self-pushed successors) serves the inject queue FIRST every 64th
  * select, so externally injected tasks cannot starve behind it.  The
- * empty-local path still drains inject before stealing. */
+ * empty-local path still drains inject before stealing.
+ *
+ * QoS pools (tp->qos) ride the lane machinery above instead of the
+ * deques: schedule() routes their tasks into the (prio, weight) lane,
+ * select() serves nonneg-priority lanes BEFORE the local path and
+ * negative-priority (background) lanes only when the default path is
+ * dry.  Non-QoS pools see zero overhead beyond one relaxed bool load
+ * per schedule/select. */
 struct SchedLWS : Scheduler {
   std::vector<WSDeque<ptc_task *> *> dq;
   MPSCQueue<ptc_task *> inj; /* external producers, lock-free */
   struct alignas(64) Tick {
-    int64_t v = 0; /* owner-worker only */
+    int64_t v = 0;             /* owner-worker only */
+    QLane *sticky = nullptr;   /* last-served lane (preempt-off mode) */
   };
   std::vector<Tick> tick;
+  /* QoS lanes: slot-then-count publication (arena-table pattern) so the
+   * per-select scan stays lock-free; creation is rare and serialized */
+  QLane *lanes[PTC_QOS_MAX_LANES] = {nullptr};
+  std::atomic<int32_t> nlanes{0};
+  std::mutex lane_lock;
   void install(int n) override {
     for (auto *d : dq)
       delete d;
@@ -64,13 +100,124 @@ struct SchedLWS : Scheduler {
   ~SchedLWS() override {
     for (auto *d : dq)
       delete d;
+    int32_t nl = nlanes.load(std::memory_order_acquire);
+    for (int32_t i = 0; i < nl; i++)
+      delete lanes[i];
   }
   ptc_task *inj_pop() {
     ptc_task *t = inj.pop();
     if (t) inject_pops.fetch_add(1, std::memory_order_relaxed);
     return t;
   }
+  QLane *lane_for(int32_t prio, int64_t weight) {
+    int32_t nl = nlanes.load(std::memory_order_acquire);
+    for (int32_t i = 0; i < nl; i++)
+      if (lanes[i]->prio == prio && lanes[i]->weight == weight)
+        return lanes[i];
+    std::lock_guard<std::mutex> g(lane_lock);
+    nl = nlanes.load(std::memory_order_acquire);
+    for (int32_t i = 0; i < nl; i++)
+      if (lanes[i]->prio == prio && lanes[i]->weight == weight)
+        return lanes[i];
+    if (nl >= PTC_QOS_MAX_LANES) return nullptr; /* default path takes it */
+    QLane *ln = new QLane();
+    ln->prio = prio;
+    ln->weight = weight < 1 ? 1 : weight;
+    /* join the tier at the current max pass so a newborn lane cannot
+     * monopolize the worker while its pass catches up */
+    int64_t p0 = 0;
+    for (int32_t i = 0; i < nl; i++)
+      if (lanes[i]->prio == prio)
+        p0 = std::max(p0, lanes[i]->pass.load(std::memory_order_relaxed));
+    ln->pass.store(p0, std::memory_order_relaxed);
+    lanes[nl] = ln;
+    nlanes.store(nl + 1, std::memory_order_release);
+    return ln;
+  }
+  ptc_task *qos_pop(QLane *ln) {
+    ptc_task *t = nullptr;
+    int64_t enq = 0;
+    {
+      std::lock_guard<std::mutex> g(ln->lock);
+      if (ln->q.empty()) return nullptr;
+      t = ln->q.front().first;
+      enq = ln->q.front().second;
+      ln->q.pop_front();
+      ln->size.fetch_sub(1, std::memory_order_relaxed);
+    }
+    ln->pass.fetch_add(PTC_QOS_STRIDE / ln->weight,
+                       std::memory_order_relaxed);
+    t->tp->q_selected.fetch_add(1, std::memory_order_relaxed);
+    t->tp->q_wait_ns.fetch_add(ptc_now_ns() - enq,
+                               std::memory_order_relaxed);
+    qos_selects.fetch_add(1, std::memory_order_relaxed);
+    return t;
+  }
+  /* serve the best lane with priority >= min_prio; nullptr when none */
+  ptc_task *qos_select(int me, int32_t min_prio) {
+    int32_t nl = nlanes.load(std::memory_order_acquire);
+    bool preempt = qos_preempt.load(std::memory_order_relaxed);
+    if (!preempt) {
+      /* preempt off: keep draining the lane last served (no re-ranking
+       * at the wave boundary) until it runs dry */
+      QLane *last = tick[(size_t)me].sticky;
+      if (last && last->prio >= min_prio &&
+          last->size.load(std::memory_order_acquire) > 0)
+        if (ptc_task *t = qos_pop(last)) return t;
+    }
+    for (;;) {
+      QLane *best = nullptr;
+      bool lower_seen = false;
+      int32_t top = 0;
+      for (int32_t i = 0; i < nl; i++) {
+        QLane *ln = lanes[i];
+        if (ln->prio < min_prio) continue;
+        if (ln->size.load(std::memory_order_acquire) <= 0) continue;
+        if (!best) {
+          best = ln;
+          top = ln->prio;
+        } else if (ln->prio > top) {
+          lower_seen = true;
+          best = ln;
+          top = ln->prio;
+        } else if (ln->prio < top) {
+          lower_seen = true;
+        } else if (ln->pass.load(std::memory_order_relaxed) <
+                   best->pass.load(std::memory_order_relaxed)) {
+          best = ln;
+        }
+      }
+      if (!best) return nullptr;
+      if (ptc_task *t = qos_pop(best)) {
+        tick[(size_t)me].sticky = best;
+        /* a preemption is a priority-driven override at the wave
+         * boundary — with the knob off, re-ranking after a lane runs
+         * dry is just rotation, not preemption */
+        if (lower_seen && preempt) {
+          qos_preempts.fetch_add(1, std::memory_order_relaxed);
+          t->tp->q_preempts.fetch_add(1, std::memory_order_relaxed);
+        }
+        return t;
+      }
+      /* the size hint raced with another consumer; re-rank */
+    }
+  }
   void schedule(int w, ptc_task *t) override {
+    if (t->tp && t->tp->qos.load(std::memory_order_relaxed)) {
+      if (QLane *ln = lane_for(t->tp->qos_prio, t->tp->qos_weight)) {
+        t->tp->q_scheduled.fetch_add(1, std::memory_order_relaxed);
+        int64_t now = ptc_now_ns();
+        {
+          std::lock_guard<std::mutex> g(ln->lock);
+          ln->q.emplace_back(t, now);
+        }
+        ln->size.fetch_add(1, std::memory_order_release);
+        return;
+      }
+      /* > PTC_QOS_MAX_LANES distinct (prio, weight) classes: overflow
+       * pools ride the default path (composed task priority still
+       * orders them under priority-aware fallbacks) */
+    }
     int n = (int)dq.size();
     if (w >= 0 && w < n && tls_owner == this && tls_worker == w) {
       dq[(size_t)w]->push(t);
@@ -85,6 +232,9 @@ struct SchedLWS : Scheduler {
     tls_owner = this;
     tls_worker = me;
     ptc_task *t;
+    bool qos = nlanes.load(std::memory_order_acquire) > 0;
+    if (qos && (t = qos_select(me, 0)))
+      return t; /* nonneg-priority lanes preempt at the wave boundary */
     if (inj.size() > 0 && (++tick[(size_t)me].v & 63) == 0 &&
         (t = inj_pop()))
       return t; /* drain rule: inject ahead of a never-empty local deque */
@@ -97,6 +247,9 @@ struct SchedLWS : Scheduler {
         return t;
       }
     }
+    /* background (negative-priority) lanes run only when the default
+     * path is dry */
+    if (qos && (t = qos_select(me, INT32_MIN))) return t;
     return nullptr;
   }
 };

@@ -137,6 +137,18 @@ class Context:
         # same-worker ready-task bypass (sched.bypass / PTC_MCA_sched_bypass)
         N.lib.ptc_context_set_sched_bypass(
             self._ptr, 1 if _mca.get("sched.bypass") else 0)
+        # per-pool QoS wave-boundary preemption (sched.qos_preempt)
+        N.lib.ptc_context_set_qos_preempt(
+            self._ptr, 1 if _mca.get("sched.qos_preempt") else 0)
+        # live taskpools (weakrefs): the per-pool QoS rows of
+        # stats()["sched"]["pools"] and the serving layer walk these.
+        # _tp_lock serializes the walk against Taskpool.destroy — a
+        # monitor thread reading qos_stats must never race the native
+        # ptc_tp_destroy (serving pools churn constantly)
+        import threading as _threading
+        self._taskpools: List = []
+        self._tp_lock = _threading.Lock()
+        self._servers: List = []  # serve.Server instances (stats export)
         if _mca.get("runtime.vpmap") not in ("", "flat"):
             self.set_vpmap(_mca.get("runtime.vpmap"))
         N.lib.ptc_device_set_affinity_skew(
@@ -341,10 +353,13 @@ class Context:
         freelist magazine hit rates, batched-insert accounting, and the
         lock-free inject queue's traffic — plus the per-worker steal
         and selected-task vectors (the print_steals data, readable from
-        Python at last instead of only at PINS teardown)."""
-        buf = (C.c_int64 * 10)()
-        n = N.lib.ptc_sched_stats(self._ptr, buf, 10)
-        v = [buf[i] for i in range(n)] + [0] * (10 - n)
+        Python at last instead of only at PINS teardown).  QoS rows:
+        qos_selects/qos_preempts aggregate the lws lane traffic, and
+        `pools` lists every live QoS-armed taskpool's per-pool counters
+        (the serving runtime's scheduler evidence)."""
+        buf = (C.c_int64 * 12)()
+        n = N.lib.ptc_sched_stats(self._ptr, buf, 12)
+        v = [buf[i] for i in range(n)] + [0] * (12 - n)
         return {
             "bypass_hits": v[0],
             "bypass_enabled": bool(v[1]),
@@ -356,9 +371,75 @@ class Context:
             "insert_batched_tasks": v[7],
             "inject_pushes": v[8],
             "inject_pops": v[9],
+            "qos_selects": v[10],
+            "qos_preempts": v[11],
+            "qos_preempt_enabled": bool(
+                N.lib.ptc_context_get_qos_preempt(self._ptr)),
+            "pools": self._qos_pool_rows(),
             "steals": self.worker_steals(),
             "executed": self.worker_stats(),
         }
+
+    # ------------------------------------------------------- QoS taskpools
+    def taskpool(self, globals: Optional[Dict[str, int]] = None,
+                 priority: Optional[int] = None,
+                 weight: Optional[int] = None):
+        """Create a Taskpool on this context.  `priority`/`weight` arm
+        per-pool QoS (the serving runtime's tenant knobs): under the lws
+        scheduler a higher-priority pool's ready tasks win every select
+        boundary (wave-boundary preemption; negative priorities are
+        background, served only when the default path is dry), and
+        weight stride-shares one priority tier.  Per-pool counters
+        export through stats()["sched"]["pools"]."""
+        from .taskpool import Taskpool
+        return Taskpool(self, globals=globals, priority=priority,
+                        weight=weight)
+
+    def _ensure_tp_tracking(self):
+        if getattr(self, "_taskpools", None) is None:
+            import threading
+            self._taskpools = []
+            self._tp_lock = threading.Lock()
+
+    def _track_taskpool(self, tp):
+        """STRONG reference until Taskpool.destroy().  Strong on
+        purpose: a fire-and-forget serving pool (Server.submit caller
+        dropping its ticket) otherwise becomes an unreferenced
+        {Taskpool, ctypes-thunk, callback} CYCLE that the cyclic GC
+        collects while the NATIVE pool is still running — the freed
+        libffi trampoline is then called by tp_mark_complete (observed:
+        heap-scrambled ctypes callbacks, then SEGV, under serve churn).
+        The native pool's lifetime anchors the wrapper's."""
+        self._ensure_tp_tracking()
+        with self._tp_lock:
+            self._taskpools.append(tp)
+
+    def _untrack_taskpool_locked(self, tp):
+        """Caller holds _tp_lock (Taskpool.destroy)."""
+        self._taskpools = [p for p in self._taskpools if p is not tp]
+
+    def live_taskpools(self) -> list:
+        """Live (not destroyed) Taskpool objects created on this
+        context, oldest first."""
+        self._ensure_tp_tracking()
+        with self._tp_lock:
+            return [tp for tp in self._taskpools if not tp._destroyed]
+
+    def _qos_pool_rows(self) -> list:
+        """Per-pool QoS counter rows.  The whole walk holds _tp_lock so
+        a concurrently-retiring pool (Server pump / engine reap calling
+        Taskpool.destroy) can never be freed mid-read."""
+        self._ensure_tp_tracking()
+        rows = []
+        with self._tp_lock:
+            for tp in self._taskpools:
+                if tp._destroyed:
+                    continue
+                st = tp.qos_stats()
+                if st is not None:
+                    st["id"] = tp.tp_id
+                    rows.append(st)
+        return rows
 
     def rusage(self) -> dict:
         """Process resource usage (the reference's per-EU rusage dumps,
@@ -489,12 +570,22 @@ class Context:
                      flight recorder, and the clock-sync estimate
           metrics -> always-on histogram subsystem health: enabled
                      flag, interned class count, watchdog status
+          serve   -> serving front door (parsec_tpu.serve.Server):
+                     admission/queue/reject counters per tenant;
+                     {"enabled": False} when no Server is attached
         """
         tuning = self.comm_tuning()
         wd = getattr(self, "_watchdog", None)
         exp = getattr(self, "_metrics_exporter", None)
+        servers = [s for s in getattr(self, "_servers", [])]
+        serve_ns = {"enabled": False}
+        if servers:
+            # one Server per context in practice; the last attached wins
+            serve_ns = dict(servers[-1].stats())
+            serve_ns["enabled"] = True
         return {
             "sched": self.sched_stats(),
+            "serve": serve_ns,
             "device": self.device_stats(),
             "comm": {
                 "enabled": self.comm_enabled,

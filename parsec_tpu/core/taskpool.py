@@ -12,7 +12,15 @@ from .taskclass import TaskClass, TaskView
 
 
 class Taskpool:
-    def __init__(self, ctx: Context, globals: Optional[Dict[str, int]] = None):
+    def __init__(self, ctx: Context, globals: Optional[Dict[str, int]] = None,
+                 priority: Optional[int] = None,
+                 weight: Optional[int] = None):
+        """`priority`/`weight` arm per-pool QoS scheduling (the serving
+        runtime's tenant knobs): priority orders pools strictly under
+        the lws scheduler — a higher-priority pool wins every select
+        boundary (wave-boundary preemption; negative = background) —
+        and weight stride-shares a priority tier.  Leaving both None
+        keeps the pool on the default path (no QoS counters)."""
         self.ctx = ctx
         self.globals_map: Dict[str, int] = {}
         vals: List[int] = []
@@ -25,6 +33,15 @@ class Taskpool:
         self._by_name: Dict[str, TaskClass] = {}
         self._committed = False
         self._destroyed = False
+        self.qos_priority: Optional[int] = None
+        self.qos_weight: Optional[int] = None
+        if priority is not None or weight is not None:
+            self.qos_priority = int(priority or 0)
+            self.qos_weight = max(1, int(weight if weight is not None
+                                         else 1))
+            N.lib.ptc_tp_set_qos(self._ptr, self.qos_priority,
+                                 self.qos_weight)
+        ctx._track_taskpool(self)
 
     # ------------------------------------------------------------- building
     def add(self, tc: TaskClass) -> TaskClass:
@@ -125,12 +142,35 @@ class Taskpool:
                 "taskpool aborted: a task body failed (see stderr)")
 
     @property
+    def tp_id(self) -> int:
+        """Distributed taskpool id (assigned at add; -1 before)."""
+        return N.lib.ptc_tp_id(self._ptr)
+
+    def qos_stats(self) -> Optional[Dict[str, int]]:
+        """Per-pool QoS counters, or None when QoS is not armed:
+        scheduled/selected tasks through the lws lanes, executed tasks
+        (any scheduler), lane wait nanoseconds, current queue depth, and
+        wave preemptions this pool won over a lower-priority lane."""
+        buf = (C.c_int64 * 8)()
+        n = N.lib.ptc_tp_qos_stats(self._ptr, buf, 8)
+        if n < 8:
+            return None
+        return {"priority": buf[0], "weight": buf[1], "scheduled": buf[2],
+                "selected": buf[3], "executed": buf[4], "wait_ns": buf[5],
+                "queued": buf[6], "preempts": buf[7]}
+
+    @property
     def nb_tasks(self) -> int:
         return N.lib.ptc_tp_nb_tasks(self._ptr)
 
     @property
     def nb_total_tasks(self) -> int:
         return N.lib.ptc_tp_nb_total_tasks(self._ptr)
+
+    @property
+    def nb_errors(self) -> int:
+        """Failed/dropped tasks (nonzero after an abort)."""
+        return N.lib.ptc_tp_nb_errors(self._ptr)
 
     def addto_nb_tasks(self, delta: int) -> int:
         """Adjust the pending-task count from a body or a user hook
@@ -159,18 +199,37 @@ class Taskpool:
         """Fire fn() exactly once when this taskpool completes (reference:
         tp->on_complete, the seam parsec_compose and recursive tasks build
         on — parsec/compound.c, parsec/recursive.h).  Runs on the
-        completing thread; must not block on this pool."""
+        completing thread; must not block on this pool.  Multiple
+        registrations chain: every fn fires, in registration order (the
+        serving layer stacks its retirement hook on top of the
+        engine's)."""
+        fns = getattr(self, "_complete_fns", None)
+        if fns is not None:
+            fns.append(fn)
+            return
+        self._complete_fns = [fn]
+
         def _cb(user, tp_ptr):
-            try:
-                fn()
-            except Exception:
-                traceback.print_exc()
+            for f in list(self._complete_fns):
+                try:
+                    f()
+                except Exception:
+                    traceback.print_exc()
 
         cb = N.TP_COMPLETE_CB_T(_cb)
         self._complete_cb = cb  # keep-alive
         N.lib.ptc_tp_set_on_complete(self._ptr, cb, None)
 
     def destroy(self):
-        if not self._destroyed:
+        if self._destroyed:
+            return
+        # the native free must not race a monitor thread reading this
+        # pool's qos_stats/tp_id (Context._qos_pool_rows holds the same
+        # lock for its whole walk)
+        self.ctx._ensure_tp_tracking()
+        with self.ctx._tp_lock:
+            if self._destroyed:
+                return
             self._destroyed = True
+            self.ctx._untrack_taskpool_locked(self)
             N.lib.ptc_tp_destroy(self._ptr)

@@ -1,0 +1,414 @@
+"""Paged KV-cache attention as PTG taskpools (the Ragged Paged
+Attention decode shape, arXiv:2604.15464, PAPERS.md).
+
+A sequence's KV cache lives in fixed-size PAGES — (page, d) tiles of two
+ordinary tiled collections — so the cache of thousands of concurrent
+sequences shares one pool of first-class runtime tiles: the PR 3
+residency planner stages, prefetches and evicts KV pages exactly like
+GEMM tiles, and the serving engine's admission control budgets them in
+bytes.  Decode is blockwise over pages with the online-softmax
+recurrence of ops/flash_attention.py carried task-to-task instead of
+kv-block-to-kv-block inside one kernel:
+
+  PUPD(s)      appends the step's new k/v row into the sequence's last
+               page (in place + runtime dataflow to the attention task)
+  PATTF(s, j)  folds FROZEN (full) page j into the (acc, m, l)
+               accumulator — a per-sequence chain, pages ragged per
+               sequence (pure-call lookup tables, verifier-exact)
+  PATTL(s)     folds the last (partial) page — received from PUPD
+               through the DAG, never stale — normalizes, writes O
+
+The prefill variant (build_paged_prefill) writes whole prompt pages
+(PFILL) and runs the same fold chain for the last prompt position.
+
+Bit-exactness contract: every fold uses `attend_page` below in f32 with
+a fixed operation order, so a batched decode step and a sequential
+per-request run produce IDENTICAL bytes — the serve bench's acceptance
+check rides on this.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..data.collections import TwoDimBlockCyclic
+
+__all__ = ["PagePool", "SeqSpec", "attend_page", "finalize_attention",
+           "build_paged_decode", "build_paged_prefill",
+           "make_slot_collections"]
+
+
+# ------------------------------------------------------------ page pool
+class PagePool:
+    """Fixed-size KV page pool: two tiled collections (K pages, V pages)
+    of (page, d) tiles plus a free-list allocator.  Pages are ordinary
+    collection tiles — the device residency planner manages them like
+    any other tile, and `bytes_per_page` feeds admission budgets."""
+
+    def __init__(self, ctx, n_pages: int, page: int, d: int,
+                 dtype=np.float32, name: str = "KV"):
+        self.n_pages, self.page, self.d = n_pages, page, d
+        self.dtype = np.dtype(dtype)
+        self.name = name
+        self.Kc = TwoDimBlockCyclic(n_pages * page, d, page, d, dtype=dtype)
+        self.Vc = TwoDimBlockCyclic(n_pages * page, d, page, d, dtype=dtype)
+        self.k_name, self.v_name = f"{name}_K", f"{name}_V"
+        self.Kc.register(ctx, self.k_name)
+        self.Vc.register(ctx, self.v_name)
+        self._free: List[int] = list(range(n_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def bytes_per_page(self) -> int:
+        return 2 * self.page * self.d * self.dtype.itemsize
+
+    def alloc(self) -> Optional[int]:
+        """One free page id, or None (backpressure signal)."""
+        return self._free.pop() if self._free else None
+
+    def free(self, pages: Sequence[int]):
+        for p in pages:
+            self._free.append(int(p))
+
+    def k_tile(self, p: int) -> np.ndarray:
+        return self.Kc.tile(p, 0)
+
+    def v_tile(self, p: int) -> np.ndarray:
+        return self.Vc.tile(p, 0)
+
+
+def make_slot_collections(ctx, max_seqs: int, d: int, name: str = "PA"):
+    """Per-slot scratch collections for `max_seqs` concurrent sequences:
+    Qc (1, d) query rows, ACCc (1, d+2) online-softmax accumulators
+    ([acc | m | l]), Oc (1, d) attention outputs, KNc (1, 2d) the new
+    token's k|v rows.  Registered as {name}_{Q,ACC,O,KN}."""
+    Qc = TwoDimBlockCyclic(max_seqs, d, 1, d, dtype=np.float32)
+    ACCc = TwoDimBlockCyclic(max_seqs, d + 2, 1, d + 2, dtype=np.float32)
+    Oc = TwoDimBlockCyclic(max_seqs, d, 1, d, dtype=np.float32)
+    KNc = TwoDimBlockCyclic(max_seqs, 2 * d, 1, 2 * d, dtype=np.float32)
+    names = {}
+    for suffix, coll in (("Q", Qc), ("ACC", ACCc), ("O", Oc), ("KN", KNc)):
+        n = f"{name}_{suffix}"
+        coll.register(ctx, n)
+        names[suffix] = n
+    return Qc, ACCc, Oc, KNc, names
+
+
+# ------------------------------------------------------ shared fold math
+def attend_page(q: np.ndarray, K: np.ndarray, V: np.ndarray,
+                acc: np.ndarray, m: float, l: float, scale: float):
+    """One online-softmax fold of `rows` K/V rows into (acc, m, l).
+    Pure f32 with a FIXED op order — the single definition both the DAG
+    bodies and the numpy reference call, so batched and sequential runs
+    are bit-identical."""
+    q = q.astype(np.float32, copy=False)
+    s = (K.astype(np.float32, copy=False) @ q) * np.float32(scale)
+    m_new = np.float32(max(np.float32(m), np.float32(s.max())))
+    p = np.exp((s - m_new).astype(np.float32))
+    corr = np.float32(np.exp(np.float32(m) - m_new))
+    l_new = np.float32(l) * corr + np.float32(p.sum(dtype=np.float32))
+    acc_new = acc.astype(np.float32, copy=False) * corr + \
+        p @ V.astype(np.float32, copy=False)
+    return acc_new.astype(np.float32), m_new, np.float32(l_new)
+
+
+def finalize_attention(acc: np.ndarray, l: float) -> np.ndarray:
+    return (acc / np.float32(max(float(l), 1e-30))).astype(np.float32)
+
+
+_NEG_BIG = np.float32(-1.0e30)
+
+
+def _acc_unpack(tile: np.ndarray):
+    d = tile.shape[1] - 2
+    return tile[0, :d], np.float32(tile[0, d]), np.float32(tile[0, d + 1])
+
+
+def _acc_pack(tile: np.ndarray, acc: np.ndarray, m, l):
+    d = tile.shape[1] - 2
+    tile[0, :d] = acc
+    tile[0, d] = m
+    tile[0, d + 1] = l
+
+
+def reset_acc(tile: np.ndarray):
+    """Accumulator tile initial value: acc=0, m=-big, l=0."""
+    tile[...] = 0.0
+    tile[0, tile.shape[1] - 2] = _NEG_BIG
+
+
+# ----------------------------------------------------------- seq specs
+class SeqSpec:
+    """One sequence's view of a decode step (or prefill):
+      slot    scratch-collection row (Q/ACC/O/KN index)
+      pages   page ids, oldest first; the LAST page receives the new row
+      fill    decode: row index the new token lands in (valid rows after
+              the step = fill + 1); prefill: rows already written is 0
+              and fill = rows used in the last page AFTER the prompt
+    """
+
+    __slots__ = ("slot", "pages", "fill")
+
+    def __init__(self, slot: int, pages: Sequence[int], fill: int):
+        self.slot = int(slot)
+        self.pages = [int(p) for p in pages]
+        self.fill = int(fill)
+        assert self.pages, "a sequence owns at least one page"
+        assert 0 <= self.fill
+
+
+def _tables(seqs: Sequence[SeqSpec]):
+    slot = [s.slot for s in seqs]
+    pages = [list(s.pages) for s in seqs]
+    nfro = [len(s.pages) - 1 for s in seqs]
+    last = [s.pages[-1] for s in seqs]
+    fill = [s.fill for s in seqs]
+    return slot, pages, nfro, last, fill
+
+
+# ------------------------------------------------------------- builders
+def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
+                       coll_names: Dict[str, str], *, scale: float = None,
+                       priority: Optional[int] = None,
+                       weight: Optional[int] = None,
+                       body_wrap: Optional[Callable] = None,
+                       dev=None):
+    """One continuous-batching DECODE step over `seqs` as a taskpool
+    (created with the given per-pool QoS priority/weight — the tenant
+    knobs).  Per sequence: PUPD appends the KN row into the last page,
+    PATTF folds each frozen page, PATTL folds the updated last page and
+    writes O.  `body_wrap` wraps the PATTL body (fault-injection seam
+    for the watchdog e2e).  With `dev`, the page-fold classes attach
+    device chores (per-task shapes are uniform: whole pages)."""
+    import parsec_tpu as pt
+
+    d, P = pool.d, pool.page
+    sc = (d ** -0.5) if scale is None else float(scale)
+    slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
+    qn, an, on, kn = (coll_names["Q"], coll_names["ACC"], coll_names["O"],
+                      coll_names["KN"])
+
+    tp = ctx.taskpool(globals={"NS": len(seqs) - 1}, priority=priority,
+                      weight=weight)
+    s = pt.L("s")
+    j = pt.L("j")
+    c_slot = pt.call(lambda locs, g: slot_t[locs[0]], pure=True)
+    c_nfro = pt.call(lambda locs, g: nfro_t[locs[0]], pure=True)
+    c_last = pt.call(lambda locs, g: last_t[locs[0]], pure=True)
+    c_page = pt.call(lambda locs, g: pages_t[locs[0]][locs[1]], pure=True)
+
+    upd = tp.task_class("PUPD")
+    upd.param("s", 0, pt.G("NS"))
+    upd.flow("KN", "READ", pt.In(pt.Mem(kn, c_slot, 0)))
+    upd.flow("KP", "RW", pt.In(pt.Mem(pool.k_name, c_last, 0)),
+             pt.Out(pt.Mem(pool.k_name, c_last, 0)),
+             pt.Out(pt.Ref("PATTL", s, flow="KP")))
+    upd.flow("VP", "RW", pt.In(pt.Mem(pool.v_name, c_last, 0)),
+             pt.Out(pt.Mem(pool.v_name, c_last, 0)),
+             pt.Out(pt.Ref("PATTL", s, flow="VP")))
+
+    def upd_body(v):
+        si = v["s"]
+        knrow = v.data("KN", np.float32, (1, 2 * d))
+        kp = v.data("KP", np.float32, (P, d))
+        vp = v.data("VP", np.float32, (P, d))
+        row = fill_t[si]
+        kp[row] = knrow[0, :d]
+        vp[row] = knrow[0, d:]
+
+    upd.body(upd_body)
+
+    fro = tp.task_class("PATTF")
+    fro.param("s", 0, pt.G("NS"))
+    fro.param("j", 0, c_nfro - 1)  # empty range when the seq has 1 page
+    fro.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
+    fro.flow("KP", "READ", pt.In(pt.Mem(pool.k_name, c_page, 0)))
+    fro.flow("VP", "READ", pt.In(pt.Mem(pool.v_name, c_page, 0)))
+    fro.flow("ACC", "RW",
+             pt.In(pt.Mem(an, c_slot, 0), guard=(j == 0)),
+             pt.In(pt.Ref("PATTF", s, j - 1, flow="ACC")),
+             pt.Out(pt.Ref("PATTF", s, j + 1, flow="ACC"),
+                    guard=(j < c_nfro - 1)),
+             pt.Out(pt.Ref("PATTL", s, flow="ACC"),
+                    guard=(j == c_nfro - 1)))
+
+    if dev is not None:
+        # device chore FIRST (the runtime takes the first enabled
+        # chore): frozen-page folds are shape-uniform (whole pages) —
+        # KV pages stage through the residency planner like any other
+        # tile.  PUPD/PATTL stay host (per-task ragged row counts).
+        def k_fold(qb, kb, vb, ab):
+            return _fold_kernel(qb, kb, vb, ab, sc)
+
+        dev.attach(fro, tp, kernel=k_fold, reads=["Q", "KP", "VP", "ACC"],
+                   writes=["ACC"],
+                   shapes={"Q": (1, d), "KP": (P, d), "VP": (P, d),
+                           "ACC": (1, d + 2)},
+                   dtype=np.float32, batch=False)
+
+    def fro_body(v):
+        q = v.data("Q", np.float32, (1, d))[0]
+        K = v.data("KP", np.float32, (P, d))
+        V = v.data("VP", np.float32, (P, d))
+        at = v.data("ACC", np.float32, (1, d + 2))
+        acc, m, l = _acc_unpack(at)
+        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
+        _acc_pack(at, acc, m, l)
+
+    fro.body(fro_body)
+
+    lst = tp.task_class("PATTL")
+    lst.param("s", 0, pt.G("NS"))
+    lst.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
+    lst.flow("KP", "READ", pt.In(pt.Ref("PUPD", s, flow="KP")))
+    lst.flow("VP", "READ", pt.In(pt.Ref("PUPD", s, flow="VP")))
+    # chain tail when frozen pages exist; ACC memory slot otherwise —
+    # selection rides the producer domain (PATTF(s, -1) does not exist),
+    # not a dynamic guard: the counting path stays exact
+    lst.flow("ACC", "RW",
+             pt.In(pt.Ref("PATTF", s, c_nfro - 1, flow="ACC")),
+             pt.In(pt.Mem(an, c_slot, 0)))
+    lst.flow("O", "RW", pt.In(pt.Mem(on, c_slot, 0)),
+             pt.Out(pt.Mem(on, c_slot, 0)))
+
+    def lst_body(v):
+        si = v["s"]
+        rows = fill_t[si] + 1  # old rows + the row PUPD just wrote
+        q = v.data("Q", np.float32, (1, d))[0]
+        K = v.data("KP", np.float32, (P, d))[:rows]
+        V = v.data("VP", np.float32, (P, d))[:rows]
+        at = v.data("ACC", np.float32, (1, d + 2))
+        acc, m, l = _acc_unpack(at)
+        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
+        v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
+
+    lst.body(body_wrap(lst_body) if body_wrap else lst_body)
+    return tp
+
+
+def _fold_kernel(qb, kb, vb, ab, sc):
+    """jnp form of attend_page for the device chore (frozen pages)."""
+    import jax.numpy as jnp
+    d = qb.shape[1]
+    acc, m, l = ab[0, :d], ab[0, d], ab[0, d + 1]
+    s = (kb @ qb[0]) * sc
+    m_new = jnp.maximum(m, s.max())
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum()
+    acc_new = acc * corr + p @ vb
+    return jnp.concatenate([acc_new, m_new[None], l_new[None]])[None, :]
+
+
+def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
+                        coll_names: Dict[str, str], prompt_name: str,
+                        prompt_tiles: Sequence[Sequence[int]], *,
+                        scale: float = None,
+                        priority: Optional[int] = None,
+                        weight: Optional[int] = None,
+                        body_wrap: Optional[Callable] = None):
+    """PREFILL as a taskpool: PFILL(s, j) writes page j of sequence s
+    from the staged prompt collection (`prompt_name`, one (page, 2d)
+    k|v tile per written page, indices in `prompt_tiles[s][j]`), then
+    the PATTF/PATTL fold chain computes attention for the LAST prompt
+    position over all written rows.  `seqs[i].fill` is the row count
+    used in the last page (1..page)."""
+    import parsec_tpu as pt
+
+    d, P = pool.d, pool.page
+    sc = (d ** -0.5) if scale is None else float(scale)
+    slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
+    ptiles = [list(row) for row in prompt_tiles]
+    qn, an, on = coll_names["Q"], coll_names["ACC"], coll_names["O"]
+
+    tp = ctx.taskpool(globals={"NS": len(seqs) - 1}, priority=priority,
+                      weight=weight)
+    s = pt.L("s")
+    j = pt.L("j")
+    c_slot = pt.call(lambda locs, g: slot_t[locs[0]], pure=True)
+    c_nfro = pt.call(lambda locs, g: nfro_t[locs[0]], pure=True)
+    c_npag = pt.call(lambda locs, g: nfro_t[locs[0]], pure=True)
+    c_page = pt.call(lambda locs, g: pages_t[locs[0]][locs[1]], pure=True)
+    c_ptile = pt.call(lambda locs, g: ptiles[locs[0]][locs[1]], pure=True)
+
+    fil = tp.task_class("PFILL")
+    fil.param("s", 0, pt.G("NS"))
+    fil.param("j", 0, c_npag)  # 0..npages-1 == 0..nfro
+    fil.flow("SRC", "READ", pt.In(pt.Mem(prompt_name, c_ptile, 0)))
+    fil.flow("KP", "RW", pt.In(pt.Mem(pool.k_name, c_page, 0)),
+             pt.Out(pt.Mem(pool.k_name, c_page, 0)),
+             pt.Out(pt.Ref("PATTF", s, j, flow="KP"),
+                    guard=(j < c_nfro)),
+             pt.Out(pt.Ref("PATTL", s, flow="KP"),
+                    guard=(j == c_nfro)))
+    fil.flow("VP", "RW", pt.In(pt.Mem(pool.v_name, c_page, 0)),
+             pt.Out(pt.Mem(pool.v_name, c_page, 0)),
+             pt.Out(pt.Ref("PATTF", s, j, flow="VP"),
+                    guard=(j < c_nfro)),
+             pt.Out(pt.Ref("PATTL", s, flow="VP"),
+                    guard=(j == c_nfro)))
+
+    def fil_body(v):
+        si = v["s"]
+        rows = P if v["j"] < nfro_t[si] else fill_t[si]
+        src = v.data("SRC", np.float32, (P, 2 * d))
+        kp = v.data("KP", np.float32, (P, d))
+        vp = v.data("VP", np.float32, (P, d))
+        kp[:rows] = src[:rows, :d]
+        vp[:rows] = src[:rows, d:]
+
+    fil.body(fil_body)
+
+    fro = tp.task_class("PATTF")
+    fro.param("s", 0, pt.G("NS"))
+    fro.param("j", 0, c_nfro - 1)
+    fro.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
+    fro.flow("KP", "READ", pt.In(pt.Ref("PFILL", s, j, flow="KP")))
+    fro.flow("VP", "READ", pt.In(pt.Ref("PFILL", s, j, flow="VP")))
+    fro.flow("ACC", "RW",
+             pt.In(pt.Mem(an, c_slot, 0), guard=(j == 0)),
+             pt.In(pt.Ref("PATTF", s, j - 1, flow="ACC")),
+             pt.Out(pt.Ref("PATTF", s, j + 1, flow="ACC"),
+                    guard=(j < c_nfro - 1)),
+             pt.Out(pt.Ref("PATTL", s, flow="ACC"),
+                    guard=(j == c_nfro - 1)))
+
+    def fro_body(v):
+        q = v.data("Q", np.float32, (1, d))[0]
+        K = v.data("KP", np.float32, (P, d))
+        V = v.data("VP", np.float32, (P, d))
+        at = v.data("ACC", np.float32, (1, d + 2))
+        acc, m, l = _acc_unpack(at)
+        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
+        _acc_pack(at, acc, m, l)
+
+    fro.body(fro_body)
+
+    lst = tp.task_class("PATTL")
+    lst.param("s", 0, pt.G("NS"))
+    lst.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
+    lst.flow("KP", "READ", pt.In(pt.Ref("PFILL", s, c_nfro, flow="KP")))
+    lst.flow("VP", "READ", pt.In(pt.Ref("PFILL", s, c_nfro, flow="VP")))
+    lst.flow("ACC", "RW",
+             pt.In(pt.Ref("PATTF", s, c_nfro - 1, flow="ACC")),
+             pt.In(pt.Mem(an, c_slot, 0)))
+    lst.flow("O", "RW", pt.In(pt.Mem(on, c_slot, 0)),
+             pt.Out(pt.Mem(on, c_slot, 0)))
+
+    def lst_body(v):
+        si = v["s"]
+        rows = fill_t[si]
+        q = v.data("Q", np.float32, (1, d))[0]
+        K = v.data("KP", np.float32, (P, d))[:rows]
+        V = v.data("VP", np.float32, (P, d))[:rows]
+        at = v.data("ACC", np.float32, (1, d + 2))
+        acc, m, l = _acc_unpack(at)
+        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
+        v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
+
+    lst.body(body_wrap(lst_body) if body_wrap else lst_body)
+    return tp

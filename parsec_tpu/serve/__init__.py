@@ -1,0 +1,28 @@
+"""ptc-serve: multi-tenant serving runtime over the task runtime.
+
+The runtime so far ran one taskpool to completion; a serving system
+runs thousands of small concurrent DAGs under priority and admission
+control (ROADMAP item 3, "millions of users").  This package is that
+layer:
+
+  Server            admission-controlled front door: per-tenant
+                    concurrent-pool and queued-bytes budgets, queue or
+                    reject beyond them, per-pool QoS (priority/weight)
+                    stamped on every admitted taskpool; counters export
+                    through Context.stats()["serve"] and the PR 7
+                    MetricsRegistry (Prometheus + /stats.json)
+  InferenceEngine   continuous-batching LLM inference scenario: paged
+                    KV-cache attention DAGs (ops/paged_attention) for
+                    prefill and per-step decode, sequences admitted and
+                    retired continuously as mixed-priority tenants
+  PagedLM           deterministic toy attention LM (f32, fixed op
+                    order) whose batched and sequential runs are
+                    bit-identical — the serve bench's correctness oracle
+"""
+from .server import (AdmissionError, Server, TenantConfig, Ticket)
+from .engine import InferenceEngine, PagedLM, PagedLMConfig, RequestHandle
+
+__all__ = [
+    "Server", "TenantConfig", "Ticket", "AdmissionError",
+    "InferenceEngine", "PagedLM", "PagedLMConfig", "RequestHandle",
+]

@@ -1,0 +1,434 @@
+"""Continuous-batching LLM inference engine over paged KV-cache DAGs.
+
+The serving scenario proving the runtime end-to-end (ROADMAP item 3):
+many concurrent sequences, each owned by a tenant, generate tokens
+step-by-step.  Every PREFILL is one admission-controlled taskpool
+(Server front door: per-tenant budgets, QoS priority/weight); every
+DECODE step builds one taskpool PER TENANT batching that tenant's
+active sequences (continuous batching: sequences join after prefill and
+retire mid-stream, pools churn every step).  KV pages are first-class
+runtime tiles (ops/paged_attention.PagePool) budgeted by the admission
+layer and managed by the device residency planner when a TpuDevice is
+attached.
+
+The model (PagedLM) is a deterministic single-layer attention LM in
+f32 with a FIXED operation order — the engine's batched run and a
+sequential per-request run produce bit-identical outputs, which is the
+serve bench's correctness acceptance.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..data.collections import TwoDimBlockCyclic
+from ..ops.paged_attention import (PagePool, SeqSpec, attend_page,
+                                   finalize_attention, build_paged_decode,
+                                   build_paged_prefill,
+                                   make_slot_collections, reset_acc)
+from .server import ResourceBusy, Server, TenantConfig
+
+__all__ = ["PagedLMConfig", "PagedLM", "InferenceEngine", "RequestHandle"]
+
+
+# ---------------------------------------------------------------- model
+class PagedLMConfig:
+    def __init__(self, vocab: int = 64, d: int = 16, page: int = 8,
+                 seed: int = 0):
+        self.vocab, self.d, self.page, self.seed = vocab, d, page, seed
+
+
+class PagedLM:
+    """Deterministic toy attention LM: fixed random embed/projections
+    (f32).  qkv() and logits() are plain numpy with one op order, so
+    every execution schedule reproduces the same bytes."""
+
+    def __init__(self, cfg: PagedLMConfig):
+        self.cfg = cfg
+        rng = np.random.RandomState(cfg.seed)
+        d, v = cfg.d, cfg.vocab
+        self.embed = rng.randn(v, d).astype(np.float32) * np.float32(0.5)
+        self.wq = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
+        self.wk = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
+        self.wv = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
+        self.wo = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
+
+    def qkv(self, token: int):
+        e = self.embed[int(token)]
+        return e @ self.wq, e @ self.wk, e @ self.wv
+
+    def logits(self, o: np.ndarray) -> np.ndarray:
+        return (o @ self.wo) @ self.embed.T.astype(np.float32)
+
+    def next_token(self, o: np.ndarray) -> int:
+        return int(np.argmax(self.logits(o)))
+
+    # ------------------------------------------------- numpy reference
+    def reference_generate(self, prompt: Sequence[int], max_new: int,
+                           page: Optional[int] = None):
+        """Pure-numpy oracle using the SAME page blocking and fold order
+        as the DAG (attend_page per page) — bit-identical to the engine.
+        Returns (tokens, outputs[n_steps, d])."""
+        P = self.cfg.page if page is None else page
+        d = self.cfg.d
+        ks: List[np.ndarray] = []
+        vs: List[np.ndarray] = []
+        toks = [int(t) for t in prompt]
+        for t in toks:
+            _, k, v = self.qkv(t)
+            ks.append(k)
+            vs.append(v)
+        outs = []
+        for _ in range(max_new):
+            q = self.qkv(toks[-1])[0]
+            acc = np.zeros(d, np.float32)
+            m, l = np.float32(-1.0e30), np.float32(0.0)
+            for off in range(0, len(ks), P):
+                K = np.stack(ks[off:off + P])
+                V = np.stack(vs[off:off + P])
+                acc, m, l = attend_page(q, K, V, acc, m, l, d ** -0.5)
+            o = finalize_attention(acc, l)
+            outs.append(o)
+            nxt = self.next_token(o)
+            toks.append(nxt)
+            _, k, v = self.qkv(nxt)
+            ks.append(k)
+            vs.append(v)
+        return toks, np.stack(outs) if outs else np.zeros((0, d), np.float32)
+
+
+# ------------------------------------------------------------- requests
+class RequestHandle:
+    """One inference request's lifecycle: prefill ticket (admission) +
+    generated tokens/outputs filled in by the decode loop."""
+
+    __slots__ = ("rid", "tenant", "prompt", "max_new", "ticket", "tokens",
+                 "outputs", "state", "submitted_t", "done_t", "_seq")
+
+    def __init__(self, rid: int, tenant: str, prompt: Sequence[int],
+                 max_new: int):
+        self.rid = rid
+        self.tenant = tenant
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.ticket = None
+        self.tokens: List[int] = list(self.prompt)
+        self.outputs: List[np.ndarray] = []
+        self.state = "submitted"  # -> active -> done | rejected | failed
+        self.submitted_t = time.monotonic()
+        self.done_t: Optional[float] = None
+        self._seq = None
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.done_t is None:
+            return None
+        return self.done_t - self.submitted_t
+
+    @property
+    def generated(self) -> List[int]:
+        return self.tokens[len(self.prompt):]
+
+
+class _Seq:
+    """Engine-internal active-sequence state."""
+
+    __slots__ = ("req", "slot", "pages", "length", "remaining")
+
+    def __init__(self, req: RequestHandle, slot: int, pages: List[int],
+                 length: int):
+        self.req = req
+        self.slot = slot
+        self.pages = pages
+        self.length = length          # tokens materialized in pages
+        self.remaining = req.max_new  # decode steps left
+
+
+# --------------------------------------------------------------- engine
+class InferenceEngine:
+    """Continuous-batching driver.
+
+    submit() routes each request's PREFILL pool through the Server
+    (admission + tenant QoS); step() builds one DECODE pool per tenant
+    over that tenant's active sequences, runs them concurrently (the
+    scheduler's QoS lanes arbitrate), applies the model head, appends
+    tokens, and retires finished sequences (pages + slots freed, pools
+    destroyed).  run() loops until every request is terminal.
+
+    `body_wrap` wraps every decode PATTL body — the fault-injection seam
+    the watchdog tail-latency e2e uses."""
+
+    def __init__(self, ctx, model: PagedLM, n_pages: int = 64,
+                 max_seqs: int = 16, server: Optional[Server] = None,
+                 tenants: Optional[List[TenantConfig]] = None,
+                 name: str = "eng", body_wrap: Optional[Callable] = None,
+                 dev=None):
+        cfg = model.cfg
+        self.ctx = ctx
+        self.model = model
+        self.pool = PagePool(ctx, n_pages, cfg.page, cfg.d,
+                             name=f"{name}_KV")
+        (self.Qc, self.ACCc, self.Oc, self.KNc,
+         self.slot_names) = make_slot_collections(ctx, max_seqs, cfg.d,
+                                                  name=f"{name}_PA")
+        self.max_seqs = max_seqs
+        self._free_slots = list(range(max_seqs - 1, -1, -1))
+        self.server = server or Server(
+            ctx, tenants or [TenantConfig("default")], name=name)
+        self.body_wrap = body_wrap
+        self.dev = dev
+        self._lock = threading.Lock()
+        self._active: List[_Seq] = []
+        self._inflight: Dict[str, tuple] = {}  # tenant -> (tp, seqs, ev)
+        self._next_rid = 0
+        self._next_prompt_tile = 0
+        self._prompt_coll_name = f"{name}_PR"
+        # staged prompt k|v pages; grows with the largest in-flight
+        # prompt set (tiles recycle per prefill pool)
+        self._prompt_tiles = 256
+        self.PRc = TwoDimBlockCyclic(self._prompt_tiles * cfg.page,
+                                     2 * cfg.d, cfg.page, 2 * cfg.d,
+                                     dtype=np.float32)
+        self.PRc.register(ctx, self._prompt_coll_name)
+        self.requests: List[RequestHandle] = []
+        self.stats = {"decode_pools": 0, "decode_steps": 0,
+                      "prefills": 0, "retired": 0, "page_stalls": 0}
+
+    def _host_wrote(self, coll, m: int, n: int = 0):
+        """The engine rewrote a slot tile's HOST bytes directly (numpy,
+        outside the runtime) — with a device attached, any mirror of it
+        is stale and must drop (the copy version cannot tell: no
+        runtime write happened)."""
+        if self.dev is None:
+            return
+        d = coll._datas.get((m, n))
+        if d is None:
+            return
+        from .. import _native as N
+        h = N.lib.ptc_copy_handle(N.lib.ptc_data_host_copy(d._ptr))
+        if h:
+            for dv in list(self.ctx._devices):
+                dv._drop_mirror(h)
+            N.lib.ptc_device_clear_data_owner(self.ctx._ptr, h, -1)
+
+    # ------------------------------------------------------------ submit
+    def submit(self, prompt: Sequence[int], max_new: int,
+               tenant: str = "default") -> RequestHandle:
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = RequestHandle(rid, tenant, prompt, max_new)
+        self.requests.append(req)
+        P = self.model.cfg.page
+        n_pages = (len(req.prompt) + P - 1) // P
+        est = n_pages * self.pool.bytes_per_page
+        req.ticket = self.server.submit(
+            tenant, lambda priority, weight, req=req: self._build_prefill(
+                req, priority, weight),
+            est_bytes=est, meta={"rid": rid})
+        if req.ticket.state == "rejected":
+            req.state = "rejected"
+            req.done_t = time.monotonic()
+        return req
+
+    def _build_prefill(self, req: RequestHandle, priority, weight):
+        """Server-side builder: allocate slot + pages (ResourceBusy when
+        exhausted — backpressure), stage prompt k|v, build the pool."""
+        cfg = self.model.cfg
+        P, d = cfg.page, cfg.d
+        T = len(req.prompt)
+        n_pages = (T + P - 1) // P
+        with self._lock:
+            if not self._free_slots or self.pool.free_pages < n_pages:
+                self.stats["page_stalls"] += 1
+                raise ResourceBusy(
+                    f"slots={len(self._free_slots)} "
+                    f"pages={self.pool.free_pages}<{n_pages}")
+            slot = self._free_slots.pop()
+            pages = [self.pool.alloc() for _ in range(n_pages)]
+            ptile0 = self._next_prompt_tile
+            self._next_prompt_tile = (ptile0 + n_pages) % \
+                self._prompt_tiles
+        # stage prompt k|v into the PR collection + the last token's q
+        kv = np.zeros((n_pages * P, 2 * d), np.float32)
+        for i, tok in enumerate(req.prompt):
+            _, k, v = self.model.qkv(tok)
+            kv[i, :d] = k
+            kv[i, d:] = v
+        ptiles = [(ptile0 + i) % self._prompt_tiles
+                  for i in range(n_pages)]
+        for i, pt_i in enumerate(ptiles):
+            self.PRc.tile(pt_i, 0)[...] = kv[i * P:(i + 1) * P]
+            self._host_wrote(self.PRc, pt_i)
+        q = self.model.qkv(req.prompt[-1])[0]
+        self.Qc.tile(slot, 0)[0] = q
+        reset_acc(self.ACCc.tile(slot, 0))
+        self._host_wrote(self.Qc, slot)
+        self._host_wrote(self.ACCc, slot)
+        fill = T - (n_pages - 1) * P
+        spec = SeqSpec(slot, pages, fill)
+        tp = build_paged_prefill(
+            self.ctx, self.pool, [spec],
+            {"Q": self.slot_names["Q"], "ACC": self.slot_names["ACC"],
+             "O": self.slot_names["O"]},
+            self._prompt_coll_name, [ptiles],
+            priority=priority, weight=weight)
+        tp.on_complete(lambda: self._prefill_done(req, spec))
+        self.stats["prefills"] += 1
+        return tp
+
+    def _prefill_done(self, req: RequestHandle, spec: SeqSpec):
+        """Worker-thread callback: activate the sequence + consume the
+        first decode output (the prefill chain already attended the
+        last prompt position)."""
+        o = self.Oc.tile(spec.slot, 0)[0].copy()
+        req.outputs.append(o)
+        nxt = self.model.next_token(o)
+        req.tokens.append(nxt)
+        seq = _Seq(req, spec.slot, spec.pages, len(req.prompt))
+        seq.remaining = req.max_new - 1
+        req._seq = seq
+        req.state = "active"
+        with self._lock:
+            if seq.remaining <= 0:
+                self._retire_locked(seq)
+            else:
+                self._active.append(seq)
+
+    # -------------------------------------------------------------- step
+    def _launch(self) -> int:
+        """Build + run one decode pool per tenant that has active
+        sequences and no decode pool in flight.  Tenants advance
+        INDEPENDENTLY — a high-priority tenant's pools complete faster
+        under the QoS lanes, so its tokens/sec (and latency) pull ahead
+        instead of lock-stepping with every other tenant's wave."""
+        cfg = self.model.cfg
+        P, d = cfg.page, cfg.d
+        with self._lock:
+            ready: Dict[str, List[_Seq]] = {}
+            for seq in self._active:
+                tenant = seq.req.tenant
+                if tenant in self._inflight:
+                    continue
+                # grow the page list when the last page is full
+                if seq.length % P == 0 and len(seq.pages) * P <= \
+                        seq.length:
+                    p = self.pool.alloc()
+                    if p is None:
+                        self.stats["page_stalls"] += 1
+                        continue
+                    seq.pages.append(p)
+                ready.setdefault(tenant, []).append(seq)
+        launched = 0
+        for tenant, seqs in ready.items():
+            ts = self.server._tenants.get(tenant)
+            prio, wt = (ts.cfg.priority, ts.cfg.weight) if ts else (0, 1)
+            specs = []
+            for seq in seqs:
+                tok = seq.req.tokens[-1]
+                q, k, v = self.model.qkv(tok)
+                self.Qc.tile(seq.slot, 0)[0] = q
+                knrow = self.KNc.tile(seq.slot, 0)
+                knrow[0, :d] = k
+                knrow[0, d:] = v
+                reset_acc(self.ACCc.tile(seq.slot, 0))
+                for coll in (self.Qc, self.KNc, self.ACCc):
+                    self._host_wrote(coll, seq.slot)
+                specs.append(SeqSpec(seq.slot, seq.pages,
+                                     seq.length % P))
+            tp = build_paged_decode(
+                self.ctx, self.pool, specs, self.slot_names,
+                priority=prio, weight=wt, body_wrap=self.body_wrap,
+                dev=self.dev)
+            done = threading.Event()
+            tp.on_complete(done.set)
+            self._inflight[tenant] = (tp, seqs, done)
+            tp.run()
+            self.stats["decode_pools"] += 1
+            launched += 1
+        return launched
+
+    def _reap(self) -> int:
+        """Consume completed decode pools: apply the model head, append
+        tokens, retire finished sequences, destroy the pools.  Returns
+        sequences advanced."""
+        done = [(t, rec) for t, rec in self._inflight.items()
+                if rec[2].is_set()]
+        advanced = 0
+        for tenant, (tp, seqs, _) in done:
+            del self._inflight[tenant]
+            for seq in seqs:
+                o = self.Oc.tile(seq.slot, 0)[0].copy()
+                seq.req.outputs.append(o)
+                nxt = self.model.next_token(o)
+                seq.req.tokens.append(nxt)
+                seq.length += 1
+                seq.remaining -= 1
+                advanced += 1
+            tp.destroy()
+            self.stats["decode_steps"] += 1
+        with self._lock:
+            for seq in [s for s in self._active if s.remaining <= 0]:
+                self._retire_locked(seq)
+        return advanced
+
+    def step(self) -> int:
+        """Synchronous decode wave: launch every launchable tenant pool,
+        wait for ALL in-flight pools, reap.  Returns sequences
+        advanced (0 = nothing active)."""
+        self._launch()
+        for _, (_, _, done) in list(self._inflight.items()):
+            done.wait()
+        return self._reap()
+
+    def _retire_locked(self, seq: _Seq):
+        if seq in self._active:
+            self._active.remove(seq)
+        self.pool.free(seq.pages)
+        self._free_slots.append(seq.slot)
+        seq.req.state = "done"
+        seq.req.done_t = time.monotonic()
+        self.stats["retired"] += 1
+        # pages/slots freed outside pool completion: unblock
+        # ResourceBusy-paused tenants (lock order: engine -> server is
+        # safe — server never calls into the engine under its lock)
+        self.server.notify_resources()
+
+    # --------------------------------------------------------------- run
+    def pending(self) -> bool:
+        with self._lock:
+            active = bool(self._active)
+        if active:
+            return True
+        for req in self.requests:
+            if req.state in ("submitted", "active") and \
+                    req.ticket is not None and \
+                    req.ticket.state not in ("rejected", "failed"):
+                return True
+        return False
+
+    def run(self, timeout_s: float = 120.0):
+        """Drive the continuous-batching loop until every request is
+        terminal: tenants launch and reap decode pools independently
+        (QoS latency separation), the admission queue drains through
+        the server's pump as capacity frees."""
+        deadline = time.monotonic() + timeout_s
+        while self.pending() or self._inflight:
+            if time.monotonic() > deadline:
+                raise TimeoutError("serving loop exceeded its deadline")
+            launched = self._launch()
+            reaped = self._reap()
+            if not launched and not reaped:
+                time.sleep(0.0005)  # waiting on pools / prefills
+        # requests that never passed admission keep their terminal state
+        for req in self.requests:
+            if req.state == "submitted" and req.ticket is not None and \
+                    req.ticket.state in ("rejected", "failed"):
+                req.state = req.ticket.state
+                req.done_t = req.done_t or time.monotonic()
+
+    def close(self):
+        self.server.close()

@@ -322,6 +322,18 @@ register("sched.bypass", True, bool,
          "skipping the schedule()+select() round trip (reference: "
          "keep_highest_priority_task, parsec/scheduling.c:373-396).  "
          "Bypass hits are counted per worker (Context.sched_stats)")
+register("sched.qos_preempt", True, bool,
+         "per-pool QoS wave-boundary preemption (serving runtime): on = "
+         "a worker re-ranks the QoS lanes by priority at EVERY select, "
+         "so a higher-priority pool wins the next wave; off = the "
+         "worker drains the lane it last served until empty (the "
+         "preemption-off control the serve bench compares against).  "
+         "QoS pools are created via Context.taskpool(priority=, "
+         "weight=); selects/preempts are counted (Context.sched_stats)")
+register("serve.admission_grace_s", 0.0, float,
+         "Server: seconds a rejected submission is retried internally "
+         "before the reject counter ticks (0 = reject immediately; "
+         "backpressure-sensitive clients can poll the ticket instead)")
 register("device.dp_transfer", False, bool,
          "cross-process device data plane via jax.experimental.transfer: "
          "PK_DEVICE payloads between NON-colocated ranks are pulled "

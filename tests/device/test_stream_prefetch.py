@@ -70,11 +70,18 @@ def test_unified_stats_schema_single_rank():
         try:
             s = ctx.stats()
             assert set(s) == {"sched", "device", "comm", "coll", "trace",
-                              "metrics"}
+                              "metrics", "serve"}
             for k in ("level", "ring_bytes", "dropped_events", "clock"):
                 assert k in s["trace"], k
             assert "bypass_hits" in s["sched"]
             assert "steals" in s["sched"]
+            # PR 9: per-pool QoS rows + lane counters (serving runtime)
+            for k in ("qos_selects", "qos_preempts",
+                      "qos_preempt_enabled", "pools"):
+                assert k in s["sched"], k
+            assert isinstance(s["sched"]["pools"], list)
+            # PR 9: serving namespace — schema-stable with no Server
+            assert s["serve"] == {"enabled": False}
             for k in ("prefetch_hits", "spills", "stream_serves",
                       "prefetch_wakeups", "overlap_ratio", "devices"):
                 assert k in s["device"], k

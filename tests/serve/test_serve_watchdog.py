@@ -1,0 +1,57 @@
+"""Flight-recorder tail-latency capture inside the serving loop: a
+delay-injected decode step (faults.py "delay" mode) trips the PR 7
+watchdog's adaptive deadline mid-serve, emits a stuck_task event, and
+dumps .watchdog.<rank>.ptt — the ROADMAP's "flight recorder capturing a
+tail-latency incident" evidence, pinned end-to-end."""
+import glob
+import os
+
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.profiling.metrics import Watchdog
+from parsec_tpu.serve import (InferenceEngine, PagedLM, PagedLMConfig,
+                              TenantConfig)
+from parsec_tpu.utils.faults import FaultInjector
+
+
+def test_stuck_decode_step_dumps_flight_recorder(tmp_path):
+    from parsec_tpu.utils import params as _mca
+    prefix = str(tmp_path / "serveflight")
+    _mca.set("runtime.trace_dump", prefix)
+    try:
+        model = PagedLM(PagedLMConfig(vocab=32, d=8, page=4, seed=5))
+        # one PATTL invocation sleeps 1.2 s — the wedged-accelerator
+        # shape; every other decode step completes normally
+        inj = FaultInjector(mode="delay", at_invocation=2, delay_s=1.2)
+        with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+            ctx.profile_enable(1)          # the dump needs a trace
+            ctx.profile_ring(1 << 16)      # flight-recorder ring mode
+            wd = Watchdog(ctx, interval=0.05, k=8.0, floor_s=0.4)
+            ctx._watchdog = wd  # stats()/healthz surface it
+            eng = InferenceEngine(ctx, model, n_pages=16, max_seqs=4,
+                                  tenants=[TenantConfig("t", priority=2)],
+                                  body_wrap=inj.wrap)
+            h = eng.submit([3, 1, 4, 1, 5], 4, "t")
+            eng.run(timeout_s=120)
+            assert h.state == "done"
+            # the delayed request still completed CORRECTLY (tail
+            # latency, not corruption)
+            rt, _ = model.reference_generate([3, 1, 4, 1, 5], 4)
+            assert h.tokens == rt
+            assert inj.injected == 1
+            kinds = {e["type"] for e in wd.events}
+            assert "stuck_task" in kinds, wd.events
+            ev = [e for e in wd.events if e["type"] == "stuck_task"][0]
+            assert ev["task_class"] == "PATTL"
+            dumps = glob.glob(prefix + ".watchdog.*.ptt")
+            assert dumps, "no flight-recorder dump written"
+            assert os.path.getsize(dumps[0]) > 0
+            # the dump is a loadable .ptt trace
+            from parsec_tpu.profiling.trace import Trace
+            tr = Trace.load(dumps[0])
+            assert len(tr.events) > 0
+            wd.stop()
+            eng.close()
+    finally:
+        _mca.unset("runtime.trace_dump")

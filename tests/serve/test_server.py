@@ -1,0 +1,150 @@
+"""Server admission control: budgets, queueing, rejection, ResourceBusy
+backpressure, QoS stamping, stats export."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.serve import AdmissionError, Server, TenantConfig
+from parsec_tpu.serve.server import ResourceBusy
+
+
+def _chain_pool(ctx, n=20, body=None):
+    """A tiny n-task chain pool builder honoring the QoS kwargs."""
+    def make(priority, weight):
+        tp = ctx.taskpool(globals={"N": n - 1}, priority=priority,
+                          weight=weight)
+        tc = tp.task_class("C")
+        tc.param("k", 0, pt.G("N"))
+        tc.flow("X", "RW",
+                pt.In(None, guard=(pt.L("k") == 0)),
+                pt.In(pt.Ref("C", pt.L("k") - 1, flow="X")),
+                pt.Out(pt.Ref("C", pt.L("k") + 1, flow="X"),
+                       guard=(pt.L("k") < pt.G("N"))), arena="t")
+        if body is not None:
+            tc.body(body)
+        else:
+            tc.body_noop()
+        return tp
+    return make
+
+
+def test_admit_queue_reject_counters():
+    gate = threading.Event()
+
+    def slow_body(v):
+        gate.wait(10)
+
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        srv = Server(ctx, [TenantConfig("a", max_pools=1, max_queue=2)])
+        # 1 admitted + 2 queued + 2 rejected
+        tickets = [srv.submit("a", _chain_pool(ctx, 4, slow_body))
+                   for _ in range(5)]
+        states = sorted(t.state for t in tickets)
+        assert states.count("rejected") == 2, states
+        st = srv.stats()["tenants"]["a"]
+        assert st["submitted"] == 5 and st["rejected"] == 2
+        assert st["active_pools"] == 1 and st["queue_depth"] == 2
+        gate.set()
+        assert srv.drain(timeout=30)
+        st = srv.stats()["tenants"]["a"]
+        assert st["completed"] == 3 and st["active_pools"] == 0
+        for t in tickets:
+            assert t.terminal
+            if t.state == "done":
+                assert t.latency_s is not None and t.latency_s >= 0
+        srv.close()
+
+
+def test_queued_bytes_budget():
+    gate = threading.Event()
+
+    def slow_body(v):
+        gate.wait(10)
+
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        srv = Server(ctx, [TenantConfig("a", max_pools=1, max_queue=100,
+                                        max_queued_bytes=1000)])
+        mk = _chain_pool(ctx, 4, slow_body)
+        srv.submit("a", mk, est_bytes=100)          # admitted
+        t1 = srv.submit("a", mk, est_bytes=600)     # queued (600)
+        t2 = srv.submit("a", mk, est_bytes=600)     # over budget
+        assert t1.state == "queued"
+        assert t2.state == "rejected"
+        with pytest.raises(AdmissionError):
+            srv.submit("a", mk, est_bytes=600, wait=True)
+        assert srv.stats()["tenants"]["a"]["queued_bytes"] == 600
+        gate.set()
+        assert srv.drain(timeout=30)
+        srv.close()
+
+
+def test_resource_busy_requeues_until_notified():
+    calls = {"n": 0}
+
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        srv = Server(ctx, [TenantConfig("a", max_pools=2, max_queue=8)])
+        inner = _chain_pool(ctx, 4)
+
+        def busy_once(priority, weight):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ResourceBusy("no pages")
+            return inner(priority, weight)
+
+        t = srv.submit("a", busy_once)
+        time.sleep(0.1)
+        assert t.state == "queued"  # parked, tenant blocked
+        assert srv.stats()["tenants"]["a"]["resource_waits"] == 1
+        srv.notify_resources()  # the engine-retirement signal
+        assert t.wait(timeout=30) == "done"
+        assert calls["n"] == 2
+        srv.close()
+
+
+def test_qos_stamped_and_stats_flatten():
+    """Admitted pools carry the tenant's priority/weight (visible in
+    sched.pools while running) and the serve namespace flattens into
+    ptc_serve_* Prometheus samples."""
+    gate = threading.Event()
+
+    def slow_body(v):
+        gate.wait(10)
+
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        srv = Server(ctx, [TenantConfig("a", priority=3, weight=2,
+                                        max_pools=2, max_queue=4)])
+        srv.submit("a", _chain_pool(ctx, 6, slow_body))
+        time.sleep(0.05)
+        rows = ctx.stats()["sched"]["pools"]
+        assert any(r["priority"] == 3 and r["weight"] == 2 for r in rows)
+        s = ctx.stats()["serve"]
+        assert s["enabled"] is True
+        assert s["tenants"]["a"]["priority"] == 3
+        text = ctx.metrics_registry().prometheus_text()
+        assert "ptc_serve_tenants_a_admitted" in text
+        assert "ptc_serve_totals_rejected" in text
+        gate.set()
+        assert srv.drain(timeout=30)
+        srv.close()
+        # closed server detaches from the stats namespace
+        assert ctx.stats()["serve"] == {"enabled": False}
+
+
+def test_failed_pool_counted():
+    def boom(v):
+        raise RuntimeError("injected")
+
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        srv = Server(ctx, [TenantConfig("a")])
+        t = srv.submit("a", _chain_pool(ctx, 3, boom))
+        assert t.wait(timeout=30) == "failed"
+        assert srv.stats()["tenants"]["a"]["failed"] == 1
+        srv.close()

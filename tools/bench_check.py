@@ -53,6 +53,14 @@ CHECKS = [
      "higher", 0.15, True),
     ("BENCH_device.json", "out_of_core_gemm.correct", "equal", 0.0,
      False),
+    # serving runtime (PR 9): hi-tenant p99 improvement over the no-QoS
+    # control is timing (trajectory-guarded, oversubscription-slacked);
+    # the in-document beats-control verdict and the continuous-vs-
+    # sequential bit-exactness are correctness flags — never relaxed
+    ("BENCH_serve.json", "hi_p99_improvement", "higher", 0.50, True),
+    ("BENCH_serve.json", "qos.hi_p99_beats_control", "equal", 0.0,
+     False),
+    ("BENCH_serve.json", "decode.bit_identical", "equal", 0.0, False),
 ]
 
 

@@ -298,6 +298,103 @@ def metrics_watchdog_coll(workers: int, elems: int, port: int,
                 os.environ[k] = v
 
 
+def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
+                env=None) -> None:
+    """Serving-runtime stress under a 2-rank context (one process, a
+    thread per rank): each rank runs a Server with two QoS tenants and
+    TWO concurrent submitter threads hammering admission — per-pool QoS
+    lane pushes/pops from every worker, concurrent taskpool
+    creation/retirement (pump-thread destroys racing worker
+    completions), admission queue/reject churn, and qos_stats reads
+    from the stats thread — while comm fences run.  TSan watches the
+    new lane machinery, the tp->qos counters, and the grow-only lane
+    table publication in one address space."""
+    import threading
+
+    from parsec_tpu.serve import Server, TenantConfig
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    errs = []
+
+    def rank_prog(rank):
+        try:
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            with ctx:
+                ctx.register_arena("t", 8)
+                srv = Server(ctx, [
+                    TenantConfig("hi", priority=4, weight=3,
+                                 max_pools=3, max_queue=64),
+                    TenantConfig("lo", priority=0, weight=1,
+                                 max_pools=3, max_queue=64),
+                ])
+
+                def mk(priority, weight):
+                    tp = ctx.taskpool(globals={"N": 15},
+                                      priority=priority, weight=weight)
+                    tc = tp.task_class("C")
+                    tc.param("k", 0, pt.G("N"))
+                    tc.flow("X", "RW",
+                            pt.In(None, guard=(pt.L("k") == 0)),
+                            pt.In(pt.Ref("C", pt.L("k") - 1, flow="X")),
+                            pt.Out(pt.Ref("C", pt.L("k") + 1, flow="X"),
+                                   guard=(pt.L("k") < pt.G("N"))),
+                            arena="t")
+                    tc.body_noop()
+                    return tp
+
+                def submitter(tenant):
+                    for _ in range(pools_per_tenant):
+                        srv.submit(tenant, mk)
+
+                subs = [threading.Thread(target=submitter, args=(t,))
+                        for t in ("hi", "lo")]
+                stop = threading.Event()
+
+                def stats_reader():
+                    while not stop.is_set():
+                        ctx.sched_stats()
+                        srv.stats()
+                        stop.wait(0.005)
+
+                rd = threading.Thread(target=stats_reader, daemon=True)
+                rd.start()
+                for t in subs:
+                    t.start()
+                for t in subs:
+                    t.join(timeout=120)
+                assert srv.drain(timeout=120)
+                stop.set()
+                rd.join(timeout=10)
+                st = srv.stats()["totals"]
+                assert st["completed"] == 2 * pools_per_tenant, st
+                srv.close()
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
     """Concurrent consumers of the same (copy, [type]) — the memoized
     reshape cache's create/hit race — plus write-back version bumps that
@@ -382,6 +479,9 @@ def main():
                               env={"PTC_MCA_comm_eager_limit": "0",
                                    "PTC_MCA_comm_chunk_size": "2048",
                                    "PTC_MCA_comm_rails": "2"})
+        # serving runtime (PR 9): QoS lanes + concurrent pool
+        # creation/retirement + admission churn under a 2-rank context
+        serve_churn(workers=4, port=30020 + rep)
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")
 

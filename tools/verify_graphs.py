@@ -188,6 +188,28 @@ def g_ops_flash_attention(ctx):
              build_flash_attention(ctx, Qc, Kc, Vc, Oc, causal=True))]
 
 
+def g_paged_attention(ctx):
+    """The serving runtime's paged KV-cache attention builders
+    (ops/paged_attention): a ragged multi-sequence DECODE step (1/2/3
+    pages per sequence — the pure-call lookup tables must verify
+    exactly) and a PREFILL with a partial last page."""
+    from parsec_tpu.ops.paged_attention import (PagePool, SeqSpec,
+                                                build_paged_decode,
+                                                build_paged_prefill,
+                                                make_slot_collections)
+    pool = PagePool(ctx, 12, 4, 8, name="KV")
+    _, _, _, _, names = make_slot_collections(ctx, 4, 8, name="PA")
+    seqs = [SeqSpec(0, [0, 1, 2], 1), SeqSpec(1, [3], 0),
+            SeqSpec(2, [4, 5], 3)]
+    dec = build_paged_decode(ctx, pool, seqs, names)
+    PRc = TwoDimBlockCyclic(8 * 4, 16, 4, 16, dtype=np.float32)
+    PRc.register(ctx, "PR")
+    pseqs = [SeqSpec(0, [6, 7], 2), SeqSpec(1, [8], 4)]
+    pre = build_paged_prefill(ctx, pool, pseqs, names, "PR",
+                              [[0, 1], [2]])
+    return [("ops_paged_decode", dec), ("ops_paged_prefill", pre)]
+
+
 def g_coll(ctx):
     """The ptc_coll_* step/leaf/src/gw classes (comm/coll.py) for every
     reduction topology plus the fan-out leg, planned for a 4-rank shape
@@ -240,6 +262,7 @@ GENERATORS = {
     "ring_attention": g_ring_attention,
     "ops_rms_norm": g_ops_rms_norm,
     "ops_flash_attention": g_ops_flash_attention,
+    "paged_attention": g_paged_attention,
     "coll": g_coll,
 }
 
