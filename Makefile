@@ -57,10 +57,18 @@ tidy:
 	fi
 
 # Static dataflow verification of every in-tree graph generator
-# (tools/verify_graphs.py -> parsec_tpu/analysis rules V001-V008).
+# (tools/verify_graphs.py -> parsec_tpu/analysis rules V001-V009).
 # Exit 1 = a graph regressed the clean baseline.
 verify-graphs: $(LIB)
 	python tools/verify_graphs.py
+
+# Static resource & schedule analysis of every in-tree graph generator
+# (tools/plan_graphs.py -> parsec_tpu/analysis/plan.py): every graph
+# must plan CLEAN (no enumeration refusal, finite residency/makespan
+# bounds) and the potrf bench tiling must plan inside its latency
+# budget.  Emits PLAN_graphs.json (bench_check guards potrf_nt16_ms).
+plan-graphs: $(LIB)
+	python tools/plan_graphs.py --json PLAN_graphs.json
 
 # Transfer-economics sweep (tools/testbandwidth.py): eager / rendezvous
 # / PK_DEVICE paths on loopback, fitted fixed-overhead + per-byte cost,
@@ -126,10 +134,10 @@ bench-trace: $(LIB)
 bench-check:
 	python tools/bench_check.py
 
-# Default check recipe: bench-trajectory guard + graph hygiene + native
-# lint — regressions in any fail fast.
-check: bench-check verify-graphs tidy
+# Default check recipe: bench-trajectory guard + graph hygiene (verify
+# + plan baselines) + native lint — regressions in any fail fast.
+check: bench-check verify-graphs plan-graphs tidy
 
-.PHONY: all clean tsan ubsan tidy verify-graphs check bench-comm \
-	bench-dispatch bench-device bench-stream bench-collective \
-	bench-trace bench-serve bench-check
+.PHONY: all clean tsan ubsan tidy verify-graphs plan-graphs check \
+	bench-comm bench-dispatch bench-device bench-stream \
+	bench-collective bench-trace bench-serve bench-check

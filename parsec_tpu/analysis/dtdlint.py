@@ -17,6 +17,11 @@ raise).  Rules carry stable IDs like the V-rules:
         OUTPUT with no later reader in the pool — the write is never
         observed through the dataflow (warning; the backing memory
         still holds it).
+  D104  tile/arena stride mismatch: an inserted tile whose backing
+        data's byte size disagrees with its collection's declared
+        stride (mb x nb x itemsize — what device staging and the
+        arena-backed wire path assume).  Caught statically at insert,
+        before the runtime truncates or over-reads the payload.
 """
 from __future__ import annotations
 
@@ -26,6 +31,7 @@ DTD_RULES: Dict[str, str] = {
     "D101": "undeclared access-mode conflict in one task",
     "D102": "tile use after taskpool finalize",
     "D103": "dead store: OUTPUT tile never read afterwards",
+    "D104": "tile byte size disagrees with its collection's stride",
 }
 
 
@@ -83,6 +89,22 @@ class DtdLinter:
         for tile, mode in args:
             key = id(tile)
             st = self._tiles.get(key)
+            stride = getattr(tile, "coll_stride", None)
+            nbytes = getattr(tile, "nbytes", None)
+            if st is None and stride is not None and nbytes is not None \
+                    and nbytes != stride:
+                # first sight of the tile: its data size must match the
+                # collection's declared stride, or the runtime's staging
+                # and wire paths truncate or over-read the payload
+                self._emit(
+                    "D104", "error",
+                    f"task #{self._task_no}: {self._tname(tile)} backs "
+                    f"{nbytes} B but its collection declares a "
+                    f"{stride} B tile stride — device staging and the "
+                    "arena-backed wire path move stride-sized "
+                    "payloads, so this tile would be truncated or "
+                    "over-read; fix the collection's tile allocation "
+                    "(or its declared mb/nb/dtype)")
             if getattr(tile, "_lint_finalized", False):
                 self._emit(
                     "D102", "error",

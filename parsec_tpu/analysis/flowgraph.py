@@ -34,6 +34,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .. import _native as N
 from ..core import expr as E
 from ..core.taskclass import Mem, Ref
@@ -359,6 +361,43 @@ class ClassModel:
             for di, d in enumerate(fl.deps):
                 self._dep_info[(fi, di)] = self._prep_dep(d)
         self._domain_cache = None  # None = undecided; False = dynamic
+        # placement affinity (": desc(m, n)"): the instance executes on
+        # rank_of(*idx) of the affinity collection — the rank mapping
+        # that V009 and the ptc-plan residency/comm analyses evaluate
+        aff = getattr(tc, "_affinity", None)
+        self._aff_coll = aff.collection if aff is not None else None
+        self._aff_fns = ([cc.compile(e, self.names) for e in aff.idx]
+                         if aff is not None else [])
+
+    def rank_of_instance(self, l: list) -> Optional[int]:
+        """Rank this instance executes on (affinity collection's
+        rank_of over the evaluated placement indices), or None when the
+        mapping is unknowable statically (no affinity declared, no
+        Python collection object registered, or rank_of raising on an
+        out-of-range probe)."""
+        if self._aff_coll is None:
+            return None
+        coll = self.fg.collection_objs.get(self._aff_coll)
+        if coll is None:
+            return None
+        try:
+            return int(coll.rank_of(*[fn(l) for fn in self._aff_fns]))
+        except Exception:
+            return None
+
+    def mem_owner_rank(self, fi: int, di: int, l: list) -> Optional[int]:
+        """Owner rank of the collection datum a Mem dep addresses, or
+        None when unknowable (same caveats as rank_of_instance)."""
+        info = self._dep_info[(fi, di)]
+        if info.get("kind") != "mem":
+            return None
+        coll = self.fg.collection_objs.get(info["coll"])
+        if coll is None:
+            return None
+        try:
+            return int(coll.rank_of(*[fn(l) for fn in info["idx"]]))
+        except Exception:
+            return None
 
     # ------------------------------------------------------------ prep
     def _prep_dep(self, d) -> dict:
@@ -744,6 +783,10 @@ class FlowGraph:
         self.arena_sizes = dict(getattr(ctx, "arena_sizes", {}))
         self.datatype_bytes = dict(getattr(ctx, "datatype_bytes", {}))
         self.collections = dict(getattr(ctx, "collections", {}))
+        # name -> the Python collection object (rank_of + geometry);
+        # native-only (linear) collections register a shim with the same
+        # duck type, so rank mapping and tile-byte sizing stay uniform
+        self.collection_objs = dict(getattr(ctx, "collection_objs", {}))
         self.classes: List[ClassModel] = [ClassModel(self, tc)
                                           for tc in tp.classes]
         self.by_name = {cm.name: cm for cm in self.classes}
@@ -856,6 +899,40 @@ class ConcreteGraph:
 
     def nb_instances(self) -> int:
         return sum(len(v) for v in self.instances.values())
+
+
+def collection_tile_bytes(coll) -> Optional[int]:
+    """Per-datum payload bytes of a collection, from its declared
+    geometry (the full mb x nb allocation the device stages and the
+    arena-backed wire path assumes; boundary tiles are padded to it).
+    None when the collection exposes no recognizable geometry."""
+    if coll is None:
+        return None
+    try:
+        if hasattr(coll, "mb") and hasattr(coll, "nb") \
+                and hasattr(coll, "dtype"):
+            return int(coll.mb) * int(coll.nb) * \
+                int(np.dtype(coll.dtype).itemsize)
+        if hasattr(coll, "nb") and hasattr(coll, "dtype"):
+            return int(coll.nb) * int(np.dtype(coll.dtype).itemsize)
+        if hasattr(coll, "elem_size"):
+            return int(coll.elem_size)
+    except Exception:
+        return None
+    return None
+
+
+class LinearCollectionShim:
+    """Stand-in for natively-registered linear collections
+    (Context.register_linear_collection): rank_of(k) = k % nodes and a
+    fixed elem_size — enough for rank mapping + byte sizing."""
+
+    def __init__(self, nodes: int, elem_size: int):
+        self.nodes = nodes
+        self.elem_size = elem_size
+
+    def rank_of(self, k: int) -> int:
+        return int(k) % max(1, self.nodes)
 
 
 def extract_flowgraph(tp) -> FlowGraph:

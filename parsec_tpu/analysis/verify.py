@@ -26,6 +26,11 @@ stable ID and reports class/flow/dep source locations:
   V008  ptc_coll_* usage-contract violation (PR 6 constraints: data IN
         deps of collective step classes must carry no guards — a
         guarded IN would be counted as a maybe-input and wait forever)
+  V009  rank-mapping soundness: a data input read straight from a
+        collection datum whose owner rank differs from the consuming
+        instance's placement rank — memory reads are affine with
+        placement in this runtime (there is no wire path for a Mem
+        IN), so the consumer reads an uninitialized local mirror
 
 Affine/interval reasoning handles what it can prove symbolically
 (V004's never-in-domain proof); bounded concrete enumeration of the
@@ -53,6 +58,7 @@ RULES: Dict[str, str] = {
     "V006": "never-read OUT dependency (dead dataflow)",
     "V007": "dtype/shape mismatch across an edge",
     "V008": "ptc_coll_* usage-contract violation",
+    "V009": "memory read of a remote-owned collection datum",
 }
 
 _MAX_SAMPLES = 4
@@ -529,11 +535,67 @@ def _v005_write_races(cg: ConcreteGraph) -> List[Finding]:
     return out
 
 
+def _v009_rank_mapping(cg: ConcreteGraph) -> List[Finding]:
+    """V009: a concretized instance whose SELECTED data input is a Mem
+    read of a collection datum owned by a different rank than the one
+    the instance executes on (placement affinity).  Unlike task
+    deliveries — which ride the wire — a Mem IN has no transport: the
+    consuming rank reads its local mirror buffer, which was never
+    materialized (gemm_dist's docstring: memory reads must be affine
+    with placement; the fix is a reader task placed AT the datum that
+    forwards it as a task dependency)."""
+    out: Dict[tuple, Finding] = {}
+    fg = cg.fg
+    for cm in fg.classes:
+        if cm._aff_coll is None:
+            continue  # placement unknowable: nothing provable
+        mem_fis = [
+            (fi, ) for fi in range(len(cm.flows))
+            if not cm.is_ctl(fi)
+            and any(d.direction == 0 and isinstance(d.target, Mem)
+                    for d in cm.flows[fi].deps)]
+        if not mem_fis:
+            continue
+        for params in cg.instances.get(cm.id, []):
+            node = (cm.id, params)
+            l = cm.fill_locals(params)
+            trank = cm.rank_of_instance(l)
+            if trank is None:
+                continue
+            for (fi, ) in mem_fis:
+                di = cg.selected.get((node, fi))
+                if di is None:
+                    continue
+                info = cm._dep_info[(fi, di)]
+                if info["kind"] != "mem":
+                    continue
+                orank = cm.mem_owner_rank(fi, di, l)
+                if orank is None or orank == trank:
+                    continue
+                key = (cm.id, fi, di)
+                f = out.get(key)
+                if f is None:
+                    f = out[key] = Finding(
+                        "V009", "error", cm.name, cm.flows[fi].name, di,
+                        cm.dep_loc(fi, di),
+                        f"memory read of {info['coll']!r} data owned "
+                        "by another rank: the instance executes where "
+                        "its affinity datum lives but this IN has no "
+                        "wire path — the rank reads an uninitialized "
+                        "local mirror.  Read it through a task placed "
+                        "at the datum instead (gemm_dist ReadA/ReadB "
+                        "pattern)", count=0)
+                f.count += 1
+                if len(f.instances) < _MAX_SAMPLES:
+                    f.instances.append(params)
+    return list(out.values())
+
+
 # ================================================================ driver
 
 def verify_graph(fg: FlowGraph, max_instances: int = 200_000,
                  ignore: Sequence[str] = ()) -> Report:
-    """Run the V001-V008 rule engine over an extracted flow graph."""
+    """Run the V001-V009 rule engine over an extracted flow graph."""
     t0 = time.perf_counter()
     findings: List[Finding] = []
     notes: List[str] = []
@@ -555,9 +617,10 @@ def verify_graph(fg: FlowGraph, max_instances: int = 200_000,
         findings += _v003_cycles(cg)
         findings += _v005_write_races(cg)
         findings += _v006_never_read_out(cg)
+        findings += _v009_rank_mapping(cg)
     else:
         findings += sym_v004
-        notes.append("instance-level rules (V001/V003/V005/V006) "
+        notes.append("instance-level rules (V001/V003/V005/V006/V009) "
                      "skipped: raise max_instances to enable")
     if ignore:
         ign = set(ignore)

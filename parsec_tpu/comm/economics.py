@@ -124,6 +124,23 @@ class TransferEconomics:
         """Modeled seconds for one transfer of `nbytes` on `path`."""
         return self.alpha(path) + nbytes * self.beta(path)
 
+    def eager_threshold(self, fallback: int = 64 * 1024) -> int:
+        """Fitted eager/rendezvous crossover in bytes: the payload size
+        where the modeled eager cost overtakes the rendezvous cost
+        (alpha_e + n*beta_e = alpha_r + n*beta_r), clamped to the same
+        [16 KiB, 16 MiB] window the adaptive calibration uses.  When the
+        sweep carries no separate eager and rdv fits (or eager's
+        per-byte cost does not exceed rdv's, so the lines never cross),
+        `fallback` — typically the static comm.eager_limit — answers.
+        This is the split ptc-plan's comm-volume analysis models."""
+        if "eager" not in self.fits or "rdv" not in self.fits:
+            return fallback
+        be, br = self.beta("eager"), self.beta("rdv")
+        if be <= br:
+            return fallback
+        n = (self.alpha("rdv") - self.alpha("eager")) / (be - br)
+        return int(min(16 << 20, max(16 << 10, n)))
+
     # ---------------------------------------------------------- selector
     def topology_costs(self, kind: str, nbytes: int, nranks: int,
                        path: str = "rdv") -> Dict[str, float]:

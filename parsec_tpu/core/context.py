@@ -165,6 +165,15 @@ class Context:
         self._datas: List[Data] = []
         self._buffers: List[np.ndarray] = []
         self.collections: Dict[str, int] = {}
+        # name -> Python collection object (or a shim for native linear
+        # collections): rank_of + geometry, read by the static analyses
+        # (ptc-verify V009 rank-mapping, ptc-plan residency/comm bounds)
+        self.collection_objs: Dict[str, object] = {}
+        # ptc-plan pre-run check counters (device.plan_check knob;
+        # exported as the stats()["plan"] namespace)
+        self._plan_stats: Dict[str, int] = {
+            "checks": 0, "over_budget": 0, "predicted_spills": 0,
+            "last_peak_bytes": 0, "last_budget_bytes": 0}
         self.arenas: Dict[str, int] = {}
         self.arena_sizes: Dict[str, int] = {}  # name -> elem bytes
         self.datatypes: Dict[str, int] = {}
@@ -573,7 +582,11 @@ class Context:
           serve   -> serving front door (parsec_tpu.serve.Server):
                      admission/queue/reject counters per tenant;
                      {"enabled": False} when no Server is attached
+          plan    -> ptc-plan pre-run checks (device.plan_check knob):
+                     check/over-budget counters and the last predicted
+                     peak vs budget
         """
+        from ..utils import params as _plan_mca
         tuning = self.comm_tuning()
         wd = getattr(self, "_watchdog", None)
         exp = getattr(self, "_metrics_exporter", None)
@@ -609,6 +622,9 @@ class Context:
                 "exporter_port": exp.port if exp is not None else 0,
                 "watchdog": wd.status() if wd is not None else None,
             },
+            "plan": dict(
+                enabled=_plan_mca.get("device.plan_check") != "off",
+                **getattr(self, "_plan_stats", {})),
         }
 
     # ------------------------------------------------------------ registries
@@ -643,6 +659,8 @@ class Context:
             self._ptr, nodes, myrank, array.ctypes.data_as(C.c_void_p),
             nb, elem_size)
         self.collections[name] = dc
+        from ..analysis.flowgraph import LinearCollectionShim
+        self.collection_objs[name] = LinearCollectionShim(nodes, elem_size)
         return dc
 
     def register_collection(self, name: str, coll) -> int:
@@ -664,6 +682,7 @@ class Context:
             self._ptr, getattr(coll, "nodes", 1), getattr(coll, "myrank", 0),
             rcb, dcb, None)
         self.collections[name] = dc
+        self.collection_objs[name] = coll
         return dc
 
     def register_arena(self, name: str, elem_size: int) -> int:
@@ -859,7 +878,8 @@ class Context:
                 "spills", "spill_bytes", "h2d_stall_ns",
                 "prefetch_h2d_ns", "ooc_waits", "h2d_hits", "h2d_bytes",
                 "evictions", "stream_serves", "stream_slices",
-                "stream_d2h_ns", "stream_bytes", "prefetch_wakeups")
+                "stream_d2h_ns", "stream_bytes", "prefetch_wakeups",
+                "cache_peak_bytes")
         agg = {k: sum(d["stats"].get(k, 0) for d in devs) for k in keys}
         moved = agg["prefetch_h2d_ns"] + agg["h2d_stall_ns"]
         agg["overlap_ratio"] = (

@@ -119,6 +119,20 @@ class Taskpool:
             print(report.text(), file=sys.stderr)
         return report
 
+    def plan(self, max_instances: Optional[int] = None, cost=None,
+             econ=None, workers: Optional[int] = None):
+        """Run the static resource & schedule analyzer (ptc-plan,
+        analysis/plan.py) over this pool's task-class tables — nothing
+        executes.  Returns a Plan: per-rank peak tile residency
+        (no-eviction working set + interval-liveness floor), the wave
+        decomposition, per-(src, dst) comm volume split eager/rdv, and
+        the critical-path/work makespan lower bounds.  `cost` defaults
+        to the context's live per-class latency histograms when they
+        carry samples (CostModel.from_context), else a uniform model."""
+        from ..analysis.plan import plan_taskpool
+        return plan_taskpool(self, max_instances=max_instances,
+                             cost=cost, econ=econ, workers=workers)
+
     def run(self, verify=None) -> "Taskpool":
         """commit + add to context + start (convenience).
 
@@ -126,9 +140,20 @@ class Taskpool:
         time: "error"/True raises VerifyError before anything is
         scheduled when a V-rule error-severity finding exists (the
         known findings are silent runtime hangs — see
-        analysis/verify.py); "warn" prints findings and proceeds."""
+        analysis/verify.py); "warn" prints findings and proceeds.
+
+        With device.plan_check armed (warn|error), every attached
+        device runs the ptc-plan pre-run residency check before the
+        pool schedules: predicted device peak vs its byte budget (see
+        TpuDevice.plan_check)."""
         if verify:
             self.verify(mode=verify)
+        from ..utils import params as _mca
+        pc_mode = _mca.get("device.plan_check")
+        if pc_mode and pc_mode != "off" and self.classes \
+                and getattr(self.ctx, "_devices", None):
+            for dev in list(self.ctx._devices):
+                dev.plan_check(self, mode=pc_mode)
         self.commit()
         rc = N.lib.ptc_context_add_taskpool(self.ctx._ptr, self._ptr)
         if rc != 0:

@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import _native as N
+from ..analysis.plan import PlanCheckError
 from ..core.context import Context
 from ..core.taskclass import Mem, TaskClass, TaskView
 from ..core.taskpool import Taskpool
@@ -921,7 +922,11 @@ class TpuDevice:
                       # driven prefetch wakeups on remote delivery)
                       "stream_serves": 0, "stream_slices": 0,
                       "stream_d2h_ns": 0, "stream_bytes": 0,
-                      "prefetch_wakeups": 0}
+                      "prefetch_wakeups": 0,
+                      # high-water mark of the accounted device bytes —
+                      # the measured side of the ptc-plan peak-residency
+                      # bound (plan-vs-measured tests)
+                      "cache_peak_bytes": 0}
         # native hook: copies dying with a device mirror drop it (a dead
         # dirty mirror is garbage by definition — no consumer remains).
         # ONE callback per context fanning out to all its devices — a
@@ -1024,6 +1029,8 @@ class TpuDevice:
                 rec[0] += 1
         else:
             self._cache_used += ent.nbytes
+        if self._cache_used > self.stats["cache_peak_bytes"]:
+            self.stats["cache_peak_bytes"] = self._cache_used
 
     def _uncharge(self, ent: _CacheEnt):
         if ent.stack is not None:
@@ -1249,6 +1256,75 @@ class TpuDevice:
         an over-budget cache evicts/spills then, not here."""
         with self._lock:
             self._cache_bytes = int(nbytes)
+
+    def plan_check(self, tp, mode: Optional[str] = None, plan=None):
+        """Pre-run residency check (ptc-plan): compare the pool's
+        predicted per-rank DEVICE working set against this device's
+        byte budget before anything schedules.
+
+          fits            -> silent (counters only)
+          over budget,
+          out_of_core=0   -> warn to stderr, or raise PlanCheckError
+                             with mode="error" — the run would pin HBM
+                             until it OOMs
+          over budget,
+          out_of_core=1   -> warn with the PREDICTED SPILL COUNT (the
+                             run completes out-of-core; the number is
+                             the d2h write-back traffic to expect)
+
+        `mode` defaults to the device.plan_check MCA param; Taskpool.run
+        calls this automatically when the knob is armed.  Analysis
+        failures never block a run (warned, counted as a skipped
+        check).  Returns the (possibly supplied) Plan, or None when the
+        pool has no device-chore classes or analysis failed."""
+        import sys as _sys
+        from ..utils import params as _mca
+        if mode is None:
+            mode = _mca.get("device.plan_check")
+        if not mode or mode == "off":
+            return None
+        try:
+            if plan is None:
+                plan = tp.plan()
+        except Exception as e:  # analysis must never kill a run
+            _sys.stderr.write(f"ptc [plan]: plan_check skipped: {e}\n")
+            return None
+        if not plan.has_device_classes:
+            return None
+        rank = getattr(self.ctx, "myrank", 0)
+        peak = plan.peak_bytes(rank=rank if rank in plan.per_rank
+                               else None, device_only=True)
+        ps = self.ctx._plan_stats
+        with self._lock:
+            budget = self._cache_bytes
+        ps["checks"] += 1
+        ps["last_peak_bytes"] = int(peak or 0)
+        ps["last_budget_bytes"] = int(budget)
+        if plan.bounded and peak is None:
+            _sys.stderr.write(
+                "ptc [plan]: plan_check inconclusive (symbolic bound "
+                "unavailable); proceeding\n")
+            return plan
+        if peak <= budget:
+            return plan
+        ps["over_budget"] += 1
+        if self._ooc:
+            spills = plan.predict_spills(budget, rank=rank,
+                                         device_only=True)
+            ps["predicted_spills"] += spills
+            _sys.stderr.write(
+                f"ptc [plan]: predicted device working set {peak} B "
+                f"exceeds cache budget {budget} B; out-of-core will "
+                f"spill (~{spills} predicted write-backs)\n")
+            return plan
+        msg = (f"predicted device working set {peak} B exceeds the "
+               f"cache budget {budget} B with device.out_of_core=0: "
+               "the run would pin HBM past budget (raise the budget, "
+               "re-enable out-of-core, or shrink the tiling)")
+        if mode == "error":
+            raise PlanCheckError(msg)
+        _sys.stderr.write(f"ptc [plan]: {msg}\n")
+        return plan
 
     def _cache_ent(self, uid, version) -> Optional["_CacheEnt"]:
         """Entry lookup without materializing _StackRefs (batched stage-in

@@ -64,14 +64,23 @@ class DtdView:
 class DtdTile:
     """Handle to a tracked datum (reference: parsec_dtd_tile_of).  `owner`
     is the rank that executes tasks writing this tile (distributed DTD
-    placement; other ranks keep shadow tasks + mirror copies)."""
+    placement; other ranks keep shadow tasks + mirror copies).
 
-    __slots__ = ("_ptr", "data", "owner", "_lint_finalized")
+    `nbytes`/`coll_stride` carry the tile's actual payload size vs its
+    collection's declared stride for the insertion linter's D104
+    size-mismatch rule (None when the source declares no geometry)."""
 
-    def __init__(self, ctx: Context, data: Data, owner: int = 0):
+    __slots__ = ("_ptr", "data", "owner", "_lint_finalized", "nbytes",
+                 "coll_stride")
+
+    def __init__(self, ctx: Context, data: Data, owner: int = 0,
+                 coll_stride: Optional[int] = None):
         self.data = data
         self.owner = owner
         self._lint_finalized = False  # set by the DTD linter on destroy
+        self.nbytes = int(data.array.nbytes) \
+            if getattr(data, "array", None) is not None else None
+        self.coll_stride = coll_stride
         self._ptr = N.lib.ptc_dtile_new(ctx._ptr, data._ptr)
         if owner:
             N.lib.ptc_dtile_set_owner(self._ptr, owner)
@@ -116,7 +125,10 @@ class DtdTaskpool:
         if k not in self._tiles:
             d = source.data_of(*key)
             own = owner if owner is not None else source.rank_of(*key)
-            self._tiles[k] = DtdTile(self.ctx, d, own)
+            from ..analysis.flowgraph import collection_tile_bytes
+            self._tiles[k] = DtdTile(self.ctx, d, own,
+                                     coll_stride=collection_tile_bytes(
+                                         source))
         return self._tiles[k]
 
     # ------------------------------------------------------------- insert

@@ -52,13 +52,18 @@ class TenantConfig:
 
     def __init__(self, name: str, priority: int = 0, weight: int = 1,
                  max_pools: int = 4, max_queue: int = 64,
-                 max_queued_bytes: Optional[int] = None):
+                 max_queued_bytes: Optional[int] = None,
+                 default_est_bytes: Optional[int] = None):
         self.name = name
         self.priority = int(priority)
         self.weight = max(1, int(weight))
         self.max_pools = max(1, int(max_pools))
         self.max_queue = max(0, int(max_queue))
         self.max_queued_bytes = max_queued_bytes
+        # byte estimate for submissions that declare none (est_bytes=0
+        # means UNKNOWN): None = derive the static ptc-plan bound from
+        # the submitted pool instead (see Server.submit)
+        self.default_est_bytes = default_est_bytes
 
 
 class Ticket:
@@ -69,10 +74,12 @@ class Ticket:
                  "admitted_t", "done_t", "error", "_event", "_make_pool",
                  "_pool")
 
-    def __init__(self, tenant: str, make_pool: Callable, est_bytes: int,
+    def __init__(self, tenant: str, make_pool: Callable, est_bytes,
                  meta):
         self.tenant = tenant
-        self.est_bytes = int(est_bytes)
+        # None = unknown AND statically unboundable (rejected whenever a
+        # byte budget is in force — the budget can never be evaded)
+        self.est_bytes = None if est_bytes is None else int(est_bytes)
         self.meta = meta
         self.state = "queued"
         self.submitted_t = time.monotonic()
@@ -160,11 +167,29 @@ class Server:
         """Submit one request DAG.  Returns its Ticket immediately
         (state "queued", "running" — admitted synchronously — or
         "rejected").  wait=True blocks for the terminal state and
-        raises AdmissionError on rejection."""
+        raises AdmissionError on rejection.
+
+        `est_bytes` <= 0 means UNKNOWN (it used to silently bypass the
+        max_queued_bytes backpressure — see MIGRATION.md).  When the
+        tenant has a byte budget in force, an unknown estimate resolves
+        to the tenant's `default_est_bytes`, or — when none is
+        configured — the server builds the pool NOW and takes the
+        static ptc-plan working-set bound (`Taskpool.plan().est_bytes`);
+        the built pool is reused at admission, never built twice.  A
+        submission whose bytes cannot be bounded at all is REJECTED
+        whenever the byte budget applies: the budget can no longer be
+        evaded."""
         if self._closed:
             raise RuntimeError("server closed")
         t = self._tenants[tenant]
         ticket = Ticket(tenant, make_pool, est_bytes, meta)
+        if (ticket.est_bytes is None or ticket.est_bytes <= 0) \
+                and t.cfg.max_queued_bytes is not None:
+            early = self._resolve_est(t, ticket)
+            if early is not None:  # ResourceBusy / failure at build
+                if wait and not ticket.terminal:
+                    ticket.wait()
+                return ticket
         admit_now = False
         with self._lock:
             t.counters["submitted"] += 1
@@ -174,12 +199,14 @@ class Server:
                 t.active += 1  # reserve before dropping the lock
             elif self._can_queue(t, ticket):
                 t.queue.append(ticket)
-                t.queued_bytes += ticket.est_bytes
+                t.queued_bytes += ticket.est_bytes or 0
             else:
                 t.counters["rejected"] += 1
                 ticket.state = "rejected"
                 ticket.done_t = time.monotonic()
                 ticket._event.set()
+        if ticket.state == "rejected" and ticket._pool is not None:
+            self._destroy_pool(ticket)  # planning pool never admitted
         if admit_now:
             self._admit(t, ticket)
         if wait and not ticket.terminal:
@@ -188,15 +215,54 @@ class Server:
             raise AdmissionError(
                 f"tenant {tenant!r}: queue budget exceeded "
                 f"(max_queue={t.cfg.max_queue}, "
-                f"max_queued_bytes={t.cfg.max_queued_bytes})")
+                f"max_queued_bytes={t.cfg.max_queued_bytes}, "
+                f"est_bytes={ticket.est_bytes})")
         return ticket
+
+    def _resolve_est(self, t: _TenantState, ticket: Ticket):
+        """Resolve an UNKNOWN byte estimate while the tenant's byte
+        budget is in force: per-tenant default first, else build the
+        pool and take the static plan bound.  Returns None on success
+        (ticket.est_bytes resolved, possibly to the None=unboundable
+        sentinel) or the ticket when the build itself parked (
+        ResourceBusy) or failed — submit returns it as-is then."""
+        if t.cfg.default_est_bytes is not None:
+            ticket.est_bytes = int(t.cfg.default_est_bytes)
+            return None
+        try:
+            tp = ticket._make_pool(priority=t.cfg.priority,
+                                   weight=t.cfg.weight)
+        except ResourceBusy:
+            with self._lock:
+                t.counters["submitted"] += 1
+                t.counters["resource_waits"] += 1
+                t.queue.appendleft(ticket)
+                t.blocked = True
+            return ticket
+        except BaseException as e:
+            with self._lock:
+                t.counters["submitted"] += 1
+                t.counters["failed"] += 1
+            ticket.state = "failed"
+            ticket.error = e
+            ticket.done_t = time.monotonic()
+            ticket._event.set()
+            return ticket
+        ticket._pool = tp  # reused by _admit; destroyed on rejection
+        try:
+            ticket.est_bytes = tp.plan().est_bytes()  # None = unbounded
+        except Exception:
+            ticket.est_bytes = None
+        return None
 
     def _can_queue(self, t: _TenantState, ticket: Ticket) -> bool:
         if len(t.queue) >= t.cfg.max_queue:
             return False
-        if t.cfg.max_queued_bytes is not None and \
-                t.queued_bytes + ticket.est_bytes > t.cfg.max_queued_bytes:
-            return False
+        if t.cfg.max_queued_bytes is not None:
+            if ticket.est_bytes is None:  # unboundable: never evades
+                return False
+            if t.queued_bytes + ticket.est_bytes > t.cfg.max_queued_bytes:
+                return False
         return True
 
     # --------------------------------------------------------- admission
@@ -204,14 +270,16 @@ class Server:
         """Build + run one pool (caller already reserved t.active).
         Runs on the submitter or the pump thread, never on a worker."""
         try:
-            tp = ticket._make_pool(priority=t.cfg.priority,
-                                   weight=t.cfg.weight)
+            tp = ticket._pool  # prebuilt at submit for the plan bound
+            if tp is None:
+                tp = ticket._make_pool(priority=t.cfg.priority,
+                                       weight=t.cfg.weight)
         except ResourceBusy:
             with self._lock:
                 t.active -= 1
                 t.counters["resource_waits"] += 1
                 t.queue.appendleft(ticket)
-                t.queued_bytes += ticket.est_bytes
+                t.queued_bytes += ticket.est_bytes or 0
                 t.blocked = True
             return
         except BaseException as e:
@@ -284,7 +352,7 @@ class Server:
                     while t.queue and not t.blocked and \
                             t.active < t.cfg.max_pools:
                         ticket = t.queue.popleft()
-                        t.queued_bytes -= ticket.est_bytes
+                        t.queued_bytes -= ticket.est_bytes or 0
                         t.active += 1
                         batch.append((t, ticket))
             for ticket in retired:

@@ -392,6 +392,19 @@ register("device.overcommit", 1.5, float,
          "budget, but at overcommit * cache_bytes it drains the "
          "writeback lane between waves (bounded memory under "
          "out-of-core pressure); <= 1 drains at any overrun")
+register("device.plan_check", "off", str,
+         "pre-run static residency check (parsec_tpu.analysis.plan): "
+         "off|warn|error.  At Taskpool.run, every attached device "
+         "plans the pool's device-class working set and compares the "
+         "predicted per-rank peak against its cache_bytes budget: "
+         "over-budget with device.out_of_core=0 warns (or raises with "
+         "'error'); with out-of-core on it reports the predicted spill "
+         "count instead.  Counters export as stats()['plan']")
+register("plan.max_instances", 200_000, int,
+         "ptc-plan concrete-enumeration budget (shared with the "
+         "verifier's default): execution spaces past this many "
+         "instances degrade to the symbolic interval bounds with an "
+         "explicit note instead of silently truncating")
 register("device.affinity_skew", 4.0, float,
          "data-affinity spill guard for best-device routing: a queue "
          "holding a current mirror of a task's flow wins over pure "

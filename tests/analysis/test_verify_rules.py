@@ -378,6 +378,70 @@ def test_v008_clean_twin(ctx):
     assert verify_taskpool(_coll_step_pool(ctx, guarded=False)).ok()
 
 
+# ------------------------------------------------------------------ V009
+def _rank_mapped_pool(ctx, through_reader: bool):
+    """Two 2-rank collections (P=2: row m lives on rank m%2).  T(k) runs
+    at A(k, 0) but reads B(k+1, 0) — owned by the OTHER rank for every
+    k.  Bad twin reads the remote datum straight from memory (no wire
+    path materializes it); the clean twin routes it through a reader
+    task placed AT the datum (the gemm_dist ReadA/ReadB pattern)."""
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+    nt, nb = 4, 8
+    mk = lambda: TwoDimBlockCyclic((nt + 1) * nb, nb, nb, nb, P=2, Q=1,
+                                   nodes=2, myrank=0, dtype=np.float32)
+    A, B = mk(), mk()
+    A.register(ctx, "VA")
+    B.register(ctx, "VB")
+    tp = pt.Taskpool(ctx, globals={"N": nt - 1})
+    k = pt.L("k")
+    t = tp.task_class("T")
+    t.param("k", 0, pt.G("N"))
+    t.affinity("VA", k, 0)
+    if through_reader:
+        r = tp.task_class("Rd")
+        r.param("k", 0, pt.G("N"))
+        r.affinity("VB", k + 1, 0)
+        r.flow("X", "READ", pt.In(pt.Mem("VB", k + 1, 0)),
+               pt.Out(pt.Ref("T", k, flow="X")))
+        r.body_noop()
+        t.flow("X", "READ", pt.In(pt.Ref("Rd", k, flow="X")))
+    else:
+        t.flow("X", "READ", pt.In(pt.Mem("VB", k + 1, 0)))
+    t.body_noop()
+    return tp
+
+
+def test_v009_remote_mem_read(ctx):
+    rep = verify_taskpool(_rank_mapped_pool(ctx, through_reader=False))
+    f = _the(rep, "V009")
+    assert f.severity == "error"
+    assert f.cls == "T" and f.flow == "X"
+    assert "'VB'" in f.message
+    assert f.count == 4  # every instance reads cross-rank
+    assert f.loc and f.loc.startswith("test_verify_rules.py:")
+
+
+def test_v009_clean_twin_reader_task(ctx):
+    assert verify_taskpool(_rank_mapped_pool(ctx,
+                                             through_reader=True)).ok()
+
+
+def test_v009_silent_on_single_rank_collections(ctx):
+    """All-local collections (nodes=1) can never mismatch: the in-tree
+    single-rank graphs must stay clean (the 29-graph baseline)."""
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+    A = TwoDimBlockCyclic(4 * 8, 8, 8, 8, dtype=np.float32)
+    A.register(ctx, "V1A")
+    tp = pt.Taskpool(ctx, globals={"N": 3})
+    k = pt.L("k")
+    t = tp.task_class("T")
+    t.param("k", 0, pt.G("N"))
+    t.affinity("V1A", k, 0)
+    t.flow("X", "READ", pt.In(pt.Mem("V1A", (k + 1) % 4, 0)))
+    t.body_noop()
+    assert verify_taskpool(tp).ok()
+
+
 # ------------------------------------------------- verify= enforcement
 def test_taskpool_run_verify_raises(ctx):
     b = compile_jdf(BAD_V001, ctx, globals={"N": 4}, dtype=np.int64,

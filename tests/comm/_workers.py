@@ -2130,3 +2130,57 @@ def coll_dispatch_runtime(rank: int, nodes: int, port: int,
         assert st["ops"] == 4, st  # every call took the runtime path
         ctx.comm_fence()
         ctx.comm_fini()
+
+
+def gemm_dist_plan(rank: int, nodes: int, port: int, N: int = 256,
+                   nb: int = 64):
+    """ptc-plan comm-volume bound vs measured wire traffic: plan the
+    2-rank gemm_dist BEFORE running it, then assert per rank that
+      payload bound     == the hand-computed B-panel crossings (exact)
+      measured bytes    >= the payload bound (the payload really moved)
+      wire_out_bound    >= measured bytes_sent (the BOUND is sound
+                           against everything the wire counts —
+                           activations, fences, clock sync, metrics)
+    P=2/Q=1 puts every ReadA at its consumer row's rank (A never
+    crosses) while every B tile crosses exactly once."""
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.algos.gemm import build_gemm_dist
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    with ctx:
+        assert nodes == 2
+        rng = np.random.default_rng(11)
+        a = rng.normal(size=(N, N)).astype(np.float32)
+        b = rng.normal(size=(N, N)).astype(np.float32)
+        mk = lambda: TwoDimBlockCyclic(N, N, nb, nb, P=2, Q=1,
+                                       nodes=nodes, myrank=rank,
+                                       dtype=np.float32)
+        A, B, C = mk(), mk(), mk()
+        A.register(ctx, "A"); A.from_dense(a)
+        B.register(ctx, "B"); B.from_dense(b)
+        C.register(ctx, "C"); C.from_dense(np.zeros((N, N), np.float32))
+        tp = build_gemm_dist(ctx, A, B, C)
+        plan = tp.plan()
+        nt = N // nb
+        tile = nb * nb * 4
+        expect_payload = (nt * nt // 2) * tile
+        row = plan.per_rank[rank]
+        assert row["comm_out_bytes"] == expect_payload, row
+        assert plan.edges_bytes[(rank, 1 - rank)] == expect_payload
+        bound = plan.wire_out_bound(rank)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        measured = ctx.comm_stats()["bytes_sent"]
+        assert measured >= expect_payload, (measured, expect_payload)
+        assert bound >= measured, (bound, measured)
+        # correctness spot check on the owned tiles
+        ref = a.astype(np.float64) @ b.astype(np.float64)
+        for m in range(C.mt):
+            for n_ in range(C.nt):
+                if C.rank_of(m, n_) == rank:
+                    np.testing.assert_allclose(
+                        C.tile(m, n_),
+                        ref[m * nb:(m + 1) * nb,
+                            n_ * nb:(n_ + 1) * nb],
+                        rtol=2e-3, atol=2e-3)

@@ -1,0 +1,9 @@
+"""ptc-plan comm-volume bound against measured wire traffic on a real
+2-rank SPMD run (the acceptance direction: per-rank bound >= measured
+Context.stats() wire bytes, with the payload term exact)."""
+from tests.comm import _workers
+from tests.comm.test_multirank import _run_spmd
+
+
+def test_gemm_dist_comm_volume_bound_2ranks():
+    _run_spmd(_workers.gemm_dist_plan, 2, timeout=240.0)

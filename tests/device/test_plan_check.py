@@ -1,0 +1,138 @@
+"""Plan-vs-measured ground truth (the ptc-plan acceptance tests) + the
+device.plan_check pre-run knob.
+
+Soundness AND tightness of the peak-residency bound are asserted
+against the device's accounted high-water mark (`cache_peak_bytes`):
+  resident GEMM     measured peak <= predicted <= 1.25 * measured
+  2x-budget OOC     predicted spills > 0 iff budget_ratio > 1, and the
+                    zero/nonzero verdict agrees with measured
+                    device_stats spills
+Batching is pinned to 1 so a vmapped wave's stacked operands cannot
+inflate the measured mark past the tile-set model."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.algos.gemm import build_gemm
+from parsec_tpu.analysis import PlanCheckError
+from parsec_tpu.data.collections import TwoDimBlockCyclic
+from parsec_tpu.device import TpuDevice
+
+
+def _build(ctx, dev, m=64, k=16, mb=8, seed=7):
+    rng = np.random.default_rng(seed)
+    A = TwoDimBlockCyclic(m, k, mb, mb, dtype=np.float32)
+    B = TwoDimBlockCyclic(k, m, mb, mb, dtype=np.float32)
+    C = TwoDimBlockCyclic(m, m, mb, mb, dtype=np.float32)
+    A.from_dense(rng.standard_normal((m, k), dtype=np.float32))
+    B.from_dense(rng.standard_normal((k, m), dtype=np.float32))
+    C.from_dense(np.zeros((m, m), np.float32))
+    A.register(ctx, "A")
+    B.register(ctx, "B")
+    C.register(ctx, "C")
+    return A, B, C, build_gemm(ctx, A, B, C, dev=dev)
+
+
+def test_resident_gemm_peak_sound_and_tight(monkeypatch):
+    """Resident run: measured device peak <= predicted peak <= 1.25x
+    measured, and both spill predictions and measurements are zero."""
+    monkeypatch.setenv("PTC_DEVICE_BATCH", "1")
+    with pt.Context(nb_workers=2) as ctx:
+        dev = TpuDevice(ctx)
+        A, B, C, tp = _build(ctx, dev)
+        plan = tp.plan()
+        predicted = plan.peak_bytes(rank=0, device_only=True)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        measured = dev.stats["cache_peak_bytes"]
+        spills = dev.stats["spills"]
+        dev.stop()
+        np.testing.assert_allclose(C.to_dense(),
+                                   A.to_dense() @ B.to_dense(),
+                                   rtol=1e-3, atol=1e-3)
+    assert measured > 0
+    assert measured <= predicted <= 1.25 * measured, (measured, predicted)
+    assert spills == 0
+    assert plan.predict_spills(4 << 30, rank=0) == 0
+
+
+def test_ooc_gemm_spill_prediction_agrees(monkeypatch):
+    """2x-over-budget run: predicted spills > 0 iff budget_ratio > 1,
+    and the nonzero verdict matches the measured spill counter."""
+    monkeypatch.setenv("PTC_DEVICE_BATCH", "1")
+    with pt.Context(nb_workers=2) as ctx:
+        m, k, mb = 64, 16, 8
+        tile_set = (m * k + k * m + m * m) * 4
+        dev = TpuDevice(ctx, cache_bytes=tile_set // 2)
+        A, B, C, tp = _build(ctx, dev)
+        plan = tp.plan()
+        # budget_ratio > 1 -> spills predicted; <= 1 -> none
+        pred = plan.predict_spills(tile_set // 2, rank=0)
+        assert pred > 0
+        assert plan.predict_spills(tile_set, rank=0) == 0
+        tp.run()
+        tp.wait()
+        dev.flush()
+        measured_spills = dev.stats["spills"]
+        dev.stop()
+        np.testing.assert_allclose(C.to_dense(),
+                                   A.to_dense() @ B.to_dense(),
+                                   rtol=1e-3, atol=1e-3)
+    assert measured_spills > 0, "ooc run did not spill"
+    assert (pred > 0) == (measured_spills > 0)
+
+
+def test_plan_check_counters_and_modes(monkeypatch):
+    """plan_check: fits -> silent counters; over budget with
+    out_of_core on -> warn + predicted spill counter; with out_of_core
+    off -> PlanCheckError in error mode."""
+    monkeypatch.setenv("PTC_DEVICE_BATCH", "1")
+    with pt.Context(nb_workers=1) as ctx:
+        dev = TpuDevice(ctx)
+        _A, _B, _C, tp = _build(ctx, dev)
+        plan = dev.plan_check(tp, mode="warn")
+        assert plan is not None and plan.has_device_classes
+        ps = ctx.stats()["plan"]
+        assert ps["checks"] == 1 and ps["over_budget"] == 0
+        assert ps["last_peak_bytes"] == plan.peak_bytes(rank=0,
+                                                        device_only=True)
+        # shrink the budget: over budget, ooc on -> predicted spills
+        dev.set_cache_budget(ps["last_peak_bytes"] // 2)
+        dev.plan_check(tp, mode="warn", plan=plan)
+        ps = ctx.stats()["plan"]
+        assert ps["checks"] == 2 and ps["over_budget"] == 1
+        assert ps["predicted_spills"] > 0
+        dev.stop()
+
+
+def test_plan_check_error_mode_without_ooc(monkeypatch):
+    monkeypatch.setenv("PTC_DEVICE_BATCH", "1")
+    monkeypatch.setenv("PTC_MCA_device_out_of_core", "0")
+    with pt.Context(nb_workers=1) as ctx:
+        m, k, mb = 64, 16, 8
+        tile_set = (m * k + k * m + m * m) * 4
+        dev = TpuDevice(ctx, cache_bytes=tile_set // 2)
+        _A, _B, _C, tp = _build(ctx, dev)
+        with pytest.raises(PlanCheckError):
+            dev.plan_check(tp, mode="error")
+        # warn mode proceeds (stderr only)
+        assert dev.plan_check(tp, mode="warn") is not None
+        dev.stop()
+
+
+def test_plan_check_armed_via_run_knob(monkeypatch):
+    """Taskpool.run() runs the check when device.plan_check is armed:
+    error mode rejects the over-budget pool before anything schedules."""
+    monkeypatch.setenv("PTC_DEVICE_BATCH", "1")
+    monkeypatch.setenv("PTC_MCA_device_out_of_core", "0")
+    monkeypatch.setenv("PTC_MCA_device_plan_check", "error")
+    with pt.Context(nb_workers=1) as ctx:
+        m, k, mb = 64, 16, 8
+        tile_set = (m * k + k * m + m * m) * 4
+        dev = TpuDevice(ctx, cache_bytes=tile_set // 2)
+        _A, _B, _C, tp = _build(ctx, dev)
+        with pytest.raises(PlanCheckError):
+            tp.run()
+        dev.stop()
+    assert not tp._committed  # rejected before commit/schedule

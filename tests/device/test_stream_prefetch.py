@@ -70,7 +70,7 @@ def test_unified_stats_schema_single_rank():
         try:
             s = ctx.stats()
             assert set(s) == {"sched", "device", "comm", "coll", "trace",
-                              "metrics", "serve"}
+                              "metrics", "serve", "plan"}
             for k in ("level", "ring_bytes", "dropped_events", "clock"):
                 assert k in s["trace"], k
             assert "bypass_hits" in s["sched"]
@@ -83,8 +83,15 @@ def test_unified_stats_schema_single_rank():
             # PR 9: serving namespace — schema-stable with no Server
             assert s["serve"] == {"enabled": False}
             for k in ("prefetch_hits", "spills", "stream_serves",
-                      "prefetch_wakeups", "overlap_ratio", "devices"):
+                      "prefetch_wakeups", "overlap_ratio", "devices",
+                      "cache_peak_bytes"):
                 assert k in s["device"], k
+            # PR 10: ptc-plan pre-run check namespace (device.plan_check)
+            assert set(s["plan"]) == {"enabled", "checks", "over_budget",
+                                      "predicted_spills",
+                                      "last_peak_bytes",
+                                      "last_budget_bytes"}
+            assert isinstance(s["plan"]["enabled"], bool)
             comm = s["comm"]
             assert comm["enabled"] is False
             assert set(comm) == {"enabled", "engine", "rdv", "tuning",

@@ -115,3 +115,76 @@ def test_batched_insert_linted(ctx):
     tp.wait()
     assert any(r == "D101" for r, _ in tp.linter.findings)
     tp.destroy()
+
+
+# ------------------------------------------------------------------ D104
+class _RaggedTiles:
+    """A collection whose tile() allocates HALF the declared stride —
+    the seeded size-mismatch bug D104 exists to catch statically."""
+
+    def __init__(self, mb=8, nb=8):
+        from parsec_tpu.data.collections import TwoDimBlockCyclic
+        self._good = TwoDimBlockCyclic(4 * mb, 4 * nb, mb, nb,
+                                       dtype=np.float32)
+        self.mb, self.nb = mb, nb
+        self.dtype = self._good.dtype
+        self.nodes, self.myrank = 1, 0
+        self._ragged = {}
+
+    def rank_of(self, m, n):
+        return 0
+
+    def data_of(self, m, n):
+        key = (m, n)
+        if key not in self._ragged:
+            arr = np.zeros((self.mb, self.nb // 2), dtype=np.float32)
+            self._ragged[key] = self._good._ctx.data(100 + m * 4 + n, arr)
+        return self._ragged[key]
+
+    def register(self, ctx, name):
+        self._ctx = ctx
+        self._good._ctx = ctx
+        return ctx.register_collection(name, self)
+
+
+def test_d104_stride_mismatch_raises(ctx):
+    coll = _RaggedTiles()
+    coll.register(ctx, "RAG")
+    tp = DtdTaskpool(ctx, lint=True)
+    with pytest.raises(DtdLintError) as ei:
+        tp.insert_task(_noop, (tp.tile_of(coll, 0, 0), INPUT))
+    assert ei.value.rule == "D104"
+    assert "stride" in str(ei.value)
+    tp.wait()
+    tp.destroy()
+
+
+def test_d104_clean_twin_full_stride(ctx):
+    """A geometry-true collection passes: tile bytes == declared
+    mb*nb*itemsize stride."""
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+    coll = TwoDimBlockCyclic(4 * 8, 4 * 8, 8, 8, dtype=np.float32)
+    coll.register(ctx, "OK104")
+    tp = DtdTaskpool(ctx, lint=True)
+    t = tp.tile_of(coll, 0, 0)
+    assert t.coll_stride == 8 * 8 * 4 == t.nbytes
+    tp.insert_task(_noop, (t, INPUT))
+    tp.wait()
+    assert not tp.linter.findings
+    tp.destroy()
+
+
+def test_d104_warn_mode_and_data_tiles_unchecked(ctx):
+    """warn mode records D104 without raising; bare Data tiles declare
+    no collection geometry and are never flagged."""
+    coll = _RaggedTiles()
+    coll.register(ctx, "RAG2")
+    tp = DtdTaskpool(ctx, lint="warn")
+    t = tp.tile_of(coll, 1, 1)
+    tp.insert_task(_noop, (t, INPUT))
+    d = tp.tile_of(_data(ctx, n=3))  # odd size, no geometry: fine
+    assert d.coll_stride is None
+    tp.insert_task(_noop, (d, INPUT))
+    tp.wait()
+    assert any(r == "D104" for r, _ in tp.linter.findings)
+    tp.destroy()

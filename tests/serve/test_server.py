@@ -148,3 +148,90 @@ def test_failed_pool_counted():
         assert t.wait(timeout=30) == "failed"
         assert srv.stats()["tenants"]["a"]["failed"] == 1
         srv.close()
+
+
+def test_unknown_est_bytes_cannot_evade_byte_budget():
+    """The est_bytes=0 bypass fix (MIGRATION: 0 now means UNKNOWN):
+    with a byte budget in force, an unset estimate resolves to the
+    static ptc-plan bound of the submitted pool — a provably-over-
+    budget pool is REJECTED instead of slipping past max_queued_bytes,
+    and a small one queues under its true bound."""
+    import numpy as np
+    from parsec_tpu.algos.gemm import build_gemm
+    from parsec_tpu.data.collections import TwoDimBlockCyclic
+
+    gate = threading.Event()
+
+    def slow_body(v):
+        gate.wait(10)
+
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        m = n = 128
+        k, mb = 32, 16
+        A = TwoDimBlockCyclic(m, k, mb, mb, dtype=np.float32)
+        B = TwoDimBlockCyclic(k, n, mb, mb, dtype=np.float32)
+        C = TwoDimBlockCyclic(m, n, mb, mb, dtype=np.float32)
+        A.register(ctx, "A")
+        B.register(ctx, "B")
+        C.register(ctx, "C")
+        tile_set = (m * k + k * n + m * n) * 4  # 98304 B
+        srv = Server(ctx, [TenantConfig("a", max_pools=1, max_queue=100,
+                                        max_queued_bytes=tile_set // 2)])
+        srv.submit("a", _chain_pool(ctx, 4, slow_body), est_bytes=64)
+
+        def big(priority, weight):
+            return build_gemm(ctx, A, B, C)
+
+        t = srv.submit("a", big)  # est UNSET -> static bound
+        assert t.state == "rejected", t.state
+        assert t.est_bytes == tile_set  # the derived plan bound
+        with pytest.raises(AdmissionError) as ei:
+            srv.submit("a", big, wait=True)
+        assert "est_bytes" in str(ei.value)
+        # a small pool with est unset still queues, under its true bound
+        t2 = srv.submit("a", _chain_pool(ctx, 4, slow_body))
+        assert t2.state == "queued"
+        assert 0 < t2.est_bytes <= tile_set // 2
+        st = srv.stats()["tenants"]["a"]
+        assert st["rejected"] == 2
+        assert st["queued_bytes"] == t2.est_bytes
+        gate.set()
+        assert srv.drain(timeout=30)
+        assert t2.wait(timeout=30) == "done"
+        srv.close()
+
+
+def test_unknown_est_bytes_tenant_default_wins():
+    """A configured per-tenant default_est_bytes resolves unknown
+    estimates without building the pool early."""
+    gate = threading.Event()
+
+    def slow_body(v):
+        gate.wait(10)
+
+    built = {"n": 0}
+    with pt.Context(nb_workers=1, scheduler="lws") as ctx:
+        ctx.register_arena("t", 8)
+        srv = Server(ctx, [TenantConfig("a", max_pools=1, max_queue=8,
+                                        max_queued_bytes=100,
+                                        default_est_bytes=40)])
+        inner = _chain_pool(ctx, 4, slow_body)
+
+        def counting(priority, weight):
+            built["n"] += 1
+            return inner(priority, weight)
+
+        srv.submit("a", counting, est_bytes=1)
+        assert built["n"] == 1
+        t1 = srv.submit("a", counting)   # default 40, queues
+        t2 = srv.submit("a", counting)   # default 40, queues (80 total)
+        t3 = srv.submit("a", counting)   # would exceed 100 -> rejected
+        assert t1.state == "queued" and t1.est_bytes == 40
+        assert t2.state == "queued"
+        assert t3.state == "rejected"
+        # queued pools were NOT built early (the default answered)
+        assert built["n"] == 1
+        gate.set()
+        assert srv.drain(timeout=30)
+        srv.close()

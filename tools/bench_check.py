@@ -61,6 +61,11 @@ CHECKS = [
     ("BENCH_serve.json", "qos.hi_p99_beats_control", "equal", 0.0,
      False),
     ("BENCH_serve.json", "decode.bit_identical", "equal", 0.0, False),
+    # ptc-plan analyzer runtime on the potrf bench tiling (NT=16, 816
+    # instances; PR 10): `make plan-graphs` emits the number, the 5 s
+    # absolute budget lives in tools/plan_graphs.py — this row guards
+    # the trajectory so the analyzer cannot quietly get 2x slower
+    ("PLAN_graphs.json", "potrf_nt16_ms", "lower", 1.0, True),
 ]
 
 
