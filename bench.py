@@ -1360,6 +1360,7 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
             wall = time.perf_counter() - t0
             sched = ctx.sched_stats()
             server = eng.server.stats()
+            scope_st = ctx.stats()["scope"]  # ptc-scope rollup
             eng.close()
         lat = {"hi": [], "lo": []}
         outs = []
@@ -1387,10 +1388,13 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
             "qos_selects": sched["qos_selects"],
             "qos_preempts": sched["qos_preempts"],
             "server_totals": server["totals"],
+            "_scope": scope_st,
         }, outs
 
     qos_doc, qos_outs = run_mix(4, 4)
     ctl_doc, ctl_outs = run_mix(0, 1)
+    qos_scope = qos_doc.pop("_scope")
+    ctl_doc.pop("_scope", None)
 
     # ---- correctness: continuous == sequential per-request, bit-exact
     bit_identical = True
@@ -1452,6 +1456,13 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
         "decode": {"bit_identical": bit_identical,
                    "requests": len(reqs),
                    "sequential_engine_checked": seq_checked},
+        # ptc-scope: per-tenant SLO metrics + plan-vs-measured
+        # conformance from the QoS run.  The `sound` flag is a
+        # CORRECTNESS row in bench_check (full plan coverage AND no
+        # pool finishing below its makespan lower bound) — never
+        # relaxed by oversubscription; the latency/rate rows are
+        # trajectory-guarded timing
+        "scope": _scope_bench_section(qos_scope),
     })
     if oversub:
         doc["caveat"] = (
@@ -1459,6 +1470,36 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
             "separation measures scheduling under timesharing; the "
             "hi-p99 gate is widened 3x (bit-exactness flags never are)")
     return doc
+
+
+def _scope_bench_section(scope_st):
+    """BENCH_serve scope section off a Context.stats()["scope"]
+    snapshot: tenant TTFT/tokens-per-s quantiles + the conformance
+    soundness verdict."""
+    tenants = scope_st.get("tenants", {})
+
+    def per_tenant(key, scale):
+        return {name: round(row.get(key, 0) * scale, 3)
+                for name, row in tenants.items()}
+
+    conf = scope_st.get("conformance", {})
+    cov = conf.get("coverage")
+    rmin = (conf.get("makespan") or {}).get("ratio_min")
+    sound = bool(cov == 1.0 and (rmin is None or rmin >= 1.0))
+    return {
+        "ttft_p99_ms": per_tenant("ttft_ns_p99", 1e-6),
+        "ttft_p50_ms": per_tenant("ttft_ns_p50", 1e-6),
+        "tokens_per_s_p50": per_tenant("tokens_per_s_p50", 1.0),
+        "queue_wait_p99_ms": per_tenant("queue_wait_ns_p99", 1e-6),
+        "conformance": {
+            "coverage": cov,
+            "makespan_ratio_p50": (conf.get("makespan") or
+                                   {}).get("ratio_p50"),
+            "makespan_ratio_min": rmin,
+            "per_class_classes": len(conf.get("per_class") or {}),
+            "sound": sound,
+        },
+    }
 
 
 def _arg_after(flag, default):

@@ -318,6 +318,9 @@ struct PendingGet {
    * delivery-time COMM_RECV event carries it, tying the whole
    * rendezvous (GET window included) back to the producer's COMM_SEND */
   uint64_t corr = 0;
+  /* request-scope id from the ACTIVATE frame (wire v6; 0 = unscoped):
+   * replayed as a PROF_KEY_SCOPE flow tag at delivery */
+  uint64_t scope = 0;
   /* always-on metrics: pull-window start (first GET posted) — the
    * online comm_wait/coll_wait histogram sample closes at delivery */
   int64_t t_pull_start = 0;
@@ -573,12 +576,15 @@ static size_t reg_live_children(CommEngine *ce, MemReg &m,
  * canary, since a byte-swapped peer presents it reversed. */
 enum : uint32_t {
   PTC_WIRE_MAGIC = 0x50544331u, /* "PTC1" */
-  PTC_WIRE_VERSION = 5, /* v5 (tracing v2): ACTIVATE/ACTIVATE_BCAST
-                           bodies carry a u64 flow-correlation cookie
-                           after `shaped`, and PONG frames append the
-                           echoer's clock sample for cross-rank clock
-                           sync.  v4: multi-rail handshake + progressive
-                           streaming serve (see MIGRATION.md). */
+  PTC_WIRE_VERSION = 6, /* v6 (request scope): ACTIVATE/ACTIVATE_BCAST
+                           bodies carry a u64 request-scope id after the
+                           corr cookie — the delivery side re-emits it
+                           as a PROF_KEY_SCOPE flow tag so per-request
+                           timelines attribute wire hops (see
+                           MIGRATION.md).  v5 (tracing v2): u64 flow-
+                           correlation cookie after `shaped` + PONG
+                           clock samples.  v4: multi-rail handshake +
+                           progressive streaming serve. */
 };
 
 static void comm_post_msg(CommEngine *ce, uint32_t rank, OutMsg &&msg,
@@ -788,7 +794,7 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                             uint64_t alloc_len = 0, int32_t shaped = -1,
                             ptc_copy *ready = nullptr,
                             uint32_t src_rank = UINT32_MAX,
-                            uint64_t corr = 0) {
+                            uint64_t corr = 0, uint64_t scope = 0) {
   if (alloc_len == 0) alloc_len = plen;
   /* ONE COMM_RECV per delivered frame, keyed (src, corr) in l0/l1 to
    * mirror the producer's COMM_SEND (dst, corr) — the merged-trace flow
@@ -798,6 +804,16 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
                    targets.empty() ? -1 : (int64_t)targets[0].class_id,
                    src_rank == UINT32_MAX ? -1 : (int64_t)src_rank,
                    (int64_t)corr, (int64_t)plen);
+  /* request-scope flow tag (wire v6): the frame named the request this
+   * delivery serves — re-emit it keyed (src, corr) so a consumer-rank
+   * trace (or a merged one) maps the flow back to the request.  Falls
+   * back to the LOCAL pool's stamp when the producer predates the
+   * stamp (SPMD skew at request admission). */
+  if (scope == 0 && tp)
+    scope = (uint64_t)tp->scope_id.load(std::memory_order_relaxed);
+  if (scope != 0 && src_rank != UINT32_MAX)
+    ptc_prof_instant(ctx, PROF_KEY_SCOPE, tp ? tp->id : -1,
+                     (int64_t)src_rank, (int64_t)corr, (int64_t)scope);
   /* collective-step delivery (ptc_coll_* consumer): a second instant
    * under its own key, so the lost-time analysis can split coll_wait
    * out of comm_wait without guessing from class ids */
@@ -1002,7 +1018,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
                             uint64_t alloc_len = 0, int32_t shaped = -1,
                             ptc_copy *ready = nullptr,
                             uint32_t src_rank = UINT32_MAX,
-                            uint64_t corr = 0) {
+                            uint64_t corr = 0, uint64_t scope = 0) {
   ptc_taskpool *tp = find_tp(ctx, tp_id);
   if (!tp) {
     /* Re-check the registry under the lock: add_taskpool may have
@@ -1027,6 +1043,7 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
       w.i32(flow_idx);
       w.i32(shaped);
       w.u64(corr); /* flow cookie survives the park (ACTIVATE grammar) */
+      w.u64(scope); /* request scope survives it too (wire v6) */
       w.raw(targets_bytes, targets_len);
       if (alloc_len && alloc_len != plen) {
         if (device_uid == 0) {
@@ -1061,7 +1078,8 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
     return;
   }
   deliver_targets(ctx, tp, flow_idx, std::move(targets), payload, plen,
-                  device_uid, alloc_len, shaped, ready, src_rank, corr);
+                  device_uid, alloc_len, shaped, ready, src_rank, corr,
+                  scope);
 }
 
 /* body excludes the type byte.  `from` is the sending rank (rendezvous
@@ -1075,6 +1093,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   int32_t flow_idx = r.i32();
   int32_t shaped = r.i32(); /* datatype the payload bytes are already in */
   uint64_t corr = r.u64();  /* flow-correlation cookie (tracing v2) */
+  uint64_t scope = r.u64(); /* request-scope id (wire v6; 0 = unscoped) */
   const uint8_t *targets_start = r.p;
   uint32_t nb_targets = r.u32();
   (void)parse_targets(r, nb_targets); /* skip to measure the slice */
@@ -1088,7 +1107,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
   case PK_NONE:
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0, 0,
-                    allow_park, 0, shaped, nullptr, from, corr);
+                    allow_park, 0, shaped, nullptr, from, corr, scope);
     return;
   case PK_EAGER: {
     uint64_t plen = r.u64();
@@ -1098,7 +1117,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     }
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), r.p, plen, 0,
-                    allow_park, 0, shaped, nullptr, from, corr);
+                    allow_park, 0, shaped, nullptr, from, corr, scope);
     return;
   }
   case PK_PARKED_DEVICE: {
@@ -1118,7 +1137,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0,
                     (int64_t)uid, allow_park, alloc_len, shaped, nullptr,
-                    from, corr);
+                    from, corr, scope);
     return;
   }
   case PK_GET:
@@ -1153,6 +1172,7 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     pg.pk = pk;
     pg.shaped = shaped;
     pg.corr = corr;
+    pg.scope = scope;
     send_rendezvous_pull(ce, from, src_handle, plen, std::move(pg));
     return;
   }
@@ -1267,7 +1287,7 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
                          const std::vector<BcastWireGroup> &groups,
                          size_t i0, uint8_t pk, uint64_t handle,
                          const uint8_t *payload, uint64_t plen,
-                         int32_t shaped = -1) {
+                         int32_t shaped = -1, uint64_t scope = 0) {
   size_t i = i0;
   while (i < groups.size()) {
     size_t n = groups.size() - i;
@@ -1281,6 +1301,7 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
      * own send/recv pair in the merged trace */
     uint64_t corr = ce->next_corr.fetch_add(1, std::memory_order_relaxed);
     w.u64(corr);
+    w.u64(scope); /* request scope rides every relay hop (wire v6) */
     w.u8(topo);
     w.u32((uint32_t)take);
     for (size_t k = i; k < i + take; k++) {
@@ -1299,6 +1320,10 @@ static void bcast_fanout(CommEngine *ce, int32_t tp_id, int32_t flow_idx,
     ptc_prof_instant(ce->ctx, PROF_KEY_COMM_SEND, groups[i].first_class,
                      (int64_t)groups[i].rank, (int64_t)corr,
                      (int64_t)plen);
+    if (scope != 0)
+      ptc_prof_instant(ce->ctx, PROF_KEY_SCOPE, tp_id,
+                       (int64_t)ce->myrank, (int64_t)corr,
+                       (int64_t)scope);
     if (coll_class(find_tp(ce->ctx, tp_id), groups[i].first_class)) {
       ce->ctx->coll_send_msgs.fetch_add(1, std::memory_order_relaxed);
       ce->ctx->coll_send_bytes.fetch_add((int64_t)plen,
@@ -1317,6 +1342,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
   int32_t flow_idx = r.i32();
   int32_t shaped = r.i32();
   uint64_t corr = r.u64(); /* this hop's flow cookie (tracing v2) */
+  uint64_t scope = r.u64(); /* request scope (wire v6; 0 = unscoped) */
   uint8_t topo = r.u8();
   uint32_t nb_groups = r.u32();
   std::vector<BcastWireGroup> groups;
@@ -1377,6 +1403,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     pg.pk = pk;
     pg.shaped = shaped;
     pg.corr = corr;
+    pg.scope = scope;
     pg.bcast = true;
     pg.topo = topo;
     pg.groups = std::move(groups);
@@ -1387,7 +1414,7 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
    * do; forwarding needs no taskpool knowledge, so SPMD skew cannot
    * stall the tree) */
   bcast_fanout(ce, tp_id, flow_idx, topo, groups, 0, pk, 0, r.p, plen,
-               shaped);
+               shaped, scope);
   if (my_targets.empty()) {
     std::fprintf(stderr, "ptc-comm: ACTIVATE_BCAST without my group; "
                          "forwarded only\n");
@@ -1400,14 +1427,14 @@ static void handle_activate_bcast_body(CommEngine *ce, uint32_t from,
     Reader tr{my_targets.data(), my_targets.data() + my_targets.size()};
     uint32_t nb_targets = tr.u32();
     deliver_targets(ctx, tp, flow_idx, parse_targets(tr, nb_targets),
-                    r.p, plen, 0, 0, shaped, nullptr, from, corr);
+                    r.p, plen, 0, 0, shaped, nullptr, from, corr, scope);
     return;
   }
   /* unknown taskpool (SPMD skew): park via the shared eager-form path (a
    * parked frame must NOT re-forward on replay — this form cannot) */
   deliver_or_park(ctx, tp_id, flow_idx, my_targets.data(), my_targets.size(),
                   r.p, plen, 0, /*allow_park=*/true, 0, shaped, nullptr,
-                  from, corr);
+                  from, corr, scope);
 }
 
 /* build one PUT_CHUNK message serving [offset, offset+clen) of a
@@ -1847,7 +1874,7 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
     }
     if (fpk)
       bcast_fanout(ce, pg.tp_id, pg.flow_idx, pg.topo, pg.groups, 0,
-                   fpk, fh, nullptr, real_len, pg.shaped);
+                   fpk, fh, nullptr, real_len, pg.shaped, pg.scope);
   }
   /* by-reference delivery (real_len != plen): the payload rode the device
    * fabric; the host copy is allocated at real_len and materialized
@@ -1858,7 +1885,7 @@ static void complete_pull(CommEngine *ce, PendingGet &&pg, uint8_t pk,
     deliver_or_park(ctx, pg.tp_id, pg.flow_idx, pg.targets_bytes.data(),
                     pg.targets_bytes.size(), payload, plen, device_uid,
                     /*allow_park=*/true, real_len, pg.shaped, pg.dst,
-                    pg.src_rank, pg.corr);
+                    pg.src_rank, pg.corr, pg.scope);
   if (pg.dst) {
     ptc_copy_release_internal(ctx, pg.dst);
     pg.dst = nullptr;
@@ -2787,6 +2814,10 @@ void ptc_comm_send_activate_batch(
    * pair the two events across ranks */
   uint64_t corr = ce->next_corr.fetch_add(1, std::memory_order_relaxed);
   w.u64(corr);
+  /* request scope (wire v6): the pool's stamp rides every activation so
+   * the consumer attributes this flow to the request it serves */
+  uint64_t scope = (uint64_t)tp->scope_id.load(std::memory_order_relaxed);
+  w.u64(scope);
   w.u32((uint32_t)targets.size());
   for (const auto &t : targets) {
     w.i32(t.first);
@@ -2903,6 +2934,11 @@ void ptc_comm_send_activate_batch(
   ptc_prof_instant(ctx, PROF_KEY_COMM_SEND,
                    targets.empty() ? -1 : (int64_t)targets[0].first,
                    (int64_t)rank, (int64_t)corr, payload_size);
+  /* scope flow tag keyed (src = me, corr) — the producer-side half of
+   * the request attribution (the consumer re-emits the same key) */
+  if (scope != 0)
+    ptc_prof_instant(ctx, PROF_KEY_SCOPE, tp->id, (int64_t)ce->myrank,
+                     (int64_t)corr, (int64_t)scope);
   if (!targets.empty() && coll_class(tp, targets[0].first)) {
     ctx->coll_send_msgs.fetch_add(1, std::memory_order_relaxed);
     ctx->coll_send_bytes.fetch_add(payload_size, std::memory_order_relaxed);
@@ -2979,6 +3015,8 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
   std::vector<uint32_t> children;
   bcast_direct_children(wire, (uint8_t)topo, children);
   size_t nframes = children.size();
+  /* origin request scope: stamped on every hop of the broadcast tree */
+  uint64_t scope = (uint64_t)tp->scope_id.load(std::memory_order_relaxed);
   if (big && nframes) {
     /* rendezvous broadcast: advertise a handle, let the direct children
      * pull (and re-root for theirs) — a big tile never rides the
@@ -3006,7 +3044,7 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
         if (ctx->dp_serve_done) ctx->dp_serve_done(ctx->dp_user, tag);
       if (excess == children.size()) return;
       bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
-                   PK_DEVICE, dp_h, nullptr, plen, shaped);
+                   PK_DEVICE, dp_h, nullptr, plen, shaped, scope);
       return;
     }
     if (!is_packed)
@@ -3064,13 +3102,14 @@ void ptc_comm_send_activate_bcast(ptc_context *ctx, ptc_taskpool *tp,
       }
     }
     bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0, PK_GET, h,
-                 nullptr, plen, shaped);
+                 nullptr, plen, shaped, scope);
     return;
   }
   if (payload && !is_packed)
     ptc_copy_sync_for_host(ctx, copy); /* coherence: pull device mirror */
   bcast_fanout(ce, tp->id, flow_idx, (uint8_t)topo, wire, 0,
-               payload ? PK_EAGER : PK_NONE, 0, payload, plen, shaped);
+               payload ? PK_EAGER : PK_NONE, 0, payload, plen, shaped,
+               scope);
 }
 
 void ptc_comm_send_put_mem(ptc_context *ctx, uint32_t rank, int32_t dc_id,

@@ -2293,8 +2293,17 @@ namespace {
 
 static void prof_event(ptc_context *ctx, int worker, int64_t key,
                        int64_t phase, ptc_task *t, int32_t min_level) {
+  bool trace = ctx->prof_level.load(std::memory_order_relaxed) >= min_level;
+  bool pins = ctx->pins_state.load(std::memory_order_relaxed) != nullptr;
+  if (!trace && !pins) return;
+  /* aux carries the owning pool's request scope (0 = unscoped): the
+   * per-request timeline decomposition keys EXEC/RELEASE spans on it */
+  int64_t scope = (t && t->tp)
+                      ? t->tp->scope_id.load(std::memory_order_relaxed)
+                      : 0;
   ptc_prof_push(ctx, worker, key, phase, t ? t->class_id : -1,
-                t ? t->locals[0] : 0, t ? t->locals[1] : 0, 0, min_level);
+                t ? t->locals[0] : 0, t ? t->locals[1] : 0, scope,
+                min_level);
 }
 
 /* begin+end of a zero-duration body as ONE buffer transaction (one lock,
@@ -2308,8 +2317,11 @@ static void prof_event_pair(ptc_context *ctx, int worker, int64_t key,
   int64_t now = ptc_now_ns();
   int64_t cid = t ? t->class_id : -1;
   int64_t l0 = t ? t->locals[0] : 0, l1 = t ? t->locals[1] : 0;
-  int64_t w[2 * PROF_WORDS] = {key, 0, cid, l0, l1, (int64_t)worker, 0, now,
-                               key, 1, cid, l0, l1, (int64_t)worker, 0, now};
+  int64_t sc = (t && t->tp)
+                   ? t->tp->scope_id.load(std::memory_order_relaxed)
+                   : 0;
+  int64_t w[2 * PROF_WORDS] = {key, 0, cid, l0, l1, (int64_t)worker, sc, now,
+                               key, 1, cid, l0, l1, (int64_t)worker, sc, now};
   if (trace) {
     ProfBuf *b = ctx->prof[(size_t)(worker < 0 ? 0 : worker)];
     ProfLockGuard g(b);
@@ -2506,6 +2518,9 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
       mw = ptc_met_worker(ctx, worker);
       m0 = ptc_now_ns();
       mw->cur_mid.store(ctx->met_dtd_mid, std::memory_order_relaxed);
+      mw->cur_scope.store(
+          t->tp ? t->tp->scope_id.load(std::memory_order_relaxed) : 0,
+          std::memory_order_relaxed);
       mw->cur_begin.store(m0, std::memory_order_relaxed);
     }
     prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
@@ -2514,6 +2529,7 @@ static void execute_dyn(ptc_context *ctx, int worker, ptc_task *t) {
     if (met) {
       mw->cur_begin.store(0, std::memory_order_relaxed);
       mw->cur_mid.store(-1, std::memory_order_relaxed);
+      mw->cur_scope.store(0, std::memory_order_relaxed);
       met_record_mw(mw, PTC_MET_EXEC, ctx->met_dtd_mid,
                     ptc_now_ns() - m0);
     }
@@ -2669,6 +2685,9 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
         mw = ptc_met_worker(ctx, worker);
         m0 = ptc_now_ns();
         mw->cur_mid.store(tc.metric_id, std::memory_order_relaxed);
+        mw->cur_scope.store(
+            t->tp ? t->tp->scope_id.load(std::memory_order_relaxed) : 0,
+            std::memory_order_relaxed);
         mw->cur_begin.store(m0, std::memory_order_relaxed);
       }
       prof_event(ctx, worker, PROF_KEY_EXEC, 0, t);
@@ -2677,6 +2696,7 @@ static void execute_task(ptc_context *ctx, int worker, ptc_task *t) {
       if (met) {
         mw->cur_begin.store(0, std::memory_order_relaxed);
         mw->cur_mid.store(-1, std::memory_order_relaxed);
+        mw->cur_scope.store(0, std::memory_order_relaxed);
         met_record_mw(mw, PTC_MET_EXEC, tc.metric_id,
                       ptc_now_ns() - m0);
       }
@@ -3334,14 +3354,15 @@ int64_t ptc_metrics_snapshot(ptc_context_t *ctx, int64_t *out, int64_t cap,
  * stuck-task scan (deadline = k * p99 of the class's histogram) */
 int64_t ptc_metrics_inflight(ptc_context_t *ctx, int64_t *out, int64_t cap) {
   int64_t n = 0;
-  for (size_t w = 0; w < ctx->met_workers.size() && n + 3 <= cap; w++) {
+  for (size_t w = 0; w < ctx->met_workers.size() && n + 4 <= cap; w++) {
     MetWorker *mw = ctx->met_workers[w];
     int64_t b = mw->cur_begin.load(std::memory_order_relaxed);
     if (!b) continue;
     out[n] = (int64_t)w;
     out[n + 1] = mw->cur_mid.load(std::memory_order_relaxed);
     out[n + 2] = b;
-    n += 3;
+    out[n + 3] = mw->cur_scope.load(std::memory_order_relaxed);
+    n += 4;
   }
   return n;
 }
@@ -3424,6 +3445,26 @@ int64_t ptc_tp_qos_stats(ptc_taskpool_t *tp, int64_t *out, int64_t cap) {
   int64_t n = cap < 8 ? (cap < 0 ? 0 : cap) : 8;
   for (int64_t i = 0; i < n; i++) out[i] = v[i];
   return n;
+}
+
+/* ---- request scope (observability) ---- */
+
+/* Stamp the request/pool id this taskpool serves (0 = unscoped).  The
+ * scope rides EXEC/RELEASE span aux, the watchdog's inflight slot, and
+ * outgoing ACTIVATE frames (comm.cpp re-emits it on delivery as a
+ * PROF_KEY_SCOPE flow tag).  Safe to call any time before run; spans
+ * pushed earlier simply carry 0. */
+void ptc_tp_set_scope(ptc_taskpool_t *tp, int64_t scope_id) {
+  tp->scope_id.store(scope_id, std::memory_order_relaxed);
+}
+
+int64_t ptc_tp_scope(ptc_taskpool_t *tp) {
+  return tp->scope_id.load(std::memory_order_relaxed);
+}
+
+int64_t ptc_task_scope(ptc_task_t *t) {
+  if (!t || !t->tp) return 0;
+  return t->tp->scope_id.load(std::memory_order_relaxed);
 }
 
 /* Wave-boundary preemption knob (PTC_MCA_sched_qos_preempt): off = a
@@ -4455,6 +4496,10 @@ int64_t ptc_profile_take(ptc_context_t *ctx, int64_t *out, int64_t cap) {
 int32_t ptc_profile_level(ptc_context_t *ctx) {
   return ctx->prof_level.load(std::memory_order_relaxed);
 }
+
+/* the trace/metrics clock, exported so Python-side lifecycle
+ * timestamps (profiling/scope.py) window trace spans exactly */
+int64_t ptc_clock_ns(void) { return ptc_now_ns(); }
 
 /* flight-recorder ring: bound each worker's trace buffer to `nbytes`,
  * overwriting oldest whole events when full (dropped counted).  0

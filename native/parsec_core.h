@@ -158,6 +158,22 @@ int64_t ptc_tp_qos_stats(ptc_taskpool_t *tp, int64_t *out, int64_t cap);
  * empty instead of re-ranking lanes by priority at every select. */
 void ptc_context_set_qos_preempt(ptc_context_t *ctx, int32_t on);
 int32_t ptc_context_get_qos_preempt(ptc_context_t *ctx);
+/* Request scope (observability): stamp the request/pool id this
+ * taskpool serves.  Nonzero scopes ride EXEC/RELEASE span aux words,
+ * cross the wire on ACTIVATE frames (the delivery side re-emits them
+ * as PROF_KEY_SCOPE flow tags), and surface in the watchdog's inflight
+ * slots.  Stamp beside ptc_tp_set_qos, before the pool runs. */
+void ptc_tp_set_scope(ptc_taskpool_t *tp, int64_t scope_id);
+int64_t ptc_tp_scope(ptc_taskpool_t *tp);
+/* the owning pool's scope of one task (0 = unscoped) — the device
+ * layer stamps H2D/STREAM staging spans with it */
+int64_t ptc_task_scope(ptc_task_t *t);
+/* the runtime's trace/metrics clock (ptc_now_ns: TSC fast path
+ * calibrated to steady_clock).  Request-lifecycle timestamps that must
+ * window trace spans (profiling/scope.py) read THIS clock — the TSC
+ * epoch drifts from CLOCK_MONOTONIC over a long process, so mixing the
+ * two misaligns by milliseconds after minutes. */
+int64_t ptc_clock_ns(void);
 
 /* registries: return non-negative id, or -1 on error */
 int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user);
@@ -427,7 +443,8 @@ void ptc_metrics_layout(int64_t *out4);
  * Returns words written. */
 int64_t ptc_metrics_snapshot(ptc_context_t *ctx, int64_t *out, int64_t cap,
                              int32_t merged);
-/* open EXEC bodies: [worker, mid, begin_ns] triplets (watchdog scan) */
+/* open EXEC bodies: [worker, mid, begin_ns, scope_id] quads (watchdog
+ * scan; scope_id = the owning pool's request scope, 0 = unscoped) */
 int64_t ptc_metrics_inflight(ptc_context_t *ctx, int64_t *out, int64_t cap);
 /* per-peer fence-time clock-sync RTTs (rank 0; watchdog slow-rank scan) */
 int32_t ptc_metrics_peer_rtts(ptc_context_t *ctx, int64_t *out,

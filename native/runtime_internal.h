@@ -679,6 +679,14 @@ enum {
                            * (l0 = src rank, l1 = corr, aux = bytes) —
                            * the evidence behind the coll_wait lost-time
                            * bucket (profiling/critpath.py)            */
+  PROF_KEY_SCOPE = 9,     /* request-scope flow tag: instant span
+                           * emitted ALONGSIDE COMM_SEND (producer) and
+                           * COMM_RECV (consumer) when the sending
+                           * taskpool carries a nonzero scope_id —
+                           * (class = tp id, l0 = src rank, l1 = corr,
+                           * aux = scope_id), so a merged trace maps
+                           * each (src, corr) wire flow back to the
+                           * request it served (profiling/scope.py)   */
 };
 enum { PROF_WORDS = 8 };
 
@@ -742,6 +750,9 @@ struct MetWorker {
   MetHist kind[PTC_MET_NKINDS]; /* kind[EXEC] = unnamed-class overflow */
   std::atomic<int64_t> cur_begin{0};
   std::atomic<int32_t> cur_mid{-1};
+  /* owning pool's request scope of the open EXEC body (0 = unscoped):
+   * lets the watchdog's stuck-task event name the victim request */
+  std::atomic<int64_t> cur_scope{0};
   std::atomic<int64_t> rel_tick{0}; /* release-latency sampling */
   ~MetWorker() {
     for (auto &h : exec) delete h.load(std::memory_order_relaxed);
@@ -833,6 +844,15 @@ struct ptc_taskpool {
   int64_t qos_weight = 1;
   std::atomic<int64_t> q_scheduled{0}, q_selected{0}, q_executed{0};
   std::atomic<int64_t> q_wait_ns{0}, q_preempts{0};
+
+  /* ---- request scope (observability; reference role: the PINS
+   * task-attribution layer generalized to the serving work unit).  A
+   * nonzero scope_id names the request/pool this taskpool serves: EXEC
+   * and RELEASE trace spans stamp it in their aux word, outgoing
+   * ACTIVATE frames carry it across the wire, and the watchdog's
+   * inflight slot reports it so a stuck-task event names the victim
+   * request.  0 = unscoped (every pre-serve workload). */
+  std::atomic<int64_t> scope_id{0};
 };
 
 struct ptc_context {

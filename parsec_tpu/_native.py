@@ -154,6 +154,10 @@ _sigs = {
     "ptc_tp_set_qos": (None, [C.c_void_p, C.c_int32, C.c_int64]),
     "ptc_tp_qos_stats": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64),
                                      C.c_int64]),
+    "ptc_tp_set_scope": (None, [C.c_void_p, C.c_int64]),
+    "ptc_tp_scope": (C.c_int64, [C.c_void_p]),
+    "ptc_task_scope": (C.c_int64, [C.c_void_p]),
+    "ptc_clock_ns": (C.c_int64, []),
     "ptc_context_set_qos_preempt": (None, [C.c_void_p, C.c_int32]),
     "ptc_context_get_qos_preempt": (C.c_int32, [C.c_void_p]),
     "ptc_context_set_rank": (None, [C.c_void_p, C.c_uint32, C.c_uint32]),

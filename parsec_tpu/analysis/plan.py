@@ -803,6 +803,13 @@ class _Analyzer:
             "workers_per_rank": workers,
             "lower_bound_ns": int(max(cp_ns, work_bound)),
             "cost_source": cost.source,
+            # the per-class ns assumptions this bound used — the
+            # calibration baseline scope conformance compares the live
+            # metrics p50s against (ptc-scope / ROADMAP item 5)
+            "per_class_cost": {fg.classes[cid].name:
+                               cost.ns(fg.classes[cid].name)
+                               for cid in sorted({n[0]
+                                                  for n in self.inst_set})},
         }
 
 

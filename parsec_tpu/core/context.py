@@ -392,17 +392,20 @@ class Context:
     # ------------------------------------------------------- QoS taskpools
     def taskpool(self, globals: Optional[Dict[str, int]] = None,
                  priority: Optional[int] = None,
-                 weight: Optional[int] = None):
+                 weight: Optional[int] = None,
+                 scope: Optional[int] = None):
         """Create a Taskpool on this context.  `priority`/`weight` arm
         per-pool QoS (the serving runtime's tenant knobs): under the lws
         scheduler a higher-priority pool's ready tasks win every select
         boundary (wave-boundary preemption; negative priorities are
         background, served only when the default path is dry), and
         weight stride-shares one priority tier.  Per-pool counters
-        export through stats()["sched"]["pools"]."""
+        export through stats()["sched"]["pools"].  `scope` stamps a
+        request-scope id for per-request observability (see
+        profiling/scope.py)."""
         from .taskpool import Taskpool
         return Taskpool(self, globals=globals, priority=priority,
-                        weight=weight)
+                        weight=weight, scope=scope)
 
     def _ensure_tp_tracking(self):
         if getattr(self, "_taskpools", None) is None:
@@ -585,6 +588,10 @@ class Context:
           plan    -> ptc-plan pre-run checks (device.plan_check knob):
                      check/over-budget counters and the last predicted
                      peak vs budget
+          scope   -> request-scoped observability (profiling/scope.py):
+                     per-tenant SLO rollups + plan-vs-measured
+                     conformance ratios; {"enabled": False} when no
+                     ScopeRegistry is attached
         """
         from ..utils import params as _plan_mca
         tuning = self.comm_tuning()
@@ -625,7 +632,22 @@ class Context:
             "plan": dict(
                 enabled=_plan_mca.get("device.plan_check") != "off",
                 **getattr(self, "_plan_stats", {})),
+            "scope": (self._scope_registry.stats()
+                      if getattr(self, "_scope_registry", None) is not None
+                      else {"enabled": False}),
         }
+
+    def scope_registry(self, create: bool = True):
+        """The request-scope observability registry (one per context;
+        profiling/scope.py).  Allocates scope ids, tracks per-request
+        lifecycles + per-tenant SLO histograms, and records
+        plan-vs-measured conformance at pool retirement.  The serve
+        stack attaches one automatically; create=False just peeks."""
+        reg = getattr(self, "_scope_registry", None)
+        if reg is None and create:
+            from ..profiling.scope import ScopeRegistry
+            reg = self._scope_registry = ScopeRegistry(self)
+        return reg
 
     # ------------------------------------------------------------ registries
     def register_expr_cb(self, fn: Callable) -> int:
@@ -981,19 +1003,21 @@ class Context:
         return self._metrics_registry
 
     def metrics_inflight(self) -> list:
-        """Open EXEC bodies as (worker, class_name, begin_ns) — the
-        watchdog's stuck-task scan input (begin_ns is on the
-        steady_clock/monotonic epoch)."""
-        cap = 3 * (self.nb_workers + 2)
+        """Open EXEC bodies as (worker, class_name, begin_ns, scope_id)
+        — the watchdog's stuck-task scan input (begin_ns is on the
+        steady_clock/monotonic epoch; scope_id = the owning pool's
+        request scope, 0 when unscoped)."""
+        cap = 4 * (self.nb_workers + 2)
         buf = (C.c_int64 * cap)()
         n = N.lib.ptc_metrics_inflight(self._ptr, buf, cap)
         name_buf = C.create_string_buffer(256)
         out = []
-        for i in range(0, n, 3):
+        for i in range(0, n, 4):
             mid = buf[i + 1]
             k = N.lib.ptc_metrics_class_name(self._ptr, mid, name_buf, 256)
             name = name_buf.value.decode() if k > 0 else f"#{mid}"
-            out.append((int(buf[i]), name, int(buf[i + 2])))
+            out.append((int(buf[i]), name, int(buf[i + 2]),
+                        int(buf[i + 3])))
         return out
 
     def metrics_peer_rtts(self) -> list:

@@ -14,13 +14,21 @@ from .taskclass import TaskClass, TaskView
 class Taskpool:
     def __init__(self, ctx: Context, globals: Optional[Dict[str, int]] = None,
                  priority: Optional[int] = None,
-                 weight: Optional[int] = None):
+                 weight: Optional[int] = None,
+                 scope: Optional[int] = None):
         """`priority`/`weight` arm per-pool QoS scheduling (the serving
         runtime's tenant knobs): priority orders pools strictly under
         the lws scheduler — a higher-priority pool wins every select
         boundary (wave-boundary preemption; negative = background) —
         and weight stride-shares a priority tier.  Leaving both None
-        keeps the pool on the default path (no QoS counters)."""
+        keeps the pool on the default path (no QoS counters).
+
+        `scope` stamps a request-scope id (observability; see
+        profiling/scope.py): EXEC/RELEASE spans carry it in aux, the
+        watchdog's inflight slot reports it, and it crosses the wire on
+        ACTIVATE frames so a merged trace reconstructs one request's
+        full multi-rank timeline.  Also settable later via
+        set_scope()."""
         self.ctx = ctx
         self.globals_map: Dict[str, int] = {}
         vals: List[int] = []
@@ -41,7 +49,19 @@ class Taskpool:
                                          else 1))
             N.lib.ptc_tp_set_qos(self._ptr, self.qos_priority,
                                  self.qos_weight)
+        if scope is not None:
+            self.set_scope(scope)
         ctx._track_taskpool(self)
+
+    def set_scope(self, scope_id: int):
+        """Stamp the request-scope id this pool serves (0 = unscoped).
+        Stamp before run(); spans pushed earlier carry 0."""
+        N.lib.ptc_tp_set_scope(self._ptr, int(scope_id))
+        return self
+
+    @property
+    def scope_id(self) -> int:
+        return int(N.lib.ptc_tp_scope(self._ptr))
 
     # ------------------------------------------------------------- building
     def add(self, tc: TaskClass) -> TaskClass:

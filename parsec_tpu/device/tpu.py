@@ -1895,7 +1895,13 @@ class TpuDevice:
         # compute spans for the overlap fraction.
         from ..profiling.trace import KEY_H2D
         t0 = time.perf_counter_ns()
-        N.lib.ptc_prof_event(self.ctx._ptr, KEY_H2D, 0, -1,
+        # ptc-scope: the dispatching task is live in hand — stamp its
+        # pool's request scope into the span's (otherwise unused) class
+        # slot, so per-request timelines attribute this stall.  -1 when
+        # unscoped (prefetch-lane spans stay -1: their tasks may retire
+        # while the lane stages, and overlapped h2d is not lost time).
+        scope = int(N.lib.ptc_task_scope(view._ptr)) or -1
+        N.lib.ptc_prof_event(self.ctx._ptr, KEY_H2D, 0, scope,
                              host.nbytes, self.qid, 0)
         # OWNED snapshot, not the raw view: jax may read the h2d source
         # AFTER device_put returns (async dispatch), and `host` is a view
@@ -1904,7 +1910,7 @@ class TpuDevice:
         # Observed failure: the first 16 bytes of a consumed panel turn
         # into freed-chunk heap metadata (tests/comm potrf device runs).
         darr = self._jax.device_put(np.array(host, copy=True), self.device)
-        N.lib.ptc_prof_event(self.ctx._ptr, KEY_H2D, 1, -1,
+        N.lib.ptc_prof_event(self.ctx._ptr, KEY_H2D, 1, scope,
                              host.nbytes, self.qid, 0)
         stall = time.perf_counter_ns() - t0
         self._disp_stall_ns += stall

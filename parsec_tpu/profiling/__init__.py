@@ -23,22 +23,24 @@ TPU-native equivalent over the native core's 8-word event stream
 """
 from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
                     KEY_COMM_SEND, KEY_COMM_RECV, KEY_DEVICE, KEY_H2D,
-                    KEY_STREAM, KEY_COLL, Dictionary, Trace, take_trace,
-                    to_dot)
+                    KEY_STREAM, KEY_COLL, KEY_SCOPE, Dictionary, Trace,
+                    take_trace, to_dot)
 from .critpath import critical_path, lost_time
 from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
                    CommVolume, DeviceActivity, StragglerLog, REGISTRY,
                    enable_pins)
 from .metrics import (Hist, MetricsRegistry, MetricsExporter, Watchdog,
                       snapshot_histograms)
+from .scope import ScopeRegistry, request_timeline
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE", "KEY_H2D",
-           "KEY_STREAM", "KEY_COLL", "Dictionary", "Trace", "take_trace",
-           "to_dot",
+           "KEY_STREAM", "KEY_COLL", "KEY_SCOPE", "Dictionary", "Trace",
+           "take_trace", "to_dot",
            "critical_path", "lost_time",
            "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
            "CommVolume", "DeviceActivity", "StragglerLog", "REGISTRY",
            "enable_pins",
            "Hist", "MetricsRegistry", "MetricsExporter", "Watchdog",
-           "snapshot_histograms"]
+           "snapshot_histograms",
+           "ScopeRegistry", "request_timeline"]

@@ -178,6 +178,42 @@ class LiveMonitor:
             except Exception:
                 pass  # histograms are best-effort in a live sample
             rec["trace_dropped"] = ctx.profile_dropped()
+        # serving rows (ptc-serve + ptc-scope): per-tenant occupancy,
+        # TTFT/latency p99, tokens/s, SLO burn and the conformance
+        # makespan ratio — the live tenant table tools/ptc_top.py draws
+        servers = getattr(ctx, "_servers", None)
+        if servers:
+            try:
+                sv = servers[-1].stats()
+                rec["serve"] = {
+                    name: {"active": row["active_pools"],
+                           "queued": row["queue_depth"],
+                           "rejected": row["rejected"]}
+                    for name, row in sv["tenants"].items()}
+            except Exception:
+                pass
+        reg = getattr(ctx, "_scope_registry", None)
+        if reg is not None:
+            try:
+                sc = reg.stats()
+                rec["tenants"] = {
+                    name: {"completed": row["completed"],
+                           "ttft_p99_ms": round(
+                               row["ttft_ns_p99"] / 1e6, 3),
+                           "latency_p99_ms": round(
+                               row["latency_ns_p99"] / 1e6, 3),
+                           "tok_s_p50": row["tokens_per_s_p50"],
+                           "slo_burn": (sc["slo"].get(name) or {}).get(
+                               "burn_rate")}
+                    for name, row in sc["tenants"].items()}
+                conf = sc["conformance"]
+                rec["conformance"] = {
+                    "coverage": conf["coverage"],
+                    "makespan_ratio_p50": conf["makespan"]["ratio_p50"],
+                    "comm_sound": conf["comm_bytes"]["sound"],
+                }
+            except Exception:
+                pass
         ru = ctx.rusage()
         rec["maxrss_kb"] = ru["maxrss_kb"]
         rec["utime_s"] = ru["utime_s"]

@@ -240,6 +240,7 @@ class MetricsRegistry:
                 l2 = f"{{{lbl[:-1]}}}" if lbl else ""
                 lines.append(f"{fam}_sum{l2} {h.sum_ns / 1e9:.9g}")
                 lines.append(f"{fam}_count{l2} {h.count}")
+        lines.extend(self._tenant_lines())
         for name, v in sorted(self.counters().items()):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {v:.9g}" if isinstance(v, float)
@@ -249,6 +250,68 @@ class MetricsRegistry:
             lines.append("# TYPE ptc_watchdog_detections_total counter")
             lines.append(f"ptc_watchdog_detections_total {len(wd.events)}")
         return "\n".join(lines) + "\n"
+
+
+    # per-tenant SLO families (ptc-scope): the per-request metrics a
+    # tenant dashboard alerts on, labelled tenant="..." — latencies in
+    # seconds, tokens/s as-is
+    _TENANT_FAMILIES = (
+        ("ttft_ns", "ptc_tenant_ttft_seconds", 1e-9,
+         "time to first token"),
+        ("queue_wait_ns", "ptc_tenant_queue_wait_seconds", 1e-9,
+         "submit -> admitted wait"),
+        ("latency_ns", "ptc_tenant_request_seconds", 1e-9,
+         "submit -> done latency"),
+        ("tokens_per_s", "ptc_tenant_tokens_per_second", 1.0,
+         "per-request decode rate"),
+    )
+    _TENANT_COUNTERS = ("submitted", "completed", "failed", "rejected",
+                        "slo_violations")
+
+    def _tenant_lines(self) -> List[str]:
+        """Tenant-dimensioned exposition from the ScopeRegistry (empty
+        when no serve stack is attached)."""
+        reg = getattr(self.ctx, "_scope_registry", None)
+        if reg is None:
+            return []
+        lines: List[str] = []
+        try:
+            with reg._lock:
+                tenants = {name: ({k: t.hists[k] for k, _, _, _ in
+                                   self._TENANT_FAMILIES},
+                                  dict(t.counters))
+                           for name, t in reg.tenants.items()}
+            slo = reg.slo_status()
+        except Exception:
+            return []
+        for key, fam, scale, help_ in self._TENANT_FAMILIES:
+            rows = [(n, h[key]) for n, (h, _) in sorted(tenants.items())
+                    if h[key].count > 0]
+            if not rows:
+                continue
+            lines.append(f"# HELP {fam} {help_} (per tenant)")
+            lines.append(f"# TYPE {fam} summary")
+            for name, h in rows:
+                lbl = f'tenant="{name}"'
+                for q in (0.5, 0.9, 0.99):
+                    lines.append(f'{fam}{{{lbl},quantile="{q}"}} '
+                                 f"{h.quantile(q) * scale:.9g}")
+                lines.append(f"{fam}_sum{{{lbl}}} {h.sum * scale:.9g}")
+                lines.append(f"{fam}_count{{{lbl}}} {h.count}")
+        for cname in self._TENANT_COUNTERS:
+            fam = f"ptc_tenant_{cname}_total"
+            rows = [(n, c.get(cname, 0))
+                    for n, (_, c) in sorted(tenants.items())]
+            if not any(v for _, v in rows):
+                continue
+            lines.append(f"# TYPE {fam} counter")
+            for name, v in rows:
+                lines.append(f'{fam}{{tenant="{name}"}} {v}')
+        for name, st in sorted(slo.items()):
+            lines.append("# TYPE ptc_tenant_slo_burn_rate gauge")
+            lines.append(f'ptc_tenant_slo_burn_rate{{tenant="{name}"}} '
+                         f"{st['burn_rate']:.9g}")
+        return lines
 
 
 class MetricsExporter:
@@ -294,7 +357,23 @@ class MetricsExporter:
                         wd = getattr(exporter.ctx, "_watchdog", None)
                         st = wd.status() if wd is not None else {
                             "watchdog": "off"}
-                        code = 503 if st.get("detections") else 200
+                        # tenant SLO burn (ptc-scope) degrades health
+                        # exactly like a watchdog detection: a scraper
+                        # needs ONE endpoint for "is this serving rank
+                        # meeting its promises"
+                        reg = getattr(exporter.ctx, "_scope_registry",
+                                      None)
+                        breached = False
+                        if reg is not None:
+                            try:
+                                slo = reg.slo_status()
+                                st = dict(st, slo=slo)
+                                breached = any(v.get("breached")
+                                               for v in slo.values())
+                            except Exception:
+                                pass
+                        code = 503 if (st.get("detections") or breached) \
+                            else 200
                         self._send(code, "application/json",
                                    json.dumps(st, default=str).encode())
                     else:
@@ -415,19 +494,60 @@ class Watchdog:
         k = N.lib.ptc_metrics_class_name(self.ctx._ptr, mid, buf, 256)
         return buf.value.decode(errors="replace") if k > 0 else f"#{mid}"
 
+    def _scope_owner(self, scope: int) -> dict:
+        """Name the victim request of a scoped detection: tenant + rid
+        from the ScopeRegistry's legend (empty for unscoped work)."""
+        if not scope:
+            return {}
+        out = {"scope_id": int(scope)}
+        reg = getattr(self.ctx, "_scope_registry", None)
+        if reg is not None:
+            try:
+                with reg._lock:
+                    r = reg.requests.get(int(scope))
+                if r is not None:
+                    out["tenant"] = r.tenant
+                    if r.rid is not None:
+                        out["rid"] = r.rid
+            except Exception:
+                pass
+        return out
+
+    def _live_requests(self, cap: int = 8) -> list:
+        """The in-flight requests at detection time (for detections —
+        stalled pull, starved worker — with no single owning task):
+        the flight dump then still names candidate victims."""
+        reg = getattr(self.ctx, "_scope_registry", None)
+        if reg is None:
+            return []
+        out = []
+        try:
+            with reg._lock:
+                for sid, r in reg.requests.items():
+                    if r.kind == "request" and r.state in ("submitted",
+                                                           "running"):
+                        out.append({"scope_id": sid, "tenant": r.tenant,
+                                    "rid": r.rid})
+                        if len(out) >= cap:
+                            break
+        except Exception:
+            pass
+        return out
+
     def _check_stuck(self, now_ns: int):
-        cap = 3 * (self.ctx.nb_workers + 2)
+        cap = 4 * (self.ctx.nb_workers + 2)
         buf = (C.c_int64 * cap)()
         n = N.lib.ptc_metrics_inflight(self.ctx._ptr, buf, cap)
         if n <= 0:
             return
         p99 = self._exec_p99()
-        for i in range(0, int(n), 3):
-            worker, mid, begin = buf[i], buf[i + 1], buf[i + 2]
+        for i in range(0, int(n), 4):
+            worker, mid, begin, scope = (buf[i], buf[i + 1], buf[i + 2],
+                                         buf[i + 3])
             open_ns = now_ns - begin
             deadline = max(self.k * p99.get(mid, 0.0), self.floor_ns)
             if open_ns > deadline:
-                self._emit({
+                self._emit(dict({
                     "type": "stuck_task",
                     "key": (worker, begin),
                     "task_class": self._class_name(mid),
@@ -435,7 +555,7 @@ class Watchdog:
                     "open_ms": round(open_ns / 1e6, 1),
                     "deadline_ms": round(deadline / 1e6, 1),
                     "class_p99_ms": round(p99.get(mid, 0.0) / 1e6, 3),
-                })
+                }, **self._scope_owner(scope)))
 
     def _check_starved(self):
         ex = self.ctx.worker_stats()
@@ -458,6 +578,7 @@ class Watchdog:
                         "worker": w,
                         "ticks": self._starve_count[w],
                         "others_progress": total,
+                        "live_requests": self._live_requests(),
                     }, dump=False)
             else:
                 self._starve_count[w] = 0
@@ -480,6 +601,7 @@ class Watchdog:
                 "key": cur[1],
                 "pending_pulls": int(cur[0]),
                 "stalled_for_s": round(self.interval, 3),
+                "live_requests": self._live_requests(),
             })
 
     def _check_slow_ranks(self):
@@ -502,6 +624,29 @@ class Watchdog:
                     "median_rtt_ms": round(median / 1e6, 3),
                 }, dump=False)
 
+    def _check_slo_burn(self):
+        """Tenant SLO burn (ptc-scope): a tenant whose sliding-window
+        violation rate reached its burn threshold gets a structured
+        event (advisory: the flight dump stays armed for harder
+        incidents, /healthz already turns 503)."""
+        reg = getattr(self.ctx, "_scope_registry", None)
+        if reg is None:
+            return
+        try:
+            status = reg.slo_status()
+        except Exception:
+            return
+        for tenant, st in status.items():
+            if st.get("breached"):
+                self._emit({
+                    "type": "slo_burn",
+                    "key": (tenant, st["violations"]),
+                    "tenant": tenant,
+                    "slo_ms": st["slo_ms"],
+                    "burn_rate": st["burn_rate"],
+                    "window_n": st["window_n"],
+                }, dump=False)
+
     # --------------------------------------------------------------- run
     def _tick(self):
         self.ticks += 1
@@ -509,6 +654,7 @@ class Watchdog:
         self._check_starved()
         self._check_stalled_pull()
         self._check_slow_ranks()
+        self._check_slo_burn()
 
     def _loop(self):
         warned = False
@@ -539,11 +685,11 @@ class Watchdog:
 
 def _native_now() -> int:
     """Clock base for comparing against the native inflight begin_ns
-    stamps: ptc_now_ns sits on the std::chrono::steady_clock epoch
-    (the TSC fast path is calibrated against it), which is
-    CLOCK_MONOTONIC on Linux/libstdc++ — the same clock
-    time.monotonic_ns reads."""
-    return time.monotonic_ns()
+    stamps: the runtime's OWN ptc_now_ns (exported as ptc_clock_ns) —
+    its TSC fast path drifts from CLOCK_MONOTONIC over long processes,
+    so time.monotonic_ns would skew open-duration estimates by
+    milliseconds after minutes of uptime."""
+    return int(N.lib.ptc_clock_ns())
 
 
 def enable_from_param(ctx, secs) -> Optional[Watchdog]:

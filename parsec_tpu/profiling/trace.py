@@ -46,6 +46,17 @@ KEY_COLL = 8       # collective-step delivery on a ptc_coll_* task class
                    # the same frame): l0 = source rank, l1 = correlation
                    # cookie, aux = payload bytes — the evidence behind
                    # the coll_wait lost-time bucket (critpath.lost_time)
+KEY_SCOPE = 9      # request-scope flow tag (instant span, emitted
+                   # alongside COMM_SEND on the producer and COMM_RECV
+                   # on the consumer when the sending pool carries a
+                   # scope stamp): l0 = source rank, l1 = correlation
+                   # cookie, aux = scope_id — maps each wire flow back
+                   # to the request it served.  EXEC/RELEASE spans of a
+                   # scoped pool carry the scope in their aux word, and
+                   # the device layer stamps dispatch-lane H2D spans'
+                   # class slot with it (prefetch-lane/STREAM spans stay
+                   # -1: overlapped staging is not request lost time).
+                   # See profiling/scope.py.
 
 _MAGIC = b"#PTCPROF"
 _VERSION = 2
@@ -61,6 +72,7 @@ _DEFAULT_KEYS = {
     KEY_H2D: ("DEVICE_H2D", "#00aaff"),
     KEY_STREAM: ("STREAM_D2H", "#ffaa00"),
     KEY_COLL: ("COLL_RECV", "#00ffcc"),
+    KEY_SCOPE: ("SCOPE", "#ff00aa"),
 }
 
 
@@ -420,6 +432,75 @@ class Trace:
             "src", "dst", "corr", "bytes", "send_ns", "recv_ns",
             "latency_ns"])
 
+    # -------------------------------------------------- request scopes
+    def scope_flows(self) -> Dict:
+        """(src_rank, corr) -> scope_id from the SCOPE flow tags —
+        the map that attributes matched wire flows to requests.  Both
+        the producer and the consumer emit the tag under the same key,
+        so single-rank and merged traces resolve identically."""
+        ev = self.events
+        out: Dict = {}
+        for i in np.flatnonzero((ev[:, 0] == KEY_SCOPE)
+                                & (ev[:, 1] == 0)):
+            out[(int(ev[i, 3]), int(ev[i, 4]))] = int(ev[i, 6])
+        return out
+
+    def scope_ids(self) -> List[int]:
+        """Distinct request-scope ids present in this trace (EXEC aux
+        stamps + SCOPE flow tags), sorted."""
+        ev = self.events
+        ids = set()
+        ex = (ev[:, 0] == KEY_EXEC) & (ev[:, 1] == 0) & (ev[:, 6] > 0)
+        ids.update(int(v) for v in np.unique(ev[ex, 6]))
+        ids.update(self.scope_flows().values())
+        ids.discard(0)
+        return sorted(ids)
+
+    def filter_scope(self, scope_id: int) -> "Trace":
+        """The sub-trace of ONE request: EXEC/RELEASE spans whose aux
+        carries `scope_id`, H2D/STREAM staging spans the device layer
+        stamped with it (class slot), the COMM/COLL instants of its
+        wire flows, its SCOPE tags, and the EDGE pairs between its own
+        EXEC nodes.  Everything else — other tenants' pools, unscoped
+        work — is dropped, so per-request critical_path()/lost_time()
+        cannot conflate same-numbered classes across pools (class ids
+        are per-pool)."""
+        ev, rk = self.events, self.ranks
+        sid = int(scope_id)
+        keep = np.zeros(len(ev), dtype=bool)
+        keep |= ((ev[:, 0] == KEY_EXEC) | (ev[:, 0] == KEY_RELEASE)) & \
+            (ev[:, 6] == sid)
+        keep |= ((ev[:, 0] == KEY_H2D) | (ev[:, 0] == KEY_STREAM)) & \
+            (ev[:, 2] == sid)
+        keep |= (ev[:, 0] == KEY_SCOPE) & (ev[:, 6] == sid)
+        # wire flows of this scope: (src, corr) keys from the SCOPE tags
+        fkeys = {k for k, v in self.scope_flows().items() if v == sid}
+        if fkeys:
+            send = ev[:, 0] == KEY_COMM_SEND
+            recvish = (ev[:, 0] == KEY_COMM_RECV) | (ev[:, 0] == KEY_COLL)
+            for i in np.flatnonzero(send):
+                if (int(rk[i]), int(ev[i, 4])) in fkeys:
+                    keep[i] = True
+            for i in np.flatnonzero(recvish):
+                if (int(ev[i, 3]), int(ev[i, 4])) in fkeys:
+                    keep[i] = True
+        # EDGE pairs whose src or dst is one of this scope's EXEC nodes
+        nodes = {(int(e[2]), int(e[3]), int(e[4]))
+                 for e in ev[(ev[:, 0] == KEY_EXEC) & (ev[:, 6] == sid)]}
+        ei = np.flatnonzero((ev[:, 0] == KEY_EDGE) & (ev[:, 1] == 0))
+        for i in ei:
+            if i + 1 >= len(ev) or ev[i + 1, 0] != KEY_EDGE or \
+                    ev[i + 1, 1] != 1:
+                continue
+            s = (int(ev[i, 2]), int(ev[i, 3]), int(ev[i, 4]))
+            d = (int(ev[i + 1, 2]), int(ev[i + 1, 3]), int(ev[i + 1, 4]))
+            if s in nodes or d in nodes:
+                keep[i] = keep[i + 1] = True
+        out = Trace(ev[keep].copy(), self.dict, self.rank,
+                    dict(self.meta, scope=sid), self.class_names)
+        out.ranks = rk[keep].copy()
+        return out
+
     # -------------------------------------------------------- analysis
     def critical_path(self, **kw):
         """Executed-DAG critical path (see profiling.critpath): walks
@@ -508,6 +589,16 @@ def take_trace(ctx, rank: Optional[int] = None,
     try:
         m.setdefault("dropped_events", ctx.profile_dropped())
         m.setdefault("ring_bytes", ctx.profile_ring())
+    except Exception:
+        pass
+    # request-scope legend (header stays v2: meta is free-form JSON) —
+    # a flight-recorder dump names the requests its spans belong to
+    try:
+        reg = getattr(ctx, "_scope_registry", None)
+        if reg is not None:
+            legend = reg.scope_legend()
+            if legend:
+                m.setdefault("scopes", legend)
     except Exception:
         pass
     return Trace(ctx.profile_take(), rank=rank, class_names=class_names,

@@ -106,7 +106,8 @@ class RequestHandle:
     generated tokens/outputs filled in by the decode loop."""
 
     __slots__ = ("rid", "tenant", "prompt", "max_new", "ticket", "tokens",
-                 "outputs", "state", "submitted_t", "done_t", "_seq")
+                 "outputs", "state", "submitted_t", "done_t", "_seq",
+                 "scope_id")
 
     def __init__(self, rid: int, tenant: str, prompt: Sequence[int],
                  max_new: int):
@@ -115,6 +116,7 @@ class RequestHandle:
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.ticket = None
+        self.scope_id: Optional[int] = None  # ptc-scope request id
         self.tokens: List[int] = list(self.prompt)
         self.outputs: List[np.ndarray] = []
         self.state = "submitted"  # -> active -> done | rejected | failed
@@ -165,10 +167,15 @@ class InferenceEngine:
                  max_seqs: int = 16, server: Optional[Server] = None,
                  tenants: Optional[List[TenantConfig]] = None,
                  name: str = "eng", body_wrap: Optional[Callable] = None,
-                 dev=None):
+                 dev=None, conformance: bool = True):
         cfg = model.cfg
         self.ctx = ctx
         self.model = model
+        # ptc-scope: per-request scopes (TTFT/tokens-per-s SLO feed) +
+        # per-decode-step shared scopes; conformance=True statically
+        # plans each decode pool so plan-vs-measured stays covered
+        self.scope = ctx.scope_registry()
+        self.conformance = bool(conformance)
         self.pool = PagePool(ctx, n_pages, cfg.page, cfg.d,
                              name=f"{name}_KV")
         (self.Qc, self.ACCc, self.Oc, self.KNc,
@@ -221,6 +228,9 @@ class InferenceEngine:
             rid = self._next_rid
             self._next_rid += 1
         req = RequestHandle(rid, tenant, prompt, max_new)
+        req.scope_id = self.scope.new_scope(tenant, rid=rid,
+                                            meta={"prompt": len(req.prompt),
+                                                  "max_new": max_new})
         self.requests.append(req)
         P = self.model.cfg.page
         n_pages = (len(req.prompt) + P - 1) // P
@@ -228,7 +238,7 @@ class InferenceEngine:
         req.ticket = self.server.submit(
             tenant, lambda priority, weight, req=req: self._build_prefill(
                 req, priority, weight),
-            est_bytes=est, meta={"rid": rid})
+            est_bytes=est, meta={"rid": rid}, scope=req.scope_id)
         if req.ticket.state == "rejected":
             req.state = "rejected"
             req.done_t = time.monotonic()
@@ -288,6 +298,9 @@ class InferenceEngine:
         req.outputs.append(o)
         nxt = self.model.next_token(o)
         req.tokens.append(nxt)
+        # the prefill chain attended the last prompt position: this IS
+        # the first generated token — the tenant TTFT histogram's feed
+        self.scope.record_first_token(req.scope_id)
         seq = _Seq(req, spec.slot, spec.pages, len(req.prompt))
         seq.remaining = req.max_new - 1
         req._seq = seq
@@ -343,9 +356,24 @@ class InferenceEngine:
                 self.ctx, self.pool, specs, self.slot_names,
                 priority=prio, weight=wt, body_wrap=self.body_wrap,
                 dev=self.dev)
+            # ptc-scope: one shared scope per decode step, with the
+            # member rid order matching the spec order so EXEC spans'
+            # sequence lane (locals[0]) maps back to each request; plan
+            # the pool for the conformance record when enabled
+            dsid = self.scope.new_scope(
+                tenant, kind="decode_step",
+                members=[s.req.rid for s in seqs])
+            self.scope.stamp(tp, dsid)
+            plan = None
+            if self.conformance:
+                try:
+                    plan = self.scope.plan_summary(tp.plan())
+                except Exception:
+                    plan = None
             done = threading.Event()
             tp.on_complete(done.set)
-            self._inflight[tenant] = (tp, seqs, done)
+            self._inflight[tenant] = (tp, seqs, done, dsid, plan,
+                                      time.monotonic_ns())
             tp.run()
             self.stats["decode_pools"] += 1
             launched += 1
@@ -358,7 +386,7 @@ class InferenceEngine:
         done = [(t, rec) for t, rec in self._inflight.items()
                 if rec[2].is_set()]
         advanced = 0
-        for tenant, (tp, seqs, _) in done:
+        for tenant, (tp, seqs, _, dsid, plan, t0_ns) in done:
             del self._inflight[tenant]
             for seq in seqs:
                 o = self.Oc.tile(seq.slot, 0)[0].copy()
@@ -368,6 +396,16 @@ class InferenceEngine:
                 seq.length += 1
                 seq.remaining -= 1
                 advanced += 1
+            # conformance: decode-step pool retired — compare the plan
+            # snapshot against the measured step wall + lane counters
+            qos = None
+            try:
+                qos = tp.qos_stats()
+            except Exception:
+                pass
+            self.scope.record_pool_done(
+                dsid, qos=qos, plan=plan,
+                measured={"wall_ns": time.monotonic_ns() - t0_ns})
             tp.destroy()
             self.stats["decode_steps"] += 1
         with self._lock:
@@ -380,8 +418,8 @@ class InferenceEngine:
         wait for ALL in-flight pools, reap.  Returns sequences
         advanced (0 = nothing active)."""
         self._launch()
-        for _, (_, _, done) in list(self._inflight.items()):
-            done.wait()
+        for rec in list(self._inflight.values()):
+            rec[2].wait()
         return self._reap()
 
     def _retire_locked(self, seq: _Seq):
@@ -392,6 +430,9 @@ class InferenceEngine:
         seq.req.state = "done"
         seq.req.done_t = time.monotonic()
         self.stats["retired"] += 1
+        # request terminal: tenant latency/tokens-per-s SLO feed
+        self.scope.record_done(seq.req.scope_id, state="done",
+                               tokens=len(seq.req.generated))
         # pages/slots freed outside pool completion: unblock
         # ResourceBusy-paused tenants (lock order: engine -> server is
         # safe — server never calls into the engine under its lock)

@@ -53,7 +53,9 @@ class TenantConfig:
     def __init__(self, name: str, priority: int = 0, weight: int = 1,
                  max_pools: int = 4, max_queue: int = 64,
                  max_queued_bytes: Optional[int] = None,
-                 default_est_bytes: Optional[int] = None):
+                 default_est_bytes: Optional[int] = None,
+                 slo_ms: Optional[float] = None,
+                 slo_burn: float = 0.5):
         self.name = name
         self.priority = int(priority)
         self.weight = max(1, int(weight))
@@ -64,6 +66,12 @@ class TenantConfig:
         # means UNKNOWN): None = derive the static ptc-plan bound from
         # the submitted pool instead (see Server.submit)
         self.default_est_bytes = default_est_bytes
+        # SLO target on submit->done latency (ms).  The ScopeRegistry
+        # tracks a sliding violation window; a burn rate at or above
+        # `slo_burn` marks the tenant breached — /healthz turns 503 and
+        # the watchdog emits a structured slo_burn event.
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        self.slo_burn = float(slo_burn)
 
 
 class Ticket:
@@ -72,7 +80,7 @@ class Ticket:
 
     __slots__ = ("tenant", "est_bytes", "meta", "state", "submitted_t",
                  "admitted_t", "done_t", "error", "_event", "_make_pool",
-                 "_pool")
+                 "_pool", "scope_id", "_owns_scope", "_plan")
 
     def __init__(self, tenant: str, make_pool: Callable, est_bytes,
                  meta):
@@ -89,6 +97,13 @@ class Ticket:
         self._event = threading.Event()
         self._make_pool = make_pool
         self._pool = None
+        # request scope (ptc-scope): stamped into the pool at admission;
+        # _owns_scope = the server allocated it, so pool completion IS
+        # the request's terminal state (an engine-owned scope outlives
+        # the prefill pool — the engine retires it)
+        self.scope_id: Optional[int] = None
+        self._owns_scope = False
+        self._plan: Optional[dict] = None  # ptc-plan prediction summary
 
     @property
     def terminal(self) -> bool:
@@ -139,9 +154,19 @@ class Server:
     """
 
     def __init__(self, ctx, tenants: List[TenantConfig],
-                 name: str = "serve"):
+                 name: str = "serve", conformance: bool = True):
         self.ctx = ctx
         self.name = name
+        # request-scope observability (ptc-scope): every ticket gets a
+        # scope_id stamped into its pool beside the QoS stamp; the
+        # registry folds tenant SLO metrics + plan-vs-measured
+        # conformance.  conformance=False skips the per-pool ptc-plan
+        # pass (prediction-free pools count against coverage).
+        self.scope = ctx.scope_registry()
+        self.conformance = bool(conformance)
+        for t in tenants:
+            self.scope.tenant(t.name, slo_ms=t.slo_ms,
+                              burn_threshold=t.slo_burn)
         self._tenants: Dict[str, _TenantState] = {
             t.name: _TenantState(t) for t in tenants}
         self._lock = threading.Lock()
@@ -159,11 +184,14 @@ class Server:
 
     # ------------------------------------------------------------ submit
     def add_tenant(self, cfg: TenantConfig):
+        self.scope.tenant(cfg.name, slo_ms=cfg.slo_ms,
+                          burn_threshold=cfg.slo_burn)
         with self._lock:
             self._tenants[cfg.name] = _TenantState(cfg)
 
     def submit(self, tenant: str, make_pool: Callable, est_bytes: int = 0,
-               meta=None, wait: bool = False) -> Ticket:
+               meta=None, wait: bool = False,
+               scope: Optional[int] = None) -> Ticket:
         """Submit one request DAG.  Returns its Ticket immediately
         (state "queued", "running" — admitted synchronously — or
         "rejected").  wait=True blocks for the terminal state and
@@ -178,11 +206,22 @@ class Server:
         the built pool is reused at admission, never built twice.  A
         submission whose bytes cannot be bounded at all is REJECTED
         whenever the byte budget applies: the budget can no longer be
-        evaded."""
+        evaded.
+
+        `scope` attaches a caller-owned request scope (the inference
+        engine allocates one per request): the server stamps it into
+        the pool but does not retire it at pool completion.  Left None,
+        the server allocates its own — pool completion is then the
+        request's terminal state."""
         if self._closed:
             raise RuntimeError("server closed")
         t = self._tenants[tenant]
         ticket = Ticket(tenant, make_pool, est_bytes, meta)
+        if scope is None:
+            ticket.scope_id = self.scope.new_scope(tenant, meta=meta)
+            ticket._owns_scope = True
+        else:
+            ticket.scope_id = int(scope)
         if (ticket.est_bytes is None or ticket.est_bytes <= 0) \
                 and t.cfg.max_queued_bytes is not None:
             early = self._resolve_est(t, ticket)
@@ -205,6 +244,8 @@ class Server:
                 ticket.state = "rejected"
                 ticket.done_t = time.monotonic()
                 ticket._event.set()
+        if ticket.state == "rejected":
+            self.scope.record_rejected(ticket.scope_id)
         if ticket.state == "rejected" and ticket._pool is not None:
             self._destroy_pool(ticket)  # planning pool never admitted
         if admit_now:
@@ -247,10 +288,14 @@ class Server:
             ticket.error = e
             ticket.done_t = time.monotonic()
             ticket._event.set()
+            self.scope.record_done(ticket.scope_id, state="failed")
             return ticket
         ticket._pool = tp  # reused by _admit; destroyed on rejection
         try:
-            ticket.est_bytes = tp.plan().est_bytes()  # None = unbounded
+            plan = tp.plan()
+            ticket.est_bytes = plan.est_bytes()  # None = unbounded
+            if self.conformance:
+                ticket._plan = self.scope.plan_summary(plan)
         except Exception:
             ticket.est_bytes = None
         return None
@@ -290,10 +335,26 @@ class Server:
             ticket.error = e
             ticket.done_t = time.monotonic()
             ticket._event.set()
+            self.scope.record_done(ticket.scope_id, state="failed")
             return
         ticket._pool = tp
         ticket.admitted_t = time.monotonic()
         ticket.state = "running"
+        # ptc-scope: stamp the request scope beside the QoS stamp
+        # (EXEC spans, wire frames and the watchdog inflight slot all
+        # carry it from here on), mark admission, and snapshot the
+        # static plan predictions the conformance record compares
+        # against at retirement
+        if ticket.scope_id is not None:
+            self.scope.stamp(tp, ticket.scope_id)
+            # no explicit timestamp: the registry reads the native
+            # trace clock, which its windows must align with
+            self.scope.record_admitted(ticket.scope_id)
+            if self.conformance and ticket._plan is None:
+                try:
+                    ticket._plan = self.scope.plan_summary(tp.plan())
+                except Exception:
+                    ticket._plan = None
         with self._lock:
             t.counters["admitted"] += 1
             t.counters["queue_wait_ns"] += int(ticket.queue_wait_s * 1e9)
@@ -308,6 +369,7 @@ class Server:
             ticket.error = e
             ticket.done_t = time.monotonic()
             ticket._event.set()
+            self.scope.record_done(ticket.scope_id, state="failed")
 
     def _on_pool_complete(self, t: _TenantState, ticket: Ticket):
         """Fires on the completing worker thread: only mark + wake the
@@ -325,6 +387,28 @@ class Server:
                 ticket.state = "done"
             self._retired.append(ticket)
             self._wake.notify_all()
+        # ptc-scope: fold the pool's conformance record (plan
+        # predictions vs measured wall + the pool's QoS lane counters)
+        # while the native pool is still alive; the request itself
+        # retires here only when the server owns the scope (an
+        # engine-owned scope keeps decoding past its prefill pool)
+        if ticket.scope_id is not None:
+            qos = None
+            try:
+                qos = ticket._pool.qos_stats() \
+                    if ticket._pool is not None else None
+            except Exception:
+                pass
+            measured = None
+            if ticket.admitted_t is not None:
+                measured = {"wall_ns": int(
+                    (ticket.done_t - ticket.admitted_t) * 1e9)}
+            self.scope.record_pool_done(ticket.scope_id, qos=qos,
+                                        plan=ticket._plan,
+                                        measured=measured)
+            if ticket._owns_scope:
+                self.scope.record_done(ticket.scope_id,
+                                       state=ticket.state)
         ticket._event.set()
 
     def notify_resources(self):

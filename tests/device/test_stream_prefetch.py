@@ -70,7 +70,24 @@ def test_unified_stats_schema_single_rank():
         try:
             s = ctx.stats()
             assert set(s) == {"sched", "device", "comm", "coll", "trace",
-                              "metrics", "serve", "plan"}
+                              "metrics", "serve", "plan", "scope"}
+            # PR 11: request-scope namespace — schema-stable with no
+            # registry attached, full rollup once one exists
+            assert s["scope"] == {"enabled": False}
+            reg_scope = ctx.scope_registry()
+            sid = reg_scope.new_scope("t0")
+            reg_scope.record_admitted(sid)
+            reg_scope.record_done(sid)
+            sc = ctx.stats()["scope"]
+            assert set(sc) == {"enabled", "scopes", "requests", "live",
+                               "tenants", "slo", "conformance"}
+            assert sc["enabled"] is True and sc["requests"] == 1
+            conf = sc["conformance"]
+            assert set(conf) == {"pools", "planned", "coverage",
+                                 "makespan", "comm_bytes", "residency",
+                                 "spills", "per_class"}
+            for k in ("predicted_sum", "measured", "sound"):
+                assert k in conf["comm_bytes"], k
             for k in ("level", "ring_bytes", "dropped_events", "clock"):
                 assert k in s["trace"], k
             assert "bypass_hits" in s["sched"]

@@ -1,0 +1,41 @@
+"""tools/ptc_top.py: the live tenant dashboard renders a real
+LiveMonitor sink (the ad-hoc live_tail replacement for serve runs)."""
+import os
+import sys
+
+import parsec_tpu as pt
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+
+def test_ptc_top_renders_live_sink(tmp_path, capsys):
+    from parsec_tpu.profiling.live import LiveMonitor
+    from parsec_tpu.serve import InferenceEngine, PagedLM, PagedLMConfig
+    from parsec_tpu.serve import TenantConfig
+    import tools.ptc_top as top
+
+    sink = str(tmp_path / "live.jsonl")
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        mon = LiveMonitor(ctx, path=sink, interval=30.0)
+        eng = InferenceEngine(
+            ctx, PagedLM(PagedLMConfig(vocab=16, d=8, page=4)),
+            n_pages=16, max_seqs=4,
+            tenants=[TenantConfig("hi", slo_ms=60_000)])
+        h = eng.submit([1, 2, 3], 2, "hi")
+        eng.run(timeout_s=60)
+        assert h.state == "done"
+        mon.stop()  # final sample carries the tenant/conformance rows
+        eng.close()
+    assert top.main(["--live", sink, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "tenant" in out and "hi" in out, out
+    assert "conformance:" in out, out
+
+
+def test_ptc_top_no_sinks(tmp_path, capsys):
+    import tools.ptc_top as top
+
+    missing = str(tmp_path / "absent.jsonl")
+    assert top.main(["--live", missing, "--once"]) == 0
+    assert "no live sinks" in capsys.readouterr().out

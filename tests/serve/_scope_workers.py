@@ -1,0 +1,121 @@
+"""Per-rank worker for the 2-rank ptc-scope serve test (spawn target;
+reuses the comm test harness' context bring-up)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from comm._workers import _mk_ctx
+
+
+def scoped_serve(rank: int, nodes: int, port: int, out_dir: str,
+                 nb: int = 14):
+    """SPMD serve run: two tenants each submit one rank-hopping RW
+    chain through an admission-controlled Server (max_pools=1 keeps the
+    admission order — and hence the SPMD scope ids — deterministic).
+    Every rank saves its .ptt; rank 0 then merges and asserts the
+    acceptance properties: scope tags cross the wire, each request's
+    flows match 1:1 in both directions, and the per-request stage
+    partition sums exactly to the ticket's measured latency."""
+    from parsec_tpu.profiling import Trace, take_trace
+    from parsec_tpu.serve import Server, TenantConfig
+
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        ctx.profile_enable(2)  # +EDGE pairs: per-request critpath too
+        srv = Server(ctx, [
+            TenantConfig("hi", priority=2, weight=2, max_pools=1,
+                         slo_ms=60_000),
+            TenantConfig("lo", max_pools=1, slo_ms=60_000),
+        ])
+        arr = np.zeros(nodes, dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=8,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", 8)
+
+        def make_builder():
+            def build(priority, weight):
+                tp = pt.Taskpool(ctx, globals={"NB": nb},
+                                 priority=priority, weight=weight)
+                k = pt.L("k")
+                tc = tp.task_class("Hop")
+                tc.param("k", 0, pt.G("NB"))
+                tc.affinity("A", k % nodes)
+                tc.flow("A", "RW",
+                        pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                        pt.In(pt.Ref("Hop", k - 1, flow="A")),
+                        pt.Out(pt.Ref("Hop", k + 1, flow="A"),
+                               guard=(k < pt.G("NB"))),
+                        pt.Out(pt.Mem("A", 0), guard=(k == pt.G("NB"))),
+                        arena="t")
+
+                def body(view):
+                    view.data("A", dtype=np.int64)[0] += 1
+
+                tc.body(body)
+                return tp
+            return build
+
+        tickets = [srv.submit("hi", make_builder(), est_bytes=64),
+                   srv.submit("lo", make_builder(), est_bytes=64)]
+        assert srv.drain(timeout=60), [t.state for t in tickets]
+        for tkt in tickets:
+            assert tkt.state == "done", tkt.state
+            assert tkt.scope_id is not None
+        # the two tenants got distinct scopes, identically on each rank
+        sids = [t.scope_id for t in tickets]
+        assert len(set(sids)) == 2, sids
+        ctx.comm_fence()
+        tr = take_trace(ctx)
+        tr.save(os.path.join(out_dir, f"r{rank}.ptt"))
+        ctx.comm_fence()  # orders every rank's save before rank 0 reads
+        if rank == 0:
+            traces = [Trace.load(os.path.join(out_dir, f"r{r}.ptt"))
+                      for r in range(nodes)]
+            m = Trace.merge(traces)
+            _assert_scoped(m, ctx, tickets, nb, nodes)
+        srv.close()
+        ctx.comm_fini()
+
+
+def _assert_scoped(m, ctx, tickets, nb, nodes):
+    reg = ctx.scope_registry()
+    sf = m.scope_flows()
+    assert sf, "no SCOPE flow tags crossed the wire"
+    assert set(sf.values()) == {t.scope_id for t in tickets}, sf
+    for tkt in tickets:
+        sub = m.filter_scope(tkt.scope_id)
+        # wire hops of THIS request, matched 1:1 with both directions
+        fl = sub.flows()
+        assert len(fl) >= nb - 2, (tkt.tenant, len(fl))
+        assert (fl[:, 6] >= 0).all()  # post-merge causal
+        dirs = {(int(r[0]), int(r[1])) for r in fl}
+        assert dirs == {(0, 1), (1, 0)}, dirs
+        # flow arrows render (perfetto s/f events)
+        phases = {e["ph"] for e in sub.to_perfetto()["traceEvents"]}
+        assert "s" in phases and "f" in phases, phases
+        # EXEC spans landed on BOTH ranks under this scope
+        ev, rk = sub.events, sub.ranks
+        exec_ranks = set(int(r) for r in
+                         np.unique(rk[(ev[:, 0] == 0) & (ev[:, 1] == 0)]))
+        assert exec_ranks == {0, 1}, exec_ranks
+        # per-request stage partition == the ticket's measured latency
+        tl = reg.scope_timeline(m, tkt.scope_id)
+        st = tl["stages"]
+        assert tl["stages_sum_ns"] == tl["e2e_ns"], tl
+        measured_ns = tkt.latency_s * 1e9
+        assert abs(tl["e2e_ns"] - measured_ns) <= \
+            max(0.05 * measured_ns, 5e6), (tl["e2e_ns"], measured_ns)
+        assert st["exec_ns"] > 0 and st["wire_ns"] >= 0, st
+        # per-request critical path (level-2 EDGE capture): the chain
+        # is serial, so the path visits every local Hop instance and
+        # its total EXEC time sits inside the request window
+        cp = sub.critical_path()
+        assert cp["nodes"] >= nb // nodes, cp
+        assert 0 < cp["total_ns"] <= tl["window_ns"], (cp["total_ns"],
+                                                       tl["window_ns"])
+    # conformance: every pool planned, wire bound sound vs measured
+    conf = ctx.stats()["scope"]["conformance"]
+    assert conf["coverage"] == 1.0, conf
+    assert conf["comm_bytes"]["sound"] is True, conf

@@ -33,3 +33,13 @@ def test_bench_serve_schema():
     assert doc["decode"]["sequential_engine_checked"] == 1
     # the QoS run really rode the lanes
     assert doc["qos"]["qos_selects"] > 0
+    # ptc-scope section (PR 11): tenant SLO quantiles + conformance
+    sc = doc["scope"]
+    for k in ("ttft_p99_ms", "ttft_p50_ms", "tokens_per_s_p50",
+              "queue_wait_p99_ms"):
+        assert set(sc[k]) == {"hi", "lo"}, (k, sc[k])
+        assert sc[k]["hi"] >= 0
+    conf = sc["conformance"]
+    assert conf["coverage"] == 1.0, conf
+    assert conf["sound"] is True, conf
+    assert conf["per_class_classes"] > 0

@@ -1,0 +1,242 @@
+"""ptc-scope (PR 11): request-scoped observability, single rank.
+
+Acceptance pins (single-rank half; the 2-rank wire story lives in
+test_scope_dist.py):
+  - every completed request yields a loadable per-request timeline whose
+    stages (admission + lane wait + exec + h2d + wire) PARTITION its
+    measured end-to-end latency (exact identity, well inside the 5%
+    acceptance gate)
+  - Prometheus export carries tenant-labelled TTFT / tokens-per-s /
+    latency histograms and SLO burn gauges; /healthz turns 503 on burn
+  - stats()["scope"]["conformance"] reports plan-vs-measured ratios with
+    full coverage on an all-planned serve run
+  - watchdog stuck-task events name the victim REQUEST (scope + tenant
+    + rid), not just the class
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+import parsec_tpu as pt
+from parsec_tpu.profiling import KEY_EXEC, take_trace
+from parsec_tpu.profiling.metrics import MetricsExporter, Watchdog
+from parsec_tpu.serve import (InferenceEngine, PagedLM, PagedLMConfig,
+                              TenantConfig)
+
+
+def _mk_engine(ctx, slo_ms=None, **kw):
+    cfg = PagedLMConfig(vocab=32, d=8, page=4, seed=3)
+    model = PagedLM(cfg)
+    return InferenceEngine(
+        ctx, model, n_pages=32, max_seqs=8,
+        tenants=[TenantConfig("hi", priority=2, weight=2, slo_ms=slo_ms),
+                 TenantConfig("lo", slo_ms=slo_ms)], **kw)
+
+
+def test_request_timeline_partitions_latency():
+    """For EVERY completed request: stages sum exactly to the measured
+    end-to-end latency, exec is nonzero, and the decode waves of a
+    SHARED continuous-batching pool attribute to the right request via
+    the sequence lane."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        ctx.profile_enable(1)
+        eng = _mk_engine(ctx)
+        hs = [eng.submit([1, 2, 3, 4, 5, 6], 4, "hi"),
+              eng.submit([2, 3, 4], 3, "lo"),
+              eng.submit([5, 6, 7, 8], 2, "hi")]
+        eng.run(timeout_s=120)
+        tr = take_trace(ctx)
+        reg = ctx.scope_registry()
+        for h in hs:
+            assert h.state == "done", h.state
+            tl = reg.request_timeline(tr, h.rid)
+            st = tl["stages"]
+            # the partition identity — and hence trivially within the
+            # 5% acceptance gate
+            assert tl["stages_sum_ns"] == tl["e2e_ns"], (tl, h.rid)
+            assert abs(tl["stages_sum_ns"] - tl["e2e_ns"]) <= \
+                0.05 * tl["e2e_ns"]
+            assert st["exec_ns"] > 0, tl
+            assert st["admission_wait_ns"] >= 0
+            # e2e agrees with the handle's own measured latency (same
+            # clock, sub-ms bookkeeping skew)
+            assert abs(tl["e2e_ns"] - h.latency_s * 1e9) < 50e6
+            # waves: prefill chain + this request's decode lanes; every
+            # wave row names a paged-attention class
+            assert tl["waves"], tl
+            assert {w["class"] for w in tl["waves"]} <= {
+                "PFILL", "PATTF", "PATTL", "PUPD"}
+            assert tl["ttft_ms"] > 0
+        # shared decode scopes list their members in spec order
+        scopes0 = reg.request_scopes(hs[0].rid)
+        assert any(m is not None for _, m in scopes0[1:]) or \
+            len(scopes0) >= 1
+        eng.close()
+
+
+def test_scope_stamps_and_filter_isolation():
+    """EXEC spans of a scoped pool carry the scope in aux;
+    filter_scope() keeps exactly that request's events (no cross-pool
+    class-id conflation)."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        ctx.profile_enable(1)
+        eng = _mk_engine(ctx)
+        h0 = eng.submit([1, 2, 3, 4], 2, "hi")
+        h1 = eng.submit([4, 3, 2, 1], 2, "lo")
+        eng.run(timeout_s=120)
+        tr = take_trace(ctx)
+        sids = tr.scope_ids()
+        assert h0.scope_id in sids and h1.scope_id in sids
+        sub = tr.filter_scope(h0.scope_id)
+        ev = sub.events
+        ex = ev[(ev[:, 0] == KEY_EXEC)]
+        assert len(ex) > 0
+        assert set(np.unique(ex[:, 6])) == {h0.scope_id}
+        # the OTHER request's scope is gone from the filtered view
+        assert h1.scope_id not in sub.scope_ids()
+        # meta legend names the request (flight-dump readability)
+        legend = tr.meta.get("scopes", {})
+        assert legend[str(h0.scope_id)]["tenant"] == "hi"
+        assert legend[str(h0.scope_id)]["rid"] == h0.rid
+        eng.close()
+
+
+def test_tenant_slo_prometheus_and_healthz():
+    """Tenant-labelled summaries + counters in the Prometheus text; an
+    impossible SLO burns and /healthz degrades to 503; the watchdog
+    emits the structured slo_burn event."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        # slo_ms=0.0001: every request violates -> burn rate 1.0
+        eng = _mk_engine(ctx, slo_ms=0.0001)
+        exp = MetricsExporter(ctx, port=0)
+        ctx._metrics_exporter = exp
+        hs = [eng.submit([1, 2, 3], 2, "hi"),
+              eng.submit([3, 2, 1], 2, "lo")]
+        eng.run(timeout_s=120)
+        for h in hs:
+            assert h.state == "done"
+        txt = ctx.metrics_registry().prometheus_text()
+        for frag in ('ptc_tenant_ttft_seconds{tenant="hi",quantile="0.99"}',
+                     'ptc_tenant_tokens_per_second{tenant="hi"',
+                     'ptc_tenant_request_seconds{tenant="lo"',
+                     'ptc_tenant_completed_total{tenant="hi"} 1',
+                     'ptc_tenant_slo_violations_total{tenant="hi"} 1',
+                     'ptc_tenant_slo_burn_rate{tenant="hi"} 1'):
+            assert frag in txt, frag
+        st = ctx.stats()["scope"]
+        assert st["slo"]["hi"]["breached"] is True
+        assert st["slo"]["hi"]["burn_rate"] == 1.0
+        exp.stop()
+        eng.close()
+
+
+def test_healthz_503_on_slo_burn():
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        eng = _mk_engine(ctx, slo_ms=0.0001)
+        exp = MetricsExporter(ctx, port=0)
+        ctx._metrics_exporter = exp
+        eng.submit([1, 2, 3], 2, "hi")
+        eng.run(timeout_s=120)
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/healthz", timeout=5)
+            raise AssertionError("expected HTTP 503 on SLO burn")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read().decode())
+            assert body["slo"]["hi"]["breached"] is True
+        # structured watchdog event rides the same signal
+        wd = Watchdog(ctx, interval=30.0)
+        ctx._watchdog = wd
+        wd._tick()
+        burns = [e for e in wd.events if e["type"] == "slo_burn"]
+        assert burns and burns[0]["tenant"] == "hi", wd.events
+        assert burns[0]["burn_rate"] == 1.0
+        wd.stop()
+        exp.stop()
+        eng.close()
+
+
+def test_conformance_full_coverage_and_ratios():
+    """Every serve pool (prefill via the Server, decode via the engine)
+    is statically planned: conformance coverage is 1.0, makespan
+    ratios exist, and per-class calibration ratios compare the live
+    metrics p50 against the planner's cost assumptions."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        eng = _mk_engine(ctx)
+        hs = [eng.submit([1, 2, 3, 4, 5], 3, "hi"),
+              eng.submit([2, 3, 4], 3, "lo")]
+        eng.run(timeout_s=120)
+        for h in hs:
+            assert h.state == "done"
+        conf = ctx.stats()["scope"]["conformance"]
+        assert conf["pools"] > 0
+        assert conf["coverage"] == 1.0, conf
+        assert conf["makespan"]["n"] > 0
+        # measured wall can never undercut the plan's lower bound by
+        # more than scheduling noise; typically it is far above it
+        assert conf["makespan"]["ratio_min"] > 0
+        assert conf["per_class"], conf
+        for cls, row in conf["per_class"].items():
+            assert row["planned_ns"] > 0 and row["ratio"] is not None
+        # no comm engine: the comm soundness check abstains, honestly
+        assert conf["comm_bytes"]["measured"] is None
+        eng.close()
+
+
+def test_watchdog_stuck_event_names_request():
+    """A stuck task in a scoped pool produces a detection carrying the
+    owning request's scope_id / tenant / rid — the satellite that makes
+    flight dumps name the victim request."""
+    with pt.Context(nb_workers=2) as ctx:
+        reg = ctx.scope_registry()
+        sid = reg.new_scope("acme", rid=7)
+        wd = Watchdog(ctx, interval=0.05, k=8.0, floor_s=0.2,
+                      min_count=1000)  # cold class: floor applies
+        ctx._watchdog = wd
+        ctx.register_arena("t_slow", 8)
+        tp = pt.Taskpool(ctx, globals={"NB": 0})
+        k = pt.L("k")
+        tc = tp.task_class("SlowReq")
+        tc.param("k", 0, pt.G("NB"))
+        tc.flow("A", "RW", pt.In(None, guard=(k == 0)), arena="t_slow")
+
+        def body(view):
+            time.sleep(0.7)
+
+        tc.body(body)
+        reg.stamp(tp, sid)
+        tp.run()
+        tp.wait()
+        stuck = [e for e in wd.events if e["type"] == "stuck_task"]
+        assert stuck, (wd.events, wd.ticks)
+        ev = stuck[0]
+        assert ev["scope_id"] == sid, ev
+        assert ev["tenant"] == "acme" and ev["rid"] == 7, ev
+        wd.stop()
+
+
+def test_ptt_critpath_scope_cli(tmp_path):
+    """ptt_critpath --scope restricts the report to one request;
+    --scope list enumerates the scopes with their legend."""
+    import tools.ptt_critpath as cli
+
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        ctx.profile_enable(2)
+        eng = _mk_engine(ctx)
+        h = eng.submit([1, 2, 3, 4], 2, "hi")
+        eng.run(timeout_s=120)
+        tr = take_trace(ctx)
+        p = str(tmp_path / "r0.ptt")
+        tr.save(p)
+        eng.close()
+    assert cli.main([p, "--scope", "list"]) == 0
+    out_json = str(tmp_path / "scope.json")
+    assert cli.main([p, "--scope", str(h.scope_id),
+                     "--json", out_json]) == 0
+    rep = json.load(open(out_json))
+    assert rep["scope"] == h.scope_id
+    assert rep["events"] > 0

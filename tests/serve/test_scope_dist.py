@@ -1,0 +1,30 @@
+"""ptc-scope (PR 11): 2-rank request-scope propagation over the wire.
+
+Extends the tests/comm/test_trace_dist.py pattern into the serve stack:
+a 2-rank SPMD Server run where every admitted request's scope id rides
+ACTIVATE frames (wire v6), so the merged trace shows per-request wire
+hops with matched flow arrows, and the per-request stage partition sums
+exactly to the ticket's measured end-to-end latency.  All assertions
+run inside rank 0's worker (it owns the registry the timelines need);
+the parent only collects ok/err.
+"""
+import pytest
+
+from comm.test_multirank import _run_spmd
+
+from . import _scope_workers
+
+
+def test_2rank_serve_scope_roundtrip(tmp_path):
+    _run_spmd(_scope_workers.scoped_serve, 2, out_dir=str(tmp_path),
+              timeout=120)
+
+
+@pytest.mark.slow
+def test_2rank_serve_scope_rendezvous(tmp_path, monkeypatch):
+    """eager_limit=0 pushes every chain payload through the GET
+    rendezvous/streaming wire: the scope must survive the pull window
+    (PendingGet carries it to delivery)."""
+    monkeypatch.setenv("PTC_MCA_comm_eager_limit", "0")
+    _run_spmd(_scope_workers.scoped_serve, 2, out_dir=str(tmp_path),
+              timeout=120)

@@ -61,6 +61,14 @@ CHECKS = [
     ("BENCH_serve.json", "qos.hi_p99_beats_control", "equal", 0.0,
      False),
     ("BENCH_serve.json", "decode.bit_identical", "equal", 0.0, False),
+    # ptc-scope (PR 11): tenant SLO trajectory rows (timing,
+    # oversubscription-slacked per convention) + the conformance
+    # soundness verdict — full plan coverage and no pool beating its
+    # makespan lower bound is CORRECTNESS, never relaxed
+    ("BENCH_serve.json", "scope.ttft_p99_ms.hi", "lower", 0.50, True),
+    ("BENCH_serve.json", "scope.tokens_per_s_p50.hi", "higher", 0.50,
+     True),
+    ("BENCH_serve.json", "scope.conformance.sound", "equal", 0.0, False),
     # ptc-plan analyzer runtime on the potrf bench tiling (NT=16, 816
     # instances; PR 10): `make plan-graphs` emits the number, the 5 s
     # absolute budget lives in tools/plan_graphs.py — this row guards

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""ptc_top — live text dashboard over a running parsec_tpu process.
+
+Replaces ad-hoc `tail -f` squinting at the LiveMonitor JSONL sink: one
+refreshing screen with workers, per-class latency quantiles, the tenant
+table (occupancy, TTFT p99, tokens/s, SLO burn) and the plan-vs-measured
+conformance rollup.
+
+Sources (either or both):
+  --live PATH[,PATH...]   LiveMonitor sinks (default: every
+                          /tmp/ptc_live_*.jsonl present), newest sample
+                          per rank
+  --url  http://HOST:PORT the PR 7 metrics exporter — polls /stats.json
+                          and /healthz (PTC_MCA_runtime_metrics_port)
+
+Usage:
+  python tools/ptc_top.py                     # tail the default sinks
+  python tools/ptc_top.py --url http://127.0.0.1:9400
+  python tools/ptc_top.py --once              # one frame, no clear
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _last_json_line(path):
+    """Newest whole JSON record of a JSONL sink (tail without loading
+    the file: read the last 64 KiB and take the last parseable line)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 65536))
+            tail = f.read().decode(errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+def _fetch(url, path):
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + path,
+                                    timeout=2) as r:
+            return r.status, json.loads(r.read().decode())
+    except Exception as e:
+        return None, {"error": repr(e)}
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "ok" if v else "VIOLATED"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render_live(samples):
+    """One frame from per-rank LiveMonitor samples."""
+    lines = []
+    tenants = {}
+    conf = None
+    for rank in sorted(samples):
+        rec = samples[rank]
+        w = rec.get("workers") or []
+        lines.append(
+            f"rank {rank}: t={rec.get('t', '?')}s "
+            f"tasks={sum(w)} workers={len(w)} "
+            f"rss={rec.get('maxrss_kb', 0) // 1024}MB")
+        for name, row in (rec.get("latency") or {}).items():
+            lines.append(f"  {name:<14} n={row[0]:<8} "
+                         f"p50={row[1] / 1e3:.1f}us p99={row[2] / 1e3:.1f}us")
+        for name, row in (rec.get("serve") or {}).items():
+            t = tenants.setdefault(name, {})
+            t["active"] = t.get("active", 0) + row.get("active", 0)
+            t["queued"] = t.get("queued", 0) + row.get("queued", 0)
+            t["rejected"] = t.get("rejected", 0) + row.get("rejected", 0)
+        for name, row in (rec.get("tenants") or {}).items():
+            tenants.setdefault(name, {}).update(row)
+        conf = rec.get("conformance") or conf
+    if tenants:
+        lines.append("")
+        lines.append(f"{'tenant':<12}{'act':>4}{'q':>4}{'rej':>5}"
+                     f"{'done':>6}{'ttft_p99':>10}{'lat_p99':>9}"
+                     f"{'tok/s':>7}{'burn':>6}")
+        for name, t in sorted(tenants.items()):
+            lines.append(
+                f"{name:<12}{t.get('active', 0):>4}"
+                f"{t.get('queued', 0):>4}{t.get('rejected', 0):>5}"
+                f"{t.get('completed', 0):>6}"
+                f"{_fmt(t.get('ttft_p99_ms')):>10}"
+                f"{_fmt(t.get('latency_p99_ms')):>9}"
+                f"{_fmt(t.get('tok_s_p50'), 0):>7}"
+                f"{_fmt(t.get('slo_burn')):>6}")
+    if conf:
+        lines.append("")
+        lines.append(
+            f"conformance: coverage={_fmt(conf.get('coverage'))} "
+            f"makespan_ratio_p50={_fmt(conf.get('makespan_ratio_p50'))} "
+            f"comm_bound={_fmt(conf.get('comm_sound'))}")
+    return "\n".join(lines)
+
+
+def render_url(stats, health_code, health):
+    lines = []
+    c = stats.get("counters") or {}
+    lines.append(f"rank {stats.get('rank', '?')}  "
+                 f"healthz={'503 DEGRADED' if health_code == 503 else health_code}")
+    sc = {k: v for k, v in c.items() if k.startswith("ptc_scope_")}
+    for k in sorted(sc):
+        lines.append(f"  {k} = {sc[k]}")
+    wd = (health or {}).get("events") or []
+    for ev in wd[-4:]:
+        lines.append(f"  watchdog: {ev.get('type')} "
+                     + json.dumps({k: v for k, v in ev.items()
+                                   if k in ('tenant', 'rid', 'scope_id',
+                                            'task_class', 'burn_rate')}))
+    slo = (health or {}).get("slo") or {}
+    for name, st in sorted(slo.items()):
+        lines.append(f"  slo[{name}]: burn={st.get('burn_rate')} "
+                     f"breached={st.get('breached')}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--live", default=None,
+                    help="comma-separated LiveMonitor JSONL sinks "
+                         "(default: /tmp/ptc_live_*.jsonl)")
+    ap.add_argument("--url", default=None,
+                    help="metrics exporter base url (polls /stats.json)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no screen clear)")
+    args = ap.parse_args(argv)
+
+    def paths():
+        if args.live:
+            return args.live.split(",")
+        return sorted(glob.glob("/tmp/ptc_live_*.jsonl"))
+
+    while True:
+        frames = []
+        samples = {}
+        for p in paths():
+            rec = _last_json_line(p)
+            if rec is not None:
+                samples[rec.get("rank", p)] = rec
+        if samples:
+            frames.append(render_live(samples))
+        if args.url:
+            code, health = _fetch(args.url, "/healthz")
+            _, stats = _fetch(args.url, "/stats.json")
+            frames.append(render_url(stats if isinstance(stats, dict)
+                                     else {}, code, health))
+        if not frames:
+            frames.append("ptc_top: no live sinks found "
+                          "(PTC_MCA_runtime_live=<secs> writes "
+                          "/tmp/ptc_live_<rank>.jsonl; or pass --url)")
+        out = "\n\n".join(frames)
+        if args.once:
+            print(out)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + out + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,12 +37,35 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("traces", nargs="+")
     ap.add_argument("--json", help="also write the report as JSON")
+    ap.add_argument("--scope", default=None,
+                    help="restrict the analysis to ONE request scope: a "
+                         "scope id, or 'list' to enumerate the scopes "
+                         "present (ptc-scope; the critical-path and "
+                         "lost-time splits then describe that request "
+                         "alone)")
     args = ap.parse_args(argv)
     traces = [Trace.load(p) for p in args.traces]
     merged = Trace.merge(traces) if len(traces) > 1 else traces[0]
+    if args.scope == "list":
+        legend = merged.meta.get("scopes") or {}
+        for t in traces:
+            legend.update(t.meta.get("scopes") or {})
+        for sid in merged.scope_ids():
+            who = legend.get(str(sid), {})
+            extra = "".join(f" {k}={who[k]}" for k in
+                            ("tenant", "kind", "rid") if who.get(k)
+                            is not None)
+            print(f"scope {sid}{extra}")
+        return 0
+    scope = None
+    if args.scope is not None:
+        scope = int(args.scope)
+        merged = merged.filter_scope(scope)
+        print(f"scope {scope}: {len(merged.events)} event(s)")
     report = {"files": list(args.traces),
               "ranks": sorted({int(t.rank) for t in traces}),
               "events": int(len(merged.events)),
+              "scope": scope,
               "clock_offsets_ns": merged.meta.get("clock_offsets_ns", {}),
               "clamped_recvs": merged.meta.get("clamped_recvs", 0)}
 

@@ -308,7 +308,15 @@ def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
     completions), admission queue/reject churn, and qos_stats reads
     from the stats thread — while comm fences run.  TSan watches the
     new lane machinery, the tp->qos counters, and the grow-only lane
-    table publication in one address space."""
+    table publication in one address space.
+
+    ptc-scope (PR 11) rides along: every admitted pool is
+    scope-stamped by the Server (tp->scope_id relaxed loads on the
+    EXEC span path + the u64 scope word on every cross-rank ACTIVATE),
+    tracing level 1 keeps those paths hot, and the reader thread
+    scrapes the full surface — Context.stats()["scope"] (registry lock
+    vs submitter/pump writers) and the tenant-labelled Prometheus
+    text — while pools churn."""
     import threading
 
     from parsec_tpu.serve import Server, TenantConfig
@@ -325,12 +333,18 @@ def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
             ctx.comm_init(port)
             with ctx:
                 ctx.register_arena("t", 8)
+                # scope span stamps + SCOPE wire instants stay hot
+                # (ring mode bounds the buffers over the pool churn)
+                ctx.profile_enable(1)
+                ctx.profile_ring(1 << 16)
                 srv = Server(ctx, [
                     TenantConfig("hi", priority=4, weight=3,
-                                 max_pools=3, max_queue=64),
+                                 max_pools=3, max_queue=64,
+                                 slo_ms=60_000),
                     TenantConfig("lo", priority=0, weight=1,
                                  max_pools=3, max_queue=64),
                 ])
+                reg = ctx.metrics_registry()
 
                 def mk(priority, weight):
                     tp = ctx.taskpool(globals={"N": 15},
@@ -358,6 +372,11 @@ def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
                     while not stop.is_set():
                         ctx.sched_stats()
                         srv.stats()
+                        # scrape surface: scope registry rollup +
+                        # tenant-labelled exposition text race the
+                        # submitters/pump mutating the same records
+                        ctx.stats()["scope"]
+                        reg.prometheus_text()
                         stop.wait(0.005)
 
                 rd = threading.Thread(target=stats_reader, daemon=True)
