@@ -134,10 +134,18 @@ bench-trace: $(LIB)
 bench-check:
 	python tools/bench_check.py
 
-# Default check recipe: bench-trajectory guard + graph hygiene (verify
-# + plan baselines) + native lint — regressions in any fail fast.
-check: bench-check verify-graphs plan-graphs tidy
+# ptc-tune gate (tools/ptc_tune.py --check): every in-tree graph must
+# plan concretely (no enumeration refusal), carry an explicit wave-
+# fusability certify/refuse verdict per wave (no silent skips), and
+# simulate to a finite, bit-reproducible makespan under the default
+# knob vector.  Exit 1 = a graph regressed the gate.
+tune-check: $(LIB)
+	python tools/ptc_tune.py --check
 
-.PHONY: all clean tsan ubsan tidy verify-graphs plan-graphs check \
-	bench-comm bench-dispatch bench-device bench-stream \
+# Default check recipe: bench-trajectory guard + graph hygiene (verify
+# + plan + tune baselines) + native lint — regressions in any fail fast.
+check: bench-check verify-graphs plan-graphs tune-check tidy
+
+.PHONY: all clean tsan ubsan tidy verify-graphs plan-graphs tune-check \
+	check bench-comm bench-dispatch bench-device bench-stream \
 	bench-collective bench-trace bench-serve bench-check

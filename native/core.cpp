@@ -123,7 +123,7 @@ void *Arena::alloc(int32_t slot) {
     if (m.items.empty()) {
       /* refill: up to a batch from the shared pool, ONE lock */
       std::lock_guard<std::mutex> g(lock);
-      int take = (int)std::min<size_t>(freelist.size(), PTC_MAG_BATCH);
+      int take = (int)std::min<size_t>(freelist.size(), (size_t)mag_batch);
       if (take > 0) {
         m.items.insert(m.items.end(), freelist.end() - take,
                        freelist.end());
@@ -156,12 +156,12 @@ void Arena::dealloc(int32_t slot, void *p) {
   if (slot >= 0 && slot < nb_mags) {
     Mag &m = mags[(size_t)slot];
     m.items.push_back(p);
-    if (m.items.size() >= 2 * PTC_MAG_BATCH) {
+    if (m.items.size() >= 2 * (size_t)mag_batch) {
       /* spill one batch back so idle workers don't hoard blocks */
       std::lock_guard<std::mutex> g(lock);
-      freelist.insert(freelist.end(), m.items.end() - PTC_MAG_BATCH,
+      freelist.insert(freelist.end(), m.items.end() - mag_batch,
                       m.items.end());
-      m.items.resize(m.items.size() - PTC_MAG_BATCH);
+      m.items.resize(m.items.size() - (size_t)mag_batch);
     }
     return;
   }
@@ -774,7 +774,7 @@ namespace {
 /* Task alloc/free with per-worker magazines: the steady-state pair
  * (alloc in deliver → free in complete, both on the executing worker)
  * touches only the worker's own magazine — no lock.  Refill/flush move
- * PTC_MAG_BATCH tasks per free_lock acquisition; external threads
+ * ctx->mag_batch tasks per free_lock acquisition; external threads
  * (startup enumeration, comm deliveries) use the shared pool directly. */
 static ptc_task *task_alloc(ptc_context *ctx) {
   int slot = mag_slot(ctx);
@@ -782,7 +782,7 @@ static ptc_task *task_alloc(ptc_context *ctx) {
     ptc_context::TaskMag &m = *ctx->task_mags[(size_t)slot];
     if (!m.head) {
       std::lock_guard<std::mutex> g(ctx->free_lock);
-      for (int i = 0; i < PTC_MAG_BATCH && ctx->free_list; i++) {
+      for (int i = 0; i < ctx->mag_batch && ctx->free_list; i++) {
         ptc_task *t = ctx->free_list;
         ctx->free_list = t->next;
         t->next = m.head;
@@ -819,10 +819,10 @@ static void task_free(ptc_context *ctx, ptc_task *t) {
     ptc_context::TaskMag &m = *ctx->task_mags[(size_t)slot];
     t->next = m.head;
     m.head = t;
-    if (++m.count >= 2 * PTC_MAG_BATCH) {
+    if (++m.count >= 2 * ctx->mag_batch) {
       /* spill one batch so idle workers don't hoard task memory */
       std::lock_guard<std::mutex> g(ctx->free_lock);
-      for (int i = 0; i < PTC_MAG_BATCH && m.head; i++) {
+      for (int i = 0; i < ctx->mag_batch && m.head; i++) {
         ptc_task *s = m.head;
         m.head = s->next;
         m.count--;
@@ -3172,6 +3172,12 @@ ptc_context_t *ptc_context_new(int32_t nb_workers) {
     nb_workers = hc > 0 ? (int32_t)hc : 1;
   }
   ctx->nb_workers = nb_workers;
+  /* magazine batch knob (ptc-tune): read once here, immutable for the
+   * context's life — workers only ever see the settled value */
+  if (const char *e = std::getenv("PTC_MCA_runtime_mag_batch")) {
+    int32_t v = (int32_t)std::atoi(e);
+    if (v >= 1 && v <= 8192) ctx->mag_batch = v;
+  }
   for (int i = 0; i < nb_workers; i++) {
     ctx->prof.push_back(new ProfBuf());
     ctx->worker_executed.push_back(new std::atomic<int64_t>(0));
@@ -3691,6 +3697,7 @@ int32_t ptc_register_arena(ptc_context_t *ctx, int64_t elem_size) {
   std::lock_guard<std::mutex> g(ctx->reg_lock);
   Arena *a = new Arena();
   a->elem_size = elem_size;
+  a->mag_batch = ctx->mag_batch;
   a->init_mags(ctx->nb_workers);
   int32_t n = ctx->arena_count.load(std::memory_order_relaxed);
   if (n == ctx->arena_cap) {

@@ -363,10 +363,14 @@ struct Collection {
  *
  * hits/misses use single-writer relaxed atomics (plain add codegen on
  * x86, TSan-visible for the cross-thread stats read). */
-constexpr int PTC_MAG_BATCH = 64;
+constexpr int PTC_MAG_BATCH_DEFAULT = 64;
 
 struct Arena {
   int64_t elem_size = 0;
+  /* refill/spill move size, stamped from the owning context's
+   * mag_batch (PTC_MCA_runtime_mag_batch) at registration and
+   * immutable afterwards — the ptc-tune magazine-batch knob */
+  int32_t mag_batch = PTC_MAG_BATCH_DEFAULT;
   std::vector<void *> freelist;
   std::mutex lock;
   struct alignas(64) Mag {
@@ -942,8 +946,11 @@ struct ptc_context {
   /* task freelist (mempool stand-in; reference parsec/mempool.c).
    * free_lock/free_list is the SHARED spill pool; each worker owns a
    * magazine (task_mags[w], owner-thread only) that refills from and
-   * flushes to it in PTC_MAG_BATCH-sized moves, so the steady-state
-   * task alloc/free pair on a worker never takes free_lock. */
+   * flushes to it in mag_batch-sized moves, so the steady-state
+   * task alloc/free pair on a worker never takes free_lock.
+   * mag_batch is read once from PTC_MCA_runtime_mag_batch at context
+   * creation (immutable afterwards) — the ptc-tune knob. */
+  int32_t mag_batch = PTC_MAG_BATCH_DEFAULT;
   std::mutex free_lock;
   ptc_task *free_list = nullptr;
   struct alignas(64) TaskMag {

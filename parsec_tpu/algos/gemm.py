@@ -56,7 +56,7 @@ def _gemm_class(tp, A, B, C, dev, cn, a_in, b_in):
         c = t.data("C", C.dtype, shp["C"])
         c += a @ b
 
-    g.body(cpu_body)
+    g.body(cpu_body, pure=True)  # pure tile chore: fusion-eligible
     return g
 
 

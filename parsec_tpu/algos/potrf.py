@@ -219,10 +219,13 @@ def build_potrf(ctx: pt.Context, A: TwoDimBlockCyclic,
         c = t.data("C", dt, shp)
         c -= a @ b.T
 
-    po.body(b_potrf)
-    tr.body(b_trsm)
-    sy.body(b_syrk)
-    ge.body(b_gemm)
+    # pure tile chores (read/write only their declared flows): the
+    # declaration makes homogeneous waves fusion-eligible for the
+    # wave-fusability certificate (analysis/plan.py certify())
+    po.body(b_potrf, pure=True)
+    tr.body(b_trsm, pure=True)
+    sy.body(b_syrk, pure=True)
+    ge.body(b_gemm, pure=True)
     return tp
 
 

@@ -16,8 +16,10 @@ from .flowgraph import (ConcreteGraph, FlowGraph, collection_tile_bytes,
                         extract_flowgraph, flowgraph_to_dot)
 from .verify import (RULES, Finding, Report, VerifyError, verify_graph,
                      verify_taskpool)
-from .plan import (CostModel, Plan, PlanCheckError, compare_critpath,
-                   plan_graph, plan_taskpool)
+from .plan import (CostModel, Plan, PlanCheckError, certify_waves,
+                   compare_critpath, plan_graph, plan_taskpool)
+from .tune import (ScheduleSimulator, TuneStore, apply_knobs, autotune,
+                   graph_signature, host_fingerprint)
 from .dtdlint import DtdLintError, DtdLinter
 
 __all__ = [
@@ -26,6 +28,8 @@ __all__ = [
     "Finding", "Report", "RULES", "VerifyError", "verify_graph",
     "verify_taskpool",
     "CostModel", "Plan", "PlanCheckError", "plan_graph", "plan_taskpool",
-    "compare_critpath",
+    "compare_critpath", "certify_waves",
+    "ScheduleSimulator", "TuneStore", "apply_knobs", "autotune",
+    "graph_signature", "host_fingerprint",
     "DtdLinter", "DtdLintError",
 ]

@@ -153,6 +153,7 @@ class Plan:
 
     def __init__(self, fg: FlowGraph):
         self.fg = fg
+        self.cg: Optional[ConcreteGraph] = None
         self.bounded = False          # True = symbolic fallback
         self.notes: List[str] = []
         self.stats: Dict[str, object] = {}
@@ -163,6 +164,10 @@ class Plan:
         self.edges_bytes: Dict[Tuple[int, int], int] = {}
         # per-rank wave tables: rank -> [{"wave", "tasks", "classes"}]
         self.waves: Dict[int, List[dict]] = {}
+        # wave-fusability certificates: one record per (rank, wave) —
+        # the MPK-prep artifact (ROADMAP item 1): an explicit
+        # certify/refuse verdict for every wave, machine-readable
+        self.fusability: List[dict] = []
         self.makespan: Dict[str, object] = {}
         self.eager_limit = 0
         self.has_device_classes = False
@@ -216,6 +221,14 @@ class Plan:
 
     def comm_bytes(self) -> int:
         return sum(self.edges_bytes.values())
+
+    def fusable_waves(self, rank: Optional[int] = None) -> int:
+        """Number of waves certified fusable (one cached executable per
+        wave, à la MPK): homogeneous, fusion-eligible bodies, no
+        intra-wave dependency or datum conflict, matching tile
+        signatures."""
+        return sum(1 for c in self.fusability
+                   if c["fusable"] and (rank is None or c["rank"] == rank))
 
     def wire_out_bound(self, rank: int) -> int:
         """Upper bound on the rank's wire bytes_sent: payload out plus
@@ -313,6 +326,8 @@ class Plan:
                             for (s, d), b in self.edges_bytes.items()},
             "waves": {str(r): [dict(w) for w in ws]
                       for r, ws in self.waves.items()},
+            "fusability": [dict(c) for c in self.fusability],
+            "fusable_waves": self.fusable_waves(),
             "makespan": dict(self.makespan),
             "comm": {
                 "total_bytes": self.comm_bytes(),
@@ -322,14 +337,21 @@ class Plan:
         }
 
     def wave_table(self, rank: int = 0, max_rows: int = 32) -> str:
-        """Per-wave text table: tasks, classes, live bytes."""
+        """Per-wave text table: tasks, classes, live bytes, and the
+        fusability verdict (see `fusability` for refusal reasons)."""
         ws = self.waves.get(rank, [])
-        lines = [f"{'wave':>5} {'tasks':>6} {'live_bytes':>12}  classes"]
+        fus = {(c["rank"], c["wave"]): c for c in self.fusability}
+        lines = [f"{'wave':>5} {'tasks':>6} {'live_bytes':>12} "
+                 f"{'fusable':>8}  classes"]
         for row in ws[:max_rows]:
             classes = ", ".join(f"{c}x{n}" for c, n in
                                 sorted(row["classes"].items()))
+            c = fus.get((rank, row["wave"]))
+            verdict = ("-" if c is None
+                       else "yes" if c["fusable"] else "no")
             lines.append(f"{row['wave']:>5} {row['tasks']:>6} "
-                         f"{row['live_bytes']:>12}  {classes}")
+                         f"{row['live_bytes']:>12} {verdict:>8}  "
+                         f"{classes}")
         if len(ws) > max_rows:
             lines.append(f"  ... {len(ws) - max_rows} more wave(s)")
         return "\n".join(lines)
@@ -360,6 +382,12 @@ class Plan:
                 f"/{row['comm_out_msgs']} msg(s) "
                 f"(eager {row['eager_bytes']} B, rdv {row['rdv_bytes']} B)"
                 f", work {row['work_ns'] / 1e6:.3f} ms")
+        if self.fusability:
+            nfus = self.fusable_waves()
+            lines.append(
+                f"  fusable waves: {nfus}/{len(self.fusability)} "
+                "certified (homogeneous, independent, table-driven "
+                "bodies, one tile signature)")
         m = self.makespan
         if m:
             lines.append(
@@ -382,6 +410,23 @@ class Plan:
 def _has_device_chore(tc) -> bool:
     return any(getattr(ch, "body_kind", None) == N.BODY_DEVICE
                for ch in getattr(tc, "chores", []))
+
+
+def _chore_kinds(tc) -> List[str]:
+    """Body kinds of a class, certificate-facing: "noop" / "device" /
+    "pure-cb" (a Python body the author declared pure) / "opaque-cb"."""
+    out = []
+    for ch in getattr(tc, "chores", []):
+        bk = getattr(ch, "body_kind", None)
+        if bk == N.BODY_NOOP:
+            out.append("noop")
+        elif bk == N.BODY_DEVICE:
+            out.append("device")
+        elif getattr(ch, "pure", False):
+            out.append("pure-cb")
+        else:
+            out.append("opaque-cb")
+    return out
 
 
 def _is_write(access: int) -> bool:
@@ -661,6 +706,14 @@ class _Analyzer:
                 })
             plan.waves[r] = rows
 
+        plan.fusability = self.certify()
+        fus = {(c["rank"], c["wave"]): c for c in plan.fusability}
+        for r, rows in plan.waves.items():
+            for row in rows:
+                c = fus.get((r, row["wave"]))
+                if c is not None:
+                    row["fusable"] = c["fusable"]
+
         self._comm_volume(eager_limit)
         self._makespan(cost, workers)
         plan.stats.update({
@@ -669,6 +722,125 @@ class _Analyzer:
             "edges": cg.nb_edges,
             "waves": n_waves,
         })
+
+    # ---------------------------------------------------- fusability
+    def certify(self) -> List[dict]:
+        """Wave-fusability certificates: one explicit certify/refuse
+        record per (rank, wave) — never a silent skip.
+
+        A wave certifies (fusable=True) when it could compile into ONE
+        cached executable (MPK, arXiv:2512.22219) and run its members
+        in any order inside it:
+
+          homogeneous   one task class across the wave (the executable
+                        is keyed by class)
+          bodies        every chore is table-driven or declared pure
+                        ("noop" / "device" / "pure-cb"): an opaque
+                        Python callback may read or write state the
+                        fused executable cannot see
+          independence  no delivery edge between two members (possible
+                        only on a cycle-parked tail wave — V003), and
+                        no datum written by one member while another
+                        member touches it (the engine's wave order is
+                        arbitrary within a wave, so such a pair is a
+                        race the per-task path hides behind copies and
+                        fusion would surface — V010 flags it)
+          tile shapes   every member's per-flow payload signature
+                        matches (one executable = one set of buffer
+                        shapes)
+
+        Structural refusals of a homogeneous wave (intra-wave
+        dependency or datum conflict) also surface as verify rule
+        V010; body opacity and signature mismatches are plain
+        refusals — legal graphs, just not fusable."""
+        fg, cg = self.fg, self.cg
+        members: Dict[Tuple[int, int], List[tuple]] = {}
+        for node in self.inst_set:
+            members.setdefault(
+                (self._rank(node), self.wave[node]), []).append(node)
+        certs: List[dict] = []
+        for (r, w) in sorted(members):
+            nodes = sorted(members[(r, w)])
+            classes = sorted({n[0] for n in nodes})
+            reasons: List[str] = []
+            structural: List[str] = []
+            if len(classes) > 1:
+                names = sorted(fg.classes[c].name for c in classes)
+                cert = {"rank": r, "wave": w, "cls": None,
+                        "width": len(nodes), "homogeneous": False,
+                        "claimed": False, "fusable": False,
+                        "body_kinds": [],
+                        "reasons": [f"heterogeneous wave "
+                                    f"({', '.join(names)})"]}
+                certs.append(cert)
+                continue
+            cm = fg.classes[classes[0]]
+            kinds = _chore_kinds(cm.tc)
+            claimed = bool(kinds) and all(k != "opaque-cb" for k in kinds)
+            if not kinds:
+                reasons.append("no body chore")
+            elif not claimed:
+                reasons.append(
+                    "opaque body (Python callback not declared pure; "
+                    "see TaskClass.body(pure=))")
+            member_set = set(nodes)
+            # independence: delivery edges between members (cycle tail)
+            dep_pairs = 0
+            for n in nodes:
+                for dst, _cert in cg.succ.get(n, ()):
+                    if dst in member_set:
+                        dep_pairs += 1
+            if dep_pairs:
+                structural.append(
+                    f"{dep_pairs} intra-wave dependency edge(s) "
+                    "(cycle-parked tail; see V003)")
+            # independence: datum conflicts + tile signatures
+            touched: Dict[object, set] = {}
+            written: Dict[object, set] = {}
+            sigs = set()
+            for n in nodes:
+                sig = []
+                for fi, fl in enumerate(cm.flows):
+                    if fl.access == N.FLOW_CTL:
+                        continue
+                    datum = self.datum_of(n, fi)
+                    sig.append(self.datum_bytes(datum, n, fi))
+                    touched.setdefault(datum, set()).add(n)
+                    if _is_write(fl.access):
+                        written.setdefault(datum, set()).add(n)
+                sigs.add(tuple(sig))
+            conflicts = 0
+            sample = None
+            for datum, writers in written.items():
+                others = touched.get(datum, set()) | writers
+                if len(others) > 1:
+                    conflicts += 1
+                    if sample is None:
+                        sample = datum
+            if conflicts:
+                nm = (f"{sample[1]}[{', '.join(str(v) for v in sample[2])}]"
+                      if sample and sample[0] == "mem" else "a temporary")
+                structural.append(
+                    f"{conflicts} intra-wave datum conflict(s) (e.g. "
+                    f"{nm} written by one member and touched by "
+                    "another with no ordering between them)")
+            if len(sigs) > 1:
+                reasons.append(
+                    f"{len(sigs)} distinct tile signatures across "
+                    "members (one executable needs one buffer shape "
+                    "set)")
+            reasons += structural
+            certs.append({
+                "rank": r, "wave": w, "cls": cm.name,
+                "width": len(nodes), "homogeneous": True,
+                "claimed": claimed,
+                "fusable": claimed and not reasons,
+                "body_kinds": kinds,
+                "tile_sig": sorted(sigs)[0] if len(sigs) == 1 else None,
+                "reasons": reasons,
+                "structural": bool(structural),
+            })
+        return certs
 
     # ---------------------------------------------------------- comm
     def _comm_volume(self, eager_limit: int):
@@ -879,6 +1051,20 @@ def _symbolic_plan(fg: FlowGraph, plan: Plan):
                        "edges": 0, "waves": 0})
 
 
+def certify_waves(fg: FlowGraph, cg: ConcreteGraph) -> List[dict]:
+    """Standalone wave-fusability certification over an already-
+    concretized graph (no cost model or economics needed): the records
+    `Plan.fusability` carries, computed for consumers that only need
+    the certificates (the V010 verify rule).  Empty when enumeration
+    was refused."""
+    if cg.bounded:
+        return []
+    plan = Plan(fg)
+    an = _Analyzer(fg, cg, plan)
+    an.compute_waves()
+    return an.certify()
+
+
 # ---------------------------------------------------------------- driver
 def plan_graph(fg: FlowGraph, max_instances: Optional[int] = None,
                cost: Optional[CostModel] = None,
@@ -901,6 +1087,9 @@ def plan_graph(fg: FlowGraph, max_instances: Optional[int] = None,
             workers = 1
     cg = fg.concretize(max_instances=max_instances)
     plan.notes += cg.notes
+    # the concretized instance DAG is kept for downstream consumers
+    # (the ptc-tune schedule simulator walks its edges)
+    plan.cg = cg
     if cg.bounded:
         _symbolic_plan(fg, plan)
     else:

@@ -31,6 +31,16 @@ stable ID and reports class/flow/dep source locations:
         instance's placement rank — memory reads are affine with
         placement in this runtime (there is no wire path for a Mem
         IN), so the consumer reads an uninitialized local mirror
+  V010  wave-fusability soundness: a wave the plan layer marks
+        homogeneous fails its fusability certificate STRUCTURALLY —
+        an intra-wave dependency (cycle tail) or a datum written by
+        one member while another member touches it with no ordering
+        between them.  The engine schedules wave members in arbitrary
+        order, so such a pair is a latent race today and a corruption
+        once the wave fuses into one executable (MPK prep; see
+        analysis/plan.py certify()).  Body opacity and tile-signature
+        mismatches refuse the certificate WITHOUT tripping V010 —
+        they are legal graphs, just not fusable
 
 Affine/interval reasoning handles what it can prove symbolically
 (V004's never-in-domain proof); bounded concrete enumeration of the
@@ -59,6 +69,7 @@ RULES: Dict[str, str] = {
     "V007": "dtype/shape mismatch across an edge",
     "V008": "ptc_coll_* usage-contract violation",
     "V009": "memory read of a remote-owned collection datum",
+    "V010": "homogeneous wave fails its fusability certificate",
 }
 
 _MAX_SAMPLES = 4
@@ -535,6 +546,33 @@ def _v005_write_races(cg: ConcreteGraph) -> List[Finding]:
     return out
 
 
+def _v010_wave_fusability(fg: FlowGraph, cg: ConcreteGraph) -> List[Finding]:
+    """V010: homogeneous waves whose fusability certificate refuses for
+    a STRUCTURAL reason (intra-wave dependency / intra-wave datum
+    conflict).  One finding per affected class, counting its refused
+    waves."""
+    from .plan import certify_waves
+    out: Dict[str, Finding] = {}
+    for cert in certify_waves(fg, cg):
+        if not cert.get("homogeneous") or not cert.get("structural"):
+            continue
+        cm = fg.by_name.get(cert["cls"])
+        f = out.get(cert["cls"])
+        if f is None:
+            f = out[cert["cls"]] = Finding(
+                "V010", "error", cert["cls"], None, None,
+                getattr(cm.tc, "srcloc", None) if cm else None,
+                "homogeneous wave fails its fusability certificate "
+                f"structurally: {'; '.join(cert['reasons'])} — wave "
+                "members execute in arbitrary order, so this is a "
+                "latent race per-task and a certain corruption under "
+                "wave fusion", count=0)
+        f.count += 1
+        if len(f.instances) < _MAX_SAMPLES:
+            f.instances.append((cert["rank"], cert["wave"]))
+    return list(out.values())
+
+
 def _v009_rank_mapping(cg: ConcreteGraph) -> List[Finding]:
     """V009: a concretized instance whose SELECTED data input is a Mem
     read of a collection datum owned by a different rank than the one
@@ -595,7 +633,7 @@ def _v009_rank_mapping(cg: ConcreteGraph) -> List[Finding]:
 
 def verify_graph(fg: FlowGraph, max_instances: int = 200_000,
                  ignore: Sequence[str] = ()) -> Report:
-    """Run the V001-V009 rule engine over an extracted flow graph."""
+    """Run the V001-V010 rule engine over an extracted flow graph."""
     t0 = time.perf_counter()
     findings: List[Finding] = []
     notes: List[str] = []
@@ -618,10 +656,11 @@ def verify_graph(fg: FlowGraph, max_instances: int = 200_000,
         findings += _v005_write_races(cg)
         findings += _v006_never_read_out(cg)
         findings += _v009_rank_mapping(cg)
+        findings += _v010_wave_fusability(fg, cg)
     else:
         findings += sym_v004
-        notes.append("instance-level rules (V001/V003/V005/V006/V009) "
-                     "skipped: raise max_instances to enable")
+        notes.append("instance-level rules (V001/V003/V005/V006/V009/"
+                     "V010) skipped: raise max_instances to enable")
     if ignore:
         ign = set(ignore)
         findings = [f for f in findings if f.rule not in ign]

@@ -115,11 +115,18 @@ class _Flow:
 
 
 class _Chore:
-    def __init__(self, device_type: int, body_kind: int, body=None):
+    def __init__(self, device_type: int, body_kind: int, body=None,
+                 pure: bool = False):
         self.device_type = device_type
         self.body_kind = body_kind
         self.body = body  # callable | qid | None
         self.body_arg = 0  # resolved at commit
+        # noop and device chores are table-driven by construction (the
+        # device chore dispatches a cached executable from the qid
+        # table); a Python callback is opaque unless the author declares
+        # it pure — the wave-fusability certificate's body criterion
+        # (analysis/plan.py), mirroring pt.call(pure=True)
+        self.pure = pure or body_kind in (N.BODY_NOOP, N.BODY_DEVICE)
 
 
 class TaskClass:
@@ -171,9 +178,18 @@ class TaskClass:
         self.flows.append(_Flow(name, ACCESS[access.upper()], deps, arena))
         return self
 
-    def body(self, fn: Callable, device: str = "cpu") -> "TaskClass":
-        """Attach a Python body chore.  fn(TaskView) -> None | hook code."""
-        self.chores.append(_Chore(DEVICE_TYPES[device], N.BODY_CB, fn))
+    def body(self, fn: Callable, device: str = "cpu",
+             pure: bool = False) -> "TaskClass":
+        """Attach a Python body chore.  fn(TaskView) -> None | hook code.
+
+        `pure=True` declares the body a pure function of its declared
+        flows (no hidden state read or written beyond the task's own
+        tiles): the wave-fusability certifier may then treat a
+        homogeneous wave of this class as fusion-eligible.  The
+        declaration is trusted, like pt.call(pure=True) — declare it
+        only for table-driven tile chores."""
+        self.chores.append(_Chore(DEVICE_TYPES[device], N.BODY_CB, fn,
+                                  pure=pure))
         return self
 
     def body_noop(self, device: str = "cpu") -> "TaskClass":

@@ -153,7 +153,7 @@ class Taskpool:
         return plan_taskpool(self, max_instances=max_instances,
                              cost=cost, econ=econ, workers=workers)
 
-    def run(self, verify=None) -> "Taskpool":
+    def run(self, verify=None, tuned=None) -> "Taskpool":
         """commit + add to context + start (convenience).
 
         `verify=` opts into the static dataflow verifier at insert
@@ -162,10 +162,35 @@ class Taskpool:
         known findings are silent runtime hangs — see
         analysis/verify.py); "warn" prints findings and proceeds.
 
+        `tuned=` opts into the ptc-tune autotuner's persisted knob
+        vectors (analysis/tune.py): True looks up the winner recorded
+        for this pool's (graph signature, host fingerprint) — a no-op
+        when none exists — and a dict applies that vector directly.
+        The vector is applied through the MCA registry AND the
+        PTC_MCA_* env for the duration of THIS call (commit, pre-run
+        checks, the context's lazy start) and then RESTORED, so one
+        pool's tuned knobs never leak into the next pool in the same
+        Context; the applied vector is recorded as
+        `self.tuned_applied` (None when nothing applied).  Knobs bound
+        at Context/comm/device creation need the runtime created under
+        the vector — the tuner's validation harness does that.
+
         With device.plan_check armed (warn|error), every attached
         device runs the ptc-plan pre-run residency check before the
         pool schedules: predicted device peak vs its byte budget (see
         TpuDevice.plan_check)."""
+        knobs = None
+        if tuned:
+            from ..analysis.tune import resolve_tuned
+            knobs = resolve_tuned(self, tuned)
+        self.tuned_applied = knobs
+        if knobs is None:
+            return self._run_inner(verify)
+        from ..analysis.tune import apply_knobs
+        with apply_knobs(knobs):
+            return self._run_inner(verify)
+
+    def _run_inner(self, verify) -> "Taskpool":
         if verify:
             self.verify(mode=verify)
         from ..utils import params as _mca

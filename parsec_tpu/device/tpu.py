@@ -822,7 +822,7 @@ class TpuDevice:
     """One TPU device (one jax device) with a manager thread."""
 
     def __init__(self, ctx: Context, jax_device=None, pipeline_depth: int = 16,
-                 cache_bytes: int = 4 << 30, autostart: bool = True,
+                 cache_bytes: Optional[int] = None, autostart: bool = True,
                  prefetch: Optional[bool] = None):
         import jax  # deferred: tests may pin the platform first
         from collections import OrderedDict
@@ -859,6 +859,12 @@ class TpuDevice:
         # device-copy LRU keyed by uid (stamped into the native copy handle,
         # so freed/reused ptc_copy addresses can't alias — ABA guard)
         self._cache: "OrderedDict[int, _CacheEnt]" = OrderedDict()
+        if cache_bytes is None:
+            # the ptc-tune cache-budget knob: an explicit constructor
+            # argument always wins; otherwise device.cache_bytes > 0
+            # overrides the 4 GiB default
+            from ..utils import params as _knobs
+            cache_bytes = int(_knobs.get("device.cache_bytes")) or 4 << 30
         self._cache_bytes = cache_bytes
         self._cache_used = 0
         # id(stack) -> [refcount, stack]; the strong ref keeps id() stable

@@ -249,5 +249,5 @@ def build_flash_attention(ctx, Qc, Kc, Vc, Oc, causal: bool = False,
         p /= p.sum(axis=-1, keepdims=True)
         o[...] = (p @ vb).astype(dt)
 
-    tc.body(body)
+    tc.body(body, pure=True)  # pure tile chore: fusion-eligible
     return tp

@@ -145,5 +145,5 @@ def build_rms_norm(ctx, Xc, Wc, Oc, eps: float = 1e-6, dev=None,
         ms = np.mean(np.square(x), axis=-1, keepdims=True)
         o[...] = (x / np.sqrt(ms + eps) * w).astype(dt)
 
-    tc.body(body)
+    tc.body(body, pure=True)  # pure tile chore: fusion-eligible
     return tp

@@ -400,6 +400,24 @@ register("device.plan_check", "off", str,
          "over-budget with device.out_of_core=0 warns (or raises with "
          "'error'); with out-of-core on it reports the predicted spill "
          "count instead.  Counters export as stats()['plan']")
+register("runtime.mag_batch", 64, int,
+         "task/arena freelist magazine batch: items moved between a "
+         "worker's private magazine and the shared pool per lock "
+         "acquisition (PR 2's PTC_MAG_BATCH, now a knob).  Bigger "
+         "batches amortize the free-lock crossing further but hoard "
+         "more memory per idle worker; read from the env at context "
+         "creation (a live context keeps its batch).  One of the "
+         "ptc-tune knob axes")
+register("device.cache_bytes", 0, int,
+         "device byte-budget override: when > 0 every TpuDevice "
+         "created without an explicit cache_bytes argument uses this "
+         "budget instead of the 4 GiB constructor default (the "
+         "ptc-tune cache-budget knob; TpuDevice.set_cache_budget "
+         "still re-budgets a live device)")
+register("tune.cache_path", "", str,
+         "persisted autotuning winners (analysis/tune.py TuneStore): "
+         "JSON keyed by (graph signature, host fingerprint), applied "
+         "by Taskpool.run(tuned=True).  Empty = ~/.ptc/tuned.json")
 register("plan.max_instances", 200_000, int,
          "ptc-plan concrete-enumeration budget (shared with the "
          "verifier's default): execution spaces past this many "

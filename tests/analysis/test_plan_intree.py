@@ -43,6 +43,36 @@ def test_intree_graphs_plan_clean():
             assert row["device_peak_bytes"] <= row["peak_bytes"]
 
 
+def test_intree_fusability_verdicts_complete():
+    """Every (rank, wave) of every in-tree graph carries an EXPLICIT
+    certify/refuse verdict (no silent skips — the ISSUE 12 acceptance
+    bar), refusals always carry a reason, and the pure-body tile DAGs
+    certify nonzero fusable waves (the mega-kernel prep artifact)."""
+    plans = _all_plans()
+    counts = {}
+    for name, p in plans:
+        waves = {(r, row["wave"]) for r, rows in p.waves.items()
+                 for row in rows}
+        certified = {(c["rank"], c["wave"]) for c in p.fusability}
+        assert waves == certified, f"{name}: waves without a verdict"
+        for c in p.fusability:
+            assert isinstance(c["fusable"], bool)
+            if not c["fusable"]:
+                assert c["reasons"], f"{name}: refusal without reason"
+            else:
+                assert c["homogeneous"] and c["claimed"]
+                assert c["tile_sig"] is not None
+        counts[name] = p.fusable_waves()
+    # locked: the declared-pure tile DAGs certify their homogeneous
+    # waves (potrf: every homogeneous wave of the 3(NT-1)+1 schedule)
+    assert counts["potrf"] >= 10
+    assert counts["gemm"] == 4
+    assert counts["gemm_dist"] == 4
+    assert counts["ops_rms_norm"] == 1
+    assert counts["ops_flash_attention"] == 1
+    assert sum(counts.values()) >= 30
+
+
 def test_potrf_bench_tiling_under_5s():
     dt_ms = plan_graphs.potrf_nt16_ms()
     assert dt_ms < plan_graphs.POTRF_NT16_BUDGET_S * 1e3, \
@@ -60,6 +90,10 @@ def test_plan_graphs_driver_json(tmp_path):
     for row in doc["graphs"].values():
         assert row["issues"] == []
         assert row["peak_bytes"] > 0
+        assert row["certified_waves"] == row["waves"]
+    # the per-graph fusable-wave count bench_check-visible baseline
+    assert doc["graphs"]["gemm"]["fusable_waves"] == 4
+    assert doc["graphs"]["moe"]["fusable_waves"] == 0
 
 
 @pytest.mark.slow

@@ -452,6 +452,67 @@ def test_taskpool_run_verify_raises(ctx):
     assert ei.value.report.errors
 
 
+# ------------------------------------------------------------------ V010
+# One homogeneous wave (no task deps): every instance reads datum 0,
+# instance 0 also writes it back — ONE writer, so V005 stays silent,
+# but wave members execute in arbitrary order, so the read/write pair
+# is unordered (a latent race today, certain corruption under wave
+# fusion).  The clean twin keeps every instance on its own datum.
+BAD_V010 = """
+N [ type="int" ]
+Wave(k)
+k = 0 .. N
+: mydata(k)
+RW A <- mydata(0)
+     -> (k == 0) ? mydata(0)
+BODY
+END
+"""
+
+CLEAN_V010 = BAD_V010.replace("<- mydata(0)", "<- mydata(k)")
+
+
+def test_v010_intra_wave_datum_conflict(ctx):
+    rep = _verify_jdf(ctx, BAD_V010, "v010.jdf")
+    f = _the(rep, "V010")
+    assert f.severity == "error"
+    assert f.cls == "Wave"
+    assert "fusability" in f.message and "conflict" in f.message
+    assert "V005" not in _rules(rep)  # single writer: not a V005 case
+    # the certificate itself refuses the wave with the same reason
+    from parsec_tpu.analysis import certify_waves, extract_flowgraph
+    b = compile_jdf(BAD_V010, ctx, globals={"N": 4}, dtype=np.int64,
+                    arenas={"A": "default"}, filename="v010b.jdf")
+    fg = extract_flowgraph(b.tp)
+    certs = certify_waves(fg, fg.concretize())
+    assert len(certs) == 1
+    c = certs[0]
+    assert c["homogeneous"] and not c["fusable"] and c["structural"]
+    assert c["width"] == 5
+
+
+def test_v010_clean_twin(ctx):
+    rep = _verify_jdf(ctx, CLEAN_V010, "v010c.jdf")
+    assert rep.ok(), rep.text()
+    # and the wave now certifies structurally: the only refusal reason
+    # left may be body opacity, never a conflict
+    from parsec_tpu.analysis import certify_waves, extract_flowgraph
+    b = compile_jdf(CLEAN_V010, ctx, globals={"N": 4}, dtype=np.int64,
+                    arenas={"A": "default"}, filename="v010d.jdf")
+    fg = extract_flowgraph(b.tp)
+    (c, ) = certify_waves(fg, fg.concretize())
+    assert c["homogeneous"] and not c["structural"]
+
+
+def test_v010_heterogeneous_waves_never_flagged(ctx):
+    """V010 is about HOMOGENEOUS waves: the V005 bad fixture has the
+    same unordered-writers shape across two classes, and it must stay
+    a V005 finding only."""
+    rep = _verify_jdf(ctx, BAD_V005, "v010h.jdf")
+    assert "V005" in _rules(rep)
+    assert "V010" not in _rules(rep)
+
+
 def test_taskpool_run_verify_clean_runs(ctx):
     b = compile_jdf(CLEAN_V001, ctx, globals={"N": 4}, dtype=np.int64,
                     arenas={"A": "default"}, filename="v001c.jdf")

@@ -24,6 +24,8 @@ def test_collective_suite_schema(tmp_path):
     out = tmp_path / "coll.json"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the tuned section persists winners: keep them in the sandbox
+    env["PTC_MCA_tune_cache_path"] = str(tmp_path / "tuned.json")
     cmd = [sys.executable, _BENCH, "--collective", "--json", str(out),
            "--sizes", f"{64 * 1024},{256 * 1024}", "--reps", "1"]
     res = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
@@ -62,6 +64,17 @@ def test_collective_suite_schema(tmp_path):
     assert gp["coll"]["lost_time_totals"]["coll_wait"] > 0
     assert gp["coll"]["matched_flows"] > gp["chain"]["matched_flows"]
     assert "wait_reduction" in gp and "overlap_fraction_gain" in gp
+
+    # ptc-tune section: model proposals (topology x slicing x eager
+    # threshold) validated with real pairs, defaults among them
+    t = doc["tuned"]
+    assert t["workload"] == "gemm_panel_reduce"
+    assert any(r["knobs"] == t["default_knobs"] for r in t["validated"])
+    assert all(r["coll_ms"] > 0 and r["predicted_ns"] > 0
+               for r in t["validated"])
+    assert t["tuned_vs_default"] is not None
+    assert t["beats_default"] == (t["tuned_vs_default"] <= 1.0)
+    assert t["persisted"] is True
 
     # the economics selector's decisions are recorded
     assert doc["coll_topology_ops"], doc

@@ -24,6 +24,8 @@ def test_stream_suite_schema(tmp_path):
     out = tmp_path / "stream.json"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the tuned section persists winners: keep them in the sandbox
+    env["PTC_MCA_tune_cache_path"] = str(tmp_path / "tuned.json")
     cmd = [sys.executable, _BENCH, "--stream", "--json", str(out),
            "--size", str(512 * 1024), "--chunk", str(64 * 1024),
            "--hops", "3", "--reps", "1"]
@@ -60,3 +62,14 @@ def test_stream_suite_schema(tmp_path):
     assert doc["stream_vs_serialized_ratio"] is not None
     assert doc["rails2_vs_rails1_throughput"] is not None
     assert doc["ratio_target"] == 0.6
+
+    # ptc-tune section: model proposals validated with real pairs, the
+    # default vector among them, ratio + equal-direction flag recorded
+    t = doc["tuned"]
+    assert t["workload"] == "device_tile_chain"
+    assert any(r["knobs"] == t["default_knobs"] for r in t["validated"])
+    assert all(r["per_transfer_ms"] > 0 and r["predicted_ns"] > 0
+               for r in t["validated"])
+    assert t["tuned_vs_default"] is not None
+    assert t["beats_default"] == (t["tuned_vs_default"] <= 1.0)
+    assert t["persisted"] is True

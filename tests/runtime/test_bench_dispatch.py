@@ -24,6 +24,8 @@ def test_dispatch_suite_schema(tmp_path):
     out = tmp_path / "dispatch.json"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the tuned section persists winners: keep them in the sandbox
+    env["PTC_MCA_tune_cache_path"] = str(tmp_path / "tuned.json")
     cmd = [sys.executable, _BENCH, "--dispatch", "--json", str(out),
            "--tasks", "2000", "--mt-tasks", "600", "--reps", "2"]
     res = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
@@ -58,6 +60,24 @@ def test_dispatch_suite_schema(tmp_path):
     if mt["oversubscribed"]:
         assert "caveat" in mt and "timeshare" in mt["caveat"]
         assert "WARNING" in res.stderr
+
+    # host fingerprint (the ptc-tune persistence key) rides provenance
+    from parsec_tpu.analysis.tune import host_fingerprint
+    assert doc["host"]["fingerprint"] == host_fingerprint()
+
+    # ptc-tune section: model proposals validated with real runs, the
+    # default vector always among them, ratios + flags recorded
+    t = doc["tuned"]
+    assert t["workload"] == "single_chain"
+    assert t["signature"] and t["host"] == host_fingerprint()
+    assert t["default_wall_s"] > 0 and t["winner_wall_s"] > 0
+    assert t["tuned_vs_default"] is not None
+    assert t["beats_default"] == (t["tuned_vs_default"] <= 1.0)
+    assert any(r["knobs"] == t["default_knobs"] for r in t["validated"])
+    assert all(r["predicted_ns"] > 0 and r["measured_s"] > 0
+               and r["predicted_vs_wall"] is not None
+               for r in t["validated"])
+    assert t["persisted"] is True
 
 
 def test_dispatch_mt_line_records_host(tmp_path):

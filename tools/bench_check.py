@@ -69,6 +69,20 @@ CHECKS = [
     ("BENCH_serve.json", "scope.tokens_per_s_p50.hi", "higher", 0.50,
      True),
     ("BENCH_serve.json", "scope.conformance.sound", "equal", 0.0, False),
+    # ptc-tune (PR 12): autotuned-vs-default ratios on the dispatch
+    # chain and the 2-rank collective — timing trajectory rows,
+    # oversubscription-slacked per convention; the beats_default
+    # verdicts are equal-direction correctness flags, never relaxed
+    ("BENCH_dispatch.json", "tuned.tuned_vs_default", "lower", 0.25,
+     True),
+    ("BENCH_dispatch.json", "tuned.beats_default", "equal", 0.0, False),
+    ("BENCH_collective.json", "tuned.tuned_vs_default", "lower", 0.25,
+     True),
+    ("BENCH_collective.json", "tuned.beats_default", "equal", 0.0,
+     False),
+    ("BENCH_stream.json", "tuned.tuned_vs_default", "lower", 0.25,
+     True),
+    ("BENCH_stream.json", "tuned.beats_default", "equal", 0.0, False),
     # ptc-plan analyzer runtime on the potrf bench tiling (NT=16, 816
     # instances; PR 10): `make plan-graphs` emits the number, the 5 s
     # absolute budget lives in tools/plan_graphs.py — this row guards

@@ -49,7 +49,9 @@ def plan_all(only=None, verbose=False):
 
 def plan_issues(plan) -> list:
     """Baseline violations for one graph's plan: enumeration refusals,
-    unbounded/absent residency or makespan numbers."""
+    unbounded/absent residency or makespan numbers, waves without an
+    explicit fusability verdict (certify/refuse — silent skips are a
+    baseline violation, refusals are not)."""
     issues = []
     if plan.bounded:
         issues.append("enumeration refused (symbolic fallback)")
@@ -63,6 +65,13 @@ def plan_issues(plan) -> list:
     m = plan.makespan
     if not m or m.get("lower_bound_ns", 0) <= 0:
         issues.append("no finite makespan lower bound")
+    waves = {(r, row["wave"]) for r, rows in plan.waves.items()
+             for row in rows}
+    certified = {(c["rank"], c["wave"]) for c in plan.fusability}
+    missing = waves - certified
+    if missing:
+        issues.append(f"{len(missing)} wave(s) without a fusability "
+                      "verdict")
     return issues
 
 
@@ -95,9 +104,11 @@ def main(argv=None):
         issues = plan_issues(plan)
         peak = plan.peak_bytes()
         status = ("clean" if not issues else "; ".join(issues))
+        fus = plan.fusable_waves()
         print(f"{name:24s} {status}  "
               f"[{plan.stats.get('instances', 0)} inst, "
-              f"{plan.stats.get('waves', 0)} wave(s), peak {peak} B, "
+              f"{plan.stats.get('waves', 0)} wave(s), "
+              f"{fus} fusable, peak {peak} B, "
               f"{plan.stats.get('elapsed_ms', 0):.0f} ms]")
         if issues:
             dirty += 1
@@ -105,6 +116,8 @@ def main(argv=None):
             "issues": issues,
             "instances": plan.stats.get("instances", 0),
             "waves": plan.stats.get("waves", 0),
+            "fusable_waves": fus,
+            "certified_waves": len(plan.fusability),
             "peak_bytes": peak,
             "est_bytes": plan.est_bytes(),
             "comm_bytes": plan.comm_bytes(),

@@ -465,6 +465,16 @@ def main():
             chain_mesh(sched, workers=8, nb=200, lanes=16)
         dtd_churn(workers=8, tiles=8, rounds=100)
         reshape_churn(workers=8, fanout=8, rounds=60)
+        # ptc-tune magazine-batch knob (PR 12): non-default batches
+        # stress the task/arena refill-spill crossings — a tiny batch
+        # maximizes free_lock traffic, a big one maximizes per-spill
+        # move size; the knob binds at context create, so each job
+        # runs its own contexts under the env
+        os.environ["PTC_MCA_runtime_mag_batch"] = "4"
+        chain_mesh("lws", workers=8, nb=120, lanes=16)
+        os.environ["PTC_MCA_runtime_mag_batch"] = "512"
+        chain_mesh("lws", workers=8, nb=120, lanes=16)
+        os.environ.pop("PTC_MCA_runtime_mag_batch", None)
         colocated_comm(workers=4, port=29900 + rep)
         # wire-v4 socket/session paths: chunk sessions, zero-copy
         # sendmsg pins, 2-rail striping (16 KiB payloads, 2 KiB chunks)
