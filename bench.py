@@ -741,9 +741,12 @@ def bench_dispatch_suite(tasks=20000, mt_tasks=4000, reps=5, workers=4,
 
 
 def _pair_spans(ev, key, aux_filter=None):
-    """(t0, t1, l0, end_aux) tuples from consecutive begin/end events of
-    one trace key.  DEVICE and H2D spans are emitted by single threads
-    (manager / prefetch lane), so time-ordered pairing is exact."""
+    """(t0, t1, l0, end_aux, begin_aux) tuples from consecutive
+    begin/end events of one trace key.  DEVICE and H2D spans are
+    emitted by single threads (manager / prefetch lane), so
+    time-ordered pairing is exact.  DEVICE begin aux carries the
+    ptc-fuse mark (0 plain, n >= 1 = a certified wave executable
+    covering n waves)."""
     rows = ev[ev[:, 0] == key]
     if aux_filter is not None:
         rows = rows[rows[:, 6] == aux_filter]
@@ -751,9 +754,9 @@ def _pair_spans(ev, key, aux_filter=None):
     spans, open_t = [], None
     for r in rows:
         if r[1] == 0:
-            open_t = (r[7], r[3])
+            open_t = (r[7], r[3], r[6])
         elif open_t is not None:
-            spans.append((open_t[0], r[7], open_t[1], r[6]))
+            spans.append((open_t[0], r[7], open_t[1], r[6], open_t[2]))
             open_t = None
     return spans
 
@@ -761,17 +764,17 @@ def _pair_spans(ev, key, aux_filter=None):
 def _overlap_fraction(h2d_spans, exec_spans):
     """Fraction of h2d span time covered by device-dispatch spans —
     the trace-level transfer/compute overlap evidence."""
-    total = sum(t1 - t0 for t0, t1, _, _ in h2d_spans)
+    total = sum(s[1] - s[0] for s in h2d_spans)
     if total <= 0:
         return None
     merged = []
-    for t0, t1, _, _ in sorted(exec_spans):
+    for t0, t1, *_ in sorted(exec_spans):
         if merged and t0 <= merged[-1][1]:
             merged[-1][1] = max(merged[-1][1], t1)
         else:
             merged.append([t0, t1])
     cov = 0
-    for t0, t1, _, _ in h2d_spans:
+    for t0, t1, *_ in h2d_spans:
         for m0, m1 in merged:
             lo, hi = max(t0, m0), min(t1, m1)
             if lo < hi:
@@ -933,6 +936,96 @@ def bench_device_ooc_gemm(m=512, n=512, k=64, mb=32):
     }
 
 
+def _fuse_gemm_run(fuse, m, k, nb, batch_wait_ms=2.0):
+    """One wave-fusion GEMM run: single-rank owner-computes k-chain
+    (kt = k/nb waves of (m/nb)^2 Gemm tasks).  Returns (C dense,
+    DEVICE launch count, fused-marked launch count, fuse counters,
+    wall_s)."""
+    from parsec_tpu.algos import build_gemm
+    from parsec_tpu.data import TwoDimBlockCyclic
+    from parsec_tpu.device import TpuDevice
+    from parsec_tpu.profiling.trace import KEY_DEVICE
+    from parsec_tpu.utils import params as _mca
+    _mca.set("device.wave_fuse", bool(fuse))
+    try:
+        rng = np.random.default_rng(5)
+        with pt.Context(nb_workers=2) as ctx:
+            A = TwoDimBlockCyclic(m, k, nb, nb, dtype=np.float32)
+            B = TwoDimBlockCyclic(k, m, nb, nb, dtype=np.float32)
+            Cc = TwoDimBlockCyclic(m, m, nb, nb, dtype=np.float32)
+            A.from_dense(rng.standard_normal((m, k), dtype=np.float32))
+            B.from_dense(rng.standard_normal((k, m), dtype=np.float32))
+            Cc.from_dense(np.zeros((m, m), np.float32))
+            A.register(ctx, "A")
+            B.register(ctx, "B")
+            Cc.register(ctx, "C")
+            ctx.profile_enable(1)
+            dev = TpuDevice(ctx)
+            # coalesce whole waves per pop (the spotrf bench setting):
+            # launch economics, not pop-timing luck, is under test
+            dev.batch_wait_ms = batch_wait_ms
+            tp = build_gemm(ctx, A, B, Cc, dev=dev)
+            t0 = time.perf_counter()
+            tp.run()
+            tp.wait()
+            dev.flush()
+            wall = time.perf_counter() - t0
+            ev = ctx.profile_take()
+            stats = ctx.device_stats()
+            dev.stop()
+            out = Cc.to_dense().copy()
+        spans = _pair_spans(ev, KEY_DEVICE)
+        fused_marked = sum(1 for s in spans if s[4] > 0)
+        return out, len(spans), fused_marked, stats["fuse"], wall
+    finally:
+        _mca.unset("device.wave_fuse")
+
+
+def bench_device_fuse_gemm(m=128, k=512, nb=32, reps=3):
+    """Wave mega-kernelization section (`make bench-device`): the SAME
+    deep-k GEMM runs with the wave compiler ON (certified waves +
+    chains compile into one cached executable each; downstream waves
+    complete from parked results with zero launches) and OFF
+    (PTC_MCA_device_wave_fuse=0 — the PR 12 per-group batched path).
+    Launch counts come straight off paired DEVICE spans; acceptance is
+    >= 5x fewer launches at BIT-EXACT results (the equal-direction
+    gate bench_check never relaxes)."""
+    tasks = (m // nb) ** 2 * (k // nb)
+    best_f = best_u = None
+    bit_identical = True
+    fuse_stats = None
+    for _ in range(reps):
+        cf, lf, marked, fs, wf = _fuse_gemm_run(True, m, k, nb)
+        cu, lu, _, _, wu = _fuse_gemm_run(False, m, k, nb)
+        bit_identical = bit_identical and \
+            (cf.tobytes() == cu.tobytes())
+        # fewest launches first, then wall (rep 0 pays the one-time
+        # chain-program compile; the cache makes later reps steady-state)
+        if best_f is None or (lf, wf) < (best_f[0], best_f[2]):
+            best_f = (lf, marked, wf)
+            fuse_stats = fs
+        if best_u is None or (lu, wu) < best_u:
+            best_u = (lu, wu)
+    launches_f, marked, wall_f = best_f
+    launches_u, wall_u = best_u
+    return {
+        "m": m, "k": k, "nb": nb, "reps": reps,
+        "tasks": tasks,
+        "waves": k // nb,
+        "launches_fused": launches_f,
+        "launches_unfused": launches_u,
+        "fused_marked_launches": marked,
+        # the two bench_check trajectory rows + the correctness gate
+        "launches_per_task": round(launches_f / tasks, 5),
+        "fused_vs_unfused_ratio": round(launches_u
+                                        / max(1, launches_f), 2),
+        "bit_identical": bit_identical,
+        "wall_fused_s": round(wall_f, 4),
+        "wall_unfused_s": round(wall_u, 4),
+        "fuse_stats": {kk: vv for kk, vv in (fuse_stats or {}).items()},
+    }
+
+
 def bench_device_suite(tiles=96, elems=32 * 1024, batch=8, reps=3,
                        gemm_m=512, gemm_k=64, gemm_mb=32):
     """The `make bench-device` document (BENCH_device.json): staged-vs-
@@ -955,6 +1048,9 @@ def bench_device_suite(tiles=96, elems=32 * 1024, batch=8, reps=3,
         "wave_pipeline": bench_device_pipeline(tiles, elems, batch, reps),
         "out_of_core_gemm": bench_device_ooc_gemm(
             m=gemm_m, n=gemm_m, k=gemm_k, mb=gemm_mb),
+        # ptc-fuse: wave mega-kernelization launch economics (>= 5x
+        # fewer DEVICE launches at bit-exact results is the gate)
+        "wave_fuse": bench_device_fuse_gemm(),
     }
     if doc["oversubscribed"]:
         doc["caveat"] = (

@@ -3529,8 +3529,10 @@ int32_t ptc_comm_quiesce(ptc_context_t *ctx, ptc_taskpool_t *tp) {
     /* local idleness first: never report idle while tasks remain */
     if (tp) {
       while (tp->nb_tasks.load(std::memory_order_acquire) > 0) {
-        std::unique_lock<std::mutex> g(tp->done_lock);
-        tp->done_cv.wait_for(g, std::chrono::milliseconds(5));
+        std::unique_lock<ptc_mutex> g(tp->done_lock);
+        tp->done_cv.wait_for(g, std::chrono::milliseconds(5), [&] {
+          return tp->nb_tasks.load(std::memory_order_acquire) <= 0;
+        });
       }
     }
     uint64_t gen;

@@ -1967,7 +1967,7 @@ static void notify_drain_waiters(ptc_taskpool *tp) {
    * flips and destroy the pool — an after-unlock notify would then
    * broadcast on a dead condvar (ptc_tp_destroy serializes on this lock
    * before deleting; TSan-caught) */
-  std::lock_guard<std::mutex> g(tp->window_lock);
+  std::lock_guard<ptc_mutex> g(tp->window_lock);
   tp->window_cv.notify_all();
 }
 
@@ -1983,12 +1983,12 @@ static void tp_mark_complete(ptc_context *ctx, ptc_taskpool *tp) {
   if (tp->complete_cb) tp->complete_cb(tp->complete_user, tp);
   {
     /* under the lock: see notify_drain_waiters */
-    std::lock_guard<std::mutex> g(tp->done_lock);
+    std::lock_guard<ptc_mutex> g(tp->done_lock);
     tp->done_cv.notify_all();
   }
   notify_drain_waiters(tp);
   if (ctx->active_tps.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-    std::lock_guard<std::mutex> g(ctx->wait_lock);
+    std::lock_guard<ptc_mutex> g(ctx->wait_lock);
     ctx->wait_cv.notify_all();
   }
 }
@@ -2409,7 +2409,7 @@ static void dyn_complete_task(ptc_context *ctx, int worker, ptc_task *t) {
   tp_task_done(ctx, tp); /* decrement before waking window waiters */
   {
     /* under the lock: see notify_drain_waiters */
-    std::lock_guard<std::mutex> g(tp->window_lock);
+    std::lock_guard<ptc_mutex> g(tp->window_lock);
     tp->window_cv.notify_all();
   }
   tp->busy.fetch_sub(1, std::memory_order_release); /* LAST tp access */
@@ -2486,7 +2486,7 @@ static void dyn_fail_task(ptc_context *ctx, ptc_task *t) {
   tp_abort(ctx, tp);
   {
     /* under the lock: see notify_drain_waiters */
-    std::lock_guard<std::mutex> g(tp->window_lock);
+    std::lock_guard<ptc_mutex> g(tp->window_lock);
     tp->window_cv.notify_all();
   }
   tp->busy.fetch_sub(1, std::memory_order_release); /* LAST tp access */
@@ -3565,7 +3565,7 @@ int32_t ptc_context_start(ptc_context_t *ctx) {
 }
 
 int32_t ptc_context_wait(ptc_context_t *ctx) {
-  std::unique_lock<std::mutex> lk(ctx->wait_lock);
+  std::unique_lock<ptc_mutex> lk(ctx->wait_lock);
   ctx->wait_cv.wait(lk, [&] { return ctx->active_tps.load() == 0; });
   return 0;
 }
@@ -3901,7 +3901,7 @@ int32_t ptc_context_add_taskpool(ptc_context_t *ctx, ptc_taskpool_t *tp) {
 }
 
 int32_t ptc_tp_wait(ptc_taskpool_t *tp) {
-  std::unique_lock<std::mutex> lk(tp->done_lock);
+  std::unique_lock<ptc_mutex> lk(tp->done_lock);
   tp->done_cv.wait(lk, [&] { return tp->completed.load(); });
   return tp->nb_errors.load() > 0 ? -1 : 0;
 }
@@ -3931,7 +3931,7 @@ int64_t ptc_tp_addto_nb_tasks(ptc_taskpool_t *tp, int64_t delta) {
 int32_t ptc_tp_drain(ptc_taskpool_t *tp) {
   tp->drain_waiters.fetch_add(1, std::memory_order_seq_cst);
   {
-    std::unique_lock<std::mutex> lk(tp->window_lock);
+    std::unique_lock<ptc_mutex> lk(tp->window_lock);
     tp->window_cv.wait(lk, [&] {
       return tp->nb_tasks.load(std::memory_order_seq_cst) == 0 ||
              tp->completed.load(std::memory_order_acquire) ||
@@ -4207,6 +4207,27 @@ void ptc_copy_unpin(ptc_context_t *ctx, ptc_copy_t *c) {
   if (ctx && c) ptc_copy_release_internal(ctx, c);
 }
 
+/* Wave-granular ready-front census (the wave compiler's peek): class id
+ * + taskpool of every task still queued on `qid`, under the queue lock,
+ * with nothing popped or pinned.  The compiler uses it to see whether
+ * the remainder of a certified wave is already queued before fusing a
+ * partially-popped front. */
+int64_t ptc_peek_ready_front(ptc_context_t *ctx, int32_t qid, int64_t *out,
+                             int64_t max_tasks) {
+  if (!ctx || !out || qid < 0 || (size_t)qid >= ctx->dev_queues.size())
+    return 0;
+  DeviceQueue *q = ctx->dev_queues[(size_t)qid];
+  int64_t n = 0;
+  std::lock_guard<ptc_mutex> g(q->lock);
+  for (ptc_task *t : q->dq) {
+    if (n >= max_tasks) break;
+    out[2 * n] = t->dyn ? -1 : (int64_t)t->class_id;
+    out[2 * n + 1] = (int64_t)(intptr_t)t->tp;
+    n++;
+  }
+  return n;
+}
+
 /* depth bookkeeping for load balancing: resolve which device queue an
  * ASYNC task was routed to (PTG: its current chore; DTD: its body) */
 static void device_task_done(ptc_context *ctx, ptc_task *t) {
@@ -4382,7 +4403,7 @@ int32_t ptc_dtask_submit(ptc_context_t *ctx, ptc_task_t *t, int64_t window) {
     dx->rank = ctx->myrank;
   }
   if (window > 0) {
-    std::unique_lock<std::mutex> lk(tp->window_lock);
+    std::unique_lock<ptc_mutex> lk(tp->window_lock);
     tp->window_cv.wait(lk, [&] {
       return tp->nb_tasks.load() < window ||
              tp->completed.load(std::memory_order_acquire) ||

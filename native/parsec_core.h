@@ -354,6 +354,12 @@ ptc_task_t *ptc_device_pop(ptc_context_t *ctx, int32_t qid, int32_t timeout_ms);
 int64_t ptc_peek_ready(ptc_context_t *ctx, int32_t qid, int64_t *out,
                        int64_t max_words, int32_t max_tasks);
 void ptc_copy_unpin(ptc_context_t *ctx, ptc_copy_t *copy);
+/* wave-granular ready-front census for the wave compiler: per queued
+ * task on `qid`, [class_id (-1 for DTD), taskpool_ptr] — the compiler
+ * sees the FULL ready front (is the rest of a certified wave already
+ * queued?) without popping or pinning anything.  Returns task count. */
+int64_t ptc_peek_ready_front(ptc_context_t *ctx, int32_t qid,
+                             int64_t *out, int64_t max_tasks);
 /* data-affinity routing (reference: parsec_get_best_device's
  * owner_device/preferred_device pass, device.c:100-117, before the load
  * pass at :129-160).  The device layer stamps which queue holds a

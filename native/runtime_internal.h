@@ -798,13 +798,19 @@ struct ptc_taskpool {
   void *complete_user = nullptr;
   DepShard shards[NB_SHARDS];
   std::vector<DenseDeps> dense; /* per class; enabled by enumeration */
-  std::mutex done_lock;
-  std::condition_variable done_cv;
+  /* ptc_mutex/ptc_condvar (not std::): explicit pthread init/destroy
+   * give each pool's sync objects a fresh TSan identity across the
+   * heap-recycled pool addresses of sequential jobs (the PR 3 fix),
+   * and keep every core condvar out of libstdc++ — the TSan
+   * suppressions may mute uninstrumented libstdc++ users (jax's
+   * Eigen pool) without ever masking this runtime's own waits. */
+  ptc_mutex done_lock;
+  ptc_condvar done_cv;
   /* DTD insertion-window throttle; drain_waiters gates the notify in the
    * per-task completion hot path (ptc_tp_drain on a PTG pool would
    * otherwise miss its wakeup — only the DTD path notified window_cv) */
-  std::mutex window_lock;
-  std::condition_variable window_cv;
+  ptc_mutex window_lock;
+  ptc_condvar window_cv;
   std::atomic<int32_t> drain_waiters{0};
   /* completion-path guard: >0 while a completer may still touch this
    * pool AFTER a waiter-visible predicate (completed / nb_tasks==0)
@@ -934,8 +940,8 @@ struct ptc_context {
 
   /* active taskpools */
   std::atomic<int64_t> active_tps{0};
-  std::mutex wait_lock;
-  std::condition_variable wait_cv;
+  ptc_mutex wait_lock;
+  ptc_condvar wait_cv;
 
   /* distributed taskpool registry (id → pool) + parked early activations */
   std::mutex tp_reg_lock;

@@ -248,6 +248,9 @@ _sigs = {
     "ptc_peek_ready": (C.c_int64, [C.c_void_p, C.c_int32,
                                    C.POINTER(C.c_int64), C.c_int64,
                                    C.c_int32]),
+    "ptc_peek_ready_front": (C.c_int64, [C.c_void_p, C.c_int32,
+                                         C.POINTER(C.c_int64),
+                                         C.c_int64]),
     "ptc_copy_unpin": (None, [C.c_void_p, C.c_void_p]),
     "ptc_device_set_data_owner": (None, [C.c_void_p, C.c_int64, C.c_int32,
                                          C.c_int32]),

@@ -17,7 +17,8 @@ from .flowgraph import (ConcreteGraph, FlowGraph, collection_tile_bytes,
 from .verify import (RULES, Finding, Report, VerifyError, verify_graph,
                      verify_taskpool)
 from .plan import (CostModel, Plan, PlanCheckError, certify_waves,
-                   compare_critpath, plan_graph, plan_taskpool)
+                   chain_certificates, compare_critpath, plan_graph,
+                   plan_taskpool)
 from .tune import (ScheduleSimulator, TuneStore, apply_knobs, autotune,
                    graph_signature, host_fingerprint)
 from .dtdlint import DtdLintError, DtdLinter
@@ -28,7 +29,7 @@ __all__ = [
     "Finding", "Report", "RULES", "VerifyError", "verify_graph",
     "verify_taskpool",
     "CostModel", "Plan", "PlanCheckError", "plan_graph", "plan_taskpool",
-    "compare_critpath", "certify_waves",
+    "compare_critpath", "certify_waves", "chain_certificates",
     "ScheduleSimulator", "TuneStore", "apply_knobs", "autotune",
     "graph_signature", "host_fingerprint",
     "DtdLinter", "DtdLintError",

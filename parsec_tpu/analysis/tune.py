@@ -73,6 +73,7 @@ TUNE_KNOBS: Tuple[str, ...] = (
     "coll.max_slices",      # slices per collective segment
     "device.staging_slots", # prefetch double-buffering depth
     "device.cache_bytes",   # device byte budget (0 = constructor default)
+    "device.wave_fuse",     # wave mega-kernelization (ptc-fuse)
     "runtime.mag_batch",    # task/arena freelist magazine batch
 )
 
@@ -416,6 +417,21 @@ class ScheduleSimulator:
             cm = fg.classes[n[0]]
             self.exec_ns[n] = float(self.cost.ns(cm.name))
             self.is_dev[n] = n[0] in dev_cls
+        # ptc-fuse pricing input: nodes sitting in a CERTIFIED fusable
+        # wave (plan.certify) share ONE dispatch-overhead charge when
+        # the wave_fuse knob is on — the simulator's model of the wave
+        # compiler collapsing a wave into one launch.  Only device
+        # nodes qualify (fusion lives in the device layer).
+        self.fused_width: Dict[tuple, int] = {}
+        cert_w = {(c["rank"], c["wave"]): c["width"]
+                  for c in self.plan.fusability
+                  if c.get("fusable") and c.get("width", 0) > 1}
+        for n in nodes:
+            if not self.is_dev[n]:
+                continue
+            w = cert_w.get((self.rank[n], an.wave[n]))
+            if w:
+                self.fused_width[n] = w
         # per-edge payloads: mirror the release walk once, keep the max
         # payload per (src, dst) node pair + the collective flag
         self.edge_payload: Dict[Tuple[tuple, tuple], int] = {}
@@ -516,6 +532,7 @@ class ScheduleSimulator:
         mag = max(1, int(kv["runtime.mag_batch"]))
         slots = max(1, int(kv["device.staging_slots"]))
         cache = int(kv["device.cache_bytes"] or 0)
+        wave_fuse = bool(kv.get("device.wave_fuse", True))
         dispatch = DISPATCH_BASE_NS + DISPATCH_MAG_NS / mag
 
         indeg = dict(self.indeg0)
@@ -544,7 +561,16 @@ class ScheduleSimulator:
                 # the previous wave's compute — the dispatch stalls for
                 # the task's staged input volume
                 stall = self.in_bytes.get(n, 0) * H2D_BYTE_NS
-            dur = self.exec_ns[n] + dispatch + stall
+            disp_n = dispatch
+            if wave_fuse:
+                # certified fusable wave -> ONE launch for the whole
+                # wave: the per-task share of the dispatch overhead is
+                # 1/width (ptc-fuse; the certificate is the gate, so
+                # uncertified waves keep the full per-task charge)
+                fw = self.fused_width.get(n)
+                if fw:
+                    disp_n = dispatch / fw
+            dur = self.exec_ns[n] + disp_n + stall
             finish = start + dur
             stall_total += stall
             heapq.heappush(wf, finish)
@@ -623,6 +649,12 @@ class ScheduleSimulator:
         else:
             axes["device.staging_slots"] = [kv["device.staging_slots"]]
             axes["device.cache_bytes"] = [kv["device.cache_bytes"]]
+        if self.has_device and self.fused_width:
+            # fusion width vs staging: only worth searching when a
+            # certified fusable wave exists for the compiler to fuse
+            axes["device.wave_fuse"] = [True, False]
+        else:
+            axes["device.wave_fuse"] = [kv["device.wave_fuse"]]
         return axes
 
     # ------------------------------------------------------- search

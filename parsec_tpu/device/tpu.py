@@ -902,6 +902,27 @@ class TpuDevice:
         # so the bench can tell prefetch-hit waves (aux == 0) from
         # staged ones without a second event
         self._disp_stall_ns = 0
+        # fused-dispatch mark for the NEXT DEVICE span's begin aux
+        # (manager thread only): 0 plain, n >= 1 a certified wave
+        # executable covering n wave(s) — set by the wave compiler
+        self._disp_fused = 0
+        # HBM pinned by parked chain speculations (ptc-fuse): the
+        # output stacks of speculated waves live outside the cache
+        # accounting until their tasks consume them, so the wave
+        # compiler charges them here and refuses to chain under
+        # residency pressure — out-of-core pools keep the PR 12
+        # spill behavior instead of pinning unaccounted stacks
+        self._chain_pinned = 0
+        # ptc-fuse wave compiler (device.wave_fuse knob; None = off
+        # reproduces the per-group batched dispatch path bit-exactly)
+        self._fuser = None
+        if bool(_mca.get("device.wave_fuse")):
+            from .fuse import WaveFuser
+            self._fuser = WaveFuser(self)
+        # chain prefetch hints: [(collection name, idx tuple)] the wave
+        # compiler predicts the NEXT chain segment will read; the
+        # prefetch lane stages them alongside the peeked lookahead
+        self._pf_chain_hints: list = []
         self._dbg(f"device up: {self.device} queue={self.qid} "
                   f"cache={cache_bytes >> 20}MiB batch<= {self.batch_max}")
         self._stop = threading.Event()
@@ -1399,6 +1420,7 @@ class TpuDevice:
             # keys lazily, and dict iteration during an insert raises
             stats = dict(self.stats)
             attached = len(self.bodies)
+        fz = self._fuser
         return {
             "device": str(self.device),
             "kind": getattr(self.device, "device_kind", "?"),
@@ -1412,6 +1434,15 @@ class TpuDevice:
             # instances of one client), hence the name
             "process_jit_kernels": len(_JIT_CACHE),
             "stats": stats,
+            # ptc-fuse wave-compiler counters (schema-stable when off)
+            "fuse": (fz.snapshot() if fz is not None
+                     else {"enabled": False, "fused_waves": 0,
+                           "fused_tasks": 0, "fused_chains": 0,
+                           "chain_waves": 0, "chain_parked": 0,
+                           "chain_hits": 0, "chain_misses": 0,
+                           "chain_drops": 0, "cache_hits": 0,
+                           "cache_misses": 0, "parked": 0,
+                           "refused": {}}),
         }
 
     def _dbg(self, msg: str):
@@ -1777,6 +1808,8 @@ class TpuDevice:
             self._stacks.clear()
             self._spec.clear()
             self._cache_used = 0
+        if self._fuser is not None:
+            self._fuser.clear()
 
     def _manager(self):
         """Dispatch loop.  XLA queues kernels asynchronously, so completing
@@ -1826,10 +1859,24 @@ class TpuDevice:
                 key = id(body)
                 gi = index.get(key)
                 if gi is None or body is None or not body.batch:
+                    if gi is not None and body is not None \
+                            and not body.batch \
+                            and self._fuser is not None:
+                        # >= 2 ready tasks of a vmap-incompatible
+                        # class: the wave exists but cannot fuse —
+                        # recorded, mirroring certify()'s opaque-body
+                        # refusals (no silent fallback)
+                        self._fuser._refuse("unbatchable-body")
                     index[key] = len(groups)
                     groups.append((body, [t]))
                 else:
                     groups[gi][1].append(t)
+            if self._fuser is not None and len(
+                    {id(b) for b, _ in groups if b is not None}) > 1:
+                # mixed ready front: each group still certifies on its
+                # own, but the front as popped was not ONE wave —
+                # recorded like certify()'s heterogeneous refusals
+                self._fuser._refuse("heterogeneous-front")
             for body, ts in groups:
                 if body is None:
                     for t in ts:
@@ -2015,23 +2062,95 @@ class TpuDevice:
         event's aux carries the wave's dispatch-time h2d stall in ns
         (0 == every input was resident/prefetched: a prefetch-hit
         wave), so the bench reads staged-vs-prefetched latency straight
-        off paired spans."""
+        off paired spans.  The BEGIN event's aux marks FUSED dispatches
+        (ptc-fuse): 0 = plain, n >= 1 = a certified wave executable
+        covering n wave(s) — the bench-device fused-vs-unfused section
+        counts launches straight off these spans."""
         from ..profiling.trace import KEY_DEVICE
         cid = body.tc.id if body.tc is not None else -1
         if phase == 0:
             self._disp_stall_ns = 0
-            aux = 0
+            aux = self._disp_fused
         else:
             aux = self._disp_stall_ns
+            self._disp_fused = 0
         N.lib.ptc_prof_event(self.ctx._ptr, KEY_DEVICE, phase, cid,
                              lanes, self.qid, aux)
 
     def _dispatch_group_chunk(self, body: _DeviceBody, tasks: List):
+        fz = self._fuser
+        if fz is not None:
+            # ptc-fuse: parked chain results complete first (zero
+            # launches), then the wave compiler certifies the remainder
+            # online — a certified wave marks its DEVICE span, and a
+            # certified CHAIN dispatches entirely inside the compiler
+            tasks = fz.consume_group(body, tasks)
+            if not tasks:
+                return
+            if len(tasks) == 1:
+                self._dispatch_one(body, tasks[0])
+                return
+            if fz.dispatch_group(body, tasks):
+                return
         self._prof(0, body, len(tasks))
         try:
             self._dispatch_group_run(body, tasks)
         finally:
             self._prof(1, body, len(tasks))
+
+    def _wave_sig_args(self, body: _DeviceBody, views: List, bucket: int):
+        """Fused-gather marshaling for one wave: per read flow, decide
+        how the lanes' inputs enter the jitted program (in-program
+        gather / shared broadcast / pre-stacked) and build the flat
+        call args.  Shared by the batched group dispatch and the wave
+        compiler (fuse.py) so the two can never marshal differently —
+        the chain executable's level 0 IS the group dispatch's
+        program."""
+        sig, call_args = [], []
+        for f in body.reads:
+            ents = self._flow_entries(views, body, f)
+            first = ents[0]
+            if all(e is first for e in ents):
+                # wave-wide shared operand: ship once, vmap axis None
+                self.stats["fused_flows"] += 1
+                if isinstance(first, _StackRef):
+                    sig.append("bidx")
+                    call_args += [first.stack, np.int32(first.idx)]
+                else:
+                    sig.append("bcast")
+                    call_args.append(first)
+                continue
+            one = _single_stack(ents)
+            if one is not None:
+                stack, idxs = one
+                if len(set(idxs)) == 1:
+                    # shared row of one stack: same broadcast case
+                    self.stats["fused_flows"] += 1
+                    sig.append("bidx")
+                    call_args += [stack, np.int32(idxs[0])]
+                    continue
+                idxs += [idxs[0]] * (bucket - len(idxs))
+                sig.append("idx")
+                self.stats["fused_flows"] += 1
+                call_args += [stack,
+                              np.asarray(idxs, dtype=np.int32)]
+            else:
+                sig.append(None)
+                self.stats["eager_gathers"] += 1
+                call_args.append(grouped_stack(
+                    self._jax.numpy, ents, bucket))
+        if sig and all(s in ("bcast", "bidx") for s in sig):
+            # degenerate wave (every flow shared): vmap needs one
+            # mapped axis — demote flow 0 to a per-lane form
+            if sig[0] == "bidx":
+                sig[0] = "idx"
+                call_args[1] = np.full((bucket,),
+                                       int(call_args[1]), np.int32)
+            else:
+                sig[0] = None
+                call_args[0] = self._jax.numpy.stack(
+                    [call_args[0]] * bucket)
+        return sig, call_args
 
     def _dispatch_group_run(self, body: _DeviceBody, tasks: List):
         if body.spec_src is not None:
@@ -2052,50 +2171,7 @@ class TpuDevice:
             # ship (stack, idx) and gather inside the fused program;
             # otherwise pre-gather eagerly (mixed sources).  The whole
             # wave is then a single device dispatch.
-            sig, call_args = [], []
-            for f in body.reads:
-                ents = self._flow_entries(views, body, f)
-                first = ents[0]
-                if all(e is first for e in ents):
-                    # wave-wide shared operand: ship once, vmap axis None
-                    self.stats["fused_flows"] += 1
-                    if isinstance(first, _StackRef):
-                        sig.append("bidx")
-                        call_args += [first.stack, np.int32(first.idx)]
-                    else:
-                        sig.append("bcast")
-                        call_args.append(first)
-                    continue
-                one = _single_stack(ents)
-                if one is not None:
-                    stack, idxs = one
-                    if len(set(idxs)) == 1:
-                        # shared row of one stack: same broadcast case
-                        self.stats["fused_flows"] += 1
-                        sig.append("bidx")
-                        call_args += [stack, np.int32(idxs[0])]
-                        continue
-                    idxs += [idxs[0]] * (bucket - len(idxs))
-                    sig.append("idx")
-                    self.stats["fused_flows"] += 1
-                    call_args += [stack,
-                                  np.asarray(idxs, dtype=np.int32)]
-                else:
-                    sig.append(None)
-                    self.stats["eager_gathers"] += 1
-                    call_args.append(grouped_stack(
-                        self._jax.numpy, ents, bucket))
-            if sig and all(s in ("bcast", "bidx") for s in sig):
-                # degenerate wave (every flow shared): vmap needs one
-                # mapped axis — demote flow 0 to a per-lane form
-                if sig[0] == "bidx":
-                    sig[0] = "idx"
-                    call_args[1] = np.full((bucket,),
-                                           int(call_args[1]), np.int32)
-                else:
-                    sig[0] = None
-                    call_args[0] = self._jax.numpy.stack(
-                        [call_args[0]] * bucket)
+            sig, call_args = self._wave_sig_args(body, views, bucket)
             # speculative epilogue: if one lane feeds the next dst-class
             # task, compute the dst kernel on it inside the same program
             epi = body.epilogue
@@ -2166,6 +2242,9 @@ class TpuDevice:
             self.ctx.task_complete(t)
 
     def _dispatch_one(self, body, task):
+        fz = self._fuser
+        if fz is not None and fz.consume(body, task):
+            return  # completed from a parked chain result: no launch
         self._prof(0, body, 1)
         try:
             self._dispatch_one_run(body, task)

@@ -217,12 +217,23 @@ def build_flash_attention(ctx, Qc, Kc, Vc, Oc, causal: bool = False,
 
     if dev is not None:
         def k_fatt(qb, kb, vb, _q=None):
-            # [T, d] block through the fused kernel as [1, T, 1, d]
-            o = flash_attention(qb[None, :, None, :],
-                                kb[None, :, None, :],
-                                vb[None, :, None, :],
-                                causal=False, scale=sc)
-            return o[0, :, 0, :]
+            if qb.shape[0] == kb.shape[0]:
+                # [T, d] block through the fused kernel as [1, T, 1, d]
+                o = flash_attention(qb[None, :, None, :],
+                                    kb[None, :, None, :],
+                                    vb[None, :, None, :],
+                                    causal=False, scale=sc)
+                return o[0, :, 0, :]
+            # T != L (a multi-block Q attending the full K/V tile): the
+            # fused kernel's internal reshape assumes square self-
+            # attention, so the blockwise softmax runs directly — the
+            # same op order as the CPU reference body
+            import jax.numpy as jnp
+            s = (qb @ kb.T) * sc
+            s = s - s.max(axis=-1, keepdims=True)
+            p = jnp.exp(s)
+            p = p / p.sum(axis=-1, keepdims=True)
+            return (p @ vb).astype(qb.dtype)
 
         if causal:
             raise ValueError(

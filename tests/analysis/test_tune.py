@@ -53,6 +53,54 @@ def test_simulator_deterministic_and_monotone():
     assert big["makespan_ns"] < small["makespan_ns"]
 
 
+def test_simulator_wave_fuse_pricing():
+    """ptc-fuse satellite: a certified fusable device wave is charged
+    ONE dispatch overhead when the wave_fuse knob is on (per-task share
+    1/width), so the simulated makespan drops vs wave_fuse=0 — and both
+    prices are bit-deterministic.  The knob axis only opens when a
+    certified wave exists for the compiler to fuse."""
+    with pt.Context(nb_workers=1) as ctx:
+        ctx.register_arena("t", 64)
+        tp = pt.Taskpool(ctx, globals={"NB": 7, "KT": 3})
+        k, b = pt.L("k"), pt.L("b")
+        tc = tp.task_class("Fan")
+        tc.param("b", 0, pt.G("NB"))
+        tc.param("k", 0, pt.G("KT"))
+        tc.flow("A", "RW",
+                pt.In(None, guard=(k == 0)),
+                pt.In(pt.Ref("Fan", b, k - 1, flow="A")),
+                pt.Out(pt.Ref("Fan", b, k + 1, flow="A"),
+                       guard=(k < pt.G("KT"))),
+                arena="t")
+        tc.body_device(0)
+        plan = tp.plan()
+    assert plan.fusable_waves() > 0
+    sim = ScheduleSimulator(plan, workers=2)
+    assert sim.fused_width, "certified fusable device waves expected"
+    assert sim.knob_axes()["device.wave_fuse"] == [True, False]
+    on = sim.simulate({"device.wave_fuse": True})
+    off = sim.simulate({"device.wave_fuse": False})
+    assert on == sim.simulate({"device.wave_fuse": True})
+    assert off == sim.simulate({"device.wave_fuse": False})
+    assert on["makespan_ns"] < off["makespan_ns"]
+
+
+def test_simulator_wave_fuse_axis_closed_without_certificates():
+    """No device chores -> no wave to fuse -> the axis stays collapsed
+    at the incumbent value (the search space must not grow for graphs
+    the compiler cannot touch)."""
+    with pt.Context(nb_workers=1) as ctx:
+        _A, tp = _potrf(ctx)
+        plan = tp.plan()
+    sim = ScheduleSimulator(plan, workers=2)
+    assert not sim.fused_width
+    axes = sim.knob_axes()
+    assert axes["device.wave_fuse"] == [default_knobs()["device.wave_fuse"]]
+    # pricing is inert: on == off when nothing is fusable
+    assert sim.simulate({"device.wave_fuse": True}) == \
+        sim.simulate({"device.wave_fuse": False})
+
+
 def test_simulator_workers_scale_work_bound():
     """A wide wave on 1 worker serializes; on 8 workers the simulated
     makespan drops toward the critical path."""

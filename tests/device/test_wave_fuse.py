@@ -1,0 +1,309 @@
+"""ptc-fuse: wave mega-kernelization — bit-exactness matrix, chain
+launch economics, refusal accounting, and the ready-front census.
+
+The acceptance contract: `device.wave_fuse=0` reproduces the PR 12
+per-group batched dispatch bit-exactly, the fused path matches it
+bit-for-bit on every in-tree graph with certified fusable waves
+(PLAN_graphs.json records 35), chained waves complete from parked
+results with zero launches, and every non-fused dispatch is counted by
+reason — never a silent fallback.
+"""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.data.collections import TwoDimBlockCyclic
+from parsec_tpu.device import TpuDevice
+from parsec_tpu.utils import params as _mca
+
+
+def _with_fuse(flag, fn):
+    _mca.set("device.wave_fuse", bool(flag))
+    try:
+        return fn()
+    finally:
+        _mca.unset("device.wave_fuse")
+
+
+def _spd(n, rng):
+    x = rng.standard_normal((n, n)).astype(np.float64)
+    return (x @ x.T + n * np.eye(n)).astype(np.float32)
+
+
+# ------------------------------------------------------------ chains
+def _gemm_run(N=64, nb=16, K=128):
+    rng = np.random.default_rng(7)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, K, nb, nb, dtype=np.float32)
+        B = TwoDimBlockCyclic(K, N, nb, nb, dtype=np.float32)
+        C = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(rng.standard_normal((N, K), dtype=np.float32))
+        B.from_dense(rng.standard_normal((K, N), dtype=np.float32))
+        C.from_dense(np.zeros((N, N), np.float32))
+        A.register(ctx, "A")
+        B.register(ctx, "B")
+        C.register(ctx, "C")
+        from parsec_tpu.algos.gemm import build_gemm
+        ctx.profile_enable(1)
+        dev = TpuDevice(ctx)
+        dev.batch_wait_ms = 2.0  # coalesce whole waves per pop
+        tp = build_gemm(ctx, A, B, C, dev=dev)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        ev = ctx.profile_take()
+        st = ctx.device_stats()
+        dev.stop()
+        out = C.to_dense().copy()
+    from parsec_tpu.profiling.trace import KEY_DEVICE
+    launches = int((ev[:, 0] == KEY_DEVICE).sum()) // 2
+    return out, st["fuse"], launches
+
+
+def test_gemm_chain_fused_bit_identical_fewer_launches():
+    """The headline: a deep-k GEMM's certified wave chain compiles into
+    one executable per segment; downstream waves complete from parked
+    results (chain_hits) with zero launches, bit-identical to the
+    unfused path and >= 4x fewer DEVICE launches on this tiling."""
+    c1, fs1, n1 = _with_fuse(True, _gemm_run)
+    c0, fs0, n0 = _with_fuse(False, _gemm_run)
+    assert c1.tobytes() == c0.tobytes()
+    assert fs1["fused_waves"] > 0
+    assert fs1["fused_chains"] > 0
+    assert fs1["chain_hits"] > 0
+    assert fs1["chain_misses"] == 0
+    # wave_fuse=0 is the PR 12 path: the compiler never runs
+    assert fs0["enabled"] is False
+    assert fs0["fused_waves"] == 0 and fs0["chain_hits"] == 0
+    # 8 waves -> 1 chained launch in the clean case; partial wave pops
+    # under an oversubscribed box can split a segment, so the gate is
+    # 3x (the bench's oversubscription-slacked rows carry the 5x gate)
+    assert n1 * 3 <= n0, (n1, n0)
+
+
+def test_chain_parked_results_version_checked():
+    """Parked speculation pins: every parked record is consumed (or
+    missed) by the end of the run — the parked count drains to zero
+    and the residency pin with it."""
+    def run():
+        rng = np.random.default_rng(3)
+        with pt.Context(nb_workers=2) as ctx:
+            A = TwoDimBlockCyclic(32, 64, 16, 16, dtype=np.float32)
+            B = TwoDimBlockCyclic(64, 32, 16, 16, dtype=np.float32)
+            C = TwoDimBlockCyclic(32, 32, 16, 16, dtype=np.float32)
+            for coll, nm, shape in ((A, "A", (32, 64)),
+                                    (B, "B", (64, 32)),
+                                    (C, "C", (32, 32))):
+                coll.from_dense(
+                    rng.standard_normal(shape).astype(np.float32))
+                coll.register(ctx, nm)
+            from parsec_tpu.algos.gemm import build_gemm
+            dev = TpuDevice(ctx)
+            dev.batch_wait_ms = 2.0
+            tp = build_gemm(ctx, A, B, C, dev=dev)
+            tp.run()
+            tp.wait()
+            dev.flush()
+            st = ctx.device_stats()["fuse"]
+            pinned = dev._chain_pinned
+            dev.stop()
+        return st, pinned
+
+    st, pinned = _with_fuse(True, run)
+    assert st["chain_parked"] == st["chain_hits"] + st["chain_misses"] \
+        + st["chain_drops"] + st["parked"]
+    assert st["parked"] == 0  # everything consumed by pool completion
+    assert pinned == 0
+
+
+# -------------------------------------------------- bit-exact matrix
+def _potrf_run(N=128, nb=8):
+    """potrf at the NT=16 tiling (816 instances; 12 certified fusable
+    waves in PLAN_graphs.json)."""
+    rng = np.random.default_rng(11)
+    M = _spd(N, rng)
+    with pt.Context(nb_workers=2) as ctx:
+        A = TwoDimBlockCyclic(N, N, nb, nb, dtype=np.float32)
+        A.from_dense(M)
+        A.register(ctx, "A")
+        from parsec_tpu.algos import build_potrf
+        dev = TpuDevice(ctx)
+        dev.batch_wait_ms = 2.0
+        tp = build_potrf(ctx, A, dev=dev)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        st = ctx.device_stats()["fuse"]
+        dev.stop()
+        out = np.tril(A.to_dense()).copy()
+    return out, st
+
+
+def _rms_norm_run(R=6, T=8, d=16):
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(R * T, d)).astype(np.float32)
+    w = rng.normal(size=(1, d)).astype(np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        Xc = TwoDimBlockCyclic(R * T, d, T, d, dtype=np.float32)
+        Wc = TwoDimBlockCyclic(1, d, 1, d, dtype=np.float32)
+        Oc = TwoDimBlockCyclic(R * T, d, T, d, dtype=np.float32)
+        from parsec_tpu.ops.rms_norm import build_rms_norm
+        dev = TpuDevice(ctx)
+        dev.batch_wait_ms = 2.0
+        tp = build_rms_norm(ctx, Xc, Wc, Oc, dev=dev)
+        Xc.from_dense(x)
+        Wc.from_dense(w)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        st = ctx.device_stats()["fuse"]
+        dev.stop()
+        out = Oc.to_dense().copy()
+    return out, st
+
+
+def _flash_attention_run(NQ=6, T=8, d=16):
+    rng = np.random.default_rng(6)
+    L = NQ * T
+    q = rng.normal(size=(L, d)).astype(np.float32)
+    k = rng.normal(size=(L, d)).astype(np.float32)
+    v = rng.normal(size=(L, d)).astype(np.float32)
+    with pt.Context(nb_workers=2) as ctx:
+        Qc = TwoDimBlockCyclic(L, d, T, d, dtype=np.float32)
+        Kc = TwoDimBlockCyclic(L, d, L, d, dtype=np.float32)
+        Vc = TwoDimBlockCyclic(L, d, L, d, dtype=np.float32)
+        Oc = TwoDimBlockCyclic(L, d, T, d, dtype=np.float32)
+        from parsec_tpu.ops.flash_attention import build_flash_attention
+        dev = TpuDevice(ctx)
+        dev.batch_wait_ms = 2.0
+        tp = build_flash_attention(ctx, Qc, Kc, Vc, Oc, dev=dev)
+        Qc.from_dense(q)
+        Kc.from_dense(k)
+        Vc.from_dense(v)
+        tp.run()
+        tp.wait()
+        dev.flush()
+        st = ctx.device_stats()["fuse"]
+        dev.stop()
+        out = Oc.to_dense().copy()
+    return out, st
+
+
+@pytest.mark.parametrize("runner", [_potrf_run, _rms_norm_run,
+                                    _flash_attention_run],
+                         ids=["potrf_nt16", "rms_norm",
+                              "flash_attention"])
+def test_bit_exactness_matrix(runner):
+    """Fused vs device.wave_fuse=0 bit-identical on every graph with
+    certified fusable waves, with fused_waves > 0 asserted (the PR 12
+    path never sees the compiler)."""
+    out1, st1 = _with_fuse(True, runner)
+    out0, st0 = _with_fuse(False, runner)
+    assert out1.tobytes() == out0.tobytes()
+    assert st1["fused_waves"] > 0, st1
+    assert st0["enabled"] is False and st0["fused_waves"] == 0
+
+
+# ---------------------------------------------------------- refusals
+def test_fuse_refused_by_reason_no_silent_fallback():
+    """A vmap-incompatible (batch=False) class refuses with an
+    explicit reason in the by-reason export — mirroring certify()'s
+    refuse records."""
+    def run():
+        with pt.Context(nb_workers=2) as ctx:
+            src = np.arange(8 * 32, dtype=np.float32).reshape(8, 32)
+            dst = np.zeros_like(src)
+            tb = 32 * 4
+            ctx.register_linear_collection("T", src, elem_size=tb)
+            ctx.register_linear_collection("O", dst, elem_size=tb)
+            ctx.register_arena("t", tb)
+            dev = TpuDevice(ctx, autostart=False)
+            dev.batch_wait_ms = 5.0
+            dev.start()
+            tp = pt.Taskpool(ctx, globals={"NT": 7})
+            kv = pt.L("k")
+            tc = tp.task_class("Raw")
+            tc.param("k", 0, pt.G("NT"))
+            tc.flow("X", "R", pt.In(pt.Mem("T", kv)), arena="t")
+            tc.flow("Y", "RW", pt.In(pt.Mem("O", kv)),
+                    pt.Out(pt.Mem("O", kv)), arena="t")
+            dev.attach(tc, tp, kernel=lambda x, y: x + y,
+                       reads=["X", "Y"], writes=["Y"],
+                       shapes={"X": (32,), "Y": (32,)},
+                       dtype=np.float32, batch=False)
+            tp.run()
+            tp.wait()
+            dev.flush()
+            st = ctx.device_stats()["fuse"]
+            dev.stop()
+        return st
+
+    st = _with_fuse(True, run)
+    assert st["refused"].get("unbatchable-body", 0) > 0, st
+
+
+def test_wave_fuse_off_exports_zero_schema():
+    """Knob off: the compiler never attaches, yet the stats schema
+    stays stable (zeros + enabled False) for exporter consumers."""
+    def run():
+        with pt.Context(nb_workers=1) as ctx:
+            dev = TpuDevice(ctx)
+            st = ctx.device_stats()["fuse"]
+            dev.stop()
+        return st
+
+    st = _with_fuse(False, run)
+    assert st["enabled"] is False
+    for k in ("fused_waves", "fused_tasks", "fused_chains",
+              "chain_hits", "chain_misses", "cache_hits",
+              "cache_misses", "parked"):
+        assert st[k] == 0, (k, st)
+    assert st["refused"] == {}
+
+
+# ----------------------------------------------------- 2-rank matrix
+def test_gemm_dist_2rank_fused_bit_identical():
+    """Distributed leg of the bit-exactness matrix: 2-rank gemm_dist
+    fused vs device.wave_fuse=0, owned tiles bitwise-identical, with
+    fused waves certified on the fused pass (see the worker)."""
+    from tests.comm import _workers
+    from tests.comm.test_multirank import _run_spmd
+    _run_spmd(_workers.gemm_dist_wave_fuse, 2, timeout=300.0)
+
+
+# ------------------------------------------------------ front census
+def test_device_peek_front_census():
+    """The wave-granular native census: queued device tasks report
+    their class ids without popping or pinning anything."""
+    with pt.Context(nb_workers=2) as ctx:
+        src = np.arange(6 * 16, dtype=np.float32).reshape(6, 16)
+        tb = 16 * 4
+        ctx.register_linear_collection("T", src, elem_size=tb)
+        ctx.register_arena("t", tb)
+        dev = TpuDevice(ctx, autostart=False)  # queue fills, no drain
+        tp = pt.Taskpool(ctx, globals={"NT": 5})
+        kv = pt.L("k")
+        tc = tp.task_class("Census")
+        tc.param("k", 0, pt.G("NT"))
+        tc.flow("X", "RW", pt.In(pt.Mem("T", kv)),
+                pt.Out(pt.Mem("T", kv)), arena="t")
+        dev.attach(tc, tp, kernel=lambda x: x * 2.0, reads=["X"],
+                   writes=["X"], shapes={"X": (16,)}, dtype=np.float32)
+        tp.run()
+        import time
+        deadline = time.time() + 10.0
+        front = []
+        while time.time() < deadline:
+            front = ctx.device_peek_front(dev.qid)
+            if len(front) == 6:
+                break
+            time.sleep(0.01)
+        assert len(front) == 6, front
+        assert {cid for cid, _tp in front} == {tc.id}
+        assert {tpp for _cid, tpp in front} == {tp._ptr}
+        dev.start()  # drain so the pool completes
+        tp.wait()
+        dev.flush()
+        np.testing.assert_allclose(
+            src, np.arange(6 * 16, dtype=np.float32).reshape(6, 16) * 2)
+        dev.stop()

@@ -53,6 +53,18 @@ CHECKS = [
      "higher", 0.15, True),
     ("BENCH_device.json", "out_of_core_gemm.correct", "equal", 0.0,
      False),
+    # ptc-fuse (PR 13): wave mega-kernelization launch economics —
+    # launches/task and the fused-vs-unfused launch ratio are
+    # trajectory rows (timing-sensitive: partial wave pops under
+    # oversubscription split launches, so the slack convention
+    # applies); the fused-vs-unfused bit-exactness verdict is a
+    # correctness flag, never relaxed
+    ("BENCH_device.json", "wave_fuse.launches_per_task", "lower", 0.50,
+     True),
+    ("BENCH_device.json", "wave_fuse.fused_vs_unfused_ratio", "higher",
+     0.35, True),
+    ("BENCH_device.json", "wave_fuse.bit_identical", "equal", 0.0,
+     False),
     # serving runtime (PR 9): hi-tenant p99 improvement over the no-QoS
     # control is timing (trajectory-guarded, oversubscription-slacked);
     # the in-document beats-control verdict and the continuous-vs-

@@ -414,6 +414,89 @@ def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
                 os.environ[k] = v
 
 
+def wave_fuse_gemm(workers: int, port: int, N: int = 32, nb: int = 8,
+                   env=None) -> None:
+    """ptc-fuse under TSan: two colocated ranks (a thread per rank) run
+    a distributed GEMM with the WAVE COMPILER ON over the streamed wire
+    — the fuse cache and online certification on each device manager
+    thread, the prefetch lane's peeks/hint staging, and the comm
+    threads' deliveries all race in one TSan-observed address space.
+    The chain path legitimately refuses on gemm_dist (task-sourced
+    panels); the certification + counter paths are what this job
+    drives concurrently with wire deliveries."""
+    import threading
+
+    env = dict(env or {})
+    env.setdefault("PTC_MCA_device_wave_fuse", "1")
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    errs = []
+
+    def rank_prog(rank):
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            from parsec_tpu.algos.gemm import build_gemm_dist
+            from parsec_tpu.data.collections import TwoDimBlockCyclic
+            from parsec_tpu.device.tpu import TpuDevice
+
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            with ctx:
+                rng = np.random.default_rng(3)
+                a = rng.normal(size=(N, N)).astype(np.float32)
+                b = rng.normal(size=(N, N)).astype(np.float32)
+                c0 = rng.normal(size=(N, N)).astype(np.float32)
+                mk = lambda: TwoDimBlockCyclic(
+                    N, N, nb, nb, P=2, Q=1, nodes=2, myrank=rank,
+                    dtype=np.float32)
+                A, B, C = mk(), mk(), mk()
+                A.register(ctx, "A"); A.from_dense(a)
+                B.register(ctx, "B"); B.from_dense(b)
+                C.register(ctx, "C"); C.from_dense(c0)
+                dev = TpuDevice(ctx)
+                tp = build_gemm_dist(ctx, A, B, C, dev=dev)
+                tp.run()
+                tp.wait()
+                ctx.comm_fence()
+                dev.flush()
+                ref = c0.astype(np.float64) + a.astype(np.float64) \
+                    @ b.astype(np.float64)
+                nt = C.mt
+                for m in range(nt):
+                    for n in range(nt):
+                        if C.rank_of(m, n) != rank:
+                            continue
+                        lo = np.abs(
+                            C.tile(m, n)
+                            - ref[m * nb:(m + 1) * nb,
+                                  n * nb:(n + 1) * nb]).max()
+                        assert lo < 2e-3, (m, n, lo)
+                dev.stop()
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=600)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
     """Concurrent consumers of the same (copy, [type]) — the memoized
     reshape cache's create/hit race — plus write-back version bumps that
@@ -511,6 +594,13 @@ def main():
         # serving runtime (PR 9): QoS lanes + concurrent pool
         # creation/retirement + admission churn under a 2-rank context
         serve_churn(workers=4, port=30020 + rep)
+        # wave mega-kernelization (PR 13): fuse cache + online
+        # certification on the device manager threads, prefetch-lane
+        # peeks, and streamed wire deliveries, 2 colocated ranks
+        wave_fuse_gemm(workers=2, port=30040 + rep,
+                       env={"PTC_MCA_comm_eager_limit": "0",
+                            "PTC_MCA_comm_chunk_size": "2048",
+                            "PTC_MCA_comm_rails": "2"})
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")
 
