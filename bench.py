@@ -1798,6 +1798,13 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
         # relaxed by oversubscription; the latency/rate rows are
         # trajectory-guarded timing
         "scope": _scope_bench_section(qos_scope),
+        # ptc-share: shared-prefix KV cache (cold vs warm prompt mix)
+        # and speculative decoding (off / k=2 / k=4 + the fused verify
+        # wave) — the bit_identical flags are equal-direction
+        # correctness rows bench_check NEVER relaxes; hit-rate and
+        # tokens/s are oversubscription-slacked timing trajectory rows
+        "prefix": _prefix_bench_section(model, workers=workers),
+        "spec": _spec_bench_section(model, workers=workers),
     })
     if oversub:
         doc["caveat"] = (
@@ -1835,6 +1842,168 @@ def _scope_bench_section(scope_st):
             "sound": sound,
         },
     }
+
+
+def _prefix_bench_section(model, workers=2, groups=4, per_group=4,
+                          seed=17):
+    """ptc-share prefix-cache section: `groups` distinct 4-page common
+    prefixes are seeded cold (freezing their pages), then a WARM mix of
+    `groups * per_group` requests re-using them runs on the live cache
+    vs the identical mix on a cache-OFF control engine.  Records the
+    warm hit rate, pages prefilled warm vs cold (the fewer-prefill-
+    waves evidence) and warm vs no-cache tokens/s; `bit_identical`
+    compares warm outputs against the control AND the numpy oracle."""
+    from parsec_tpu.serve import InferenceEngine, TenantConfig
+
+    cfg = model.cfg
+    rng = np.random.RandomState(seed)
+    common = [list(rng.randint(0, cfg.vocab, size=4 * cfg.page))
+              for _ in range(groups)]
+    seeds = [(c, 3, "t") for c in common]
+    warm_reqs = []
+    for g in range(groups):
+        for _ in range(per_group):
+            tail = list(rng.randint(0, cfg.vocab,
+                                    size=int(rng.randint(0, 4))))
+            warm_reqs.append((common[g] + tail, 5, "t"))
+
+    def run_mix(prefix_cache):
+        with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+            eng = InferenceEngine(
+                ctx, model, n_pages=512, max_seqs=64,
+                tenants=[TenantConfig("t", max_pools=64, max_queue=256)],
+                prefix_cache=prefix_cache)
+            hs0 = [eng.submit(p, n, t) for p, n, t in seeds]
+            eng.run(timeout_s=300)
+            st0 = eng.pool.stats()
+            t0 = time.perf_counter()
+            hs = [eng.submit(p, n, t) for p, n, t in warm_reqs]
+            eng.run(timeout_s=300)
+            wall = time.perf_counter() - t0
+            st = eng.pool.stats()
+            eng.close()
+        assert all(h.state == "done" for h in hs0 + hs)
+        tokens = sum(len(h.generated) for h in hs)
+        outs = [(h.tokens, np.stack(h.outputs)) for h in hs]
+        return {
+            "hits": st["prefix_hits"] - st0["prefix_hits"],
+            "misses": st["prefix_misses"] - st0["prefix_misses"],
+            "shared_bytes": st["shared_bytes"],
+            "cow_copies": st["cow_copies"],
+            "tokens_per_s": round(tokens / wall, 1),
+            "wall_s": round(wall, 3),
+        }, outs
+
+    warm_doc, warm_outs = run_mix(True)
+    ctl_doc, ctl_outs = run_mix(False)
+    bit_identical = True
+    for (wt, wo), (ct, co), (p, n, _t) in zip(warm_outs, ctl_outs,
+                                              warm_reqs):
+        rt, ro = model.reference_generate(p, n)
+        if wt != rt or ct != rt or not np.array_equal(wo, ro) or \
+                not np.array_equal(co, ro):
+            bit_identical = False
+    hits, misses = warm_doc["hits"], warm_doc["misses"]
+    return {
+        "groups": groups, "per_group": per_group,
+        "hit_rate": round(hits / max(1, hits + misses), 4),
+        "pages_prefilled_warm": misses,
+        "pages_prefilled_cold": ctl_doc["hits"] + ctl_doc["misses"],
+        "fewer_prefill_than_cold": bool(
+            misses < ctl_doc["hits"] + ctl_doc["misses"]),
+        "shared_bytes": warm_doc["shared_bytes"],
+        "cow_copies": warm_doc["cow_copies"],
+        "warm_tokens_per_s": warm_doc["tokens_per_s"],
+        "nocache_tokens_per_s": ctl_doc["tokens_per_s"],
+        "bit_identical": bit_identical,
+    }
+
+
+def _spec_bench_section(model, workers=2, n_reqs=8, max_new=8, seed=23):
+    """ptc-share speculative-decoding section: the SAME request mix
+    decodes with speculation OFF and at k=2 / k=4 (oracle self-draft —
+    the acceptance upper bound), recording tokens/s, verify waves vs
+    tokens (the fewer-waves evidence) and draft acceptance;
+    `bit_identical` compares every speculative output stream against
+    the non-speculative run.  `verify_wave` runs one device-attached
+    k=4 mix and counts paired DEVICE spans: the batched verification's
+    VATF waves dispatch FUSED (begin-aux marked) — launches well under
+    task count."""
+    from parsec_tpu.profiling.trace import KEY_DEVICE
+    from parsec_tpu.serve import InferenceEngine, TenantConfig
+
+    cfg = model.cfg
+    rng = np.random.RandomState(seed)
+    reqs = [(list(rng.randint(0, cfg.vocab,
+                              size=int(rng.randint(6, 18)))),
+             max_new, "t") for _ in range(n_reqs)]
+
+    def run_k(k, dev=False, trace=False):
+        with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+            if trace:
+                ctx.profile_enable(1)
+            dev_obj = None
+            if dev:
+                from parsec_tpu.device import TpuDevice
+                dev_obj = TpuDevice(ctx)
+            try:
+                eng = InferenceEngine(
+                    ctx, model, n_pages=512, max_seqs=32,
+                    tenants=[TenantConfig("t", max_pools=32,
+                                          max_queue=256)],
+                    spec_k=k, dev=dev_obj)
+                t0 = time.perf_counter()
+                hs = [eng.submit(p, n, t) for p, n, t in reqs]
+                eng.run(timeout_s=300)
+                wall = time.perf_counter() - t0
+                st = dict(eng.stats)
+                serve_spec = eng._spec_stats()
+                fuse = ctx.device_stats().get("fuse", {}) if dev else {}
+                ev = ctx.profile_take() if trace else None
+                eng.close()
+            finally:
+                if dev_obj is not None:
+                    dev_obj.stop()
+        assert all(h.state == "done" for h in hs)
+        tokens = sum(len(h.generated) for h in hs)
+        return {
+            "tokens": tokens,
+            "tokens_per_s": round(tokens / wall, 1),
+            "decode_waves": st["decode_pools"],
+            "accept_rate": round(serve_spec["accept_rate"], 4),
+            "fallbacks": st["spec_fallbacks"],
+        }, [(h.tokens, np.stack(h.outputs)) for h in hs], fuse, ev
+
+    base, base_outs, _, _ = run_k(0)
+    out = {"off": base}
+    bit_identical = True
+    for k in (2, 4):
+        doc, outs, _, _ = run_k(k)
+        for (st_, so), (bt, bo) in zip(outs, base_outs):
+            if st_ != bt or not np.array_equal(so, bo):
+                bit_identical = False
+        doc["waves_vs_tokens"] = round(
+            doc["decode_waves"] / max(1, doc["tokens"]), 3)
+        out[f"k{k}"] = doc
+    out["bit_identical"] = bit_identical
+    out["fewer_waves_than_off"] = bool(
+        out["k4"]["decode_waves"] < base["decode_waves"])
+    # fused verify wave: DEVICE span evidence (device folds = VATF
+    # verification only; PATTL/VATL run host-side)
+    vdoc, _, fuse, ev = run_k(4, dev=True, trace=True)
+    spans = _pair_spans(ev, KEY_DEVICE) if ev is not None else []
+    fused_marked = sum(1 for s in spans if s[4] > 0)
+    out["verify_wave"] = {
+        "device_launches": len(spans),
+        "fused_marked_launches": fused_marked,
+        "fused_waves": fuse.get("fused_waves", 0),
+        "fused_tasks": fuse.get("fused_tasks", 0),
+        "single_fused_launch": bool(
+            fuse.get("fused_waves", 0) > 0 and
+            fuse.get("fused_tasks", 0) > fuse.get("fused_waves", 0)),
+        "tokens_per_s": vdoc["tokens_per_s"],
+    }
+    return out
 
 
 def _arg_after(flag, default):

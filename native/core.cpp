@@ -194,7 +194,11 @@ Arena::~Arena() {
 /* ------------------------------------------------------------------ */
 
 ptc_context::~ptc_context() {
-  for (auto *c : collections) delete c;
+  {
+    Collection **t = collections.tab.load(std::memory_order_relaxed);
+    int32_t n = collections.count.load(std::memory_order_relaxed);
+    for (int32_t i = 0; i < n; i++) delete t[i];
+  }
   {
     Arena **t = arena_tab.load(std::memory_order_relaxed);
     int32_t n = arena_count.load(std::memory_order_relaxed);
@@ -3639,14 +3643,12 @@ int32_t ptc_worker_binding(ptc_context_t *ctx, int32_t worker) {
 
 int32_t ptc_register_expr_cb(ptc_context_t *ctx, ptc_expr_cb cb, void *user) {
   std::lock_guard<std::mutex> g(ctx->reg_lock);
-  ctx->expr_cbs.push_back({cb, user});
-  return (int32_t)ctx->expr_cbs.size() - 1;
+  return ctx->expr_cbs.push({cb, user});
 }
 
 int32_t ptc_register_body(ptc_context_t *ctx, ptc_body_cb cb, void *user) {
   std::lock_guard<std::mutex> g(ctx->reg_lock);
-  ctx->body_cbs.push_back({cb, user});
-  return (int32_t)ctx->body_cbs.size() - 1;
+  return ctx->body_cbs.push({cb, user});
 }
 
 int32_t ptc_register_collection(ptc_context_t *ctx, uint32_t nodes,
@@ -3659,8 +3661,7 @@ int32_t ptc_register_collection(ptc_context_t *ctx, uint32_t nodes,
   dc->rank_of = rank_of;
   dc->data_of = data_of;
   dc->user = user;
-  ctx->collections.push_back(dc);
-  return (int32_t)ctx->collections.size() - 1;
+  return ctx->collections.push(dc);
 }
 
 int32_t ptc_register_linear_collection(ptc_context_t *ctx, uint32_t nodes,
@@ -3674,21 +3675,20 @@ int32_t ptc_register_linear_collection(ptc_context_t *ctx, uint32_t nodes,
   dc->base = (char *)base;
   dc->nb_elems = nb_elems;
   dc->elem_size = elem_size;
-  ctx->collections.push_back(dc);
-  return (int32_t)ctx->collections.size() - 1;
+  return ctx->collections.push(dc);
 }
 
 /* tool access to a registered collection's vtable (ptg_to_dtd, dumps) */
 ptc_data_t *ptc_dc_data_of(ptc_context_t *ctx, int32_t dc_id,
                            const int64_t *idx, int32_t n) {
-  if (!ctx || dc_id < 0 || (size_t)dc_id >= ctx->collections.size())
+  if (!ctx || dc_id < 0 || dc_id >= ctx->collections.size())
     return nullptr;
   return ptc_collection_data_of(ctx, dc_id, idx, n);
 }
 
 int32_t ptc_dc_rank_of(ptc_context_t *ctx, int32_t dc_id,
                        const int64_t *idx, int32_t n) {
-  if (!ctx || dc_id < 0 || (size_t)dc_id >= ctx->collections.size())
+  if (!ctx || dc_id < 0 || dc_id >= ctx->collections.size())
     return 0;
   return (int32_t)ptc_collection_rank_of(ctx, dc_id, idx, n);
 }

@@ -132,6 +132,46 @@ struct ExprCb {
   void *user;
 };
 
+/* lock-free-read registry (the PR 6 arena-table idiom, templated):
+ * readers acquire-load the published table and index it with ids
+ * handed out by registration; writers (reg_lock held) publish
+ * slot-then-count and grow by TABLE REPLACEMENT, retiring every old
+ * table until teardown so a reader holding a stale pointer never
+ * dangles.  Registration stays open for the context's life: the
+ * serving stack registers pt.call lookup tables and KV-page
+ * collections from submitter/pump threads while admitted pools
+ * execute — a plain vector's push_back realloc would move the
+ * elements under the OP_CALL / body-dispatch readers (TSan-caught
+ * by the ptc-share prefix/speculation churn). */
+template <typename T> struct PubReg {
+  std::atomic<T *> tab{nullptr};
+  std::atomic<int32_t> count{0};
+  int32_t cap = 0;         /* writer-side, under reg_lock */
+  std::vector<T *> tables; /* every table ever published */
+  int32_t push(T v) {      /* caller holds reg_lock */
+    int32_t n = count.load(std::memory_order_relaxed);
+    if (n == cap) {
+      int32_t nc = cap ? cap * 2 : 16;
+      T *nt = new T[nc];
+      T *ot = tab.load(std::memory_order_relaxed);
+      for (int32_t i = 0; i < n; i++) nt[i] = ot[i];
+      tables.push_back(nt);
+      tab.store(nt, std::memory_order_release);
+      cap = nc;
+    }
+    tab.load(std::memory_order_relaxed)[n] = v;
+    count.store(n + 1, std::memory_order_release);
+    return n;
+  }
+  T &operator[](size_t i) {
+    return tab.load(std::memory_order_acquire)[i];
+  }
+  int32_t size() const { return count.load(std::memory_order_acquire); }
+  ~PubReg() {
+    for (T *t : tables) delete[] t;
+  }
+};
+
 /* ------------------------------------------------------------------ */
 /* data                                                                */
 /* ------------------------------------------------------------------ */
@@ -882,10 +922,13 @@ struct ptc_context {
   ptc_condvar idle_cv;
   std::atomic<int64_t> work_signal{0};
 
-  /* registries */
-  std::vector<ExprCb> expr_cbs;
-  std::vector<BodyCb> body_cbs;
-  std::vector<Collection *> collections;
+  /* registries: lock-free readers (OP_CALL evaluation, body dispatch,
+   * collection vtable lookups on workers and the comm thread) against
+   * registration that stays open for the context's life — grow-only
+   * published tables, same discipline as the arena registry below */
+  PubReg<ExprCb> expr_cbs;
+  PubReg<BodyCb> body_cbs;
+  PubReg<Collection *> collections;
   /* arena registry: lock-free reads on the copy-release / comm sizing
    * hot paths while registration stays OPEN for the context's life
    * (runtime-native collectives register one arena per op with comm

@@ -222,13 +222,27 @@ class Plan:
             return 0
         return max(r[key] for r in rows)
 
-    def est_bytes(self) -> Optional[int]:
+    def est_bytes(self, discount_bytes: int = 0) -> Optional[int]:
         """Admission-control byte estimate: the pool's global working
         set (sum of per-rank peaks — every rank holds its own mirrors).
-        None only when the symbolic fallback could not bound it."""
+        None only when the symbolic fallback could not bound it.
+
+        `discount_bytes` subtracts working-set bytes the caller knows
+        are ALREADY resident and shared (ptc-share: prompt pages
+        predicted to map onto frozen prefix-cache pages cost admission
+        nothing); the estimate never discounts below 1 byte, so a
+        known bound stays distinguishable from the <=0 UNKNOWN
+        sentinel serve admission uses."""
         if self.bounded:
-            return self._symbolic_peak
-        return sum(r["peak_bytes"] for r in self.per_rank.values())
+            total = self._symbolic_peak
+        else:
+            total = sum(r["peak_bytes"] for r in self.per_rank.values())
+        if total is None:
+            return None
+        disc = max(0, int(discount_bytes or 0))
+        if disc and total > 0:
+            total = max(1, total - disc)
+        return total
 
     def comm_bytes(self) -> int:
         return sum(self.edges_bytes.values())

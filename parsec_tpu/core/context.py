@@ -842,6 +842,23 @@ class Context:
     def device_clear_data_owner(self, handle: int, qid: int = -1):
         N.lib.ptc_device_clear_data_owner(self._ptr, handle, qid)
 
+    def host_wrote(self, coll, m: int, n: int = 0):
+        """A caller rewrote a collection tile's HOST bytes directly
+        (numpy, outside the runtime): any device mirror of it is stale
+        and must drop — the copy version cannot tell, no runtime write
+        happened.  The serving engine's prompt/COW staging and the
+        PagePool's copy-on-write clones route through here."""
+        if not self._devices:
+            return
+        d = coll._datas.get((m, n))
+        if d is None:
+            return
+        h = N.lib.ptc_copy_handle(N.lib.ptc_data_host_copy(d._ptr))
+        if h:
+            for dev in list(self._devices):
+                dev._drop_mirror(h)
+            N.lib.ptc_device_clear_data_owner(self._ptr, h, -1)
+
     def device_get_data_owner(self, handle: int):
         """(qid, version) of the stamped mirror owner, or (-1, 0)."""
         ver = C.c_int32(0)

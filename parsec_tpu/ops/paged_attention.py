@@ -28,7 +28,9 @@ check rides on this.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -36,43 +38,266 @@ from ..data.collections import TwoDimBlockCyclic
 
 __all__ = ["PagePool", "SeqSpec", "attend_page", "finalize_attention",
            "build_paged_decode", "build_paged_prefill",
-           "make_slot_collections"]
+           "build_paged_verify", "make_slot_collections"]
 
 
 # ------------------------------------------------------------ page pool
 class PagePool:
-    """Fixed-size KV page pool: two tiled collections (K pages, V pages)
-    of (page, d) tiles plus a free-list allocator.  Pages are ordinary
-    collection tiles — the device residency planner manages them like
-    any other tile, and `bytes_per_page` feeds admission budgets."""
+    """Refcounted copy-on-write KV page pool: two tiled collections
+    (K pages, V pages) of (page, d) tiles plus a free-list allocator.
+    Pages are ordinary collection tiles — the device residency planner
+    manages them like any other tile, and `bytes_per_page` feeds
+    admission budgets.
+
+    ptc-share adds prefix sharing à la Ragged Paged Attention
+    (arXiv:2604.15464 — pages are the unit of sharing):
+
+      refcounts     every live page carries a reference count; a page
+                    is handed out again only at refcount 0 (a shared
+                    frozen page can NEVER be evicted under a sharer)
+      frozen index  FULL immutable pages register a content-hash key
+                    (token-id prefix chunk + model id) — `freeze()`;
+                    `acquire_prefix()` maps the longest page-aligned
+                    warm prefix of a new prompt onto existing frozen
+                    pages (refcount++) so only the cold tail prefills
+      cached free   a frozen page released to refcount 0 keeps its
+                    content and index entry on an LRU list; allocation
+                    prefers never-written free pages and only then
+                    evicts cached pages (clean-first — the page is
+                    host-authoritative, dropping it loses no data),
+                    counting `evictions`
+      copy-on-write `make_private()` gives a writer an exclusive page:
+                    the same page with its index entry dropped when
+                    nobody shares it, else a fresh page with the bytes
+                    copied (`cow_copies`) — a sharer's view is never
+                    mutated
+
+    Every operation is ATOMIC under the pool lock: admission's
+    check-and-reserve (`reserve`/`acquire_prefix`) cannot be interleaved
+    with concurrent sequence retirement on the pump thread, so two
+    tenants can no longer both pass a `free_pages` check and
+    oversubscribe the pool."""
 
     def __init__(self, ctx, n_pages: int, page: int, d: int,
                  dtype=np.float32, name: str = "KV"):
         self.n_pages, self.page, self.d = n_pages, page, d
         self.dtype = np.dtype(dtype)
         self.name = name
+        self._ctx = ctx
         self.Kc = TwoDimBlockCyclic(n_pages * page, d, page, d, dtype=dtype)
         self.Vc = TwoDimBlockCyclic(n_pages * page, d, page, d, dtype=dtype)
         self.k_name, self.v_name = f"{name}_K", f"{name}_V"
         self.Kc.register(ctx, self.k_name)
         self.Vc.register(ctx, self.v_name)
+        self._lock = threading.Lock()
         self._free: List[int] = list(range(n_pages - 1, -1, -1))
+        self._refs: List[int] = [0] * n_pages
+        self._index: Dict[object, int] = {}      # content key -> page
+        self._key_of: Dict[int, object] = {}     # page -> content key
+        self._cached: "OrderedDict[int, bool]" = OrderedDict()  # LRU
+        self._counters = {
+            "prefix_hits": 0, "prefix_misses": 0, "shared_bytes": 0,
+            "cow_copies": 0, "evictions": 0, "reserve_fails": 0,
+            "frozen": 0,
+        }
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Pages allocatable right now (never-written free list + the
+        refcount-0 cached frozen pages an allocation may evict)."""
+        with self._lock:
+            return len(self._free) + len(self._cached)
 
     @property
     def bytes_per_page(self) -> int:
         return 2 * self.page * self.d * self.dtype.itemsize
 
+    # ------------------------------------------------------- allocation
+    def _take_free_locked(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._cached:  # evict the LRU cached frozen page (refcount 0)
+            p, _ = self._cached.popitem(last=False)
+            key = self._key_of.pop(p)
+            del self._index[key]
+            self._counters["evictions"] += 1
+            return p
+        return None
+
     def alloc(self) -> Optional[int]:
-        """One free page id, or None (backpressure signal)."""
-        return self._free.pop() if self._free else None
+        """One page at refcount 1, or None (backpressure signal)."""
+        got = self.reserve(1)
+        return got[0] if got else None
+
+    def reserve(self, n: int) -> Optional[List[int]]:
+        """ATOMIC check-and-reserve of `n` pages (each refcount 1) —
+        all or nothing: on shortfall every page taken so far goes back
+        and None returns (the admission backpressure signal)."""
+        with self._lock:
+            got: List[int] = []
+            for _ in range(int(n)):
+                p = self._take_free_locked()
+                if p is None:
+                    for q in got:
+                        self._refs[q] = 0
+                        self._free.append(q)
+                    self._counters["reserve_fails"] += 1
+                    return None
+                self._refs[p] = 1
+                got.append(p)
+            return got
 
     def free(self, pages: Sequence[int]):
-        for p in pages:
-            self._free.append(int(p))
+        """Release one reference per page (see `release`)."""
+        self.release(pages)
+
+    def release(self, pages: Sequence[int]):
+        """Drop one reference per page.  At refcount 0 a frozen
+        (content-indexed) page parks on the cached-free LRU — content
+        preserved for future prefix hits — and an unindexed page goes
+        straight back to the free list."""
+        with self._lock:
+            for p in pages:
+                p = int(p)
+                assert self._refs[p] > 0, f"page {p} over-released"
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    if p in self._key_of:
+                        self._cached[p] = True  # LRU tail (most recent)
+                    else:
+                        self._free.append(p)
+
+    def retain(self, pages: Sequence[int]):
+        """One extra reference per (already-live) page."""
+        with self._lock:
+            for p in pages:
+                assert self._refs[int(p)] > 0
+                self._refs[int(p)] += 1
+
+    def refcount(self, p: int) -> int:
+        with self._lock:
+            return self._refs[int(p)]
+
+    # ---------------------------------------------------- prefix sharing
+    def freeze(self, p: int, key) -> bool:
+        """Register a FULL immutable page under its content key.  First
+        writer wins: a concurrent identical prefill keeps its private
+        copy unindexed (False)."""
+        with self._lock:
+            if key in self._index or int(p) in self._key_of:
+                return False
+            self._index[key] = int(p)
+            self._key_of[int(p)] = key
+            self._counters["frozen"] += 1
+            return True
+
+    def is_frozen(self, p: int) -> bool:
+        with self._lock:
+            return int(p) in self._key_of
+
+    def probe(self, keys: Sequence) -> int:
+        """Longest warm prefix (leading keys present in the index) —
+        NO side effects; admission's predicted-shared-page discount."""
+        with self._lock:
+            n = 0
+            for k in keys:
+                if k not in self._index:
+                    break
+                n += 1
+            return n
+
+    def acquire_prefix(self, keys: Sequence,
+                       n_pages: int) -> Optional[Tuple[List[int], int]]:
+        """ATOMIC admission of an `n_pages` sequence whose leading full
+        pages carry content `keys`: map the longest warm prefix onto
+        existing frozen pages (refcount++) and reserve fresh pages for
+        the cold tail.  Returns (pages, warm_count), or None with every
+        side effect rolled back when the cold tail doesn't fit."""
+        with self._lock:
+            warm: List[int] = []
+            for k in keys:
+                p = self._index.get(k)
+                if p is None:
+                    break
+                warm.append(p)
+            for p in warm:
+                if self._refs[p] == 0:
+                    self._cached.pop(p, None)
+                self._refs[p] += 1
+            cold: List[int] = []
+            for _ in range(n_pages - len(warm)):
+                p = self._take_free_locked()
+                if p is None:
+                    for q in cold:
+                        self._refs[q] = 0
+                        self._free.append(q)
+                    for q in warm:
+                        self._refs[q] -= 1
+                        if self._refs[q] == 0:
+                            self._cached[q] = True
+                    self._counters["reserve_fails"] += 1
+                    return None
+                self._refs[p] = 1
+                cold.append(p)
+            self._counters["prefix_hits"] += len(warm)
+            self._counters["prefix_misses"] += len(cold)
+            self._counters["shared_bytes"] += \
+                len(warm) * self.bytes_per_page
+            return warm + cold, len(warm)
+
+    def make_private(self, p: int) -> Optional[int]:
+        """Exclusive writable view of page `p` for its (sole calling)
+        owner: when nobody else holds it, the page itself with its
+        index entry dropped; otherwise a COPY-ON-WRITE clone — fresh
+        page, bytes copied, caller's reference moved (old refcount--).
+        Returns None when the pool can't supply the clone."""
+        with self._lock:
+            p = int(p)
+            assert self._refs[p] > 0
+            if self._refs[p] == 1:
+                key = self._key_of.pop(p, None)
+                if key is not None:
+                    del self._index[key]
+                return p
+            q = self._take_free_locked()
+            if q is None:
+                self._counters["reserve_fails"] += 1
+                return None
+            self._refs[q] = 1
+            self._refs[p] -= 1  # >0: sharers remain, p stays frozen
+            self._counters["cow_copies"] += 1
+        # bytes copied OUTSIDE the lock: q is exclusively ours, p is
+        # immutable (frozen) while its sharers hold it
+        np.copyto(self.k_tile(q), self.k_tile(p))
+        np.copyto(self.v_tile(q), self.v_tile(p))
+        self.host_wrote(q)
+        return q
+
+    def host_wrote(self, p: int):
+        """The caller rewrote page `p`'s HOST bytes directly (numpy,
+        outside the runtime): any device mirror is stale and must drop
+        (COW clones, speculative row staging)."""
+        ctx = self._ctx
+        if hasattr(ctx, "host_wrote"):
+            ctx.host_wrote(self.Kc, int(p))
+            ctx.host_wrote(self.Vc, int(p))
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        """Prefix-cache counter snapshot (stats()["serve"]["prefix"])."""
+        with self._lock:
+            out = dict(self._counters)
+            out["n_pages"] = self.n_pages
+            out["free"] = len(self._free)
+            out["cached_free"] = len(self._cached)
+            out["frozen_live"] = len(self._key_of)
+            out["shared_refs"] = sum(
+                r - 1 for p, r in enumerate(self._refs)
+                if r > 1 and p in self._key_of)
+            hits, misses = out["prefix_hits"], out["prefix_misses"]
+            out["hit_rate"] = (hits / (hits + misses)
+                               if hits + misses else 0.0)
+            return out
 
     def k_tile(self, p: int) -> np.ndarray:
         return self.Kc.tile(p, 0)
@@ -220,7 +445,7 @@ def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         kp[row] = knrow[0, :d]
         vp[row] = knrow[0, d:]
 
-    upd.body(upd_body)
+    upd.body(upd_body, pure=True)
 
     fro = tp.task_class("PATTF")
     fro.param("s", 0, pt.G("NS"))
@@ -259,7 +484,7 @@ def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         acc, m, l = attend_page(q, K, V, acc, m, l, sc)
         _acc_pack(at, acc, m, l)
 
-    fro.body(fro_body)
+    fro.body(fro_body, pure=True)
 
     lst = tp.task_class("PATTL")
     lst.param("s", 0, pt.G("NS"))
@@ -286,7 +511,114 @@ def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         acc, m, l = attend_page(q, K, V, acc, m, l, sc)
         v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
 
-    lst.body(body_wrap(lst_body) if body_wrap else lst_body)
+    if body_wrap:
+        lst.body(body_wrap(lst_body))
+    else:
+        lst.body(lst_body, pure=True)
+    return tp
+
+
+def build_paged_verify(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
+                       coll_names: Dict[str, str], *, scale: float = None,
+                       priority: Optional[int] = None,
+                       weight: Optional[int] = None,
+                       body_wrap: Optional[Callable] = None,
+                       dev=None):
+    """Speculative-decoding VERIFY WAVE: every page of every sequence
+    is already materialized in the KV collections (the shared frozen
+    prefix plus host-staged private query-window pages), so the pool is
+    pure fold chains — VATF(s, j) folds frozen page j, VATL(s) folds
+    the last page to `fill` rows and writes O.  One virtual sequence
+    per (real sequence, query position): the engine flattens a k-token
+    draft window into k+1 of these, and the resulting VATF wave is
+    HOMOGENEOUS — with a device attached it carries the same
+    shape-uniform chore as decode's PATTF, so the PR 13 wave compiler
+    certifies it and the whole batched verification dispatches as one
+    fused launch.  Fold math and page blocking are `attend_page` with
+    the decode builder's exact operand split: a verified position's
+    output is BIT-IDENTICAL to the sequential decode step's."""
+    import parsec_tpu as pt
+
+    d, P = pool.d, pool.page
+    sc = (d ** -0.5) if scale is None else float(scale)
+    slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
+    qn, an, on = coll_names["Q"], coll_names["ACC"], coll_names["O"]
+
+    tp = ctx.taskpool(globals={"NS": len(seqs) - 1}, priority=priority,
+                      weight=weight)
+    s = pt.L("s")
+    j = pt.L("j")
+    c_slot = pt.call(lambda locs, g: slot_t[locs[0]], pure=True)
+    c_nfro = pt.call(lambda locs, g: nfro_t[locs[0]], pure=True)
+    c_last = pt.call(lambda locs, g: last_t[locs[0]], pure=True)
+    c_page = pt.call(lambda locs, g: pages_t[locs[0]][locs[1]], pure=True)
+
+    fro = tp.task_class("VATF")
+    fro.param("s", 0, pt.G("NS"))
+    fro.param("j", 0, c_nfro - 1)
+    fro.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
+    fro.flow("KP", "READ", pt.In(pt.Mem(pool.k_name, c_page, 0)))
+    fro.flow("VP", "READ", pt.In(pt.Mem(pool.v_name, c_page, 0)))
+    fro.flow("ACC", "RW",
+             pt.In(pt.Mem(an, c_slot, 0), guard=(j == 0)),
+             pt.In(pt.Ref("VATF", s, j - 1, flow="ACC")),
+             pt.Out(pt.Ref("VATF", s, j + 1, flow="ACC"),
+                    guard=(j < c_nfro - 1)),
+             pt.Out(pt.Ref("VATL", s, flow="ACC"),
+                    guard=(j == c_nfro - 1)))
+
+    if dev is not None:
+        # same shape-uniform fold as decode's PATTF, but declared
+        # BATCHABLE (the kernel is elementwise over whole-page tiles):
+        # a homogeneous VATF wave certifies under the PR 13 wave
+        # compiler and the entire batched verification dispatches as
+        # ONE fused launch
+        def k_fold(qb, kb, vb, ab):
+            return _fold_kernel(qb, kb, vb, ab, sc)
+
+        dev.attach(fro, tp, kernel=k_fold, reads=["Q", "KP", "VP", "ACC"],
+                   writes=["ACC"],
+                   shapes={"Q": (1, d), "KP": (P, d), "VP": (P, d),
+                           "ACC": (1, d + 2)},
+                   dtype=np.float32, batch=True)
+
+    def fro_body(v):
+        q = v.data("Q", np.float32, (1, d))[0]
+        K = v.data("KP", np.float32, (P, d))
+        V = v.data("VP", np.float32, (P, d))
+        at = v.data("ACC", np.float32, (1, d + 2))
+        acc, m, l = _acc_unpack(at)
+        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
+        _acc_pack(at, acc, m, l)
+
+    fro.body(fro_body, pure=True)
+
+    lst = tp.task_class("VATL")
+    lst.param("s", 0, pt.G("NS"))
+    lst.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
+    lst.flow("KP", "READ", pt.In(pt.Mem(pool.k_name, c_last, 0)))
+    lst.flow("VP", "READ", pt.In(pt.Mem(pool.v_name, c_last, 0)))
+    lst.flow("ACC", "RW",
+             pt.In(pt.Ref("VATF", s, c_nfro - 1, flow="ACC")),
+             pt.In(pt.Mem(an, c_slot, 0)))
+    lst.flow("O", "RW", pt.In(pt.Mem(on, c_slot, 0)),
+             pt.Out(pt.Mem(on, c_slot, 0)))
+
+    def lst_body(v):
+        si = v["s"]
+        rows = fill_t[si]
+        q = v.data("Q", np.float32, (1, d))[0]
+        K = v.data("KP", np.float32, (P, d))[:rows]
+        V = v.data("VP", np.float32, (P, d))[:rows]
+        at = v.data("ACC", np.float32, (1, d + 2))
+        acc, m, l = _acc_unpack(at)
+        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
+        v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
+
+    if body_wrap:
+        lst.body(body_wrap(lst_body))
+    else:
+        lst.body(lst_body, pure=True)
     return tp
 
 
@@ -310,19 +642,30 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
                         scale: float = None,
                         priority: Optional[int] = None,
                         weight: Optional[int] = None,
-                        body_wrap: Optional[Callable] = None):
+                        body_wrap: Optional[Callable] = None,
+                        warm: Optional[Sequence[int]] = None):
     """PREFILL as a taskpool: PFILL(s, j) writes page j of sequence s
     from the staged prompt collection (`prompt_name`, one (page, 2d)
     k|v tile per written page, indices in `prompt_tiles[s][j]`), then
     the PATTF/PATTL fold chain computes attention for the LAST prompt
     position over all written rows.  `seqs[i].fill` is the row count
-    used in the last page (1..page)."""
+    used in the last page (1..page).
+
+    `warm[i]` (prefix cache, ptc-share) marks the first `warm[i]` pages
+    of sequence i as ALREADY MATERIALIZED shared frozen pages: PFILL's
+    domain starts at the cold tail, and the fold chain reads warm pages
+    straight from the KV collections — selection rides the producer
+    domain (PFILL(s, j<warm) does not exist), not dynamic guards, so
+    input counting stays verifier-exact.  A fully-warm sequence
+    prefills ZERO pages and still folds its whole cache."""
     import parsec_tpu as pt
 
     d, P = pool.d, pool.page
     sc = (d ** -0.5) if scale is None else float(scale)
     slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
     ptiles = [list(row) for row in prompt_tiles]
+    warm_t = [0] * len(seqs) if warm is None else [int(w) for w in warm]
+    assert len(warm_t) == len(seqs)
     qn, an, on = coll_names["Q"], coll_names["ACC"], coll_names["O"]
 
     tp = ctx.taskpool(globals={"NS": len(seqs) - 1}, priority=priority,
@@ -332,12 +675,14 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
     c_slot = pt.call(lambda locs, g: slot_t[locs[0]], pure=True)
     c_nfro = pt.call(lambda locs, g: nfro_t[locs[0]], pure=True)
     c_npag = pt.call(lambda locs, g: nfro_t[locs[0]], pure=True)
+    c_warm = pt.call(lambda locs, g: warm_t[locs[0]], pure=True)
+    c_last = pt.call(lambda locs, g: last_t[locs[0]], pure=True)
     c_page = pt.call(lambda locs, g: pages_t[locs[0]][locs[1]], pure=True)
     c_ptile = pt.call(lambda locs, g: ptiles[locs[0]][locs[1]], pure=True)
 
     fil = tp.task_class("PFILL")
     fil.param("s", 0, pt.G("NS"))
-    fil.param("j", 0, c_npag)  # 0..npages-1 == 0..nfro
+    fil.param("j", c_warm, c_npag)  # cold tail: warm..npages-1
     fil.flow("SRC", "READ", pt.In(pt.Mem(prompt_name, c_ptile, 0)))
     fil.flow("KP", "RW", pt.In(pt.Mem(pool.k_name, c_page, 0)),
              pt.Out(pt.Mem(pool.k_name, c_page, 0)),
@@ -361,14 +706,19 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         kp[:rows] = src[:rows, :d]
         vp[:rows] = src[:rows, d:]
 
-    fil.body(fil_body)
+    fil.body(fil_body, pure=True)
 
     fro = tp.task_class("PATTF")
     fro.param("s", 0, pt.G("NS"))
     fro.param("j", 0, c_nfro - 1)
     fro.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
-    fro.flow("KP", "READ", pt.In(pt.Ref("PFILL", s, j, flow="KP")))
-    fro.flow("VP", "READ", pt.In(pt.Ref("PFILL", s, j, flow="VP")))
+    # cold pages arrive from PFILL through the DAG; warm (shared frozen)
+    # pages fall back to the KV collection datum — PFILL(s, j < warm)
+    # is out of the producer domain, so selection stays exact
+    fro.flow("KP", "READ", pt.In(pt.Ref("PFILL", s, j, flow="KP")),
+             pt.In(pt.Mem(pool.k_name, c_page, 0)))
+    fro.flow("VP", "READ", pt.In(pt.Ref("PFILL", s, j, flow="VP")),
+             pt.In(pt.Mem(pool.v_name, c_page, 0)))
     fro.flow("ACC", "RW",
              pt.In(pt.Mem(an, c_slot, 0), guard=(j == 0)),
              pt.In(pt.Ref("PATTF", s, j - 1, flow="ACC")),
@@ -386,13 +736,16 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         acc, m, l = attend_page(q, K, V, acc, m, l, sc)
         _acc_pack(at, acc, m, l)
 
-    fro.body(fro_body)
+    fro.body(fro_body, pure=True)
 
     lst = tp.task_class("PATTL")
     lst.param("s", 0, pt.G("NS"))
     lst.flow("Q", "READ", pt.In(pt.Mem(qn, c_slot, 0)))
-    lst.flow("KP", "READ", pt.In(pt.Ref("PFILL", s, c_nfro, flow="KP")))
-    lst.flow("VP", "READ", pt.In(pt.Ref("PFILL", s, c_nfro, flow="VP")))
+    # a fully-warm sequence's LAST page is shared too: Mem fallback
+    lst.flow("KP", "READ", pt.In(pt.Ref("PFILL", s, c_nfro, flow="KP")),
+             pt.In(pt.Mem(pool.k_name, c_last, 0)))
+    lst.flow("VP", "READ", pt.In(pt.Ref("PFILL", s, c_nfro, flow="VP")),
+             pt.In(pt.Mem(pool.v_name, c_last, 0)))
     lst.flow("ACC", "RW",
              pt.In(pt.Ref("PATTF", s, c_nfro - 1, flow="ACC")),
              pt.In(pt.Mem(an, c_slot, 0)))
@@ -410,5 +763,8 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         acc, m, l = attend_page(q, K, V, acc, m, l, sc)
         v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
 
-    lst.body(body_wrap(lst_body) if body_wrap else lst_body)
+    if body_wrap:
+        lst.body(body_wrap(lst_body))
+    else:
+        lst.body(lst_body, pure=True)
     return tp

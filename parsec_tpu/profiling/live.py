@@ -196,6 +196,11 @@ class LiveMonitor:
         if reg is not None:
             try:
                 sc = reg.stats()
+                def _rate(row, num, *dens):
+                    total = sum(row.get(k, 0) for k in dens)
+                    return round(row.get(num, 0) / total, 3) \
+                        if total else None
+
                 rec["tenants"] = {
                     name: {"completed": row["completed"],
                            "ttft_p99_ms": round(
@@ -204,7 +209,14 @@ class LiveMonitor:
                                row["latency_ns_p99"] / 1e6, 3),
                            "tok_s_p50": row["tokens_per_s_p50"],
                            "slo_burn": (sc["slo"].get(name) or {}).get(
-                               "burn_rate")}
+                               "burn_rate"),
+                           # ptc-share: prefix-cache hit rate +
+                           # speculative draft acceptance per tenant
+                           "prefix_hit": _rate(row, "prefix_hits",
+                                               "prefix_hits",
+                                               "prefix_misses"),
+                           "spec_acc": _rate(row, "spec_accepted",
+                                             "spec_proposed")}
                     for name, row in sc["tenants"].items()}
                 conf = sc["conformance"]
                 rec["conformance"] = {

@@ -264,9 +264,20 @@ class MetricsRegistry:
          "submit -> done latency"),
         ("tokens_per_s", "ptc_tenant_tokens_per_second", 1.0,
          "per-request decode rate"),
+        ("spec_accept_pct", "ptc_tenant_spec_accept_percent", 1.0,
+         "speculative-decode draft acceptance per verify wave"),
     )
     _TENANT_COUNTERS = ("submitted", "completed", "failed", "rejected",
-                        "slo_violations")
+                        "slo_violations", "prefix_hits", "prefix_misses",
+                        "spec_proposed", "spec_accepted")
+    # derived per-tenant rate gauges off the counters above
+    # (ptc-share dashboards): (family, numerator, denominator keys)
+    _TENANT_RATES = (
+        ("ptc_tenant_prefix_hit_rate", "prefix_hits",
+         ("prefix_hits", "prefix_misses"), "prefix-cache page hit rate"),
+        ("ptc_tenant_spec_accept_rate", "spec_accepted",
+         ("spec_proposed",), "speculative draft acceptance rate"),
+    )
 
     def _tenant_lines(self) -> List[str]:
         """Tenant-dimensioned exposition from the ScopeRegistry (empty
@@ -307,6 +318,18 @@ class MetricsRegistry:
             lines.append(f"# TYPE {fam} counter")
             for name, v in rows:
                 lines.append(f'{fam}{{tenant="{name}"}} {v}')
+        for fam, num, dens, help_ in self._TENANT_RATES:
+            rows = []
+            for name, (_, c) in sorted(tenants.items()):
+                total = sum(c.get(k, 0) for k in dens)
+                if total:
+                    rows.append((name, c.get(num, 0) / total))
+            if not rows:
+                continue
+            lines.append(f"# HELP {fam} {help_} (per tenant)")
+            lines.append(f"# TYPE {fam} gauge")
+            for name, v in rows:
+                lines.append(f'{fam}{{tenant="{name}"}} {v:.9g}')
         for name, st in sorted(slo.items()):
             lines.append("# TYPE ptc_tenant_slo_burn_rate gauge")
             lines.append(f'ptc_tenant_slo_burn_rate{{tenant="{name}"}} '

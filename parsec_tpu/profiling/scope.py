@@ -130,9 +130,14 @@ class _Tenant:
         # sliding outcome window: True = SLO violated
         self.window: deque = deque(maxlen=int(window_n))
         self.counters = {"submitted": 0, "completed": 0, "failed": 0,
-                         "rejected": 0, "slo_violations": 0}
+                         "rejected": 0, "slo_violations": 0,
+                         "prefix_hits": 0, "prefix_misses": 0,
+                         "spec_proposed": 0, "spec_accepted": 0}
         self.hists = {"ttft_ns": ScopeHist(), "queue_wait_ns": ScopeHist(),
-                      "latency_ns": ScopeHist(), "tokens_per_s": ScopeHist()}
+                      "latency_ns": ScopeHist(), "tokens_per_s": ScopeHist(),
+                      # ptc-share: per-verify-wave draft acceptance, in
+                      # whole percent (0..100) of proposed tokens
+                      "spec_accept_pct": ScopeHist()}
 
 
 def _now_ns() -> int:
@@ -324,6 +329,29 @@ class ScopeRegistry:
                     t.window.append(viol)
                     if viol:
                         t.counters["slo_violations"] += 1
+
+    def record_prefix(self, tenant: str, hits: int, misses: int):
+        """ptc-share: one prompt's prefix-cache outcome — `hits` pages
+        mapped onto frozen shared pages, `misses` prefilled cold
+        (per-tenant hit-rate feed for ptc_top + Prometheus)."""
+        self.tenant(tenant)
+        with self._lock:
+            t = self.tenants[tenant]
+            t.counters["prefix_hits"] += int(hits)
+            t.counters["prefix_misses"] += int(misses)
+
+    def record_spec(self, tenant: str, proposed: int, accepted: int):
+        """ptc-share: one speculative verify wave's outcome — `accepted`
+        of `proposed` draft tokens survived target verification.  Feeds
+        the per-tenant acceptance-rate histogram (whole percent)."""
+        self.tenant(tenant)
+        with self._lock:
+            t = self.tenants[tenant]
+            t.counters["spec_proposed"] += int(proposed)
+            t.counters["spec_accepted"] += int(accepted)
+            if proposed > 0:
+                t.hists["spec_accept_pct"].record(
+                    round(100 * accepted / proposed))
 
     @staticmethod
     def plan_summary(plan) -> dict:

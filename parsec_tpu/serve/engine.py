@@ -18,6 +18,7 @@ serve bench's correctness acceptance.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -27,7 +28,7 @@ import numpy as np
 from ..data.collections import TwoDimBlockCyclic
 from ..ops.paged_attention import (PagePool, SeqSpec, attend_page,
                                    finalize_attention, build_paged_decode,
-                                   build_paged_prefill,
+                                   build_paged_prefill, build_paged_verify,
                                    make_slot_collections, reset_acc)
 from .server import ResourceBusy, Server, TenantConfig
 
@@ -48,6 +49,12 @@ class PagedLM:
 
     def __init__(self, cfg: PagedLMConfig):
         self.cfg = cfg
+        # prefix-cache identity: a page's KV bytes are a pure function
+        # of (model_id, token-id prefix), so the content-hash index is
+        # keyed by both — two engines sharing one PagePool but serving
+        # different weights can never cross-hit
+        self.model_id = (f"paged-lm:v{cfg.vocab}:d{cfg.d}:"
+                         f"p{cfg.page}:s{cfg.seed}")
         rng = np.random.RandomState(cfg.seed)
         d, v = cfg.d, cfg.vocab
         self.embed = rng.randn(v, d).astype(np.float32) * np.float32(0.5)
@@ -167,10 +174,24 @@ class InferenceEngine:
                  max_seqs: int = 16, server: Optional[Server] = None,
                  tenants: Optional[List[TenantConfig]] = None,
                  name: str = "eng", body_wrap: Optional[Callable] = None,
-                 dev=None, conformance: bool = True):
+                 dev=None, conformance: bool = True,
+                 prefix_cache: bool = True, spec_k: int = 0,
+                 spec_draft="self"):
         cfg = model.cfg
         self.ctx = ctx
         self.model = model
+        # ptc-share serving fast path: `prefix_cache` turns the shared
+        # copy-on-write prompt-prefix index on (default); `spec_k` > 0
+        # turns on speculative decoding — a draft model proposes k
+        # tokens per sequence per step and ONE batched verify wave of
+        # the target model checks them all (greedy accept / longest-
+        # prefix reject, page-table rollback on rejection).
+        # `spec_draft` is the proposer: "self" (the target's own
+        # argmax chain — the oracle upper bound) or any PagedLM.
+        self.prefix_cache = bool(prefix_cache)
+        self.spec_k = max(0, int(spec_k))
+        self.spec_draft = (model if spec_draft in (None, "self")
+                           else spec_draft)
         # ptc-scope: per-request scopes (TTFT/tokens-per-s SLO feed) +
         # per-decode-step shared scopes; conformance=True statically
         # plans each decode pool so plan-vs-measured stays covered
@@ -183,8 +204,20 @@ class InferenceEngine:
                                                   name=f"{name}_PA")
         self.max_seqs = max_seqs
         self._free_slots = list(range(max_seqs - 1, -1, -1))
+        # speculative verify scratch: one (Q, ACC, O) row per (sequence
+        # slot, query position) — slot s's query i lives at row
+        # s * (spec_k + 1) + i, so no allocator is needed
+        if self.spec_k:
+            (self.SQc, self.SACCc, self.SOc, _,
+             self.spec_names) = make_slot_collections(
+                ctx, max_seqs * (self.spec_k + 1), cfg.d,
+                name=f"{name}_SV")
         self.server = server or Server(
             ctx, tenants or [TenantConfig("default")], name=name)
+        # stats()["serve"] grows the pool's prefix-cache counters and
+        # the engine's speculative-decode counters
+        self.server.register_resource_stats("prefix", self.pool.stats)
+        self.server.register_resource_stats("spec", self._spec_stats)
         self.body_wrap = body_wrap
         self.dev = dev
         self._lock = threading.Lock()
@@ -202,7 +235,23 @@ class InferenceEngine:
         self.PRc.register(ctx, self._prompt_coll_name)
         self.requests: List[RequestHandle] = []
         self.stats = {"decode_pools": 0, "decode_steps": 0,
-                      "prefills": 0, "retired": 0, "page_stalls": 0}
+                      "prefills": 0, "retired": 0, "page_stalls": 0,
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "cow_copies": 0, "spec_steps": 0,
+                      "spec_proposed": 0, "spec_accepted": 0,
+                      "spec_fallbacks": 0}
+
+    def _spec_stats(self) -> dict:
+        with self._lock:
+            prop = self.stats["spec_proposed"]
+            acc = self.stats["spec_accepted"]
+            return {
+                "enabled": self.spec_k > 0, "k": self.spec_k,
+                "steps": self.stats["spec_steps"],
+                "proposed": prop, "accepted": acc,
+                "fallbacks": self.stats["spec_fallbacks"],
+                "accept_rate": (acc / prop) if prop else 0.0,
+            }
 
     def _host_wrote(self, coll, m: int, n: int = 0):
         """The engine rewrote a slot tile's HOST bytes directly (numpy,
@@ -211,15 +260,23 @@ class InferenceEngine:
         runtime write happened)."""
         if self.dev is None:
             return
-        d = coll._datas.get((m, n))
-        if d is None:
-            return
-        from .. import _native as N
-        h = N.lib.ptc_copy_handle(N.lib.ptc_data_host_copy(d._ptr))
-        if h:
-            for dv in list(self.ctx._devices):
-                dv._drop_mirror(h)
-            N.lib.ptc_device_clear_data_owner(self.ctx._ptr, h, -1)
+        self.ctx.host_wrote(coll, m, n)
+
+    # ------------------------------------------------------ prefix keys
+    def _page_keys(self, prompt: Sequence[int]) -> List[str]:
+        """Content-hash keys for a prompt's FULL pages.  Key j digests
+        (model id, tokens[0 : (j+1)*page]) — prefix-CUMULATIVE, so a
+        page's KV bytes are a pure function of its key and a hit can
+        only map onto a page holding exactly the bytes a cold prefill
+        would write (shared-prefix warm runs stay bit-identical)."""
+        P = self.model.cfg.page
+        h = hashlib.sha1(self.model.model_id.encode())
+        keys = []
+        for j in range(len(prompt) // P):
+            h.update(np.asarray(prompt[j * P:(j + 1) * P],
+                                np.int64).tobytes())
+            keys.append(h.hexdigest())
+        return keys
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int], max_new: int,
@@ -235,42 +292,68 @@ class InferenceEngine:
         P = self.model.cfg.page
         n_pages = (len(req.prompt) + P - 1) // P
         est = n_pages * self.pool.bytes_per_page
+        # admission-time prefix discount: pages predicted to map onto
+        # existing frozen pages cost the pool nothing — the byte budget
+        # sees only the cold tail (plan-side twin: Plan.est_bytes'
+        # discount_bytes parameter)
+        discount = 0
+        if self.prefix_cache:
+            discount = self.pool.probe(self._page_keys(req.prompt)) * \
+                self.pool.bytes_per_page
         req.ticket = self.server.submit(
             tenant, lambda priority, weight, req=req: self._build_prefill(
                 req, priority, weight),
-            est_bytes=est, meta={"rid": rid}, scope=req.scope_id)
+            est_bytes=est, est_discount_bytes=discount,
+            meta={"rid": rid}, scope=req.scope_id)
         if req.ticket.state == "rejected":
             req.state = "rejected"
             req.done_t = time.monotonic()
         return req
 
     def _build_prefill(self, req: RequestHandle, priority, weight):
-        """Server-side builder: allocate slot + pages (ResourceBusy when
-        exhausted — backpressure), stage prompt k|v, build the pool."""
+        """Server-side builder: admit the page table ATOMICALLY —
+        `acquire_prefix` maps the longest warm prefix onto existing
+        frozen pages (refcount++) and reserves only the cold tail in
+        one pool-lock transaction (ResourceBusy when it doesn't fit —
+        backpressure, with no half-taken pages) — then stage the COLD
+        prompt k|v and build the pool: a warm page never re-prefills."""
         cfg = self.model.cfg
         P, d = cfg.page, cfg.d
         T = len(req.prompt)
         n_pages = (T + P - 1) // P
+        keys = self._page_keys(req.prompt) if self.prefix_cache else []
         with self._lock:
-            if not self._free_slots or self.pool.free_pages < n_pages:
+            if not self._free_slots:
+                self.stats["page_stalls"] += 1
+                raise ResourceBusy("slots=0")
+            got = self.pool.acquire_prefix(keys, n_pages)
+            if got is None:
                 self.stats["page_stalls"] += 1
                 raise ResourceBusy(
-                    f"slots={len(self._free_slots)} "
                     f"pages={self.pool.free_pages}<{n_pages}")
+            pages, warm = got
             slot = self._free_slots.pop()
-            pages = [self.pool.alloc() for _ in range(n_pages)]
+            self.stats["prefix_hits"] += warm
+            self.stats["prefix_misses"] += n_pages - warm
             ptile0 = self._next_prompt_tile
             self._next_prompt_tile = (ptile0 + n_pages) % \
                 self._prompt_tiles
-        # stage prompt k|v into the PR collection + the last token's q
+        self.scope.record_prefix(req.tenant, hits=warm,
+                                 misses=n_pages - warm)
+        # stage COLD prompt k|v into the PR collection + the last
+        # token's q; warm pages already hold their rows (frozen)
         kv = np.zeros((n_pages * P, 2 * d), np.float32)
         for i, tok in enumerate(req.prompt):
+            if i < warm * P:
+                continue
             _, k, v = self.model.qkv(tok)
             kv[i, :d] = k
             kv[i, d:] = v
         ptiles = [(ptile0 + i) % self._prompt_tiles
                   for i in range(n_pages)]
         for i, pt_i in enumerate(ptiles):
+            if i < warm:
+                continue
             self.PRc.tile(pt_i, 0)[...] = kv[i * P:(i + 1) * P]
             self._host_wrote(self.PRc, pt_i)
         q = self.model.qkv(req.prompt[-1])[0]
@@ -285,15 +368,22 @@ class InferenceEngine:
             {"Q": self.slot_names["Q"], "ACC": self.slot_names["ACC"],
              "O": self.slot_names["O"]},
             self._prompt_coll_name, [ptiles],
-            priority=priority, weight=weight)
-        tp.on_complete(lambda: self._prefill_done(req, spec))
+            priority=priority, weight=weight, warm=[warm])
+        tp.on_complete(lambda: self._prefill_done(req, spec, warm, keys))
         self.stats["prefills"] += 1
         return tp
 
-    def _prefill_done(self, req: RequestHandle, spec: SeqSpec):
+    def _prefill_done(self, req: RequestHandle, spec: SeqSpec,
+                      warm: int = 0, keys: Optional[List[str]] = None):
         """Worker-thread callback: activate the sequence + consume the
         first decode output (the prefill chain already attended the
         last prompt position)."""
+        # freeze the cold FULL pages under their content keys — the
+        # next request sharing this prefix maps onto them (first
+        # writer wins; the mutable last page never freezes)
+        if keys:
+            for j in range(warm, len(keys)):
+                self.pool.freeze(spec.pages[j], keys[j])
         o = self.Oc.tile(spec.slot, 0)[0].copy()
         req.outputs.append(o)
         nxt = self.model.next_token(o)
@@ -339,30 +429,26 @@ class InferenceEngine:
         for tenant, seqs in ready.items():
             ts = self.server._tenants.get(tenant)
             prio, wt = (ts.cfg.priority, ts.cfg.weight) if ts else (0, 1)
-            specs = []
-            for seq in seqs:
-                tok = seq.req.tokens[-1]
-                q, k, v = self.model.qkv(tok)
-                self.Qc.tile(seq.slot, 0)[0] = q
-                knrow = self.KNc.tile(seq.slot, 0)
-                knrow[0, :d] = k
-                knrow[0, d:] = v
-                reset_acc(self.ACCc.tile(seq.slot, 0))
-                for coll in (self.Qc, self.KNc, self.ACCc):
-                    self._host_wrote(coll, seq.slot)
-                specs.append(SeqSpec(seq.slot, seq.pages,
-                                     seq.length % P))
-            tp = build_paged_decode(
-                self.ctx, self.pool, specs, self.slot_names,
-                priority=prio, weight=wt, body_wrap=self.body_wrap,
-                dev=self.dev)
+            rec = None
+            if self.spec_k:
+                rec = self._stage_spec(seqs, prio, wt)
+                if rec is None:  # page reservation failed: plain decode
+                    with self._lock:
+                        self.stats["spec_fallbacks"] += 1
+            if rec is None:
+                rec = self._stage_decode(seqs, prio, wt)
+            tp, staged, spec_info = rec
+            if not staged:
+                tp.destroy()  # nothing stageable this wave (COW dry)
+                continue
             # ptc-scope: one shared scope per decode step, with the
             # member rid order matching the spec order so EXEC spans'
             # sequence lane (locals[0]) maps back to each request; plan
             # the pool for the conformance record when enabled
             dsid = self.scope.new_scope(
-                tenant, kind="decode_step",
-                members=[s.req.rid for s in seqs])
+                tenant,
+                kind="spec_verify_step" if spec_info else "decode_step",
+                members=[s.req.rid for s in staged])
             self.scope.stamp(tp, dsid)
             plan = None
             if self.conformance:
@@ -372,12 +458,130 @@ class InferenceEngine:
                     plan = None
             done = threading.Event()
             tp.on_complete(done.set)
-            self._inflight[tenant] = (tp, seqs, done, dsid, plan,
-                                      time.monotonic_ns())
+            self._inflight[tenant] = (tp, staged, done, dsid, plan,
+                                      time.monotonic_ns(), spec_info)
             tp.run()
             self.stats["decode_pools"] += 1
             launched += 1
         return launched
+
+    def _stage_decode(self, seqs, prio, wt):
+        """Stage + build one NORMAL decode step over `seqs`.  A shared
+        (prefix-cache) or frozen last page goes copy-on-write first:
+        PUPD appends in place, and a sharer's view must never move.
+        Returns (taskpool, staged sequences, None)."""
+        cfg = self.model.cfg
+        P, d = cfg.page, cfg.d
+        specs, staged = [], []
+        for seq in seqs:
+            last = seq.pages[-1]
+            if self.pool.refcount(last) > 1 or self.pool.is_frozen(last):
+                priv = self.pool.make_private(last)
+                if priv is None:  # clone pool dry: retry next wave
+                    with self._lock:
+                        self.stats["page_stalls"] += 1
+                    continue
+                if priv != last:
+                    with self._lock:
+                        self.stats["cow_copies"] += 1
+                    seq.pages[-1] = priv
+            tok = seq.req.tokens[-1]
+            q, k, v = self.model.qkv(tok)
+            self.Qc.tile(seq.slot, 0)[0] = q
+            knrow = self.KNc.tile(seq.slot, 0)
+            knrow[0, :d] = k
+            knrow[0, d:] = v
+            reset_acc(self.ACCc.tile(seq.slot, 0))
+            for coll in (self.Qc, self.KNc, self.ACCc):
+                self._host_wrote(coll, seq.slot)
+            specs.append(SeqSpec(seq.slot, seq.pages, seq.length % P))
+            staged.append(seq)
+        tp = build_paged_decode(
+            self.ctx, self.pool, specs, self.slot_names,
+            priority=prio, weight=wt, body_wrap=self.body_wrap,
+            dev=self.dev)
+        return tp, staged, None
+
+    def _stage_spec(self, seqs, prio, wt):
+        """Stage + build one SPECULATIVE decode step over `seqs`: the
+        draft proposes up to k tokens per sequence, and the k+1 query
+        positions (current token + each draft token) verify in ONE
+        batched target-model wave (build_paged_verify — the VATF wave
+        is homogeneous, so PR 13 fuses it to a single launch).
+
+        Per (sequence, query i): the query window's pages — every page
+        touched by rows L..L+i — are PRIVATE clones (existing rows
+        copied, speculative k|v rows host-staged), while pages wholly
+        below row L stay shared read-only; the fold then reproduces the
+        sequential decode step for position L+i bit-exactly.  Page
+        reservation is all-or-nothing against the refcounted pool:
+        shortfall returns None and the caller falls back to plain
+        decode (never half-speculates).  Returns
+        (taskpool, sequences, per-seq speculation records)."""
+        cfg = self.model.cfg
+        P, d = cfg.page, cfg.d
+        dm = self.spec_draft
+        nq_tot = 0
+        layout = []
+        for seq in seqs:
+            L = seq.length
+            nq = min(self.spec_k + 1, seq.remaining)
+            pbase = L // P
+            cnt = sum(((L + i) // P + 1) - pbase for i in range(nq))
+            layout.append((seq, L, nq, pbase, cnt))
+            nq_tot += nq
+        total_pages = sum(c for _, _, _, _, c in layout)
+        pages = self.pool.reserve(total_pages)
+        if pages is None:
+            return None
+        take = iter(pages)
+        vspecs, recs = [], []
+        for seq, L, nq, pbase, _cnt in layout:
+            # draft proposals: the draft model's own greedy chain over
+            # the sequence's tokens (for spec_draft="self" this is the
+            # target's argmax chain — the oracle acceptance bound)
+            toks = list(seq.req.tokens)
+            g = dm.reference_generate(toks, nq - 1)[0][len(toks):] \
+                if nq > 1 else []
+            u = [toks[-1]] + [int(t) for t in g]
+            kvs = [self.model.qkv(t) for t in u]  # (q, k, v) per query
+            base_rows = L - pbase * P  # existing rows in the base page
+            privs = []
+            for i in range(nq):
+                npg = (L + i) // P + 1
+                priv = [next(take) for _ in range(npg - pbase)]
+                # copy the base page's existing rows, then host-stage
+                # the speculative rows u[0..i] at absolute rows L..L+i
+                if base_rows:
+                    src = seq.pages[pbase]
+                    self.pool.k_tile(priv[0])[:base_rows] = \
+                        self.pool.k_tile(src)[:base_rows]
+                    self.pool.v_tile(priv[0])[:base_rows] = \
+                        self.pool.v_tile(src)[:base_rows]
+                for r in range(L, L + i + 1):
+                    pg = priv[r // P - pbase]
+                    _, k, v = kvs[r - L]
+                    self.pool.k_tile(pg)[r % P] = k
+                    self.pool.v_tile(pg)[r % P] = v
+                for pg in priv:
+                    self.pool.host_wrote(pg)
+                vslot = seq.slot * (self.spec_k + 1) + i
+                self.SQc.tile(vslot, 0)[0] = kvs[i][0]
+                reset_acc(self.SACCc.tile(vslot, 0))
+                self._host_wrote(self.SQc, vslot)
+                self._host_wrote(self.SACCc, vslot)
+                R = L + 1 + i
+                vspecs.append(SeqSpec(
+                    vslot, seq.pages[:pbase] + priv,
+                    R - ((L + i) // P) * P))
+                privs.append(priv)
+            recs.append({"seq": seq, "nq": nq, "g": [int(t) for t in g],
+                         "pbase": pbase, "privs": privs})
+        tp = build_paged_verify(
+            self.ctx, self.pool, vspecs, self.spec_names,
+            priority=prio, weight=wt, body_wrap=self.body_wrap,
+            dev=self.dev)
+        return tp, seqs, recs
 
     def _reap(self) -> int:
         """Consume completed decode pools: apply the model head, append
@@ -386,16 +590,19 @@ class InferenceEngine:
         done = [(t, rec) for t, rec in self._inflight.items()
                 if rec[2].is_set()]
         advanced = 0
-        for tenant, (tp, seqs, _, dsid, plan, t0_ns) in done:
+        for tenant, (tp, seqs, _, dsid, plan, t0_ns, spec) in done:
             del self._inflight[tenant]
-            for seq in seqs:
-                o = self.Oc.tile(seq.slot, 0)[0].copy()
-                seq.req.outputs.append(o)
-                nxt = self.model.next_token(o)
-                seq.req.tokens.append(nxt)
-                seq.length += 1
-                seq.remaining -= 1
-                advanced += 1
+            if spec is not None:
+                advanced += self._reap_spec(tenant, spec)
+            else:
+                for seq in seqs:
+                    o = self.Oc.tile(seq.slot, 0)[0].copy()
+                    seq.req.outputs.append(o)
+                    nxt = self.model.next_token(o)
+                    seq.req.tokens.append(nxt)
+                    seq.length += 1
+                    seq.remaining -= 1
+                    advanced += 1
             # conformance: decode-step pool retired — compare the plan
             # snapshot against the measured step wall + lane counters
             qos = None
@@ -411,6 +618,45 @@ class InferenceEngine:
         with self._lock:
             for seq in [s for s in self._active if s.remaining <= 0]:
                 self._retire_locked(seq)
+        return advanced
+
+    def _reap_spec(self, tenant: str, recs) -> int:
+        """Consume one speculative verify wave: greedy accept — query i
+        is valid while every earlier draft matched the target's own
+        argmax — so the emitted (token, output) stream is BIT-IDENTICAL
+        to sequential decode regardless of draft quality.  Rejected
+        tokens roll back by truncating the page table: the losing
+        queries' private pages release (refcounts make this free)."""
+        advanced = 0
+        for rec in recs:
+            seq, nq, g = rec["seq"], rec["nq"], rec["g"]
+            pbase, privs = rec["pbase"], rec["privs"]
+            outs, nxts = [], []
+            for i in range(nq):
+                vslot = seq.slot * (self.spec_k + 1) + i
+                o = self.SOc.tile(vslot, 0)[0].copy()
+                outs.append(o)
+                nxts.append(self.model.next_token(o))
+            j = 0  # query 0 is the plain decode position: always valid
+            while j < nq - 1 and g[j] == nxts[j]:
+                j += 1
+            for i in range(j + 1):
+                seq.req.outputs.append(outs[i])
+                seq.req.tokens.append(nxts[i])
+            # the deepest accepted query's window becomes the canonical
+            # page-table tail; everything else rolls back to the pool
+            old_tail = seq.pages[pbase:]
+            seq.pages = seq.pages[:pbase] + privs[j]
+            self.pool.release(old_tail + [
+                p for i, priv in enumerate(privs) if i != j for p in priv])
+            seq.length += j + 1
+            seq.remaining -= j + 1
+            advanced += j + 1
+            with self._lock:
+                self.stats["spec_steps"] += 1
+                self.stats["spec_proposed"] += nq - 1
+                self.stats["spec_accepted"] += j
+            self.scope.record_spec(tenant, proposed=nq - 1, accepted=j)
         return advanced
 
     def step(self) -> int:

@@ -80,7 +80,8 @@ class Ticket:
 
     __slots__ = ("tenant", "est_bytes", "meta", "state", "submitted_t",
                  "admitted_t", "done_t", "error", "_event", "_make_pool",
-                 "_pool", "scope_id", "_owns_scope", "_plan")
+                 "_pool", "scope_id", "_owns_scope", "_plan",
+                 "_est_discount")
 
     def __init__(self, tenant: str, make_pool: Callable, est_bytes,
                  meta):
@@ -104,6 +105,7 @@ class Ticket:
         self.scope_id: Optional[int] = None
         self._owns_scope = False
         self._plan: Optional[dict] = None  # ptc-plan prediction summary
+        self._est_discount = 0  # predicted-shared bytes (prefix cache)
 
     @property
     def terminal(self) -> bool:
@@ -138,7 +140,7 @@ class _TenantState:
         self.counters = {
             "submitted": 0, "admitted": 0, "rejected": 0,
             "completed": 0, "failed": 0, "resource_waits": 0,
-            "queue_wait_ns": 0,
+            "queue_wait_ns": 0, "discounted_bytes": 0,
         }
 
 
@@ -169,6 +171,10 @@ class Server:
                               burn_threshold=t.slo_burn)
         self._tenants: Dict[str, _TenantState] = {
             t.name: _TenantState(t) for t in tenants}
+        # shared-resource counter providers (the engine registers its
+        # PagePool prefix-cache + speculative-decode counters here so
+        # they export through Context.stats()["serve"])
+        self._resource_stats: Dict[str, Callable[[], dict]] = {}
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._retired: List[Ticket] = []
@@ -189,9 +195,15 @@ class Server:
         with self._lock:
             self._tenants[cfg.name] = _TenantState(cfg)
 
+    def register_resource_stats(self, name: str, fn: Callable[[], dict]):
+        """Export a shared-resource counter snapshot (e.g. the KV
+        PagePool's prefix-cache counters) under stats()[name]."""
+        self._resource_stats[name] = fn
+
     def submit(self, tenant: str, make_pool: Callable, est_bytes: int = 0,
                meta=None, wait: bool = False,
-               scope: Optional[int] = None) -> Ticket:
+               scope: Optional[int] = None,
+               est_discount_bytes: int = 0) -> Ticket:
         """Submit one request DAG.  Returns its Ticket immediately
         (state "queued", "running" — admitted synchronously — or
         "rejected").  wait=True blocks for the terminal state and
@@ -217,6 +229,17 @@ class Server:
             raise RuntimeError("server closed")
         t = self._tenants[tenant]
         ticket = Ticket(tenant, make_pool, est_bytes, meta)
+        # prefix-cache admission discount (ptc-share): pages predicted
+        # to map onto existing frozen pages are free to the pool, so
+        # the byte budget charges only the cold tail.  Clamped to stay
+        # a KNOWN estimate (<= 0 means unknown — see MIGRATION.md).
+        disc = max(0, int(est_discount_bytes or 0))
+        ticket._est_discount = disc
+        if disc and ticket.est_bytes is not None and ticket.est_bytes > 0:
+            applied = min(disc, ticket.est_bytes - 1)
+            ticket.est_bytes -= applied
+            with self._lock:
+                t.counters["discounted_bytes"] += applied
         if scope is None:
             ticket.scope_id = self.scope.new_scope(tenant, meta=meta)
             ticket._owns_scope = True
@@ -293,7 +316,9 @@ class Server:
         ticket._pool = tp  # reused by _admit; destroyed on rejection
         try:
             plan = tp.plan()
-            ticket.est_bytes = plan.est_bytes()  # None = unbounded
+            # None = unbounded; predicted-shared pages discount here too
+            ticket.est_bytes = plan.est_bytes(
+                discount_bytes=ticket._est_discount)
             if self.conformance:
                 ticket._plan = self.scope.plan_summary(plan)
         except Exception:
@@ -487,7 +512,14 @@ class Server:
                     totals[k] += row.get(k, 0)
             totals["preempts"] = self._preempts_retired + sum(
                 p["preempts"] for p in self.ctx._qos_pool_rows())
-        return {"tenants": tenants, "totals": totals}
+        out = {"tenants": tenants, "totals": totals}
+        # shared-resource counters (prefix cache, speculative decode)
+        for name, fn in self._resource_stats.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                pass
+        return out
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Block until every submitted request is terminal."""

@@ -25,10 +25,11 @@ def _all_plans():
 
 def test_intree_graphs_plan_clean():
     plans = _all_plans()
-    assert len(plans) >= 29
+    assert len(plans) >= 31
     names = {n for n, _ in plans}
     for expected in ("potrf", "gemm_dist", "moe", "ring_attention",
-                     "ops_paged_decode", "coll_reduce_ring",
+                     "ops_paged_decode", "ops_paged_prefill_warm",
+                     "ops_paged_spec_verify", "coll_reduce_ring",
                      "coll_fanout"):
         assert any(expected in n for n in names), names
     dirty = {n: plan_graphs.plan_issues(p) for n, p in plans

@@ -31,7 +31,8 @@ def test_intree_graphs_verify_clean():
     for expected in ("potrf", "potrf_panels", "gemm_dist", "geqrf",
                      "moe", "ring_attention", "ops_rms_norm",
                      "ops_flash_attention", "ops_paged_decode",
-                     "ops_paged_prefill", "coll_reduce_ring",
+                     "ops_paged_prefill", "ops_paged_prefill_warm",
+                     "ops_paged_spec_verify", "coll_reduce_ring",
                      "coll_fanout"):
         assert any(expected in n for n in names), names
     dirty = {n: [repr(f) for f in r.findings]

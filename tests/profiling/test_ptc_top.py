@@ -21,16 +21,25 @@ def test_ptc_top_renders_live_sink(tmp_path, capsys):
         eng = InferenceEngine(
             ctx, PagedLM(PagedLMConfig(vocab=16, d=8, page=4)),
             n_pages=16, max_seqs=4,
-            tenants=[TenantConfig("hi", slo_ms=60_000)])
-        h = eng.submit([1, 2, 3], 2, "hi")
+            tenants=[TenantConfig("hi", slo_ms=60_000)], spec_k=2)
+        # two requests sharing one full-page prefix: the ptc-share
+        # columns (prefix hit rate, spec acceptance) carry real values
+        h = eng.submit([1, 2, 3, 4, 5], 3, "hi")
         eng.run(timeout_s=60)
-        assert h.state == "done"
+        h2 = eng.submit([1, 2, 3, 4, 6], 3, "hi")
+        eng.run(timeout_s=60)
+        assert h.state == "done" and h2.state == "done"
         mon.stop()  # final sample carries the tenant/conformance rows
         eng.close()
     assert top.main(["--live", sink, "--once"]) == 0
     out = capsys.readouterr().out
     assert "tenant" in out and "hi" in out, out
     assert "conformance:" in out, out
+    assert "pfx_hit" in out and "spec_acc" in out, out
+    # the hi row renders a real hit rate, not the "-" placeholder
+    hi_row = [ln for ln in out.splitlines() if ln.startswith("hi")][0]
+    assert "0.25" in hi_row, hi_row  # 1 shared page of 4 prefilled
+    assert "1.00" in hi_row, hi_row  # oracle draft: all accepted
 
 
 def test_ptc_top_no_sinks(tmp_path, capsys):

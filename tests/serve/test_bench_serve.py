@@ -43,3 +43,20 @@ def test_bench_serve_schema():
     assert conf["coverage"] == 1.0, conf
     assert conf["sound"] is True, conf
     assert conf["per_class_classes"] > 0
+    # ptc-share sections (PR 14): prefix cache + speculative decode
+    pfx = doc["prefix"]
+    assert 0.0 < pfx["hit_rate"] <= 1.0
+    assert pfx["bit_identical"] is True
+    assert pfx["fewer_prefill_than_cold"] is True
+    assert pfx["pages_prefilled_warm"] < pfx["pages_prefilled_cold"]
+    assert pfx["warm_tokens_per_s"] > 0
+    sp = doc["spec"]
+    assert sp["bit_identical"] is True
+    assert sp["fewer_waves_than_off"] is True
+    for k in ("off", "k2", "k4"):
+        assert sp[k]["tokens_per_s"] > 0
+    assert sp["k4"]["accept_rate"] == 1.0  # oracle self-draft
+    vw = sp["verify_wave"]
+    assert vw["single_fused_launch"] is True
+    assert vw["fused_marked_launches"] > 0
+    assert vw["device_launches"] < vw["fused_tasks"]

@@ -81,6 +81,22 @@ CHECKS = [
     ("BENCH_serve.json", "scope.tokens_per_s_p50.hi", "higher", 0.50,
      True),
     ("BENCH_serve.json", "scope.conformance.sound", "equal", 0.0, False),
+    # ptc-share (PR 14): prefix-cache hit rate + warm tokens/s and the
+    # k=4 speculative tokens/s are oversubscription-slacked timing
+    # trajectory rows; warm-run and speculative bit-exactness vs the
+    # cold / non-speculative baselines are equal-direction correctness
+    # flags — never relaxed — as are the fewer-prefill-waves and
+    # single-fused-verify-launch evidence verdicts
+    ("BENCH_serve.json", "prefix.hit_rate", "higher", 0.50, True),
+    ("BENCH_serve.json", "prefix.warm_tokens_per_s", "higher", 0.50,
+     True),
+    ("BENCH_serve.json", "prefix.bit_identical", "equal", 0.0, False),
+    ("BENCH_serve.json", "prefix.fewer_prefill_than_cold", "equal", 0.0,
+     False),
+    ("BENCH_serve.json", "spec.k4.tokens_per_s", "higher", 0.50, True),
+    ("BENCH_serve.json", "spec.bit_identical", "equal", 0.0, False),
+    ("BENCH_serve.json", "spec.verify_wave.single_fused_launch",
+     "equal", 0.0, False),
     # ptc-tune (PR 12): autotuned-vs-default ratios on the dispatch
     # chain and the 2-rank collective — timing trajectory rows,
     # oversubscription-slacked per convention; the beats_default

@@ -99,7 +99,8 @@ def render_live(samples):
         lines.append("")
         lines.append(f"{'tenant':<12}{'act':>4}{'q':>4}{'rej':>5}"
                      f"{'done':>6}{'ttft_p99':>10}{'lat_p99':>9}"
-                     f"{'tok/s':>7}{'burn':>6}")
+                     f"{'tok/s':>7}{'burn':>6}{'pfx_hit':>8}"
+                     f"{'spec_acc':>9}")
         for name, t in sorted(tenants.items()):
             lines.append(
                 f"{name:<12}{t.get('active', 0):>4}"
@@ -108,7 +109,9 @@ def render_live(samples):
                 f"{_fmt(t.get('ttft_p99_ms')):>10}"
                 f"{_fmt(t.get('latency_p99_ms')):>9}"
                 f"{_fmt(t.get('tok_s_p50'), 0):>7}"
-                f"{_fmt(t.get('slo_burn')):>6}")
+                f"{_fmt(t.get('slo_burn')):>6}"
+                f"{_fmt(t.get('prefix_hit')):>8}"
+                f"{_fmt(t.get('spec_acc')):>9}")
     if conf:
         lines.append("")
         lines.append(
@@ -123,7 +126,9 @@ def render_url(stats, health_code, health):
     c = stats.get("counters") or {}
     lines.append(f"rank {stats.get('rank', '?')}  "
                  f"healthz={'503 DEGRADED' if health_code == 503 else health_code}")
-    sc = {k: v for k, v in c.items() if k.startswith("ptc_scope_")}
+    sc = {k: v for k, v in c.items()
+          if k.startswith(("ptc_scope_", "ptc_serve_prefix_",
+                           "ptc_serve_spec_"))}
     for k in sorted(sc):
         lines.append(f"  {k} = {sc[k]}")
     wd = (health or {}).get("events") or []

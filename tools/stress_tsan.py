@@ -298,6 +298,101 @@ def metrics_watchdog_coll(workers: int, elems: int, port: int,
                 os.environ[k] = v
 
 
+def prefix_spec_churn(workers: int, reqs_per_thread: int = 6,
+                      env=None) -> None:
+    """ptc-share churn (PR 14): 2 QoS tenants x 2 submitter threads
+    hammer OVERLAPPING prompts through a live InferenceEngine with
+    speculative decoding ON and a page pool small enough to force the
+    whole shared-prefix life cycle — concurrent `acquire_prefix`
+    check-and-reserve against pump-thread retirement (the admission
+    race fix), freeze/hit/refcount churn, COW clones, cached-frozen
+    eviction and speculative page rollback — while the driver thread
+    runs the continuous-batching loop and a reader scrapes the pool
+    counters, stats()["serve"] and the tenant-labelled Prometheus text.
+    TSan watches the pool lock discipline, the engine/server/scope
+    locks and the native QoS-pool churn underneath in one address
+    space; a final bit-exactness spot check keeps the stress honest."""
+    import threading
+    import time
+
+    from parsec_tpu.serve import (InferenceEngine, PagedLM,
+                                  PagedLMConfig, TenantConfig)
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        model = PagedLM(PagedLMConfig(vocab=24, d=8, page=4, seed=5))
+        rng0 = np.random.RandomState(3)
+        common = [list(rng0.randint(0, 24, size=12)) for _ in range(3)]
+        with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+            eng = InferenceEngine(
+                ctx, model, n_pages=40, max_seqs=8,
+                tenants=[TenantConfig("hi", priority=4, weight=3,
+                                      max_pools=4, max_queue=128),
+                         TenantConfig("lo", max_pools=4,
+                                      max_queue=128)],
+                spec_k=2)
+            reg = ctx.metrics_registry()
+            handles, hlock = [], threading.Lock()
+
+            def submitter(tenant, seed):
+                rng = np.random.RandomState(seed)
+                for _ in range(reqs_per_thread):
+                    c = common[rng.randint(len(common))]
+                    tail = list(rng.randint(0, 24,
+                                            size=rng.randint(0, 3)))
+                    h = eng.submit(c[:rng.randint(4, 13)] + tail,
+                                   int(rng.randint(2, 5)), tenant)
+                    with hlock:
+                        handles.append(h)
+
+            stop = threading.Event()
+
+            def reader():
+                while not stop.is_set():
+                    eng.pool.stats()
+                    ctx.stats()["serve"]
+                    reg.prometheus_text()
+                    stop.wait(0.005)
+
+            subs = [threading.Thread(target=submitter, args=(t, s))
+                    for s, t in enumerate(("hi", "lo", "hi", "lo"))]
+            rd = threading.Thread(target=reader, daemon=True)
+            rd.start()
+            for t in subs:
+                t.start()
+            deadline = time.monotonic() + 300
+            while any(t.is_alive() for t in subs) or eng.pending() \
+                    or eng._inflight:
+                assert time.monotonic() < deadline, "churn deadlocked"
+                eng.run(timeout_s=240)
+                time.sleep(0.001)
+            for t in subs:
+                t.join(timeout=60)
+            stop.set()
+            rd.join(timeout=10)
+            st = eng.pool.stats()
+            assert st["free"] + st["cached_free"] == st["n_pages"], st
+            assert st["prefix_hits"] > 0, st
+            with hlock:
+                done = [h for h in handles if h.state == "done"]
+                assert len(done) == len(handles), \
+                    [(h.state, h.tenant) for h in handles]
+            for h in done[:4]:
+                rt, ro = model.reference_generate(h.prompt,
+                                                  h.max_new)
+                assert h.tokens == rt
+                assert np.array_equal(np.stack(h.outputs), ro)
+            eng.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
                 env=None) -> None:
     """Serving-runtime stress under a 2-rank context (one process, a
@@ -594,6 +689,9 @@ def main():
         # serving runtime (PR 9): QoS lanes + concurrent pool
         # creation/retirement + admission churn under a 2-rank context
         serve_churn(workers=4, port=30020 + rep)
+        # ptc-share (PR 14): shared-prefix COW/eviction + speculative
+        # rollback under concurrent submitters, retirement and scrapes
+        prefix_spec_churn(workers=4)
         # wave mega-kernelization (PR 13): fuse cache + online
         # certification on the device manager threads, prefetch-lane
         # peeks, and streamed wire deliveries, 2 colocated ranks

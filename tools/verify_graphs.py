@@ -192,12 +192,18 @@ def g_paged_attention(ctx):
     """The serving runtime's paged KV-cache attention builders
     (ops/paged_attention): a ragged multi-sequence DECODE step (1/2/3
     pages per sequence — the pure-call lookup tables must verify
-    exactly) and a PREFILL with a partial last page."""
+    exactly), a PREFILL with a partial last page, a WARM prefill whose
+    shared-prefix pages read straight from the KV collections
+    (ptc-share: PFILL's domain starts at the cold tail, one sequence
+    fully warm prefilling ZERO pages), and the speculative VERIFY WAVE
+    (pure fold chains over host-staged pages — the one-fused-launch
+    batched verification graph)."""
     from parsec_tpu.ops.paged_attention import (PagePool, SeqSpec,
                                                 build_paged_decode,
                                                 build_paged_prefill,
+                                                build_paged_verify,
                                                 make_slot_collections)
-    pool = PagePool(ctx, 12, 4, 8, name="KV")
+    pool = PagePool(ctx, 16, 4, 8, name="KV")
     _, _, _, _, names = make_slot_collections(ctx, 4, 8, name="PA")
     seqs = [SeqSpec(0, [0, 1, 2], 1), SeqSpec(1, [3], 0),
             SeqSpec(2, [4, 5], 3)]
@@ -207,7 +213,20 @@ def g_paged_attention(ctx):
     pseqs = [SeqSpec(0, [6, 7], 2), SeqSpec(1, [8], 4)]
     pre = build_paged_prefill(ctx, pool, pseqs, names, "PR",
                               [[0, 1], [2]])
-    return [("ops_paged_decode", dec), ("ops_paged_prefill", pre)]
+    # warm prefill: seq 0 shares its first page (cold tail = 1 page),
+    # seq 1 is FULLY warm (PFILL empty; the fold still runs whole)
+    wseqs = [SeqSpec(0, [9, 10], 3), SeqSpec(1, [11, 12], 4)]
+    warm = build_paged_prefill(ctx, pool, wseqs, names, "PR",
+                               [[3, 4], [5, 6]], warm=[1, 2])
+    # speculative verify wave: 3 virtual queries over a shared frozen
+    # prefix [13] with ragged private windows — the engine's k-token
+    # batched verification shape
+    vseqs = [SeqSpec(0, [13, 14], 2), SeqSpec(1, [13, 14, 15], 3),
+             SeqSpec(2, [13], 4)]
+    ver = build_paged_verify(ctx, pool, vseqs, names)
+    return [("ops_paged_decode", dec), ("ops_paged_prefill", pre),
+            ("ops_paged_prefill_warm", warm),
+            ("ops_paged_spec_verify", ver)]
 
 
 def g_coll(ctx):
