@@ -1805,6 +1805,12 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
         # tokens/s are oversubscription-slacked timing trajectory rows
         "prefix": _prefix_bench_section(model, workers=workers),
         "spec": _spec_bench_section(model, workers=workers),
+        # ptc-route: 1 vs 2 replicas behind the fleet router —
+        # aggregate tokens/s scaling and global hit rate are
+        # oversubscription-slacked timing trajectory rows; the
+        # routed-vs-single bit_identical flag is an equal-direction
+        # correctness row bench_check NEVER relaxes
+        "fleet": _fleet_bench_section(model, workers=workers),
     })
     if oversub:
         doc["caveat"] = (
@@ -2004,6 +2010,96 @@ def _spec_bench_section(model, workers=2, n_reqs=8, max_new=8, seed=23):
         "tokens_per_s": vdoc["tokens_per_s"],
     }
     return out
+
+
+def _fleet_bench_section(model, workers=2, groups=3, per_group=4,
+                         max_new=5, seed=29):
+    """ptc-route fleet section: the SAME shared-prefix request mix runs
+    through ONE engine and through TWO replicas behind a Router
+    (prefix-locality scored placement, page migration priced in).
+    Records aggregate tokens/s for both (scaling = fleet / single),
+    the GLOBAL fleet prefix hit rate vs the single replica's, and a
+    routed-vs-single bit_identical flag — the correctness row
+    bench_check NEVER relaxes.  Both runs share one process's cores,
+    so scaling is an efficiency trajectory (oversubscription-slacked),
+    not a speedup claim."""
+    from parsec_tpu.serve import (InferenceEngine, Replica, Router,
+                                  TenantConfig)
+
+    cfg = model.cfg
+    rng = np.random.RandomState(seed)
+    common = [list(rng.randint(0, cfg.vocab, size=3 * cfg.page))
+              for _ in range(groups)]
+    reqs = []
+    for g in range(groups):
+        for _ in range(per_group):
+            tail = list(rng.randint(0, cfg.vocab,
+                                    size=int(rng.randint(1, 4))))
+            reqs.append((common[g] + tail, max_new, "t"))
+
+    def pool_rate(*stats):
+        hits = sum(s["prefix_hits"] for s in stats)
+        misses = sum(s["prefix_misses"] for s in stats)
+        return round(hits / max(1, hits + misses), 4)
+
+    # ---- single replica baseline
+    with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+        eng = InferenceEngine(
+            ctx, model, n_pages=256, max_seqs=32,
+            tenants=[TenantConfig("t", max_pools=32, max_queue=256)])
+        t0 = time.perf_counter()
+        hs = [eng.submit(p, n, t) for p, n, t in reqs]
+        eng.run(timeout_s=300)
+        single_wall = time.perf_counter() - t0
+        single_stats = eng.pool.stats()
+        eng.close()
+    assert all(h.state == "done" for h in hs)
+    tokens = sum(len(h.generated) for h in hs)
+    single_outs = [(h.tokens, np.stack(h.outputs)) for h in hs]
+    single_tok_s = tokens / single_wall
+
+    # ---- 2 replicas behind the router
+    ctxs = [pt.Context(nb_workers=workers, scheduler="lws")
+            for _ in range(2)]
+    try:
+        reps = [Replica(InferenceEngine(
+            c, model, n_pages=256, max_seqs=32,
+            tenants=[TenantConfig("t", max_pools=32, max_queue=256)],
+            name=f"r{i}")) for i, c in enumerate(ctxs)]
+        router = Router(reps)
+        t0 = time.perf_counter()
+        fhs = [router.submit(p, n, tenant=t) for p, n, t in reqs]
+        router.run(timeout_s=300)
+        fleet_wall = time.perf_counter() - t0
+        fleet_stats = [r.pool.stats() for r in reps]
+        rstats = router.stats()
+        router.close()
+    finally:
+        for c in ctxs:
+            c.destroy()
+    assert all(fh.state == "done" for fh in fhs)
+    fleet_tokens = sum(len(fh.generated) for fh in fhs)
+    fleet_tok_s = fleet_tokens / fleet_wall
+    bit_identical = True
+    for fh, (st_, so), (p, n, _t) in zip(fhs, single_outs, reqs):
+        rt, ro = model.reference_generate(p, n)
+        if fh.tokens != st_ or fh.tokens != rt or \
+                not np.array_equal(np.stack(fh.outputs), so) or \
+                not np.array_equal(np.stack(fh.outputs), ro):
+            bit_identical = False
+    return {
+        "replicas": 2, "requests": len(reqs),
+        "groups": groups, "per_group": per_group,
+        "single_tokens_per_s": round(single_tok_s, 1),
+        "fleet_tokens_per_s": round(fleet_tok_s, 1),
+        "scaling": round(fleet_tok_s / max(1e-9, single_tok_s), 3),
+        "single_hit_rate": pool_rate(single_stats),
+        "hit_rate": pool_rate(*fleet_stats),
+        "placed": rstats["router"]["placed"],
+        "migrated_pages": rstats["router"]["migrated_pages"],
+        "migrated_bytes": rstats["router"]["migrated_bytes"],
+        "bit_identical": bit_identical,
+    }
 
 
 def _arg_after(flag, default):

@@ -1359,3 +1359,53 @@ def compare_critpath(plan: Plan, trace) -> dict:
         "executed_path_len": len(executed.get("path", [])),
         "cost_source": plan.makespan.get("cost_source"),
     }
+
+
+# -------------------------------------------------- fleet placement cost
+def placement_cost(est_bytes: int, shared_bytes: int, queued_bytes: int,
+                   active_pools: int, burn_rate: float,
+                   migrate_bytes: int = 0, econ=None,
+                   mem_gbps: float = 16.0) -> float:
+    """Modeled seconds-until-done for placing ONE request on ONE replica
+    — the scalar the fleet router minimizes (serve/router.py).  Three
+    legs, all in seconds so they compose with the fitted transfer
+    economics:
+
+      cold work     the bytes the replica must actually produce —
+                    est_bytes minus the prefix bytes its frozen-page
+                    index already holds (never below 1: the ptc-plan
+                    UNKNOWN sentinel convention) — through a nominal
+                    host-memory bandwidth.  Prefix locality enters the
+                    score HERE, as saved bytes, commensurable with the
+                    wire leg rather than an ad-hoc bonus term.
+      queue         the replica's admitted-but-unfinished bytes plus a
+                    per-active-pool slot cost of a QUARTER request
+                    equivalent (continuous batching overlaps active
+                    sequences, so an occupied slot delays a newcomer by
+                    a fraction of a request, not a full one — and
+                    keeping it in byte-time units means locality vs
+                    occupancy trades off identically at toy and
+                    production page sizes), scaled by (1 + burn_rate):
+                    a replica burning its SLO budget serves its backlog
+                    slower than its steady-state bandwidth suggests, so
+                    pressure is super-linear.
+      wire          econ.cost() of any frozen pages the router would
+                    migrate to create the locality it is pricing in
+                    (disaggregated prefill->decode handoff) — one
+                    rendezvous transfer per bundle on today's chunked
+                    pull path.
+
+    Pure arithmetic under a static model (deliberately so: deterministic
+    placement tests pin tie-breaks), sharing TransferEconomics with the
+    collective selector so a refit of BENCH_comm.json moves BOTH."""
+    if econ is None:
+        from ..comm.economics import default_economics
+        econ = default_economics()
+    per_byte = 1.0 / (max(float(mem_gbps), 1e-3) * (1 << 30))
+    cold = max(1, int(est_bytes) - int(shared_bytes)) * per_byte
+    queue = (max(0, int(queued_bytes))
+             + 0.25 * max(0, int(active_pools)) * max(1, int(est_bytes))
+             ) * per_byte
+    queue *= 1.0 + max(0.0, float(burn_rate))
+    wire = econ.cost(int(migrate_bytes), "rdv") if migrate_bytes else 0.0
+    return cold + queue + wire

@@ -1,0 +1,131 @@
+"""Content-hash KV page migration (ptc-route).
+
+Moves FROZEN prefix-cache pages between PagePools — the fleet tier's
+prefill->decode handoff.  Two transports, one contract:
+
+  migrate_keys           in-process pool-to-pool copy (replicas sharing
+                         a host, and the unit-testable core)
+  build_page_migration   an SPMD taskpool over the comm engine: the
+                         source rank stages each wanted page's exported
+                         payload into a flow, the destination rank's
+                         receive task (placed by affinity) pulls it
+                         through the ordinary remote-dep protocol — so
+                         a page above the eager limit automatically
+                         rides the PR 4 CHUNKED rendezvous
+                         (PUT_CHUNK/watermark streaming, rails,
+                         peer-loss reaping) with NO new frame type and
+                         NO PTC_WIRE_VERSION bump (see MIGRATION.md)
+
+Dedup is RECEIVER-DRIVEN and decided before anything moves: the wanted
+set is computed against the receiver's key digest (Server.advertise),
+so a key the receiver already holds produces no task, no GET and zero
+payload bytes — the content-hash key makes every transfer idempotent
+(the bytes are a pure function of the key; re-sending can only write
+what is already there, and PagePool.import_frozen refuses duplicates
+at refcount-exact cost).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["migrate_keys", "wanted_keys", "build_page_migration"]
+
+
+def wanted_keys(dst_pool, keys: Sequence) -> List:
+    """The subset of `keys` the destination pool does NOT hold — the
+    receiver-driven dedup decision (zero payload bytes for the rest)."""
+    return [k for k in keys if dst_pool.probe([k]) == 0]
+
+
+def migrate_keys(src_pool, dst_pool, keys: Sequence) -> Dict[str, int]:
+    """Copy frozen pages `keys` from src_pool to dst_pool, skipping
+    keys the receiver already holds (zero bytes moved for those) and
+    keys the source no longer holds (evicted: counted, not fatal).
+    Idempotent: running it twice transfers nothing the second time.
+    Returns {"requested", "transferred", "skipped_held",
+    "skipped_missing", "bytes"}."""
+    out = {"requested": len(list(keys)), "transferred": 0,
+           "skipped_held": 0, "skipped_missing": 0, "bytes": 0}
+    for key in keys:
+        if dst_pool.probe([key]):
+            out["skipped_held"] += 1
+            continue
+        payload = src_pool.export_frozen(key)
+        if payload is None:
+            out["skipped_missing"] += 1
+            continue
+        if dst_pool.import_frozen(key, payload[0], payload[1]):
+            out["transferred"] += 1
+            out["bytes"] += dst_pool.bytes_per_page
+        else:
+            out["skipped_held"] += 1  # lost a concurrent import race
+    return out
+
+
+def build_page_migration(pt, ctx, keys: Sequence, wanted_idx: Sequence[int],
+                         src_pool=None, dst_pool=None,
+                         src_rank: int = 0, dst_rank: int = 1,
+                         page: Optional[int] = None,
+                         d: Optional[int] = None,
+                         coll_name: str = "MIG"):
+    """Build the SPMD page-migration taskpool (both ranks run this with
+    the SAME keys and wanted_idx — the execution space must agree).
+
+    MSRC(j), placed on `src_rank`, exports frozen page
+    keys[wanted_idx[j]] into its payload flow; MRECV(j), placed on
+    `dst_rank`, receives the (page, 2d) k|v tile through the remote-dep
+    protocol and imports it under the same key.  With the eager path
+    off (PTC_MCA_comm_eager_limit=0) and chunk_size below the payload,
+    every page streams as ranged GET/PUT_CHUNK frames — the existing
+    chunked pull path, unchanged.
+
+    `src_pool` is required on the source rank, `dst_pool` on the
+    destination rank (an SPMD caller passes its local pool as both —
+    only the rank-local one is touched).  `page`/`d` default from
+    whichever pool is present.  Returns the taskpool, or None when
+    wanted_idx is empty (nothing to migrate — zero tasks, zero bytes)."""
+    wanted = [int(j) for j in wanted_idx]
+    if not wanted:
+        return None
+    pool = src_pool if src_pool is not None else dst_pool
+    P = int(page if page is not None else pool.page)
+    D = int(d if d is not None else pool.d)
+    size = P * 2 * D * 4  # one f32 k|v payload tile
+    nodes = getattr(ctx, "nodes", 1) or 1
+    arr = np.zeros((max(nodes, 2), P * 2 * D), dtype=np.float32)
+    ctx.register_linear_collection(coll_name, arr, elem_size=size,
+                                   nodes=nodes,
+                                   myrank=getattr(ctx, "rank", 0))
+    ctx.register_arena(f"{coll_name}_t", size)
+    tp = pt.Taskpool(ctx, globals={"NM": len(wanted) - 1})
+    j = pt.L("j")
+    msrc = tp.task_class("MSRC")
+    msrc.param("j", 0, pt.G("NM"))
+    msrc.affinity(coll_name, src_rank)
+    mrecv = tp.task_class("MRECV")
+    mrecv.param("j", 0, pt.G("NM"))
+    mrecv.affinity(coll_name, dst_rank)
+
+    def src_body(view):
+        key = keys[wanted[view["j"]]]
+        payload = src_pool.export_frozen(key)
+        assert payload is not None, f"source lost frozen key {key}"
+        buf = view.data("P", dtype=np.float32, shape=(P, 2 * D))
+        buf[:, :D] = payload[0]
+        buf[:, D:] = payload[1]
+
+    msrc.flow("P", "W", pt.Out(pt.Ref("MRECV", j, flow="P")),
+              arena=f"{coll_name}_t")
+    msrc.body(src_body)
+
+    def recv_body(view):
+        key = keys[wanted[view["j"]]]
+        buf = view.data("P", dtype=np.float32, shape=(P, 2 * D))
+        dst_pool.import_frozen(key, buf[:, :D], buf[:, D:])
+
+    mrecv.flow("P", "R", pt.In(pt.Ref("MSRC", j, flow="P")),
+               arena=f"{coll_name}_t")
+    mrecv.body(recv_body)
+    return tp

@@ -28,6 +28,7 @@ check rides on this.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -38,7 +39,28 @@ from ..data.collections import TwoDimBlockCyclic
 
 __all__ = ["PagePool", "SeqSpec", "attend_page", "finalize_attention",
            "build_paged_decode", "build_paged_prefill",
-           "build_paged_verify", "make_slot_collections"]
+           "build_paged_verify", "make_slot_collections",
+           "prefix_page_keys"]
+
+
+def prefix_page_keys(model_id: str, tokens: Sequence[int],
+                     page: int) -> List[str]:
+    """Content-hash keys for a prompt's FULL pages.  Key j digests
+    (model id, tokens[0 : (j+1)*page]) — prefix-CUMULATIVE, so a page's
+    KV bytes are a pure function of its key: a hit can only map onto a
+    page holding exactly the bytes a cold prefill would write, and two
+    PROCESSES (or Server replicas) computing the chain independently
+    agree bit-for-bit.  This is the single definition the engine, the
+    fleet router and the page-migration wire all share — the router
+    predicts a replica's warm-prefix hit length from these keys without
+    touching it, and a migrated page is addressed by them on the wire."""
+    h = hashlib.sha1(str(model_id).encode())
+    keys: List[str] = []
+    for j in range(len(tokens) // page):
+        h.update(np.asarray(tokens[j * page:(j + 1) * page],
+                            np.int64).tobytes())
+        keys.append(h.hexdigest())
+    return keys
 
 
 # ------------------------------------------------------------ page pool
@@ -99,6 +121,9 @@ class PagePool:
             "prefix_hits": 0, "prefix_misses": 0, "shared_bytes": 0,
             "cow_copies": 0, "evictions": 0, "reserve_fails": 0,
             "frozen": 0,
+            # fleet page migration (ptc-route)
+            "exported": 0, "imported": 0, "import_dups": 0,
+            "migrated_in_bytes": 0,
         }
 
     @property
@@ -281,6 +306,70 @@ class PagePool:
         if hasattr(ctx, "host_wrote"):
             ctx.host_wrote(self.Kc, int(p))
             ctx.host_wrote(self.Vc, int(p))
+
+    # ------------------------------------------------- page migration
+    def frozen_keys(self) -> List:
+        """Snapshot of every content key currently indexed (live frozen
+        pages AND cached-free ones) — the raw material for a replica's
+        advertised key digest."""
+        with self._lock:
+            return list(self._index.keys())
+
+    def export_frozen(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Copy a frozen page's (K, V) tiles out by content key, or None
+        when the key is not indexed (e.g. just evicted — the caller
+        treats it as a miss and moves on).  The page is PINNED
+        (refcount++) for the out-of-lock copy, so a concurrent
+        `_take_free_locked` eviction can never recycle it mid-read; the
+        pin is dropped afterwards, re-parking a refcount-0 page on the
+        cached LRU.  Because frozen bytes are a pure function of the
+        key, the returned copy is valid forever — export is idempotent
+        and migration needs no coherence protocol."""
+        with self._lock:
+            p = self._index.get(key)
+            if p is None:
+                return None
+            if self._refs[p] == 0:
+                self._cached.pop(p, None)
+            self._refs[p] += 1
+            self._counters["exported"] += 1
+        k = np.array(self.k_tile(p), copy=True)
+        v = np.array(self.v_tile(p), copy=True)
+        self.release([p])
+        return k, v
+
+    def import_frozen(self, key, k: np.ndarray, v: np.ndarray) -> bool:
+        """Install a migrated frozen page under its content key.  True =
+        page written and indexed (parked refcount-0 on the cached LRU,
+        warm for the next `acquire_prefix`, evictable under pressure);
+        False = the key was already held (or won a concurrent race to
+        the index) — ZERO page bytes written, `import_dups` counted.
+        Idempotent by construction: the key determines the bytes, so a
+        duplicate import has nothing to add."""
+        with self._lock:
+            if key in self._index:
+                self._counters["import_dups"] += 1
+                return False
+            p = self._take_free_locked()
+            if p is None:
+                self._counters["reserve_fails"] += 1
+                return False
+            self._refs[p] = 1  # private until frozen: invisible to probes
+        np.copyto(self.k_tile(p), np.asarray(k, dtype=self.dtype))
+        np.copyto(self.v_tile(p), np.asarray(v, dtype=self.dtype))
+        self.host_wrote(p)
+        if not self.freeze(p, key):
+            # lost a first-writer race since the check above: the winner
+            # holds identical bytes, ours goes straight back (unindexed)
+            self.release([p])
+            with self._lock:
+                self._counters["import_dups"] += 1
+            return False
+        with self._lock:
+            self._counters["imported"] += 1
+            self._counters["migrated_in_bytes"] += self.bytes_per_page
+        self.release([p])  # refcount 0 + indexed -> cached-free LRU
+        return True
 
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:

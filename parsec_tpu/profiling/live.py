@@ -192,6 +192,18 @@ class LiveMonitor:
                     for name, row in sv["tenants"].items()}
             except Exception:
                 pass
+        # fleet rows (ptc-route): the Router registers on every replica
+        # context it fronts; one stats() snapshot per sample feeds the
+        # per-replica table (occupancy, pfx_hit, migrated bytes) that
+        # tools/ptc_top.py draws
+        routers = getattr(ctx, "_routers", None)
+        if routers:
+            try:
+                rt = routers[-1].stats()
+                rec["fleet"] = {"router": rt["router"],
+                                "replicas": rt["replicas"]}
+            except Exception:
+                pass
         reg = getattr(ctx, "_scope_registry", None)
         if reg is not None:
             try:

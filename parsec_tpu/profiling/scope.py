@@ -174,6 +174,9 @@ class ScopeRegistry:
         self._makespan_ratios: deque = deque(maxlen=512)
         self._spill_pred_nonzero = 0
         self._per_class_cost: Dict[str, float] = {}  # last planned ns
+        # structured decision log (fleet router placements, re-routes,
+        # migrations): bounded ring so a long-lived router can't grow it
+        self._events: deque = deque(maxlen=1024)
         try:
             self._comm_base = (ctx.comm_stats()["bytes_sent"]
                                if ctx.comm_enabled else 0)
@@ -352,6 +355,26 @@ class ScopeRegistry:
             if proposed > 0:
                 t.hists["spec_accept_pct"].record(
                     round(100 * accepted / proposed))
+
+    def record_event(self, kind: str, **fields):
+        """ptc-route: one structured fleet decision — placement (with
+        per-replica scores), re-route after a 503 flip, page-migration
+        bundle.  Ring-buffered; `events()` snapshots for dashboards and
+        the deterministic router tests (which assert on WHY a replica
+        won, not just which one)."""
+        ev = {"kind": str(kind), "t_ns": _now_ns()}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        """Snapshot of the structured decision log, oldest first,
+        optionally filtered by kind."""
+        with self._lock:
+            evs = list(self._events)
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind]
+        return evs
 
     @staticmethod
     def plan_summary(plan) -> dict:

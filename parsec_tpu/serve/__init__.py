@@ -18,11 +18,18 @@ layer:
   PagedLM           deterministic toy attention LM (f32, fixed op
                     order) whose batched and sequential runs are
                     bit-identical — the serve bench's correctness oracle
+  Router            fleet tier (ptc-route): prefix-locality scored
+                    placement over N replicas, prefill/decode role
+                    disaggregation, content-hash KV page migration and
+                    queued-only re-placement off unhealthy replicas
 """
 from .server import (AdmissionError, Server, TenantConfig, Ticket)
 from .engine import InferenceEngine, PagedLM, PagedLMConfig, RequestHandle
+from .router import (FleetHandle, KeyDigest, Replica, RoutePolicy,
+                     Router)
 
 __all__ = [
     "Server", "TenantConfig", "Ticket", "AdmissionError",
     "InferenceEngine", "PagedLM", "PagedLMConfig", "RequestHandle",
+    "Router", "Replica", "RoutePolicy", "KeyDigest", "FleetHandle",
 ]

@@ -18,7 +18,6 @@ serve bench's correctness acceptance.
 """
 from __future__ import annotations
 
-import hashlib
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -29,7 +28,8 @@ from ..data.collections import TwoDimBlockCyclic
 from ..ops.paged_attention import (PagePool, SeqSpec, attend_page,
                                    finalize_attention, build_paged_decode,
                                    build_paged_prefill, build_paged_verify,
-                                   make_slot_collections, reset_acc)
+                                   make_slot_collections, prefix_page_keys,
+                                   reset_acc)
 from .server import ResourceBusy, Server, TenantConfig
 
 __all__ = ["PagedLMConfig", "PagedLM", "InferenceEngine", "RequestHandle"]
@@ -218,6 +218,9 @@ class InferenceEngine:
         # the engine's speculative-decode counters
         self.server.register_resource_stats("prefix", self.pool.stats)
         self.server.register_resource_stats("spec", self._spec_stats)
+        # ptc-route: the frozen-page key digest a fleet router scores
+        # placements against (Server.advertise()["prefix"])
+        self.server.register_advertiser("prefix", self._prefix_advert)
         self.body_wrap = body_wrap
         self.dev = dev
         self._lock = threading.Lock()
@@ -240,6 +243,17 @@ class InferenceEngine:
                       "cow_copies": 0, "spec_steps": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_fallbacks": 0}
+
+    def _prefix_advert(self) -> dict:
+        """Advertisement payload (Server.advertise()["prefix"], schema
+        in MIGRATION.md): the exact frozen content-key set plus the
+        scalars a router needs to convert predicted hits into bytes."""
+        keys = self.pool.frozen_keys()
+        return {"mode": "set", "n": len(keys),
+                "keys": [str(k) for k in keys],
+                "model_id": self.model.model_id,
+                "page_bytes": self.pool.bytes_per_page,
+                "free_pages": self.pool.free_pages}
 
     def _spec_stats(self) -> dict:
         with self._lock:
@@ -264,19 +278,13 @@ class InferenceEngine:
 
     # ------------------------------------------------------ prefix keys
     def _page_keys(self, prompt: Sequence[int]) -> List[str]:
-        """Content-hash keys for a prompt's FULL pages.  Key j digests
-        (model id, tokens[0 : (j+1)*page]) — prefix-CUMULATIVE, so a
-        page's KV bytes are a pure function of its key and a hit can
-        only map onto a page holding exactly the bytes a cold prefill
-        would write (shared-prefix warm runs stay bit-identical)."""
-        P = self.model.cfg.page
-        h = hashlib.sha1(self.model.model_id.encode())
-        keys = []
-        for j in range(len(prompt) // P):
-            h.update(np.asarray(prompt[j * P:(j + 1) * P],
-                                np.int64).tobytes())
-            keys.append(h.hexdigest())
-        return keys
+        """Content-hash keys for a prompt's FULL pages — the shared
+        ops.paged_attention.prefix_page_keys chain (ptc-route: the fleet
+        router and the migration wire compute the SAME keys without an
+        engine in hand, so a router-predicted warm hit is exactly what
+        acquire_prefix will find)."""
+        return prefix_page_keys(self.model.model_id, prompt,
+                                self.model.cfg.page)
 
     # ------------------------------------------------------------ submit
     def submit(self, prompt: Sequence[int], max_new: int,
@@ -309,6 +317,15 @@ class InferenceEngine:
             req.state = "rejected"
             req.done_t = time.monotonic()
         return req
+
+    def prefill_warm(self, prompt: Sequence[int],
+                     tenant: str = "default") -> RequestHandle:
+        """Disaggregated-prefill entry point (ptc-route): prefill the
+        prompt, FREEZE its full pages into the prefix cache, emit
+        nothing.  A prefill-role replica runs these so a decode-role
+        replica can import the frozen pages (page migration) and serve
+        the real request fully warm."""
+        return self.submit(prompt, max_new=0, tenant=tenant)
 
     def _build_prefill(self, req: RequestHandle, priority, weight):
         """Server-side builder: admit the page table ATOMICALLY —
@@ -384,6 +401,17 @@ class InferenceEngine:
         if keys:
             for j in range(warm, len(keys)):
                 self.pool.freeze(spec.pages[j], keys[j])
+        if req.max_new <= 0:
+            # prefill-warm (ptc-route disaggregated prefill role): the
+            # request exists only to POPULATE the prefix cache — no
+            # token is emitted, no TTFT recorded.  Retiring releases
+            # the pages; the frozen full ones park on the cached LRU,
+            # warm for export_frozen / the next acquire_prefix.
+            seq = _Seq(req, spec.slot, spec.pages, len(req.prompt))
+            req._seq = seq
+            with self._lock:
+                self._retire_locked(seq)
+            return
         o = self.Oc.tile(spec.slot, 0)[0].copy()
         req.outputs.append(o)
         nxt = self.model.next_token(o)
@@ -693,7 +721,8 @@ class InferenceEngine:
         for req in self.requests:
             if req.state in ("submitted", "active") and \
                     req.ticket is not None and \
-                    req.ticket.state not in ("rejected", "failed"):
+                    req.ticket.state not in ("rejected", "failed",
+                                             "cancelled"):
                 return True
         return False
 
@@ -713,7 +742,8 @@ class InferenceEngine:
         # requests that never passed admission keep their terminal state
         for req in self.requests:
             if req.state == "submitted" and req.ticket is not None and \
-                    req.ticket.state in ("rejected", "failed"):
+                    req.ticket.state in ("rejected", "failed",
+                                         "cancelled"):
                 req.state = req.ticket.state
                 req.done_t = req.done_t or time.monotonic()
 

@@ -76,7 +76,10 @@ class TenantConfig:
 
 class Ticket:
     """One submission's lifecycle handle.  States:
-    queued -> running -> done | failed, or rejected (terminal)."""
+    queued -> running -> done | failed, or rejected / cancelled
+    (terminal).  `cancelled` only ever happens to a still-QUEUED ticket
+    (Server.cancel — the fleet router re-placing work off an unhealthy
+    replica); a running pool is never torn out from under its waves."""
 
     __slots__ = ("tenant", "est_bytes", "meta", "state", "submitted_t",
                  "admitted_t", "done_t", "error", "_event", "_make_pool",
@@ -109,7 +112,7 @@ class Ticket:
 
     @property
     def terminal(self) -> bool:
-        return self.state in ("done", "failed", "rejected")
+        return self.state in ("done", "failed", "rejected", "cancelled")
 
     def wait(self, timeout: Optional[float] = None) -> str:
         """Block until terminal; returns the final state."""
@@ -140,7 +143,7 @@ class _TenantState:
         self.counters = {
             "submitted": 0, "admitted": 0, "rejected": 0,
             "completed": 0, "failed": 0, "resource_waits": 0,
-            "queue_wait_ns": 0, "discounted_bytes": 0,
+            "queue_wait_ns": 0, "discounted_bytes": 0, "cancelled": 0,
         }
 
 
@@ -175,6 +178,11 @@ class Server:
         # PagePool prefix-cache + speculative-decode counters here so
         # they export through Context.stats()["serve"])
         self._resource_stats: Dict[str, Callable[[], dict]] = {}
+        # advertisement providers (ptc-route): cheap snapshots folded
+        # into advertise() — the engine registers its frozen-page key
+        # digest here so a fleet router can predict warm-prefix hits
+        # without scraping full stats()
+        self._advertisers: Dict[str, Callable[[], object]] = {}
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._retired: List[Ticket] = []
@@ -199,6 +207,86 @@ class Server:
         """Export a shared-resource counter snapshot (e.g. the KV
         PagePool's prefix-cache counters) under stats()[name]."""
         self._resource_stats[name] = fn
+
+    def register_advertiser(self, name: str, fn: Callable[[], object]):
+        """Fold `fn()` into advertise() under `name` — the engine
+        registers its frozen-page key digest here (ptc-route)."""
+        self._advertisers[name] = fn
+
+    # ---------------------------------------------------------- fleet
+    def healthy(self) -> bool:
+        """The /healthz verdict a router polls: False once closed or
+        when any tenant's SLO burn rate breached its threshold (the
+        same condition that flips the metrics exporter to 503)."""
+        if self._closed:
+            return False
+        try:
+            slo = self.scope.slo_status()
+        except Exception:
+            return True
+        return not any(st.get("breached") for st in slo.values())
+
+    def advertise(self) -> dict:
+        """Cheap placement snapshot for a fleet router — schema in
+        MIGRATION.md (PR 16).  Deliberately NOT full stats(): occupancy
+        scalars + the max tenant SLO burn rate + whatever digests the
+        engine registered (register_advertiser), typically
+        {"prefix": {"mode": "set"|"bloom", ...}} over the PagePool's
+        frozen content keys."""
+        with self._lock:
+            active = sum(t.active for t in self._tenants.values())
+            queued = sum(len(t.queue) for t in self._tenants.values())
+            queued_bytes = sum(t.queued_bytes
+                               for t in self._tenants.values())
+        burn = 0.0
+        try:
+            for st in self.scope.slo_status().values():
+                burn = max(burn, float(st.get("burn_rate") or 0.0))
+        except Exception:
+            pass
+        out = {
+            "name": self.name,
+            "healthy": self.healthy(),
+            "active_pools": active,
+            "queue_depth": queued,
+            "queued_bytes": queued_bytes,
+            "slo_burn_rate": round(burn, 4),
+        }
+        for name, fn in self._advertisers.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                pass
+        return out
+
+    def cancel(self, ticket: Ticket) -> bool:
+        """Withdraw a still-QUEUED ticket (fleet re-placement off an
+        unhealthy replica).  True = removed from its tenant's queue and
+        marked terminal `cancelled` (counted, never silently dropped);
+        False = already running or terminal — a decoding sequence is
+        NEVER re-placed, per the fleet contract."""
+        t = self._tenants.get(ticket.tenant)
+        if t is None:
+            return False
+        with self._lock:
+            if ticket.state != "queued":
+                return False
+            try:
+                t.queue.remove(ticket)
+            except ValueError:
+                return False  # racing _pump_loop already popped it
+            t.queued_bytes -= ticket.est_bytes or 0
+            t.counters["cancelled"] += 1
+            ticket.state = "cancelled"
+            ticket.done_t = time.monotonic()
+            ticket._event.set()
+        if ticket._owns_scope and ticket.scope_id is not None:
+            # scope-side terminal: counts as a rejection (the router's
+            # re-route counter pairs with it so nothing is lost)
+            self.scope.record_rejected(ticket.scope_id)
+        if ticket._pool is not None:
+            self._destroy_pool(ticket)  # planning pool never admitted
+        return True
 
     def submit(self, tenant: str, make_pool: Callable, est_bytes: int = 0,
                meta=None, wait: bool = False,
@@ -499,7 +587,7 @@ class Server:
             totals = {"submitted": 0, "admitted": 0, "rejected": 0,
                       "completed": 0, "failed": 0, "resource_waits": 0,
                       "queue_depth": 0, "queued_bytes": 0,
-                      "active_pools": 0}
+                      "active_pools": 0, "cancelled": 0}
             for name, t in self._tenants.items():
                 row = dict(t.counters)
                 row["queue_depth"] = len(t.queue)

@@ -2253,3 +2253,148 @@ def gemm_dist_wave_fuse(rank: int, nodes: int, port: int, N: int = 64,
                 f"tile {key} differs fused vs unfused"
         ctx.comm_fence()
         ctx.comm_fini()
+
+
+# ------------------------------------------------------ page migration
+def _author_page(pool, key, seed, page, d):
+    """Freeze one page whose bytes are a pure function of `seed` — the
+    content-hash contract (same key <=> same bytes) migration rides."""
+    import numpy as np_
+
+    p = pool.alloc()
+    assert p is not None
+    rng = np_.random.RandomState(seed)
+    pool.k_tile(p)[...] = rng.randn(page, d).astype(np_.float32)
+    pool.v_tile(p)[...] = rng.randn(page, d).astype(np_.float32)
+    pool.host_wrote(p)
+    assert pool.freeze(p, key)
+    pool.release([p])
+
+
+def migrate_pages_wire(rank: int, nodes: int, port: int, n_keys: int = 4,
+                       held: int = 0, page: int = 16, d: int = 16,
+                       chunk: int = 1024):
+    """ptc-route fleet handoff over the wire: rank 0's PagePool holds
+    `n_keys` frozen content-keyed pages; rank 1 already holds the first
+    `held` of them.  build_page_migration moves ONLY the wanted tail —
+    each page's k|v payload rides the ordinary remote-dep pull, which
+    with eager off and chunk_size << page bytes means the PR 4 CHUNKED
+    streaming path (no new frame type, no wire version bump).  The
+    receiver asserts bit-exact imported bytes and, when everything was
+    already held, that ZERO payload chunks moved (the dedup ack)."""
+    import os
+
+    from parsec_tpu.comm.migrate import build_page_migration
+    from parsec_tpu.ops.paged_attention import (PagePool,
+                                                prefix_page_keys)
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
+    os.environ["PTC_MCA_comm_inflight"] = "3"
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        pool = PagePool(ctx, n_keys + 2, page, d, name="MIGP")
+        keys = prefix_page_keys("wire-model", list(range(n_keys * page)),
+                                page)
+        if rank == 0:
+            for j, key in enumerate(keys):
+                _author_page(pool, key, 1000 + j, page, d)
+        elif held:
+            for j in range(held):
+                _author_page(pool, keys[j], 1000 + j, page, d)
+        # both ranks must agree on the execution space: in the fleet the
+        # receiver's advertised digest decides this; here it is static
+        wanted = list(range(held, n_keys))
+        tp = build_page_migration(pt, ctx, keys, wanted,
+                                  src_pool=pool, dst_pool=pool,
+                                  src_rank=0, dst_rank=1,
+                                  page=page, d=d)
+        if tp is None:
+            assert held == n_keys
+        else:
+            tp.run()
+            tp.wait()
+        ctx.comm_fence()
+        tune = ctx.comm_tuning()
+        if rank == 1:
+            st = pool.stats()
+            assert st["imported"] == n_keys - held, st
+            assert st["migrated_in_bytes"] == \
+                (n_keys - held) * pool.bytes_per_page, st
+            assert pool.probe(keys) == n_keys, st
+            rng_mod = np.random
+            for j, key in enumerate(keys):
+                rng = rng_mod.RandomState(1000 + j)
+                p = pool._index[key]
+                assert (pool.k_tile(p) ==
+                        rng.randn(page, d).astype(np.float32)).all(), j
+                assert (pool.v_tile(p) ==
+                        rng.randn(page, d).astype(np.float32)).all(), j
+            if wanted:
+                # each page (page*2*d*4 bytes) exceeds chunk_size: the
+                # payloads must have streamed as chunked pulls
+                assert page * 2 * d * 4 > chunk
+                assert tune["chunks_recv"] > 0, tune
+            else:
+                # everything deduped at the receiver: NOT ONE payload
+                # chunk crossed the wire
+                assert tune["chunks_recv"] == 0, tune
+        if rank == 0 and wanted:
+            assert pool.stats()["exported"] == len(wanted), pool.stats()
+        rd = ctx.comm_rdv_stats()
+        assert rd["pending_pulls"] == 0 and rd["registered_bytes"] == 0, rd
+        ctx.comm_fini()
+
+
+def migrate_kill_receiver(rank: int, nodes: int, port: int,
+                          page: int = 512, d: int = 128,
+                          chunk: int = 4096, die_after_s: float = 1.0):
+    """2-replica kill-a-receiver: the decode replica (rank 1) dies
+    mid-chunked-page-pull; the prefill replica (rank 0) must REAP the
+    dead puller's streaming session and expectation records (reap
+    counter up, registered bytes back to zero) instead of pinning the
+    exported page for the life of the engine.  The dying rank pushes
+    nothing; only rank 0 is collected."""
+    import os
+    import threading
+    import time as _time
+
+    from parsec_tpu.comm.migrate import build_page_migration
+    from parsec_tpu.ops.paged_attention import PagePool
+    from parsec_tpu.utils.faults import apply_comm_faults
+
+    os.environ["PTC_MCA_comm_eager_limit"] = "0"
+    os.environ["PTC_MCA_comm_chunk_size"] = str(chunk)
+    os.environ["PTC_MCA_comm_inflight"] = "2"
+    if rank == 1:
+        # crawl: ~20 ms per recv makes the 128-chunk page pull take far
+        # longer than die_after_s, so death lands mid-session
+        apply_comm_faults(delay_us=20000)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        pool = PagePool(ctx, 2, page, d, name="MIGP")
+        key = "victim-page"
+        if rank == 0:
+            _author_page(pool, key, 7, page, d)
+        tp = build_page_migration(pt, ctx, [key], [0],
+                                  src_pool=pool, dst_pool=pool,
+                                  src_rank=0, dst_rank=1,
+                                  page=page, d=d)
+        if rank == 1:
+            threading.Timer(die_after_s, lambda: os._exit(0)).start()
+        tp.run()
+        if rank == 1:
+            tp.wait()  # never finishes: the timer kills the process
+            return
+        tp.wait()
+        deadline = _time.time() + 90.0
+        st = rd = None
+        while _time.time() < deadline:
+            st = ctx.comm_stream_stats()
+            rd = ctx.comm_rdv_stats()
+            if st["reaps"] >= 1 and rd["registered_bytes"] == 0:
+                break
+            _time.sleep(0.1)
+        assert st is not None and st["reaps"] >= 1, (st, rd)
+        assert rd["registered_bytes"] == 0, rd
+        ctx.comm_fini()

@@ -1,0 +1,73 @@
+"""Fleet KV page migration over the streaming wire (ptc-route).
+
+The prefill->decode handoff ships frozen content-keyed pages through
+the ORDINARY remote-dep pull path: with eager off and the page payload
+above chunk_size, every page streams as ranged GET/PUT_CHUNK frames —
+the PR 4 chunked rendezvous, unchanged (no new frame type, no
+PTC_WIRE_VERSION bump).  Covered here:
+
+  - a 2-rank transfer whose receiver imports bit-exact pages and whose
+    payloads demonstrably rode the chunked path (chunks_recv > 0)
+  - receiver-side dedup: keys the receiver already holds produce no
+    task, no GET and ZERO payload chunks (counter-asserted)
+  - kill-a-receiver: the source reaps the dead puller's streaming
+    session instead of pinning the exported page forever
+"""
+import multiprocessing as mp
+
+import pytest
+
+from . import _workers
+from .test_multirank import _pick_base_port, _run_spmd
+
+
+def test_migrate_pages_chunked_2ranks():
+    """4 frozen pages rank0 -> rank1, all cold at the receiver: every
+    payload streams chunked, the import is bit-exact and warm."""
+    _run_spmd(_workers.migrate_pages_wire, 2, timeout=240.0, n_keys=4)
+
+
+def test_migrate_pages_partial_dedup():
+    """Receiver already holds the first 2 of 4 keys: only the wanted
+    tail moves (imported == 2), the held pages never re-transfer."""
+    _run_spmd(_workers.migrate_pages_wire, 2, timeout=240.0, n_keys=4,
+              held=2)
+
+
+def test_migrate_pages_full_dedup_zero_bytes():
+    """Receiver holds EVERYTHING: zero tasks, zero GETs, zero payload
+    chunks on the wire — the content-hash ack-and-skip."""
+    _run_spmd(_workers.migrate_pages_wire, 2, timeout=240.0, n_keys=3,
+              held=3)
+
+
+@pytest.mark.slow
+def test_migrate_kill_receiver_reaps_session():
+    """2-replica kill-a-receiver on the migration stream: rank 1 dies
+    mid-chunked-page-pull; rank 0 must reap its streaming session
+    (reaps >= 1, registered bytes drained to zero).  The dying rank
+    pushes no result; only rank 0 is collected."""
+    nodes = 2
+    port = _pick_base_port(nodes)
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    procs = [
+        mpctx.Process(target=_workers.run,
+                      args=(_workers.migrate_kill_receiver, r, nodes,
+                            port, q))
+        for r in range(nodes)
+    ]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nodes - 1):  # rank 1 dies silently
+            results.append(q.get(timeout=240.0))
+    finally:
+        for p in procs:
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in results if r[0] != "ok"]
+    assert not errs, "\n".join(str(e) for e in errs)
+    assert [r[1] for r in results] == [0], results

@@ -1,0 +1,196 @@
+"""Content-hash KV page migration (ptc-route): export/import between
+PagePools is idempotent and dedupable (a receiver already holding a key
+moves ZERO bytes), refcount-exact, and safe under concurrent eviction
+pressure -- a shared page is never dropped."""
+import threading
+
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.comm.migrate import migrate_keys, wanted_keys
+from parsec_tpu.ops.paged_attention import PagePool, prefix_page_keys
+
+PAGE, D = 4, 8
+
+
+def _pool(ctx, n_pages, name):
+    return PagePool(ctx, n_pages, PAGE, D, name=name)
+
+
+def _freeze(pool, key, seed):
+    """Author one frozen page whose bytes are a pure function of
+    `seed` (the content-hash contract migration relies on)."""
+    p = pool.alloc()
+    assert p is not None
+    rng = np.random.RandomState(seed)
+    pool.k_tile(p)[...] = rng.randn(PAGE, D).astype(np.float32)
+    pool.v_tile(p)[...] = rng.randn(PAGE, D).astype(np.float32)
+    pool.host_wrote(p)
+    assert pool.freeze(p, key)
+    pool.release([p])  # refcount 0: parks on the cached LRU, warm
+    return p
+
+
+def _page_bytes(pool, key):
+    p = pool._index[key]
+    return (np.array(pool.k_tile(p)), np.array(pool.v_tile(p)))
+
+
+def test_migrate_transfers_once_then_dedups():
+    """Same key migrated twice: the second run moves ZERO bytes
+    (counter-asserted), and a receiver already holding the key skips
+    the payload entirely."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        src = _pool(ctx, 8, "SRC")
+        dst = _pool(ctx, 8, "DST")
+        keys = prefix_page_keys("m", list(range(12)), PAGE)
+        for j, k in enumerate(keys):
+            _freeze(src, k, seed=j)
+        assert wanted_keys(dst, keys) == keys
+        res = migrate_keys(src, dst, keys)
+        assert res == {"requested": 3, "transferred": 3,
+                       "skipped_held": 0, "skipped_missing": 0,
+                       "bytes": 3 * dst.bytes_per_page}
+        # bytes are bit-exact and warm for the next acquire
+        for j, k in enumerate(keys):
+            sk, sv = _page_bytes(src, k)
+            dk, dv = _page_bytes(dst, k)
+            assert np.array_equal(sk, dk) and np.array_equal(sv, dv)
+        assert dst.probe(keys) == 3
+        # refcount-exact: imported pages sit at refcount 0 on the LRU
+        for k in keys:
+            assert dst.refcount(dst._index[k]) == 0
+        assert dst.free_pages == 8  # 5 never written + 3 cached
+        # idempotence: run it again -> zero transfers, zero bytes
+        res2 = migrate_keys(src, dst, keys)
+        assert res2["transferred"] == 0 and res2["bytes"] == 0
+        assert res2["skipped_held"] == 3
+        assert dst.stats()["imported"] == 3
+        assert dst.stats()["migrated_in_bytes"] == 3 * dst.bytes_per_page
+        # a source that no longer holds a key is counted, not fatal
+        res3 = migrate_keys(src, dst.__class__(ctx, 4, PAGE, D,
+                                               name="DST2"),
+                            list(keys) + ["ghost"])
+        assert res3["transferred"] == 3 and res3["skipped_missing"] == 1
+
+
+def test_import_refuses_duplicates_refcount_exact():
+    """A duplicate import (lost race / re-delivered payload) is refused
+    with no page leaked and the EXISTING page untouched -- re-sending
+    can only write what is already there."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        src = _pool(ctx, 4, "SRC")
+        dst = _pool(ctx, 4, "DST")
+        key = "k0"
+        _freeze(src, key, seed=7)
+        payload = src.export_frozen(key)
+        assert payload is not None
+        assert src.stats()["exported"] == 1
+        # the export pinned and released: source refcount back to 0
+        assert src.refcount(src._index[key]) == 0
+        assert dst.import_frozen(key, *payload)
+        free0 = dst.free_pages
+        p0 = dst._index[key]
+        before = _page_bytes(dst, key)
+        assert not dst.import_frozen(key, payload[0] * 2, payload[1])
+        assert dst.stats()["import_dups"] == 1
+        assert dst.free_pages == free0          # no page leaked
+        assert dst._index[key] == p0            # same page, untouched
+        after = _page_bytes(dst, key)
+        assert np.array_equal(before[0], after[0])
+        assert np.array_equal(before[1], after[1])
+        assert dst.export_frozen("missing") is None
+
+
+def test_shared_page_survives_eviction_pressure_during_migration():
+    """Eviction under migration never drops a shared page: with the
+    imported page ACQUIRED (refcount 1) on the receiver, allocation
+    pressure evicts only refcount-0 cached pages; the sharer's bytes
+    stay bit-exact and the free accounting balances."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        src = _pool(ctx, 8, "SRC")
+        dst = _pool(ctx, 4, "DST")
+        keys = [f"k{j}" for j in range(3)]
+        for j, k in enumerate(keys):
+            _freeze(src, k, seed=j)
+        assert migrate_keys(src, dst, keys)["transferred"] == 3
+        # a consumer maps the first page warm and HOLDS it
+        got = dst.acquire_prefix(keys[:1], 1)
+        assert got is not None and got[1] == 1
+        held = got[0][0]
+        want = _page_bytes(dst, keys[0])
+        # pressure: grab every allocatable page -> evicts the OTHER two
+        # cached pages but can never touch the held one
+        grabbed = dst.reserve(3)
+        assert grabbed is not None and held not in grabbed
+        assert dst.stats()["evictions"] == 2
+        assert dst.probe(keys[:1]) == 1         # still indexed
+        now = _page_bytes(dst, keys[0])
+        assert np.array_equal(want[0], now[0])
+        assert np.array_equal(want[1], now[1])
+        # re-migration restores the evicted keys (idempotent repair)
+        dst.release(grabbed)
+        res = migrate_keys(src, dst, keys)
+        assert res["transferred"] == 2 and res["skipped_held"] == 1
+        assert dst.probe(keys) == 3
+        dst.release([held])
+        assert dst.free_pages == 4
+        assert all(dst.refcount(p) == 0 for p in range(4))
+
+
+def test_concurrent_migration_and_eviction_churn():
+    """Threaded churn: one thread re-migrates a key set while another
+    hammers reserve/release (forcing LRU evictions of cached frozen
+    pages).  Invariants at every quiesce: page accounting balances,
+    no refcount leaks, and every still-indexed key is bit-exact."""
+    with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+        src = _pool(ctx, 8, "SRC")
+        dst = _pool(ctx, 4, "DST")
+        keys = [f"c{j}" for j in range(4)]
+        blobs = {}
+        for j, k in enumerate(keys):
+            _freeze(src, k, seed=100 + j)
+            blobs[k] = _page_bytes(src, k)
+        stop = threading.Event()
+        errs = []
+
+        def migrator():
+            try:
+                while not stop.is_set():
+                    migrate_keys(src, dst, keys)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        def evictor():
+            try:
+                while not stop.is_set():
+                    got = dst.reserve(2)
+                    if got:
+                        dst.release(got)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=migrator),
+              threading.Thread(target=evictor)]
+        for t in ts:
+            t.start()
+        import time
+        time.sleep(0.4)
+        stop.set()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        # quiesced invariants: accounting balances, nothing leaked
+        st = dst.stats()
+        assert st["free"] + st["cached_free"] == 4, st
+        assert all(dst.refcount(p) == 0 for p in range(4))
+        # every key still indexed carries its exact authored bytes
+        for k in keys:
+            if dst.probe([k]):
+                dk, dv = _page_bytes(dst, k)
+                assert np.array_equal(dk, blobs[k][0])
+                assert np.array_equal(dv, blobs[k][1])
+        # and a final idempotent pass restores full warmth
+        migrate_keys(src, dst, keys)
+        assert dst.probe(keys) == 4

@@ -1,0 +1,290 @@
+"""Fleet router (ptc-route): deterministic scored placement, digest
+warm-prefix prediction vs the pool's actual acquire, disaggregated
+prefill/decode handoff, and queued-only re-placement off unhealthy
+replicas."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.ops.paged_attention import prefix_page_keys
+from parsec_tpu.serve import (InferenceEngine, KeyDigest, PagedLM,
+                              PagedLMConfig, Replica, RoutePolicy,
+                              Router, TenantConfig)
+
+CFG = PagedLMConfig(vocab=32, d=8, page=4, seed=2)
+
+
+def _fleet(model, n=2, n_pages=24, roles=None, tenants=None):
+    ctxs, reps = [], []
+    for i in range(n):
+        ctx = pt.Context(nb_workers=2, scheduler="lws")
+        eng = InferenceEngine(
+            ctx, model, n_pages=n_pages, max_seqs=4,
+            tenants=tenants or [TenantConfig("t")], name=f"r{i}")
+        ctxs.append(ctx)
+        reps.append(Replica(eng, role=(roles or {}).get(i, "mixed")))
+    return ctxs, reps
+
+
+def _teardown(router, ctxs):
+    router.close()
+    for c in ctxs:
+        c.destroy()
+
+
+def _advert(keys=(), healthy=True, queued_bytes=0, active_pools=0,
+            burn=0.0, page_bytes=256):
+    return {"healthy": healthy, "queued_bytes": queued_bytes,
+            "active_pools": active_pools, "slo_burn_rate": burn,
+            "prefix": {"mode": "set", "keys": [str(k) for k in keys],
+                       "page_bytes": page_bytes}}
+
+
+# ---------------------------------------------------------------- digest
+def test_key_digest_set_and_bloom():
+    keys = [f"k{i:02d}" for i in range(8)]
+    ds = KeyDigest("set", keys)
+    db = KeyDigest("bloom", keys, m=1024, k=3)
+    for k in keys:
+        assert k in ds and k in db  # bloom: NEVER a false negative
+    assert "nope" not in ds
+    # exact predict on the set digest; bloom is an upper bound
+    chain = keys[:4] + ["cold0", "cold1"]
+    assert ds.predict_warm(chain) == 4
+    assert db.predict_warm(chain) >= 4
+    # advert round-trip + merge
+    ds2 = KeyDigest.from_advert(ds.to_advert())
+    assert ds2.predict_warm(chain) == 4
+    m = KeyDigest("set", keys[:2]).merge(KeyDigest("set", keys[2:5]))
+    assert m.predict_warm(keys) == 5
+    bm = KeyDigest.from_advert(db.to_advert()).merge(
+        KeyDigest("bloom", ["extra"], m=1024, k=3))
+    assert "extra" in bm and keys[0] in bm
+    # garbled / missing adverts decode to an empty (cold) digest
+    assert KeyDigest.from_advert(None).predict_warm(chain) == 0
+    assert KeyDigest.from_advert({"mode": "bloom", "bits": "zz"}) \
+        .predict_warm(chain) == 0
+
+
+# ------------------------------------------------------------- placement
+def test_placement_prediction_matches_acquire_prefix_exactly():
+    """The router's digest-predicted warm length is EXACTLY what the
+    chosen replica's pool maps warm on admission — pinned against
+    probe() and against the engine's real prefix_hits delta."""
+    model = PagedLM(CFG)
+    ctxs, reps = _fleet(model, n=2)
+    router = Router(reps)
+    try:
+        shared = [1, 2, 3, 4, 5, 6, 7, 8]      # 2 full pages
+        fh0 = router.submit(shared + [9], 3, tenant="t",
+                            adverts={0: _advert(), 1: _advert()})
+        assert fh0.replica is reps[0]          # cold tie -> replica 0
+        router.run(timeout_s=120)
+
+        prompt = shared + [10, 11, 12, 13, 14]  # shares 2 frozen pages
+        keys = prefix_page_keys(model.model_id, prompt, CFG.page)
+        rows = router.score(prompt)            # live adverts this time
+        by = {r["replica"]: r for r in rows}
+        # digest prediction == pool.probe == 2 shared pages, replica 0
+        assert by[0]["warm"] == reps[0].pool.probe(keys) == 2
+        assert by[1]["warm"] == reps[1].pool.probe(keys) == 0
+        assert by[0]["cost"] < by[1]["cost"]
+        hits0 = reps[0].pool.stats()["prefix_hits"]
+        fh1 = router.submit(prompt, 3, tenant="t")
+        assert fh1.replica is reps[0]
+        router.run(timeout_s=120)
+        # the actual acquire mapped exactly the predicted pages warm
+        assert reps[0].pool.stats()["prefix_hits"] - hits0 == 2
+        rt, _ = model.reference_generate(prompt, 3)
+        assert fh1.tokens == rt
+    finally:
+        _teardown(router, ctxs)
+
+
+def test_tie_break_and_occupancy_pressure_pinned():
+    """Injected adverts pin the policy arithmetic: exact ties break to
+    the LOWEST index; queue pressure and SLO burn flip a warm-but-
+    overloaded replica below a cold idle one."""
+    model = PagedLM(CFG)
+    ctxs, reps = _fleet(model, n=2)
+    router = Router(reps, RoutePolicy(migrate=False))
+    try:
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+        keys = prefix_page_keys(model.model_id, prompt, CFG.page)
+        # exact tie (identical adverts) -> replica 0
+        rows = router.score(prompt, adverts={0: _advert(), 1: _advert()})
+        assert rows[0]["cost"] == rows[1]["cost"]
+        assert router._choose(rows)["replica"] == 0
+        # locality wins when load is equal: replica 1 warm -> chosen
+        rows = router.score(prompt, adverts={
+            0: _advert(), 1: _advert(keys=keys)})
+        assert router._choose(rows)["replica"] == 1
+        # occupancy pressure: the warm replica drowning in queued bytes
+        # and burning its SLO budget loses to the cold idle one
+        rows = router.score(prompt, adverts={
+            0: _advert(),
+            1: _advert(keys=keys, queued_bytes=1 << 30,
+                       active_pools=64, burn=1.0)})
+        assert router._choose(rows)["replica"] == 0
+        # unhealthy is never chosen while an alternative exists
+        rows = router.score(prompt, adverts={
+            0: _advert(healthy=False), 1: _advert(queued_bytes=1 << 30)})
+        assert rows[0]["cost"] == float("inf")
+        assert router._choose(rows)["replica"] == 1
+    finally:
+        _teardown(router, ctxs)
+
+
+def test_fleet_bit_identical_and_migration_priced_in():
+    """Shared-prefix mix over 2 replicas: every routed output is
+    bit-identical to the reference; a cold replica advertised next to a
+    warm donor triggers a priced-in page migration instead of a cold
+    prefill."""
+    model = PagedLM(CFG)
+    ctxs, reps = _fleet(model, n=2)
+    # toy pages are a few hundred bytes, so under the real fitted wire
+    # economics a cold prefill is always cheaper than a transfer; a
+    # slow-memory setting scales the discount up and pins the
+    # migration-decision arithmetic
+    router = Router(reps, RoutePolicy(mem_gbps=1e-4))
+    try:
+        shared = [3, 1, 4, 1, 5, 9, 2, 6]
+        reqs = [(shared + [7 + i], 4) for i in range(4)]
+        # pin phase 1 onto replica 0 (replica 1 advertised overloaded)
+        # so replica 1 stays genuinely cold for the migration phase
+        pin = {0: _advert(), 1: _advert(queued_bytes=1 << 30)}
+        fhs = [router.submit(p, n, tenant="t", adverts=pin)
+               for p, n in reqs]
+        router.run(timeout_s=120)
+        for fh, (p, n) in zip(fhs, reqs):
+            assert fh.state == "done"
+            rt, ro = model.reference_generate(p, n)
+            assert fh.tokens == rt
+            assert np.array_equal(np.stack(fh.outputs), ro)
+        # force the migration decision: replica 0 warm donor, replica 1
+        # cold but the only healthy target
+        keys = prefix_page_keys(model.model_id, shared, CFG.page)
+        assert reps[0].pool.probe(keys) == 2
+        rows = router.score(shared + [9], adverts={
+            0: _advert(keys=keys, healthy=False),
+            1: _advert()})
+        best = router._choose(rows)
+        assert best["replica"] == 1
+        assert best["migrate_pages"] == 2 and best["migrate_from"] == 0
+        res = router.migrate(keys, dst=reps[1], src=reps[0])
+        assert res["transferred"] == 2
+        assert reps[1].pool.probe(keys) == 2
+        assert router.counters["migrated_pages"] == 2
+        ev = reps[1].engine.scope.events("page_migration")
+        assert ev and ev[-1]["transferred"] == 2
+    finally:
+        _teardown(router, ctxs)
+
+
+def test_prefill_then_decode_disaggregated_bit_identical():
+    """Prefill-role replica freezes the pages (emitting nothing), the
+    decode replica imports them and serves the request fully warm —
+    output bit-identical to the single-replica reference."""
+    model = PagedLM(CFG)
+    ctxs, reps = _fleet(model, n=2, roles={0: "prefill", 1: "decode"})
+    router = Router(reps)
+    try:
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        fh = router.prefill_then_decode(prompt, 5, tenant="t")
+        assert fh.replica is reps[1]
+        router.run(timeout_s=120)
+        assert fh.state == "done"
+        rt, ro = model.reference_generate(prompt, 5)
+        assert fh.tokens == rt
+        assert np.array_equal(np.stack(fh.outputs), ro)
+        # both full prompt pages migrated and mapped warm on decode
+        dstats = reps[1].pool.stats()
+        assert dstats["imported"] == 2
+        assert dstats["prefix_hits"] >= 2
+        assert router.counters["prefill_jobs"] == 1
+        assert router.counters["migrated_pages"] == 2
+        # the prefill job emitted nothing (it only warmed the cache)
+        assert reps[0].engine.stats["retired"] == 1
+    finally:
+        _teardown(router, ctxs)
+
+
+# ---------------------------------------------------------- re-placement
+def test_requeued_request_replaced_off_unhealthy_replica():
+    """A request still QUEUED on a replica whose health flips (the
+    /healthz 503 condition: SLO burn breach) is cancelled and re-placed
+    on the healthy replica; the cancelled->rerouted counter pair proves
+    nothing is dropped.  The decoding request on the sick replica is
+    NEVER touched."""
+    model = PagedLM(CFG)
+    # replica 0: room for exactly one active sequence, so the second
+    # submission parks in the tenant queue (ResourceBusy -> requeue)
+    tenants = [TenantConfig("t"), TenantConfig("probe", slo_ms=1e-6,
+                                               slo_burn=0.5)]
+    ctxs, reps = _fleet(model, n=2, n_pages=2, tenants=tenants)
+    router = Router(reps)
+    try:
+        # r1 occupies both pages of replica 0 (prompt 6 tokens -> 2
+        # pages; both decode tokens fit the tail page) and with
+        # max_new=2 it PARKS as an active sequence holding its pages
+        # until the decode loop -- which we have not driven yet
+        fh1 = router.submit([1, 2, 3, 4, 5, 6], 2, tenant="t",
+                            adverts={0: _advert(), 1: _advert()})
+        assert fh1.replica is reps[0]
+        # r2 cannot reserve a page on replica 0 -> stays queued there
+        fh2 = router.submit([8, 9, 10, 11], 2, tenant="t",
+                            adverts={0: _advert(),
+                                     1: _advert(queued_bytes=1 << 30)})
+        assert fh2.replica is reps[0]
+        assert fh2.handle.ticket.state == "queued"
+        # replica 0's health flips: one blown probe-tenant request
+        # breaches its (microscopic) SLO -> burn 1.0 -> /healthz 503
+        sid = reps[0].engine.scope.new_scope("probe")
+        reps[0].engine.scope.record_done(sid)
+        assert not reps[0].server.healthy()
+        assert reps[1].server.healthy()
+        moved = router._pump()
+        assert moved == 1
+        assert fh2.replica is reps[1] and fh2.reroutes == 1
+        assert router.counters["rerouted"] == 1
+        # the cancel is accounted server-side -- not a silent drop
+        assert reps[0].server.stats()["tenants"]["t"]["cancelled"] == 1
+        ev = reps[1].engine.scope.events("route_replace")
+        assert ev and ev[-1]["from_replica"] == "r0"
+        # fh1 keeps decoding on the unhealthy replica to completion
+        router.run(timeout_s=120)
+        for fh, (p, n) in ((fh1, ([1, 2, 3, 4, 5, 6], 2)),
+                           (fh2, ([8, 9, 10, 11], 2))):
+            assert fh.state == "done"
+            rt, _ = model.reference_generate(p, n)
+            assert fh.tokens == rt
+        assert fh1.reroutes == 0
+    finally:
+        _teardown(router, ctxs)
+
+
+def test_no_healthy_replica_is_counted_not_silent():
+    """With every alternative unhealthy the pump leaves the ticket
+    cancelled but counts reroute_failed -- visible, not dropped."""
+    model = PagedLM(CFG)
+    tenants = [TenantConfig("t"), TenantConfig("probe", slo_ms=1e-6,
+                                               slo_burn=0.5)]
+    ctxs, reps = _fleet(model, n=2, n_pages=2, tenants=tenants)
+    router = Router(reps)
+    try:
+        router.submit([1, 2, 3, 4, 5, 6], 2, tenant="t",
+                      adverts={0: _advert(), 1: _advert()})
+        fh2 = router.submit([8, 9, 10, 11], 2, tenant="t",
+                            adverts={0: _advert(),
+                                     1: _advert(queued_bytes=1 << 30)})
+        assert fh2.handle.ticket.state == "queued"
+        for rep in reps:  # the WHOLE fleet breaches
+            sid = rep.engine.scope.new_scope("probe")
+            rep.engine.scope.record_done(sid)
+        assert router._pump() == 0
+        assert router.counters["reroute_failed"] == 1
+        assert fh2.handle.ticket.state == "cancelled"
+        assert fh2.state == "cancelled"
+        router.run(timeout_s=120)  # fh1 still drains; fh2 stays cancelled
+    finally:
+        _teardown(router, ctxs)

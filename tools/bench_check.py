@@ -97,6 +97,14 @@ CHECKS = [
     ("BENCH_serve.json", "spec.bit_identical", "equal", 0.0, False),
     ("BENCH_serve.json", "spec.verify_wave.single_fused_launch",
      "equal", 0.0, False),
+    # ptc-route (PR 16): 2-replica fleet scaling and global prefix hit
+    # rate are oversubscription-slacked timing trajectory rows (both
+    # replicas timeshare one process's cores); the routed-vs-single
+    # bit_identical verdict is an equal-direction correctness flag —
+    # never relaxed
+    ("BENCH_serve.json", "fleet.scaling", "higher", 0.50, True),
+    ("BENCH_serve.json", "fleet.hit_rate", "higher", 0.50, True),
+    ("BENCH_serve.json", "fleet.bit_identical", "equal", 0.0, False),
     # ptc-tune (PR 12): autotuned-vs-default ratios on the dispatch
     # chain and the 2-rank collective — timing trajectory rows,
     # oversubscription-slacked per convention; the beats_default

@@ -77,8 +77,10 @@ def render_live(samples):
     lines = []
     tenants = {}
     conf = None
+    fleet = None
     for rank in sorted(samples):
         rec = samples[rank]
+        fleet = rec.get("fleet") or fleet
         w = rec.get("workers") or []
         lines.append(
             f"rank {rank}: t={rec.get('t', '?')}s "
@@ -112,6 +114,36 @@ def render_live(samples):
                 f"{_fmt(t.get('slo_burn')):>6}"
                 f"{_fmt(t.get('prefix_hit')):>8}"
                 f"{_fmt(t.get('spec_acc')):>9}")
+    if fleet:
+        # per-replica fleet table (ptc-route): occupancy, prefix hit
+        # rate and the migration ledger, straight off Router.stats()
+        lines.append("")
+        lines.append(f"{'replica':<10}{'role':<9}{'ok':>3}{'act':>4}"
+                     f"{'q':>4}{'burn':>6}{'pfx_hit':>8}{'frozen':>7}"
+                     f"{'imp':>5}{'exp':>5}{'mig_in_kb':>10}")
+        for name, row in sorted(
+                (fleet.get("replicas") or {}).items(),
+                key=lambda kv: kv[1].get("index", 0)):
+            lines.append(
+                f"{name:<10}{row.get('role', '?'):<9}"
+                f"{('y' if row.get('healthy') else 'N'):>3}"
+                f"{row.get('active_pools', 0):>4}"
+                f"{row.get('queue_depth', 0):>4}"
+                f"{_fmt(row.get('slo_burn_rate')):>6}"
+                f"{_fmt(row.get('pfx_hit')):>8}"
+                f"{row.get('frozen_live', 0):>7}"
+                f"{row.get('imported', 0):>5}"
+                f"{row.get('exported', 0):>5}"
+                f"{row.get('migrated_in_bytes', 0) // 1024:>10}")
+        r = fleet.get("router") or {}
+        lines.append(
+            f"router: placed={r.get('placed', 0)} "
+            f"rerouted={r.get('rerouted', 0)} "
+            f"reroute_failed={r.get('reroute_failed', 0)} "
+            f"prefill_jobs={r.get('prefill_jobs', 0)} "
+            f"migrated={r.get('migrated_pages', 0)}p/"
+            f"{r.get('migrated_bytes', 0) // 1024}kb "
+            f"dups={r.get('migration_dups', 0)}")
     if conf:
         lines.append("")
         lines.append(

@@ -393,6 +393,119 @@ def prefix_spec_churn(workers: int, reqs_per_thread: int = 6,
                 os.environ[k] = v
 
 
+def fleet_churn(workers: int, reqs_per_thread: int = 5,
+                env=None) -> None:
+    """ptc-route fleet churn (PR 16): TWO replica engines (own contexts,
+    one address space) behind one Router; two submitter threads route
+    OVERLAPPING shared-prefix prompts through the scored placement path
+    (advertise -> digest -> placement_cost) while a migration thread
+    hammers content-hash page migration in BOTH directions between the
+    live pools — concurrent with each engine's own freeze/acquire/
+    eviction churn and the pump-thread retirements underneath — and a
+    reader scrapes router.stats() (which walks every replica's
+    advertise + pool counters).  TSan watches the router handle-list
+    lock, both pool locks under cross-pool export/import, the
+    server/scope locks and the native QoS churn in one address space;
+    bit-exactness spot checks and exact page accounting on both pools
+    keep the stress honest."""
+    import threading
+    import time
+
+    from parsec_tpu.ops.paged_attention import prefix_page_keys
+    from parsec_tpu.serve import (InferenceEngine, PagedLM,
+                                  PagedLMConfig, Replica, Router,
+                                  TenantConfig)
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        model = PagedLM(PagedLMConfig(vocab=24, d=8, page=4, seed=5))
+        rng0 = np.random.RandomState(11)
+        common = [list(rng0.randint(0, 24, size=12)) for _ in range(3)]
+        ckeys = [prefix_page_keys(model.model_id, c, 4) for c in common]
+        ctxs = [pt.Context(nb_workers=workers, scheduler="lws")
+                for _ in range(2)]
+        try:
+            reps = [Replica(InferenceEngine(
+                c, model, n_pages=28, max_seqs=6,
+                tenants=[TenantConfig("t", max_pools=4,
+                                      max_queue=128)],
+                name=f"r{i}")) for i, c in enumerate(ctxs)]
+            router = Router(reps)
+            handles, hlock = [], threading.Lock()
+
+            def submitter(seed):
+                rng = np.random.RandomState(seed)
+                for _ in range(reqs_per_thread):
+                    c = common[rng.randint(len(common))]
+                    tail = list(rng.randint(0, 24,
+                                            size=rng.randint(0, 3)))
+                    fh = router.submit(c + tail,
+                                       int(rng.randint(2, 5)), "t")
+                    with hlock:
+                        handles.append(fh)
+
+            stop = threading.Event()
+
+            def migrator():
+                i = 0
+                while not stop.is_set():
+                    keys = ckeys[i % len(ckeys)]
+                    dst = reps[i % 2]
+                    src = reps[(i + 1) % 2]
+                    router.migrate(keys, dst=dst, src=src)
+                    i += 1
+                    stop.wait(0.002)
+
+            def reader():
+                while not stop.is_set():
+                    router.stats()
+                    stop.wait(0.005)
+
+            subs = [threading.Thread(target=submitter, args=(s,))
+                    for s in (1, 2)]
+            aux = [threading.Thread(target=migrator, daemon=True),
+                   threading.Thread(target=reader, daemon=True)]
+            for t in aux:
+                t.start()
+            for t in subs:
+                t.start()
+            deadline = time.monotonic() + 300
+            while any(t.is_alive() for t in subs) or router._busy():
+                assert time.monotonic() < deadline, "fleet deadlocked"
+                router.run(timeout_s=240)
+                time.sleep(0.001)
+            for t in subs:
+                t.join(timeout=60)
+            stop.set()
+            for t in aux:
+                t.join(timeout=10)
+            for rep in reps:
+                st = rep.pool.stats()
+                assert st["free"] + st["cached_free"] == \
+                    st["n_pages"], st
+            with hlock:
+                done = [fh for fh in handles if fh.state == "done"]
+                assert len(done) == len(handles), \
+                    [fh.state for fh in handles]
+            for fh in done[:4]:
+                rt, ro = model.reference_generate(fh.prompt,
+                                                  fh.max_new)
+                assert fh.tokens == rt
+                assert np.array_equal(np.stack(fh.outputs), ro)
+            router.close()
+        finally:
+            for c in ctxs:
+                c.destroy()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
                 env=None) -> None:
     """Serving-runtime stress under a 2-rank context (one process, a
@@ -692,6 +805,10 @@ def main():
         # ptc-share (PR 14): shared-prefix COW/eviction + speculative
         # rollback under concurrent submitters, retirement and scrapes
         prefix_spec_churn(workers=4)
+        # ptc-route (PR 16): 2 replicas behind the fleet router —
+        # scored placement + cross-pool page migration racing both
+        # engines' freeze/acquire/eviction churn and stats scrapes
+        fleet_churn(workers=4)
         # wave mega-kernelization (PR 13): fuse cache + online
         # certification on the device manager threads, prefetch-lane
         # peeks, and streamed wire deliveries, 2 colocated ranks
