@@ -117,6 +117,15 @@ bench-collective: $(LIB)
 bench-serve: $(LIB)
 	python bench.py --serve --json BENCH_serve.json
 
+# Topology-tier soak (bench.py --topo --json, ptc-topo): the 4-rank
+# two-island mesh under the island emulator's per-peer recv delays —
+# ring vs hierarchical all_reduce (bit-exact, per-class wire split),
+# and the rank-remap chain: measured DCN bytes identity vs
+# run(remap=True) (>= 30% reduction enforced), plan-predicted per-class
+# bytes sound vs the classed wire_out_bound.  CPU-only, loopback.
+bench-topo: $(LIB)
+	python bench.py --topo --json BENCH_topo.json
+
 # Tracing-overhead ladder (bench.py --trace --json): per-task cost at
 # trace levels 0/1/2 and the flight-recorder ring vs unbounded buffers
 # at level 1 (the PR2 one-transaction-per-task contract), plus the
@@ -148,4 +157,4 @@ check: bench-check verify-graphs plan-graphs tune-check tidy
 
 .PHONY: all clean tsan ubsan tidy verify-graphs plan-graphs tune-check \
 	check bench-comm bench-dispatch bench-device bench-stream \
-	bench-collective bench-trace bench-serve bench-check
+	bench-collective bench-trace bench-serve bench-topo bench-check

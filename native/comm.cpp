@@ -428,9 +428,24 @@ struct CommEngine {
   uint64_t next_stream = 1;
   /* fault injection (PTC_COMM_FAULT_*): recv-size cap (forces short
    * reads / frame fragmentation) and a per-recv delay — the soak
-   * harness for the chunk/stream session state machines */
+   * harness for the chunk/stream session state machines.  The DELAY_MAP
+   * ("rank:us,rank:us") overrides the global delay per peer, so a flat
+   * in-process mesh can emulate latency-separated islands (ptc-topo) */
   int64_t fault_recv_max = 0;
   int64_t fault_delay_us = 0;
+  std::vector<int64_t> fault_delay_map; /* per-peer recv delay, us */
+
+  /* ptc-topo per-peer wire counters (ptc_comm_peer_stats): the measured
+   * side of the link-class model.  Python folds these per class via the
+   * TopologyModel; rtt_ns is the min PONG round trip to THAT peer
+   * (ptc_comm_probe_rtts), the RTT auto-classing input. */
+  struct PeerStats {
+    std::atomic<uint64_t> bytes_sent{0}, bytes_recv{0};
+    std::atomic<uint64_t> msgs_sent{0}, msgs_recv{0};
+    std::atomic<uint64_t> parked{0};
+    std::atomic<int64_t> rtt_ns{0};
+  };
+  std::vector<PeerStats> peer_stats;
   /* producer chunk sessions (under `lock`), keyed by (puller rank,
    * cookie) — cookies are allocated by each CONSUMER's own counter, so
    * two consumers pulling one producer concurrently WILL present the
@@ -602,6 +617,8 @@ static void comm_post_msg(CommEngine *ce, uint32_t rank, OutMsg &&msg,
     ce->app_sent.fetch_add(1, std::memory_order_relaxed);
   }
   ce->msgs_sent.fetch_add(1, std::memory_order_relaxed);
+  if (rank < ce->peer_stats.size())
+    ce->peer_stats[rank].msgs_sent.fetch_add(1, std::memory_order_relaxed);
   ce->ops->post(ce, rank, std::move(msg), rail);
 }
 
@@ -1552,6 +1569,9 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
          * frontier — park it; the next watermark advance flushes it */
         s.parked.push_back({offset, req_len});
         ce->stream_parked.fetch_add(1, std::memory_order_relaxed);
+        if (from < ce->peer_stats.size())
+          ce->peer_stats[from].parked.fetch_add(1,
+                                                std::memory_order_relaxed);
         return;
       } else {
         std::shared_ptr<std::vector<uint8_t>> base = s.buf;
@@ -1717,6 +1737,9 @@ static void handle_get_body(CommEngine *ce, uint32_t from,
       }
       ce->stream_sessions.fetch_add(1, std::memory_order_relaxed);
       ce->stream_parked.fetch_add(1, std::memory_order_relaxed);
+      if (from < ce->peer_stats.size())
+        ce->peer_stats[from].parked.fetch_add(1,
+                                              std::memory_order_relaxed);
       if (rel) ptc_copy_release_internal(ctx, rel);
       return;
     }
@@ -2054,6 +2077,8 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
                          const uint8_t *body, size_t len) {
   ptc_context *ctx = ce->ctx;
   ce->msgs_recv.fetch_add(1, std::memory_order_relaxed);
+  if (from < ce->peer_stats.size())
+    ce->peer_stats[from].msgs_recv.fetch_add(1, std::memory_order_relaxed);
   if (type != MSG_FENCE && type != MSG_TD && type != MSG_FINI &&
       type != MSG_PING && type != MSG_PONG && type != MSG_METRICS)
     ce->app_recv.fetch_add(1, std::memory_order_relaxed);
@@ -2146,6 +2171,14 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
         int64_t cur = ce->rtt_ns.load(std::memory_order_relaxed);
         while ((cur == 0 || rtt < cur) &&
                !ce->rtt_ns.compare_exchange_weak(cur, rtt)) {
+        }
+        /* per-peer min RTT (ptc-topo): the auto-classing input */
+        if (from < ce->peer_stats.size()) {
+          std::atomic<int64_t> &pr = ce->peer_stats[from].rtt_ns;
+          int64_t pcur = pr.load(std::memory_order_relaxed);
+          while ((pcur == 0 || rtt < pcur) &&
+                 !pr.compare_exchange_weak(pcur, rtt)) {
+          }
         }
       }
       /* clock sync: a pong FROM rank 0 carries rank 0's clock sampled
@@ -2338,6 +2371,9 @@ static void parse_inbuf(CommEngine *ce, uint32_t rank, uint32_t rail) {
     const uint8_t *frame = rl.inbuf.data() + rl.in_off + 4;
     uint8_t type = frame[0];
     ce->bytes_recv.fetch_add(4 + body_len, std::memory_order_relaxed);
+    if (rank < ce->peer_stats.size())
+      ce->peer_stats[rank].bytes_recv.fetch_add(
+          4 + body_len, std::memory_order_relaxed);
     handle_frame(ce, rank, type, frame + 1, body_len - 1);
     rl.in_off += 4 + body_len;
   }
@@ -2416,8 +2452,10 @@ static void comm_main(CommEngine *ce) {
       if (rl.fd < 0 || rl.fd != pfds[i].fd) continue;
       if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
         while (true) {
-          if (ce->fault_delay_us > 0)
-            usleep((useconds_t)ce->fault_delay_us);
+          int64_t fd_us = ce->fault_delay_us;
+          if (r < ce->fault_delay_map.size() && ce->fault_delay_map[r] > 0)
+            fd_us = ce->fault_delay_map[r]; /* per-peer override */
+          if (fd_us > 0) usleep((useconds_t)fd_us);
           ssize_t n = recv(rl.fd, rbuf, recv_cap, 0);
           if (n > 0) {
             rl.inbuf.insert(rl.inbuf.end(), rbuf, rbuf + n);
@@ -2479,6 +2517,9 @@ static void comm_main(CommEngine *ce) {
           g.lock();
           if (n > 0) {
             ce->bytes_sent.fetch_add((uint64_t)n, std::memory_order_relaxed);
+            if (r < ce->peer_stats.size())
+              ce->peer_stats[r].bytes_sent.fetch_add(
+                  (uint64_t)n, std::memory_order_relaxed);
             rl.out_off += (size_t)n;
             if (rl.out_off == m.size()) {
               rl.out.pop_front();
@@ -3373,6 +3414,25 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
     ce->fault_recv_max = std::atoll(e);
   if (const char *e = std::getenv("PTC_COMM_FAULT_DELAY_US"))
     ce->fault_delay_us = std::atoll(e);
+  ce->fault_delay_map.assign(ctx->nodes, 0);
+  if (const char *e = std::getenv("PTC_COMM_FAULT_DELAY_MAP")) {
+    /* "rank:us,rank:us" — per-peer recv-delay overrides (ptc-topo:
+     * emulate latency-separated islands on a flat in-process mesh) */
+    const char *p = e;
+    while (*p) {
+      char *end = nullptr;
+      long long rank = std::strtoll(p, &end, 10);
+      if (end == p || *end != ':') break;
+      p = end + 1;
+      long long us = std::strtoll(p, &end, 10);
+      if (end == p) break;
+      if (rank >= 0 && (size_t)rank < ce->fault_delay_map.size() && us > 0)
+        ce->fault_delay_map[(size_t)rank] = us;
+      p = (*end == ',') ? end + 1 : end;
+      if (*end != ',') break;
+    }
+  }
+  ce->peer_stats = std::vector<CommEngine::PeerStats>(ctx->nodes);
   ce->rail_rr = std::vector<std::atomic<uint32_t>>(ctx->nodes);
   if (const char *e = std::getenv("PTC_MCA_comm_fence_timeout_s"))
     ce->fence_timeout_s = std::atoll(e);
@@ -3704,6 +3764,66 @@ void ptc_comm_stream_stats(ptc_context_t *ctx, int64_t *out8) {
   out8[5] = ce ? (int64_t)ce->reaps.load() : 0;
   out8[6] = ce ? (int64_t)ce->rails : 0;
   out8[7] = (ce && ce->stream) ? 1 : 0;
+}
+
+/* ptc-topo per-peer counters: 6 int64 per peer —
+ * [bytes_sent, bytes_recv, msgs_sent, msgs_recv, parked_gets, rtt_ns].
+ * Writes up to max_peers records into out; returns the peer count
+ * written (0 when comm is off).  Python folds these per link class via
+ * the TopologyModel (Context.stats()["comm"]["topo"]). */
+int32_t ptc_comm_peer_stats(ptc_context_t *ctx, int64_t *out,
+                            int32_t max_peers) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return 0;
+  int32_t n = (int32_t)ce->peer_stats.size();
+  if (n > max_peers) n = max_peers;
+  for (int32_t r = 0; r < n; r++) {
+    CommEngine::PeerStats &p = ce->peer_stats[(size_t)r];
+    out[r * 6 + 0] = (int64_t)p.bytes_sent.load(std::memory_order_relaxed);
+    out[r * 6 + 1] = (int64_t)p.bytes_recv.load(std::memory_order_relaxed);
+    out[r * 6 + 2] = (int64_t)p.msgs_sent.load(std::memory_order_relaxed);
+    out[r * 6 + 3] = (int64_t)p.msgs_recv.load(std::memory_order_relaxed);
+    out[r * 6 + 4] = (int64_t)p.parked.load(std::memory_order_relaxed);
+    out[r * 6 + 5] = p.rtt_ns.load(std::memory_order_relaxed);
+  }
+  return n;
+}
+
+/* ptc-topo RTT probe: PING every peer (the clock/calibration probes
+ * only measure rank 0 / the global min), wait <= 2 s for the per-peer
+ * PONGs.  Fills peer_stats[].rtt_ns (read back via
+ * ptc_comm_peer_stats); returns the number of peers with a measured
+ * RTT.  PING/PONG are control frames — a fence never dirties on it. */
+int32_t ptc_comm_probe_rtts(ptc_context_t *ctx) {
+  CommEngine *ce = ctx->comm;
+  if (!ce) return 0;
+  for (uint32_t r = 0; r < ce->nodes; r++) {
+    if (r == ce->myrank) continue;
+    for (int i = 0; i < 3; i++) {
+      std::vector<uint8_t> f = frame_begin(MSG_PING);
+      Writer w{f};
+      w.u64((uint64_t)ptc_now_ns());
+      frame_finish(f);
+      comm_post(ce, r, std::move(f));
+    }
+  }
+  auto measured = [&] {
+    int32_t got = 0;
+    for (uint32_t r = 0; r < ce->peer_stats.size(); r++) {
+      if (r == ce->myrank) continue;
+      if (ce->peer_stats[r].rtt_ns.load(std::memory_order_relaxed) > 0)
+        got++;
+    }
+    return got;
+  };
+  {
+    std::unique_lock<ptc_mutex> g(ce->lock);
+    ce->fence_cv.wait_for(g, std::chrono::seconds(2), [&] {
+      return (uint32_t)measured() >= ce->nodes - 1 ||
+             ce->stop.load(std::memory_order_acquire);
+    });
+  }
+  return measured();
 }
 
 /* clock-sync snapshot (tracing v2): [offset_ns (rank0 - local),

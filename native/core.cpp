@@ -765,8 +765,37 @@ ptc_data *ptc_collection_data_of(ptc_context *ctx, int32_t dc_id,
 uint32_t ptc_collection_rank_of(ptc_context *ctx, int32_t dc_id,
                                 const int64_t *idx, int32_t n) {
   Collection *dc = ctx->collections[(size_t)dc_id];
-  if (dc->linear) return dc->nodes ? (uint32_t)((n > 0 ? idx[0] : 0) % dc->nodes) : 0;
-  return dc->rank_of ? dc->rank_of(dc->user, idx, n) : 0;
+  uint32_t r;
+  if (dc->linear)
+    r = dc->nodes ? (uint32_t)((n > 0 ? idx[0] : 0) % dc->nodes) : 0;
+  else
+    r = dc->rank_of ? dc->rank_of(dc->user, idx, n) : 0;
+  /* ptc-topo rank remap: relabel the logical owner to its physical
+   * rank.  Every rank_of consumer funnels through here, so affinity,
+   * placement and mem owners move consistently. */
+  ptc_context::RankMap *rm =
+      ctx->rank_map.load(std::memory_order_acquire);
+  if (rm && r < rm->map.size()) r = (uint32_t)rm->map[r];
+  return r;
+}
+
+/* Install (or clear, map == NULL / n <= 0) the ptc-topo rank remap.
+ * The permutation must be SPMD-identical across ranks — every rank
+ * computes placement with it, so divergent maps would strand tasks.
+ * Old maps are retired until destroy (lock-free readers in flight). */
+extern "C" void ptc_context_set_rank_map(ptc_context_t *ctx,
+                                         const int32_t *map, int32_t n) {
+  ptc_context::RankMap *rm = nullptr;
+  if (map && n > 0) {
+    rm = new ptc_context::RankMap();
+    rm->map.assign(map, map + n);
+  }
+  ptc_context::RankMap *old =
+      ctx->rank_map.exchange(rm, std::memory_order_acq_rel);
+  if (old) {
+    std::lock_guard<std::mutex> g(ctx->reg_lock);
+    ctx->rank_maps_retired.push_back(old);
+  }
 }
 
 /* ------------------------------------------------------------------ */
@@ -3589,6 +3618,8 @@ void ptc_context_destroy(ptc_context_t *ctx) {
   ptc_comm_shutdown(ctx); /* no-op when comm was never initialized */
   delete ctx->pins_state.load(std::memory_order_relaxed);
   for (auto *st : ctx->pins_retired) delete st;
+  delete ctx->rank_map.load(std::memory_order_relaxed);
+  for (auto *rm : ctx->rank_maps_retired) delete rm;
   delete ctx;
 }
 

@@ -248,6 +248,13 @@ void ptc_context_set_binding(ptc_context_t *ctx, int32_t mode);
  * worker, set before the context starts.  Hierarchical schedulers
  * (lhq) steal within a worker's vp before crossing vps.  Returns 0, or
  * -1 when the context already started (the map would be ignored). */
+/* ptc-topo rank remap (plan.remap_ranks / Taskpool.run(remap=)): a
+ * permutation applied to every collection rank_of result, relabeling
+ * which physical rank plays which logical role.  Must be SPMD-identical
+ * across ranks; NULL / n<=0 clears it.  Set between taskpool build and
+ * run — rank_of is evaluated lazily at pool startup. */
+void ptc_context_set_rank_map(ptc_context_t *ctx, const int32_t *map,
+                              int32_t n);
 int32_t ptc_context_set_vpmap(ptc_context_t *ctx, const int32_t *vp,
                               int32_t n);
 /* test/debug probe: a hierarchical scheduler's computed steal order
@@ -635,6 +642,13 @@ void ptc_comm_tuning(ptc_context_t *ctx, int64_t *out8);
 /* streaming pipeline: [sessions, parked_gets, overlap_ns, d2h_ns,
  * wire_ns, reaps, rails, stream_enabled] */
 void ptc_comm_stream_stats(ptc_context_t *ctx, int64_t *out8);
+/* ptc-topo per-peer counters: 6 int64 per peer [bytes_sent, bytes_recv,
+ * msgs_sent, msgs_recv, parked_gets, rtt_ns]; returns peers written */
+int32_t ptc_comm_peer_stats(ptc_context_t *ctx, int64_t *out,
+                            int32_t max_peers);
+/* PING every peer and wait (<= 2 s) for per-peer min RTTs — the
+ * link-class auto-detect input; returns peers with a measured RTT */
+int32_t ptc_comm_probe_rtts(ptc_context_t *ctx);
 /* distributed clock sync (tracing v2): each rank estimates its
  * ptc_now_ns offset to RANK 0's clock from PING/PONG midpoints over the
  * existing wire (probed at comm bring-up and refreshed at each fence;

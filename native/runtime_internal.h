@@ -973,6 +973,19 @@ struct ptc_context {
    * 2 binomial (reference: runtime_comm_coll_bcast, remote_dep.c:39-47) */
   std::atomic<int32_t> comm_topo{0};
 
+  /* ptc-topo rank remap (plan.remap_ranks / Taskpool.run(remap=)): a
+   * permutation applied to EVERY ptc_collection_rank_of result, so task
+   * affinity, successor placement, mem owners and the startup filter
+   * move together — a pure relabeling of which physical rank plays
+   * which logical role.  Published by atomic pointer swap; replaced
+   * maps are retired (not freed) until context destroy so a concurrent
+   * reader can never touch freed memory.  Rank_of is evaluated lazily
+   * at/after pool startup, so setting the map between taskpool build
+   * and run re-places the whole pool. */
+  struct RankMap { std::vector<int32_t> map; };
+  std::atomic<RankMap *> rank_map{nullptr};
+  std::vector<RankMap *> rank_maps_retired; /* under reg_lock */
+
   /* runtime-native collective counters (ptc_coll_stats): steps = executed
    * ptc_coll_* task bodies; send/recv = cross-rank activation frames
    * whose (first) target is a ptc_coll_* class, with their payload bytes.

@@ -314,6 +314,11 @@ _sigs = {
     "ptc_comm_stream_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_clock_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_clock_sync": (C.c_int64, [C.c_void_p]),
+    "ptc_comm_peer_stats": (C.c_int32, [C.c_void_p, C.POINTER(C.c_int64),
+                                        C.c_int32]),
+    "ptc_comm_probe_rtts": (C.c_int32, [C.c_void_p]),
+    "ptc_context_set_rank_map": (None, [C.c_void_p,
+                                        C.POINTER(C.c_int32), C.c_int32]),
     "ptc_tp_id": (C.c_int32, [C.c_void_p]),
     "ptc_dtile_set_owner": (None, [C.c_void_p, C.c_uint32]),
     "ptc_dtask_set_rank": (None, [C.c_void_p, C.c_int32]),

@@ -162,6 +162,7 @@ class Plan:
         # comm_out_msgs, eager_bytes, rdv_bytes, wire_out_bound
         self.per_rank: Dict[int, Dict[str, int]] = {}
         self.edges_bytes: Dict[Tuple[int, int], int] = {}
+        self.edges_msgs: Dict[Tuple[int, int], int] = {}
         # per-rank wave tables: rank -> [{"wave", "tasks", "classes"}]
         self.waves: Dict[int, List[dict]] = {}
         # wave-fusability certificates: one record per (rank, wave) —
@@ -284,16 +285,158 @@ class Plan:
         return {"classes": dict(self._chain_classes),
                 "links": dict(self._chain_links.get(rank, {}))}
 
-    def wire_out_bound(self, rank: int) -> int:
+    def wire_out_bound(self, rank: int,
+                       cls: Optional[str] = None) -> int:
         """Upper bound on the rank's wire bytes_sent: payload out plus
         the modeled per-message envelope and static control-plane
-        allowance."""
+        allowance.  With `cls` (ptc-topo link class: "host"/"ici"/
+        "dcn") only the edges of that class count — the per-class bound
+        the topo soak checks against the measured per-class split."""
+        if cls is not None:
+            tmodel = self._tmodel()
+            payload = msgs = 0
+            for (s, d), b in self.edges_bytes.items():
+                if s == rank and tmodel.class_of(s, d) == cls:
+                    payload += b
+                    msgs += self.edges_msgs.get((s, d), 0)
+            return payload + msgs * WIRE_ENVELOPE_BYTES \
+                + WIRE_STATIC_BYTES
         row = self.per_rank.get(rank)
         if row is None:
             return WIRE_STATIC_BYTES
         return (row["comm_out_bytes"]
                 + row["comm_out_msgs"] * WIRE_ENVELOPE_BYTES
                 + WIRE_STATIC_BYTES)
+
+    # --------------------------------------------- topology (ptc-topo)
+    def _nranks_hint(self) -> int:
+        n = 0
+        for r in self.per_rank:
+            n = max(n, int(r) + 1)
+        for (s, d) in self.edges_bytes:
+            n = max(n, int(s) + 1, int(d) + 1)
+        return n
+
+    def _tmodel(self, tmodel=None):
+        if tmodel is not None:
+            return tmodel
+        from ..comm.topology import default_topology
+        return default_topology(self._nranks_hint())
+
+    def class_bytes(self, tmodel=None,
+                    perm: Optional[List[int]] = None) -> Dict[str, int]:
+        """The comm volume split by link class over the exact
+        per-(src, dst) traffic matrix.  `perm` (a rank_of remap,
+        perm[logical] = physical) reclasses every edge as if the pool
+        ran under that mapping — the objective remap_ranks minimizes."""
+        from ..comm.topology import LINK_CLASSES
+        tm = self._tmodel(tmodel)
+        out = {c: 0 for c in LINK_CLASSES}
+        for (s, d), b in self.edges_bytes.items():
+            ps = perm[s] if perm and s < len(perm) else s
+            pd = perm[d] if perm and d < len(perm) else d
+            out[tm.class_of(ps, pd)] += b
+        return out
+
+    def dcn_bytes(self, tmodel=None,
+                  perm: Optional[List[int]] = None) -> int:
+        """Predicted inter-island payload bytes (the slow-network spend
+        the topo tier exists to shrink)."""
+        return self.class_bytes(tmodel, perm)["dcn"]
+
+    def _perm_cost(self, perm: List[int], tmodel, econ) -> float:
+        """Modeled wire seconds of the traffic matrix under `perm`:
+        per-edge classed alpha (per message) + beta (per byte)."""
+        tot = 0.0
+        for (s, d), b in self.edges_bytes.items():
+            if s >= len(perm) or d >= len(perm):
+                continue
+            cls = tmodel.class_of(perm[s], perm[d])
+            if cls == "loopback":
+                continue
+            m = self.edges_msgs.get((s, d), 1)
+            tot += (m * econ.alpha("rdv", cls) * 1e-6
+                    + b * econ.beta("rdv", cls) * 1e-9)
+        return tot
+
+    def remap_ranks(self, tmodel=None, econ=None) -> List[int]:
+        """Search rank_of permutations (perm[logical] = physical) that
+        minimize the modeled classed wire cost of the EXACT traffic
+        matrix — in practice: keep chatty logical ranks inside one ICI
+        island so the DCN carries as little as possible.
+
+        Greedy constructive seed (assign logical ranks, heaviest
+        talkers first, to the island holding their traffic) followed by
+        island-aware pairwise-swap refinement; the identity mapping is
+        always a candidate, so the result never predicts worse than
+        not remapping.  Returns the identity permutation when the
+        topology is flat or no permutation helps — callers can compare
+        against list(range(n)) to decide whether to install it
+        (Taskpool.run(remap=...), ctx.set_rank_map)."""
+        tm = self._tmodel(tmodel)
+        n = max(self._nranks_hint(), tm.nranks)
+        ident = list(range(n))
+        if tm.n_islands <= 1 or n <= 1 or not self.edges_bytes \
+                or n > tm.nranks:
+            return ident
+        if econ is None:
+            from ..comm.economics import default_economics
+            econ = default_economics()
+        sym: Dict[Tuple[int, int], float] = {}
+        deg = [0.0] * n
+        for (s, d), b in self.edges_bytes.items():
+            if s == d or s >= n or d >= n:
+                continue
+            k = (min(s, d), max(s, d))
+            sym[k] = sym.get(k, 0.0) + b
+            deg[s] += b
+            deg[d] += b
+        # greedy: heaviest talkers first, each into the island where
+        # its already-placed traffic lives (ties: most free slots)
+        slots = [list(tm.island_ranks(i)) for i in range(tm.n_islands)]
+        free = [len(sl) for sl in slots]
+        isl_of_logical: Dict[int, int] = {}
+        assign: Dict[int, int] = {}
+        for l in sorted(range(n), key=lambda x: -deg[x]):
+            best_i, best_aff = -1, -1.0
+            for i in range(tm.n_islands):
+                if free[i] <= 0:
+                    continue
+                aff = sum(sym.get((min(l, o), max(l, o)), 0.0)
+                          for o, oi in isl_of_logical.items() if oi == i)
+                if aff > best_aff or (aff == best_aff and best_i >= 0
+                                      and free[i] > free[best_i]):
+                    best_i, best_aff = i, aff
+            isl_of_logical[l] = best_i
+            assign[l] = slots[best_i][len(slots[best_i]) - free[best_i]]
+            free[best_i] -= 1
+        greedy = [assign[l] for l in range(n)]
+
+        def refine(perm: List[int]) -> Tuple[List[int], float]:
+            perm = list(perm)
+            cost = self._perm_cost(perm, tm, econ)
+            for _ in range(2 * n):
+                improved = False
+                for i in range(n):
+                    for j in range(i + 1, n):
+                        if tm.island_of(perm[i]) == tm.island_of(perm[j]):
+                            continue  # island-aware: only DCN-moving swaps
+                        perm[i], perm[j] = perm[j], perm[i]
+                        c = self._perm_cost(perm, tm, econ)
+                        if c < cost - 1e-15:
+                            cost, improved = c, True
+                        else:
+                            perm[i], perm[j] = perm[j], perm[i]
+                if not improved:
+                    break
+            return perm, cost
+
+        cand = [refine(ident), refine(greedy)]
+        ident_cost = self._perm_cost(ident, tm, econ)
+        best, best_cost = min(cand, key=lambda pc: pc[1])
+        if best_cost >= ident_cost - 1e-15:
+            return ident
+        return best
 
     # ------------------------------------------------- spill prediction
     def predict_spills(self, cache_bytes: int, rank: int = 0,
@@ -1129,6 +1272,7 @@ class _Analyzer:
             srow["rdv_bytes"] += payload
         key = (src, dst)
         plan.edges_bytes[key] = plan.edges_bytes.get(key, 0) + payload
+        plan.edges_msgs[key] = plan.edges_msgs.get(key, 0) + 1
 
     # ------------------------------------------------------- makespan
     def _makespan(self, cost: CostModel, workers: int):
@@ -1365,7 +1509,8 @@ def compare_critpath(plan: Plan, trace) -> dict:
 def placement_cost(est_bytes: int, shared_bytes: int, queued_bytes: int,
                    active_pools: int, burn_rate: float,
                    migrate_bytes: int = 0, econ=None,
-                   mem_gbps: float = 16.0) -> float:
+                   mem_gbps: float = 16.0,
+                   migrate_cls: Optional[str] = None) -> float:
     """Modeled seconds-until-done for placing ONE request on ONE replica
     — the scalar the fleet router minimizes (serve/router.py).  Three
     legs, all in seconds so they compose with the fitted transfer
@@ -1393,7 +1538,10 @@ def placement_cost(est_bytes: int, shared_bytes: int, queued_bytes: int,
                     migrate to create the locality it is pricing in
                     (disaggregated prefill->decode handoff) — one
                     rendezvous transfer per bundle on today's chunked
-                    pull path.
+                    pull path.  `migrate_cls` (ptc-topo link class of
+                    the donor->target leg, e.g. "dcn") prices it with
+                    the classed fit: a migration that wins inside an
+                    island can honestly lose across islands.
 
     Pure arithmetic under a static model (deliberately so: deterministic
     placement tests pin tie-breaks), sharing TransferEconomics with the
@@ -1407,5 +1555,6 @@ def placement_cost(est_bytes: int, shared_bytes: int, queued_bytes: int,
              + 0.25 * max(0, int(active_pools)) * max(1, int(est_bytes))
              ) * per_byte
     queue *= 1.0 + max(0.0, float(burn_rate))
-    wire = econ.cost(int(migrate_bytes), "rdv") if migrate_bytes else 0.0
+    wire = econ.cost(int(migrate_bytes), "rdv", cls=migrate_cls) \
+        if migrate_bytes else 0.0
     return cold + queue + wire

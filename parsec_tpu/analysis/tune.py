@@ -75,6 +75,17 @@ TUNE_KNOBS: Tuple[str, ...] = (
     "device.cache_bytes",   # device byte budget (0 = constructor default)
     "device.wave_fuse",     # wave mega-kernelization (ptc-fuse)
     "runtime.mag_batch",    # task/arena freelist magazine batch
+    # ptc-topo: per-link-class overrides ("" = inherit the base knob).
+    # The simulator prices each cross-rank edge at ITS class, so these
+    # axes only matter (and are only searched) on multi-island meshes.
+    "comm.chunk_size.ici",
+    "comm.chunk_size.dcn",
+    "comm.rails.ici",
+    "comm.rails.dcn",
+    "comm.eager_limit.ici",
+    "comm.eager_limit.dcn",
+    "coll.topo.ici",
+    "coll.topo.dcn",
 )
 
 # Modeled dispatch-path constants (nanoseconds), calibrated against the
@@ -369,7 +380,8 @@ class ScheduleSimulator:
     NO wall-clock reads, NO randomness — same inputs, same makespan."""
 
     def __init__(self, plan: Plan, cost: Optional[CostModel] = None,
-                 econ=None, workers: Optional[int] = None):
+                 econ=None, workers: Optional[int] = None,
+                 tmodel=None):
         if plan.bounded or plan.cg is None:
             raise ValueError(
                 "ScheduleSimulator needs a concrete plan (enumeration "
@@ -391,6 +403,11 @@ class ScheduleSimulator:
             workers = int(plan.makespan.get("workers_per_rank", 1) or 1)
         self.workers = max(1, workers)
         self._prepare()
+        if tmodel is None:
+            from ..comm.topology import default_topology
+            tmodel = default_topology(max(self.ranks, default=0) + 1)
+        self.tmodel = tmodel
+        self._cls_cache: Dict[Tuple[int, int], str] = {}
 
     # ------------------------------------------------------- prepare
     def _prepare(self):
@@ -487,15 +504,49 @@ class ScheduleSimulator:
                     self.succ.setdefault(src, []).append(dst)
 
     # ------------------------------------------------------- pricing
-    def _wire_ns(self, payload: int, kv: Dict[str, object]) -> float:
+    def _edge_cls(self, src_rank: int, dst_rank: int) -> Optional[str]:
+        """Link class of a cross-rank edge (memoized; None = unclassed
+        flat pricing when no topology model is present)."""
+        key = (src_rank, dst_rank)
+        c = self._cls_cache.get(key)
+        if c is None:
+            tm = self.tmodel
+            c = tm.class_of(src_rank, dst_rank) if tm is not None \
+                else "ici"
+            self._cls_cache[key] = c
+        return c
+
+    def _mesh_cls(self) -> Optional[str]:
+        """The class collectives resolve against: 'dcn' when the mesh
+        spans islands, 'ici' otherwise (matches coll._mesh_class)."""
+        tm = self.tmodel
+        if tm is None or len(self.ranks) <= 1:
+            return None
+        return "dcn" if tm.n_islands > 1 else "ici"
+
+    @staticmethod
+    def _knob_cls(kv: Dict[str, object], name: str,
+                  cls: Optional[str]) -> object:
+        """Per-class override of a base knob inside a knob VECTOR: the
+        `{name}.{cls}` spelling when present and non-empty, else the
+        base value — the vector-local mirror of
+        topology.resolve_class_knob (which reads the MCA registry)."""
+        if cls in ("ici", "dcn"):
+            v = kv.get(f"{name}.{cls}")
+            if v not in (None, ""):
+                return v
+        return kv[name]
+
+    def _wire_ns(self, payload: int, kv: Dict[str, object],
+                 cls: Optional[str] = None) -> float:
         econ = self.econ
-        eager = int(kv["comm.eager_limit"])
+        eager = int(self._knob_cls(kv, "comm.eager_limit", cls))
         if payload <= eager:
-            return econ.cost(payload, "eager") * 1e9
-        chunk = int(kv["comm.chunk_size"])
-        rails = max(1, int(kv["comm.rails"]))
-        a = econ.alpha("rdv") * 1e9
-        b = econ.beta("rdv") * 1e9
+            return econ.cost(payload, "eager", cls=cls) * 1e9
+        chunk = int(self._knob_cls(kv, "comm.chunk_size", cls))
+        rails = max(1, int(self._knob_cls(kv, "comm.rails", cls)))
+        a = econ.alpha("rdv", cls=cls) * 1e9
+        b = econ.beta("rdv", cls=cls) * 1e9
         env = max(a, CHUNK_ENVELOPE_NS)
         if chunk > 0 and payload > chunk:
             nch = (payload + chunk - 1) // chunk
@@ -504,10 +555,12 @@ class ScheduleSimulator:
         return a + payload * b
 
     def _coll_factor(self, payload: int, kv: Dict[str, object]) -> float:
-        topo = kv.get("coll.topo", "auto")
+        cls = self._mesh_cls()
+        topo = self._knob_cls(kv, "coll.topo", cls) or "auto"
         nranks = max(2, len(self.ranks))
         costs = self.econ.topology_costs("reduce", max(1, payload),
-                                         nranks)
+                                         nranks, cls=cls,
+                                         tmodel=self.tmodel)
         best = min(costs.values())
         if best <= 0:
             return 1.0
@@ -580,7 +633,9 @@ class ScheduleSimulator:
                 delay = 0.0
                 if self.rank[n] != self.rank[dst]:
                     payload = self.edge_payload.get((n, dst), 0)
-                    delay = self._wire_ns(payload, kv)
+                    delay = self._wire_ns(
+                        payload, kv,
+                        self._edge_cls(self.rank[n], self.rank[dst]))
                     if self.edge_coll.get((n, dst)):
                         delay *= self._coll_factor(payload, kv)
                         delay += self._slice_overhead_ns(kv, payload)
@@ -623,6 +678,9 @@ class ScheduleSimulator:
         to the current default so the search space stays small and the
         proposals deterministic."""
         kv = default_knobs()
+        multi = (self.tmodel is not None
+                 and self.tmodel.n_islands > 1
+                 and len(self.ranks) > 1)
         axes: Dict[str, List[object]] = {}
         axes["runtime.mag_batch"] = [16, 64, 128, 256]
         if self.has_wire:
@@ -635,10 +693,33 @@ class ScheduleSimulator:
                 axes[k] = [kv[k]]
         if self.has_coll and self.has_wire:
             axes["coll.topo"] = ["auto", "ring", "binomial", "star"]
+            if multi:
+                axes["coll.topo"].append("hier")
             axes["coll.max_slices"] = [1, 4, 16]
         else:
             axes["coll.topo"] = [kv["coll.topo"]]
             axes["coll.max_slices"] = [kv["coll.max_slices"]]
+        # ptc-topo per-class overrides: only a multi-island mesh has a
+        # 'dcn' class for them to act on, so the dcn axes open there
+        # ("" = inherit base always a candidate) and collapse to the
+        # current value everywhere else.  The ici spellings stay
+        # collapsed — on a single-island mesh they ARE the base knob.
+        if self.has_wire and multi:
+            axes["comm.chunk_size.dcn"] = ["", 1 << 20, 4 << 20,
+                                           16 << 20]
+            axes["comm.rails.dcn"] = ["", 2, 4, 8]
+            axes["comm.eager_limit.dcn"] = ["", 8 << 10, 64 << 10]
+        else:
+            for k in ("comm.chunk_size.dcn", "comm.rails.dcn",
+                      "comm.eager_limit.dcn"):
+                axes[k] = [kv[k]]
+        if self.has_coll and self.has_wire and multi:
+            axes["coll.topo.dcn"] = ["", "hier", "star", "binomial"]
+        else:
+            axes["coll.topo.dcn"] = [kv["coll.topo.dcn"]]
+        for k in ("comm.chunk_size.ici", "comm.rails.ici",
+                  "comm.eager_limit.ici", "coll.topo.ici"):
+            axes[k] = [kv[k]]
         if self.has_device:
             axes["device.staging_slots"] = [1, 2, 4]
             peak = int(self.plan.peak_bytes(device_only=True) or 0)

@@ -20,9 +20,10 @@ schedule, trace (PROF_KEY_COLL delivery instants), fault-reap and count
 (ptc_coll_stats) like any other task — there is no separate collective
 engine to keep correct.
 
-Topology is chosen per (message size, rank count) from the fitted
-transfer-economics model (comm/economics.py over BENCH_comm.json),
-overridable via PTC_MCA_coll_topo:
+Topology is chosen per (message size, rank count, link class) from the
+fitted transfer-economics model (comm/economics.py over
+BENCH_comm.json), overridable via PTC_MCA_coll_topo (with
+coll.topo.ici / coll.topo.dcn per-class overrides, ptc-topo):
 
   reduce legs   ring | binomial | star as explicit event DAGs (the
                 planner below), computed in Python and compiled into
@@ -31,6 +32,12 @@ overridable via PTC_MCA_coll_topo:
   fan-out legs  one src -> Range broadcast riding the native
                 ACTIVATE_BCAST trees (star/chain/binomial selected via
                 ctx.comm_set_topology — the reference machinery)
+  hier (ptc-topo)  two-level trees over a multi-island TopologyModel:
+                reduce legs pair binomially INSIDE each island onto a
+                local head, then the heads star into the root — exactly
+                (islands - 1) DCN crossings; fan-out legs insert a lead
+                class on each remote island's leader (src -> leads over
+                DCN once, leads -> their members at ici cost)
 
 SPMD contract: every rank must build the same collectives in the same
 order (class/arena/collection registration ids are creation-ordered).
@@ -44,7 +51,8 @@ import numpy as np
 
 import parsec_tpu as pt
 
-from .economics import default_economics
+from .economics import HIER, default_economics
+from .topology import default_topology, resolve_class_knob
 
 # reduction operators: (elementwise numpy fn, identity for padding)
 OPS = {
@@ -55,7 +63,10 @@ OPS = {
 }
 
 _NATIVE_TOPO = {"star": "star", "ring": "chain", "chain": "chain",
-                "binomial": "binomial"}
+                "binomial": "binomial",
+                # hier's src->leads / lead->members legs are explicit
+                # classes; the residual Range activations go direct
+                "hier": "star"}
 
 
 def _op_identity(op: str, dtype) -> float:
@@ -95,12 +106,24 @@ def rank_affinity_collection(ctx) -> str:
     return name
 
 
-def _slicing(nbytes: int, itemsize: int) -> Tuple[int, int]:
+def _mesh_class(tmodel) -> Optional[str]:
+    """Dominant link class of the mesh: "dcn" when the topology spans
+    islands (the collective will cross DCN), else "ici"; None for a
+    single rank.  Keys the per-class knob/fit resolution (ptc-topo)."""
+    if tmodel is None or tmodel.nranks <= 1:
+        return None
+    return "dcn" if tmodel.n_islands > 1 else "ici"
+
+
+def _slicing(nbytes: int, itemsize: int,
+             cls: Optional[str] = None) -> Tuple[int, int]:
     """(nslices, slice_elems) for one segment of `nbytes`: slices of
-    ~coll.slice bytes (default comm.chunk_size), at most coll.max_slices
-    per segment — each slice is an independent pipelined chain."""
+    ~coll.slice bytes (default comm.chunk_size, per-link-class override
+    comm.chunk_size.{ici,dcn}), at most coll.max_slices per segment —
+    each slice is an independent pipelined chain."""
     from ..utils import params as _mca
-    q = _mca.get("coll.slice") or _mca.get("comm.chunk_size")
+    q = _mca.get("coll.slice") or resolve_class_knob("comm.chunk_size",
+                                                     cls)
     if q <= 0:
         q = 1 << 20
     cap = max(1, _mca.get("coll.max_slices"))
@@ -147,11 +170,14 @@ class _Plan:
 
 def _plan_reduce(nseg: int, nranks: int, root_of: Callable[[int], int],
                  contributors_of: Callable[[int], Sequence[Tuple[int, object]]],
-                 topo: str, ext: bool) -> _Plan:
+                 topo: str, ext: bool, tmodel=None) -> _Plan:
     """Build the reduction DAG: per segment, local same-rank chains
     first (zero wire traffic), then the cross-rank phase in the chosen
     topology, converging on root_of(seg).  contributors_of(seg) yields
-    (rank, contrib_id) pairs; duplicates per rank are chained locally."""
+    (rank, contrib_id) pairs; duplicates per rank are chained locally.
+    topo == "hier" needs `tmodel` (comm/topology.py): reduce binomially
+    inside each island onto a local head, then star the heads into the
+    root — (islands - 1) inter-island hops total."""
     plan = _Plan()
     for seg in range(nseg):
         root = root_of(seg)
@@ -197,6 +223,42 @@ def _plan_reduce(nseg: int, nranks: int, root_of: Callable[[int], int],
                     state[p] = ("ev", i)
                 j *= 2
             cur = state[0]
+        elif topo == HIER and others:
+            # two-level (ptc-topo): binomial pairing INSIDE each island
+            # onto a local head — the root for its own island, the
+            # lowest contributing rank elsewhere — then the root stars
+            # the remote heads in.  Intra-island hops ride ici links;
+            # only the (islands - 1) head->root hops cross DCN.
+            isl_of = ((lambda r: tmodel.island_of(r)) if tmodel
+                      else (lambda r: 0))
+            groups: Dict[int, List[int]] = {}
+            for r in order:
+                groups.setdefault(isl_of(r), []).append(r)
+            root_isl = isl_of(root)
+            head_val: Dict[int, tuple] = {}
+            for isl in sorted(groups):
+                members = sorted(groups[isl])
+                head = root if isl == root_isl else members[0]
+                rest = [r for r in members if r != head]
+                nodes_list = [head] + rest
+                state = [super_of.get(r) for r in nodes_list]
+                j = 1
+                while j < len(nodes_list):
+                    for p in range(0, len(nodes_list), 2 * j):
+                        q = p + j
+                        if q >= len(nodes_list) or state[q] is None:
+                            continue
+                        i = plan._add(nodes_list[p], seg,
+                                      state[p], state[q])
+                        state[p] = ("ev", i)
+                    j *= 2
+                head_val[isl] = state[0]
+            cur = head_val.get(root_isl)
+            for isl in sorted(groups):
+                if isl == root_isl:
+                    continue
+                i = plan._add(root, seg, cur, head_val[isl])
+                cur = ("ev", i)
         elif others:  # star: the root chains every remote super
             for r in others:
                 i = plan._add(root, seg, cur, super_of[r])
@@ -372,18 +434,54 @@ def _emit_fanout(ctx, tp, uid: int, nseg: int, ns: int, nranks: int,
                  owner_of: Callable[[int], int], arena: str, dtype,
                  src_in: Optional[Callable] = None,
                  src_read: Optional[Callable] = None,
-                 sink: Optional[Callable] = None):
+                 sink: Optional[Callable] = None,
+                 tmodel=None):
     """src(s, sl) on the owner -> Range broadcast to every other rank's
     gw(s, q, sl), each sinking the slice locally.  The wire propagation
     of the one-to-all leg follows the NATIVE bcast topology in force
-    (ctx.comm_set_topology): star / chain / binomial trees."""
+    (ctx.comm_set_topology): star / chain / binomial trees.
+
+    With a multi-island `tmodel` (hier fan-out, ptc-topo) a lead(s, li,
+    sl) class is inserted on each REMOTE island's leader: src sends once
+    per remote island (the only DCN crossings), each lead re-fans to its
+    island's members at ici cost, and src feeds its own island's members
+    directly.  gw instances enumerate followers (non-owner, non-lead
+    ranks) with owner-island followers first, so the src->local and
+    lead->members legs are contiguous Range fans selected by guarded
+    Out deps + -1-routed In deps (the _emit_reduce discipline)."""
     src_name = f"ptc_coll_{uid}_src"
     gw_name = f"ptc_coll_{uid}_gw"
+    lead_name = f"ptc_coll_{uid}_lead"
     rankc = rank_affinity_collection(ctx)
     s, q, sl = pt.L("s"), pt.L("q"), pt.L("sl")
     owner_tab = [owner_of(i) for i in range(nseg)]
     owner_e = pt.call(lambda locs, g, t=owner_tab: t[locs[0]],
                       pure=True)
+    hier = tmodel is not None and tmodel.n_islands > 1 and nranks > 1
+    if hier:
+        nlead = tmodel.n_islands - 1
+        nfol = nranks - 1 - nlead
+        lead_rank, fan_rank, n_local, flo, fhi, li_of = [], [], [], [], [], []
+        for seg in range(nseg):
+            owner = owner_tab[seg]
+            oi = tmodel.island_of(owner)
+            others = [i for i in range(tmodel.n_islands) if i != oi]
+            lead_rank.append([tmodel.leader_of(i) for i in others])
+            fr = [r for r in tmodel.island_ranks(oi) if r != owner]
+            n_local.append(len(fr))
+            lo_row, hi_row = [], []
+            for i in others:
+                lead = tmodel.leader_of(i)
+                mem = [r for r in tmodel.island_ranks(i) if r != lead]
+                lo_row.append(len(fr))
+                fr.extend(mem)
+                hi_row.append(len(fr) - 1)
+            fan_rank.append(fr)
+            flo.append(lo_row)
+            fhi.append(hi_row)
+            li_of.append([next((li for li in range(nlead)
+                                if lo_row[li] <= p <= hi_row[li]), 0)
+                          for p in range(len(fr))])
 
     src = tp.task_class(src_name)
     src.param("s", 0, nseg - 1)
@@ -392,7 +490,19 @@ def _emit_fanout(ctx, tp, uid: int, nseg: int, ns: int, nranks: int,
     src.flow("X", "READ", *( [src_in(s, sl)] if src_in else [] ),
              arena=arena)
     o_deps = []
-    if nranks > 1:
+    if hier:
+        o_deps.append(pt.Out(pt.Ref(lead_name, s, pt.Range(0, nlead - 1),
+                                    sl, flow="X")))
+        if nfol > 0:
+            o_deps.append(pt.Out(
+                pt.Ref(gw_name, s,
+                       pt.Range(0, pt.call(
+                           lambda l, g, t=n_local: t[l[0]] - 1,
+                           pure=True)),
+                       sl, flow="X"),
+                guard=pt.call(lambda l, g, t=n_local:
+                              1 if t[l[0]] > 0 else 0, pure=True)))
+    elif nranks > 1:
         o_deps.append(pt.Out(pt.Ref(gw_name, s, pt.Range(0, nranks - 2),
                                     sl, flow="X")))
     src.flow("O", "W", *o_deps, arena=arena)
@@ -411,14 +521,70 @@ def _emit_fanout(ctx, tp, uid: int, nseg: int, ns: int, nranks: int,
 
     src.body(src_body)
 
-    if nranks > 1:
+    if hier:
+        lead = tp.task_class(lead_name)
+        lead.param("s", 0, nseg - 1)
+        lead.param("li", 0, nlead - 1)
+        lead.param("sl", 0, ns - 1)
+        lead.affinity(rankc, pt.call(
+            lambda l, g, t=lead_rank: t[l[0]][l[1]], pure=True))
+        lead.flow("X", "READ", pt.In(pt.Ref(src_name, s, sl, flow="O")),
+                  arena=arena)
+        fan_deps = []
+        if nfol > 0:
+            fan_deps.append(pt.Out(
+                pt.Ref(gw_name, s,
+                       pt.Range(pt.call(lambda l, g, t=flo: t[l[0]][l[1]],
+                                        pure=True),
+                                pt.call(lambda l, g, t=fhi: t[l[0]][l[1]],
+                                        pure=True)),
+                       sl, flow="X"),
+                guard=pt.call(lambda l, g, lo=flo, hi=fhi:
+                              1 if hi[l[0]][l[1]] >= lo[l[0]][l[1]] else 0,
+                              pure=True)))
+        lead.flow("O", "W", *fan_deps, arena=arena)
+
+        def lead_body(view):
+            i, slc = view["s"], view["sl"]
+            x = view.data("X", dtype=dtype)
+            if view.data_ptr("O"):
+                o = view.data("O", dtype=dtype)
+                o[:x.size] = x
+            if sink is not None:
+                sink(i, slc, x)
+
+        lead.body(lead_body)
+
+    if (hier and nfol > 0) or (not hier and nranks > 1):
         gw = tp.task_class(gw_name)
         gw.param("s", 0, nseg - 1)
-        gw.param("q", 0, nranks - 2)
+        gw.param("q", 0, (nfol - 1) if hier else (nranks - 2))
         gw.param("sl", 0, ns - 1)
-        gw.affinity(rankc, (owner_e + 1 + q) % nranks)
-        gw.flow("X", "READ", pt.In(pt.Ref(src_name, s, sl, flow="O")),
+        if hier:
+            gw.affinity(rankc, pt.call(
+                lambda l, g, t=fan_rank: t[l[0]][l[1]], pure=True))
+            # exactly one producer per instance: src for owner-island
+            # followers, the island's lead otherwise — the inactive dep
+            # routes to -1 (out-of-domain), never a dynamic guard
+            gw.flow(
+                "X", "READ",
+                pt.In(pt.Ref(src_name,
+                             pt.call(lambda l, g, t=n_local:
+                                     l[0] if l[1] < t[l[0]] else -1,
+                                     pure=True),
+                             sl, flow="O")),
+                pt.In(pt.Ref(lead_name,
+                             pt.call(lambda l, g, t=n_local:
+                                     l[0] if l[1] >= t[l[0]] else -1,
+                                     pure=True),
+                             pt.call(lambda l, g, t=li_of: t[l[0]][l[1]],
+                                     pure=True),
+                             sl, flow="O")),
                 arena=arena)
+        else:
+            gw.affinity(rankc, (owner_e + 1 + q) % nranks)
+            gw.flow("X", "READ", pt.In(pt.Ref(src_name, s, sl, flow="O")),
+                    arena=arena)
 
         def gw_body(view):
             if sink is not None:
@@ -442,13 +608,15 @@ def _restore_topo(ctx):
 # array-level primitives
 # --------------------------------------------------------------------
 
-def _prep(local: np.ndarray, nseg: int, op: str):
+def _prep(local: np.ndarray, nseg: int, op: str,
+          cls: Optional[str] = None):
     """Pad the flat local contribution into (nseg, ns, slice_elems) work
     form; padding holds the op identity so sliced reduction of a length
     not divisible by nseg*ns stays exact."""
     flat = np.ravel(local)
     seg_elems = math.ceil(flat.size / nseg) if nseg else 0
-    ns, slice_elems = _slicing(seg_elems * flat.itemsize, flat.itemsize)
+    ns, slice_elems = _slicing(seg_elems * flat.itemsize, flat.itemsize,
+                               cls)
     work = np.full((nseg, ns, slice_elems), _op_identity(op, flat.dtype),
                    dtype=flat.dtype)
     np.ravel(work)[:flat.size] = flat
@@ -469,15 +637,19 @@ def reduce_scatter(ctx, local: np.ndarray, op: str = "sum",
     if R == 1 or not ctx.comm_enabled:
         return flat.copy()
     econ = default_economics()
-    topo = econ.choose_topology("reduce", flat.nbytes, R, override=topo)
+    tmodel = default_topology(R)
+    cls = _mesh_class(tmodel)
+    topo = econ.choose_topology("reduce", flat.nbytes, R, override=topo,
+                                cls=cls, tmodel=tmodel)
     _record(ctx, "reduce_scatter", topo)
-    work, seg_elems, ns, slice_elems = _prep(local, R, op)
+    work, seg_elems, ns, slice_elems = _prep(local, R, op, cls)
     out = np.zeros((ns, slice_elems), dtype=flat.dtype)
     uid = _next_uid(ctx)
     arena = f"__ptc_coll_{uid}"
     ctx.register_arena(arena, slice_elems * flat.itemsize)
     plan = _plan_reduce(R, R, lambda s: s,
-                        lambda s: [(r, r) for r in range(R)], topo, False)
+                        lambda s: [(r, r) for r in range(R)], topo, False,
+                        tmodel=tmodel)
     tp = pt.Taskpool(ctx)
     _emit_reduce(ctx, tp, uid, plan, ns, arena, OPS[op][0], flat.dtype,
                  local_read=lambda cid, seg, s: work[seg, s],
@@ -499,17 +671,21 @@ def all_reduce(ctx, local: np.ndarray, op: str = "sum",
     if R == 1 or not ctx.comm_enabled:
         return local.copy()
     econ = default_economics()
-    rtopo = econ.choose_topology("reduce", flat.nbytes, R, override=topo)
+    tmodel = default_topology(R)
+    cls = _mesh_class(tmodel)
+    rtopo = econ.choose_topology("reduce", flat.nbytes, R, override=topo,
+                                 cls=cls, tmodel=tmodel)
     ftopo = econ.choose_topology("fanout", flat.nbytes // R, R,
-                                 override=topo)
+                                 override=topo, cls=cls, tmodel=tmodel)
     _record(ctx, "all_reduce", rtopo)
-    work, seg_elems, ns, slice_elems = _prep(local, R, op)
+    work, seg_elems, ns, slice_elems = _prep(local, R, op, cls)
     out = np.zeros((R, ns, slice_elems), dtype=flat.dtype)
     uid = _next_uid(ctx)
     arena = f"__ptc_coll_{uid}"
     ctx.register_arena(arena, slice_elems * flat.itemsize)
     plan = _plan_reduce(R, R, lambda s: s,
-                        lambda s: [(r, r) for r in range(R)], rtopo, False)
+                        lambda s: [(r, r) for r in range(R)], rtopo, False,
+                        tmodel=tmodel)
     tp = pt.Taskpool(ctx)
     step_name = _emit_reduce(
         ctx, tp, uid, plan, ns, arena, OPS[op][0], flat.dtype,
@@ -528,7 +704,8 @@ def all_reduce(ctx, local: np.ndarray, op: str = "sum",
                  src_in=lambda s, slc: pt.In(
                      pt.Ref(step_name, fin, slc, flow="R")),
                  sink=lambda s, slc, arr:
-                     out[s, slc].__setitem__(slice(None, arr.size), arr))
+                     out[s, slc].__setitem__(slice(None, arr.size), arr),
+                 tmodel=tmodel if ftopo == HIER else None)
     try:
         _run(ctx, tp)
     finally:
@@ -546,9 +723,12 @@ def all_gather(ctx, local: np.ndarray,
     if R == 1 or not ctx.comm_enabled:
         return flat.copy()
     econ = default_economics()
-    topo = econ.choose_topology("fanout", flat.nbytes, R, override=topo)
+    tmodel = default_topology(R)
+    cls = _mesh_class(tmodel)
+    topo = econ.choose_topology("fanout", flat.nbytes, R, override=topo,
+                                cls=cls, tmodel=tmodel)
     _record(ctx, "all_gather", topo)
-    ns, slice_elems = _slicing(flat.nbytes, flat.itemsize)
+    ns, slice_elems = _slicing(flat.nbytes, flat.itemsize, cls)
     work = np.zeros((ns, slice_elems), dtype=flat.dtype)
     np.ravel(work)[:flat.size] = flat
     out = np.zeros((R, ns, slice_elems), dtype=flat.dtype)
@@ -560,7 +740,8 @@ def all_gather(ctx, local: np.ndarray,
     _emit_fanout(ctx, tp, uid, R, ns, R, lambda s: s, arena, flat.dtype,
                  src_read=lambda s, slc: work[slc],
                  sink=lambda s, slc, arr:
-                     out[s, slc].__setitem__(slice(None, arr.size), arr))
+                     out[s, slc].__setitem__(slice(None, arr.size), arr),
+                 tmodel=tmodel if topo == HIER else None)
     try:
         _run(ctx, tp)
     finally:
@@ -577,9 +758,12 @@ def broadcast(ctx, buf: np.ndarray, root: int = 0,
     if R == 1 or not ctx.comm_enabled:
         return buf.copy()
     econ = default_economics()
-    topo = econ.choose_topology("fanout", flat.nbytes, R, override=topo)
+    tmodel = default_topology(R)
+    cls = _mesh_class(tmodel)
+    topo = econ.choose_topology("fanout", flat.nbytes, R, override=topo,
+                                cls=cls, tmodel=tmodel)
     _record(ctx, "broadcast", topo)
-    ns, slice_elems = _slicing(flat.nbytes, flat.itemsize)
+    ns, slice_elems = _slicing(flat.nbytes, flat.itemsize, cls)
     work = np.zeros((ns, slice_elems), dtype=flat.dtype)
     if ctx.myrank == root:
         np.ravel(work)[:flat.size] = flat
@@ -593,7 +777,8 @@ def broadcast(ctx, buf: np.ndarray, root: int = 0,
                  flat.dtype,
                  src_read=lambda s, slc: work[slc],
                  sink=lambda s, slc, arr:
-                     out[slc].__setitem__(slice(None, arr.size), arr))
+                     out[slc].__setitem__(slice(None, arr.size), arr),
+                 tmodel=tmodel if topo == HIER else None)
     try:
         _run(ctx, tp)
     finally:
@@ -626,14 +811,17 @@ class RefReduce:
                  fanout_sink: Optional[Callable] = None):
         R = max(1, ctx.nodes)
         econ = default_economics()
+        tmodel = default_topology(R)
+        cls = _mesh_class(tmodel)
         self.topo = econ.choose_topology("reduce", arena_bytes, R,
-                                         override=topo)
+                                         override=topo, cls=cls,
+                                         tmodel=tmodel)
         _record(ctx, "ref_reduce", self.topo)
         self.uid = _next_uid(ctx)
         self.arena = f"__ptc_coll_{self.uid}"
         ctx.register_arena(self.arena, arena_bytes)
         self.plan = _plan_reduce(nseg, R, root_of, contributors_of,
-                                 self.topo, ext=True)
+                                 self.topo, ext=True, tmodel=tmodel)
         self.step_name = _emit_reduce(
             ctx, tp, self.uid, self.plan, 1, self.arena, OPS[op][0],
             dtype, final_sink=final_sink,
@@ -642,7 +830,8 @@ class RefReduce:
                     "params_of": prod_params_of})
         if bcast:
             ftopo = econ.choose_topology("fanout", arena_bytes, R,
-                                         override=topo)
+                                         override=topo, cls=cls,
+                                         tmodel=tmodel)
             _set_fanout_topo(ctx, ftopo)
             fin = pt.call(
                 lambda locs, g, t=self.plan.final_of: t[locs[0]],
@@ -658,7 +847,8 @@ class RefReduce:
                          self.arena, dtype,
                          src_in=lambda s, slc: pt.In(
                              pt.Ref(self.step_name, fin, slc, flow="R")),
-                         sink=fanout_sink)
+                         sink=fanout_sink,
+                         tmodel=tmodel if ftopo == HIER else None)
 
     def producer_out_deps(self, cid_of: Callable) -> List:
         """Out deps for the producer's output flow.  cid_of(locals,

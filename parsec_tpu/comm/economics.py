@@ -20,9 +20,13 @@ matched collective shapes dominate at pod scale):
   ring      R-1 rounds of size/R messages — (R-1) latency terms, but
             the bandwidth-optimal 1x payload factor
 
-ROADMAP item 5 (per-link-class routing: loopback/intra-host/ICI/DCN
-economics) will key instances of this model per link class; the loader
-is deliberately dumb about WHERE its numbers came from.
+ptc-topo: the model is keyed per LINK CLASS (loopback / host / ici /
+dcn — comm/topology.py).  A classed testbandwidth sweep publishes
+per-class fits under doc["classes"]; absent a measured fit for a class
+the base fit is scaled by DEFAULT_CLASS_FACTORS (dcn ~4x the fixed
+cost, ~8x the per-byte cost of the flat loopback fit — the
+inter-island network is both farther and oversubscribed).  `cls=None`
+everywhere means the un-classed base model, bit-identical to pre-topo.
 """
 from __future__ import annotations
 
@@ -39,6 +43,24 @@ import numpy as np
 DEFAULT_FIT = {"fixed_overhead_us": 50.0, "per_byte_ns": 1.0}
 
 TOPOLOGIES = ("ring", "binomial", "star")
+
+# The hierarchical two-level tree (ptc-topo): intra-island reduce, then
+# leaders-only exchange, then fan back out.  Kept out of TOPOLOGIES —
+# it only exists (and is only offered by the selector) when a
+# multi-island TopologyModel is in force.
+HIER = "hier"
+
+# (alpha_factor, beta_factor) applied to the base fit when a class has
+# no measured fit of its own.  loopback/host/ici keep the base numbers
+# (the sweep that produced them ran on exactly those paths); dcn scales
+# the fixed cost ~4x (cross-fabric round trip) and the per-byte cost
+# ~8x (oversubscribed inter-island bandwidth).
+DEFAULT_CLASS_FACTORS = {
+    "loopback": (1.0, 1.0),
+    "host": (1.0, 1.0),
+    "ici": (1.0, 1.0),
+    "dcn": (4.0, 8.0),
+}
 
 
 def fit_points(points: Sequence[Tuple[float, float]]) -> Optional[dict]:
@@ -74,9 +96,12 @@ class TransferEconomics:
     clamp to 0 — a transfer cannot have negative fixed cost, and the
     selector only needs the relative ordering."""
 
-    def __init__(self, fits: Dict[str, dict], source: str = "defaults"):
+    def __init__(self, fits: Dict[str, dict], source: str = "defaults",
+                 class_fits: Optional[Dict[str, Dict[str, dict]]] = None):
         self.fits = fits
         self.source = source
+        # ptc-topo: {link_class: {path: fit}} from a classed sweep
+        self.class_fits: Dict[str, Dict[str, dict]] = class_fits or {}
 
     # ------------------------------------------------------------ loading
     @classmethod
@@ -99,32 +124,59 @@ class TransferEconomics:
                 doc = json.load(f)
             fits = {name: p["fit"] for name, p in doc.get("paths", {}).items()
                     if isinstance(p, dict) and p.get("fit")}
-            if not fits:
+            class_fits = {
+                lc: {name: p["fit"]
+                     for name, p in paths.items()
+                     if isinstance(p, dict) and p.get("fit")}
+                for lc, paths in doc.get("classes", {}).items()
+                if isinstance(paths, dict)}
+            class_fits = {lc: f for lc, f in class_fits.items() if f}
+            if not fits and not class_fits:
                 return cls({}, source="defaults")
-            return cls(fits, source=path)
+            return cls(fits, source=path, class_fits=class_fits)
         except (OSError, ValueError, KeyError):
             return cls({}, source="defaults")
 
     # ------------------------------------------------------------- model
-    def path_fit(self, path: str = "rdv") -> dict:
+    def path_fit(self, path: str = "rdv",
+                 cls: Optional[str] = None) -> dict:
         """The (fixed_overhead_us, per_byte_ns) legs for `path`, falling
-        back eager -> rdv -> defaults so a partial sweep still answers."""
+        back eager -> rdv -> defaults so a partial sweep still answers.
+        With a link class: that class's measured fit when the classed
+        sweep ran, else the base fit scaled by DEFAULT_CLASS_FACTORS."""
+        if cls is not None:
+            cfits = self.class_fits.get(cls)
+            if cfits:
+                for cand in (path, "rdv", "eager"):
+                    if cand in cfits:
+                        return cfits[cand]
+            fa, fb = DEFAULT_CLASS_FACTORS.get(cls, (1.0, 1.0))
+            base = self.path_fit(path)
+            if fa == 1.0 and fb == 1.0:
+                return base
+            scaled = dict(base)
+            scaled["fixed_overhead_us"] = base["fixed_overhead_us"] * fa
+            scaled["per_byte_ns"] = base["per_byte_ns"] * fb
+            return scaled
         for cand in (path, "rdv", "eager"):
             if cand in self.fits:
                 return self.fits[cand]
         return dict(DEFAULT_FIT)
 
-    def alpha(self, path: str = "rdv") -> float:
-        return max(0.0, self.path_fit(path)["fixed_overhead_us"]) * 1e-6
+    def alpha(self, path: str = "rdv", cls: Optional[str] = None) -> float:
+        return max(0.0, self.path_fit(path, cls)["fixed_overhead_us"]) * 1e-6
 
-    def beta(self, path: str = "rdv") -> float:
-        return max(0.0, self.path_fit(path)["per_byte_ns"]) * 1e-9
+    def beta(self, path: str = "rdv", cls: Optional[str] = None) -> float:
+        return max(0.0, self.path_fit(path, cls)["per_byte_ns"]) * 1e-9
 
-    def cost(self, nbytes: int, path: str = "rdv") -> float:
-        """Modeled seconds for one transfer of `nbytes` on `path`."""
-        return self.alpha(path) + nbytes * self.beta(path)
+    def cost(self, nbytes: int, path: str = "rdv",
+             cls: Optional[str] = None) -> float:
+        """Modeled seconds for one transfer of `nbytes` on `path` (over
+        link class `cls` when given)."""
+        return self.alpha(path, cls) + nbytes * self.beta(path, cls)
 
-    def eager_threshold(self, fallback: int = 64 * 1024) -> int:
+    def eager_threshold(self, fallback: int = 64 * 1024,
+                        cls: Optional[str] = None) -> int:
         """Fitted eager/rendezvous crossover in bytes: the payload size
         where the modeled eager cost overtakes the rendezvous cost
         (alpha_e + n*beta_e = alpha_r + n*beta_r), clamped to the same
@@ -133,30 +185,38 @@ class TransferEconomics:
         per-byte cost does not exceed rdv's, so the lines never cross),
         `fallback` — typically the static comm.eager_limit — answers.
         This is the split ptc-plan's comm-volume analysis models."""
-        if "eager" not in self.fits or "rdv" not in self.fits:
+        fits = self.class_fits.get(cls) if cls is not None else None
+        if fits is None:
+            fits = self.fits
+        if "eager" not in fits or "rdv" not in fits:
             return fallback
-        be, br = self.beta("eager"), self.beta("rdv")
+        be, br = self.beta("eager", cls), self.beta("rdv", cls)
         if be <= br:
             return fallback
-        n = (self.alpha("rdv") - self.alpha("eager")) / (be - br)
+        n = (self.alpha("rdv", cls) - self.alpha("eager", cls)) / (be - br)
         return int(min(16 << 20, max(16 << 10, n)))
 
     # ---------------------------------------------------------- selector
     def topology_costs(self, kind: str, nbytes: int, nranks: int,
-                       path: str = "rdv") -> Dict[str, float]:
+                       path: str = "rdv", cls: Optional[str] = None,
+                       tmodel=None) -> Dict[str, float]:
         """Modeled completion time per topology for one collective of
         `nbytes` (the per-rank contribution / broadcast payload) across
         `nranks`.  `kind`: "reduce" (reduce-scatter-shaped: the unit is
         a 1/R segment converging on its root) or "fanout" (bcast /
-        all-gather-shaped: the full payload leaves one root)."""
+        all-gather-shaped: the full payload leaves one root).  With a
+        multi-island `tmodel` (comm/topology.py) the dict gains "hier":
+        the two-level tree that reduces inside each island at ici cost
+        and exchanges only between the island leaders at dcn cost —
+        (islands - 1) DCN crossings instead of O(nranks)."""
         if nranks <= 1:
             return {t: 0.0 for t in TOPOLOGIES}
-        a, b = self.alpha(path), self.beta(path)
+        a, b = self.alpha(path, cls), self.beta(path, cls)
         R = nranks
         L = max(1, math.ceil(math.log2(R)))
         if kind == "reduce":
             seg = nbytes / R
-            return {
+            costs = {
                 # R-1 pipelined hops of one segment each
                 "ring": (R - 1) * (a + seg * b),
                 # log rounds, each hop carries a segment
@@ -164,35 +224,71 @@ class TransferEconomics:
                 # one round, but the root's link serializes R-1 segments
                 "star": a + (R - 1) * seg * b,
             }
-        # fanout: full payload from the root
-        return {
-            # chain pipeline: R-1 latency terms, one payload down the pipe
-            # (wire chunking overlaps the hops for large payloads)
-            "ring": (R - 1) * a + nbytes * b,
-            "binomial": L * (a + nbytes * b),
-            "star": a + (R - 1) * nbytes * b,
-        }
+        else:
+            # fanout: full payload from the root
+            costs = {
+                # chain pipeline: R-1 latency terms, one payload down the
+                # pipe (wire chunking overlaps the hops for large payloads)
+                "ring": (R - 1) * a + nbytes * b,
+                "binomial": L * (a + nbytes * b),
+                "star": a + (R - 1) * nbytes * b,
+            }
+        if tmodel is not None and getattr(tmodel, "n_islands", 1) > 1:
+            # Multi-island mesh: reprice the flat trees honestly — their
+            # crossing hops pay DCN cost (assuming island-contiguous
+            # ranks, remap_ranks' invariant) — and offer the two-level
+            # hier tree that crosses DCN only between island leaders.
+            ai = self.alpha(path, "ici")
+            bi = self.beta(path, "ici")
+            ad = self.alpha(path, "dcn")
+            bd = self.beta(path, "dcn")
+            I = tmodel.n_islands
+            Rl = max(len(tmodel.island_ranks(i)) for i in range(I))
+            Li = max(1, math.ceil(math.log2(max(2, Rl))))
+            Ld = max(1, math.ceil(math.log2(I)))
+            unit = nbytes / R if kind == "reduce" else nbytes
+            hop_i = ai + unit * bi
+            hop_d = ad + unit * bd
+            # chain/ring: R-1 hops, I-1 of them cross islands
+            costs["ring"] = (R - I) * hop_i + (I - 1) * hop_d
+            # binomial: log2(R) rounds; the top log2(I) pair across
+            costs["binomial"] = max(0, L - Ld) * hop_i + Ld * hop_d
+            # star: the root's link serializes R-1 transfers, the ones
+            # to/from other islands at DCN per-byte cost
+            far = R - R // I
+            costs["star"] = ad + (R - 1 - far) * unit * bi + far * unit * bd
+            intra = Li * hop_i if Rl > 1 else 0.0
+            costs[HIER] = intra + ad + (I - 1) * unit * bd
+        return costs
 
     def choose_topology(self, kind: str, nbytes: int, nranks: int,
                         path: str = "rdv",
-                        override: Optional[str] = None) -> str:
+                        override: Optional[str] = None,
+                        cls: Optional[str] = None,
+                        tmodel=None) -> str:
         """Pick the cheapest topology under the fitted model.  `override`
         (or the PTC_MCA_coll_topo param when it is not 'auto') wins
         unconditionally — the knob is the escape hatch when the model is
-        wrong for a deployment."""
+        wrong for a deployment.  "hier" is only legal/offered alongside
+        a multi-island `tmodel` (the tree needs island structure)."""
+        hier_ok = tmodel is not None and getattr(tmodel, "n_islands", 1) > 1
         if override is None:
-            from ..utils import params as _mca
-            ov = _mca.get("coll.topo")
+            from .topology import resolve_class_knob
+            ov = resolve_class_knob("coll.topo", cls)
             override = None if ov in (None, "", "auto") else ov
         if override is not None:
-            if override not in TOPOLOGIES:
+            legal = TOPOLOGIES + ((HIER,) if hier_ok else ())
+            if override not in legal:
                 raise ValueError(
                     f"unknown collective topology {override!r} "
-                    f"(coll.topo): expected one of {list(TOPOLOGIES)} "
+                    f"(coll.topo): expected one of {list(legal)} "
                     "or 'auto'")
             return override
-        costs = self.topology_costs(kind, nbytes, nranks, path)
-        return min(costs, key=lambda t: costs[t])
+        costs = self.topology_costs(kind, nbytes, nranks, path, cls,
+                                    tmodel if hier_ok else None)
+        # on modeled-time ties prefer hier: it moves strictly fewer
+        # DCN-crossing bytes than any flat tree of the same cost
+        return min(costs, key=lambda t: (costs[t], 0 if t == HIER else 1))
 
 
 _cached: Optional[TransferEconomics] = None
@@ -209,7 +305,8 @@ def default_economics() -> TransferEconomics:
 
 def choose_topology(kind: str, nbytes: int, nranks: int,
                     override: Optional[str] = None,
-                    econ: Optional[TransferEconomics] = None) -> str:
+                    econ: Optional[TransferEconomics] = None,
+                    tmodel=None) -> str:
     """Module-level convenience over default_economics()."""
     return (econ or default_economics()).choose_topology(
-        kind, nbytes, nranks, override=override)
+        kind, nbytes, nranks, override=override, tmodel=tmodel)

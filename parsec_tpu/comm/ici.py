@@ -110,16 +110,35 @@ class TransferSessionPool:
     the caller's thread.  A lost race establishes two connections and
     keeps the first registered (the loser is dropped; connections are
     cheap to leak once, unlike per-pull setup).
+
+    ptc-topo: when the caller knows the peer's RANK it passes it to
+    get(); the pool classes the session against the process topology
+    model (comm/topology.py) and reports setup cost per link class —
+    on a two-island mesh the ~100 ms establishment is expected to
+    cluster by class, and `stats()["by_class"]` makes that visible.
     """
 
-    def __init__(self):
+    def __init__(self, topo=None, my_rank: int = 0):
         self._lock = threading.Lock()
         self._conns: Dict[str, object] = {}
         self._setup_ms: Dict[str, float] = {}
+        self._cls: Dict[str, str] = {}
         self._established = 0
         self._reused = 0
+        self._topo = topo
+        self._my_rank = int(my_rank)
 
-    def get(self, server, addr: str):
+    def _class_of(self, peer_rank) -> str:
+        if peer_rank is None:
+            return "ici"
+        topo = self._topo
+        if topo is None:
+            from .topology import default_topology
+            topo = self._topo = default_topology(
+                max(self._my_rank, int(peer_rank)) + 1)
+        return topo.class_of(self._my_rank, int(peer_rank))
+
+    def get(self, server, addr: str, peer_rank=None):
         """The session for `addr`, establishing it on first use."""
         with self._lock:
             conn = self._conns.get(addr)
@@ -136,14 +155,22 @@ class TransferSessionPool:
                 return prior
             self._conns[addr] = conn
             self._setup_ms[addr] = dt_ms
+            self._cls[addr] = self._class_of(peer_rank)
             self._established += 1
         return conn
 
     def stats(self) -> dict:
         with self._lock:
+            by_class: Dict[str, dict] = {}
+            for addr, ms in self._setup_ms.items():
+                c = by_class.setdefault(self._cls.get(addr, "ici"),
+                                        {"peers": 0, "setup_ms": 0.0})
+                c["peers"] += 1
+                c["setup_ms"] += ms
             return {
                 "peers": len(self._conns),
                 "established": self._established,
                 "reused": self._reused,
                 "setup_ms": dict(self._setup_ms),
+                "by_class": by_class,
             }

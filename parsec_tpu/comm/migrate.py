@@ -30,7 +30,54 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["migrate_keys", "wanted_keys", "build_page_migration"]
+__all__ = ["migrate_keys", "wanted_keys", "build_page_migration",
+           "migration_class", "migration_cost", "relay_rank_for"]
+
+
+def migration_class(src_rank: int, dst_rank: int, topo=None) -> str:
+    """Link class a (src -> dst) page migration rides (ptc-topo).  The
+    pre-topo code priced every migration at the flat 'ici' rate; a
+    cross-island move actually crosses the DCN and must be priced
+    there."""
+    if topo is None:
+        from .topology import default_topology
+        topo = default_topology(max(src_rank, dst_rank) + 1)
+    return topo.class_of(src_rank, dst_rank)
+
+
+def migration_cost(nbytes: int, src_rank: int, dst_rank: int,
+                   topo=None, econ=None) -> float:
+    """Modeled seconds to migrate `nbytes` of pages src -> dst, priced
+    at the link class of that leg (DCN for cross-island moves)."""
+    if econ is None:
+        from .economics import default_economics
+        econ = default_economics()
+    return econ.cost(int(nbytes), "rdv",
+                     cls=migration_class(src_rank, dst_rank, topo))
+
+
+def relay_rank_for(nbytes: int, src_rank: int, dst_rank: int,
+                   topo=None, econ=None) -> Optional[int]:
+    """The island-leader relay rank for an inter-island migration when
+    forwarding through it is modeled cheaper than the direct classed
+    leg (topology.relay_beats_direct), else None.  The relay is the
+    DESTINATION island's leader — the provisioned DCN endpoint closest
+    to the receiver — unless that leader is one of the endpoints, in
+    which case the source island's leader is tried instead."""
+    if topo is None:
+        from .topology import default_topology
+        topo = default_topology(max(src_rank, dst_rank) + 1)
+    from .topology import relay_beats_direct
+    if not relay_beats_direct(int(nbytes), src_rank, dst_rank,
+                              topo, econ):
+        return None
+    ld = topo.leader_of(topo.island_of(dst_rank))
+    if ld not in (src_rank, dst_rank):
+        return ld
+    ls = topo.leader_of(topo.island_of(src_rank))
+    if ls not in (src_rank, dst_rank):
+        return ls
+    return None
 
 
 def wanted_keys(dst_pool, keys: Sequence) -> List:
@@ -69,7 +116,8 @@ def build_page_migration(pt, ctx, keys: Sequence, wanted_idx: Sequence[int],
                          src_rank: int = 0, dst_rank: int = 1,
                          page: Optional[int] = None,
                          d: Optional[int] = None,
-                         coll_name: str = "MIG"):
+                         coll_name: str = "MIG",
+                         topo=None, econ=None, relay=None):
     """Build the SPMD page-migration taskpool (both ranks run this with
     the SAME keys and wanted_idx — the execution space must agree).
 
@@ -85,7 +133,16 @@ def build_page_migration(pt, ctx, keys: Sequence, wanted_idx: Sequence[int],
     destination rank (an SPMD caller passes its local pool as both —
     only the rank-local one is touched).  `page`/`d` default from
     whichever pool is present.  Returns the taskpool, or None when
-    wanted_idx is empty (nothing to migrate — zero tasks, zero bytes)."""
+    wanted_idx is empty (nothing to migrate — zero tasks, zero bytes).
+
+    ptc-topo: when the (src, dst) leg crosses islands and forwarding
+    through an island leader is modeled cheaper than the penalized
+    direct DCN leg (relay_rank_for), an MFWD(j) pass-through task is
+    inserted on the leader — MSRC -> MFWD -> MRECV — so the bulk pull
+    rides the provisioned leader uplink.  `relay` overrides the
+    decision: None = auto, False = never, an int = relay through that
+    rank unconditionally.  All ranks must agree (SPMD): pass the same
+    topo/econ/relay on every rank."""
     wanted = [int(j) for j in wanted_idx]
     if not wanted:
         return None
@@ -93,6 +150,14 @@ def build_page_migration(pt, ctx, keys: Sequence, wanted_idx: Sequence[int],
     P = int(page if page is not None else pool.page)
     D = int(d if d is not None else pool.d)
     size = P * 2 * D * 4  # one f32 k|v payload tile
+    relay_rank: Optional[int] = None
+    if relay is None:
+        relay_rank = relay_rank_for(size * len(wanted), src_rank,
+                                    dst_rank, topo=topo, econ=econ)
+    elif relay is not False:
+        relay_rank = int(relay)
+        if relay_rank in (src_rank, dst_rank):
+            relay_rank = None
     nodes = getattr(ctx, "nodes", 1) or 1
     arr = np.zeros((max(nodes, 2), P * 2 * D), dtype=np.float32)
     ctx.register_linear_collection(coll_name, arr, elem_size=size,
@@ -116,8 +181,28 @@ def build_page_migration(pt, ctx, keys: Sequence, wanted_idx: Sequence[int],
         buf[:, :D] = payload[0]
         buf[:, D:] = payload[1]
 
-    msrc.flow("P", "W", pt.Out(pt.Ref("MRECV", j, flow="P")),
-              arena=f"{coll_name}_t")
+    recv_src = "MSRC"
+    if relay_rank is not None:
+        mfwd = tp.task_class("MFWD")
+        mfwd.param("j", 0, pt.G("NM"))
+        mfwd.affinity(coll_name, relay_rank)
+        mfwd.flow("X", "R", pt.In(pt.Ref("MSRC", j, flow="P")),
+                  arena=f"{coll_name}_t")
+        mfwd.flow("P", "W", pt.Out(pt.Ref("MRECV", j, flow="P")),
+                  arena=f"{coll_name}_t")
+
+        def fwd_body(view):
+            x = view.data("X", dtype=np.float32, shape=(P, 2 * D))
+            p = view.data("P", dtype=np.float32, shape=(P, 2 * D))
+            p[:] = x
+
+        mfwd.body(fwd_body)
+        msrc.flow("P", "W", pt.Out(pt.Ref("MFWD", j, flow="X")),
+                  arena=f"{coll_name}_t")
+        recv_src = "MFWD"
+    else:
+        msrc.flow("P", "W", pt.Out(pt.Ref("MRECV", j, flow="P")),
+                  arena=f"{coll_name}_t")
     msrc.body(src_body)
 
     def recv_body(view):
@@ -125,7 +210,7 @@ def build_page_migration(pt, ctx, keys: Sequence, wanted_idx: Sequence[int],
         buf = view.data("P", dtype=np.float32, shape=(P, 2 * D))
         dst_pool.import_frozen(key, buf[:, :D], buf[:, D:])
 
-    mrecv.flow("P", "R", pt.In(pt.Ref("MSRC", j, flow="P")),
+    mrecv.flow("P", "R", pt.In(pt.Ref(recv_src, j, flow="P")),
                arena=f"{coll_name}_t")
     mrecv.body(recv_body)
     return tp

@@ -547,6 +547,53 @@ class Context:
                 "overlap_fraction":
                     round(buf[2] / d2h, 4) if d2h > 0 else None}
 
+    def comm_peer_stats(self) -> list:
+        """Per-peer wire counters (ptc-topo): one dict per peer rank
+        with bytes/msgs sent+received, parked streaming GETs, and the
+        min probed RTT to that peer (0 until a probe ran).  Empty when
+        comm is off."""
+        cap = max(1, self.nodes)
+        buf = (C.c_int64 * (cap * 6))()
+        n = N.lib.ptc_comm_peer_stats(self._ptr, buf, cap)
+        out = []
+        for r in range(n):
+            b = buf[r * 6:r * 6 + 6]
+            out.append({"bytes_sent": int(b[0]), "bytes_recv": int(b[1]),
+                        "msgs_sent": int(b[2]), "msgs_recv": int(b[3]),
+                        "parked_gets": int(b[4]), "rtt_ns": int(b[5])})
+        return out
+
+    def comm_probe_rtts(self) -> int:
+        """PING every peer and wait (<= 2 s) for per-peer min RTTs —
+        the link-class auto-detect input (TopologyModel.from_rtts).
+        Returns the number of peers with a measured RTT."""
+        return int(N.lib.ptc_comm_probe_rtts(self._ptr))
+
+    def comm_topo_stats(self) -> dict:
+        """Per-link-class wire counters (ptc-topo): the per-peer native
+        counters folded through the TopologyModel in force, plus the
+        detected class matrix.  Schema is stable when comm is off (all
+        classes present, zeroed; matrix empty) so the unified-stats
+        golden schema holds across single- and multi-rank runs."""
+        from ..comm.topology import LINK_CLASSES, default_topology
+        keys = ("bytes_sent", "bytes_recv", "msgs_sent", "msgs_recv",
+                "parked_gets")
+        classes = {c: {k: 0 for k in keys} for c in LINK_CLASSES}
+        peers = self.comm_peer_stats()
+        rtts = {r: p["rtt_ns"] for r, p in enumerate(peers)
+                if p["rtt_ns"] > 0}
+        topo = default_topology(self.nodes, rtts_ns=rtts or None,
+                                my_rank=self.myrank)
+        for r, p in enumerate(peers):
+            cls = topo.class_of(self.myrank, r)
+            row = classes[cls]
+            for k in keys:
+                row[k] += p[k]
+        return {"classes": classes,
+                "matrix": topo.matrix() if peers else [],
+                "n_islands": topo.n_islands,
+                "source": topo.source}
+
     def coll_stats(self) -> dict:
         """Runtime-native collective counters (the ptc_coll_* task-class
         family built by parsec_tpu.comm.coll): native step/frame/byte
@@ -573,9 +620,10 @@ class Context:
         point in time.
           sched   -> sched_stats() (dispatch fast paths, steals, ...)
           device  -> device_stats() (prefetch/spill/h2d, per-device info)
-          comm    -> engine/rdv/tuning/stream counter groups (empty
+          comm    -> engine/rdv/tuning/stream/topo counter groups (empty
                      sub-dicts stay present when comm is off, so the
-                     schema is stable across single- and multi-rank runs)
+                     schema is stable across single- and multi-rank
+                     runs; topo is the ptc-topo per-link-class split)
           coll    -> coll_stats() (runtime-native collective steps,
                      frames/bytes, per-op topology decisions)
           trace   -> tracing health: level, ring/drop state of the
@@ -615,6 +663,8 @@ class Context:
                 # same snapshot as tuning["stream"], surfaced at the top
                 # level too — one native read, two access paths, no skew
                 "stream": tuning["stream"],
+                # ptc-topo: per-link-class byte/msg split + class matrix
+                "topo": self.comm_topo_stats(),
             },
             "coll": self.coll_stats(),
             "trace": {
@@ -738,6 +788,24 @@ class Context:
                 "set_vpmap: context already started — the scheduler was "
                 "installed with the previous map")
         return vps
+
+    def set_rank_map(self, perm) -> None:
+        """Install (or clear, with None/empty) the ptc-topo rank remap:
+        a permutation applied to every collection rank_of result, so
+        task affinity, successor placement and mem owners relabel
+        consistently — plan.remap_ranks() computes one that minimizes
+        predicted DCN-crossing bytes.  MUST be identical on every rank
+        (SPMD placement), and set between taskpool build and run —
+        rank_of is evaluated lazily at pool startup."""
+        if not perm:
+            N.lib.ptc_context_set_rank_map(self._ptr, None, 0)
+            return
+        perm = [int(x) for x in perm]
+        if sorted(perm) != list(range(len(perm))):
+            raise ValueError(f"rank map must be a permutation of "
+                             f"0..{len(perm) - 1}, got {perm}")
+        arr = (C.c_int32 * len(perm))(*perm)
+        N.lib.ptc_context_set_rank_map(self._ptr, arr, len(perm))
 
     def sched_victim_order(self, worker: int, cap: int = 64):
         """A hierarchical scheduler's computed steal order for `worker`

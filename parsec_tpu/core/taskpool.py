@@ -153,8 +153,19 @@ class Taskpool:
         return plan_taskpool(self, max_instances=max_instances,
                              cost=cost, econ=econ, workers=workers)
 
-    def run(self, verify=None, tuned=None) -> "Taskpool":
+    def run(self, verify=None, tuned=None, remap=None) -> "Taskpool":
         """commit + add to context + start (convenience).
+
+        `remap=` opts into topology-aware rank remapping (ptc-topo):
+        True runs this pool's ptc-plan traffic matrix through
+        Plan.remap_ranks() against the process TopologyModel and
+        installs the winning rank_of permutation via
+        ctx.set_rank_map() before anything schedules (a no-op when
+        the search keeps the identity); an explicit list installs
+        that permutation directly.  The applied permutation (or None)
+        is recorded as `self.remap_applied`.  SPMD contract: every
+        rank must pass the same `remap` — the search is deterministic
+        over the pool's static plan, so remap=True satisfies that.
 
         `verify=` opts into the static dataflow verifier at insert
         time: "error"/True raises VerifyError before anything is
@@ -179,6 +190,14 @@ class Taskpool:
         device runs the ptc-plan pre-run residency check before the
         pool schedules: predicted device peak vs its byte budget (see
         TpuDevice.plan_check)."""
+        self.remap_applied = None
+        if remap is not None and remap is not False:
+            perm = remap if isinstance(remap, (list, tuple)) \
+                else self.plan().remap_ranks()
+            perm = list(perm)
+            if perm != list(range(len(perm))):
+                self.ctx.set_rank_map(perm)
+                self.remap_applied = perm
         knobs = None
         if tuned:
             from ..analysis.tune import resolve_tuned

@@ -156,6 +156,21 @@ class LiveMonitor:
             rec["stream"] = {k: ss[k] for k in
                              ("sessions", "parked_gets",
                               "overlap_fraction")}
+            # per-link-class wire split (ptc-topo): compact rows — the
+            # ici/dcn byte balance is the live signal that hierarchical
+            # collectives / rank remaps are actually keeping bulk
+            # traffic off the inter-island links
+            try:
+                ts = ctx.comm_topo_stats()
+                rec["topo"] = {
+                    "n_islands": ts["n_islands"],
+                    "classes": {c: [row["bytes_sent"],
+                                    row["msgs_sent"]]
+                                for c, row in ts["classes"].items()
+                                if row["msgs_sent"]
+                                or row["bytes_sent"]}}
+            except Exception:
+                pass  # topo rows are best-effort in a live sample
         # always-on latency quantiles (PR7): per-class exec p50/p99 +
         # the per-kind p99s — the continuous-serving signal the offline
         # trace can't give.  Compact form: [count, p50_ns, p99_ns].

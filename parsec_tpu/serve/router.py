@@ -165,16 +165,26 @@ class RoutePolicy:
                     re-place still-queued requests off replicas whose
                     healthy() flips false
       econ          TransferEconomics for the wire legs (defaults to
-                    the fitted BENCH_comm.json model)"""
+                    the fitted BENCH_comm.json model)
+      topo          TopologyModel over REPLICA INDICES (replica i is
+                    "rank" i of the fleet mesh) — ptc-topo.  Migration
+                    legs are priced at the (donor, target) link class,
+                    so a cross-island donor pays the DCN rate and an
+                    intra-island donor the ICI rate; the donor choice
+                    itself minimizes the classed cost.  Defaults to
+                    the PTC_MCA_comm_topology spec over the fleet size
+                    (flat when unset — the pre-topo behavior)."""
 
     def __init__(self, mem_gbps: float = 16.0, migrate: bool = True,
                  digest_mode: str = "set",
-                 replace_unhealthy: bool = True, econ=None):
+                 replace_unhealthy: bool = True, econ=None,
+                 topo=None):
         self.mem_gbps = float(mem_gbps)
         self.migrate = bool(migrate)
         self.digest_mode = digest_mode
         self.replace_unhealthy = bool(replace_unhealthy)
         self.econ = econ or default_economics()
+        self.topo = topo
 
 
 # ------------------------------------------------------------ replica
@@ -301,6 +311,10 @@ class Router:
                    for i in idxs}
         warms = {i: digests[i].predict_warm(keys) for i in idxs}
         best_warm = max(warms.values()) if warms else 0
+        topo = self.policy.topo
+        if topo is None:
+            from ..comm.topology import default_topology
+            topo = default_topology(len(self.replicas))
         rows = []
         for i in idxs:
             ad = snap[i]
@@ -313,7 +327,8 @@ class Router:
             row = {"replica": i, "warm": warm,
                    "healthy": bool(ad.get("healthy", True)),
                    "burn": float(ad.get("slo_burn_rate") or 0.0),
-                   "migrate_pages": 0, "migrate_from": None}
+                   "migrate_pages": 0, "migrate_from": None,
+                   "migrate_cls": None}
             base = dict(est_bytes=est,
                         queued_bytes=int(ad.get("queued_bytes") or 0),
                         active_pools=int(ad.get("active_pools") or 0),
@@ -322,18 +337,27 @@ class Router:
             cost = placement_cost(shared_bytes=warm * pb,
                                   migrate_bytes=0, **base)
             if extra:
-                cmig = placement_cost(
-                    shared_bytes=(warm + extra) * pb,
-                    migrate_bytes=extra * pb, **base)
-                if cmig < cost:
-                    cost = cmig
+                # donor candidates: any OTHER replica advertising the
+                # full best_warm chain.  Each donor's leg is priced at
+                # ITS link class (ptc-topo: an intra-island donor at
+                # ici, a cross-island one at dcn), and the cheapest
+                # classed donor wins (ties -> lowest index).
+                best_mig = None
+                for j in sorted(warms):
+                    if j == i or warms[j] < warm + extra:
+                        continue
+                    cls = topo.class_of(j, i)
+                    cmig = placement_cost(
+                        shared_bytes=(warm + extra) * pb,
+                        migrate_bytes=extra * pb,
+                        migrate_cls=cls, **base)
+                    if best_mig is None or cmig < best_mig[0]:
+                        best_mig = (cmig, j, cls)
+                if best_mig is not None and best_mig[0] < cost:
+                    cost = best_mig[0]
                     row["migrate_pages"] = extra
-                    # the donor: any OTHER replica advertising the full
-                    # best_warm chain (lowest index — deterministic)
-                    for j in sorted(warms):
-                        if j != i and warms[j] >= warm + extra:
-                            row["migrate_from"] = j
-                            break
+                    row["migrate_from"] = best_mig[1]
+                    row["migrate_cls"] = best_mig[2]
             if not row["healthy"]:
                 cost = float("inf")
             row["cost"] = cost

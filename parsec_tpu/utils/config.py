@@ -309,6 +309,51 @@ register("coll.econ_path", "", str,
          "path to a transfer-economics JSON (BENCH_comm.json schema) "
          "for the topology selector; empty = the repo's BENCH_comm.json "
          "when present, else built-in loopback defaults")
+# ptc-topo: link-class topology (comm/topology.py).  The per-class
+# override knobs are registered as strings with '' = inherit-base so 0
+# stays a legal override value; loopback/host always inherit base.
+register("comm.topology", "", str,
+         "hosts-and-islands topology spec (';' separates islands, '|' "
+         "hosts, ',' ranks: \"0,1|2,3;4,5|6,7\"), or a path to a JSON "
+         "file {\"islands\": [[[ranks...],...],...]}.  Empty = flat "
+         "mesh (every non-self pair classes 'ici'; pre-topo behavior "
+         "bit-exactly).  Drives link-class pricing, hierarchical "
+         "collective trees, plan.remap_ranks and the per-class stats "
+         "split (comm/topology.py)")
+register("comm.dcn_nonleader_penalty", 4.0, float,
+         "per-byte multiplier for DCN legs NOT between island leaders "
+         "(host uplinks into the inter-island network are "
+         "oversubscribed; the leader's uplink is the provisioned one). "
+         "Feeds relay_beats_direct: inter-island bulk pulls forward "
+         "through the leaders when the penalized direct leg costs more")
+register("comm.chunk_size.ici", "", str,
+         "per-class override of comm.chunk_size for intra-island "
+         "(ici) legs; '' = inherit comm.chunk_size")
+register("comm.chunk_size.dcn", "", str,
+         "per-class override of comm.chunk_size for inter-island "
+         "(dcn) legs — bigger chunks amortize the higher DCN fixed "
+         "cost; '' = inherit comm.chunk_size")
+register("comm.eager_limit.ici", "", str,
+         "per-class override of comm.eager_limit for ici legs; "
+         "'' = inherit comm.eager_limit")
+register("comm.eager_limit.dcn", "", str,
+         "per-class override of comm.eager_limit for dcn legs — the "
+         "eager/rendezvous crossover sits lower where per-byte cost is "
+         "higher; '' = inherit comm.eager_limit")
+register("comm.rails.ici", "", str,
+         "per-class override of comm.rails for ici legs; '' = inherit "
+         "comm.rails")
+register("comm.rails.dcn", "", str,
+         "per-class override of comm.rails for dcn legs (striping "
+         "cannot beat an oversubscribed uplink, so fewer DCN rails is "
+         "common); '' = inherit comm.rails")
+register("coll.topo.ici", "", str,
+         "per-class override of coll.topo for the intra-island phase "
+         "of hierarchical collectives; '' = inherit coll.topo")
+register("coll.topo.dcn", "", str,
+         "per-class override of coll.topo for the inter-island "
+         "(leader) phase of hierarchical collectives; '' = inherit "
+         "coll.topo")
 register("dtd.window_size", 8000, int,
          "DTD discovery window (reference: parsec_dtd_window_size)")
 register("dtd.insert_batch", 256, int,

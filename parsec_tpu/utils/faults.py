@@ -21,33 +21,60 @@ reads two env knobs at engine init —
                             the chunk window / watermark timing so
                             session races (the PR1 cross-wiring shape)
                             get hammered
+  PTC_COMM_FAULT_DELAY_MAP  per-PEER recv delays, "rank:us,rank:us" —
+                            overrides the global delay for those peers
+                            only, so a flat in-process mesh emulates
+                            latency-separated islands deterministically
+                            (ptc-topo: the two-island soak and the RTT
+                            auto-classing tests run on loopback)
 `comm_fault_env()` builds the env dict; `apply_comm_faults()` applies it
 to THIS process (call before Context.comm_init — the engine snapshots
 the knobs once).
 """
 import os
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Mapping, Optional
 
 from .._native import HOOK_DISABLE, HOOK_NEXT
 
 
-def comm_fault_env(delay_us: int = 0, recv_max: int = 0) -> Dict[str, str]:
+def comm_fault_env(delay_us: int = 0, recv_max: int = 0,
+                   delay_map: Optional[Mapping[int, int]] = None
+                   ) -> Dict[str, str]:
     """Env dict arming the native comm engine's fault injection: a
     per-recv delay (µs) and/or a recv-size cap (bytes — short reads /
-    frame fragmentation).  Hand to a spawned rank's environment, or to
-    apply_comm_faults() for this process."""
+    frame fragmentation), plus an optional per-peer delay map
+    ({peer_rank: µs}) that overrides the global delay for those peers —
+    the ptc-topo island emulator.  Hand to a spawned rank's
+    environment, or to apply_comm_faults() for this process."""
     env: Dict[str, str] = {}
     if delay_us:
         env["PTC_COMM_FAULT_DELAY_US"] = str(int(delay_us))
     if recv_max:
         env["PTC_COMM_FAULT_RECV_MAX"] = str(int(recv_max))
+    if delay_map:
+        env["PTC_COMM_FAULT_DELAY_MAP"] = ",".join(
+            f"{int(r)}:{int(us)}" for r, us in sorted(delay_map.items()))
     return env
 
 
-def apply_comm_faults(delay_us: int = 0, recv_max: int = 0) -> None:
+def island_delay_map(my_rank: int, topo, delay_us: int
+                     ) -> Dict[int, int]:
+    """The {peer: µs} delay map that makes a flat in-process mesh look
+    like `topo` (comm/topology.py TopologyModel) from `my_rank`'s seat:
+    every inter-island peer's recv is delayed by `delay_us`, intra-
+    island peers stay fast.  Feed to comm_fault_env(delay_map=...) in
+    each spawned rank — RTTs then cluster exactly as the RTT
+    auto-classing expects."""
+    return {r: int(delay_us) for r in range(topo.nranks)
+            if r != my_rank and topo.class_of(my_rank, r) == "dcn"}
+
+
+def apply_comm_faults(delay_us: int = 0, recv_max: int = 0,
+                      delay_map: Optional[Mapping[int, int]] = None
+                      ) -> None:
     """Arm comm fault injection for THIS process (before comm_init)."""
-    os.environ.update(comm_fault_env(delay_us, recv_max))
+    os.environ.update(comm_fault_env(delay_us, recv_max, delay_map))
 
 
 class InjectedFault(RuntimeError):

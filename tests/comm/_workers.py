@@ -2398,3 +2398,218 @@ def migrate_kill_receiver(rank: int, nodes: int, port: int,
         assert st is not None and st["reaps"] >= 1, (st, rd)
         assert rd["registered_bytes"] == 0, rd
         ctx.comm_fini()
+
+
+# ------------------------------------------------------------ ptc-topo
+def _apply_island_env(rank: int, spec: str, delay_us: int = 0):
+    """Arm the topology spec (and, optionally, the deterministic
+    inter-island recv-delay map) in THIS process's environment — must
+    run before the Context is created (native comm reads env at init)."""
+    import os
+
+    os.environ["PTC_MCA_comm_topology"] = spec
+    if delay_us:
+        from parsec_tpu.comm.topology import TopologyModel
+        from parsec_tpu.utils.faults import comm_fault_env, island_delay_map
+
+        topo = TopologyModel.parse(spec)
+        os.environ.update(comm_fault_env(
+            delay_map=island_delay_map(rank, topo, delay_us)))
+
+
+def topo_hier_primitives(rank: int, nodes: int, port: int,
+                         spec: str = "0,1;2,3", elems: int = 4096,
+                         delay_us: int = 0, topo="hier"):
+    """All four collectives under a two-island topology spec: the
+    hierarchical two-level tree (reduce inside islands, exchange between
+    island leaders, fan back out) must stay BIT-IDENTICAL to the flat
+    reference — coll_primitives' integer-valued payloads make every
+    association order exact.  delay_us>0 adds the island emulator's
+    per-peer recv delays (the soak shape)."""
+    _apply_island_env(rank, spec, delay_us)
+    coll_primitives(rank, nodes, port, topo=topo, elems=elems)
+
+
+def topo_class_counters(rank: int, nodes: int, port: int,
+                        spec: str = "0,1;2,3"):
+    """Per-link-class wire counters: a rank-hopping chain crosses both
+    intra- and inter-island legs; stats()["comm"]["topo"] must class
+    them per the spec (dcn rows counted, matrix == the model's)."""
+    _apply_island_env(rank, spec)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    from parsec_tpu.comm.topology import TopologyModel
+
+    tm = TopologyModel.parse(spec)
+    with ctx:
+        arr = np.zeros(nodes, dtype=np.int64)
+        ctx.register_linear_collection("A", arr, elem_size=8, nodes=nodes,
+                                       myrank=rank)
+        ctx.register_arena("t", 8)
+        nb = 4 * nodes
+        tp = pt.Taskpool(ctx, globals={"NB": nb})
+        k = pt.L("k")
+        tc = tp.task_class("Task")
+        tc.param("k", 0, pt.G("NB"))
+        tc.affinity("A", k % nodes)
+        tc.flow("A", "RW",
+                pt.In(pt.Mem("A", 0), guard=(k == 0)),
+                pt.In(pt.Ref("Task", k - 1, flow="A")),
+                pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                       guard=(k < pt.G("NB"))),
+                arena="t")
+
+        def body(view):
+            view.data("A", dtype=np.int64)[0] += 1
+
+        tc.body(body)
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        ts = ctx.stats()["comm"]["topo"]
+        assert ts["n_islands"] == tm.n_islands, ts
+        assert ts["source"] == tm.source, ts
+        assert ts["matrix"] == tm.matrix(), ts
+        # the k%nodes walk hops rank r -> r+1 (and nodes-1 -> 0): under
+        # "0,1;2,3" that is one intra-island leg and one dcn leg per
+        # lap from this rank's seat
+        nxt = (rank + 1) % nodes
+        cls = tm.class_of(rank, nxt)
+        row = ts["classes"][cls]
+        assert row["msgs_sent"] > 0, (cls, ts["classes"])
+        assert row["bytes_sent"] > 0, (cls, ts["classes"])
+        # no traffic ever classes loopback (self legs never hit the wire)
+        assert ts["classes"]["loopback"]["msgs_sent"] == 0, ts
+        ctx.comm_fini()
+
+
+def topo_remap_pairs(rank: int, nodes: int, port: int,
+                     spec: str = "0,1;2,3", hops: int = 8,
+                     elems: int = 8192):
+    """Rank-remap end-to-end: two bulk RW chains, each hopping between a
+    logical rank PAIR that identity placement puts on DIFFERENT islands
+    ((0,2) and (1,3) under "0,1;2,3" — every hop a DCN crossing).
+    plan.remap_ranks() must find a permutation co-placing each pair
+    intra-island; running under Taskpool.run(remap=True) must cut this
+    rank's measured DCN bytes >= 30% (they drop to ~zero) while every
+    hop's payload stays bit-identical (asserted inside the body)."""
+    _apply_island_env(rank, spec)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    assert nodes == 4
+    with ctx:
+        data = np.arange(elems, dtype=np.float32)
+        arr = np.tile(data, (nodes, 1))  # same payload on every slot, so
+        # any ownership permutation reads identical bytes (bit-exactness
+        # of the remapped run is decided by construction + the asserts)
+        ctx.register_linear_collection("A", arr, elem_size=elems * 4,
+                                       nodes=nodes, myrank=rank)
+        ctx.register_arena("t", elems * 4)
+
+        def build():
+            tp = pt.Taskpool(ctx, globals={"NB": hops})
+            c, k = pt.L("c"), pt.L("k")
+            tc = tp.task_class("Hop")
+            tc.param("c", 0, 1)
+            tc.param("k", 0, pt.G("NB"))
+            tc.affinity("A", c + 2 * (k % 2))
+            tc.flow("A", "RW",
+                    pt.In(pt.Mem("A", c), guard=(k == 0)),
+                    pt.In(pt.Ref("Hop", c, k - 1, flow="A")),
+                    pt.Out(pt.Ref("Hop", c, k + 1, flow="A"),
+                           guard=(k < pt.G("NB"))),
+                    arena="t")
+
+            def body(view):
+                a = view.data("A", dtype=np.float32)
+                np.testing.assert_array_equal(a, data + view["k"])
+                a += 1.0
+
+            tc.body(body)
+            return tp
+
+        # identity run: every hop crosses islands
+        tp = build()
+        tp.run()
+        tp.wait()
+        ctx.comm_fence()
+        d_ident = ctx.comm_topo_stats()["classes"]["dcn"]["bytes_sent"]
+        assert d_ident > 0, "identity placement must cross the DCN"
+        arr[:] = data  # k==0 owner reads may have bumped the collection
+        # remapped run: the plan's searched permutation, SPMD-identical
+        # on every rank (deterministic search over the same DAG)
+        tp2 = build()
+        plan = tp2.plan()
+        perm = plan.remap_ranks()
+        assert perm != list(range(nodes)), perm
+        pred_ident = plan.class_bytes()
+        pred_remap = plan.class_bytes(perm=perm)
+        assert pred_remap.get("dcn", 0) <= 0.7 * pred_ident["dcn"], \
+            (pred_ident, pred_remap)
+        tp2.run(remap=True)
+        tp2.wait()
+        ctx.comm_fence()
+        assert tp2.remap_applied == perm, (tp2.remap_applied, perm)
+        d_total = ctx.comm_topo_stats()["classes"]["dcn"]["bytes_sent"]
+        d_remap = d_total - d_ident
+        assert d_remap <= 0.7 * d_ident, (d_ident, d_remap)
+        ctx.set_rank_map(None)
+        ctx.comm_fini()
+
+
+def topo_rtt_autodetect(rank: int, nodes: int, port: int,
+                        spec: str = "0,1;2,3", delay_us: int = 120000):
+    """RTT auto-classing end-to-end: NO explicit spec — only the island
+    emulator's per-peer recv delays.  ptc_comm_probe_rtts must measure
+    every peer, and TopologyModel.from_rtts must split the mesh at the
+    delay gap into exactly the islands the (unset) spec describes.
+    The injected delay is LARGE (120 ms) on purpose: loopback RTTs
+    under suite load carry tens of ms of scheduler noise, and the
+    detector's gap must dominate it."""
+    import os
+    import time
+
+    from parsec_tpu.comm.topology import TopologyModel
+    from parsec_tpu.utils.faults import comm_fault_env, island_delay_map
+
+    ref = TopologyModel.parse(spec)
+    os.environ.update(comm_fault_env(
+        delay_map=island_delay_map(rank, ref, delay_us)))
+    os.environ.pop("PTC_MCA_comm_topology", None)
+    pt, ctx = _mk_ctx(rank, nodes, port)
+    with ctx:
+        # The emulated delay SLEEPS on the single comm thread, so any
+        # inbound far-peer frame (another rank's concurrent PING)
+        # queues this rank's near-peer PONGs behind a 120 ms sleep and
+        # inflates the near RTT past the gap the detector needs.  Two
+        # counter-measures: STAGGER the probe windows so each rank
+        # probes an otherwise-idle mesh, and min-CAS over several
+        # rounds so one clean near round suffices.
+        ctx.comm_fence()  # everyone connected before the stagger clock
+        time.sleep(rank * 1.5)
+        got = 0
+        for _ in range(3):
+            got = max(got, ctx.comm_probe_rtts())
+        assert got == nodes - 1, (got, nodes)
+        time.sleep((nodes - rank) * 1.5)  # idle while later ranks probe
+        peers = ctx.comm_peer_stats()
+        rtts = {r: p["rtt_ns"] for r, p in enumerate(peers)
+                if p["rtt_ns"] > 0}
+        tm = TopologyModel.from_rtts(rtts, rank, nodes)
+        assert tm.source == "rtt-autodetect", tm.source
+        assert tm.n_islands == ref.n_islands, (tm.islands, ref.islands)
+        for r in range(nodes):
+            want = "dcn" if ref.class_of(rank, r) == "dcn" else \
+                ("loopback" if r == rank else tm.class_of(rank, r))
+            if want == "dcn":
+                assert tm.class_of(rank, r) == "dcn", \
+                    (r, rtts, tm.islands)
+            elif r == rank:
+                assert tm.class_of(rank, r) == "loopback"
+            else:  # near peer: must NOT class dcn
+                assert tm.class_of(rank, r) != "dcn", \
+                    (r, rtts, tm.islands)
+        # the stats surface folds the same auto-detect in (no spec set)
+        ts = ctx.comm_topo_stats()
+        assert ts["source"] == "rtt-autodetect", ts["source"]
+        assert ts["n_islands"] == ref.n_islands, ts
+        ctx.comm_fence()
+        ctx.comm_fini()

@@ -71,3 +71,37 @@ def test_migrate_kill_receiver_reaps_session():
     errs = [r for r in results if r[0] != "ok"]
     assert not errs, "\n".join(str(e) for e in errs)
     assert [r[1] for r in results] == [0], results
+
+
+# ----------------------------------------------------- ptc-topo pricing
+def test_migration_class_and_cost_classed():
+    """Cross-island migrations price at the DCN fit: same byte count,
+    strictly costlier than the intra-island leg."""
+    from parsec_tpu.comm.economics import TransferEconomics
+    from parsec_tpu.comm.migrate import migration_class, migration_cost
+    from parsec_tpu.comm.topology import TopologyModel
+
+    tm = TopologyModel.parse("0,1;2,3")
+    assert migration_class(0, 1, tm) == "host"
+    assert migration_class(0, 2, tm) == "dcn"
+    assert migration_class(2, 2, tm) == "loopback"
+    econ = TransferEconomics(
+        {"rdv": {"fixed_overhead_us": 50.0, "per_byte_ns": 1.0}},
+        source="synthetic")
+    nb = 1 << 20
+    intra = migration_cost(nb, 0, 1, tm, econ)
+    cross = migration_cost(nb, 0, 2, tm, econ)
+    assert cross > intra, (intra, cross)
+
+
+def test_relay_rank_for_prefers_dst_leader():
+    """Bulk follower->follower DCN pulls route through the destination
+    island's leader; legs that ARE a leader endpoint stay direct."""
+    from parsec_tpu.comm.migrate import relay_rank_for
+    from parsec_tpu.comm.topology import TopologyModel
+
+    tm = TopologyModel.parse("0,1;2,3")
+    nb = 1 << 24
+    assert relay_rank_for(nb, 1, 3, tm) == 2    # dst-island leader
+    assert relay_rank_for(nb, 0, 2, tm) is None  # leader-to-leader
+    assert relay_rank_for(nb, 0, 1, tm) is None  # intra-island

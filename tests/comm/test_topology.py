@@ -1,0 +1,176 @@
+"""ptc-topo link-class model: spec parsing, RTT auto-classing,
+per-class knob resolution, the hierarchical two-level collectives'
+bit-exactness against the flat trees, and the per-class wire counters.
+
+Single-process tests pin the TopologyModel itself; the SPMD tests run
+4-rank two-island meshes (the island emulator's per-peer recv delays
+when a soak shape is wanted) through tests/comm/_workers.py.
+"""
+import json
+
+import pytest
+
+from parsec_tpu.comm.topology import (LINK_CLASSES, TopologyModel,
+                                      default_topology,
+                                      relay_beats_direct,
+                                      resolve_class_knob)
+from tests.comm import _workers
+from tests.comm.test_multirank import _run_spmd
+
+
+# ------------------------------------------------------------- the model
+
+def test_parse_hosts_and_islands():
+    """';' splits islands, '|' hosts, ',' ranks — the grammar the env
+    spec uses."""
+    tm = TopologyModel.parse("0,1|2,3;4,5|6,7")
+    assert tm.n_islands == 2
+    assert tm.nranks == 8
+    assert tm.island_ranks(0) == [0, 1, 2, 3]
+    assert tm.island_ranks(1) == [4, 5, 6, 7]
+    assert tm.class_of(0, 0) == "loopback"
+    assert tm.class_of(0, 1) == "host"      # same host
+    assert tm.class_of(0, 2) == "ici"       # same island, other host
+    assert tm.class_of(0, 4) == "dcn"       # cross-island
+    assert tm.class_of(4, 0) == "dcn"
+    assert tm.leader_of(0) == 0 and tm.leader_of(1) == 4
+    assert tm.leaders() == [0, 4]
+
+
+def test_parse_json_file(tmp_path):
+    p = tmp_path / "topo.json"
+    p.write_text(json.dumps({"islands": [[[0], [1]], [[2], [3]]]}))
+    tm = TopologyModel.parse(str(p))
+    assert tm.n_islands == 2
+    assert tm.source == str(p)
+    assert tm.class_of(0, 1) == "ici"
+    assert tm.class_of(1, 2) == "dcn"
+
+
+def test_parse_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        TopologyModel.parse("0,1;1,2")  # duplicate rank
+    with pytest.raises(ValueError):
+        TopologyModel.parse("0,2")      # missing rank 1 (must be dense)
+    with pytest.raises(ValueError):
+        TopologyModel.parse(";")        # empty
+
+
+def test_flat_and_degradation():
+    """flat() is one island; ranks beyond the spec degrade to 'ici'
+    (class_of never raises — a late-joining rank prices conservatively
+    instead of crashing the pricing path)."""
+    tm = TopologyModel.flat(4)
+    assert tm.n_islands == 1 and tm.source == "flat"
+    assert tm.class_of(1, 2) == "ici"
+    assert tm.class_of(2, 2) == "loopback"
+    spec = TopologyModel.parse("0,1;2,3")
+    assert spec.class_of(0, 99) == "ici"
+    assert spec.class_of(99, 0) == "ici"
+    assert spec.class_of(99, 99) == "loopback"
+
+
+def test_matrix_matches_class_of():
+    tm = TopologyModel.parse("0,1;2,3")
+    m = tm.matrix()
+    assert len(m) == 4 and all(len(row) == 4 for row in m)
+    for s in range(4):
+        for d in range(4):
+            assert m[s][d] == tm.class_of(s, d)
+            assert m[s][d] in LINK_CLASSES
+
+
+def test_from_rtts_splits_at_gap():
+    """Synthetic RTTs with a clear far cluster: the near set becomes my
+    island, the far set the other; no gap -> flat."""
+    rtts = {1: 40_000, 2: 900_000, 3: 950_000}
+    tm = TopologyModel.from_rtts(rtts, my_rank=0, nranks=4)
+    assert tm.source == "rtt-autodetect"
+    assert tm.n_islands == 2
+    assert tm.class_of(0, 1) != "dcn"
+    assert tm.class_of(0, 2) == "dcn" and tm.class_of(0, 3) == "dcn"
+    flat = TopologyModel.from_rtts({1: 50_000, 2: 55_000, 3: 60_000},
+                                   my_rank=0, nranks=4)
+    assert flat.n_islands == 1
+
+
+def test_default_topology_prefers_spec(monkeypatch):
+    monkeypatch.setenv("PTC_MCA_comm_topology", "0,1;2,3")
+    tm = default_topology(4)
+    assert tm.n_islands == 2 and tm.class_of(1, 2) == "dcn"
+    monkeypatch.delenv("PTC_MCA_comm_topology")
+    assert default_topology(4, rtts_ns={1: 10_000, 2: 10_000,
+                                        3: 800_000},
+                            my_rank=0).n_islands == 2
+    assert default_topology(4).source == "flat"
+
+
+# ---------------------------------------------------------- class knobs
+
+def test_resolve_class_knob(monkeypatch):
+    """Per-class spellings override the base knob for ici/dcn only,
+    '' means inherit, and values coerce to the base knob's type."""
+    base = resolve_class_knob("comm.chunk_size")
+    assert resolve_class_knob("comm.chunk_size", "ici") == base
+    assert resolve_class_knob("comm.chunk_size", "host") == base
+    assert resolve_class_knob("comm.chunk_size", None) == base
+    monkeypatch.setenv("PTC_MCA_comm_chunk_size_dcn", "1048576")
+    got = resolve_class_knob("comm.chunk_size", "dcn")
+    assert got == 1048576 and isinstance(got, int)
+    assert resolve_class_knob("comm.chunk_size", "ici") == base
+    monkeypatch.setenv("PTC_MCA_coll_topo_dcn", "hier")
+    assert resolve_class_knob("coll.topo", "dcn") == "hier"
+    monkeypatch.setenv("PTC_MCA_coll_topo_dcn", "")
+    assert resolve_class_knob("coll.topo", "dcn") == \
+        resolve_class_knob("coll.topo")
+
+
+def test_relay_beats_direct_shape(monkeypatch):
+    """Relay wins only on bulk non-leader DCN legs: small payloads stay
+    direct (the intra-island alphas beat the penalty savings),
+    leader-to-leader legs never relay, intra-island legs never relay."""
+    from parsec_tpu.comm.economics import TransferEconomics
+
+    # synthetic econ with a REAL fixed cost per hop (the committed
+    # BENCH_comm fit clamps its intercept to 0, which would make the
+    # relay free at every size and the size threshold untestable)
+    econ = TransferEconomics(
+        {"rdv": {"fixed_overhead_us": 50.0, "per_byte_ns": 1.0}},
+        source="synthetic")
+    tm = TopologyModel.parse("0,1;2,3")
+    assert not relay_beats_direct(1 << 20, 0, 1, tm, econ)  # same island
+    assert not relay_beats_direct(1 << 24, 0, 2, tm, econ)  # leader-leader
+    assert relay_beats_direct(1 << 24, 1, 3, tm, econ)      # bulk, followers
+    assert not relay_beats_direct(64, 1, 3, tm, econ)       # tiny: alphas win
+    monkeypatch.setenv("PTC_MCA_comm_dcn_nonleader_penalty", "1.0")
+    assert not relay_beats_direct(1 << 24, 1, 3, tm, econ)  # no penalty
+
+
+# ------------------------------------------------------------- SPMD 4rk
+
+def test_hier_collectives_bit_identical():
+    """All four primitives under the hierarchical two-level tree on a
+    two-island spec match the in-process references EXACTLY."""
+    _run_spmd(_workers.topo_hier_primitives, 4, timeout=240.0)
+
+
+@pytest.mark.slow
+def test_hier_collectives_under_island_delays():
+    """Same, with the island emulator's per-peer recv delays armed (the
+    soak shape): correctness must not depend on link speed."""
+    _run_spmd(_workers.topo_hier_primitives, 4, timeout=300.0,
+              delay_us=200)
+
+
+def test_per_class_counters():
+    """stats()['comm']['topo'] classes real wire traffic per the spec
+    (dcn rows counted, matrix == the model's, loopback never hit)."""
+    _run_spmd(_workers.topo_class_counters, 4, timeout=240.0)
+
+
+@pytest.mark.slow
+def test_rtt_autodetect_classes_islands():
+    """No spec, only injected per-peer delays: probe + from_rtts must
+    recover the two-island split.  slow: wall-clock staggered probe
+    windows (the island emulator sleeps on the comm thread)."""
+    _run_spmd(_workers.topo_rtt_autodetect, 4, timeout=240.0)

@@ -124,7 +124,21 @@ def test_unified_stats_schema_single_rank():
             comm = s["comm"]
             assert comm["enabled"] is False
             assert set(comm) == {"enabled", "engine", "rdv", "tuning",
-                                 "stream"}
+                                 "stream", "topo"}
+            # PR 17 (ptc-topo): per-link-class split — schema stable
+            # with comm off: every class present and zeroed, flat
+            # single-island matrix, source reported
+            topo = comm["topo"]
+            assert set(topo) == {"classes", "matrix", "n_islands",
+                                 "source"}
+            assert set(topo["classes"]) == {"loopback", "host", "ici",
+                                            "dcn"}
+            for row in topo["classes"].values():
+                assert set(row) == {"bytes_sent", "bytes_recv",
+                                    "msgs_sent", "msgs_recv",
+                                    "parked_gets"}
+                assert all(v == 0 for v in row.values())
+            assert topo["n_islands"] >= 1
             for k in ("msgs_sent", "bytes_recv"):
                 assert k in comm["engine"], k
             for k in ("gets_sent", "registered_bytes", "pending_pulls"):

@@ -288,3 +288,52 @@ def test_no_healthy_replica_is_counted_not_silent():
         router.run(timeout_s=120)  # fh1 still drains; fh2 stays cancelled
     finally:
         _teardown(router, ctxs)
+
+
+def test_migration_priced_per_link_class():
+    """ptc-topo satellite: the SAME warm donor at the SAME warmth wins
+    the migration decision when it sits in the target's island and
+    loses it across islands — the flat-mesh migration pricing bug,
+    pinned.  mem_gbps is chosen so the cold-work saving lands strictly
+    between the intra-island and DCN wire costs of the migrated
+    bytes."""
+    from parsec_tpu.comm.topology import TopologyModel
+
+    model = PagedLM(CFG)
+    ctxs, reps = _fleet(model, n=2)
+    try:
+        shared = [3, 1, 4, 1, 5, 9, 2, 6]
+        keys = prefix_page_keys(model.model_id, shared, CFG.page)
+        pb = 256
+        nbytes = len(keys) * pb
+        from parsec_tpu.comm.economics import default_economics
+        econ = default_economics()
+        s_intra = econ.cost(nbytes, "rdv", cls="host")
+        s_dcn = econ.cost(nbytes, "rdv", cls="dcn")
+        assert s_intra < s_dcn
+        # saving = nbytes / (mem_gbps GB/s); aim midway between the
+        # two wire costs so the class alone decides
+        mem_gbps = nbytes / ((s_intra + s_dcn) / 2) / 1e9
+        adverts = {0: _advert(keys=keys, page_bytes=pb), 1: _advert()}
+
+        intra = Router(reps, RoutePolicy(
+            mem_gbps=mem_gbps, topo=TopologyModel.parse("0,1")))
+        rows = {r["replica"]: r for r in
+                intra.score(shared, adverts=adverts)}
+        assert rows[1]["migrate_from"] == 0
+        assert rows[1]["migrate_pages"] == len(keys)
+        assert rows[1]["migrate_cls"] == "host"
+        intra.close()
+
+        cross = Router(reps, RoutePolicy(
+            mem_gbps=mem_gbps, topo=TopologyModel.parse("0;1")))
+        rows = {r["replica"]: r for r in
+                cross.score(shared, adverts=adverts)}
+        # the only donor is cross-island: priced at dcn, it loses to
+        # the cold prefill — no migration planned
+        assert rows[1]["migrate_pages"] == 0
+        assert rows[1]["migrate_from"] is None
+        assert rows[1]["migrate_cls"] is None
+        router = cross
+    finally:
+        _teardown(router, ctxs)

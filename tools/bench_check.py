@@ -119,6 +119,20 @@ CHECKS = [
     ("BENCH_stream.json", "tuned.tuned_vs_default", "lower", 0.25,
      True),
     ("BENCH_stream.json", "tuned.beats_default", "equal", 0.0, False),
+    # ptc-topo (PR 17): bit_identical and predicted_sound are
+    # equal-direction correctness flags — the remapped run and the
+    # hierarchical collectives must stay bit-exact and the plan's
+    # per-class byte split must never under-bound the wire — never
+    # relaxed.  dcn_reduction and the hier-vs-ring byte ratio are
+    # deterministic byte-count trajectories (small control-plane
+    # jitter only); the hier-vs-ring wall is a timing row,
+    # oversubscription-slacked (4 ranks timeshare one host).
+    ("BENCH_topo.json", "bit_identical", "equal", 0.0, False),
+    ("BENCH_topo.json", "remap.predicted_sound", "equal", 0.0, False),
+    ("BENCH_topo.json", "remap.dcn_reduction", "higher", 0.25, False),
+    ("BENCH_topo.json", "allreduce.dcn_ratio_hier_vs_ring", "lower",
+     0.25, False),
+    ("BENCH_topo.json", "allreduce.hier_vs_ring", "lower", 0.50, True),
     # ptc-plan analyzer runtime on the potrf bench tiling (NT=16, 816
     # instances; PR 10): `make plan-graphs` emits the number, the 5 s
     # absolute budget lives in tools/plan_graphs.py — this row guards

@@ -89,6 +89,15 @@ def render_live(samples):
         for name, row in (rec.get("latency") or {}).items():
             lines.append(f"  {name:<14} n={row[0]:<8} "
                          f"p50={row[1] / 1e3:.1f}us p99={row[2] / 1e3:.1f}us")
+        topo = rec.get("topo")
+        if topo and topo.get("classes"):
+            # per-link-class wire split (ptc-topo): bytes/msgs sent per
+            # class — dcn staying small is the hier/remap win, live
+            parts = " ".join(
+                f"{cls}={row[0] // 1024}kb/{row[1]}m"
+                for cls, row in sorted(topo["classes"].items()))
+            lines.append(f"  topo: islands={topo.get('n_islands', 1)} "
+                         f"{parts}")
         for name, row in (rec.get("serve") or {}).items():
             t = tenants.setdefault(name, {})
             t["active"] = t.get("active", 0) + row.get("active", 0)

@@ -214,6 +214,68 @@ def colocated_coll(workers: int, elems: int, port: int, env=None) -> None:
                 os.environ[k] = v
 
 
+def colocated_hier_coll(workers: int, elems: int, port: int,
+                        env=None) -> None:
+    """ptc-topo: FOUR ranks in one process on a two-island topology
+    spec running the hierarchical two-level collectives (intra-island
+    binomial reduce onto heads, leaders-only exchange, follower fan-
+    out) plus the per-class counter folds — the island-leader step
+    deliveries, the -1 route-table deactivations and the classed
+    counter reads all under TSan's happens-before analysis."""
+    import threading
+
+    from parsec_tpu.comm import coll
+
+    env = dict(env or {})
+    env.setdefault("PTC_MCA_comm_topology", "0,1;2,3")
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    nodes = 4
+    errs = []
+
+    def rank_prog(rank):
+        try:
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, nodes)
+            ctx.comm_init(port)
+            with ctx:
+                alls = [np.arange(elems, dtype=np.float32) + 100.0 * r
+                        for r in range(nodes)]
+                total = np.sum(np.stack(alls), axis=0,
+                               dtype=np.float32)
+                for _ in range(2):
+                    got = coll.all_reduce(ctx, alls[rank], topo="hier")
+                    assert (got == total).all()
+                got = coll.broadcast(ctx, alls[rank].copy(), root=1,
+                                     topo="hier")
+                assert (got == alls[1]).all()
+                st = ctx.coll_stats()
+                assert st["by_topo"].get("hier", 0) >= 3, st
+                ts = ctx.comm_topo_stats()
+                assert ts["n_islands"] == 2, ts
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(nodes)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def metrics_watchdog_coll(workers: int, elems: int, port: int,
                           env=None) -> None:
     """PR 7 observability paths under TSan: the lock-free metrics hot
@@ -799,6 +861,14 @@ def main():
                               env={"PTC_MCA_comm_eager_limit": "0",
                                    "PTC_MCA_comm_chunk_size": "2048",
                                    "PTC_MCA_comm_rails": "2"})
+        # ptc-topo (PR 17): two-island hierarchical collectives, 4
+        # colocated ranks — island-leader exchange + follower fan-out
+        # step deliveries over the chunked wire + per-class counter
+        # folds, one TSan-observed address space
+        colocated_hier_coll(workers=2, elems=4096, port=30060 + rep,
+                            env={"PTC_MCA_comm_eager_limit": "0",
+                                 "PTC_MCA_comm_chunk_size": "2048",
+                                 "PTC_MCA_comm_rails": "2"})
         # serving runtime (PR 9): QoS lanes + concurrent pool
         # creation/retirement + admission churn under a 2-rank context
         serve_churn(workers=4, port=30020 + rep)

@@ -29,9 +29,20 @@ same two quantities the adaptive eager threshold is derived from, so
 the model is checkable against the engine's own calibration (also
 reported, from a dedicated eager_limit=auto run).
 
+ptc-topo: `--classed` (or an explicit `--classes ici,dcn`) re-runs the
+wire paths per LINK CLASS and publishes the per-class fits under
+doc["classes"] = {cls: {path: {"fit": ...}}} — exactly the shape
+TransferEconomics.load consumes for class-aware pricing.  On loopback
+the dcn class is EMULATED with the native per-peer fault delay map
+(PTC_COMM_FAULT_DELAY_MAP, --dcn-delay-us µs per recv) — the same
+deterministic island emulator the topology tests use; on a real
+multi-host deployment run the harness once per link class between
+hosts of that class and merge the docs.
+
   python tools/testbandwidth.py                        # full sweep
   python tools/testbandwidth.py --paths device --sizes 4194304
   python tools/testbandwidth.py --quick --json /tmp/comm.json
+  python tools/testbandwidth.py --quick --classed      # + per-class fits
   make bench-comm                                      # BENCH-style file
 """
 import json
@@ -243,6 +254,35 @@ def main():
             doc["paths"][path] = {"error": str(e)[:300]}
         print(json.dumps({path: doc["paths"][path]}), flush=True)
         port += 4
+    # ptc-topo classed sweep: the wire paths again, once per link
+    # class.  ici = the plain loopback wire; dcn = the same wire under
+    # the per-peer fault delay map (deterministic island emulation).
+    # The device path is skipped — staging is class-independent.
+    if "--classed" in sys.argv or _arg("--classes"):
+        cls_list = [c for c in (_arg("--classes") or "ici,dcn").split(",")
+                    if c]
+        dcn_us = int(_arg("--dcn-delay-us", "150"))
+        doc["meta"]["dcn_delay_us"] = dcn_us
+        doc["classes"] = {}
+        for cls_name in cls_list:
+            extra = {}
+            if cls_name == "dcn":
+                extra = {"PTC_COMM_FAULT_DELAY_MAP":
+                         f"0:{dcn_us},1:{dcn_us}"}
+            doc["classes"][cls_name] = {}
+            for path in paths:
+                if path == "device":
+                    continue
+                try:
+                    doc["classes"][cls_name][path] = run_path(
+                        path, sizes, hops, reps, port, extra_env=extra)
+                except Exception as e:
+                    doc["classes"][cls_name][path] = \
+                        {"error": str(e)[:300]}
+                print(json.dumps(
+                    {f"{cls_name}.{path}":
+                     doc["classes"][cls_name][path]}), flush=True)
+                port += 4
     out = _arg("--json")
     if out:
         with open(out, "w") as f:
