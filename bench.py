@@ -1388,7 +1388,7 @@ def _xla_psum_baseline(sizes, reps):
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=2").strip()
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     if not os.environ.get("PTC_BENCH_TPU"):
         jax.config.update("jax_platforms", "cpu")
@@ -1909,6 +1909,15 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
     from parsec_tpu.serve import (InferenceEngine, PagedLM, PagedLMConfig,
                                   TenantConfig)
 
+    # 8 virtual host devices BEFORE the first jax backend use: the tp
+    # section pins one per colocated rank (up to 4) and the spec
+    # section's fused-verify run takes device 0
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
     cfg = PagedLMConfig(vocab=48, d=16, page=4, seed=7)
     model = PagedLM(cfg)
     rng = np.random.RandomState(seed)
@@ -2060,6 +2069,13 @@ def bench_serve_suite(n_hi=6, n_lo=18, max_new=6, workers=2, seed=0,
         # routed-vs-single bit_identical flag is an equal-direction
         # correctness row bench_check NEVER relaxes
         "fleet": _fleet_bench_section(model, workers=workers),
+        # ptc-shard: 2- and 4-rank tensor-parallel PagedLM vs the
+        # single-rank reference — bit_identical and the every-rank-
+        # fused-waves verdict are equal-direction correctness flags
+        # bench_check NEVER relaxes; the per-token wall ratio is an
+        # oversubscription-slacked timing trajectory row (all ranks
+        # timeshare this host)
+        "tp": _tp_bench_section(workers=workers),
     })
     if oversub:
         doc["caveat"] = (
@@ -2349,6 +2365,172 @@ def _fleet_bench_section(model, workers=2, groups=3, per_group=4,
         "migrated_bytes": rstats["router"]["migrated_bytes"],
         "bit_identical": bit_identical,
     }
+
+
+def _tp_bench_section(workers=2, max_new=6, n_reqs=3, seed=23,
+                      base_port=29930):
+    """ptc-shard tensor-parallel section: the SAME request mix (shared
+    prefix + speculative decoding k=2 both LIVE) decodes on a 1-rank
+    reference engine and on 2- and 4-rank colocated tp groups — a
+    heads=4 qlog PagedLM with head-sharded KV pages, the per-rank
+    partial pre-logit projections summed by the RefReduce chain
+    embedded in every decode/prefill/verify pool, and SPMD next-token
+    selection off the fanned-out reduction.  Records:
+
+      bit_identical   every tp degree reproduces the single-rank
+                      reference AND the numpy oracle — tokens and the
+                      exact f32 pre-logit bytes (the qlog dyadic grids
+                      make the split reduction exact in any
+                      association) — equal-direction, never relaxed
+      tpN.ms_per_token  decode wall per generated token; flat-ish as tp
+                      grows is the win, but all ranks timeshare one
+                      host so this is oversubscription-slacked timing
+      tpN.fused_waves per-rank PR 13 wave-compiler counts from a
+                      separate device-attached run of the same mix
+                      (each rank certifies + fuses ITS OWN shard of
+                      the batched verify wave); all_ranks_fused is the
+                      fused_waves>0-on-every-rank verdict —
+                      equal-direction, never relaxed
+      tpN.coll_wait_ms  total engine stall on the embedded collective
+    """
+    import threading
+
+    from parsec_tpu.serve import InferenceEngine, PagedLM, PagedLMConfig
+
+    cfg = PagedLMConfig(heads=4, qlog=True, seed=11)
+    model = PagedLM(cfg)
+    rng = np.random.RandomState(seed)
+    common = list(rng.randint(0, cfg.vocab, size=2 * cfg.page))
+    reqs = [(common + list(rng.randint(0, cfg.vocab,
+                                       size=int(rng.randint(0, 6)))),
+             max_new) for _ in range(n_reqs)]
+    oracle = [model.reference_generate(p, m) for p, m in reqs]
+
+    def drive(eng):
+        hs = []
+        t0 = time.monotonic()
+        for p, m in reqs:
+            h = eng.submit(p, m)
+            hs.append(h)
+            while h.state == "submitted":
+                if time.monotonic() - t0 > 120:
+                    raise TimeoutError("prefill stuck")
+                time.sleep(0.001)
+        while eng.pending() or eng._inflight:
+            if time.monotonic() - t0 > 240:
+                raise TimeoutError("decode stuck")
+            eng.step()
+        return hs
+
+    def run_group(nodes, port, with_dev=False):
+        results = {}
+
+        def worker(rank):
+            try:
+                ctx = pt.Context(nb_workers=1)
+                ctx.set_rank(rank, nodes)
+                ctx.comm_init(port)
+                ctx.comm_set_colocated(
+                    [r for r in range(nodes) if r != rank])
+                with ctx:
+                    dev = None
+                    if with_dev:
+                        import jax
+
+                        from parsec_tpu.device import TpuDevice
+                        jd = jax.devices()
+                        dev = TpuDevice(ctx,
+                                        jax_device=jd[rank % len(jd)])
+                    try:
+                        eng = InferenceEngine(
+                            ctx, model, n_pages=128, max_seqs=8,
+                            tp=nodes, spec_k=2, dev=dev)
+                        t0 = time.perf_counter()
+                        hs = drive(eng)
+                        wall = time.perf_counter() - t0
+                        st = dict(eng.stats)
+                        fuse = (ctx.device_stats().get("fuse", {})
+                                if with_dev else {})
+                        toks = [list(h.tokens) for h in hs]
+                        outs = [[o.copy() for o in h.outputs]
+                                for h in hs]
+                        eng.close()
+                    finally:
+                        if dev is not None:
+                            dev.stop()
+                    ctx.comm_fence()
+                    ctx.comm_fini()
+                results[rank] = ("ok", toks, outs, wall, st, fuse)
+            except Exception:
+                import traceback
+                results[rank] = ("err", traceback.format_exc(),
+                                 None, None, None, None)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(nodes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=280)
+        for r in range(nodes):
+            st = results.get(r, ("missing", None))
+            assert st[0] == "ok", f"tp{nodes} rank {r}: {st[1]}"
+        return results
+
+    # ---- single-rank reference (same mix, prefix + spec on)
+    with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+        eng = InferenceEngine(ctx, model, n_pages=128, max_seqs=8,
+                              spec_k=2)
+        t0 = time.perf_counter()
+        hs = drive(eng)
+        ref_wall = time.perf_counter() - t0
+        eng.close()
+    tokens = sum(len(h.generated) for h in hs)
+    ref_toks = [list(h.tokens) for h in hs]
+    ref_pre = [[model.pre_logits(o) for o in h.outputs] for h in hs]
+    bit_identical = True
+    for i, ((p, m), (ot, oo)) in enumerate(zip(reqs, oracle)):
+        if ref_toks[i] != ot:
+            bit_identical = False
+        for j in range(m):
+            if not np.array_equal(ref_pre[i][j], model.pre_logits(oo[j])):
+                bit_identical = False
+
+    doc = {"requests": len(reqs), "tokens": tokens,
+           "heads": cfg.heads, "d": cfg.d,
+           "tp1": {"ms_per_token": round(ref_wall * 1e3 / tokens, 3)}}
+    all_fused = True
+    for i, nodes in enumerate((2, 4)):
+        res = run_group(nodes, base_port + 4 * i)
+        # every rank's tokens + reduced pre-logit bytes must equal the
+        # single-rank reference (and, transitively, the oracle)
+        for r in range(nodes):
+            if res[r][1] != ref_toks:
+                bit_identical = False
+            for o_tp, o_ref in zip(res[r][2], ref_pre):
+                for a, b in zip(o_tp, o_ref):
+                    if not np.array_equal(a, b):
+                        bit_identical = False
+        wall = max(res[r][3] for r in range(nodes))
+        st = res[0][4]
+        fres = run_group(nodes, base_port + 4 * i + 2, with_dev=True)
+        fused = [fres[r][5].get("fused_waves", 0) for r in range(nodes)]
+        if not all(f > 0 for f in fused):
+            all_fused = False
+        doc[f"tp{nodes}"] = {
+            "ms_per_token": round(wall * 1e3 / tokens, 3),
+            "coll_pools": st["tp_coll_pools"],
+            "coll_wait_ms": round(st["tp_coll_wait_ns"] / 1e6, 3),
+            "prefix_hits": st["prefix_hits"],
+            "spec_accepted": st["spec_accepted"],
+            "fused_waves": fused,
+        }
+    doc["bit_identical"] = bit_identical
+    doc["all_ranks_fused"] = all_fused
+    doc["tp4_vs_tp1_ms_per_token"] = round(
+        doc["tp4"]["ms_per_token"] / max(1e-9,
+                                         doc["tp1"]["ms_per_token"]), 3)
+    return doc
 
 
 def _arg_after(flag, default):

@@ -163,6 +163,11 @@ class Plan:
         self.per_rank: Dict[int, Dict[str, int]] = {}
         self.edges_bytes: Dict[Tuple[int, int], int] = {}
         self.edges_msgs: Dict[Tuple[int, int], int] = {}
+        # collective sub-matrix: (src, dst) -> {"bytes", "msgs"} for
+        # edges whose producer is a ptc_coll_* chain class (ptc-shard:
+        # the embedded tensor-parallel reduction legs, costed per link
+        # class by coll_legs())
+        self.coll_edges: Dict[Tuple[int, int], Dict[str, int]] = {}
         # per-rank wave tables: rank -> [{"wave", "tasks", "classes"}]
         self.waves: Dict[int, List[dict]] = {}
         # wave-fusability certificates: one record per (rank, wave) —
@@ -223,7 +228,8 @@ class Plan:
             return 0
         return max(r[key] for r in rows)
 
-    def est_bytes(self, discount_bytes: int = 0) -> Optional[int]:
+    def est_bytes(self, discount_bytes: int = 0,
+                  rank: Optional[int] = None) -> Optional[int]:
         """Admission-control byte estimate: the pool's global working
         set (sum of per-rank peaks — every rank holds its own mirrors).
         None only when the symbolic fallback could not bound it.
@@ -233,9 +239,17 @@ class Plan:
         predicted to map onto frozen prefix-cache pages cost admission
         nothing); the estimate never discounts below 1 byte, so a
         known bound stays distinguishable from the <=0 UNKNOWN
-        sentinel serve admission uses."""
+        sentinel serve admission uses.
+
+        `rank` restricts the estimate to ONE rank's peak (ptc-shard:
+        a tensor-parallel pool holds 1/R of the weights and KV pages
+        per rank, so per-rank admission must not be charged the global
+        sum — each rank's server admits against its own residency)."""
         if self.bounded:
             total = self._symbolic_peak
+        elif rank is not None:
+            row = self.per_rank.get(rank)
+            total = row["peak_bytes"] if row is not None else 0
         else:
             total = sum(r["peak_bytes"] for r in self.per_rank.values())
         if total is None:
@@ -343,6 +357,41 @@ class Plan:
         """Predicted inter-island payload bytes (the slow-network spend
         the topo tier exists to shrink)."""
         return self.class_bytes(tmodel, perm)["dcn"]
+
+    def coll_bytes(self) -> int:
+        """Total payload bytes carried by ptc_coll_* chain edges (the
+        embedded collective's share of comm_bytes())."""
+        return sum(r["bytes"] for r in self.coll_edges.values())
+
+    def coll_legs(self, tmodel=None, econ=None) -> List[dict]:
+        """Classed collective legs (ptc-shard): one record per
+        (src, dst) wire edge produced by a ptc_coll_* chain class,
+        carrying its ptc-topo link class and the modeled wire cost
+        under the PR 17 transfer economics —
+
+          {"src", "dst", "cls", "bytes", "msgs", "cost_us"}
+
+        cost_us = (msgs * alpha("rdv", cls) + bytes * beta("rdv", cls))
+        in microseconds (rdv mode — coll chunks stream large segments).
+        Sorted most-expensive-first, so the top row is the leg a
+        topology remap or chunk-size retune should attack.  Empty when
+        the pool embeds no collective."""
+        if not self.coll_edges:
+            return []
+        tm = self._tmodel(tmodel)
+        if econ is None:
+            from ..comm.economics import default_economics
+            econ = default_economics()
+        legs = []
+        for (s, d), r in sorted(self.coll_edges.items()):
+            cls = tm.class_of(s, d)
+            cost = (r["msgs"] * econ.alpha("rdv", cls)
+                    + r["bytes"] * econ.beta("rdv", cls)) * 1e6
+            legs.append({"src": s, "dst": d, "cls": cls,
+                         "bytes": r["bytes"], "msgs": r["msgs"],
+                         "cost_us": float(cost)})
+        legs.sort(key=lambda g: -g["cost_us"])
+        return legs
 
     def _perm_cost(self, perm: List[int], tmodel, econ) -> float:
         """Modeled wire seconds of the traffic matrix under `perm`:
@@ -531,6 +580,9 @@ class Plan:
             "comm": {
                 "total_bytes": self.comm_bytes(),
                 "eager_limit": self.eager_limit,
+                "coll_bytes": self.coll_bytes(),
+                "coll_edges": {f"{s}->{d}": dict(r)
+                               for (s, d), r in self.coll_edges.items()},
             },
             "est_bytes": self.est_bytes(),
         }
@@ -1249,10 +1301,10 @@ class _Analyzer:
                             continue
                         sent.add(dedup)
                         self._account_edge(src_rank, dst_rank, payload,
-                                           eager_limit)
+                                           eager_limit, cls=cm.name)
 
     def _account_edge(self, src: int, dst: int, payload: int,
-                      eager_limit: int):
+                      eager_limit: int, cls: Optional[str] = None):
         plan = self.plan
         for r in (src, dst):
             if r not in plan.per_rank:
@@ -1273,6 +1325,10 @@ class _Analyzer:
         key = (src, dst)
         plan.edges_bytes[key] = plan.edges_bytes.get(key, 0) + payload
         plan.edges_msgs[key] = plan.edges_msgs.get(key, 0) + 1
+        if cls is not None and cls.startswith("ptc_coll_"):
+            row = plan.coll_edges.setdefault(key, {"bytes": 0, "msgs": 0})
+            row["bytes"] += payload
+            row["msgs"] += 1
 
     # ------------------------------------------------------- makespan
     def _makespan(self, cost: CostModel, workers: int):

@@ -786,6 +786,121 @@ def broadcast(ctx, buf: np.ndarray, root: int = 0,
     return np.ravel(out)[:flat.size].reshape(buf.shape)
 
 
+def restore_topology(ctx):
+    """Put the configured default broadcast topology back on the comm
+    layer.  In-pool emissions (all_reduce_into, RefReduce(bcast=True))
+    leave the chosen fanout topology set — a per-pool restore would race
+    other live pools — so a long-lived driver restores once on
+    teardown."""
+    _restore_topo(ctx)
+
+
+def all_reduce_into(ctx, tp, local: np.ndarray, op: str = "sum",
+                    topo: Optional[str] = None) -> np.ndarray:
+    """Emit an all-reduce INTO the caller's live taskpool `tp` (ptc-shard
+    satellite of the RefReduce machinery): the same ptc_coll_* step /
+    leaf / src / gw chains the standalone all_reduce builds, but fused
+    into an application pool the caller runs — the collective overlaps
+    whatever else that pool is doing instead of bulk-synchronizing.
+
+    Returns a result array (same shape as `local`): ZERO-FILLED now,
+    written by the fan-out sink tasks as the pool executes — valid after
+    the caller's tp.run()/wait().  The chosen fanout topology stays set
+    on the ctx (see restore_topology)."""
+    R = max(1, ctx.nodes)
+    flat = np.ravel(local)
+    res = np.zeros(local.shape, dtype=flat.dtype)
+    if R == 1 or not ctx.comm_enabled:
+        np.ravel(res)[...] = flat
+        return res
+    econ = default_economics()
+    tmodel = default_topology(R)
+    cls = _mesh_class(tmodel)
+    rtopo = econ.choose_topology("reduce", flat.nbytes, R, override=topo,
+                                 cls=cls, tmodel=tmodel)
+    ftopo = econ.choose_topology("fanout", flat.nbytes // R, R,
+                                 override=topo, cls=cls, tmodel=tmodel)
+    _record(ctx, "all_reduce_into", rtopo)
+    work, seg_elems, ns, slice_elems = _prep(local, R, op, cls)
+    uid = _next_uid(ctx)
+    arena = f"__ptc_coll_{uid}"
+    ctx.register_arena(arena, slice_elems * flat.itemsize)
+    plan = _plan_reduce(R, R, lambda s: s,
+                        lambda s: [(r, r) for r in range(R)], rtopo, False,
+                        tmodel=tmodel)
+    step_name = _emit_reduce(
+        ctx, tp, uid, plan, ns, arena, OPS[op][0], flat.dtype,
+        local_read=lambda cid, seg, s: work[seg, s])
+    fin = pt.call(lambda locs, g, t=plan.final_of: t[locs[0]],
+                  pure=True)
+    sl = pt.L("sl")
+    tp.class_by_name(step_name).flows[2].deps.append(
+        pt.Out(pt.Ref(f"ptc_coll_{uid}_src", _tab(
+            [plan.events[i].seg for i in range(len(plan.events))]), sl,
+            flow="X"),
+            guard=_tab([1 if e.final else 0 for e in plan.events])))
+    rf = np.ravel(res)
+
+    def sink(s, slc, arr, rf=rf, se=seg_elems, sl_e=slice_elems,
+             n=flat.size):
+        # work layout rows are ns*slice_elems wide but a segment's
+        # LOGICAL payload is seg_elems: clip each slice to its own
+        # segment so identity padding never bleeds into the next one
+        base = s * se
+        lo = base + slc * sl_e
+        hi = min(lo + arr.size, base + se, n)
+        if hi > lo:
+            rf[lo:hi] = arr[:hi - lo]
+
+    _set_fanout_topo(ctx, ftopo)
+    _emit_fanout(ctx, tp, uid, R, ns, R, lambda s: s, arena, flat.dtype,
+                 src_in=lambda s, slc: pt.In(
+                     pt.Ref(step_name, fin, slc, flow="R")),
+                 sink=sink,
+                 tmodel=tmodel if ftopo == HIER else None)
+    return res
+
+
+def reduce_scatter_into(ctx, tp, local: np.ndarray, op: str = "sum",
+                        topo: Optional[str] = None) -> np.ndarray:
+    """Emit a reduce-scatter INTO the caller's live taskpool (see
+    all_reduce_into).  Returns this rank's 1/R segment buffer (flat):
+    zero-filled now, written by the final reduce events as the pool
+    executes."""
+    R = max(1, ctx.nodes)
+    flat = np.ravel(local)
+    if R == 1 or not ctx.comm_enabled:
+        return flat.copy()
+    econ = default_economics()
+    tmodel = default_topology(R)
+    cls = _mesh_class(tmodel)
+    rtopo = econ.choose_topology("reduce", flat.nbytes, R, override=topo,
+                                 cls=cls, tmodel=tmodel)
+    _record(ctx, "reduce_scatter_into", rtopo)
+    work, seg_elems, ns, slice_elems = _prep(local, R, op, cls)
+    seg_len = max(0, min(flat.size - ctx.myrank * seg_elems, seg_elems))
+    res = np.zeros(seg_len, dtype=flat.dtype)
+    uid = _next_uid(ctx)
+    arena = f"__ptc_coll_{uid}"
+    ctx.register_arena(arena, slice_elems * flat.itemsize)
+    plan = _plan_reduce(R, R, lambda s: s,
+                        lambda s: [(r, r) for r in range(R)], rtopo, False,
+                        tmodel=tmodel)
+
+    def sink(seg, s, arr, me=ctx.myrank, sl_e=slice_elems, n=seg_len):
+        if seg != me:
+            return
+        lo = s * sl_e
+        hi = min(lo + arr.size, n)
+        if hi > lo:
+            res[lo:hi] = arr[:hi - lo]
+
+    _emit_reduce(ctx, tp, uid, plan, ns, arena, OPS[op][0], flat.dtype,
+                 local_read=lambda cid, seg, s: work[seg, s],
+                 final_sink=sink)
+    return res
+
+
 # --------------------------------------------------------------------
 # Ref-contributed reduction (collectives INSIDE an application taskpool)
 # --------------------------------------------------------------------

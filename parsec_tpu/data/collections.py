@@ -154,6 +154,29 @@ class TwoDimBlockCyclic(Collection):
             self._datas[key] = d
         return d
 
+class ReplicatedLocal(TwoDimBlockCyclic):
+    """Rank-replicated tiled matrix: every rank holds (and owns) its own
+    private instance of every tile.  rank_of always answers the local
+    rank, so in a multi-rank context tasks touching the collection see
+    purely local Mem edges on whichever rank they were anchored to —
+    the placement model for SPMD-replicated shard state (per-rank KV
+    page pools, slot collections) in tensor-parallel serving, where the
+    only cross-rank traffic is the explicit ptc_coll_* reduction wire.
+    """
+
+    def __init__(self, M: int, N: int, mb: int, nb: int, nodes: int = 1,
+                 myrank: int = 0, dtype=np.float32,
+                 init: Optional[Callable] = None):
+        # grid validation is meaningless here: storage is per-rank
+        # private, so build the tile store single-rank then stamp the
+        # real (nodes, myrank) identity used by rank_of.
+        super().__init__(M, N, mb, nb, dtype=dtype, init=init)
+        self.nodes, self.myrank = nodes, myrank
+
+    def rank_of(self, m: int, n: int) -> int:
+        return self.myrank
+
+
 class SymTwoDimBlockCyclic(_SymStorage, TwoDimBlockCyclic):
     """Symmetric/lower(upper)-storage variant: only one triangle's tiles
     are stored and addressed — tasks only reference stored tiles.

@@ -322,7 +322,14 @@ class WaveFuser:
             self._refuse("dtd-body")
             return False
         if not body.batch:
-            self._refuse("unbatchable-body")
+            # ptc_coll_* chain tasks are latency-bound relay hops, never
+            # wave-fusable; a dedicated reason keeps tp benches able to
+            # tell the embedded collective's expected refusals apart
+            # from genuinely unbatchable compute bodies
+            if body.tc.name.startswith("ptc_coll_"):
+                self._refuse("coll-chain")
+            else:
+                self._refuse("unbatchable-body")
             return False
         views = [body.make_view(t) for t in tasks]
         # Independence, against the LIVE copies: no member may write a

@@ -35,9 +35,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..data.collections import TwoDimBlockCyclic
+from ..data.collections import ReplicatedLocal, TwoDimBlockCyclic
 
-__all__ = ["PagePool", "SeqSpec", "attend_page", "finalize_attention",
+__all__ = ["PagePool", "SeqSpec", "attend_page", "attend_heads",
+           "finalize_attention", "finalize_heads",
            "build_paged_decode", "build_paged_prefill",
            "build_paged_verify", "make_slot_collections",
            "prefix_page_keys"]
@@ -101,13 +102,32 @@ class PagePool:
     oversubscribe the pool."""
 
     def __init__(self, ctx, n_pages: int, page: int, d: int,
-                 dtype=np.float32, name: str = "KV"):
+                 dtype=np.float32, name: str = "KV", nodes: int = 1,
+                 myrank: int = 0):
         self.n_pages, self.page, self.d = n_pages, page, d
         self.dtype = np.dtype(dtype)
         self.name = name
         self._ctx = ctx
-        self.Kc = TwoDimBlockCyclic(n_pages * page, d, page, d, dtype=dtype)
-        self.Vc = TwoDimBlockCyclic(n_pages * page, d, page, d, dtype=dtype)
+        # tensor-parallel serving (ptc-shard): KV pages shard BY HEAD —
+        # each rank's pool holds its head-slice (d = d_model / tp) of
+        # every page, rank-replicated placement (rank_of == myrank) so
+        # page folds stay purely local Mem edges on every rank.  The
+        # refcount/COW/content-hash machinery below is rank-local and
+        # unchanged: frozen keys digest token ids (not KV bytes), so the
+        # per-shard chains stay deterministic and prefix sharing,
+        # admission discounts and fleet page migration work per rank.
+        if nodes > 1:
+            self.Kc = ReplicatedLocal(n_pages * page, d, page, d,
+                                      nodes=nodes, myrank=myrank,
+                                      dtype=dtype)
+            self.Vc = ReplicatedLocal(n_pages * page, d, page, d,
+                                      nodes=nodes, myrank=myrank,
+                                      dtype=dtype)
+        else:
+            self.Kc = TwoDimBlockCyclic(n_pages * page, d, page, d,
+                                        dtype=dtype)
+            self.Vc = TwoDimBlockCyclic(n_pages * page, d, page, d,
+                                        dtype=dtype)
         self.k_name, self.v_name = f"{name}_K", f"{name}_V"
         self.Kc.register(ctx, self.k_name)
         self.Vc.register(ctx, self.v_name)
@@ -395,15 +415,27 @@ class PagePool:
         return self.Vc.tile(p, 0)
 
 
-def make_slot_collections(ctx, max_seqs: int, d: int, name: str = "PA"):
+def make_slot_collections(ctx, max_seqs: int, d: int, name: str = "PA",
+                          nh: int = 1, nodes: int = 1, myrank: int = 0):
     """Per-slot scratch collections for `max_seqs` concurrent sequences:
-    Qc (1, d) query rows, ACCc (1, d+2) online-softmax accumulators
-    ([acc | m | l]), Oc (1, d) attention outputs, KNc (1, 2d) the new
-    token's k|v rows.  Registered as {name}_{Q,ACC,O,KN}."""
-    Qc = TwoDimBlockCyclic(max_seqs, d, 1, d, dtype=np.float32)
-    ACCc = TwoDimBlockCyclic(max_seqs, d + 2, 1, d + 2, dtype=np.float32)
-    Oc = TwoDimBlockCyclic(max_seqs, d, 1, d, dtype=np.float32)
-    KNc = TwoDimBlockCyclic(max_seqs, 2 * d, 1, 2 * d, dtype=np.float32)
+    Qc (1, d) query rows, ACCc (1, d+2*nh) online-softmax accumulators
+    ([acc | m_0..m_{nh-1} | l_0..l_{nh-1}]), Oc (1, d) attention
+    outputs, KNc (1, 2d) the new token's k|v rows.  Registered as
+    {name}_{Q,ACC,O,KN}.  `nh` is the number of attention heads held
+    locally (each with its own softmax state); with nodes > 1 the
+    collections are rank-replicated (tensor-parallel shard scratch)."""
+    aw = d + 2 * nh
+
+    def mk(rows, cols):
+        if nodes > 1:
+            return ReplicatedLocal(rows, cols, 1, cols, nodes=nodes,
+                                   myrank=myrank, dtype=np.float32)
+        return TwoDimBlockCyclic(rows, cols, 1, cols, dtype=np.float32)
+
+    Qc = mk(max_seqs, d)
+    ACCc = mk(max_seqs, aw)
+    Oc = mk(max_seqs, d)
+    KNc = mk(max_seqs, 2 * d)
     names = {}
     for suffix, coll in (("Q", Qc), ("ACC", ACCc), ("O", Oc), ("KN", KNc)):
         n = f"{name}_{suffix}"
@@ -449,10 +481,53 @@ def _acc_pack(tile: np.ndarray, acc: np.ndarray, m, l):
     tile[0, d + 1] = l
 
 
-def reset_acc(tile: np.ndarray):
-    """Accumulator tile initial value: acc=0, m=-big, l=0."""
+def reset_acc(tile: np.ndarray, nh: int = 1):
+    """Accumulator tile initial value: acc=0, m=-big, l=0 (per head)."""
+    dl = tile.shape[1] - 2 * nh
     tile[...] = 0.0
-    tile[0, tile.shape[1] - 2] = _NEG_BIG
+    tile[0, dl:dl + nh] = _NEG_BIG
+
+
+def attend_heads(q: np.ndarray, K: np.ndarray, V: np.ndarray,
+                 at: np.ndarray, scale: float, nh: int,
+                 rows: Optional[int] = None):
+    """Fold K/V `rows` into the packed `nh`-head accumulator tile IN
+    PLACE (layout [acc | m_0.. | l_0..], width dl + 2*nh).  Each head's
+    fold is one `attend_page` on CONTIGUOUS per-head operands — slices
+    are materialized before BLAS sees them, so the fold's f32 op
+    sequence is a function of (head values, rows, dh) only, never of
+    how many ranks the heads happen to be split over: per-head outputs
+    are bit-identical across tp degrees.  nh=1 degenerates to exactly
+    the single-softmax fold the non-sharded builders always ran."""
+    dl = q.shape[0]
+    dh = dl // nh
+    if rows is not None:
+        K = K[:rows]
+        V = V[:rows]
+    for h in range(nh):
+        sl = slice(h * dh, (h + 1) * dh)
+        acc, m, l = attend_page(
+            np.ascontiguousarray(q[sl]),
+            np.ascontiguousarray(K[:, sl]),
+            np.ascontiguousarray(V[:, sl]),
+            np.ascontiguousarray(at[0, sl]),
+            np.float32(at[0, dl + h]), np.float32(at[0, dl + nh + h]),
+            scale)
+        at[0, sl] = acc
+        at[0, dl + h] = m
+        at[0, dl + nh + h] = l
+
+
+def finalize_heads(at: np.ndarray, nh: int) -> np.ndarray:
+    """Per-head finalize of a packed accumulator tile -> (dl,) output."""
+    dl = at.shape[1] - 2 * nh
+    dh = dl // nh
+    out = np.empty(dl, np.float32)
+    for h in range(nh):
+        out[h * dh:(h + 1) * dh] = finalize_attention(
+            np.ascontiguousarray(at[0, h * dh:(h + 1) * dh]),
+            np.float32(at[0, dl + nh + h]))
+    return out
 
 
 # ----------------------------------------------------------- seq specs
@@ -484,23 +559,77 @@ def _tables(seqs: Sequence[SeqSpec]):
     return slot, pages, nfro, last, fill
 
 
+def _wire_shard(ctx, tp, classes, prod_class: str, nseg: int, shard: dict):
+    """Tensor-parallel shard wiring (ptc-shard).  The pool is built SPMD
+    on every rank of the tp group: `classes` are anchored on THIS rank
+    (rank-replicated shard compute — each rank folds its own head slice
+    of every sequence), and a RefReduce all-reduce chain is embedded in
+    the SAME taskpool to sum the per-rank partial pre-logit projections.
+    Contributions enter the ptc_coll_* steps slice-granularly as each
+    sequence's last fold completes, so the wire starts after the FIRST
+    sequence's shard is done and overlaps the remaining per-head
+    compute.  `shard` keys:
+
+      rank     this rank (affinity anchor + contributor-id base)
+      nranks   tp degree R (every rank contributes one partial per seq)
+      dm       full model dim — the (dm,) reduction payload
+      sink     fanout_sink(seg, slc, x): reduced pre-logits, delivered
+               ON EVERY RANK (bcast=True) for SPMD next-token selection
+      topo     optional reduce/fanout topology override
+
+    Returns (rr, cid_of): the caller declares the producer "PL" flow
+    with `*rr.producer_out_deps(cid_of)` on rr.arena."""
+    import parsec_tpu as pt
+    from ..comm.coll import RefReduce, rank_affinity_collection
+
+    R = max(1, int(shard.get("nranks", 1)))
+    rk = int(shard.get("rank", 0))
+    dm = int(shard["dm"])
+    rankc = rank_affinity_collection(ctx)
+    my = pt.call(lambda l, g, r=rk: r, pure=True)
+    for cls in classes:
+        cls.affinity(rankc, my)
+    rr = RefReduce(
+        ctx, tp, nseg,
+        contributors_of=lambda seg, R=R, n=nseg:
+            [(r, r * n + seg) for r in range(R)],
+        root_of=lambda seg, R=R: seg % R,
+        prod_class=prod_class, prod_flow="PL", prod_nparams=1,
+        prod_params_of=lambda cid, n=nseg: (cid % n,),
+        arena_bytes=dm * 4, dtype=np.float32, op="sum",
+        topo=shard.get("topo"), bcast=True,
+        fanout_sink=shard.get("sink"))
+
+    def cid_of(l, g, rk=rk, n=nseg):
+        return rk * n + l[0]
+
+    return rr, cid_of
+
+
 # ------------------------------------------------------------- builders
 def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
                        coll_names: Dict[str, str], *, scale: float = None,
                        priority: Optional[int] = None,
                        weight: Optional[int] = None,
                        body_wrap: Optional[Callable] = None,
-                       dev=None):
+                       dev=None, nh: int = 1,
+                       shard: Optional[dict] = None):
     """One continuous-batching DECODE step over `seqs` as a taskpool
     (created with the given per-pool QoS priority/weight — the tenant
     knobs).  Per sequence: PUPD appends the KN row into the last page,
     PATTF folds each frozen page, PATTL folds the updated last page and
     writes O.  `body_wrap` wraps the PATTL body (fault-injection seam
     for the watchdog e2e).  With `dev`, the page-fold classes attach
-    device chores (per-task shapes are uniform: whole pages)."""
+    device chores (per-task shapes are uniform: whole pages).
+
+    `nh` heads live locally (packed accumulator, per-head softmax);
+    with `shard` (see _wire_shard) the classes anchor on this rank and
+    PATTL additionally projects its head-slice output through the
+    rank's wo rows, feeding the embedded ptc_coll_* all-reduce."""
     import parsec_tpu as pt
 
     d, P = pool.d, pool.page
+    aw = d + 2 * nh
     sc = (d ** -0.5) if scale is None else float(scale)
     slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
     qn, an, on, kn = (coll_names["Q"], coll_names["ACC"], coll_names["O"],
@@ -556,22 +685,22 @@ def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         # KV pages stage through the residency planner like any other
         # tile.  PUPD/PATTL stay host (per-task ragged row counts).
         def k_fold(qb, kb, vb, ab):
-            return _fold_kernel(qb, kb, vb, ab, sc)
+            if nh == 1:
+                return _fold_kernel(qb, kb, vb, ab, sc)
+            return _fold_kernel_heads(qb, kb, vb, ab, sc, nh)
 
         dev.attach(fro, tp, kernel=k_fold, reads=["Q", "KP", "VP", "ACC"],
                    writes=["ACC"],
                    shapes={"Q": (1, d), "KP": (P, d), "VP": (P, d),
-                           "ACC": (1, d + 2)},
+                           "ACC": (1, aw)},
                    dtype=np.float32, batch=False)
 
     def fro_body(v):
         q = v.data("Q", np.float32, (1, d))[0]
         K = v.data("KP", np.float32, (P, d))
         V = v.data("VP", np.float32, (P, d))
-        at = v.data("ACC", np.float32, (1, d + 2))
-        acc, m, l = _acc_unpack(at)
-        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
-        _acc_pack(at, acc, m, l)
+        at = v.data("ACC", np.float32, (1, aw))
+        attend_heads(q, K, V, at, sc, nh)
 
     fro.body(fro_body, pure=True)
 
@@ -589,19 +718,34 @@ def build_paged_decode(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
     lst.flow("O", "RW", pt.In(pt.Mem(on, c_slot, 0)),
              pt.Out(pt.Mem(on, c_slot, 0)))
 
+    rr = None
+    if shard is not None:
+        rr, cid_of = _wire_shard(ctx, tp, (upd, fro, lst), "PATTL",
+                                 len(seqs), shard)
+        lst.flow("PL", "W", *rr.producer_out_deps(cid_of), arena=rr.arena)
+        dm = int(shard["dm"])
+        project = shard["project"]
+        mark = shard.get("local")
+
     def lst_body(v):
         si = v["s"]
         rows = fill_t[si] + 1  # old rows + the row PUPD just wrote
         q = v.data("Q", np.float32, (1, d))[0]
-        K = v.data("KP", np.float32, (P, d))[:rows]
-        V = v.data("VP", np.float32, (P, d))[:rows]
-        at = v.data("ACC", np.float32, (1, d + 2))
-        acc, m, l = _acc_unpack(at)
-        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
-        v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
+        K = v.data("KP", np.float32, (P, d))
+        V = v.data("VP", np.float32, (P, d))
+        at = v.data("ACC", np.float32, (1, aw))
+        attend_heads(q, K, V, at, sc, nh, rows=rows)
+        o = finalize_heads(at, nh)
+        v.data("O", np.float32, (1, d))[0] = o
+        if shard is not None:
+            v.data("PL", np.float32)[:dm] = project(o)
+            if mark is not None:
+                mark(si)
 
     if body_wrap:
         lst.body(body_wrap(lst_body))
+    elif shard is not None:
+        lst.body(lst_body)
     else:
         lst.body(lst_body, pure=True)
     return tp
@@ -612,7 +756,8 @@ def build_paged_verify(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
                        priority: Optional[int] = None,
                        weight: Optional[int] = None,
                        body_wrap: Optional[Callable] = None,
-                       dev=None):
+                       dev=None, nh: int = 1,
+                       shard: Optional[dict] = None):
     """Speculative-decoding VERIFY WAVE: every page of every sequence
     is already materialized in the KV collections (the shared frozen
     prefix plus host-staged private query-window pages), so the pool is
@@ -625,10 +770,15 @@ def build_paged_verify(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
     certifies it and the whole batched verification dispatches as one
     fused launch.  Fold math and page blocking are `attend_page` with
     the decode builder's exact operand split: a verified position's
-    output is BIT-IDENTICAL to the sequential decode step's."""
+    output is BIT-IDENTICAL to the sequential decode step's.
+
+    `nh`/`shard` as in build_paged_decode: per-head fold state, and the
+    tensor-parallel rank anchoring + embedded partial-projection
+    all-reduce (producer VATL)."""
     import parsec_tpu as pt
 
     d, P = pool.d, pool.page
+    aw = d + 2 * nh
     sc = (d ** -0.5) if scale is None else float(scale)
     slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
     qn, an, on = coll_names["Q"], coll_names["ACC"], coll_names["O"]
@@ -661,24 +811,25 @@ def build_paged_verify(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         # BATCHABLE (the kernel is elementwise over whole-page tiles):
         # a homogeneous VATF wave certifies under the PR 13 wave
         # compiler and the entire batched verification dispatches as
-        # ONE fused launch
+        # ONE fused launch — in tp mode each rank certifies and fuses
+        # ITS OWN shard of the wave (the per-rank fused_waves count)
         def k_fold(qb, kb, vb, ab):
-            return _fold_kernel(qb, kb, vb, ab, sc)
+            if nh == 1:
+                return _fold_kernel(qb, kb, vb, ab, sc)
+            return _fold_kernel_heads(qb, kb, vb, ab, sc, nh)
 
         dev.attach(fro, tp, kernel=k_fold, reads=["Q", "KP", "VP", "ACC"],
                    writes=["ACC"],
                    shapes={"Q": (1, d), "KP": (P, d), "VP": (P, d),
-                           "ACC": (1, d + 2)},
+                           "ACC": (1, aw)},
                    dtype=np.float32, batch=True)
 
     def fro_body(v):
         q = v.data("Q", np.float32, (1, d))[0]
         K = v.data("KP", np.float32, (P, d))
         V = v.data("VP", np.float32, (P, d))
-        at = v.data("ACC", np.float32, (1, d + 2))
-        acc, m, l = _acc_unpack(at)
-        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
-        _acc_pack(at, acc, m, l)
+        at = v.data("ACC", np.float32, (1, aw))
+        attend_heads(q, K, V, at, sc, nh)
 
     fro.body(fro_body, pure=True)
 
@@ -693,19 +844,34 @@ def build_paged_verify(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
     lst.flow("O", "RW", pt.In(pt.Mem(on, c_slot, 0)),
              pt.Out(pt.Mem(on, c_slot, 0)))
 
+    rr = None
+    if shard is not None:
+        rr, cid_of = _wire_shard(ctx, tp, (fro, lst), "VATL",
+                                 len(seqs), shard)
+        lst.flow("PL", "W", *rr.producer_out_deps(cid_of), arena=rr.arena)
+        dm = int(shard["dm"])
+        project = shard["project"]
+        mark = shard.get("local")
+
     def lst_body(v):
         si = v["s"]
         rows = fill_t[si]
         q = v.data("Q", np.float32, (1, d))[0]
-        K = v.data("KP", np.float32, (P, d))[:rows]
-        V = v.data("VP", np.float32, (P, d))[:rows]
-        at = v.data("ACC", np.float32, (1, d + 2))
-        acc, m, l = _acc_unpack(at)
-        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
-        v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
+        K = v.data("KP", np.float32, (P, d))
+        V = v.data("VP", np.float32, (P, d))
+        at = v.data("ACC", np.float32, (1, aw))
+        attend_heads(q, K, V, at, sc, nh, rows=rows)
+        o = finalize_heads(at, nh)
+        v.data("O", np.float32, (1, d))[0] = o
+        if shard is not None:
+            v.data("PL", np.float32)[:dm] = project(o)
+            if mark is not None:
+                mark(si)
 
     if body_wrap:
         lst.body(body_wrap(lst_body))
+    elif shard is not None:
+        lst.body(lst_body)
     else:
         lst.body(lst_body, pure=True)
     return tp
@@ -725,6 +891,27 @@ def _fold_kernel(qb, kb, vb, ab, sc):
     return jnp.concatenate([acc_new, m_new[None], l_new[None]])[None, :]
 
 
+def _fold_kernel_heads(qb, kb, vb, ab, sc, nh):
+    """jnp form of attend_heads: `nh` statically-unrolled per-head folds
+    over the packed (1, dl + 2*nh) accumulator — the _fold_kernel op
+    sequence applied to each head's contiguous slice."""
+    import jax.numpy as jnp
+    dl = qb.shape[1]
+    dh = dl // nh
+    outs, ms, ls = [], [], []
+    for h in range(nh):
+        sl = slice(h * dh, (h + 1) * dh)
+        acc, m, l = ab[0, sl], ab[0, dl + h], ab[0, dl + nh + h]
+        s = (kb[:, sl] @ qb[0, sl]) * sc
+        m_new = jnp.maximum(m, s.max())
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        ls.append((l * corr + p.sum())[None])
+        outs.append(acc * corr + p @ vb[:, sl])
+        ms.append(m_new[None])
+    return jnp.concatenate(outs + ms + ls)[None, :]
+
+
 def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
                         coll_names: Dict[str, str], prompt_name: str,
                         prompt_tiles: Sequence[Sequence[int]], *,
@@ -732,7 +919,8 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
                         priority: Optional[int] = None,
                         weight: Optional[int] = None,
                         body_wrap: Optional[Callable] = None,
-                        warm: Optional[Sequence[int]] = None):
+                        warm: Optional[Sequence[int]] = None,
+                        nh: int = 1, shard: Optional[dict] = None):
     """PREFILL as a taskpool: PFILL(s, j) writes page j of sequence s
     from the staged prompt collection (`prompt_name`, one (page, 2d)
     k|v tile per written page, indices in `prompt_tiles[s][j]`), then
@@ -746,10 +934,16 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
     straight from the KV collections — selection rides the producer
     domain (PFILL(s, j<warm) does not exist), not dynamic guards, so
     input counting stays verifier-exact.  A fully-warm sequence
-    prefills ZERO pages and still folds its whole cache."""
+    prefills ZERO pages and still folds its whole cache.
+
+    `nh`/`shard` as in build_paged_decode: in tp mode every rank
+    prefills its own head-slice pages and the first generated token's
+    partial projection rides the embedded all-reduce (producer
+    PATTL)."""
     import parsec_tpu as pt
 
     d, P = pool.d, pool.page
+    aw = d + 2 * nh
     sc = (d ** -0.5) if scale is None else float(scale)
     slot_t, pages_t, nfro_t, last_t, fill_t = _tables(seqs)
     ptiles = [list(row) for row in prompt_tiles]
@@ -820,10 +1014,8 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
         q = v.data("Q", np.float32, (1, d))[0]
         K = v.data("KP", np.float32, (P, d))
         V = v.data("VP", np.float32, (P, d))
-        at = v.data("ACC", np.float32, (1, d + 2))
-        acc, m, l = _acc_unpack(at)
-        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
-        _acc_pack(at, acc, m, l)
+        at = v.data("ACC", np.float32, (1, aw))
+        attend_heads(q, K, V, at, sc, nh)
 
     fro.body(fro_body, pure=True)
 
@@ -841,19 +1033,34 @@ def build_paged_prefill(ctx, pool: PagePool, seqs: Sequence[SeqSpec],
     lst.flow("O", "RW", pt.In(pt.Mem(on, c_slot, 0)),
              pt.Out(pt.Mem(on, c_slot, 0)))
 
+    rr = None
+    if shard is not None:
+        rr, cid_of = _wire_shard(ctx, tp, (fil, fro, lst), "PATTL",
+                                 len(seqs), shard)
+        lst.flow("PL", "W", *rr.producer_out_deps(cid_of), arena=rr.arena)
+        dm = int(shard["dm"])
+        project = shard["project"]
+        mark = shard.get("local")
+
     def lst_body(v):
         si = v["s"]
         rows = fill_t[si]
         q = v.data("Q", np.float32, (1, d))[0]
-        K = v.data("KP", np.float32, (P, d))[:rows]
-        V = v.data("VP", np.float32, (P, d))[:rows]
-        at = v.data("ACC", np.float32, (1, d + 2))
-        acc, m, l = _acc_unpack(at)
-        acc, m, l = attend_page(q, K, V, acc, m, l, sc)
-        v.data("O", np.float32, (1, d))[0] = finalize_attention(acc, l)
+        K = v.data("KP", np.float32, (P, d))
+        V = v.data("VP", np.float32, (P, d))
+        at = v.data("ACC", np.float32, (1, aw))
+        attend_heads(q, K, V, at, sc, nh, rows=rows)
+        o = finalize_heads(at, nh)
+        v.data("O", np.float32, (1, d))[0] = o
+        if shard is not None:
+            v.data("PL", np.float32)[:dm] = project(o)
+            if mark is not None:
+                mark(si)
 
     if body_wrap:
         lst.body(body_wrap(lst_body))
+    elif shard is not None:
+        lst.body(lst_body)
     else:
         lst.body(lst_body, pure=True)
     return tp

@@ -121,16 +121,25 @@ def _stacked(x, mesh: Mesh, axis: str):
 
 def all_reduce(x, ctx=None, mesh: Optional[Mesh] = None,
                axis: str = "sp", op: str = "sum",
-               topo: Optional[str] = None):
+               topo: Optional[str] = None, tp=None):
     """Elementwise-reduce per-rank contributions; replicated result.
 
     Runtime path (`ctx` live + multi-rank): `x` is THIS rank's local
     contribution; returns the cross-rank reduction (same shape) via the
-    streamed ptc_coll_* task classes.  XLA path (`mesh`): `x` stacks the
-    contributions on dim 0 (one per device of `axis`); returns their
-    reduction via shard_map+psum.  Neither: local semantics (`x` is the
-    only contribution)."""
+    streamed ptc_coll_* task classes.  With `tp` (a live taskpool the
+    caller is about to run), the chains emit IN-POOL instead of as a
+    standalone bulk-synchronous pool (ptc-shard): the returned array is
+    zero-filled now and written by the fan-out sinks as the caller's
+    pool executes — the collective overlaps the pool's other work (see
+    comm.coll.all_reduce_into / restore_topology).  XLA path (`mesh`):
+    `x` stacks the contributions on dim 0 (one per device of `axis`);
+    returns their reduction via shard_map+psum.  Neither: local
+    semantics (`x` is the only contribution)."""
     if _runtime_live(ctx):
+        if tp is not None:
+            from ..comm.coll import all_reduce_into
+            return all_reduce_into(ctx, tp, np.asarray(x), op=op,
+                                   topo=topo)
         from ..comm.coll import all_reduce as _ar
         return _ar(ctx, np.asarray(x), op=op, topo=topo)
     if mesh is not None:
@@ -152,15 +161,21 @@ def all_reduce(x, ctx=None, mesh: Optional[Mesh] = None,
 
 def reduce_scatter(x, ctx=None, mesh: Optional[Mesh] = None,
                    axis: str = "sp", op: str = "sum",
-                   topo: Optional[str] = None):
+                   topo: Optional[str] = None, tp=None):
     """Reduce + scatter 1/R segments.
 
     Runtime path: `x` is this rank's contribution; returns THIS rank's
-    flat segment of the reduction.  XLA path: `x` stacks contributions
+    flat segment of the reduction.  With `tp` (a live taskpool the
+    caller runs), emits in-pool and returns the deferred segment buffer
+    (ptc-shard; see all_reduce).  XLA path: `x` stacks contributions
     on dim 0; returns the FULL reduced array sharded along dim 0 of the
     result (device r holds segment r — materialized, so the caller sees
     every segment).  Neither: the whole local contribution."""
     if _runtime_live(ctx):
+        if tp is not None:
+            from ..comm.coll import reduce_scatter_into
+            return reduce_scatter_into(ctx, tp, np.asarray(x), op=op,
+                                       topo=topo)
         from ..comm.coll import reduce_scatter as _rs
         return _rs(ctx, np.asarray(x), op=op, topo=topo)
     if mesh is not None:

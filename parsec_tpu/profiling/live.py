@@ -243,7 +243,11 @@ class LiveMonitor:
                                                "prefix_hits",
                                                "prefix_misses"),
                            "spec_acc": _rate(row, "spec_accepted",
-                                             "spec_proposed")}
+                                             "spec_proposed"),
+                           # ptc-shard: p99 stall waiting on the
+                           # embedded tensor-parallel collective
+                           "coll_wait_p99_ms": round(
+                               row.get("coll_wait_ns_p99", 0) / 1e6, 3)}
                     for name, row in sc["tenants"].items()}
                 conf = sc["conformance"]
                 rec["conformance"] = {

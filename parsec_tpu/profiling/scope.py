@@ -132,12 +132,17 @@ class _Tenant:
         self.counters = {"submitted": 0, "completed": 0, "failed": 0,
                          "rejected": 0, "slo_violations": 0,
                          "prefix_hits": 0, "prefix_misses": 0,
-                         "spec_proposed": 0, "spec_accepted": 0}
+                         "spec_proposed": 0, "spec_accepted": 0,
+                         "coll_waves": 0}
         self.hists = {"ttft_ns": ScopeHist(), "queue_wait_ns": ScopeHist(),
                       "latency_ns": ScopeHist(), "tokens_per_s": ScopeHist(),
                       # ptc-share: per-verify-wave draft acceptance, in
                       # whole percent (0..100) of proposed tokens
-                      "spec_accept_pct": ScopeHist()}
+                      "spec_accept_pct": ScopeHist(),
+                      # ptc-shard: per decode step, the critical-path
+                      # exposure to the embedded tp all-reduce — local
+                      # shard done -> reduced pre-logits delivered
+                      "coll_wait_ns": ScopeHist()}
 
 
 def _now_ns() -> int:
@@ -355,6 +360,18 @@ class ScopeRegistry:
             if proposed > 0:
                 t.hists["spec_accept_pct"].record(
                     round(100 * accepted / proposed))
+
+    def record_coll_wait(self, tenant: str, wait_ns: int, n: int = 1):
+        """ptc-shard: one tp pool's collective-wait exposure — the time
+        between this rank's LAST local shard fold finishing and the
+        all-reduced pre-logits arriving back (`n` sequences were served
+        by the wave).  Feeds the per-tenant coll_wait histogram the
+        ptc_top tenant table and Prometheus export surface."""
+        self.tenant(tenant)
+        with self._lock:
+            t = self.tenants[tenant]
+            t.counters["coll_waves"] += 1
+            t.hists["coll_wait_ns"].record(max(0, int(wait_ns)))
 
     def record_event(self, kind: str, **fields):
         """ptc-route: one structured fleet decision — placement (with
@@ -674,16 +691,23 @@ def request_timeline(trace, scopes, submitted_ns=None, admitted_ns=None,
       admission_wait  submit -> admitted (queue + backpressure)
       exec            time-union of the request's EXEC spans
       h2d             device staging (H2D spans) outside exec
-      wire            matched wire-flow windows outside exec+h2d
+      coll_wait       wire-flow windows delivering ptc_coll_* collective
+                      steps (ptc-shard tp all-reduce legs — flows whose
+                      (src, corr) matches a KEY_COLL instant, the same
+                      evidence critpath.lost_time uses), outside
+                      exec+h2d
+      wire            remaining matched wire-flow windows outside
+                      exec+h2d+coll_wait
       lane_wait       the measured residual: window - the above — lane
                       queueing, scheduler boundaries, driver overhead
-    By construction admission_wait + exec + h2d + wire + lane_wait ==
-    end-to-end latency (done - submitted): the partition identity the
-    acceptance test pins.  Also returns the per-stage span lists and
-    the wire hops (src, dst, bytes, latency_ns).  `class_names` maps
-    scope_id -> [class names by id] (class ids are per pool; the
-    registry passes each scope's own table)."""
-    from .trace import KEY_EXEC, KEY_H2D, KEY_RELEASE, KEY_STREAM
+    By construction admission_wait + exec + h2d + coll_wait + wire +
+    lane_wait == end-to-end latency (done - submitted): the partition
+    identity the acceptance test pins.  Also returns the per-stage span
+    lists and the wire hops (src, dst, bytes, latency_ns, coll flag).
+    `class_names` maps scope_id -> [class names by id] (class ids are
+    per pool; the registry passes each scope's own table)."""
+    from .trace import (KEY_COLL, KEY_EXEC, KEY_H2D, KEY_RELEASE,
+                        KEY_STREAM)
 
     def _cname(sid, cid):
         tbl = (class_names or {}).get(sid)
@@ -694,6 +718,7 @@ def request_timeline(trace, scopes, submitted_ns=None, admitted_ns=None,
     ex_iv: List[Tuple[int, int]] = []
     h2d_iv: List[Tuple[int, int]] = []
     wire_iv: List[Tuple[int, int]] = []
+    coll_iv: List[Tuple[int, int]] = []
     hops: List[dict] = []
     waves: List[dict] = []
     ev_min, ev_max = None, None
@@ -702,6 +727,7 @@ def request_timeline(trace, scopes, submitted_ns=None, admitted_ns=None,
         if not len(sub.events):
             continue
         t = sub._spans_table()
+        coll_keys = set()
         for row in t:
             key = int(row[2])
             b, e = int(row[7]), int(row[8])
@@ -719,13 +745,19 @@ def request_timeline(trace, scopes, submitted_ns=None, admitted_ns=None,
                                   "rank": int(row[0])})
             elif key in (KEY_H2D, KEY_STREAM):
                 h2d_iv.append((b, e))
+            elif key == KEY_COLL:
+                # collective-step delivery instant: l0 = source rank,
+                # l1 = correlation cookie — tags the matching wire flow
+                coll_keys.add((int(row[4]), int(row[5])))
         fl = sub.flows()
         for row in fl:
             s, d, corr, nbytes, t_s, t_r, lat = (int(x) for x in row)
-            wire_iv.append((t_s, t_r))
+            is_coll = (s, corr) in coll_keys
+            (coll_iv if is_coll else wire_iv).append((t_s, t_r))
             hops.append({"scope": sid, "src": s, "dst": d,
                          "bytes": nbytes, "latency_ns": lat,
-                         "send_ns": t_s, "recv_ns": t_r})
+                         "send_ns": t_s, "recv_ns": t_r,
+                         "coll": is_coll})
     # window: the ticket's [admitted, done] when known, else the span
     # envelope (pure-trace mode)
     w0 = admitted_ns if admitted_ns is not None else ev_min
@@ -736,18 +768,21 @@ def request_timeline(trace, scopes, submitted_ns=None, admitted_ns=None,
     ex_u = _clip(_union(ex_iv), w0, w1)
     h2d_u = _subtract(_clip(_union(h2d_iv), w0, w1), ex_u)
     busy = _union([*ex_u, *h2d_u])
-    wire_u = _subtract(_clip(_union(wire_iv), w0, w1), busy)
+    coll_u = _subtract(_clip(_union(coll_iv), w0, w1), busy)
+    busy_c = _union([*busy, *coll_u])
+    wire_u = _subtract(_clip(_union(wire_iv), w0, w1), busy_c)
     exec_ns = _union_len(ex_u)
     h2d_ns = _union_len(h2d_u)
+    coll_ns = _union_len(coll_u)
     wire_ns = _union_len(wire_u)
     window_ns = w1 - w0
-    lane_ns = max(0, window_ns - exec_ns - h2d_ns - wire_ns)
+    lane_ns = max(0, window_ns - exec_ns - h2d_ns - coll_ns - wire_ns)
     admission_ns = (w0 - submitted_ns) if (submitted_ns is not None and
                                            admitted_ns is not None) else 0
     waves.sort(key=lambda w: w["begin_ns"])
     stages = {"admission_wait_ns": admission_ns, "exec_ns": exec_ns,
-              "h2d_ns": h2d_ns, "wire_ns": wire_ns,
-              "lane_wait_ns": lane_ns}
+              "h2d_ns": h2d_ns, "coll_wait_ns": coll_ns,
+              "wire_ns": wire_ns, "lane_wait_ns": lane_ns}
     return {
         "scopes": [s for s, _ in scopes],
         "window_ns": window_ns,

@@ -24,9 +24,10 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..data.collections import TwoDimBlockCyclic
-from ..ops.paged_attention import (PagePool, SeqSpec, attend_page,
-                                   finalize_attention, build_paged_decode,
+from ..data.collections import ReplicatedLocal, TwoDimBlockCyclic
+from ..ops.paged_attention import (PagePool, SeqSpec, attend_heads,
+                                   attend_page, finalize_attention,
+                                   finalize_heads, build_paged_decode,
                                    build_paged_prefill, build_paged_verify,
                                    make_slot_collections, prefix_page_keys,
                                    reset_acc)
@@ -38,23 +39,51 @@ __all__ = ["PagedLMConfig", "PagedLM", "InferenceEngine", "RequestHandle"]
 # ---------------------------------------------------------------- model
 class PagedLMConfig:
     def __init__(self, vocab: int = 64, d: int = 16, page: int = 8,
-                 seed: int = 0):
+                 seed: int = 0, heads: int = 1, qlog: bool = False):
         self.vocab, self.d, self.page, self.seed = vocab, d, page, seed
+        # ptc-shard: `heads` independent attention heads (d must divide
+        # evenly) — the tensor-parallel sharding unit; `qlog` quantizes
+        # the output projection to a dyadic grid so the pre-logit
+        # partial sums are EXACT in f32 (order-independent — the
+        # cross-rank all-reduce is bit-identical to a single-rank run)
+        assert d % max(1, heads) == 0, "d must divide by heads"
+        self.heads = max(1, int(heads))
+        self.qlog = bool(qlog)
 
 
 class PagedLM:
     """Deterministic toy attention LM: fixed random embed/projections
     (f32).  qkv() and logits() are plain numpy with one op order, so
-    every execution schedule reproduces the same bytes."""
+    every execution schedule reproduces the same bytes.
+
+    Tensor-parallel vocabulary (ptc-shard): think of the weights laid
+    on a 1-D mesh with a `tp` axis — qkv projections partitioned
+    PartitionSpec(None, "tp") (column/head parallel), the output
+    projection wo PartitionSpec("tp", None) (row parallel), embed
+    replicated — the SNIPPETS [2]/[3] layout-rule shape ("heads" ->
+    "mp").  `shard_slice`/`wo_shard` hand each rank its contiguous
+    head-block; partial projections sum across ranks (all-reduce).
+
+    `qlog` mode snaps attention outputs to the 1/256 grid and wo to the
+    1/8 grid: every pre-logit partial product is a small dyadic
+    rational, so f32 sums are exact in ANY association — the integer-
+    valued-f32 trick the coll tests use, applied to the model head, and
+    the reason a tp=2/tp=4 run is BIT-identical to tp=1."""
 
     def __init__(self, cfg: PagedLMConfig):
         self.cfg = cfg
         # prefix-cache identity: a page's KV bytes are a pure function
         # of (model_id, token-id prefix), so the content-hash index is
         # keyed by both — two engines sharing one PagePool but serving
-        # different weights can never cross-hit
+        # different weights can never cross-hit.  Non-default heads /
+        # qlog change the bytes, so they suffix the id (defaults keep
+        # the historical id: existing frozen-key baselines stand).
         self.model_id = (f"paged-lm:v{cfg.vocab}:d{cfg.d}:"
                          f"p{cfg.page}:s{cfg.seed}")
+        if cfg.heads != 1:
+            self.model_id += f":h{cfg.heads}"
+        if cfg.qlog:
+            self.model_id += ":q"
         rng = np.random.RandomState(cfg.seed)
         d, v = cfg.d, cfg.vocab
         self.embed = rng.randn(v, d).astype(np.float32) * np.float32(0.5)
@@ -62,25 +91,63 @@ class PagedLM:
         self.wk = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
         self.wv = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
         self.wo = rng.randn(d, d).astype(np.float32) * np.float32(d ** -0.5)
+        if cfg.qlog:
+            self.wo = (np.round(self.wo * np.float32(8.0)) /
+                       np.float32(8.0)).astype(np.float32)
+        self.dh = d // cfg.heads
+        self.scale = float(self.dh) ** -0.5  # == d**-0.5 for heads=1
 
     def qkv(self, token: int):
         e = self.embed[int(token)]
         return e @ self.wq, e @ self.wk, e @ self.wv
 
+    @staticmethod
+    def quant_o(o: np.ndarray) -> np.ndarray:
+        """Snap an attention output to the 1/256 dyadic grid (qlog)."""
+        return (np.round(o * np.float32(256.0)) /
+                np.float32(256.0)).astype(np.float32)
+
+    def pre_logits(self, o: np.ndarray) -> np.ndarray:
+        """Output projection (pre-embedding-tie logits) — the quantity
+        the tp ranks produce partially and all-reduce."""
+        if self.cfg.qlog:
+            return self.quant_o(o) @ self.wo
+        return o @ self.wo
+
+    def logits_from_pre(self, pre: np.ndarray) -> np.ndarray:
+        return pre @ self.embed.T.astype(np.float32)
+
     def logits(self, o: np.ndarray) -> np.ndarray:
-        return (o @ self.wo) @ self.embed.T.astype(np.float32)
+        return self.logits_from_pre(self.pre_logits(o))
 
     def next_token(self, o: np.ndarray) -> int:
         return int(np.argmax(self.logits(o)))
+
+    def next_token_pre(self, pre: np.ndarray) -> int:
+        return int(np.argmax(self.logits_from_pre(pre)))
+
+    # --------------------------------------------- tensor-parallel view
+    def shard_slice(self, rank: int, tp: int) -> slice:
+        """This rank's contiguous head-block of the model dim: heads
+        [rank*hl, (rank+1)*hl) with hl = heads/tp — dims
+        [rank*dl, (rank+1)*dl), dl = hl*dh."""
+        assert self.cfg.heads % tp == 0, "heads must divide by tp"
+        dl = (self.cfg.heads // tp) * self.dh
+        return slice(rank * dl, (rank + 1) * dl)
+
+    def wo_shard(self, rank: int, tp: int) -> np.ndarray:
+        """Row-parallel wo shard: the rows matched to this rank's head
+        block (partial products sum exactly under qlog)."""
+        return np.ascontiguousarray(self.wo[self.shard_slice(rank, tp), :])
 
     # ------------------------------------------------- numpy reference
     def reference_generate(self, prompt: Sequence[int], max_new: int,
                            page: Optional[int] = None):
         """Pure-numpy oracle using the SAME page blocking and fold order
-        as the DAG (attend_page per page) — bit-identical to the engine.
-        Returns (tokens, outputs[n_steps, d])."""
+        as the DAG (attend_heads per page) — bit-identical to the
+        engine at ANY tp degree.  Returns (tokens, outputs[n_steps, d])."""
         P = self.cfg.page if page is None else page
-        d = self.cfg.d
+        d, H = self.cfg.d, self.cfg.heads
         ks: List[np.ndarray] = []
         vs: List[np.ndarray] = []
         toks = [int(t) for t in prompt]
@@ -89,15 +156,15 @@ class PagedLM:
             ks.append(k)
             vs.append(v)
         outs = []
+        at = np.zeros((1, d + 2 * H), np.float32)
         for _ in range(max_new):
             q = self.qkv(toks[-1])[0]
-            acc = np.zeros(d, np.float32)
-            m, l = np.float32(-1.0e30), np.float32(0.0)
+            reset_acc(at, H)
             for off in range(0, len(ks), P):
                 K = np.stack(ks[off:off + P])
                 V = np.stack(vs[off:off + P])
-                acc, m, l = attend_page(q, K, V, acc, m, l, d ** -0.5)
-            o = finalize_attention(acc, l)
+                attend_heads(q, K, V, at, self.scale, H)
+            o = finalize_heads(at, H)
             outs.append(o)
             nxt = self.next_token(o)
             toks.append(nxt)
@@ -168,7 +235,17 @@ class InferenceEngine:
     destroyed).  run() loops until every request is terminal.
 
     `body_wrap` wraps every decode PATTL body — the fault-injection seam
-    the watchdog tail-latency e2e uses."""
+    the watchdog tail-latency e2e uses.
+
+    Tensor-parallel mode (`tp` > 1, ptc-shard): construct the SAME
+    engine on every rank of a tp-rank comm group (SPMD) and drive the
+    SAME submit sequence on each.  Per step the ranks' pools are
+    coupled by the embedded all-reduce, so step() is naturally
+    barriered by the collective itself.  Driving contract: let every
+    submitted prefill complete (handle.state == "active") on a rank
+    before that rank enters its decode step loop — mid-stream joins
+    would need a cross-rank agreement layer the engine does not
+    provide."""
 
     def __init__(self, ctx, model: PagedLM, n_pages: int = 64,
                  max_seqs: int = 16, server: Optional[Server] = None,
@@ -176,10 +253,34 @@ class InferenceEngine:
                  name: str = "eng", body_wrap: Optional[Callable] = None,
                  dev=None, conformance: bool = True,
                  prefix_cache: bool = True, spec_k: int = 0,
-                 spec_draft="self"):
+                 spec_draft="self", tp: int = 1):
         cfg = model.cfg
         self.ctx = ctx
         self.model = model
+        # ptc-shard: tensor-parallel serving across a rank group.  The
+        # engine is constructed SPMD on every rank of the group (one
+        # process-local ctx per rank, comm-initialized): each rank owns
+        # the KV pages and slot scratch for ITS contiguous head block
+        # (d_local = d/tp — the model is bigger than one rank's pages),
+        # and every decode/verify/prefill pool embeds a RefReduce
+        # all-reduce chain summing the per-rank partial pre-logit
+        # projections.  qlog quantization makes those sums exact, so
+        # tp>1 output bytes equal the single-rank reference's.
+        self.tp = max(1, int(tp))
+        if self.tp > 1:
+            assert ctx.nodes == self.tp, \
+                f"tp={self.tp} needs a {self.tp}-rank ctx (nodes={ctx.nodes})"
+            assert cfg.heads % self.tp == 0, "heads must divide by tp"
+            assert cfg.qlog, \
+                "tp>1 requires qlog=True (exact cross-rank partial sums)"
+        self.rank = ctx.myrank if self.tp > 1 else 0
+        self._nh = cfg.heads // self.tp            # heads held locally
+        self._dl = self._nh * model.dh             # local model-dim slice
+        self._shard_sl = slice(self.rank * self._dl,
+                               (self.rank + 1) * self._dl)
+        self._wo_s = model.wo_shard(self.rank, self.tp) \
+            if self.tp > 1 else None
+        nodes = ctx.nodes if self.tp > 1 else 1
         # ptc-share serving fast path: `prefix_cache` turns the shared
         # copy-on-write prompt-prefix index on (default); `spec_k` > 0
         # turns on speculative decoding — a draft model proposes k
@@ -197,11 +298,18 @@ class InferenceEngine:
         # plans each decode pool so plan-vs-measured stays covered
         self.scope = ctx.scope_registry()
         self.conformance = bool(conformance)
-        self.pool = PagePool(ctx, n_pages, cfg.page, cfg.d,
-                             name=f"{name}_KV")
+        # KV pages shard BY HEAD: one PagePool per rank holding the
+        # d_local columns of every page — refcount/COW/freeze semantics
+        # are untouched (frozen keys digest token ids, so the per-shard
+        # content chains are deterministic and rank-consistent)
+        self.pool = PagePool(ctx, n_pages, cfg.page, self._dl,
+                             name=f"{name}_KV", nodes=nodes,
+                             myrank=self.rank)
         (self.Qc, self.ACCc, self.Oc, self.KNc,
-         self.slot_names) = make_slot_collections(ctx, max_seqs, cfg.d,
-                                                  name=f"{name}_PA")
+         self.slot_names) = make_slot_collections(ctx, max_seqs, self._dl,
+                                                  name=f"{name}_PA",
+                                                  nh=self._nh, nodes=nodes,
+                                                  myrank=self.rank)
         self.max_seqs = max_seqs
         self._free_slots = list(range(max_seqs - 1, -1, -1))
         # speculative verify scratch: one (Q, ACC, O) row per (sequence
@@ -210,14 +318,16 @@ class InferenceEngine:
         if self.spec_k:
             (self.SQc, self.SACCc, self.SOc, _,
              self.spec_names) = make_slot_collections(
-                ctx, max_seqs * (self.spec_k + 1), cfg.d,
-                name=f"{name}_SV")
+                ctx, max_seqs * (self.spec_k + 1), self._dl,
+                name=f"{name}_SV", nh=self._nh, nodes=nodes,
+                myrank=self.rank)
         self.server = server or Server(
             ctx, tenants or [TenantConfig("default")], name=name)
         # stats()["serve"] grows the pool's prefix-cache counters and
         # the engine's speculative-decode counters
         self.server.register_resource_stats("prefix", self.pool.stats)
         self.server.register_resource_stats("spec", self._spec_stats)
+        self.server.register_resource_stats("tp", self._tp_stats)
         # ptc-route: the frozen-page key digest a fleet router scores
         # placements against (Server.advertise()["prefix"])
         self.server.register_advertiser("prefix", self._prefix_advert)
@@ -232,9 +342,16 @@ class InferenceEngine:
         # staged prompt k|v pages; grows with the largest in-flight
         # prompt set (tiles recycle per prefill pool)
         self._prompt_tiles = 256
-        self.PRc = TwoDimBlockCyclic(self._prompt_tiles * cfg.page,
-                                     2 * cfg.d, cfg.page, 2 * cfg.d,
-                                     dtype=np.float32)
+        if self.tp > 1:
+            self.PRc = ReplicatedLocal(self._prompt_tiles * cfg.page,
+                                       2 * self._dl, cfg.page,
+                                       2 * self._dl, nodes=nodes,
+                                       myrank=self.rank,
+                                       dtype=np.float32)
+        else:
+            self.PRc = TwoDimBlockCyclic(self._prompt_tiles * cfg.page,
+                                         2 * self._dl, cfg.page,
+                                         2 * self._dl, dtype=np.float32)
         self.PRc.register(ctx, self._prompt_coll_name)
         self.requests: List[RequestHandle] = []
         self.stats = {"decode_pools": 0, "decode_steps": 0,
@@ -242,7 +359,8 @@ class InferenceEngine:
                       "prefix_hits": 0, "prefix_misses": 0,
                       "cow_copies": 0, "spec_steps": 0,
                       "spec_proposed": 0, "spec_accepted": 0,
-                      "spec_fallbacks": 0}
+                      "spec_fallbacks": 0, "tp_coll_pools": 0,
+                      "tp_coll_wait_ns": 0}
 
     def _prefix_advert(self) -> dict:
         """Advertisement payload (Server.advertise()["prefix"], schema
@@ -266,6 +384,59 @@ class InferenceEngine:
                 "fallbacks": self.stats["spec_fallbacks"],
                 "accept_rate": (acc / prop) if prop else 0.0,
             }
+
+    def _tp_stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.tp > 1, "tp": self.tp, "rank": self.rank,
+                "heads_local": self._nh, "d_local": self._dl,
+                "coll_pools": self.stats["tp_coll_pools"],
+                "coll_wait_ns": self.stats["tp_coll_wait_ns"],
+            }
+
+    # ------------------------------------------------- tp shard plumbing
+    def _project(self, o: np.ndarray) -> np.ndarray:
+        """This rank's partial output projection: the local head-block's
+        attention output against the matching wo rows.  Under qlog every
+        partial product is dyadic-exact, so the cross-rank sum equals
+        the full-width projection BITWISE."""
+        return self.model.quant_o(o) @ self._wo_s
+
+    def _mk_shard(self, nseg: int):
+        """Per-pool shard record: the dict build_paged_* hands to
+        _wire_shard (rank identity + projection + delivery sink) and the
+        reap-side record carrying the reduced pre-logit buffer plus the
+        coll-wait instants (local shard done -> reduced vector back)."""
+        d = self.model.cfg.d
+        buf = np.zeros((nseg, d), np.float32)
+        t_loc = np.zeros(nseg, np.int64)
+        t_del = np.zeros(nseg, np.int64)
+
+        def mark(seg, t=t_loc):
+            t[seg] = time.monotonic_ns()
+
+        def sink(seg, slc, x, buf=buf, t=t_del):
+            # RefReduce fanout uses ns=1 (the pre-logit vector is one
+            # slice); x is the whole reduced segment
+            buf[seg, :x.size] = x
+            t[seg] = time.monotonic_ns()
+
+        shard = {"rank": self.rank, "nranks": self.tp, "dm": d,
+                 "project": self._project, "sink": sink, "local": mark}
+        return shard, {"buf": buf, "t_local": t_loc, "t_deliver": t_del}
+
+    def _coll_wait(self, srec, tenant: str) -> int:
+        """Fold one reaped pool's coll-wait instants into the stats +
+        the tenant's live scope feed; returns the pool's max wait (the
+        step's critical-path exposure to the wire)."""
+        waits = np.maximum(srec["t_deliver"] - srec["t_local"], 0)
+        total = int(waits.sum())
+        with self._lock:
+            self.stats["tp_coll_pools"] += 1
+            self.stats["tp_coll_wait_ns"] += total
+        self.scope.record_coll_wait(tenant, int(waits.max()) if
+                                    waits.size else 0, n=int(waits.size))
+        return int(waits.max()) if waits.size else 0
 
     def _host_wrote(self, coll, m: int, n: int = 0):
         """The engine rewrote a slot tile's HOST bytes directly (numpy,
@@ -358,14 +529,17 @@ class InferenceEngine:
         self.scope.record_prefix(req.tenant, hits=warm,
                                  misses=n_pages - warm)
         # stage COLD prompt k|v into the PR collection + the last
-        # token's q; warm pages already hold their rows (frozen)
-        kv = np.zeros((n_pages * P, 2 * d), np.float32)
+        # token's q; warm pages already hold their rows (frozen).  In tp
+        # mode the FULL qkv rows are computed and this rank's head block
+        # sliced out — projection numerics never depend on the shard.
+        dl, sl = self._dl, self._shard_sl
+        kv = np.zeros((n_pages * P, 2 * dl), np.float32)
         for i, tok in enumerate(req.prompt):
             if i < warm * P:
                 continue
             _, k, v = self.model.qkv(tok)
-            kv[i, :d] = k
-            kv[i, d:] = v
+            kv[i, :dl] = k[sl]
+            kv[i, dl:] = v[sl]
         ptiles = [(ptile0 + i) % self._prompt_tiles
                   for i in range(n_pages)]
         for i, pt_i in enumerate(ptiles):
@@ -374,24 +548,31 @@ class InferenceEngine:
             self.PRc.tile(pt_i, 0)[...] = kv[i * P:(i + 1) * P]
             self._host_wrote(self.PRc, pt_i)
         q = self.model.qkv(req.prompt[-1])[0]
-        self.Qc.tile(slot, 0)[0] = q
-        reset_acc(self.ACCc.tile(slot, 0))
+        self.Qc.tile(slot, 0)[0] = q[sl]
+        reset_acc(self.ACCc.tile(slot, 0), self._nh)
         self._host_wrote(self.Qc, slot)
         self._host_wrote(self.ACCc, slot)
         fill = T - (n_pages - 1) * P
         spec = SeqSpec(slot, pages, fill)
+        shard = srec = None
+        if self.tp > 1:
+            shard, srec = self._mk_shard(1)
         tp = build_paged_prefill(
             self.ctx, self.pool, [spec],
             {"Q": self.slot_names["Q"], "ACC": self.slot_names["ACC"],
              "O": self.slot_names["O"]},
             self._prompt_coll_name, [ptiles],
-            priority=priority, weight=weight, warm=[warm])
-        tp.on_complete(lambda: self._prefill_done(req, spec, warm, keys))
+            scale=self.model.scale,
+            priority=priority, weight=weight, warm=[warm],
+            nh=self._nh, shard=shard)
+        tp.on_complete(lambda: self._prefill_done(req, spec, warm, keys,
+                                                  srec))
         self.stats["prefills"] += 1
         return tp
 
     def _prefill_done(self, req: RequestHandle, spec: SeqSpec,
-                      warm: int = 0, keys: Optional[List[str]] = None):
+                      warm: int = 0, keys: Optional[List[str]] = None,
+                      srec: Optional[dict] = None):
         """Worker-thread callback: activate the sequence + consume the
         first decode output (the prefill chain already attended the
         last prompt position)."""
@@ -412,9 +593,18 @@ class InferenceEngine:
             with self._lock:
                 self._retire_locked(seq)
             return
-        o = self.Oc.tile(spec.slot, 0)[0].copy()
-        req.outputs.append(o)
-        nxt = self.model.next_token(o)
+        if srec is not None:
+            # tp: token selection from the all-reduced pre-logits (the
+            # same bytes on every rank); outputs carry the reduced
+            # pre-logit vector in tp mode
+            pre = srec["buf"][0].copy()
+            req.outputs.append(pre)
+            nxt = self.model.next_token_pre(pre)
+            self._coll_wait(srec, req.tenant)
+        else:
+            o = self.Oc.tile(spec.slot, 0)[0].copy()
+            req.outputs.append(o)
+            nxt = self.model.next_token(o)
         req.tokens.append(nxt)
         # the prefill chain attended the last prompt position: this IS
         # the first generated token — the tenant TTFT histogram's feed
@@ -454,7 +644,16 @@ class InferenceEngine:
                     seq.pages.append(p)
                 ready.setdefault(tenant, []).append(seq)
         launched = 0
-        for tenant, seqs in ready.items():
+        items = list(ready.items())
+        if self.tp > 1:
+            # SPMD discipline: every rank must build the SAME pool
+            # sequence (the embedded RefReduce uids and class tables
+            # must line up across ranks), so tenant build order and
+            # per-tenant sequence order are made canonical
+            items.sort(key=lambda kv: kv[0])
+            for _, seqs in items:
+                seqs.sort(key=lambda s: s.req.rid)
+        for tenant, seqs in items:
             ts = self.server._tenants.get(tenant)
             prio, wt = (ts.cfg.priority, ts.cfg.weight) if ts else (0, 1)
             rec = None
@@ -465,7 +664,7 @@ class InferenceEngine:
                         self.stats["spec_fallbacks"] += 1
             if rec is None:
                 rec = self._stage_decode(seqs, prio, wt)
-            tp, staged, spec_info = rec
+            tp, staged, spec_info, srec = rec
             if not staged:
                 tp.destroy()  # nothing stageable this wave (COW dry)
                 continue
@@ -487,7 +686,8 @@ class InferenceEngine:
             done = threading.Event()
             tp.on_complete(done.set)
             self._inflight[tenant] = (tp, staged, done, dsid, plan,
-                                      time.monotonic_ns(), spec_info)
+                                      time.monotonic_ns(), spec_info,
+                                      srec)
             tp.run()
             self.stats["decode_pools"] += 1
             launched += 1
@@ -497,9 +697,10 @@ class InferenceEngine:
         """Stage + build one NORMAL decode step over `seqs`.  A shared
         (prefix-cache) or frozen last page goes copy-on-write first:
         PUPD appends in place, and a sharer's view must never move.
-        Returns (taskpool, staged sequences, None)."""
+        Returns (taskpool, staged sequences, None, shard record)."""
         cfg = self.model.cfg
-        P, d = cfg.page, cfg.d
+        P = cfg.page
+        dl, sl = self._dl, self._shard_sl
         specs, staged = [], []
         for seq in seqs:
             last = seq.pages[-1]
@@ -515,20 +716,24 @@ class InferenceEngine:
                     seq.pages[-1] = priv
             tok = seq.req.tokens[-1]
             q, k, v = self.model.qkv(tok)
-            self.Qc.tile(seq.slot, 0)[0] = q
+            self.Qc.tile(seq.slot, 0)[0] = q[sl]
             knrow = self.KNc.tile(seq.slot, 0)
-            knrow[0, :d] = k
-            knrow[0, d:] = v
-            reset_acc(self.ACCc.tile(seq.slot, 0))
+            knrow[0, :dl] = k[sl]
+            knrow[0, dl:] = v[sl]
+            reset_acc(self.ACCc.tile(seq.slot, 0), self._nh)
             for coll in (self.Qc, self.KNc, self.ACCc):
                 self._host_wrote(coll, seq.slot)
             specs.append(SeqSpec(seq.slot, seq.pages, seq.length % P))
             staged.append(seq)
+        shard = srec = None
+        if self.tp > 1 and specs:
+            shard, srec = self._mk_shard(len(specs))
         tp = build_paged_decode(
             self.ctx, self.pool, specs, self.slot_names,
+            scale=self.model.scale,
             priority=prio, weight=wt, body_wrap=self.body_wrap,
-            dev=self.dev)
-        return tp, staged, None
+            dev=self.dev, nh=self._nh, shard=shard)
+        return tp, staged, None, srec
 
     def _stage_spec(self, seqs, prio, wt):
         """Stage + build one SPECULATIVE decode step over `seqs`: the
@@ -545,9 +750,11 @@ class InferenceEngine:
         reservation is all-or-nothing against the refcounted pool:
         shortfall returns None and the caller falls back to plain
         decode (never half-speculates).  Returns
-        (taskpool, sequences, per-seq speculation records)."""
+        (taskpool, sequences, per-seq speculation records, shard
+        record)."""
         cfg = self.model.cfg
-        P, d = cfg.page, cfg.d
+        P = cfg.page
+        dl, hsl = self._dl, self._shard_sl
         dm = self.spec_draft
         nq_tot = 0
         layout = []
@@ -589,13 +796,13 @@ class InferenceEngine:
                 for r in range(L, L + i + 1):
                     pg = priv[r // P - pbase]
                     _, k, v = kvs[r - L]
-                    self.pool.k_tile(pg)[r % P] = k
-                    self.pool.v_tile(pg)[r % P] = v
+                    self.pool.k_tile(pg)[r % P] = k[hsl]
+                    self.pool.v_tile(pg)[r % P] = v[hsl]
                 for pg in priv:
                     self.pool.host_wrote(pg)
                 vslot = seq.slot * (self.spec_k + 1) + i
-                self.SQc.tile(vslot, 0)[0] = kvs[i][0]
-                reset_acc(self.SACCc.tile(vslot, 0))
+                self.SQc.tile(vslot, 0)[0] = kvs[i][0][hsl]
+                reset_acc(self.SACCc.tile(vslot, 0), self._nh)
                 self._host_wrote(self.SQc, vslot)
                 self._host_wrote(self.SACCc, vslot)
                 R = L + 1 + i
@@ -605,11 +812,15 @@ class InferenceEngine:
                 privs.append(priv)
             recs.append({"seq": seq, "nq": nq, "g": [int(t) for t in g],
                          "pbase": pbase, "privs": privs})
+        shard = srec = None
+        if self.tp > 1 and vspecs:
+            shard, srec = self._mk_shard(len(vspecs))
         tp = build_paged_verify(
             self.ctx, self.pool, vspecs, self.spec_names,
+            scale=self.model.scale,
             priority=prio, weight=wt, body_wrap=self.body_wrap,
-            dev=self.dev)
-        return tp, seqs, recs
+            dev=self.dev, nh=self._nh, shard=shard)
+        return tp, seqs, recs, srec
 
     def _reap(self) -> int:
         """Consume completed decode pools: apply the model head, append
@@ -618,19 +829,32 @@ class InferenceEngine:
         done = [(t, rec) for t, rec in self._inflight.items()
                 if rec[2].is_set()]
         advanced = 0
-        for tenant, (tp, seqs, _, dsid, plan, t0_ns, spec) in done:
+        for tenant, (tp, seqs, _, dsid, plan, t0_ns, spec,
+                     srec) in done:
             del self._inflight[tenant]
+            coll_ns = None
             if spec is not None:
-                advanced += self._reap_spec(tenant, spec)
+                advanced += self._reap_spec(tenant, spec, srec)
+                if srec is not None:
+                    coll_ns = self._coll_wait(srec, tenant)
             else:
-                for seq in seqs:
-                    o = self.Oc.tile(seq.slot, 0)[0].copy()
+                for k, seq in enumerate(seqs):
+                    if srec is not None:
+                        # tp: the all-reduced pre-logits (identical
+                        # bytes on every rank) select the token; the
+                        # staged order IS the segment order
+                        o = srec["buf"][k].copy()
+                        nxt = self.model.next_token_pre(o)
+                    else:
+                        o = self.Oc.tile(seq.slot, 0)[0].copy()
+                        nxt = self.model.next_token(o)
                     seq.req.outputs.append(o)
-                    nxt = self.model.next_token(o)
                     seq.req.tokens.append(nxt)
                     seq.length += 1
                     seq.remaining -= 1
                     advanced += 1
+                if srec is not None:
+                    coll_ns = self._coll_wait(srec, tenant)
             # conformance: decode-step pool retired — compare the plan
             # snapshot against the measured step wall + lane counters
             qos = None
@@ -638,9 +862,11 @@ class InferenceEngine:
                 qos = tp.qos_stats()
             except Exception:
                 pass
-            self.scope.record_pool_done(
-                dsid, qos=qos, plan=plan,
-                measured={"wall_ns": time.monotonic_ns() - t0_ns})
+            measured = {"wall_ns": time.monotonic_ns() - t0_ns}
+            if coll_ns is not None:
+                measured["coll_wait_ns"] = coll_ns
+            self.scope.record_pool_done(dsid, qos=qos, plan=plan,
+                                        measured=measured)
             tp.destroy()
             self.stats["decode_steps"] += 1
         with self._lock:
@@ -648,7 +874,7 @@ class InferenceEngine:
                 self._retire_locked(seq)
         return advanced
 
-    def _reap_spec(self, tenant: str, recs) -> int:
+    def _reap_spec(self, tenant: str, recs, srec=None) -> int:
         """Consume one speculative verify wave: greedy accept — query i
         is valid while every earlier draft matched the target's own
         argmax — so the emitted (token, output) stream is BIT-IDENTICAL
@@ -656,11 +882,18 @@ class InferenceEngine:
         tokens roll back by truncating the page table: the losing
         queries' private pages release (refcounts make this free)."""
         advanced = 0
+        vi = 0  # flat verify-spec index == srec segment index (tp)
         for rec in recs:
             seq, nq, g = rec["seq"], rec["nq"], rec["g"]
             pbase, privs = rec["pbase"], rec["privs"]
             outs, nxts = [], []
             for i in range(nq):
+                if srec is not None:
+                    o = srec["buf"][vi].copy()
+                    vi += 1
+                    outs.append(o)
+                    nxts.append(self.model.next_token_pre(o))
+                    continue
                 vslot = seq.slot * (self.spec_k + 1) + i
                 o = self.SOc.tile(vslot, 0)[0].copy()
                 outs.append(o)
@@ -749,3 +982,10 @@ class InferenceEngine:
 
     def close(self):
         self.server.close()
+        if self.tp > 1:
+            # RefReduce(bcast=True) leaves the fanout topology set on
+            # the comm layer (per-pool restore would race concurrent
+            # tenant pools; every step chooses the same topology).
+            # Put the configured default back on teardown.
+            from ..comm.coll import restore_topology
+            restore_topology(self.ctx)

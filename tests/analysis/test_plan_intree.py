@@ -25,12 +25,13 @@ def _all_plans():
 
 def test_intree_graphs_plan_clean():
     plans = _all_plans()
-    assert len(plans) >= 31
+    assert len(plans) >= 33
     names = {n for n, _ in plans}
     for expected in ("potrf", "gemm_dist", "moe", "ring_attention",
                      "ops_paged_decode", "ops_paged_prefill_warm",
                      "ops_paged_spec_verify", "coll_reduce_ring",
-                     "coll_fanout"):
+                     "coll_fanout", "ops_tp_paged_decode",
+                     "ops_tp_paged_verify"):
         assert any(expected in n for n in names), names
     dirty = {n: plan_graphs.plan_issues(p) for n, p in plans
              if plan_graphs.plan_issues(p)}

@@ -105,6 +105,17 @@ CHECKS = [
     ("BENCH_serve.json", "fleet.scaling", "higher", 0.50, True),
     ("BENCH_serve.json", "fleet.hit_rate", "higher", 0.50, True),
     ("BENCH_serve.json", "fleet.bit_identical", "equal", 0.0, False),
+    # ptc-shard (PR 18): 2-/4-rank tensor-parallel decode vs the
+    # single-rank reference — bit_identical (tokens AND exact f32
+    # pre-logit bytes, prefix cache + speculative decoding live) and
+    # the fused_waves>0-on-every-rank verdict are equal-direction
+    # correctness flags, never relaxed; the tp4-vs-tp1 per-token wall
+    # ratio is a timing trajectory row, oversubscription-slacked (all
+    # ranks timeshare one host)
+    ("BENCH_serve.json", "tp.bit_identical", "equal", 0.0, False),
+    ("BENCH_serve.json", "tp.all_ranks_fused", "equal", 0.0, False),
+    ("BENCH_serve.json", "tp.tp4_vs_tp1_ms_per_token", "lower", 0.50,
+     True),
     # ptc-tune (PR 12): autotuned-vs-default ratios on the dispatch
     # chain and the 2-rank collective — timing trajectory rows,
     # oversubscription-slacked per convention; the beats_default

@@ -124,6 +124,8 @@ def main(argv=None):
             "peak_bytes": peak,
             "est_bytes": plan.est_bytes(),
             "comm_bytes": plan.comm_bytes(),
+            "coll_bytes": plan.coll_bytes(),
+            "coll_legs": len(plan.coll_legs()),
             "makespan_lower_ns": plan.makespan.get("lower_bound_ns", 0),
             "elapsed_ms": round(plan.stats.get("elapsed_ms", 0), 2),
         }

@@ -111,7 +111,7 @@ def render_live(samples):
         lines.append(f"{'tenant':<12}{'act':>4}{'q':>4}{'rej':>5}"
                      f"{'done':>6}{'ttft_p99':>10}{'lat_p99':>9}"
                      f"{'tok/s':>7}{'burn':>6}{'pfx_hit':>8}"
-                     f"{'spec_acc':>9}")
+                     f"{'spec_acc':>9}{'coll_wait':>10}")
         for name, t in sorted(tenants.items()):
             lines.append(
                 f"{name:<12}{t.get('active', 0):>4}"
@@ -122,7 +122,8 @@ def render_live(samples):
                 f"{_fmt(t.get('tok_s_p50'), 0):>7}"
                 f"{_fmt(t.get('slo_burn')):>6}"
                 f"{_fmt(t.get('prefix_hit')):>8}"
-                f"{_fmt(t.get('spec_acc')):>9}")
+                f"{_fmt(t.get('spec_acc')):>9}"
+                f"{_fmt(t.get('coll_wait_p99_ms')):>10}")
     if fleet:
         # per-replica fleet table (ptc-route): occupancy, prefix hit
         # rate and the migration ledger, straight off Router.stats()

@@ -767,6 +767,114 @@ def wave_fuse_gemm(workers: int, port: int, N: int = 32, nb: int = 8,
                 os.environ[k] = v
 
 
+def tp_decode_churn(workers: int, port: int, max_new: int = 5,
+                    env=None) -> None:
+    """ptc-shard (PR 18): TWO colocated ranks serve ONE tensor-parallel
+    PagedLM — qkv/ffn rows and KV pages sharded by head (one PagePool
+    per rank), every prefill/decode/verify pool embedding a RefReduce
+    ptc_coll_* chain whose slice-granular step deliveries race the
+    wave compiler (per-rank shard-wave certification + fused dispatch
+    on the device manager thread) and the prefetch lane's peeks, all
+    over the streamed (rendezvous + chunked, 2-rail) wire.  A reader
+    thread per rank concurrently scrapes the head-sharded pool
+    counters, stats()["serve"]["tp"] (the coll_wait fold readers) and
+    device_stats() while the SPMD step loop and both comm threads
+    mutate them in one TSan-observed address space.  A final bitwise
+    check against the single-rank reference and the fused_waves>0 /
+    coll_pools>0 floors keep the stress honest."""
+    import threading
+    import time
+
+    from parsec_tpu.serve import InferenceEngine, PagedLM, PagedLMConfig
+
+    env = dict(env or {})
+    env.setdefault("PTC_MCA_device_wave_fuse", "1")
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    errs = []
+    prompts = [[1, 2, 3, 4, 5, 6, 7, 8], [1, 2, 3, 4, 5, 6, 7, 8, 9],
+               [3, 1, 4, 1, 5]]
+
+    def rank_prog(rank):
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            from parsec_tpu.device import TpuDevice
+
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            ctx.comm_set_colocated([1 - rank])
+            with ctx:
+                model = PagedLM(PagedLMConfig(heads=4, qlog=True))
+                dev = TpuDevice(ctx)
+                try:
+                    eng = InferenceEngine(ctx, model, n_pages=64,
+                                          max_seqs=4, tp=2, spec_k=2,
+                                          dev=dev)
+                    stop = threading.Event()
+
+                    def reader():
+                        while not stop.is_set():
+                            eng.pool.stats()
+                            ctx.stats()["serve"]
+                            ctx.device_stats()
+                            stop.wait(0.003)
+
+                    rd = threading.Thread(target=reader, daemon=True)
+                    rd.start()
+                    hs = []
+                    t0 = time.monotonic()
+                    for p in prompts:
+                        h = eng.submit(p, max_new)
+                        hs.append(h)
+                        while h.state == "submitted":
+                            assert time.monotonic() - t0 < 240, \
+                                "prefill stuck"
+                            time.sleep(0.001)
+                    while eng.pending() or eng._inflight:
+                        assert time.monotonic() - t0 < 240, \
+                            "decode stuck"
+                        eng.step()
+                    stop.set()
+                    rd.join(timeout=10)
+                    tp_st = eng._tp_stats()
+                    assert tp_st["coll_pools"] > 0, tp_st
+                    fuse = ctx.device_stats().get("fuse", {})
+                    assert fuse.get("fused_waves", 0) > 0, fuse
+                    for h in hs:
+                        rt, ro = model.reference_generate(h.prompt,
+                                                          h.max_new)
+                        assert list(h.tokens) == rt
+                        for j, o in enumerate(h.outputs):
+                            assert np.array_equal(
+                                o, model.pre_logits(ro[j]))
+                    eng.close()
+                finally:
+                    dev.stop()
+                ctx.comm_fence()
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        ts = [threading.Thread(target=rank_prog, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=600)
+        hung = [t.name for t in ts if t.is_alive()]
+        assert not hung, f"deadlocked rank threads: {hung}"
+        assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def reshape_churn(workers: int, fanout: int, rounds: int) -> None:
     """Concurrent consumers of the same (copy, [type]) — the memoized
     reshape cache's create/hit race — plus write-back version bumps that
@@ -886,6 +994,13 @@ def main():
                        env={"PTC_MCA_comm_eager_limit": "0",
                             "PTC_MCA_comm_chunk_size": "2048",
                             "PTC_MCA_comm_rails": "2"})
+        # ptc-shard (PR 18): 2-rank tensor-parallel decode — embedded
+        # RefReduce coll chains + wave compiler + prefetch lane under
+        # the streamed wire, concurrent stats readers on both ranks
+        tp_decode_churn(workers=1, port=30080 + rep,
+                        env={"PTC_MCA_comm_eager_limit": "0",
+                             "PTC_MCA_comm_chunk_size": "2048",
+                             "PTC_MCA_comm_rails": "2"})
         sys.stderr.write(f"rep {rep + 1}/{reps} done\n")
     print("stress ok")
 

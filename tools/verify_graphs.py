@@ -262,6 +262,39 @@ def g_coll(ctx):
     return out
 
 
+def g_tp_paged(ctx):
+    """Tensor-parallel sharded serving graphs (ptc-shard): the DECODE
+    and speculative-VERIFY builders with an embedded RefReduce
+    all-reduce over the per-rank partial pre-logit projections.  Built
+    for a 1-rank tp group on this single-rank context — the SPMD shape
+    each rank compiles is IDENTICAL up to the contributor-id base, so
+    the R=1 degenerate chain (local fold + fan-out, producer-domain
+    selection, no dynamic guards on the coll step IN deps — V008)
+    verifying exactly certifies the per-rank shard wave shape."""
+    from parsec_tpu.ops.paged_attention import (PagePool, SeqSpec,
+                                                build_paged_decode,
+                                                build_paged_verify,
+                                                make_slot_collections)
+    d, nh, dm = 8, 2, 16
+    pool = PagePool(ctx, 16, 4, d, name="TKV")
+    _, _, _, _, names = make_slot_collections(ctx, 4, d, name="TPA",
+                                              nh=nh)
+    wo = np.zeros((d, dm), np.float32)
+
+    def mk_shard():
+        return {"rank": 0, "nranks": 1, "dm": dm,
+                "project": lambda o, w=wo: o @ w,
+                "sink": lambda seg, slc, x: None}
+
+    seqs = [SeqSpec(0, [0, 1], 2), SeqSpec(1, [2], 1)]
+    dec = build_paged_decode(ctx, pool, seqs, names, nh=nh,
+                             shard=mk_shard())
+    vseqs = [SeqSpec(0, [3, 4], 2), SeqSpec(1, [3], 3)]
+    ver = build_paged_verify(ctx, pool, vseqs, names, nh=nh,
+                             shard=mk_shard())
+    return [("ops_tp_paged_decode", dec), ("ops_tp_paged_verify", ver)]
+
+
 GENERATORS = {
     "potrf": g_potrf,
     "potrf_textbook": g_potrf_textbook,
@@ -283,6 +316,7 @@ GENERATORS = {
     "ops_flash_attention": g_ops_flash_attention,
     "paged_attention": g_paged_attention,
     "coll": g_coll,
+    "tp_paged": g_tp_paged,
 }
 
 
