@@ -117,6 +117,17 @@ bench-collective: $(LIB)
 bench-serve: $(LIB)
 	python bench.py --serve --json BENCH_serve.json
 
+# Self-driving-runtime suite (bench.py --control --json, ptc-pilot):
+# the drift soak — a stale device-cache knob vector lands mid-run with
+# PTC_COMM_FAULT_DELAY_US armed, the controller detects the sustained
+# makespan drift, re-simulates on the recalibrated cost model and
+# hot-swaps the winner at the next pool boundary (recovered-throughput
+# ratio gated >= 0.5, no restart) — plus the adaptive-vs-fixed spec_k
+# sweep over a mixed oracle/adversarial draft workload (deterministic
+# score; bit-identity never relaxed).  CPU-only — no TPU needed.
+bench-control: $(LIB)
+	python bench.py --control --json BENCH_control.json
+
 # Topology-tier soak (bench.py --topo --json, ptc-topo): the 4-rank
 # two-island mesh under the island emulator's per-peer recv delays —
 # ring vs hierarchical all_reduce (bit-exact, per-class wire split),
@@ -157,4 +168,5 @@ check: bench-check verify-graphs plan-graphs tune-check tidy
 
 .PHONY: all clean tsan ubsan tidy verify-graphs plan-graphs tune-check \
 	check bench-comm bench-dispatch bench-device bench-stream \
-	bench-collective bench-trace bench-serve bench-topo bench-check
+	bench-collective bench-trace bench-serve bench-topo \
+	bench-control bench-check

@@ -20,7 +20,8 @@ from .plan import (CostModel, Plan, PlanCheckError, certify_waves,
                    chain_certificates, compare_critpath, plan_graph,
                    plan_taskpool)
 from .tune import (ScheduleSimulator, TuneStore, apply_knobs, autotune,
-                   graph_signature, host_fingerprint)
+                   graph_signature, hold_knobs, host_fingerprint)
+from .control import Controller, SimClock
 from .dtdlint import DtdLintError, DtdLinter
 
 __all__ = [
@@ -30,7 +31,8 @@ __all__ = [
     "verify_taskpool",
     "CostModel", "Plan", "PlanCheckError", "plan_graph", "plan_taskpool",
     "compare_critpath", "certify_waves", "chain_certificates",
-    "ScheduleSimulator", "TuneStore", "apply_knobs", "autotune",
-    "graph_signature", "host_fingerprint",
+    "ScheduleSimulator", "TuneStore", "apply_knobs", "hold_knobs",
+    "autotune", "graph_signature", "host_fingerprint",
+    "Controller", "SimClock",
     "DtdLinter", "DtdLintError",
 ]

@@ -119,6 +119,20 @@ class CostModel:
             if isinstance(doc, dict) else DEFAULT_TASK_NS
         return cls(costs, default_ns=default, source=path)
 
+    def recalibrated(self, ratios: Dict[str, float],
+                     fallback: float = 1.0) -> "CostModel":
+        """ptc-pilot: fold live measured/planned calibration ratios
+        (scope conformance `per_class[cls]["ratio"]`) into a NEW model
+        — each named class's cost scales by its ratio, classes without
+        a live ratio (and the default) scale by `fallback` (typically
+        the window's median makespan ratio).  The original is never
+        mutated: the planner that produced it may still be in use."""
+        fb = max(0.0, float(fallback)) or 1.0
+        costs = {cls: ns * max(0.0, float(ratios.get(cls, fb)) or fb)
+                 for cls, ns in self.costs.items()}
+        return CostModel(costs, default_ns=self.default_ns * fb,
+                         source=f"{self.source}+recalibrated")
+
     def to_json(self) -> dict:
         return {"source": self.source, "default_ns": self.default_ns,
                 "classes": dict(self.costs)}

@@ -295,6 +295,26 @@ def apply_knobs(knobs: Optional[Dict[str, object]]):
                 os.environ[env] = old
 
 
+def hold_knobs(knobs: Dict[str, object]):
+    """apply_knobs, held open: apply the vector NOW (same MCA + env
+    double-write, same unknown-name check) and return a zero-argument
+    `restore()` that puts the snapshot back — the ptc-pilot
+    controller's hot-swap primitive, where the swap must outlive any
+    single with-block (it stays in force across pools until the next
+    retune or teardown).  Restore is idempotent."""
+    cm = apply_knobs(dict(knobs) if knobs else None)
+    applied = cm.__enter__()
+    done = []
+
+    def restore():
+        if done:
+            return
+        done.append(True)
+        cm.__exit__(None, None, None)
+
+    return applied, restore
+
+
 def knob_env(knobs: Dict[str, object]) -> Dict[str, str]:
     """The PTC_MCA_* env spelling of a knob vector — what a spawned
     SPMD rank needs in its environment to run under the vector."""

@@ -214,6 +214,14 @@ class Context:
                     chain.uninstall()
                 except Exception:
                     pass
+            # ptc-pilot: restore any controller-held knob vector while
+            # the registry/env snapshot is still meaningful
+            ctrl = getattr(self, "_controller", None)
+            if ctrl is not None:
+                try:
+                    ctrl.stop()
+                except Exception:
+                    pass
             for attr in ("_watchdog", "_metrics_exporter"):
                 obj = getattr(self, attr, None)
                 if obj is not None:
@@ -640,6 +648,10 @@ class Context:
                      per-tenant SLO rollups + plan-vs-measured
                      conformance ratios; {"enabled": False} when no
                      ScopeRegistry is attached
+          control -> ptc-pilot feedback controller (analysis/control.py):
+                     drift window, retune/swap counters, last swap,
+                     per-tenant adaptive spec_k and budget shares;
+                     {"enabled": False} when no Controller is attached
         """
         from ..utils import params as _plan_mca
         tuning = self.comm_tuning()
@@ -685,6 +697,9 @@ class Context:
             "scope": (self._scope_registry.stats()
                       if getattr(self, "_scope_registry", None) is not None
                       else {"enabled": False}),
+            "control": (self._controller.stats()
+                        if getattr(self, "_controller", None) is not None
+                        else {"enabled": False}),
         }
 
     def scope_registry(self, create: bool = True):
@@ -698,6 +713,19 @@ class Context:
             from ..profiling.scope import ScopeRegistry
             reg = self._scope_registry = ScopeRegistry(self)
         return reg
+
+    def controller(self, create: bool = True, **kwargs):
+        """The ptc-pilot feedback controller (one per context;
+        analysis/control.py).  Consumes the scope registry's
+        conformance observations at pool boundaries, retunes knob
+        vectors on drift, and drives adaptive speculation depth and
+        tenant cache budgets.  create=False just peeks; kwargs
+        (clock=, drift_ratio=, window=, ...) apply only on creation."""
+        ctrl = getattr(self, "_controller", None)
+        if ctrl is None and create:
+            from ..analysis.control import Controller
+            ctrl = Controller(self, **kwargs)
+        return ctrl
 
     # ------------------------------------------------------------ registries
     def register_expr_cb(self, fn: Callable) -> int:

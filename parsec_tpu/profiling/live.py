@@ -219,6 +219,14 @@ class LiveMonitor:
                                 "replicas": rt["replicas"]}
             except Exception:
                 pass
+        # ptc-pilot: the self-driving controller's decision snapshot
+        # (drift, retunes, hot-swaps, budget shares, per-tenant spec_k)
+        ctrl = getattr(ctx, "_controller", None)
+        if ctrl is not None:
+            try:
+                rec["control"] = ctrl.stats()
+            except Exception:
+                pass
         reg = getattr(ctx, "_scope_registry", None)
         if reg is not None:
             try:

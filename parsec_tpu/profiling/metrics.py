@@ -487,6 +487,17 @@ class Watchdog:
                     emit(dict(ev, event=ev["type"]))
                 except Exception:
                     pass
+        # ptc-pilot interrupt path: a stuck task or slow rank is acted
+        # on IMMEDIATELY — the controller closes its observation window
+        # and re-evaluates now rather than waiting out control.window
+        # more pools
+        if ev["type"] in ("stuck_task", "slow_rank"):
+            ctrl = getattr(self.ctx, "_controller", None)
+            if ctrl is not None:
+                try:
+                    ctrl.interrupt(ev["type"], key=str(ev.get("key")))
+                except Exception:
+                    pass
         if dump and self._dumps < self.max_dumps:
             try:
                 if self.ctx.profile_level() > 0:

@@ -170,7 +170,20 @@ class ScopeRegistry:
         self._by_rid: Dict[object, int] = {}
         # decode-style shared pools: scope -> ordered member rids
         self._members: Dict[int, List[object]] = {}
-        # conformance aggregates
+        # conformance aggregates — EPOCHED (ptc-pilot): the fold-only
+        # counters roll to a fresh generation every conformance_window
+        # retired pools (one closed generation kept), so a long soak's
+        # rollup reads the RECENT plan-vs-measured ratio in O(window)
+        # state instead of a run-lifetime average the drift detector
+        # could never move
+        from ..utils import params as _mca
+        try:
+            self.conformance_window = int(
+                _mca.get("scope.conformance_window"))
+        except Exception:
+            self.conformance_window = 2048
+        self._conf_prev: Optional[dict] = None  # closed epoch fold
+        self._conf_epochs = 0
         self._pools_done = 0
         self._pools_planned = 0
         self._unplanned = 0
@@ -280,6 +293,7 @@ class ScopeRegistry:
         """One POOL retired under this scope: fold the plan-vs-measured
         conformance record (a request scope may span several pools; a
         shared decode-step scope is exactly one)."""
+        ratio = None
         with self._lock:
             r = self.requests.get(scope_id)
             if r is not None:
@@ -290,21 +304,67 @@ class ScopeRegistry:
                 if measured is not None:
                     r.measured = measured
             self._pools_done += 1
-            if not plan:
+            if plan:
+                self._pools_planned += 1
+                if plan.get("est_bytes"):
+                    self._pred_est_bytes += int(plan["est_bytes"])
+                self._pred_wire_bytes += int(
+                    plan.get("wire_out_bound_sum", 0))
+                lb = plan.get("makespan_lb_ns")
+                wall = (measured or {}).get("wall_ns")
+                if lb and wall and lb > 0:
+                    ratio = wall / lb
+                    self._makespan_ratios.append(ratio)
+                if plan.get("spills_predicted"):
+                    self._spill_pred_nonzero += 1
+                for cls, ns in (plan.get("per_class_cost") or {}).items():
+                    self._per_class_cost[cls] = float(ns)
+            else:
                 self._unplanned += 1
-                return
-            self._pools_planned += 1
-            if plan.get("est_bytes"):
-                self._pred_est_bytes += int(plan["est_bytes"])
-            self._pred_wire_bytes += int(plan.get("wire_out_bound_sum", 0))
-            lb = plan.get("makespan_lb_ns")
-            wall = (measured or {}).get("wall_ns")
-            if lb and wall and lb > 0:
-                self._makespan_ratios.append(wall / lb)
-            if plan.get("spills_predicted"):
-                self._spill_pred_nonzero += 1
-            for cls, ns in (plan.get("per_class_cost") or {}).items():
-                self._per_class_cost[cls] = float(ns)
+            if self.conformance_window > 0 and \
+                    self._pools_done >= self.conformance_window:
+                self._conf_roll_locked()
+        # ptc-pilot: the pool boundary IS the controller's clock — one
+        # observation per retired pool (ratio None when unplanned),
+        # delivered OUTSIDE the registry lock (the controller logs its
+        # decisions back through record_event)
+        ctrl = getattr(self.ctx, "_controller", None)
+        if ctrl is not None:
+            try:
+                ctrl.observe_pool(ratio)
+            except Exception:
+                pass
+
+    def _conf_roll_locked(self):
+        """Close the current conformance epoch: fold it into
+        `_conf_prev` (replacing the older generation) and zero the live
+        counters.  The comm baseline advances so the closed epoch owns
+        exactly the bytes sent during it — conformance() then merges
+        the two generations, keeping coverage/soundness recent AND
+        bounded."""
+        bytes_now = self._comm_base
+        try:
+            if self.ctx.comm_enabled:
+                bytes_now = self.ctx.comm_stats()["bytes_sent"]
+        except Exception:
+            pass
+        self._conf_prev = {
+            "pools": self._pools_done,
+            "planned": self._pools_planned,
+            "unplanned": self._unplanned,
+            "pred_wire": self._pred_wire_bytes,
+            "pred_est": self._pred_est_bytes,
+            "spill_pred": self._spill_pred_nonzero,
+            "measured_wire": max(0, bytes_now - self._comm_base),
+        }
+        self._conf_epochs += 1
+        self._pools_done = 0
+        self._pools_planned = 0
+        self._unplanned = 0
+        self._pred_wire_bytes = 0
+        self._pred_est_bytes = 0
+        self._spill_pred_nonzero = 0
+        self._comm_base = bytes_now
 
     def record_done(self, scope_id: int, state: str = "done",
                     tokens: int = 0):
@@ -431,12 +491,25 @@ class ScopeRegistry:
             pred_est = self._pred_est_bytes
             spill_pred = self._spill_pred_nonzero
             per_class_cost = dict(self._per_class_cost)
+            prev = self._conf_prev
+            epochs = self._conf_epochs
+            comm_base = self._comm_base
+        prev_wire = 0
+        if prev is not None:
+            # merge the closed generation: the rollup spans at most two
+            # conformance windows, however long the run has been
+            pools += prev["pools"]
+            planned += prev["planned"]
+            pred_wire += prev["pred_wire"]
+            pred_est += prev["pred_est"]
+            spill_pred += prev["spill_pred"]
+            prev_wire = prev["measured_wire"]
         measured_wire = None
         comm_sound = None
         try:
             if self.ctx.comm_enabled:
                 measured_wire = (self.ctx.comm_stats()["bytes_sent"]
-                                 - self._comm_base)
+                                 - comm_base) + prev_wire
         except Exception:
             pass
         coverage = planned / pools if pools else None
@@ -475,6 +548,7 @@ class ScopeRegistry:
         return {
             "pools": pools,
             "planned": planned,
+            "epochs": epochs,
             "coverage": round(coverage, 4) if coverage is not None
             else None,
             "makespan": {

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -252,7 +253,7 @@ class InferenceEngine:
                  tenants: Optional[List[TenantConfig]] = None,
                  name: str = "eng", body_wrap: Optional[Callable] = None,
                  dev=None, conformance: bool = True,
-                 prefix_cache: bool = True, spec_k: int = 0,
+                 prefix_cache: bool = True, spec_k=0,
                  spec_draft="self", tp: int = 1):
         cfg = model.cfg
         self.ctx = ctx
@@ -289,8 +290,33 @@ class InferenceEngine:
         # prefix reject, page-table rollback on rejection).
         # `spec_draft` is the proposer: "self" (the target's own
         # argmax chain — the oracle upper bound) or any PagedLM.
+        # ptc-pilot: spec_k="auto" turns on per-tenant ADAPTIVE
+        # speculation — scratch sizes for control.spec_k_max, and each
+        # tenant's live k tracks its own acceptance window (shrink on
+        # low acceptance, pause under page pressure, grow back on
+        # sustained high acceptance).  Acceptance is a pure function of
+        # draft-vs-target token agreement, so every k emits the same
+        # bit-exact stream — the policy only moves the work/latency
+        # trade-off, never the tokens.
+        from ..utils import params as _mca
+        self._spec_auto = (spec_k == "auto")
+        if self._spec_auto:
+            try:
+                self.spec_k = max(1, int(_mca.get("control.spec_k_max")))
+            except Exception:
+                self.spec_k = 4
+        else:
+            self.spec_k = max(0, int(spec_k))
+        try:
+            self._spec_window = max(1, int(_mca.get("control.spec_window")))
+            self._spec_low = float(_mca.get("control.spec_accept_low"))
+            self._spec_high = float(_mca.get("control.spec_accept_high"))
+            self._spec_floor = float(_mca.get("control.spec_page_floor"))
+        except Exception:
+            self._spec_window, self._spec_low = 4, 0.45
+            self._spec_high, self._spec_floor = 0.80, 0.25
+        self._spec_state: Dict[str, dict] = {}  # tenant -> bandit state
         self.prefix_cache = bool(prefix_cache)
-        self.spec_k = max(0, int(spec_k))
         self.spec_draft = (model if spec_draft in (None, "self")
                            else spec_draft)
         # ptc-scope: per-request scopes (TTFT/tokens-per-s SLO feed) +
@@ -361,6 +387,15 @@ class InferenceEngine:
                       "spec_proposed": 0, "spec_accepted": 0,
                       "spec_fallbacks": 0, "tp_coll_pools": 0,
                       "tp_coll_wait_ns": 0}
+        # ptc-pilot: a Controller created before the engine gets its
+        # resource levers (cached-free shares, admission pressure,
+        # per-tenant spec_k) bound automatically
+        ctrl = getattr(ctx, "_controller", None)
+        if ctrl is not None:
+            try:
+                ctrl.bind_engine(self)
+            except Exception:
+                pass
 
     def _prefix_advert(self) -> dict:
         """Advertisement payload (Server.advertise()["prefix"], schema
@@ -379,6 +414,10 @@ class InferenceEngine:
             acc = self.stats["spec_accepted"]
             return {
                 "enabled": self.spec_k > 0, "k": self.spec_k,
+                "auto": self._spec_auto,
+                "k_by_tenant": {t: (0 if st["paused"] else st["k"])
+                                for t, st in
+                                sorted(self._spec_state.items())},
                 "steps": self.stats["spec_steps"],
                 "proposed": prop, "accepted": acc,
                 "fallbacks": self.stats["spec_fallbacks"],
@@ -581,7 +620,8 @@ class InferenceEngine:
         # writer wins; the mutable last page never freezes)
         if keys:
             for j in range(warm, len(keys)):
-                self.pool.freeze(spec.pages[j], keys[j])
+                self.pool.freeze(spec.pages[j], keys[j],
+                                 owner=req.tenant)
         if req.max_new <= 0:
             # prefill-warm (ptc-route disaggregated prefill role): the
             # request exists only to POPULATE the prefix cache — no
@@ -657,11 +697,13 @@ class InferenceEngine:
             ts = self.server._tenants.get(tenant)
             prio, wt = (ts.cfg.priority, ts.cfg.weight) if ts else (0, 1)
             rec = None
-            if self.spec_k:
-                rec = self._stage_spec(seqs, prio, wt)
+            k = self._spec_k_for(tenant)
+            if k:
+                rec = self._stage_spec(seqs, prio, wt, k)
                 if rec is None:  # page reservation failed: plain decode
                     with self._lock:
                         self.stats["spec_fallbacks"] += 1
+                    self._spec_reserve_failed(tenant)
             if rec is None:
                 rec = self._stage_decode(seqs, prio, wt)
             tp, staged, spec_info, srec = rec
@@ -735,7 +777,106 @@ class InferenceEngine:
             dev=self.dev, nh=self._nh, shard=shard)
         return tp, staged, None, srec
 
-    def _stage_spec(self, seqs, prio, wt):
+    # -------------------------------------------- adaptive speculation
+    def _spec_tenant_locked(self, tenant: str) -> dict:
+        st = self._spec_state.get(tenant)
+        if st is None:
+            # optimistic start at k_max: the first windows measure the
+            # tenant's real acceptance and shrink from there
+            st = {"k": self.spec_k, "paused": False,
+                  "accepts": deque(maxlen=self._spec_window)}
+            self._spec_state[tenant] = st
+        return st
+
+    def _spec_event(self, tenant: str, ev: Optional[dict]):
+        if ev is not None:
+            self.scope.record_event("control_spec", tenant=tenant, **ev)
+
+    def _spec_k_for(self, tenant: str) -> int:
+        """The k this tenant speculates with THIS wave.  Fixed spec_k
+        passes through; auto mode reads the tenant's bandit state and
+        the pool's free fraction — under page pressure speculation
+        pauses (k=0: private verify clones are the first load to shed),
+        resuming at the remembered k once pressure clears."""
+        if not self.spec_k:
+            return 0
+        if not self._spec_auto:
+            return self.spec_k
+        frac = self.pool.free_pages / max(1, self.pool.n_pages)
+        ev, k = None, 0
+        with self._lock:
+            st = self._spec_tenant_locked(tenant)
+            if frac < self._spec_floor:
+                if not st["paused"]:
+                    st["paused"] = True
+                    ev = {"k_from": st["k"], "k_to": 0,
+                          "reason": "page_pressure",
+                          "free_frac": round(frac, 4)}
+            else:
+                if st["paused"]:
+                    st["paused"] = False
+                    st["accepts"].clear()
+                    ev = {"k_from": 0, "k_to": st["k"],
+                          "reason": "pressure_cleared",
+                          "free_frac": round(frac, 4)}
+                k = st["k"]
+        self._spec_event(tenant, ev)  # outside the engine lock
+        return k
+
+    def _spec_reserve_failed(self, tenant: str):
+        """All-or-nothing page reservation failed mid-stage: treat it
+        as pressure (the free-fraction gate raced a concurrent
+        allocation) and pause this tenant's speculation."""
+        if not self._spec_auto:
+            return
+        ev = None
+        with self._lock:
+            st = self._spec_tenant_locked(tenant)
+            if not st["paused"]:
+                st["paused"] = True
+                ev = {"k_from": st["k"], "k_to": 0,
+                      "reason": "reserve_failed"}
+        self._spec_event(tenant, ev)
+
+    def _spec_observe(self, tenant: str, proposed: int, accepted: int):
+        """Fold one reaped verify wave's acceptance into the tenant's
+        window; on a FULL window, halve k below control.spec_accept_low
+        and grow k+1 at/above control.spec_accept_high (window clears on
+        every move — full-window hysteresis, deterministic for a given
+        token stream)."""
+        if not self._spec_auto or proposed <= 0:
+            return
+        ev = None
+        with self._lock:
+            st = self._spec_tenant_locked(tenant)
+            st["accepts"].append(accepted / proposed)
+            if len(st["accepts"]) == self._spec_window:
+                mean = sum(st["accepts"]) / self._spec_window
+                k = st["k"]
+                if mean < self._spec_low and k > 1:
+                    st["k"] = max(1, k // 2)
+                    st["accepts"].clear()
+                    ev = {"k_from": k, "k_to": st["k"],
+                          "reason": "accept_low",
+                          "accept": round(mean, 4)}
+                elif mean >= self._spec_high and k < self.spec_k:
+                    st["k"] = k + 1
+                    st["accepts"].clear()
+                    ev = {"k_from": k, "k_to": st["k"],
+                          "reason": "accept_high",
+                          "accept": round(mean, 4)}
+        self._spec_event(tenant, ev)
+
+    def spec_k_snapshot(self) -> dict:
+        """Controller/monitor view of live per-tenant speculation
+        (stats()["control"]["spec_k"])."""
+        with self._lock:
+            return {"auto": self._spec_auto, "max": self.spec_k,
+                    "tenants": {t: (0 if st["paused"] else st["k"])
+                                for t, st in
+                                sorted(self._spec_state.items())}}
+
+    def _stage_spec(self, seqs, prio, wt, k: Optional[int] = None):
         """Stage + build one SPECULATIVE decode step over `seqs`: the
         draft proposes up to k tokens per sequence, and the k+1 query
         positions (current token + each draft token) verify in ONE
@@ -756,11 +897,14 @@ class InferenceEngine:
         P = cfg.page
         dl, hsl = self._dl, self._shard_sl
         dm = self.spec_draft
+        # per-wave k (adaptive speculation): scratch rows and vslot
+        # stride stay sized for spec_k (the max), only nq shrinks
+        k = self.spec_k if k is None else min(int(k), self.spec_k)
         nq_tot = 0
         layout = []
         for seq in seqs:
             L = seq.length
-            nq = min(self.spec_k + 1, seq.remaining)
+            nq = min(k + 1, seq.remaining)
             pbase = L // P
             cnt = sum(((L + i) // P + 1) - pbase for i in range(nq))
             layout.append((seq, L, nq, pbase, cnt))
@@ -872,6 +1016,15 @@ class InferenceEngine:
         with self._lock:
             for seq in [s for s in self._active if s.remaining <= 0]:
                 self._retire_locked(seq)
+        if done:
+            # pool boundary: let an attached controller rebalance its
+            # resource budgets (cached-free shares, admission pressure)
+            ctrl = getattr(self.ctx, "_controller", None)
+            if ctrl is not None:
+                try:
+                    ctrl.poll()
+                except Exception:
+                    pass
         return advanced
 
     def _reap_spec(self, tenant: str, recs, srec=None) -> int:
@@ -883,6 +1036,7 @@ class InferenceEngine:
         queries' private pages release (refcounts make this free)."""
         advanced = 0
         vi = 0  # flat verify-spec index == srec segment index (tp)
+        wave_prop = wave_acc = 0
         for rec in recs:
             seq, nq, g = rec["seq"], rec["nq"], rec["g"]
             pbase, privs = rec["pbase"], rec["privs"]
@@ -918,6 +1072,10 @@ class InferenceEngine:
                 self.stats["spec_proposed"] += nq - 1
                 self.stats["spec_accepted"] += j
             self.scope.record_spec(tenant, proposed=nq - 1, accepted=j)
+            wave_prop += nq - 1
+            wave_acc += j
+        # adaptive speculation: one acceptance sample per verify wave
+        self._spec_observe(tenant, wave_prop, wave_acc)
         return advanced
 
     def step(self) -> int:

@@ -329,10 +329,16 @@ class Router:
                    "burn": float(ad.get("slo_burn_rate") or 0.0),
                    "migrate_pages": 0, "migrate_from": None,
                    "migrate_cls": None}
+            # ptc-pilot: a replica whose controller raised admission
+            # pricing is already shedding load — fold the advertised
+            # pressure into the burn leg so the fleet steers new
+            # placements away BEFORE the replica's /healthz flips
+            press = float(ad.get("admission_pressure") or 0.0)
             base = dict(est_bytes=est,
                         queued_bytes=int(ad.get("queued_bytes") or 0),
                         active_pools=int(ad.get("active_pools") or 0),
-                        burn_rate=row["burn"], econ=self.policy.econ,
+                        burn_rate=row["burn"] + press,
+                        econ=self.policy.econ,
                         mem_gbps=self.policy.mem_gbps)
             cost = placement_cost(shared_bytes=warm * pb,
                                   migrate_bytes=0, **base)

@@ -144,6 +144,7 @@ class _TenantState:
             "submitted": 0, "admitted": 0, "rejected": 0,
             "completed": 0, "failed": 0, "resource_waits": 0,
             "queue_wait_ns": 0, "discounted_bytes": 0, "cancelled": 0,
+            "pressure_inflated_bytes": 0,
         }
 
 
@@ -183,6 +184,11 @@ class Server:
         # digest here so a fleet router can predict warm-prefix hits
         # without scraping full stats()
         self._advertisers: Dict[str, Callable[[], object]] = {}
+        # ptc-pilot admission pricing: per-tenant SLO-burn pressure set
+        # by the controller — a burning tenant's byte estimates inflate
+        # by (1 + pressure), so its queue budget bites EARLIER and load
+        # sheds before /healthz flips for the whole replica
+        self._admission_pressure: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._retired: List[Ticket] = []
@@ -213,6 +219,24 @@ class Server:
         registers its frozen-page key digest here (ptc-route)."""
         self._advertisers[name] = fn
 
+    def set_admission_pressure(self, tenant: str, pressure: float):
+        """Install SLO-burn admission pricing for `tenant` (ptc-pilot):
+        subsequent submits see their byte estimates inflated by
+        (1 + pressure), clamped to [0, 4].  Pressure ~0 removes the
+        entry (free admission).  Unknown tenants are ignored."""
+        p = min(4.0, max(0.0, float(pressure)))
+        with self._lock:
+            if tenant not in self._tenants:
+                return
+            if p < 1e-3:
+                self._admission_pressure.pop(tenant, None)
+            else:
+                self._admission_pressure[tenant] = p
+
+    def admission_pressure(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._admission_pressure)
+
     # ---------------------------------------------------------- fleet
     def healthy(self) -> bool:
         """The /healthz verdict a router polls: False once closed or
@@ -238,6 +262,7 @@ class Server:
             queued = sum(len(t.queue) for t in self._tenants.values())
             queued_bytes = sum(t.queued_bytes
                                for t in self._tenants.values())
+            pressure = max(self._admission_pressure.values(), default=0.0)
         burn = 0.0
         try:
             for st in self.scope.slo_status().values():
@@ -251,6 +276,7 @@ class Server:
             "queue_depth": queued,
             "queued_bytes": queued_bytes,
             "slo_burn_rate": round(burn, 4),
+            "admission_pressure": round(pressure, 4),
         }
         for name, fn in self._advertisers.items():
             try:
@@ -328,6 +354,19 @@ class Server:
             ticket.est_bytes -= applied
             with self._lock:
                 t.counters["discounted_bytes"] += applied
+        # SLO-burn admission pricing (ptc-pilot): a burning tenant's
+        # KNOWN estimates inflate by (1 + pressure), so max_queued_bytes
+        # sheds its load first — applied after the prefix discount (the
+        # discount models real pool bytes; pressure is pure pricing)
+        with self._lock:
+            pressure = self._admission_pressure.get(tenant, 0.0)
+        if pressure > 0 and ticket.est_bytes is not None \
+                and ticket.est_bytes > 0:
+            infl = int(ticket.est_bytes * pressure)
+            if infl:
+                ticket.est_bytes += infl
+                with self._lock:
+                    t.counters["pressure_inflated_bytes"] += infl
         if scope is None:
             ticket.scope_id = self.scope.new_scope(tenant, meta=meta)
             ticket._owns_scope = True

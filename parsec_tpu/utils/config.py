@@ -486,6 +486,57 @@ register("plan.max_instances", 200_000, int,
          "verifier's default): execution spaces past this many "
          "instances degrade to the symbolic interval bounds with an "
          "explicit note instead of silently truncating")
+register("scope.conformance_window", 2048, int,
+         "pools per conformance epoch (profiling/scope.py): the "
+         "fold-only aggregates roll over to a fresh generation every "
+         "this-many retired pools (one previous generation kept), so a "
+         "long soak's conformance rollup reads O(window) state and "
+         "tracks the RECENT plan-vs-measured ratio — what the ptc-pilot "
+         "controller's drift detection needs — instead of a "
+         "run-lifetime average; <= 0 restores the unbounded fold")
+register("control.drift_ratio", 1.25, float,
+         "ptc-pilot drift threshold: the controller declares model "
+         "drift when the median measured/lower-bound makespan ratio "
+         "over its control.window most recent planned pools exceeds "
+         "this value — then re-runs the schedule simulator on the "
+         "recalibrated cost model and hot-swaps the winning knob "
+         "vector at the next pool boundary")
+register("control.window", 8, int,
+         "ptc-pilot observation window, in retired planned pools: "
+         "drift must be sustained across a FULL window before a retune "
+         "fires (single-pool spikes never trigger), and the window "
+         "clears after every evaluation")
+register("control.cooldown", 16, int,
+         "ptc-pilot retune cooldown, in retired pools: after an "
+         "evaluation the controller ignores drift for this many pools "
+         "so a swap's own transient (caches refilling, knobs "
+         "re-binding) cannot trigger an immediate second retune")
+register("control.spec_k_max", 4, int,
+         "ptc-pilot adaptive speculation ceiling: engines built with "
+         "spec_k='auto' size their verify scratch for this k and the "
+         "per-tenant bandit picks 0..max from live acceptance")
+register("control.spec_window", 4, int,
+         "adaptive-speculation acceptance window, in verify waves per "
+         "tenant: shrink/grow decisions read the mean acceptance over "
+         "this many most recent waves")
+register("control.spec_accept_low", 0.45, float,
+         "shrink threshold: a tenant whose windowed draft acceptance "
+         "falls below this fraction has its spec_k halved (floor 1 — "
+         "only page pressure disables speculation outright)")
+register("control.spec_accept_high", 0.80, float,
+         "re-expand threshold: a tenant whose windowed acceptance "
+         "sustains at or above this fraction for a full spec_window "
+         "grows its spec_k by one, up to control.spec_k_max")
+register("control.spec_page_floor", 0.25, float,
+         "page-pressure disable: when the pool's free+cached fraction "
+         "drops below this floor (or a speculative reservation just "
+         "failed), adaptive tenants decode plainly (k=0) until the "
+         "fraction recovers above the floor")
+register("control.budget_min_share", 0.10, float,
+         "dynamic cached-page budgets: the smallest cached-free LRU "
+         "share a tenant can be squeezed to when the controller "
+         "re-weights shares by prefix hit rate (keeps a cold tenant "
+         "from being evicted to zero)")
 register("device.affinity_skew", 4.0, float,
          "data-affinity spill guard for best-device routing: a queue "
          "holding a current mirror of a task's flow wins over pure "

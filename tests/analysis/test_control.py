@@ -1,0 +1,223 @@
+"""ptc-pilot coverage: drift detection determinism under a simulated
+clock, the pool-boundary hot-swap contract (never mid-window), the
+watchdog interrupt path, decision-log replay reproducibility, TuneStore
+persistence of controller winners, and the epoched (O(window), not
+O(run)) conformance aggregates the controller's drift window reads."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.analysis.control import Controller, SimClock
+from parsec_tpu.data.collections import TwoDimBlockCyclic
+from parsec_tpu.utils import params as _mca
+
+
+class _Store:
+    """TuneStore stand-in: records puts, touches no filesystem."""
+
+    def __init__(self):
+        self.puts = []
+
+    def put(self, sig, host, rec):
+        self.puts.append((sig, host, dict(rec)))
+
+
+def _potrf(ctx, nt=6, nb=8):
+    from parsec_tpu.algos.potrf import build_potrf
+    A = TwoDimBlockCyclic(nt * nb, nt * nb, nb, nb, dtype=np.float32)
+    A.register(ctx, "A")
+    return build_potrf(ctx, A)
+
+
+def _ctrl(ctx, **kw):
+    kw.setdefault("clock", SimClock())
+    kw.setdefault("window", 4)
+    kw.setdefault("cooldown", 4)
+    kw.setdefault("drift_ratio", 1.25)
+    kw.setdefault("store", _Store())
+    return Controller(ctx, **kw)
+
+
+# -------------------------------------------------------- drift + swap
+def test_drift_triggers_retune_and_persists():
+    """Sustained ratio > drift_ratio over a full window -> one
+    control_retune decision with before/after predicted makespan, and
+    the winner lands in the (stub) TuneStore under source='control'."""
+    with pt.Context(nb_workers=1) as ctx:
+        tp = _potrf(ctx)
+        ctrl = _ctrl(ctx)
+        ctrl.attach_target(tp, workers=2)
+        for _ in range(4):
+            ctrl.observe_pool(2.0)
+        s = ctrl.stats()
+        assert s["retunes"] == 1 and s["pending"] is True
+        kinds = [d["kind"] for d in ctrl.decision_log()]
+        assert "control_retune" in kinds
+        ret = [d for d in ctrl.decision_log()
+               if d["kind"] == "control_retune"][0]
+        assert ret["before_ns"] > 0 and ret["after_ns"] > 0
+        assert ret["after_ns"] <= ret["before_ns"]
+        assert ret["knobs"], "a retune decision names its knob delta"
+        # persisted through the PR 12 store under the control source
+        assert len(ctrl._store.puts) == 1
+        sig, _host, rec = ctrl._store.puts[0]
+        assert sig and rec["source"] == "control"
+        # mirrored as structured scope events
+        ev = ctx.scope_registry().events("control_retune")
+        assert len(ev) == 1 and ev[0]["knobs"] == ret["knobs"]
+        ctrl.stop()
+
+
+def test_hot_swap_only_at_pool_boundary():
+    """The winning vector does NOT go live inside the evaluation — the
+    knobs hold their old values until the NEXT observe_pool call (the
+    pool boundary), then swap atomically and restore on stop()."""
+    with pt.Context(nb_workers=1) as ctx:
+        tp = _potrf(ctx)
+        ctrl = _ctrl(ctx)
+        ctrl.attach_target(tp, workers=2)
+        before = {k: _mca.get(k) for k in ("runtime.mag_batch",)}
+        for _ in range(4):
+            ctrl.observe_pool(2.0)
+        s = ctrl.stats()
+        assert s["pending"] is True and s["swaps"] == 0
+        # mid-window: nothing applied yet
+        assert {k: _mca.get(k) for k in before} == before
+        ctrl.observe_pool(1.0)  # the next pool boundary
+        s = ctrl.stats()
+        assert s["pending"] is False and s["swaps"] == 1
+        changed = s["last_swap"]["knobs"]
+        assert changed
+        for k, v in changed.items():
+            assert _mca.get(k) == v, k
+        apply_ev = [d for d in ctrl.decision_log()
+                    if d["kind"] == "control_apply"]
+        assert len(apply_ev) == 1 and apply_ev[0]["ok"] is True
+        ctrl.stop()
+        # teardown restores the pre-swap vector
+        assert {k: _mca.get(k) for k in before} == before
+
+
+def test_cooldown_suppresses_immediate_redrift():
+    """After an evaluation the window clears and drift is ignored for
+    `cooldown` pool boundaries — no decision storm on a sustained
+    incident."""
+    with pt.Context(nb_workers=1) as ctx:
+        tp = _potrf(ctx)
+        ctrl = _ctrl(ctx, cooldown=16)
+        ctrl.attach_target(tp, workers=2)
+        for _ in range(12):
+            ctrl.observe_pool(3.0)
+        assert ctrl.stats()["retunes"] == 1
+        ctrl.stop()
+
+
+# ---------------------------------------------------------- interrupts
+def test_watchdog_interrupt_closes_window_immediately():
+    """interrupt('stuck_task') evaluates NOW with a half-full window:
+    the interrupt decision logs, an evaluation follows, and the counter
+    ticks — no waiting for `window` more pools."""
+    with pt.Context(nb_workers=1) as ctx:
+        tp = _potrf(ctx)
+        ctrl = _ctrl(ctx)
+        ctrl.attach_target(tp, workers=2)
+        ctrl.observe_pool(2.0)
+        ctrl.observe_pool(2.0)  # window 2/4: drift cannot fire yet
+        assert ctrl.stats()["retunes"] == 0
+        ctrl.interrupt("stuck_task", key="Pool#1:GEMM(3,2)")
+        s = ctrl.stats()
+        assert s["interrupts"] == 1 and s["retunes"] == 1
+        kinds = [d["kind"] for d in ctrl.decision_log()]
+        assert kinds[0] == "control_interrupt"
+        ret = [d for d in ctrl.decision_log()
+               if d["kind"] == "control_retune"][0]
+        assert ret["trigger"] == "interrupt:stuck_task"
+        ctrl.stop()
+
+
+def test_drift_without_target_logged_not_retuned():
+    """No attach_target -> drift is still detected and logged as a
+    structured decision (target=False), but nothing can be proposed."""
+    with pt.Context(nb_workers=1) as ctx:
+        ctrl = _ctrl(ctx)
+        for _ in range(4):
+            ctrl.observe_pool(9.0)
+        s = ctrl.stats()
+        assert s["retunes"] == 0 and s["pending"] is False
+        drifts = [d for d in ctrl.decision_log()
+                  if d["kind"] == "control_drift"]
+        assert len(drifts) == 1 and drifts[0]["target"] is False
+        ctrl.stop()
+
+
+# -------------------------------------------------------------- replay
+def test_simulated_clock_replay_identical_decision_log():
+    """Determinism contract: two controllers fed the SAME observation
+    sequence under equal SimClocks produce byte-identical decision
+    logs — timestamps, knob deltas, predicted makespans, everything."""
+    seq = [2.0, 1.1, 2.4, 1.9, 2.2, 1.0, 3.0, 2.6, 2.1, 1.3,
+           2.8, 2.2, 1.7, 2.5, 2.0, 1.9]
+
+    def run():
+        with pt.Context(nb_workers=1) as ctx:
+            tp = _potrf(ctx)
+            ctrl = _ctrl(ctx)
+            ctrl.attach_target(tp, workers=2)
+            for i, r in enumerate(seq):
+                if i == 6:
+                    ctrl.interrupt("slow_rank", key="rank1")
+                ctrl.observe_pool(r)
+            log = ctrl.decision_log()
+            ctrl.stop()
+            return log
+
+    a, b = run(), run()
+    assert a, "the sequence must produce decisions"
+    assert a == b
+
+
+# ----------------------------------------- epoched conformance (O(win))
+def test_conformance_epochs_bounded_rollover():
+    """Satellite: the fold-only conformance aggregates roll to a fresh
+    epoch every scope.conformance_window pools (one closed generation
+    kept), so the controller's drift window reads O(window) recent
+    state — pinned: pools never exceeds two windows however long the
+    run, and `epochs` counts the rollovers."""
+    with pt.Context(nb_workers=1) as ctx:
+        reg = ctx.scope_registry()
+        reg.conformance_window = 8
+        plan = {"makespan_lb_ns": 1000, "wire_out_bound_sum": 64,
+                "est_bytes": 256, "per_class_cost": {"GEMM": 1000.0}}
+        for i in range(50):
+            sid = reg.new_scope("t0", kind="decode_step")
+            reg.record_pool_done(sid, plan=dict(plan),
+                                 measured={"wall_ns": 2000})
+        conf = reg.conformance()
+        assert conf["epochs"] == 50 // 8
+        assert 0 < conf["pools"] <= 16, "two windows max, not O(run)"
+        assert conf["coverage"] == 1.0
+        # the recent-window ratio stays live through rollovers
+        assert conf["makespan"]["n"] > 0
+        assert conf["makespan"]["ratio_p50"] == pytest.approx(2.0)
+
+
+def test_record_pool_done_feeds_controller_observe():
+    """ScopeRegistry.record_pool_done IS the controller's clock: each
+    planned pool delivers one makespan ratio observation outside the
+    registry lock."""
+    with pt.Context(nb_workers=1) as ctx:
+        reg = ctx.scope_registry()
+        ctrl = _ctrl(ctx, window=3)
+        plan = {"makespan_lb_ns": 1000}
+        for _ in range(3):
+            sid = reg.new_scope("t0", kind="decode_step")
+            reg.record_pool_done(sid, plan=dict(plan),
+                                 measured={"wall_ns": 5000})
+        s = ctrl.stats()
+        assert s["pools"] == 3
+        # window filled with ratio 5.0 -> drift fired (no target: logged)
+        drifts = [d for d in ctrl.decision_log()
+                  if d["kind"] == "control_drift"]
+        assert len(drifts) == 1
+        assert drifts[0]["makespan_ratio"] == pytest.approx(5.0)
+        ctrl.stop()

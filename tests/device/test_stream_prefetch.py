@@ -70,10 +70,27 @@ def test_unified_stats_schema_single_rank():
         try:
             s = ctx.stats()
             assert set(s) == {"sched", "device", "comm", "coll", "trace",
-                              "metrics", "serve", "plan", "scope"}
+                              "metrics", "serve", "plan", "scope",
+                              "control"}
             # PR 11: request-scope namespace — schema-stable with no
             # registry attached, full rollup once one exists
             assert s["scope"] == {"enabled": False}
+            # PR 19 (ptc-pilot): feedback-controller namespace —
+            # schema-stable with no controller attached, live decision
+            # ledger once one exists
+            assert s["control"] == {"enabled": False}
+            from parsec_tpu.analysis.control import Controller, SimClock
+            ctrl = Controller(ctx, clock=SimClock())
+            cst = ctx.stats()["control"]
+            for k in ("enabled", "pools", "window", "window_n",
+                      "drift_ratio", "drift_now", "retunes", "swaps",
+                      "interrupts", "persisted", "pending", "target",
+                      "decisions", "last_swap", "budget_shares",
+                      "pressure", "spec_k"):
+                assert k in cst, k
+            assert cst["enabled"] is True
+            ctrl.stop()
+            assert ctx.stats()["control"] == {"enabled": False}
             reg_scope = ctx.scope_registry()
             sid = reg_scope.new_scope("t0")
             reg_scope.record_admitted(sid)
@@ -83,7 +100,9 @@ def test_unified_stats_schema_single_rank():
                                "tenants", "slo", "conformance"}
             assert sc["enabled"] is True and sc["requests"] == 1
             conf = sc["conformance"]
-            assert set(conf) == {"pools", "planned", "coverage",
+            # PR 19: `epochs` counts conformance-window rollovers (the
+            # fold-only aggregates stay O(window), not O(run))
+            assert set(conf) == {"pools", "planned", "epochs", "coverage",
                                  "makespan", "comm_bytes", "residency",
                                  "spills", "per_class"}
             for k in ("predicted_sum", "measured", "sound"):

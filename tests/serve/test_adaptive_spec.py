@@ -1,0 +1,118 @@
+"""ptc-pilot adaptive speculation (spec_k="auto"): per-tenant
+bandit-over-k driven by acceptance windows — shrinks against an
+adversarial draft, pauses under PagePool pressure, grows back on
+sustained acceptance, and (the hard invariant) emits BIT-IDENTICAL
+token/output streams at every k, fixed or adaptive."""
+import numpy as np
+import pytest
+
+import parsec_tpu as pt
+from parsec_tpu.serve.engine import (InferenceEngine, PagedLM,
+                                     PagedLMConfig)
+from parsec_tpu.serve.server import TenantConfig
+from parsec_tpu.utils import params as _mca
+
+
+def _model(seed=5):
+    return PagedLM(PagedLMConfig(vocab=24, d=8, page=4, seed=seed))
+
+
+def _run(spec_k, spec_draft="self", prompts=((1, 2, 3, 4, 5),),
+         max_new=24, n_pages=96, tenants=("default",), floor=None):
+    old_floor = _mca.get("control.spec_page_floor")
+    if floor is not None:
+        _mca.set("control.spec_page_floor", floor)
+    try:
+        with pt.Context(nb_workers=2, scheduler="lws") as ctx:
+            model = _model()
+            eng = InferenceEngine(
+                ctx, model, n_pages=n_pages, max_seqs=8,
+                tenants=[TenantConfig(t) for t in tenants],
+                spec_k=spec_k, spec_draft=spec_draft)
+            reqs = [eng.submit(list(p), max_new,
+                               tenant=tenants[i % len(tenants)])
+                    for i, p in enumerate(prompts)]
+            eng.run(timeout_s=120)
+            toks = [list(r.tokens) for r in reqs]
+            snap = eng.spec_k_snapshot()
+            stats = eng._spec_stats()
+            events = [dict(e) for e in
+                      ctx.scope_registry().events("control_spec")]
+            return toks, snap, stats, events
+    finally:
+        _mca.set("control.spec_page_floor", old_floor)
+
+
+def test_bit_identical_outputs_at_every_k():
+    """The acceptance rule only ever keeps target-argmax-confirmed
+    tokens, so k=0 (plain decode), every fixed k and adaptive mode all
+    emit the same stream — even under an adversarial draft."""
+    adv = _model(seed=99)
+    base, _, _, _ = _run(0)
+    for spec_k in (1, 2, 4, "auto"):
+        for draft in ("self", adv):
+            toks, _, _, _ = _run(spec_k, spec_draft=draft)
+            assert toks == base, (spec_k, draft)
+
+
+def test_adaptive_shrinks_on_adversarial_draft():
+    """A draft that never agrees with the target drives acceptance to
+    ~0: the bandit halves k window-by-window down to 1, logging one
+    structured control_spec decision per move."""
+    toks, snap, stats, events = _run("auto", spec_draft=_model(seed=99),
+                                     max_new=40)
+    assert snap["auto"] is True and snap["max"] >= 2
+    assert snap["tenants"]["default"] == 1
+    assert stats["accept_rate"] < 0.05
+    moves = [(e["k_from"], e["k_to"], e["reason"]) for e in events]
+    assert all(r == "accept_low" for _, _, r in moves)
+    assert [m[1] for m in moves][-1] == 1
+    for frm, to, _ in moves:
+        assert to < frm
+
+
+def test_adaptive_holds_max_k_on_oracle_draft():
+    """spec_draft='self' is the oracle (acceptance 1.0): adaptive mode
+    must keep every tenant at k_max — no spurious shrink decisions."""
+    toks, snap, stats, events = _run("auto", max_new=40)
+    assert snap["tenants"]["default"] == snap["max"]
+    assert stats["accept_rate"] == pytest.approx(1.0)
+    assert events == []
+
+
+def test_adaptive_disables_under_page_pressure():
+    """With the free-page floor raised above what the pool can ever
+    satisfy, speculation pauses (k=0 -> plain decode, zero verify
+    waves) instead of competing with sequences for pages — and the
+    stream is still exact."""
+    base, _, _, _ = _run(0)
+    toks, snap, stats, events = _run("auto", floor=1.5)
+    assert toks == base
+    assert stats["steps"] == 0 and stats["proposed"] == 0
+    assert snap["tenants"]["default"] == 0
+    assert any(e["reason"] == "page_pressure" and e["k_to"] == 0
+               for e in events)
+
+
+def test_per_tenant_k_independent():
+    """Two tenants, one oracle-like and one adversarial?  Both share
+    the engine but not the bandit: acceptance windows are per tenant,
+    so one tenant's bad draft cannot shrink another's k.  (A single
+    draft model serves both here, so we pin the weaker property that
+    holds structurally: state, windows and snapshots are per-tenant.)"""
+    toks, snap, stats, _ = _run(
+        "auto", prompts=((1, 2, 3, 4, 5), (6, 7, 8, 9)),
+        tenants=("a", "b"), max_new=24)
+    assert set(snap["tenants"]) == {"a", "b"}
+    assert set(stats["k_by_tenant"]) == {"a", "b"}
+    # oracle self-draft: both independently hold k_max
+    assert all(k == snap["max"] for k in snap["tenants"].values())
+
+
+def test_fixed_k_unaffected_by_auto_plumbing():
+    """spec_k=2 still behaves exactly as before ptc-pilot: no bandit
+    state mutations, no control_spec events, k reported fixed."""
+    toks, snap, stats, events = _run(2, max_new=24)
+    assert snap["auto"] is False and snap["max"] == 2
+    assert stats["auto"] is False
+    assert events == []

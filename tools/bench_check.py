@@ -130,6 +130,20 @@ CHECKS = [
     ("BENCH_stream.json", "tuned.tuned_vs_default", "lower", 0.25,
      True),
     ("BENCH_stream.json", "tuned.beats_default", "equal", 0.0, False),
+    # ptc-pilot (PR 19): the drift-soak recovery ratio is a timing
+    # trajectory row (oversubscription-slacked), but the in-document
+    # `recovered` verdict (>= 50% of incident-lost throughput clawed
+    # back by the hot-swap, no restart) is an equal-direction
+    # correctness flag — never relaxed — as are the adaptive-vs-fixed
+    # spec_k verdict (deterministic wave/waste counts, not wall time)
+    # and the every-k bit-identity of the token streams
+    ("BENCH_control.json", "soak.recovery_ratio", "higher", 0.50, True),
+    ("BENCH_control.json", "soak.recovered", "equal", 0.0, False),
+    ("BENCH_control.json", "spec.adaptive_ge_best_fixed", "equal", 0.0,
+     False),
+    ("BENCH_control.json", "spec.bit_identical", "equal", 0.0, False),
+    ("BENCH_control.json", "spec.adaptive_score", "higher", 0.25,
+     False),
     # ptc-topo (PR 17): bit_identical and predicted_sound are
     # equal-direction correctness flags — the remapped run and the
     # hierarchical collectives must stay bit-exact and the plan's

@@ -78,9 +78,11 @@ def render_live(samples):
     tenants = {}
     conf = None
     fleet = None
+    control = None
     for rank in sorted(samples):
         rec = samples[rank]
         fleet = rec.get("fleet") or fleet
+        control = rec.get("control") or control
         w = rec.get("workers") or []
         lines.append(
             f"rank {rank}: t={rec.get('t', '?')}s "
@@ -160,6 +162,40 @@ def render_live(samples):
             f"conformance: coverage={_fmt(conf.get('coverage'))} "
             f"makespan_ratio_p50={_fmt(conf.get('makespan_ratio_p50'))} "
             f"comm_bound={_fmt(conf.get('comm_sound'))}")
+    if control and control.get("enabled"):
+        # ptc-pilot controller panel: drift vs threshold, the retune /
+        # hot-swap ledger and the live per-tenant resource levers
+        lines.append("")
+        lines.append(
+            f"control: drift={_fmt(control.get('drift_now'))}"
+            f"/{_fmt(control.get('drift_ratio'))} "
+            f"window={control.get('window_n', 0)}"
+            f"/{control.get('window', 0)} "
+            f"retunes={control.get('retunes', 0)} "
+            f"swaps={control.get('swaps', 0)} "
+            f"interrupts={control.get('interrupts', 0)} "
+            f"decisions={control.get('decisions', 0)}")
+        last = control.get("last_swap")
+        if last:
+            lines.append(
+                f"  last swap [{last.get('trigger')}]: "
+                f"{_fmt((last.get('before_ns') or 0) / 1e6)}ms -> "
+                f"{_fmt((last.get('after_ns') or 0) / 1e6)}ms "
+                f"knobs={','.join(sorted(last.get('knobs') or {}))}")
+        spec = control.get("spec_k") or {}
+        if spec.get("auto"):
+            ks = " ".join(f"{t}={k}" for t, k in
+                          sorted((spec.get("tenants") or {}).items()))
+            lines.append(f"  spec_k[auto max={spec.get('max')}]: "
+                         f"{ks or '-'}")
+        shares = control.get("budget_shares") or {}
+        if shares:
+            lines.append("  cache shares: " + " ".join(
+                f"{t}={_fmt(v)}" for t, v in sorted(shares.items())))
+        press = control.get("pressure") or {}
+        if press:
+            lines.append("  admission pressure: " + " ".join(
+                f"{t}={_fmt(v)}" for t, v in sorted(press.items())))
     return "\n".join(lines)
 
 

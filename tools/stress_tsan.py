@@ -568,6 +568,130 @@ def fleet_churn(workers: int, reqs_per_thread: int = 5,
                 os.environ[k] = v
 
 
+def control_churn(workers: int, reqs_per_thread: int = 5,
+                  env=None) -> None:
+    """ptc-pilot churn (PR 19): a live InferenceEngine with ADAPTIVE
+    speculation (spec_k='auto') and a feedback Controller bound to it,
+    under concurrent fire from every side at once — submitter threads
+    on two tenants (per-tenant bandit windows + the page-pressure
+    pause/resume gate against a small pool), an observer thread feeding
+    drifted makespan ratios and watchdog interrupts (retune evaluation
+    + the pool-boundary hot-swap's hold_knobs snapshot/restore racing
+    everything), and a scraper hammering ctrl.stats() /
+    Context.stats()['control'] / ctrl.poll() (budget-share pushes into
+    the pool and admission-pressure pushes into the server) while the
+    driver runs the continuous-batching loop (whose _reap also calls
+    poll).  TSan watches the controller lock against the engine, pool,
+    server and scope locks in one address space; a final bit-exactness
+    spot check keeps the adaptive path honest."""
+    import threading
+    import time
+
+    from parsec_tpu.analysis.control import Controller
+    from parsec_tpu.serve import (InferenceEngine, PagedLM,
+                                  PagedLMConfig, TenantConfig)
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        model = PagedLM(PagedLMConfig(vocab=24, d=8, page=4, seed=5))
+        rng0 = np.random.RandomState(7)
+        common = [list(rng0.randint(0, 24, size=10)) for _ in range(3)]
+        with pt.Context(nb_workers=workers, scheduler="lws") as ctx:
+            ctrl = Controller(ctx, window=4, cooldown=2,
+                              drift_ratio=1.25)
+            # a small never-run chain as the retune target: evaluate()
+            # re-simulates it concurrently with the serving loop
+            ctx.register_arena("t", 8)
+            tp = pt.Taskpool(ctx, globals={"NB": 199})
+            kk = pt.L("k")
+            tc = tp.task_class("Task")
+            tc.param("k", 0, pt.G("NB"))
+            tc.flow("A", "RW",
+                    pt.In(None, guard=(kk == 0)),
+                    pt.In(pt.Ref("Task", kk - 1, flow="A")),
+                    pt.Out(pt.Ref("Task", kk + 1, flow="A"),
+                           guard=(kk < pt.G("NB"))),
+                    arena="t")
+            tc.body_noop()
+            ctrl.attach_target(tp, workers=workers)
+            eng = InferenceEngine(          # auto-binds to ctx._controller
+                ctx, model, n_pages=40, max_seqs=8,
+                tenants=[TenantConfig("hi", priority=4, weight=3,
+                                      max_pools=4, max_queue=128),
+                         TenantConfig("lo", max_pools=4,
+                                      max_queue=128)],
+                spec_k="auto")
+            handles, hlock = [], threading.Lock()
+
+            def submitter(tenant, seed):
+                rng = np.random.RandomState(seed)
+                for _ in range(reqs_per_thread):
+                    c = common[rng.randint(len(common))]
+                    h = eng.submit(c[:rng.randint(4, 11)],
+                                   int(rng.randint(2, 5)), tenant)
+                    with hlock:
+                        handles.append(h)
+
+            stop = threading.Event()
+
+            def observer():
+                i = 0
+                while not stop.is_set():
+                    ctrl.observe_pool(2.5 if i % 3 else 0.9)
+                    if i % 17 == 11:
+                        ctrl.interrupt("stuck_task", key=f"Pool#{i}")
+                    i += 1
+                    stop.wait(0.002)
+
+            def scraper():
+                while not stop.is_set():
+                    ctrl.stats()
+                    ctx.stats()["control"]
+                    ctrl.poll()
+                    stop.wait(0.004)
+
+            subs = [threading.Thread(target=submitter, args=(t, s))
+                    for s, t in enumerate(("hi", "lo", "hi", "lo"))]
+            obs = threading.Thread(target=observer, daemon=True)
+            scr = threading.Thread(target=scraper, daemon=True)
+            obs.start()
+            scr.start()
+            for t in subs:
+                t.start()
+            deadline = time.monotonic() + 300
+            while any(t.is_alive() for t in subs) or eng.pending() \
+                    or eng._inflight:
+                assert time.monotonic() < deadline, "churn deadlocked"
+                eng.run(timeout_s=240)
+                time.sleep(0.001)
+            for t in subs:
+                t.join(timeout=60)
+            stop.set()
+            obs.join(timeout=10)
+            scr.join(timeout=10)
+            s = ctrl.stats()
+            assert s["retunes"] >= 1, s
+            assert s["decisions"] >= 1, s
+            with hlock:
+                done = [h for h in handles if h.state == "done"]
+                assert len(done) == len(handles), \
+                    [(h.state, h.tenant) for h in handles]
+            for h in done[:4]:
+                rt, ro = model.reference_generate(h.prompt, h.max_new)
+                assert h.tokens == rt
+                assert np.array_equal(np.stack(h.outputs), ro)
+            eng.close()
+            ctrl.stop()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def serve_churn(workers: int, port: int, pools_per_tenant: int = 24,
                 env=None) -> None:
     """Serving-runtime stress under a 2-rank context (one process, a
@@ -987,6 +1111,10 @@ def main():
         # scored placement + cross-pool page migration racing both
         # engines' freeze/acquire/eviction churn and stats scrapes
         fleet_churn(workers=4)
+        # ptc-pilot (PR 19): feedback controller vs the serving loop —
+        # drift observations, interrupts and hot-swaps racing adaptive
+        # speculation, budget-share/pressure pushes and stats scrapes
+        control_churn(workers=4)
         # wave mega-kernelization (PR 13): fuse cache + online
         # certification on the device manager threads, prefetch-lane
         # peeks, and streamed wire deliveries, 2 colocated ranks
