@@ -162,11 +162,20 @@ bench-check:
 tune-check: $(LIB)
 	python tools/ptc_tune.py --check
 
+# ptc-blackbox smoke: the postmortem assembler over a committed
+# fixture (two survivor journals for a 3-rank incident) must produce a
+# byte-stable incident report — dead rank, first cause, holdings.
+# Deterministic, no runtime needed; exit 1 = report drift.
+postmortem-smoke:
+	python tools/ptc_postmortem.py tests/data/blackbox_fixture \
+		--expect tests/data/blackbox_fixture/expected.json > /dev/null
+
 # Default check recipe: bench-trajectory guard + graph hygiene (verify
-# + plan + tune baselines) + native lint — regressions in any fail fast.
-check: bench-check verify-graphs plan-graphs tune-check tidy
+# + plan + tune baselines) + postmortem smoke + native lint —
+# regressions in any fail fast.
+check: bench-check verify-graphs plan-graphs tune-check postmortem-smoke tidy
 
 .PHONY: all clean tsan ubsan tidy verify-graphs plan-graphs tune-check \
 	check bench-comm bench-dispatch bench-device bench-stream \
 	bench-collective bench-trace bench-serve bench-topo \
-	bench-control bench-check
+	bench-control bench-check postmortem-smoke

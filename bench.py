@@ -202,6 +202,58 @@ def bench_trace_suite(tasks: int = 20000, reps: int = 5,
     # an adjacent pair keeps the comparison controlled
     met_on_wall = run(0, 0)[0]
     met_off_wall = run(0, 0, metrics=False)[0]
+
+    # ptc-blackbox pair, also adjacent: the same level-0 chain with a
+    # live Journal attached (cadence thread, crash handler armed,
+    # fsync cadence ticking) vs without.  The recorder must be
+    # invisible to the dispatch hot path (<= 1.05); the per-record
+    # append cost of the buffered record() API rides along.
+    import tempfile
+    from parsec_tpu.profiling.blackbox import Journal
+
+    def run_journal(enabled):
+        best = None
+        for _ in range(reps):
+            with tempfile.TemporaryDirectory() as td, \
+                    pt.Context(nb_workers=1) as ctx:
+                jr = Journal(ctx, dirpath=td, fsync_s=0.2,
+                             checkpoint_s=0.5) if enabled else None
+                ctx.register_arena("t", 8)
+                tp = pt.Taskpool(ctx, globals={"NB": tasks - 1})
+                k = pt.L("k")
+                tc = tp.task_class("Task")
+                tc.param("k", 0, pt.G("NB"))
+                tc.flow("A", "RW",
+                        pt.In(None, guard=(k == 0)),
+                        pt.In(pt.Ref("Task", k - 1, flow="A")),
+                        pt.Out(pt.Ref("Task", k + 1, flow="A"),
+                               guard=(k < pt.G("NB"))),
+                        arena="t")
+                tc.body_noop()
+                t0 = time.perf_counter()
+                tp.run()
+                tp.wait()
+                dt = time.perf_counter() - t0
+                if jr is not None:
+                    jr.stop()
+            if best is None or dt < best:
+                best = dt
+        return best
+
+    jr_on_wall = run_journal(True)
+    jr_off_wall = run_journal(False)
+    n_recs = 50000
+    with tempfile.TemporaryDirectory() as td, \
+            pt.Context(nb_workers=1) as ctx:
+        jr = Journal(ctx, dirpath=td, fsync_s=0.2, checkpoint_s=1e9,
+                     arm_crash=False)
+        t0 = time.perf_counter()
+        for i in range(n_recs):
+            jr.record("serve", op="admit", tenant="bench", scope_id=i)
+            if i % 8192 == 0:
+                jr.flush(fsync=False)  # keep the pending list bounded
+        rec_wall = time.perf_counter() - t0
+        jr.stop()
     per = {lv: walls[lv] / tasks * 1e9 for lv in walls}
     ring_per = ring_wall / tasks * 1e9
     met_on_per = met_on_wall / tasks * 1e9
@@ -218,6 +270,19 @@ def bench_trace_suite(tasks: int = 20000, reps: int = 5,
             "ns_per_task_off": round(met_off_per, 1),
             "overhead_ratio": (round(met_on_per / met_off_per, 3)
                                if met_off_per else None),
+        },
+        "journal": {
+            # level-0 chain with a live recorder vs without (adjacent
+            # pair); the acceptance gate is <= 1.05
+            "ns_per_task_on": round(jr_on_wall / tasks * 1e9, 1),
+            "ns_per_task_off": round(jr_off_wall / tasks * 1e9, 1),
+            "overhead_ratio": (round(jr_on_wall / jr_off_wall, 3)
+                               if jr_off_wall else None),
+            "within_gate": bool(jr_off_wall
+                                and jr_on_wall / jr_off_wall <= 1.05),
+            # buffered record() append cost (format + list push; the
+            # cadence thread owns the disk)
+            "ns_per_record": round(rec_wall / n_recs * 1e9, 1),
         },
         "overhead_ns_per_task": {
             "level1": round(per[1] - per[0], 1),
@@ -2338,6 +2403,17 @@ def _fleet_bench_section(model, workers=2, groups=3, per_group=4,
         fleet_wall = time.perf_counter() - t0
         fleet_stats = [r.pool.stats() for r in reps]
         rstats = router.stats()
+        # ptc-blackbox: FleetView federation cost over these replicas
+        # (merge of every tenant histogram + replica advertise), the
+        # price of one /fleet.json refresh
+        from parsec_tpu.profiling.blackbox import FleetView
+        fv = FleetView(servers=[r.server for r in reps], start=False)
+        n_scrapes = 20
+        t0 = time.perf_counter()
+        for _ in range(n_scrapes):
+            fv.scrape_once()
+        scrape_ms = (time.perf_counter() - t0) / n_scrapes * 1e3
+        fv.stop()
         router.close()
     finally:
         for c in ctxs:
@@ -2364,6 +2440,7 @@ def _fleet_bench_section(model, workers=2, groups=3, per_group=4,
         "migrated_pages": rstats["router"]["migrated_pages"],
         "migrated_bytes": rstats["router"]["migrated_bytes"],
         "bit_identical": bit_identical,
+        "fleet_scrape_ms": round(scrape_ms, 3),
     }
 
 

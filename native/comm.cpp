@@ -136,6 +136,12 @@ enum {
                         ptc_met_serialize body to rank 0 after each
                         quiesced fence; rank 0 keeps the latest per
                         peer for ptc_metrics_snapshot(merged=1) */
+  MSG_BLOB = 16,      /* inventory-blob broadcast (control frame, like
+                        MSG_METRICS — never dirties a fence): opaque
+                        bytes pushed by ptc_comm_share_blob; every
+                        receiver keeps the LATEST blob per peer so a
+                        survivor still holds a SIGKILLed rank's last
+                        checkpoint (ptc-blackbox journal inventory) */
 };
 
 /* ACTIVATE payload kinds (reference: short/eager piggy-back vs GET
@@ -148,8 +154,16 @@ enum {
                     served from / delivered to the device layer */
   PK_PARKED_DEVICE = 9, /* parked-frame only (never on the wire): a
                     resolved by-ref delivery whose pool was unknown —
-                    [u64 device_uid][u64 alloc_len], bytes live in the
-                    device cache */
+                    [u64 device_uid][u64 alloc_len][u32 true_src],
+                    bytes live in the device cache */
+  PK_PARKED_EAGER = 10, /* parked-frame only: an eager/CTL activation
+                    whose pool was unknown — [u32 true_src][u64 plen]
+                    [payload].  The parked frame's `from` stays
+                    UINT32_MAX (replay never pulls); true_src rides
+                    inside so the replayed delivery's COMM_RECV still
+                    carries the real (src, corr) flow key and merged
+                    traces match it (SPMD-skew parks used to orphan
+                    the flow) */
 };
 
 /* Device-plane tags (allocated by the device layer's own counter) and
@@ -518,6 +532,10 @@ struct CommEngine {
    * this, every clean SPMD teardown logs 'connection lost' noise that
    * masks real failures (judge r4 weak #3). */
   std::vector<uint8_t> fin_seen;
+  /* latest MSG_BLOB inventory per peer (ptc-blackbox: a survivor's
+   * copy of what each rank last checkpointed; ce->lock guards it).
+   * Slot myrank holds this rank's own latest share. */
+  std::vector<std::vector<uint8_t>> peer_blobs;
   /* fence/TD wave timeout (PTC_MCA_comm_fence_timeout_s; 0 = infinite —
    * the default: a slow-but-alive peer must not fail a collective;
    * crashed peers are caught by peer_lost fail-fast) */
@@ -607,7 +625,8 @@ static void comm_post_msg(CommEngine *ce, uint32_t rank, OutMsg &&msg,
   bool is_ctl = msg.hdr.size() > 4 &&
                 (msg.hdr[4] == MSG_FENCE || msg.hdr[4] == MSG_TD ||
                  msg.hdr[4] == MSG_FINI || msg.hdr[4] == MSG_PING ||
-                 msg.hdr[4] == MSG_PONG || msg.hdr[4] == MSG_METRICS);
+                 msg.hdr[4] == MSG_PONG || msg.hdr[4] == MSG_METRICS ||
+                 msg.hdr[4] == MSG_BLOB);
   if (!is_ctl) {
     /* activity ticks before the transport enqueues: a fence snapshot
      * must never see the queued frame but miss the count (the transport
@@ -815,8 +834,8 @@ static void deliver_targets(ptc_context *ctx, ptc_taskpool *tp,
   if (alloc_len == 0) alloc_len = plen;
   /* ONE COMM_RECV per delivered frame, keyed (src, corr) in l0/l1 to
    * mirror the producer's COMM_SEND (dst, corr) — the merged-trace flow
-   * pair (tracing v2).  Parked replays lost their true src (UINT32_MAX
-   * sign-extends to -1-ish l0): they stay unmatched, which is honest. */
+   * pair (tracing v2).  SPMD-skew parks carry the true src inside the
+   * parked body (PK_PARKED_*), so replayed deliveries match too. */
   ptc_prof_instant(ctx, PROF_KEY_COMM_RECV,
                    targets.empty() ? -1 : (int64_t)targets[0].class_id,
                    src_rank == UINT32_MAX ? -1 : (int64_t)src_rank,
@@ -1072,12 +1091,14 @@ static void deliver_or_park(ptc_context *ctx, int32_t tp_id, int32_t flow_idx,
         w.u8(PK_PARKED_DEVICE);
         w.u64((uint64_t)device_uid);
         w.u64(alloc_len);
+        w.u32(src_rank); /* true src: the replayed COMM_RECV keeps its
+                          * flow key even though `from` is the parked
+                          * sentinel */
       } else {
-        w.u8(plen ? PK_EAGER : PK_NONE);
-        if (plen) {
-          w.u64(plen);
-          w.raw(payload, (size_t)plen);
-        }
+        w.u8(PK_PARKED_EAGER);
+        w.u32(src_rank);
+        w.u64(plen);
+        if (plen) w.raw(payload, (size_t)plen);
       }
       ctx->tp_early[tp_id].push_back(std::move(parked));
       return;
@@ -1150,11 +1171,33 @@ static void handle_activate_body(CommEngine *ce, ptc_context *ctx,
     }
     uint64_t uid = r.u64();
     uint64_t alloc_len = r.u64();
+    uint32_t true_src = r.u32(); /* the pre-park sender (trace flow key) */
     if (!r.ok) return;
     deliver_or_park(ctx, tp_id, flow_idx, targets_start,
                     (size_t)(targets_end - targets_start), nullptr, 0,
                     (int64_t)uid, allow_park, alloc_len, shaped, nullptr,
-                    from, corr, scope);
+                    true_src, corr, scope);
+    return;
+  }
+  case PK_PARKED_EAGER: {
+    /* parked-frame replay of an eager/CTL activation: like
+     * PK_PARKED_DEVICE, never valid from the network — the true sender
+     * rides inside the parked body, `from` must be the park sentinel */
+    if (from != UINT32_MAX) {
+      std::fprintf(stderr, "ptc-comm: PK_PARKED_EAGER from the wire "
+                           "(rank %u) dropped\n", from);
+      return;
+    }
+    uint32_t true_src = r.u32();
+    uint64_t plen = r.u64();
+    if (!r.ok || (size_t)(r.end - r.p) < plen) {
+      std::fprintf(stderr, "ptc-comm: malformed ACTIVATE frame dropped\n");
+      return;
+    }
+    deliver_or_park(ctx, tp_id, flow_idx, targets_start,
+                    (size_t)(targets_end - targets_start),
+                    plen ? r.p : nullptr, plen, 0, allow_park, 0, shaped,
+                    nullptr, true_src, corr, scope);
     return;
   }
   case PK_GET:
@@ -2080,7 +2123,8 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
   if (from < ce->peer_stats.size())
     ce->peer_stats[from].msgs_recv.fetch_add(1, std::memory_order_relaxed);
   if (type != MSG_FENCE && type != MSG_TD && type != MSG_FINI &&
-      type != MSG_PING && type != MSG_PONG && type != MSG_METRICS)
+      type != MSG_PING && type != MSG_PONG && type != MSG_METRICS &&
+      type != MSG_BLOB)
     ce->app_recv.fetch_add(1, std::memory_order_relaxed);
   switch (type) {
   case MSG_ACTIVATE:
@@ -2150,6 +2194,12 @@ static void handle_frame(CommEngine *ce, uint32_t from, uint8_t type,
     int64_t offset = r.i64();
     if (r.ok)
       ptc_met_absorb(ctx, from, rtt, offset, r.p, (size_t)(r.end - r.p));
+    break;
+  }
+  case MSG_BLOB: { /* keep the sender's LATEST inventory blob */
+    std::lock_guard<ptc_mutex> g(ce->lock);
+    if (from < ce->peer_blobs.size())
+      ce->peer_blobs[from].assign(body, body + len);
     break;
   }
   case MSG_PING: { /* RTT probe: echo the body back + our clock sample */
@@ -3383,6 +3433,7 @@ int32_t ptc_comm_init(ptc_context_t *ctx, int32_t base_port) {
   ce->td_info.resize(ctx->nodes);
   ce->peer_lost.assign(ctx->nodes, 0);
   ce->fin_seen.assign(ctx->nodes, 0);
+  ce->peer_blobs.assign(ctx->nodes, {});
   ce->ops = ce_select(std::getenv("PTC_MCA_comm_engine"));
   if (!ce->ops) {
     delete ce;
@@ -3844,6 +3895,67 @@ int64_t ptc_comm_clock_sync(ptc_context_t *ctx) {
   if (!ce) return 0;
   clock_sync_probe(ce, /*wait=*/true);
   return (int64_t)ce->clock_samples.load(std::memory_order_relaxed);
+}
+
+/* ---- inventory-blob replication (ptc-blackbox) ----
+ * Push this rank's latest inventory blob (opaque bytes; the journal
+ * ships JSON) to every live peer as a MSG_BLOB control frame.  Safe
+ * from any app thread (comm_post is); control frames never dirty a
+ * fence.  The local slot is updated too so peer_blob(myrank) works. */
+int32_t ptc_comm_share_blob(ptc_context_t *ctx, const void *buf,
+                            int64_t len) {
+  CommEngine *ce = ctx->comm;
+  if (!ce || !buf || len < 0) return -1;
+  const uint8_t *p = (const uint8_t *)buf;
+  for (uint32_t r = 0; r < ce->nodes; r++) {
+    if (r == ce->myrank) continue;
+    bool lost;
+    {
+      std::lock_guard<ptc_mutex> g(ce->lock);
+      lost = r < ce->peer_lost.size() && ce->peer_lost[r];
+    }
+    if (lost) continue;
+    std::vector<uint8_t> f = frame_begin(MSG_BLOB);
+    Writer w{f};
+    w.raw(p, (size_t)len);
+    frame_finish(f);
+    comm_post(ce, r, std::move(f));
+  }
+  {
+    std::lock_guard<ptc_mutex> g(ce->lock);
+    if (ce->myrank < ce->peer_blobs.size())
+      ce->peer_blobs[ce->myrank].assign(p, p + len);
+  }
+  return 0;
+}
+
+/* Copy out the latest blob received from `rank` (or this rank's own
+ * last share when rank == myrank).  Returns the blob's FULL length (0
+ * = none yet; re-call with a bigger buffer when it exceeds cap). */
+int64_t ptc_comm_peer_blob(ptc_context_t *ctx, int32_t rank, void *out,
+                           int64_t cap) {
+  CommEngine *ce = ctx->comm;
+  if (!ce || rank < 0) return -1;
+  std::lock_guard<ptc_mutex> g(ce->lock);
+  if ((size_t)rank >= ce->peer_blobs.size()) return -1;
+  const std::vector<uint8_t> &b = ce->peer_blobs[(size_t)rank];
+  int64_t n = std::min((int64_t)b.size(), cap);
+  if (out && n > 0) std::memcpy(out, b.data(), (size_t)n);
+  return (int64_t)b.size();
+}
+
+/* Export the peer-loss flags (1 = connection died outside shutdown).
+ * The journal cadence polls this to stamp peer_loss records. */
+int32_t ptc_comm_peers_lost(ptc_context_t *ctx, int64_t *out, int32_t cap) {
+  CommEngine *ce = ctx->comm;
+  if (!ce || !out) return 0;
+  std::lock_guard<ptc_mutex> g(ce->lock);
+  int32_t n = (int32_t)ce->nodes;
+  if (n > cap) n = cap;
+  for (int32_t r = 0; r < n; r++)
+    out[r] =
+        ((size_t)r < ce->peer_lost.size() && ce->peer_lost[r]) ? 1 : 0;
+  return n;
 }
 
 /* PROGRESSIVE SERVE d2h hook (wire v4 streaming): the device layer's

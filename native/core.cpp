@@ -35,6 +35,10 @@
 #include <sched.h>
 #endif
 #include <cstdlib>
+#include <cstring>
+#include <csignal>
+#include <fcntl.h>
+#include <unistd.h>
 #if defined(__x86_64__)
 #include <x86intrin.h>
 #endif
@@ -2058,11 +2062,176 @@ void ptc_tp_abort_internal(ptc_context *ctx, ptc_taskpool *tp) {
   tp_abort(ctx, tp);
 }
 
+/* ---- crash-durable flight recorder (ptc-blackbox) ----
+ * On SIGSEGV/SIGABRT/SIGBUS an async-signal-safe handler write()s the
+ * flight-recorder ring tail + an inflight-slots snapshot to the armed
+ * path (<journal dir>/crash.<rank>.ptt) before re-raising, so a fatal
+ * native fault leaves the same artifact the journal's peer-loss path
+ * leaves on survivors.  The .ptt header is PREFORMATTED on the normal
+ * path (arm / update_meta on the journal cadence) because snprintf and
+ * malloc are off-limits in the handler. */
+namespace {
+
+struct CrashState {
+  std::atomic<ptc_context *> ctx{nullptr};
+  char path[512] = {0};
+  /* handler reads hdr/hlen without a lock: a torn read during a racing
+   * update_meta costs header fields in the artifact, never event words
+   * (best-effort by design; meta_lock serializes the writers) */
+  char hdr[512] = {0};
+  std::atomic<int32_t> hlen{0};
+  std::atomic<bool> fired{false};
+  std::mutex meta_lock;
+  bool installed = false;
+  struct sigaction prev[3] = {};
+};
+CrashState g_crash;
+const int k_crash_sigs[3] = {SIGSEGV, SIGABRT, SIGBUS};
+
+/* (re)format the preformatted header; g_crash.meta_lock held */
+void crash_format_header(ptc_context *ctx) {
+  int64_t clock[4] = {0, 0, 0, 0};
+  ptc_comm_clock_stats(ctx, clock);
+  int n = std::snprintf(
+      g_crash.hdr, sizeof g_crash.hdr,
+      "{\"rank\": %u, \"dictionary\": {}, \"class_names\": [], "
+      "\"meta\": {\"flight\": 1, \"crash\": 1, \"dropped_events\": %lld, "
+      "\"ring_bytes\": %lld, \"clock_offset_ns\": %lld, "
+      "\"clock_err_ns\": %lld}}",
+      ctx->myrank, (long long)ptc_profile_dropped(ctx),
+      (long long)ctx->trace_ring_bytes.load(std::memory_order_relaxed),
+      (long long)clock[0], (long long)clock[1]);
+  g_crash.hlen.store((n > 0 && n < (int)sizeof g_crash.hdr) ? n : 0,
+                     std::memory_order_release);
+}
+
+/* The async-signal-safe writer: open/write/close only.  ProfBuf locks
+ * are taken with a BOUNDED spin — the crashed thread may itself be the
+ * lock holder — and on timeout the buffer is written anyway: records
+ * are 8-word aligned, so a torn in-progress append costs at most one
+ * garbage event, which readers drop by key range.  ptc_now_ns here is
+ * a TSC read (calibration ran at the first trace event, long before). */
+void crash_write(ptc_context *ctx) {
+  int fd = ::open(g_crash.path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  const char magic[8] = {'#', 'P', 'T', 'C', 'P', 'R', 'O', 'F'};
+  uint32_t ver = 2, h = (uint32_t)g_crash.hlen.load(std::memory_order_acquire);
+  bool ok = ::write(fd, magic, 8) == 8 && ::write(fd, &ver, 4) == 4 &&
+            ::write(fd, &h, 4) == 4 &&
+            (h == 0 || ::write(fd, g_crash.hdr, h) == (ssize_t)h);
+  for (size_t bi = 0; ok && bi < ctx->prof.size(); bi++) {
+    ProfBuf *b = ctx->prof[bi];
+    int64_t spins = 0;
+    bool locked = true;
+    while (b->lock.test_and_set(std::memory_order_acquire))
+      if (++spins > 4000000) { locked = false; break; }
+    size_t n = b->cap_words ? b->count : b->words.size();
+    const int64_t *base = b->words.data();
+    if (n && base) {
+      if (b->cap_words && b->count <= b->cap_words) {
+        size_t start = (b->head + b->cap_words - b->count) % b->cap_words;
+        size_t first = std::min(n, b->cap_words - start);
+        (void)!::write(fd, base + start, first * sizeof(int64_t));
+        if (n > first)
+          (void)!::write(fd, base, (n - first) * sizeof(int64_t));
+      } else if (!b->cap_words) {
+        (void)!::write(fd, base, n * sizeof(int64_t));
+      }
+    }
+    if (locked) b->lock.clear(std::memory_order_release);
+  }
+  /* inflight-slots snapshot: each open EXEC body as a synthetic
+   * PROF_KEY_INFLIGHT instant span (relaxed loads of the MetWorker
+   * watchdog slots) — what this rank was executing when it died */
+  int64_t now = ptc_now_ns();
+  for (size_t w = 0; ok && w < ctx->met_workers.size(); w++) {
+    MetWorker *mw = ctx->met_workers[w];
+    int64_t begin = mw->cur_begin.load(std::memory_order_relaxed);
+    if (!begin) continue;
+    int64_t mid = (int64_t)mw->cur_mid.load(std::memory_order_relaxed);
+    int64_t scope = mw->cur_scope.load(std::memory_order_relaxed);
+    int64_t ev[2][PROF_WORDS] = {
+        {PROF_KEY_INFLIGHT, 0, mid, (int64_t)w, 0, (int64_t)w, scope, begin},
+        {PROF_KEY_INFLIGHT, 1, mid, (int64_t)w, 0, (int64_t)w, scope, now}};
+    (void)!::write(fd, ev, sizeof ev);
+  }
+  ::close(fd);
+}
+
+void crash_handler(int sig, siginfo_t *, void *) {
+  ptc_context *ctx = g_crash.ctx.load(std::memory_order_relaxed);
+  if (ctx && !g_crash.fired.exchange(true)) crash_write(ctx);
+  /* restore the pre-arm disposition and re-raise so the process still
+   * dies with the original signal (core dump + wait status intact) */
+  for (int i = 0; i < 3; i++)
+    if (k_crash_sigs[i] == sig) ::sigaction(sig, &g_crash.prev[i], nullptr);
+  ::raise(sig);
+}
+
+} // namespace
+
+/* internal hook: peer-loss / abort reaping leaves the crash-format
+ * artifact on survivors too (same one-shot as the signal path) */
+void ptc_crash_dump_if_armed(ptc_context *ctx) {
+  if (g_crash.ctx.load(std::memory_order_acquire) != ctx) return;
+  if (g_crash.fired.exchange(true)) return;
+  crash_write(ctx);
+  std::fprintf(stderr, "ptc: crash-format dump written to %s\n",
+               g_crash.path);
+}
+
+extern "C" int32_t ptc_crash_arm(ptc_context_t *ctx, const char *path) {
+  if (!path || !*path) return -1;
+  std::lock_guard<std::mutex> g(g_crash.meta_lock);
+  std::snprintf(g_crash.path, sizeof g_crash.path, "%s", path);
+  crash_format_header(ctx);
+  g_crash.fired.store(false, std::memory_order_relaxed);
+  g_crash.ctx.store(ctx, std::memory_order_release);
+  if (!g_crash.installed) {
+    struct sigaction sa;
+    std::memset(&sa, 0, sizeof sa);
+    sa.sa_sigaction = crash_handler;
+    sa.sa_flags = SA_SIGINFO;
+    sigemptyset(&sa.sa_mask);
+    for (int i = 0; i < 3; i++)
+      ::sigaction(k_crash_sigs[i], &sa, &g_crash.prev[i]);
+    g_crash.installed = true;
+  }
+  return 0;
+}
+
+extern "C" void ptc_crash_update_meta(ptc_context_t *ctx) {
+  std::lock_guard<std::mutex> g(g_crash.meta_lock);
+  if (g_crash.ctx.load(std::memory_order_relaxed) != ctx) return;
+  crash_format_header(ctx);
+}
+
+extern "C" void ptc_crash_disarm(ptc_context_t *ctx) {
+  std::lock_guard<std::mutex> g(g_crash.meta_lock);
+  if (g_crash.ctx.load(std::memory_order_relaxed) != ctx) return;
+  g_crash.ctx.store(nullptr, std::memory_order_release);
+  if (g_crash.installed) {
+    for (int i = 0; i < 3; i++)
+      ::sigaction(k_crash_sigs[i], &g_crash.prev[i], nullptr);
+    g_crash.installed = false;
+  }
+}
+
+extern "C" int32_t ptc_crash_dump_now(ptc_context_t *ctx) {
+  if (g_crash.ctx.load(std::memory_order_acquire) != ctx) return -1;
+  if (g_crash.fired.exchange(true)) return 1; /* already written */
+  crash_write(ctx);
+  return 0;
+}
+
 /* Flight-recorder autodump: at most ONE dump per context (the first
  * failure is the interesting one; later aborts of cascading pools would
  * overwrite it with a trace of the wreckage).  No-op when tracing is
  * off or no dump path is armed (ring mode arms the /tmp default). */
 void ptc_flight_autodump(ptc_context *ctx, const char *reason) {
+  ptc_crash_dump_if_armed(ctx); /* journal-armed ranks get the crash-
+                                 * format artifact (inflight snapshot
+                                 * included) even with tracing off */
   if (ctx->prof_level.load(std::memory_order_relaxed) <= 0) return;
   if (ctx->flight_dump_path.empty()) return;
   if (ctx->flight_dumped.exchange(true, std::memory_order_acq_rel)) return;

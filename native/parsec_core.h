@@ -429,6 +429,22 @@ int32_t ptc_flight_dump(ptc_context_t *ctx, const char *path);
  * mode re-arms the /tmp default); call before the traced run */
 void ptc_flight_set_dump_path(ptc_context_t *ctx, const char *prefix);
 
+/* ---- crash-durable flight recorder (ptc-blackbox) ----
+ * Arm an async-signal-safe SIGSEGV/SIGABRT/SIGBUS handler that
+ * write()s the flight-recorder ring tail + an inflight-slots snapshot
+ * (synthetic PROF_KEY_INFLIGHT instant spans) to `path` as a .ptt v2
+ * file before re-raising the signal.  The header is preformatted on
+ * the normal path; refresh it (clock offsets drift between fences)
+ * with ptc_crash_update_meta on the journal cadence.  One dump per
+ * arming; peer-loss reaping fires the same dump on survivors.  Disarm
+ * restores the previous signal dispositions (call at context destroy).
+ * ptc_crash_dump_now writes the artifact without a signal (returns 0
+ * written, 1 already fired, -1 not armed for this context). */
+int32_t ptc_crash_arm(ptc_context_t *ctx, const char *path);
+void ptc_crash_update_meta(ptc_context_t *ctx);
+void ptc_crash_disarm(ptc_context_t *ctx);
+int32_t ptc_crash_dump_now(ptc_context_t *ctx);
+
 /* ------------------------------------------------------- ptc_metrics
  * Always-on, low-overhead latency metrics: per-worker lock-free
  * log2-bucket histograms (8 linear sub-buckets per octave) accumulated
@@ -659,6 +675,19 @@ void ptc_comm_clock_stats(ptc_context_t *ctx, int64_t *out4);
 /* re-probe now (blocks up to ~2s for at least one fresh sample);
  * returns samples accumulated so far */
 int64_t ptc_comm_clock_sync(ptc_context_t *ctx);
+
+/* inventory-blob replication (ptc-blackbox): share_blob pushes opaque
+ * bytes to every live peer as a control frame (never dirties a fence);
+ * each receiver keeps the LATEST blob per peer, so survivors still
+ * hold a SIGKILLed rank's last checkpoint.  peer_blob copies the blob
+ * from `rank` into out (returns the FULL length; 0 = none yet; -1 =
+ * no comm / bad rank).  peers_lost exports the per-peer loss flags
+ * (1 = connection died outside shutdown); returns entries written. */
+int32_t ptc_comm_share_blob(ptc_context_t *ctx, const void *buf,
+                            int64_t len);
+int64_t ptc_comm_peer_blob(ptc_context_t *ctx, int32_t rank, void *out,
+                           int64_t cap);
+int32_t ptc_comm_peers_lost(ptc_context_t *ctx, int64_t *out, int32_t cap);
 
 /* distributed taskpool id (SPMD creation order; assigned at add_taskpool) */
 int32_t ptc_tp_id(ptc_taskpool_t *tp);

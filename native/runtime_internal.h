@@ -731,6 +731,15 @@ enum {
                            * aux = scope_id), so a merged trace maps
                            * each (src, corr) wire flow back to the
                            * request it served (profiling/scope.py)   */
+  PROF_KEY_INFLIGHT = 10, /* crash-dump synthetic: one instant span per
+                           * OPEN EXEC body at fatal-signal time, built
+                           * from the MetWorker inflight slots inside
+                           * the async-signal-safe crash writer —
+                           * (class = mid, l0 = worker, l1 = 0,
+                           * aux = scope_id, begin stamped at the body's
+                           * cur_begin).  Never emitted on the normal
+                           * path; ptc_postmortem reads these to name
+                           * what a dead rank was executing.           */
 };
 enum { PROF_WORDS = 8 };
 
@@ -1213,6 +1222,13 @@ void ptc_met_absorb(ptc_context *ctx, uint32_t from, int64_t rtt_ns,
  * taskpool abort (core.cpp) and peer loss (comm.cpp) so production
  * failures always leave a last-N-seconds trace behind. */
 void ptc_flight_autodump(ptc_context *ctx, const char *reason);
+
+/* crash-path hook (core.cpp): when ptc_crash_arm has armed this context
+ * and the crash file has not fired yet, write the crash-format dump
+ * (ring tail + inflight-slot snapshot) to the armed path.  Called from
+ * ptc_flight_autodump so peer-loss reaping on survivors leaves the same
+ * artifact a fatal signal would. */
+void ptc_crash_dump_if_armed(ptc_context *ctx);
 
 /* deliver one dependency release to a local successor instance (the
  * incoming half of the remote ACTIVATE path calls this).

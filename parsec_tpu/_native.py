@@ -280,6 +280,10 @@ _sigs = {
     "ptc_profile_dropped": (C.c_int64, [C.c_void_p]),
     "ptc_flight_dump": (C.c_int32, [C.c_void_p, C.c_char_p]),
     "ptc_flight_set_dump_path": (None, [C.c_void_p, C.c_char_p]),
+    "ptc_crash_arm": (C.c_int32, [C.c_void_p, C.c_char_p]),
+    "ptc_crash_update_meta": (None, [C.c_void_p]),
+    "ptc_crash_disarm": (None, [C.c_void_p]),
+    "ptc_crash_dump_now": (C.c_int32, [C.c_void_p]),
     "ptc_worker_stats": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
     "ptc_worker_steals": (C.c_int64, [C.c_void_p, C.POINTER(C.c_int64), C.c_int64]),
     "ptc_prof_event": (None, [C.c_void_p, C.c_int64, C.c_int64, C.c_int64,
@@ -314,6 +318,11 @@ _sigs = {
     "ptc_comm_stream_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_clock_stats": (None, [C.c_void_p, C.POINTER(C.c_int64)]),
     "ptc_comm_clock_sync": (C.c_int64, [C.c_void_p]),
+    "ptc_comm_share_blob": (C.c_int32, [C.c_void_p, C.c_char_p, C.c_int64]),
+    "ptc_comm_peer_blob": (C.c_int64, [C.c_void_p, C.c_int32, C.c_void_p,
+                                       C.c_int64]),
+    "ptc_comm_peers_lost": (C.c_int32, [C.c_void_p, C.POINTER(C.c_int64),
+                                        C.c_int32]),
     "ptc_comm_peer_stats": (C.c_int32, [C.c_void_p, C.POINTER(C.c_int64),
                                         C.c_int32]),
     "ptc_comm_probe_rtts": (C.c_int32, [C.c_void_p]),

@@ -132,6 +132,13 @@ class Context:
         if _mca.get("runtime.watchdog"):
             from ..profiling.metrics import enable_from_param as _wd
             self._watchdog = _wd(self, _mca.get("runtime.watchdog"))
+        # ptc-blackbox: crash-durable event journal + fleet federation
+        self._journal = None
+        self._fleetview = None
+        self._fence_epoch = 0
+        if _mca.get("runtime.journal"):
+            from ..profiling.blackbox import enable_from_param as _jr
+            self._journal = _jr(self, _mca.get("runtime.journal"))
         if _mca.get("runtime.bind") == "core":
             N.lib.ptc_context_set_binding(self._ptr, 1)
         # same-worker ready-task bypass (sched.bypass / PTC_MCA_sched_bypass)
@@ -222,7 +229,8 @@ class Context:
                     ctrl.stop()
                 except Exception:
                     pass
-            for attr in ("_watchdog", "_metrics_exporter"):
+            for attr in ("_fleetview", "_journal", "_watchdog",
+                         "_metrics_exporter"):
                 obj = getattr(self, attr, None)
                 if obj is not None:
                     try:
@@ -318,6 +326,16 @@ class Context:
         PTC_MCA_comm_fence_timeout_s is set (default infinite — a slow
         peer is not a dead peer)."""
         rc = N.lib.ptc_comm_fence(self._ptr)
+        jr = getattr(self, "_journal", None)
+        if rc == 0:
+            # fence-epoch counter: journal records bracket the run into
+            # globally-quiesced intervals (the postmortem's time ruler)
+            self._fence_epoch = getattr(self, "_fence_epoch", 0) + 1
+            if jr is not None:
+                jr.record("fence", epoch=self._fence_epoch)
+        elif jr is not None:
+            jr.record("fence", epoch=getattr(self, "_fence_epoch", 0),
+                      error="peer_lost" if rc == -2 else "timeout")
         if rc == -2:
             raise RuntimeError("comm fence failed: peer lost")
         if rc != 0:
@@ -652,6 +670,11 @@ class Context:
                      drift window, retune/swap counters, last swap,
                      per-tenant adaptive spec_k and budget shares;
                      {"enabled": False} when no Controller is attached
+          fleet   -> ptc-blackbox fleet federation (profiling/blackbox
+                     FleetView): per-replica occupancy/health rows +
+                     fleet-merged per-tenant SLO burn and aggregate
+                     tokens/s; {"enabled": False} when no FleetView is
+                     attached
         """
         from ..utils import params as _plan_mca
         tuning = self.comm_tuning()
@@ -700,6 +723,9 @@ class Context:
             "control": (self._controller.stats()
                         if getattr(self, "_controller", None) is not None
                         else {"enabled": False}),
+            "fleet": (self._fleetview.snapshot()
+                      if getattr(self, "_fleetview", None) is not None
+                      else {"enabled": False}),
         }
 
     def scope_registry(self, create: bool = True):

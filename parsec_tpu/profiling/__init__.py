@@ -20,11 +20,14 @@ TPU-native equivalent over the native core's 8-word event stream
   to_dot         executed-DAG capture from EDGE event pairs
   pins           pluggable instrumentation-module chain at the event
                  points (parsec/mca/pins/pins.h analog), MCA-selected
+  Journal        crash-durable per-rank JSONL flight journal + native
+                 fatal-signal dump arming (ptc-blackbox)
+  FleetView      cross-replica /stats.json federation -> /fleet.json
 """
 from .trace import (KEY_EXEC, KEY_RELEASE, KEY_EDGE,
                     KEY_COMM_SEND, KEY_COMM_RECV, KEY_DEVICE, KEY_H2D,
-                    KEY_STREAM, KEY_COLL, KEY_SCOPE, Dictionary, Trace,
-                    take_trace, to_dot)
+                    KEY_STREAM, KEY_COLL, KEY_SCOPE, KEY_INFLIGHT,
+                    Dictionary, Trace, take_trace, to_dot)
 from .critpath import critical_path, lost_time
 from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
                    CommVolume, DeviceActivity, StragglerLog, REGISTRY,
@@ -32,10 +35,12 @@ from .pins import (PinsModule, PinsChain, TaskCounter, TaskProfiler,
 from .metrics import (Hist, MetricsRegistry, MetricsExporter, Watchdog,
                       snapshot_histograms)
 from .scope import ScopeRegistry, request_timeline
+from .blackbox import Journal, FleetView
 
 __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "KEY_COMM_SEND", "KEY_COMM_RECV", "KEY_DEVICE", "KEY_H2D",
-           "KEY_STREAM", "KEY_COLL", "KEY_SCOPE", "Dictionary", "Trace",
+           "KEY_STREAM", "KEY_COLL", "KEY_SCOPE", "KEY_INFLIGHT",
+           "Dictionary", "Trace",
            "take_trace", "to_dot",
            "critical_path", "lost_time",
            "PinsModule", "PinsChain", "TaskCounter", "TaskProfiler",
@@ -43,4 +48,5 @@ __all__ = ["KEY_EXEC", "KEY_RELEASE", "KEY_EDGE",
            "enable_pins",
            "Hist", "MetricsRegistry", "MetricsExporter", "Watchdog",
            "snapshot_histograms",
-           "ScopeRegistry", "request_timeline"]
+           "ScopeRegistry", "request_timeline",
+           "Journal", "FleetView"]

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import ctypes as C
 import json
+import os
 import sys
 import threading
 import time
@@ -196,12 +197,21 @@ class MetricsRegistry:
         for h in self.histograms(merged=merged):
             key = h.name if (h.kind == N.MET_EXEC and h.name) else "_"
             hists[h.kind_name][key] = h.summary()
+        reg = getattr(self.ctx, "_scope_registry", None)
+        try:
+            scope_hists = reg.tenant_export() if reg is not None else {}
+        except Exception:
+            scope_hists = {}
         return {
             "t": time.time(),
             "rank": self.ctx.myrank,
             "merged": merged,
             "histograms": hists,
             "counters": self.counters(),
+            # ptc-blackbox: per-tenant sparse-bucket export so a remote
+            # FleetView federates /stats.json scrapes bit-identically
+            # to in-process Server scrapes
+            "scope_hists": scope_hists,
         }
 
     # ------------------------------------------------------- prometheus
@@ -249,6 +259,12 @@ class MetricsRegistry:
         if wd is not None:
             lines.append("# TYPE ptc_watchdog_detections_total counter")
             lines.append(f"ptc_watchdog_detections_total {len(wd.events)}")
+        fv = getattr(self.ctx, "_fleetview", None)
+        if fv is not None:
+            try:
+                lines.extend(fv.prometheus_lines())
+            except Exception:
+                pass
         return "\n".join(lines) + "\n"
 
 
@@ -376,6 +392,15 @@ class MetricsExporter:
                                 merged=exporter.merged),
                             default=str).encode()
                         self._send(200, "application/json", body)
+                    elif self.path.startswith("/fleet.json"):
+                        fv = getattr(exporter.ctx, "_fleetview", None)
+                        if fv is None:
+                            self._send(404, "text/plain",
+                                       b"no fleet view attached\n")
+                        else:
+                            self._send(200, "application/json",
+                                       json.dumps(fv.snapshot(),
+                                                  default=str).encode())
                     elif self.path.startswith("/healthz"):
                         wd = getattr(exporter.ctx, "_watchdog", None)
                         st = wd.status() if wd is not None else {
@@ -440,15 +465,20 @@ class Watchdog:
                      > outlier_factor * the median peer RTT (and above
                      1 ms — loopback noise must not page anyone)
 
-    Every non-advisory detection triggers ONE flight-recorder dump per
-    watchdog (tracing must be on for the dump to contain anything), so
-    an incident always leaves a post-mortem artifact next to the event.
+    Every non-advisory detection triggers a flight-recorder dump
+    (tracing must be on for the dump to contain anything), so an
+    incident always leaves a post-mortem artifact next to the event.
+    Dump names carry a per-process run id + a generation seq
+    (`<prefix>.watchdog.<run_id>.<rank>.<seq>.ptt`) so repeat
+    detections never overwrite an earlier incident's artifact;
+    `max_dumps` bounds the generations per run and the emitted event
+    (and its journal record) references the exact path it wrote.
     """
 
     def __init__(self, ctx, interval: float, k: float = 8.0,
                  floor_s: float = 30.0, min_count: int = 20,
                  starve_ticks: int = 3, starve_min_progress: int = 100,
-                 outlier_factor: float = 4.0, max_dumps: int = 1):
+                 outlier_factor: float = 4.0, max_dumps: int = 4):
         self.ctx = ctx
         self.interval = float(interval)
         self.k = float(k)
@@ -461,6 +491,9 @@ class Watchdog:
         self.events: List[dict] = []
         self.ticks = 0
         self._dumps = 0
+        # per-process run id: repeat runs against the same dump prefix
+        # (or repeat detections within one) can never collide on names
+        self._run_id = f"{os.getpid():x}-{int(time.time()) & 0xffffff:x}"
         self._reported = set()  # dedup key per incident
         self._prev_exec: Optional[list] = None
         self._starve_count: Dict[int, int] = {}
@@ -504,7 +537,8 @@ class Watchdog:
                     from ..utils import params as _mca
                     prefix = (_mca.get("runtime.trace_dump")
                               or "/tmp/ptc_flight")
-                    path = f"{prefix}.watchdog.{self.ctx.myrank}.ptt"
+                    path = (f"{prefix}.watchdog.{self._run_id}."
+                            f"{self.ctx.myrank}.{self._dumps}.ptt")
                     self.ctx.flight_dump(path)
                     self._dumps += 1
                     ev["flight_dump"] = path
@@ -513,6 +547,19 @@ class Watchdog:
             except Exception as e:
                 sys.stderr.write(f"ptc-watchdog: flight dump failed "
                                  f"({e!r})\n")
+        # ptc-blackbox: every detection is a durable journal record that
+        # references the dump it corresponds to (after the dump attempt,
+        # so flight_dump rides along when one was written)
+        jr = getattr(self.ctx, "_journal", None)
+        if jr is not None:
+            try:
+                # the detection's own "type" rides as `kind` so it
+                # cannot clobber the journal envelope's record type
+                jr.record("watchdog",
+                          **{("kind" if k == "type" else k): v
+                             for k, v in ev.items()})
+            except Exception:
+                pass
 
     # -------------------------------------------------------- detections
     def _exec_p99(self) -> Dict[int, float]:

@@ -443,6 +443,14 @@ class ScopeRegistry:
         ev.update(fields)
         with self._lock:
             self._events.append(ev)
+        # ptc-blackbox: decision events are journal records too — the
+        # ring above dies with the process, the journal does not
+        jr = getattr(self.ctx, "_journal", None)
+        if jr is not None:
+            try:
+                jr.record("scope_event", **ev)
+            except Exception:
+                pass
 
     def events(self, kind: Optional[str] = None) -> List[dict]:
         """Snapshot of the structured decision log, oldest first,
@@ -629,6 +637,39 @@ class ScopeRegistry:
             "slo": slo,
             "conformance": self.conformance(),
         }
+
+    def live_scopes(self) -> List[dict]:
+        """Every scope not yet terminal (submitted/running) with enough
+        identity for a postmortem to name a dead rank's inflight
+        requests — the ptc-blackbox checkpoint's `live_scopes` field."""
+        with self._lock:
+            return [{"scope_id": sid, "tenant": r.tenant, "kind": r.kind,
+                     "rid": r.rid, "state": r.state}
+                    for sid, r in self.requests.items()
+                    if r.state in ("submitted", "running")]
+
+    def tenant_export(self) -> dict:
+        """Per-tenant counters + SPARSE histogram buckets (native
+        log2/8-sub-bucket indices, so cross-replica merging is pure
+        addition — the same fold as the fence-time MSG_METRICS peer
+        snapshots).  The FleetView scrape input; rides /stats.json as
+        `scope_hists` so remote replicas federate identically."""
+        slo = self.slo_status()
+        with self._lock:
+            out = {}
+            for name, t in self.tenants.items():
+                hists = {}
+                for k, h in t.hists.items():
+                    if not h.count:
+                        continue
+                    nz = np.nonzero(h.buckets)[0]
+                    hists[k] = {
+                        "count": int(h.count), "sum": int(h.sum),
+                        "buckets": [[int(i), int(h.buckets[i])]
+                                    for i in nz]}
+                out[name] = {"counters": dict(t.counters),
+                             "hists": hists, "slo": slo.get(name)}
+        return out
 
     def scope_legend(self) -> dict:
         """scope_id -> {tenant, kind, rid} — stamped into .ptt meta by

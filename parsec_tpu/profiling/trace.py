@@ -57,6 +57,14 @@ KEY_SCOPE = 9      # request-scope flow tag (instant span, emitted
                    # class slot with it (prefetch-lane/STREAM spans stay
                    # -1: overlapped staging is not request lost time).
                    # See profiling/scope.py.
+KEY_INFLIGHT = 10  # crash-dump synthetic (ptc-blackbox): one instant
+                   # span per OPEN EXEC body at fatal-signal / peer-loss
+                   # dump time, built from the watchdog inflight slots
+                   # inside the async-signal-safe crash writer.
+                   # class = metrics class id (mid), l0 = worker,
+                   # aux = scope_id, begin = the body's open timestamp.
+                   # Never emitted on the normal path; ptc_postmortem
+                   # reads these to name what a dead rank was executing.
 
 _MAGIC = b"#PTCPROF"
 _VERSION = 2
@@ -73,6 +81,7 @@ _DEFAULT_KEYS = {
     KEY_STREAM: ("STREAM_D2H", "#ffaa00"),
     KEY_COLL: ("COLL_RECV", "#00ffcc"),
     KEY_SCOPE: ("SCOPE", "#ff00aa"),
+    KEY_INFLIGHT: ("INFLIGHT", "#ff4444"),
 }
 
 

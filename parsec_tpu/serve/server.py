@@ -238,6 +238,19 @@ class Server:
             return dict(self._admission_pressure)
 
     # ---------------------------------------------------------- fleet
+    def _journal_rec(self, op: str, ticket):
+        """ptc-blackbox: every admission decision is a durable journal
+        record (type "serve") so a postmortem can replay the front door
+        without the process's memory."""
+        jr = getattr(self.ctx, "_journal", None)
+        if jr is None:
+            return
+        try:
+            jr.record("serve", op=op, server=self.name,
+                      tenant=ticket.tenant, scope_id=ticket.scope_id)
+        except Exception:
+            pass
+
     def healthy(self) -> bool:
         """The /healthz verdict a router polls: False once closed or
         when any tenant's SLO burn rate breached its threshold (the
@@ -310,6 +323,7 @@ class Server:
             # scope-side terminal: counts as a rejection (the router's
             # re-route counter pairs with it so nothing is lost)
             self.scope.record_rejected(ticket.scope_id)
+        self._journal_rec("cancel", ticket)
         if ticket._pool is not None:
             self._destroy_pool(ticket)  # planning pool never admitted
         return True
@@ -396,6 +410,7 @@ class Server:
                 ticket._event.set()
         if ticket.state == "rejected":
             self.scope.record_rejected(ticket.scope_id)
+            self._journal_rec("reject", ticket)
         if ticket.state == "rejected" and ticket._pool is not None:
             self._destroy_pool(ticket)  # planning pool never admitted
         if admit_now:
@@ -510,6 +525,7 @@ class Server:
         with self._lock:
             t.counters["admitted"] += 1
             t.counters["queue_wait_ns"] += int(ticket.queue_wait_s * 1e9)
+        self._journal_rec("admit", ticket)
         tp.on_complete(lambda: self._on_pool_complete(t, ticket))
         try:
             tp.run()
@@ -522,6 +538,7 @@ class Server:
             ticket.done_t = time.monotonic()
             ticket._event.set()
             self.scope.record_done(ticket.scope_id, state="failed")
+            self._journal_rec("failed", ticket)
 
     def _on_pool_complete(self, t: _TenantState, ticket: Ticket):
         """Fires on the completing worker thread: only mark + wake the
@@ -539,6 +556,7 @@ class Server:
                 ticket.state = "done"
             self._retired.append(ticket)
             self._wake.notify_all()
+        self._journal_rec("failed" if failed else "done", ticket)
         # ptc-scope: fold the pool's conformance record (plan
         # predictions vs measured wall + the pool's QoS lane counters)
         # while the native pool is still alive; the request itself

@@ -237,6 +237,37 @@ register("runtime.live_max_bytes", 64 * 1024 * 1024, int,
          "exceeds this many bytes it rotates to <path>.1 (one "
          "generation kept) so long serving runs cannot grow /tmp "
          "unboundedly; <= 0 disables rotation")
+register("runtime.journal", "", str,
+         "black-box event journal directory (ptc-blackbox; empty = off): "
+         "each rank appends schema-versioned JSONL records (watchdog, "
+         "scope/control events, serve admission, fence epochs, peer "
+         "loss, inventory checkpoints) to <dir>/journal.<rank>.jsonl "
+         "with size-capped rotation and batched fsync, and arms the "
+         "fatal-signal crash dump to <dir>/crash.<rank>.ptt")
+register("runtime.journal_fsync_s", 0.5, float,
+         "journal fsync cadence in seconds: records are buffered and "
+         "flushed+fsynced by the cadence thread so the hot path never "
+         "blocks on disk; <= 0 fsyncs on every flush tick")
+register("runtime.journal_max_bytes", 64 * 1024 * 1024, int,
+         "journal rotation threshold (like runtime.live_max_bytes): "
+         "past this many bytes the journal rotates to <path>.1, one "
+         "generation kept; <= 0 disables rotation")
+register("runtime.journal_checkpoint_s", 5.0, float,
+         "inventory checkpoint cadence in seconds: the journal "
+         "periodically records recovery-relevant inventory (live scope "
+         "ids, QoS pool census, registered providers such as frozen "
+         "page keys) and replicates it to every peer as a MSG_BLOB "
+         "control frame, so survivors hold a dead rank's last "
+         "checkpoint; <= 0 disables checkpoints")
+register("runtime.journal_crash_dump", True, bool,
+         "arm the async-signal-safe SIGSEGV/SIGABRT/SIGBUS handler when "
+         "the journal is enabled: on a fatal signal the flight-recorder "
+         "ring + inflight-slots snapshot is write()n to "
+         "<dir>/crash.<rank>.ptt before re-raising")
+register("runtime.fleet_scrape_s", 2.0, float,
+         "FleetView scrape cadence in seconds (used when a FleetView is "
+         "started without an explicit interval): each tick scrapes every "
+         "replica's stats + health and folds tenant histograms fleet-wide")
 register("comm.base_port", 29650, int, "TCP rendezvous base port")
 register("comm.bcast_topo", "star", str,
          "activation broadcast topology: star|chain|binomial "

@@ -71,7 +71,10 @@ def test_unified_stats_schema_single_rank():
             s = ctx.stats()
             assert set(s) == {"sched", "device", "comm", "coll", "trace",
                               "metrics", "serve", "plan", "scope",
-                              "control"}
+                              "control", "fleet"}
+            # PR 20 (ptc-blackbox): fleet-federation namespace —
+            # schema-stable with no FleetView attached
+            assert s["fleet"] == {"enabled": False}
             # PR 11: request-scope namespace — schema-stable with no
             # registry attached, full rollup once one exists
             assert s["scope"] == {"enabled": False}
@@ -185,8 +188,11 @@ def test_unified_stats_schema_single_rank():
             # scrape (spot-pin the cross-namespace ones)
             reg = ctx.metrics_registry()
             snap = reg.snapshot()
+            # PR 20 (ptc-blackbox): `scope_hists` carries the per-tenant
+            # sparse histogram export FleetView federates across replicas
             assert set(snap) == {"t", "rank", "merged", "histograms",
-                                 "counters"}
+                                 "counters", "scope_hists"}
+            assert isinstance(snap["scope_hists"], dict)
             assert set(snap["histograms"]) == {
                 "exec", "release", "h2d_stall", "comm_wait", "coll_wait"}
             counters = snap["counters"]

@@ -1,0 +1,65 @@
+"""Per-rank worker for the ptc-blackbox SIGKILL postmortem test.
+
+Run as: python _blackbox_kill_worker.py <rank> <nodes> <port> <dir> <victim>
+
+Every rank journals into <dir>, registers a frozen-page-key inventory
+provider, opens a LIVE request scope (admitted, never done) and
+checkpoints — replicating its inventory to every peer as a MSG_BLOB.
+The victim rank then spins until the parent SIGKILLs it; survivors spin
+until the journal's peer-loss poll names the victim, stop their
+journals cleanly and exit 0.  The parent deletes every victim artifact
+before running the postmortem: the report must come from survivor
+artifacts ALONE.
+"""
+import os
+import sys
+import time
+
+
+def main():
+    rank, nodes, port = (int(a) for a in sys.argv[1:4])
+    jdir, victim = sys.argv[4], int(sys.argv[5])
+
+    import parsec_tpu as pt
+    from parsec_tpu.profiling import Journal
+
+    ctx = pt.Context(nb_workers=2)
+    ctx.set_rank(rank, nodes)
+    ctx.comm_init(port)
+    jr = Journal(ctx, dirpath=jdir, fsync_s=0.05, checkpoint_s=0.15)
+    jr.register_inventory(
+        "frozen_page_keys",
+        lambda: [f"page:{rank}:{i}" for i in range(3)])
+
+    reg = ctx.scope_registry()
+    reg.tenant(f"t{rank}")
+    sid = reg.new_scope(tenant=f"t{rank}", kind="request",
+                        rid=f"req-{rank}")
+    reg.record_admitted(sid)  # live forever: the postmortem's holding
+
+    ctx.comm_fence()    # membership + clock sync settled
+    jr.checkpoint()     # inventory replicated to every peer NOW
+    time.sleep(0.5)     # a couple of cadence checkpoints land too
+    with open(os.path.join(jdir, f"ready.{rank}"), "w") as f:
+        f.write("1")
+
+    if rank == victim:
+        while True:     # parent SIGKILLs us mid-spin
+            time.sleep(0.05)
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if victim in jr.lost_peers():
+            break
+        time.sleep(0.05)
+    assert victim in jr.lost_peers(), "peer loss never detected"
+    jr.stop()
+    with open(os.path.join(jdir, f"done.{rank}"), "w") as f:
+        f.write("1")
+    # skip comm_fini/destroy: the mesh has a dead peer and this process
+    # is exiting anyway — the journals on disk are the test's output
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""ptc-blackbox acceptance: 3 ranks, one SIGKILLed mid-run — the
+survivors' artifacts ALONE must let the postmortem assembler name the
+dead rank, its live (inflight) scopes and its frozen page keys.
+
+SIGKILL is the point: the victim gets no signal handler, no atexit, no
+flush — everything the report knows about it must come from the
+checkpoints it replicated to peers (MSG_BLOB) before dying and from the
+survivors' peer-loss records."""
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_blackbox_kill_worker.py")
+POSTMORTEM = os.path.join(REPO, "tools", "ptc_postmortem.py")
+
+NODES, VICTIM = 3, 2
+
+
+def _pick_base_port(n):
+    import random
+    for _ in range(64):
+        base = random.randint(20000, 55000)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def _wait_files(paths, timeout, procs):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(os.path.exists(p) for p in paths):
+            return
+        for p in procs:
+            if p.poll() not in (None, 0, -signal.SIGKILL):
+                raise AssertionError(
+                    f"worker died rc={p.returncode}:\n"
+                    f"{p.stderr.read() if p.stderr else ''}")
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {paths}")
+
+
+def test_sigkill_postmortem_from_survivors_alone(tmp_path):
+    port = _pick_base_port(NODES)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(r), str(NODES), str(port),
+         str(tmp_path), str(VICTIM)],
+        env=env, cwd=REPO, stderr=subprocess.PIPE, text=True)
+        for r in range(NODES)]
+    try:
+        _wait_files([os.path.join(tmp_path, f"ready.{r}")
+                     for r in range(NODES)], 120, procs)
+        procs[VICTIM].kill()  # SIGKILL: no handler, no flush, nothing
+        procs[VICTIM].wait(timeout=30)
+        for r in range(NODES):
+            if r == VICTIM:
+                continue
+            assert procs[r].wait(timeout=120) == 0, procs[r].stderr.read()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    # erase every trace the victim left on disk itself: the postmortem
+    # must reconstruct it from SURVIVOR artifacts only
+    removed = 0
+    for pat in (f"journal.{VICTIM}.jsonl*", f"crash.{VICTIM}.ptt"):
+        for path in glob.glob(os.path.join(tmp_path, pat)):
+            os.remove(path)
+            removed += 1
+    assert removed >= 1  # the victim did journal before dying
+
+    p = subprocess.run(
+        [sys.executable, POSTMORTEM, str(tmp_path), "--json"],
+        env=env, cwd=REPO, timeout=120, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    rep = json.loads(p.stdout)
+
+    assert rep["schema"] == "ptc-postmortem-v1"
+    assert rep["dead_ranks"] == [VICTIM]
+    assert rep["first_cause"]["rank"] == VICTIM
+    assert VICTIM not in rep["ranks"]  # no victim journal was read
+
+    h = rep["holdings"][str(VICTIM)]
+    # the live scope the victim admitted and never finished
+    scopes = h["live_scopes"]
+    assert any(s["tenant"] == f"t{VICTIM}"
+               and s["rid"] == f"req-{VICTIM}"
+               and s["state"] in ("submitted", "running")
+               for s in scopes), scopes
+    # the frozen page keys its provider checkpointed
+    assert set(h["frozen_keys"]) >= {f"page:{VICTIM}:{i}"
+                                     for i in range(3)}
+
+    # both survivors observed the loss
+    losers = {a["rank"] for a in rep["anomalies"]
+              if a["type"] == "peer_loss"}
+    assert losers == {r for r in range(NODES) if r != VICTIM}
+
+    # text mode renders without error and names the victim
+    p = subprocess.run(
+        [sys.executable, POSTMORTEM, str(tmp_path)],
+        env=env, cwd=REPO, timeout=120, capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr
+    assert f"rank {VICTIM}" in p.stdout
+    assert f"page:{VICTIM}:0" in p.stdout

@@ -46,6 +46,12 @@ CHECKS = [
     ("BENCH_trace.json", "overhead_ns_per_task.level1", "lower", 0.50,
      True),
     ("BENCH_trace.json", "ring.vs_unbounded_level1", "lower", 0.10, True),
+    # ptc-blackbox (PR 20): the crash-durable journal must stay
+    # invisible to the level-0 dispatch hot path — the on/off ratio is
+    # an oversubscription-slacked timing trajectory row, the <= 1.05
+    # within_gate verdict an equal-direction flag, never relaxed
+    ("BENCH_trace.json", "journal.overhead_ratio", "lower", 0.05, True),
+    ("BENCH_trace.json", "journal.within_gate", "equal", 0.0, False),
     ("BENCH_collective.json", "coll_vs_chain_ratio", "lower", 0.25, True),
     ("BENCH_collective.json", "gemm_panel.overlap_fraction_gain",
      "higher", 0.50, True),
@@ -105,6 +111,9 @@ CHECKS = [
     ("BENCH_serve.json", "fleet.scaling", "higher", 0.50, True),
     ("BENCH_serve.json", "fleet.hit_rate", "higher", 0.50, True),
     ("BENCH_serve.json", "fleet.bit_identical", "equal", 0.0, False),
+    # ptc-blackbox (PR 20): one FleetView federation refresh over both
+    # replicas (tenant histogram merge + advertise) — timing row
+    ("BENCH_serve.json", "fleet.fleet_scrape_ms", "lower", 0.50, True),
     # ptc-shard (PR 18): 2-/4-rank tensor-parallel decode vs the
     # single-rank reference — bit_identical (tokens AND exact f32
     # pre-logit bytes, prefix cache + speculative decoding live) and

@@ -222,6 +222,41 @@ def render_url(stats, health_code, health):
     return "\n".join(lines)
 
 
+def render_fleet(snap):
+    """One frame of the FleetView /fleet.json snapshot: the replica
+    table then the fleet-merged tenant table (ptc-blackbox)."""
+    lines = []
+    if not (snap or {}).get("enabled"):
+        return "fleet: no snapshot yet (is a FleetView attached?)"
+    reps = snap.get("replicas") or []
+    lines.append(f"fleet  replicas={len(reps)} "
+                 f"healthy={snap.get('healthy_replicas')} "
+                 f"scrapes={snap.get('scrapes')} "
+                 f"errors={snap.get('errors')}")
+    if reps:
+        lines.append(f"  {'replica':<24} {'ok':>3} {'pools':>6} "
+                     f"{'queue':>6} {'burn':>8} {'adm.press':>9}")
+        for r in reps:
+            lines.append(
+                f"  {str(r.get('name'))[:24]:<24} "
+                f"{'y' if r.get('healthy') else 'N':>3} "
+                f"{_fmt(r.get('active_pools'), 0):>6} "
+                f"{_fmt(r.get('queue_depth'), 0):>6} "
+                f"{_fmt(r.get('slo_burn_rate')):>8} "
+                f"{_fmt(r.get('admission_pressure')):>9}")
+    tens = snap.get("tenants") or {}
+    if tens:
+        lines.append(f"  {'tenant':<16} {'burn':>8} {'agg tok/s':>10} "
+                     f"{'ttft p99':>10} {'done':>8}")
+        for name, row in sorted(tens.items()):
+            lines.append(
+                f"  {name[:16]:<16} {_fmt(row.get('slo_burn_rate')):>8} "
+                f"{_fmt(row.get('agg_tokens_per_s'), 1):>10} "
+                f"{_fmt(row.get('ttft_ms_p99'), 1):>10} "
+                f"{_fmt((row.get('counters') or {}).get('completed'), 0):>8}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--live", default=None,
@@ -232,6 +267,9 @@ def main(argv=None):
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit (no screen clear)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the FleetView federation table "
+                         "(polls --url /fleet.json)")
     args = ap.parse_args(argv)
 
     def paths():
@@ -253,6 +291,14 @@ def main(argv=None):
             _, stats = _fetch(args.url, "/stats.json")
             frames.append(render_url(stats if isinstance(stats, dict)
                                      else {}, code, health))
+            if args.fleet:
+                _, fleet = _fetch(args.url, "/fleet.json")
+                frames.append(render_fleet(fleet
+                                           if isinstance(fleet, dict)
+                                           else {}))
+        elif args.fleet:
+            frames.append("fleet: --fleet needs --url "
+                          "(the exporter serves /fleet.json)")
         if not frames:
             frames.append("ptc_top: no live sinks found "
                           "(PTC_MCA_runtime_live=<secs> writes "

@@ -568,6 +568,130 @@ def fleet_churn(workers: int, reqs_per_thread: int = 5,
                 os.environ[k] = v
 
 
+def journal_churn(workers: int, port: int, pools_per_tenant: int = 10,
+                  env=None) -> None:
+    """ptc-blackbox stress under a 2-rank context: each rank runs a
+    Server + a crash-armed Journal with aggressive cadences — record()
+    from submitter/pump/worker threads (serve + scope-event hooks)
+    racing the cadence thread's drain/fsync/rotation, inventory
+    checkpoints snapshotting live scopes + inflight slots + MSG_BLOB
+    replication riding the comm engine, crash-header refreshes
+    (ptc_crash_update_meta reading the clock/ring atomics) racing
+    fence-time clock sync, a FleetView scraping the local server and a
+    reader thread on stats()/prometheus (with the ptc_fleet_* family)
+    — all in one TSan-observed address space.  The fatal-signal writer
+    itself never fires here: its bounded-spin ProfBuf read is
+    crash-path-only by design (a deliberate data race TSan must not
+    see in healthy runs)."""
+    import tempfile
+    import threading
+    import time
+
+    from parsec_tpu.profiling.blackbox import FleetView, Journal
+    from parsec_tpu.serve import Server, TenantConfig
+
+    env = env or {}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    errs = []
+
+    def rank_prog(rank, jdir):
+        try:
+            ctx = pt.Context(nb_workers=workers, scheduler="lws")
+            ctx.set_rank(rank, 2)
+            ctx.comm_init(port)
+            with ctx:
+                ctx.register_arena("t", 8)
+                ctx.profile_enable(1)
+                ctx.profile_ring(1 << 16)
+                jr = Journal(ctx, dirpath=jdir, max_bytes=1 << 16,
+                             fsync_s=0.02, checkpoint_s=0.05)
+                jr.register_inventory(
+                    "frozen_page_keys",
+                    lambda: [f"page:{rank}:{i}" for i in range(4)])
+                srv = Server(ctx, [
+                    TenantConfig("hi", priority=4, weight=3,
+                                 max_pools=3, max_queue=64),
+                    TenantConfig("lo", priority=0, weight=1,
+                                 max_pools=3, max_queue=64),
+                ])
+                fv = FleetView(ctx=ctx, servers=[srv], interval_s=0.01)
+                reg = ctx.metrics_registry()
+
+                def mk(priority, weight):
+                    tp = ctx.taskpool(globals={"N": 15},
+                                      priority=priority, weight=weight)
+                    tc = tp.task_class("C")
+                    tc.param("k", 0, pt.G("N"))
+                    tc.flow("X", "RW",
+                            pt.In(None, guard=(pt.L("k") == 0)),
+                            pt.In(pt.Ref("C", pt.L("k") - 1, flow="X")),
+                            pt.Out(pt.Ref("C", pt.L("k") + 1, flow="X"),
+                                   guard=(pt.L("k") < pt.G("N"))),
+                            arena="t")
+                    tc.body_noop()
+                    return tp
+
+                def submitter(tenant):
+                    for _ in range(pools_per_tenant):
+                        srv.submit(tenant, mk)
+
+                subs = [threading.Thread(target=submitter, args=(t,))
+                        for t in ("hi", "lo")]
+                stop = threading.Event()
+
+                def reader():
+                    while not stop.is_set():
+                        ctx.stats()["fleet"]
+                        reg.prometheus_text()
+                        jr.stats()
+                        jr.lost_peers()
+                        stop.wait(0.005)
+
+                rd = threading.Thread(target=reader, daemon=True)
+                rd.start()
+                for t in subs:
+                    t.start()
+                # fences interleave the MSG_BLOB checkpoints with
+                # clock sync + MSG_METRICS merges
+                for _ in range(3):
+                    ctx.comm_fence()
+                    time.sleep(0.05)
+                for t in subs:
+                    t.join(timeout=120)
+                assert srv.drain(timeout=120)
+                stop.set()
+                rd.join(timeout=10)
+                fv.stop()
+                srv.close()
+                ctx.comm_fence()
+                jr.stop()
+                st = jr.stats()
+                assert st["records"] > 0 and st["checkpoints"] >= 0, st
+                ctx.comm_fini()
+        except Exception as e:  # pragma: no cover - stress harness
+            errs.append((rank, repr(e)))
+
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            dirs = [os.path.join(td, f"r{r}") for r in range(2)]
+            ts = [threading.Thread(target=rank_prog, args=(r, dirs[r]))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+            hung = [t.name for t in ts if t.is_alive()]
+            assert not hung, f"deadlocked rank threads: {hung}"
+            assert not errs, errs
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def control_churn(workers: int, reqs_per_thread: int = 5,
                   env=None) -> None:
     """ptc-pilot churn (PR 19): a live InferenceEngine with ADAPTIVE
@@ -1104,6 +1228,9 @@ def main():
         # serving runtime (PR 9): QoS lanes + concurrent pool
         # creation/retirement + admission churn under a 2-rank context
         serve_churn(workers=4, port=30020 + rep)
+        # ptc-blackbox (PR 20): crash-armed journal + checkpoint blob
+        # replication + FleetView scrapes racing the serve churn
+        journal_churn(workers=4, port=30100 + rep)
         # ptc-share (PR 14): shared-prefix COW/eviction + speculative
         # rollback under concurrent submitters, retirement and scrapes
         prefix_spec_churn(workers=4)
